@@ -1,0 +1,160 @@
+"""AMP: auto_cast / GradScaler / decorate.
+
+Reference: python/paddle/amp/ — auto_cast (auto_cast.py:1006), GradScaler
+(grad_scaler.py:657 — dynamic loss scaling via check_finite_and_unscale +
+update_loss_scaling), decorate (master weights for O2).
+
+TPU-native: bf16 is the default AMP dtype (MXU-native, full fp32 exponent
+range) so GradScaler is a no-op pass-through for bf16 and only does real
+dynamic scaling for fp16 parity.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.amp import state as _state_mod
+from paddle_tpu.amp.state import BLACK_LIST, WHITE_LIST, amp_state
+from paddle_tpu.core import dtype as dtype_mod
+from paddle_tpu.core.tensor import Tensor
+
+
+class auto_cast:
+    """Context manager enabling per-op auto-cast (O1) or full cast (O2)."""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16"):
+        self.enable = enable
+        self.level = level
+        self.dtype = np.dtype(dtype_mod.to_jax_dtype(dtype))
+        self.white = frozenset(custom_white_list or ())
+        self.black = frozenset(custom_black_list or ())
+
+    def __enter__(self):
+        st = amp_state()
+        self._saved = (st.enabled, st.dtype, st.level, st.custom_white, st.custom_black)
+        st.enabled = self.enable
+        st.dtype = self.dtype
+        st.level = self.level
+        st.custom_white = self.white
+        st.custom_black = self.black
+        return self
+
+    def __exit__(self, *exc):
+        st = amp_state()
+        (st.enabled, st.dtype, st.level, st.custom_white, st.custom_black) = self._saved
+        return False
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the AMP dtype. Master fp32 weights
+    are kept by the optimizer (multi_precision=True default in Adam)."""
+    d = dtype_mod.to_jax_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=d)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference grad_scaler.py:657). With bf16 (TPU
+    default) scaling is unnecessary; enabled only for fp16."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0**15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def scale(self, var: Tensor) -> Tensor:
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        if self._unscaled:
+            # reference grad_scaler raises on double-unscale; guard the
+            # "unscale_ then step" pattern (e.g. external grad clipping)
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer since "
+                "the last update()")
+        import jax.numpy as jnp
+
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list or []:
+            if p.grad is not None:
+                g = p.grad._value * inv
+                p.grad = Tensor._wrap(g)
+                if bool(jnp.any(~jnp.isfinite(g))):
+                    found = True
+        self._found_inf = found
+        self._unscaled = True
+
+    def step(self, optimizer):
+        """Unscale (if not already) and step when grads are finite. Call
+        update() afterwards (reference pattern: scaler.step(opt);
+        scaler.update())."""
+        if not self._enable:
+            optimizer.step()
+            return
+        if not self._unscaled:
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+        self.update()
+
+    def update(self):
+        self._unscaled = False
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def get_scale(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def set_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good_steps = state["good_steps"]
+        self._bad_steps = state["bad_steps"]

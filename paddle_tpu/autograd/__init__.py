@@ -1,0 +1,4 @@
+"""paddle_tpu.autograd — reference: python/paddle/autograd/."""
+from paddle_tpu.autograd.engine import (  # noqa: F401
+    backward, enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled,
+)

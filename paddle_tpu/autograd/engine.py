@@ -1,0 +1,296 @@
+"""Eager autograd: tape of GradNodes + reverse topological backward engine.
+
+Reference architecture being mirrored (not ported):
+  - GradNodeBase slot-edge graph: paddle/fluid/eager/grad_node_info.h:197
+  - backward engine (dual-queue topo walk + GradTensorHolder accumulation):
+    paddle/fluid/eager/backward.cc:25-214
+  - leaf accumulation: paddle/fluid/eager/accumulation/accumulation_node.h:26
+  - partial-graph paddle.grad: paddle/fluid/eager/general_grad.h
+
+TPU-native design: instead of per-op hand-written grad kernels, every recorded
+op captures the `jax.vjp` of its (pure, jittable) implementation at forward
+time. The vjp closure holds device residuals (the analogue of TensorWrapper,
+tensor_wrapper.h:39). backward() walks the node graph host-side; all math runs
+as XLA ops on device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.dtypes import float0
+
+# ---------------------------------------------------------------- grad mode
+
+_grad_enabled = True
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    global _grad_enabled
+    _grad_enabled = bool(mode)
+
+
+class no_grad:
+    """Context manager / decorator: disable autograd recording.
+
+    Reference: python/paddle/autograd (paddle.no_grad).
+    """
+
+    def __enter__(self):
+        self._prev = _grad_enabled
+        set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*args, **kwargs):
+            with type(self)():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad(no_grad):
+    def __enter__(self):
+        self._prev = _grad_enabled
+        set_grad_enabled(True)
+        return self
+
+
+# ---------------------------------------------------------------- GradNode
+
+
+class GradNode:
+    """One recorded op. vjp_fn maps output cotangents -> input cotangents."""
+
+    __slots__ = (
+        "name",
+        "vjp_fn",
+        "inputs",
+        "out_avals",
+        "holder",
+        "multi_output",
+        "_pending",
+    )
+
+    def __init__(self, name: str, vjp_fn, inputs: Sequence[Any], out_avals,
+                 multi_output: bool = False):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)  # Tensor objects, aligned with vjp outputs
+        self.out_avals = out_avals  # [(shape, dtype)] per forward output
+        self.holder: Dict[int, Any] = {}  # out_idx -> accumulated cotangent
+        self.multi_output = multi_output
+        self._pending = 0
+
+    def accumulate_out_grad(self, idx: int, grad):
+        cur = self.holder.get(idx)
+        self.holder[idx] = grad if cur is None else cur + grad
+
+    def materialize_out_grads(self) -> List[Any]:
+        grads = []
+        for i, (shape, dtype) in enumerate(self.out_avals):
+            g = self.holder.get(i)
+            if g is None:
+                if jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(
+                    dtype, jnp.complexfloating
+                ):
+                    g = jnp.zeros(shape, dtype)
+                else:
+                    g = np.zeros(shape, dtype=float0)
+            grads.append(g)
+        return grads
+
+    def release(self):
+        self.vjp_fn = None
+        self.inputs = []
+        self.holder = {}
+
+
+# ---------------------------------------------------------------- engine
+
+
+def _is_float0(g) -> bool:
+    return getattr(g, "dtype", None) == float0
+
+
+def run_backward(
+    tensors: Sequence[Any],
+    grad_tensors: Sequence[Any] = None,
+    retain_graph: bool = False,
+    inputs: Optional[Sequence[Any]] = None,
+    accumulate_into_grad: bool = True,
+):
+    """Reverse-mode walk. If `inputs` given, returns their grads (paddle.grad
+    semantics, reference general_grad.h); otherwise writes `.grad` on leaves.
+    """
+    from paddle_tpu.core.tensor import Tensor  # late import, avoids cycle
+
+    roots = [t for t in tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(roots)
+
+    capture: Dict[int, Any] = {}
+    capture_ids = {id(t) for t in inputs} if inputs is not None else None
+
+    # ---- seed root gradients
+    ready: List[GradNode] = []
+    cons_count: Dict[int, int] = {}
+    nodes: Dict[int, GradNode] = {}
+
+    # discover reachable graph, count consumer edges (iterative — deep op
+    # chains exceed Python's recursion limit)
+    def discover(root: GradNode):
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            if id(node) in nodes:
+                continue
+            nodes[id(node)] = node
+            for t in node.inputs:
+                prod = t._grad_node[0] if t._grad_node is not None else None
+                if prod is not None and not t.stop_gradient:
+                    cons_count[id(prod)] = cons_count.get(id(prod), 0) + 1
+                    stack.append(prod)
+
+    root_nodes = []
+    for t, g in zip(roots, grad_tensors):
+        if t.stop_gradient and t._grad_node is None:
+            continue
+        if g is None:
+            gval = jnp.ones(t.shape, t.dtype)
+        else:
+            gval = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        if t._grad_node is None:
+            _accumulate_leaf(t, gval, capture, capture_ids, accumulate_into_grad)
+            continue
+        node, idx = t._grad_node
+        node.accumulate_out_grad(idx, gval)
+        root_nodes.append(node)
+
+    for n in root_nodes:
+        discover(n)
+
+    for nid, n in nodes.items():
+        if cons_count.get(nid, 0) == 0:
+            ready.append(n)
+
+    # de-dup ready (same node rooted twice)
+    seen = set()
+    queue = []
+    for n in ready:
+        if id(n) not in seen:
+            seen.add(id(n))
+            queue.append(n)
+
+    # ---- process
+    while queue:
+        node = queue.pop()
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"GradNode {node.name} already released; pass retain_graph=True "
+                "to backward() to allow a second backward pass."
+            )
+        out_grads = node.materialize_out_grads()
+        # jax.vjp returns a function of ONE cotangent matching the primal
+        # output structure (tuple for multi-output ops)
+        cot = tuple(out_grads) if node.multi_output else out_grads[0]
+        in_grads = node.vjp_fn(cot)
+        if not isinstance(in_grads, (tuple, list)):
+            in_grads = (in_grads,)
+        for t, g in zip(node.inputs, in_grads):
+            if g is None or _is_float0(g) or t.stop_gradient:
+                continue
+            for hook in t._hooks:
+                new = hook(Tensor._wrap(g))
+                if new is not None:
+                    g = new._value if isinstance(new, Tensor) else new
+            prod = t._grad_node
+            if prod is None:
+                _accumulate_leaf(t, g, capture, capture_ids, accumulate_into_grad)
+            else:
+                pnode, pidx = prod
+                pnode.accumulate_out_grad(pidx, g)
+                cons_count[id(pnode)] -= 1
+                if cons_count[id(pnode)] == 0:
+                    queue.append(pnode)
+        if not retain_graph:
+            node.release()
+        else:
+            node.holder = {}
+
+    if inputs is not None:
+        return [capture.get(id(t)) for t in inputs]
+    return None
+
+
+def _accumulate_leaf(t, g, capture, capture_ids, accumulate_into_grad):
+    from paddle_tpu.core.tensor import Tensor
+
+    if capture_ids is not None and id(t) in capture_ids:
+        prev = capture.get(id(t))
+        capture[id(t)] = Tensor._wrap(g if prev is None else prev._value + g)
+    if accumulate_into_grad:
+        if t.grad is None:
+            t.grad = Tensor._wrap(g)
+        else:
+            t.grad = Tensor._wrap(t.grad._value + g)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward equivalent."""
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    allow_unused=False,
+):
+    """paddle.grad — partial-graph gradients (reference general_grad.h)."""
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True in eager mode is not supported; use the "
+            "functional API (paddle_tpu.jit) for higher-order AD."
+        )
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = False
+    res = run_backward(
+        outputs,
+        grad_outputs,
+        retain_graph=retain_graph,
+        inputs=inputs,
+        accumulate_into_grad=False,
+    )
+    if not allow_unused:
+        for t, g in zip(inputs, res):
+            if g is None:
+                raise RuntimeError(
+                    "one of the input tensors received no gradient; pass "
+                    "allow_unused=True to permit this"
+                )
+    return res

@@ -1,0 +1,2 @@
+from paddle_tpu.core import dtype, place, random  # noqa: F401
+from paddle_tpu.core.tensor import Parameter, Tensor  # noqa: F401

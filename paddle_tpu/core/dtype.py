@@ -1,0 +1,88 @@
+"""Dtype system.
+
+Mirrors the reference's phi::DataType enum (paddle/phi/common/data_type.h) and
+the type-promotion table (paddle/phi/common/type_promotion.h:53) — but delegates
+promotion to jax.numpy's lattice, which matches NumPy semantics the reference
+emulates. Canonical names are the paddle-style strings ("float32", ...).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# canonical name -> jnp dtype
+_NAME_TO_DTYPE = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "int": "int32",
+    "long": "int64",
+    "bf16": "bfloat16",
+    "fp16": "float16",
+    "fp32": "float32",
+    "fp64": "float64",
+}
+
+bool_ = _NAME_TO_DTYPE["bool"]
+uint8 = _NAME_TO_DTYPE["uint8"]
+int8 = _NAME_TO_DTYPE["int8"]
+int16 = _NAME_TO_DTYPE["int16"]
+int32 = _NAME_TO_DTYPE["int32"]
+int64 = _NAME_TO_DTYPE["int64"]
+float16 = _NAME_TO_DTYPE["float16"]
+bfloat16 = _NAME_TO_DTYPE["bfloat16"]
+float32 = _NAME_TO_DTYPE["float32"]
+float64 = _NAME_TO_DTYPE["float64"]
+complex64 = _NAME_TO_DTYPE["complex64"]
+complex128 = _NAME_TO_DTYPE["complex128"]
+
+
+def to_jax_dtype(dtype):
+    """Normalize a paddle-style dtype spec (str / np / jnp dtype) to np.dtype.
+    Canonicalized per the active x64 mode: with x64 disabled (TPU default)
+    int64/float64 map to int32/float32, matching XLA-native widths."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        dtype = _NAME_TO_DTYPE[_ALIASES.get(dtype, dtype)]
+    from jax.dtypes import canonicalize_dtype
+
+    return np.dtype(canonicalize_dtype(np.dtype(dtype)))
+
+
+def dtype_name(dtype) -> str:
+    """Canonical string name for a dtype."""
+    return np.dtype(dtype).name if np.dtype(dtype).name != "bool" else "bool"
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), jnp.integer)
+
+
+def is_complex(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(dtype), jnp.complexfloating)
+
+
+def promote_types(a, b):
+    """Binary promotion — reference: phi promoteTypes (type_promotion.h:53)."""
+    return jnp.promote_types(to_jax_dtype(a), to_jax_dtype(b))

@@ -1,0 +1,96 @@
+"""Places and device selection.
+
+Reference: paddle Place family (paddle/phi/common/place.h) — CPUPlace /
+GPUPlace / XPUPlace / CustomPlace — and the python device API
+(python/paddle/device/__init__.py, set_device/get_device).
+
+TPU-native design: a Place names a JAX backend + device index. The framework
+keeps one process-global "expected place"; eager tensors are committed to that
+device, and jit programs inherit shardings from their inputs. The virtual
+multi-device CPU backend (jax_num_cpu_devices) gives N fake devices in one
+process for tests — richer than the reference's fake_cpu_device.h story.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class Place:
+    """A (backend, device_id) pair."""
+
+    backend: str = "cpu"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def jax_device(self):
+        return jax.devices(self.backend)[self.device_id]
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.backend == other.backend
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.backend, self.device_id))
+
+    def __repr__(self):
+        return f"Place({self.backend}:{self.device_id})"
+
+
+class CPUPlace(Place):
+    backend = "cpu"
+
+
+class TPUPlace(Place):
+    backend = "tpu"
+
+
+_expected_place: Place | None = None
+
+
+def _default_backend() -> str:
+    return jax.default_backend()
+
+
+def set_device(device: str) -> Place:
+    """paddle.device.set_device — "cpu", "tpu", "tpu:0"."""
+    global _expected_place
+    if ":" in device:
+        backend, idx = device.split(":")
+        idx = int(idx)
+    else:
+        backend, idx = device, 0
+    cls = {"cpu": CPUPlace, "tpu": TPUPlace}.get(backend)
+    if cls is None:
+        place = Place(idx)
+        place.backend = backend
+    else:
+        place = cls(idx)
+    _expected_place = place
+    return place
+
+
+def get_device() -> str:
+    p = expected_place()
+    return f"{p.backend}:{p.device_id}"
+
+
+def expected_place() -> Place:
+    global _expected_place
+    if _expected_place is None:
+        backend = _default_backend()
+        cls = {"cpu": CPUPlace, "tpu": TPUPlace}.get(backend)
+        if cls is None:
+            _expected_place = Place(0)
+            _expected_place.backend = backend
+        else:
+            _expected_place = cls(0)
+    return _expected_place
+
+
+def device_count(backend: str | None = None) -> int:
+    return len(jax.devices(backend or expected_place().backend))

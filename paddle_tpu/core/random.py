@@ -1,0 +1,55 @@
+"""RNG state.
+
+Reference: phi::Generator (paddle/phi/core/generator.h:32) and
+paddle.seed/get_rng_state (python/paddle/framework/random.py:28/72).
+
+TPU-native design: a Generator holds a JAX PRNG key plus a python-side offset
+counter. `next_key()` = fold_in(key, ++offset) — deterministic, stateless on
+device, and trace-friendly: under `to_static` tracing the functionalizer swaps
+`key` for a traced input so each compiled step consumes fresh randomness, while
+the static per-call-site offsets keep distinct streams per dropout site
+(analogue of the reference's TP-safe RNG tracker, fleet/layers/mpu/random.py).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self.key = jax.random.key(self._seed)
+        self.offset = 0
+        return self
+
+    def next_key(self):
+        self.offset += 1
+        return jax.random.fold_in(self.key, self.offset)
+
+    def get_state(self):
+        return {"seed": self._seed, "key": self.key, "offset": self.offset}
+
+    def set_state(self, state):
+        self._seed = state["seed"]
+        self.key = state["key"]
+        self.offset = state["offset"]
+
+
+default_generator = Generator(0)
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed"""
+    return default_generator.manual_seed(s)
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state):
+    default_generator.set_state(state)

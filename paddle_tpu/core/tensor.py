@@ -1,0 +1,366 @@
+"""The eager Tensor.
+
+Reference: paddle::Tensor (paddle/phi/api/include/tensor.h:82) — a refcounted
+handle over a DenseTensor with attached AutogradMeta
+(paddle/fluid/eager/autograd_meta.h:61) — plus the python Tensor methods bound
+in paddle/fluid/pybind/eager_method.cc.
+
+TPU-native design: `_value` is a jax.Array (a PJRT buffer on TPU) or a JAX
+tracer (so the whole eager API is traceable by `paddle_tpu.jit.to_static` —
+one codebase serves both the eager and the compiled universe, where the
+reference needs two). Autograd state = (stop_gradient, grad, _grad_node);
+`_grad_node` points at the producing GradNode + output slot, exactly the
+reference's slot-edge shape (grad_node_info.h:197).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd import engine
+from paddle_tpu.core import dtype as dtype_mod
+from paddle_tpu.core.place import expected_place
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "grad",
+        "_grad_node",
+        "_hooks",
+        "name",
+        "persistable",
+        "trainable",
+        "__weakref__",
+    )
+
+    def __init__(self, value, stop_gradient: bool = True, name: str = ""):
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self._grad_node = None
+        self._hooks = []
+        self.name = name
+        self.persistable = False
+        self.trainable = not stop_gradient
+
+    # ------------------------------------------------------------- basics
+
+    @staticmethod
+    def _wrap(value) -> "Tensor":
+        return Tensor(value, stop_gradient=True)
+
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def T(self):
+        from paddle_tpu.ops.registry import C_OPS
+
+        return C_OPS.t(self)
+
+    @property
+    def place(self):
+        devs = getattr(self._value, "devices", None)
+        if callable(devs):
+            try:
+                return next(iter(self._value.devices()))
+            except Exception:
+                return None
+        return None
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    def numel(self):
+        return self.size
+
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self):
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, dtype):
+        from paddle_tpu.ops.registry import C_OPS
+
+        return C_OPS.cast(self, dtype_mod.to_jax_dtype(dtype))
+
+    cast = astype
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._value, stop_gradient=True, name=self.name)
+
+    def clone(self) -> "Tensor":
+        from paddle_tpu.ops.registry import C_OPS
+
+        return C_OPS.scale(self, 1.0)
+
+    def to(self, *args, device=None, dtype=None, blocking=None):
+        out = self
+        for a in args:
+            if isinstance(a, str) and a.split(":")[0] in ("cpu", "tpu"):
+                device = a
+            else:
+                dtype = a
+        if device is not None:
+            name, _, idx = str(device).partition(":")
+            dev = jax.devices(name)[int(idx) if idx else 0]
+            out = Tensor(jax.device_put(out._value, dev),
+                         stop_gradient=out.stop_gradient)
+        if dtype is not None:
+            out = out.astype(dtype)
+        return out
+
+    def cpu(self):
+        return self.to("cpu")
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    # ------------------------------------------------------------ autograd
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        engine.backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def gradient(self):
+        return None if self.grad is None else self.grad.numpy()
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor._wrap(jnp.zeros_like(self.grad._value))
+        else:
+            self.grad = None
+
+    def register_hook(self, hook):
+        self._hooks.append(hook)
+
+        class _Handle:
+            def remove(inner):
+                try:
+                    self._hooks.remove(hook)
+                except ValueError:
+                    pass
+
+        return _Handle()
+
+    def zero_(self):
+        self._inplace_update(jnp.zeros_like(self._value))
+        return self
+
+    def fill_(self, value):
+        self._inplace_update(jnp.full_like(self._value, value))
+        return self
+
+    def copy_(self, other, blocking=True):
+        v = other._value if isinstance(other, Tensor) else jnp.asarray(other)
+        self._inplace_update(v.astype(self._value.dtype))
+        return self
+
+    def set_value(self, value):
+        self.copy_(value)
+
+    def _inplace_update(self, new_value):
+        if not self.stop_gradient and engine.is_grad_enabled() and self._grad_node is not None:
+            raise RuntimeError(
+                "in-place update on a non-leaf tensor that requires grad is "
+                "not supported; wrap in paddle_tpu.no_grad() or use detach()"
+            )
+        self._value = new_value
+
+    # ------------------------------------------------------------ indexing
+
+    def __getitem__(self, idx):
+        from paddle_tpu.ops.registry import dispatch
+
+        idx = _normalize_index(idx)
+        return dispatch("_getitem", (self,), {"idx": idx})
+
+    def __setitem__(self, idx, value):
+        idx = _normalize_index(idx)
+        v = value._value if isinstance(value, Tensor) else value
+        self._inplace_update(self._value.at[idx].set(v))
+
+    # ---------------------------------------------------------- operators
+
+    def _binop(self, name, other, reverse=False):
+        from paddle_tpu.ops.registry import C_OPS
+
+        fn = getattr(C_OPS, name)
+        if reverse:
+            return fn(_as_tensor_like(other, self), self)
+        return fn(self, _as_tensor_like(other, self))
+
+    def __add__(self, o):
+        return self._binop("add", o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop("subtract", o)
+
+    def __rsub__(self, o):
+        return self._binop("subtract", o, reverse=True)
+
+    def __mul__(self, o):
+        return self._binop("multiply", o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop("divide", o)
+
+    def __rtruediv__(self, o):
+        return self._binop("divide", o, reverse=True)
+
+    def __floordiv__(self, o):
+        return self._binop("floor_divide", o)
+
+    def __mod__(self, o):
+        return self._binop("remainder", o)
+
+    def __pow__(self, o):
+        return self._binop("pow", o)
+
+    def __rpow__(self, o):
+        return self._binop("pow", o, reverse=True)
+
+    def __matmul__(self, o):
+        return self._binop("matmul", o)
+
+    def __neg__(self):
+        from paddle_tpu.ops.registry import C_OPS
+
+        return C_OPS.neg(self)
+
+    def __abs__(self):
+        from paddle_tpu.ops.registry import C_OPS
+
+        return C_OPS.abs(self)
+
+    def __eq__(self, o):
+        return self._binop("equal", o)
+
+    def __ne__(self, o):
+        return self._binop("not_equal", o)
+
+    def __lt__(self, o):
+        return self._binop("less_than", o)
+
+    def __le__(self, o):
+        return self._binop("less_equal", o)
+
+    def __gt__(self, o):
+        return self._binop("greater_than", o)
+
+    def __ge__(self, o):
+        return self._binop("greater_equal", o)
+
+    def __invert__(self):
+        from paddle_tpu.ops.registry import C_OPS
+
+        return C_OPS.logical_not(self)
+
+    def __hash__(self):
+        return id(self)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        grad_s = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={dtype_mod.dtype_name(self.dtype)}"
+            f"{grad_s},\n       {np.asarray(self._value)!r})"
+        )
+
+    # jax pytree-friendliness: let jnp.asarray(tensor) work
+    def __jax_array__(self):
+        return self._value
+
+
+class Parameter(Tensor):
+    """Trainable parameter (reference: paddle EagerParamBase,
+    python/paddle/base/framework.py)."""
+
+    __slots__ = ("optimize_attr", "regularizer", "is_distributed", "_sharding")
+
+    def __init__(self, value, trainable: bool = True, name: str = ""):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+        self._sharding = None  # PartitionSpec for parallel builds
+
+
+def _as_tensor_like(other, ref: Tensor):
+    if isinstance(other, Tensor):
+        return other
+    arr = jnp.asarray(other)
+    if np.issubdtype(arr.dtype, np.floating) and np.issubdtype(
+        ref.dtype, np.floating
+    ):
+        arr = arr.astype(ref.dtype)
+    if np.issubdtype(arr.dtype, np.integer) and np.issubdtype(ref.dtype, np.integer):
+        arr = arr.astype(ref.dtype)
+    return Tensor._wrap(arr)
+
+
+def _normalize_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._value
+    if isinstance(idx, tuple):
+        return tuple(i._value if isinstance(i, Tensor) else i for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(idx)
+    return idx

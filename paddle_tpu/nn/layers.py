@@ -1,0 +1,402 @@
+"""Standard layers.
+
+Reference: python/paddle/nn/layer/{common.py,conv.py,norm.py,pooling.py,
+activation.py}. Weight layouts follow paddle: Linear weight [in, out],
+Conv2D weight [out, in/groups, kh, kw].
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.ops.registry import C_OPS as _C
+
+
+def _init_from_attr(attr, default):
+    if attr is None:
+        return default, None
+    if isinstance(attr, I.Initializer):
+        return attr, None
+    if isinstance(attr, dict):
+        return attr.get("initializer", default), attr.get("sharding")
+    return default, None
+
+
+class Linear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        w_init, w_shard = _init_from_attr(weight_attr, I.XavierNormal())
+        self.weight = self.create_parameter(
+            [in_features, out_features], default_initializer=w_init,
+            attr={"sharding": w_shard} if w_shard else None)
+        if bias_attr is False:
+            self.bias = None
+        else:
+            b_init, b_shard = _init_from_attr(bias_attr, I.Constant(0.0))
+            self.bias = self.create_parameter(
+                [out_features], is_bias=True, default_initializer=b_init,
+                attr={"sharding": b_shard} if b_shard else None)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+    def extra_repr(self):
+        return f"in_features={self.in_features}, out_features={self.out_features}"
+
+
+class Embedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
+                 sparse=False, weight_attr=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.padding_idx = padding_idx
+        w_init, w_shard = _init_from_attr(weight_attr, I.Normal(0.0, 1.0))
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], default_initializer=w_init,
+            attr={"sharding": w_shard} if w_shard else None)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, padding_idx=self.padding_idx)
+
+    def extra_repr(self):
+        return f"{self.num_embeddings}, {self.embedding_dim}"
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, axis=None, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x):
+        return F.dropout(x, p=self.p, training=self.training, mode=self.mode)
+
+
+class Dropout2D(Dropout):
+    pass
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self.start_axis = start_axis
+        self.stop_axis = stop_axis
+
+    def forward(self, x):
+        return _C.flatten(x, self.start_axis, self.stop_axis)
+
+
+class Identity(Layer):
+    def forward(self, x):
+        return x
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 align_corners=False, data_format="NCHW"):
+        super().__init__()
+        self.size, self.scale_factor = size, scale_factor
+        self.mode, self.align_corners = mode, align_corners
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size, scale_factor=self.scale_factor,
+                             mode=self.mode, align_corners=self.align_corners)
+
+
+# ---------------------------------------------------------------- conv
+
+
+class Conv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, (list, tuple)) else (kernel_size,) * 2
+        self._stride, self._padding, self._dilation = stride, padding, dilation
+        self._groups = groups
+        fan_in = in_channels // groups * k[0] * k[1]
+        w_init, w_shard = _init_from_attr(
+            weight_attr, I.Uniform(-np.sqrt(1 / fan_in), np.sqrt(1 / fan_in)))
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, k[0], k[1]],
+            default_initializer=w_init,
+            attr={"sharding": w_shard} if w_shard else None)
+        if bias_attr is False:
+            self.bias = None
+        else:
+            b_init, _ = _init_from_attr(bias_attr, I.Constant(0.0))
+            self.bias = self.create_parameter([out_channels], is_bias=True,
+                                              default_initializer=b_init)
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, stride=self._stride,
+                        padding=self._padding, dilation=self._dilation,
+                        groups=self._groups)
+
+
+class Conv1D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+        self._stride, self._padding, self._dilation = stride, padding, dilation
+        self._groups = groups
+        fan_in = in_channels // groups * k
+        w_init, _ = _init_from_attr(
+            weight_attr, I.Uniform(-np.sqrt(1 / fan_in), np.sqrt(1 / fan_in)))
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, k], default_initializer=w_init)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], is_bias=True)
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight, self.bias, stride=self._stride,
+                        padding=self._padding, dilation=self._dilation,
+                        groups=self._groups)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, (list, tuple)) else (kernel_size,) * 2
+        self._stride, self._padding = stride, padding
+        self._output_padding, self._dilation = output_padding, dilation
+        self._groups = groups
+        fan_in = in_channels // groups * k[0] * k[1]
+        w_init, _ = _init_from_attr(
+            weight_attr, I.Uniform(-np.sqrt(1 / fan_in), np.sqrt(1 / fan_in)))
+        # paddle conv_transpose weight layout: [in, out/groups, kh, kw]
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups, k[0], k[1]],
+            default_initializer=w_init)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], is_bias=True)
+
+    def forward(self, x):
+        return F.conv2d_transpose(
+            x, self.weight, self.bias, stride=self._stride,
+            padding=self._padding, output_padding=self._output_padding,
+            dilation=self._dilation, groups=self._groups)
+
+
+# ---------------------------------------------------------------- norm
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        n = int(np.prod(normalized_shape))
+        self.weight = None if weight_attr is False else self.create_parameter(
+            [n], default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [n], is_bias=True)
+
+    def forward(self, x):
+        return F.layer_norm(x, self.weight, self.bias, epsilon=self._epsilon,
+                            begin_norm_axis=x.ndim - len(self._normalized_shape))
+
+
+class RMSNorm(Layer):
+    """Reference: paddle.incubate.nn.FusedRMSNorm — XLA fuses the chain."""
+
+    def __init__(self, hidden_size, epsilon=1e-6):
+        super().__init__()
+        self._epsilon = epsilon
+        self.weight = self.create_parameter([hidden_size],
+                                            default_initializer=I.Constant(1.0))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, epsilon=self._epsilon)
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None):
+        super().__init__()
+        self._momentum, self._epsilon = momentum, epsilon
+        self._data_format = data_format
+        self.weight = None if weight_attr is False else self.create_parameter(
+            [num_features], default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_features], is_bias=True)
+        import jax.numpy as jnp
+
+        self.register_buffer("_mean", Tensor._wrap(jnp.zeros(num_features, jnp.float32)))
+        self.register_buffer("_variance", Tensor._wrap(jnp.ones(num_features, jnp.float32)))
+
+    def forward(self, x):
+        out, new_mean, new_var = F.batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=self.training, momentum=self._momentum,
+            epsilon=self._epsilon, data_format=self._data_format)
+        if self.training:
+            self._mean._value = new_mean._value
+            self._variance._value = new_var._value
+        return out
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+BatchNorm = _BatchNormBase
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Under GSPMD data parallelism the batch axis is sharded and XLA computes
+    global statistics automatically inside jit — so SyncBatchNorm == BatchNorm
+    on TPU (the reference needs a dedicated NCCL kernel,
+    paddle/phi/kernels/gpu/sync_batch_norm_kernel.cu)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self.weight = None if weight_attr is False else self.create_parameter(
+            [num_channels], default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_channels], is_bias=True)
+
+    def forward(self, x):
+        return F.group_norm(x, self.weight, self.bias, epsilon=self._epsilon,
+                            groups=self._num_groups,
+                            data_format=self._data_format)
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.scale = None if weight_attr is False else self.create_parameter(
+            [num_features], default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_features], is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, self.scale, self.bias, epsilon=self._epsilon)
+
+
+# ---------------------------------------------------------------- pooling
+
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False):
+        super().__init__()
+        self.k, self.s, self.p, self.ceil_mode = kernel_size, stride, padding, ceil_mode
+
+    def forward(self, x):
+        return F.max_pool2d(x, self.k, self.s, self.p, self.ceil_mode)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True):
+        super().__init__()
+        self.k, self.s, self.p = kernel_size, stride, padding
+        self.ceil_mode, self.exclusive = ceil_mode, exclusive
+
+    def forward(self, x):
+        return F.avg_pool2d(x, self.k, self.s, self.p, self.ceil_mode,
+                            self.exclusive)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size)
+
+
+class AdaptiveMaxPool2D(Layer):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self.output_size)
+
+
+# ---------------------------------------------------------------- activations
+
+
+def _act_layer(name, fn, params=()):
+    def __init__(self, *args, **kwargs):
+        Layer.__init__(self)
+        self._args = args
+        self._kwargs = kwargs
+
+    def forward(self, x):
+        return fn(x, *self._args, **self._kwargs)
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+ReLU = _act_layer("ReLU", F.relu)
+ReLU6 = _act_layer("ReLU6", F.relu6)
+GELU = _act_layer("GELU", F.gelu)
+Sigmoid = _act_layer("Sigmoid", F.sigmoid)
+Tanh = _act_layer("Tanh", F.tanh)
+Silu = _act_layer("Silu", F.silu)
+Swish = _act_layer("Swish", F.swish)
+Mish = _act_layer("Mish", F.mish)
+Hardswish = _act_layer("Hardswish", F.hardswish)
+Hardsigmoid = _act_layer("Hardsigmoid", F.hardsigmoid)
+Hardtanh = _act_layer("Hardtanh", F.hardtanh)
+LeakyReLU = _act_layer("LeakyReLU", F.leaky_relu)
+ELU = _act_layer("ELU", F.elu)
+SELU = _act_layer("SELU", F.selu)
+CELU = _act_layer("CELU", F.celu)
+Softplus = _act_layer("Softplus", F.softplus)
+Softsign = _act_layer("Softsign", F.softsign)
+Softshrink = _act_layer("Softshrink", F.softshrink)
+Hardshrink = _act_layer("Hardshrink", F.hardshrink)
+Tanhshrink = _act_layer("Tanhshrink", F.tanhshrink)
+Softmax = _act_layer("Softmax", F.softmax)
+LogSoftmax = _act_layer("LogSoftmax", F.log_softmax)
+GLU = _act_layer("GLU", F.glu)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_parameters], default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight)

@@ -1,0 +1,1 @@
+from paddle_tpu.ops.registry import C_OPS, OPS, dispatch  # noqa: F401

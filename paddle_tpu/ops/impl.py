@@ -1,0 +1,1299 @@
+"""Op implementations: pure, jittable functions over jax arrays.
+
+This is the analogue of the reference kernel library (paddle/phi/kernels/ —
+~600 op kernels across cpu/gpu/xpu backends). On TPU there is exactly one
+backend: every op lowers to XLA HLO (jax.numpy / jax.lax / jax.nn), which
+XLA fuses and tiles onto the MXU/VPU; hand-written Pallas kernels slot in only
+where fusion can't express the op (see paddle_tpu/ops/pallas/). Shape/dtype
+inference (the reference's paddle/phi/infermeta/) comes free from jax's
+abstract evaluation.
+
+Conventions:
+  - functions take jax arrays positionally + python attrs as keywords,
+    return a jax array or tuple of arrays
+  - NCHW layout for conv/pool (paddle default data_format="NCHW")
+  - names match the op names registered in ops.yaml
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+from jax.dtypes import canonicalize_dtype as _canon
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# ============================================================ element-wise math
+
+
+def add(x, y):
+    return jnp.add(x, y)
+
+
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+def remainder(x, y):
+    return jnp.remainder(x, y)
+
+
+def pow(x, y):  # noqa: A001
+    return jnp.power(x, y)
+
+
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+def abs(x):  # noqa: A001
+    return jnp.abs(x)
+
+
+def neg(x):
+    return jnp.negative(x)
+
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def expm1(x):
+    return jnp.expm1(x)
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def log2(x):
+    return jnp.log2(x)
+
+
+def log10(x):
+    return jnp.log10(x)
+
+
+def log1p(x):
+    return jnp.log1p(x)
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+def rsqrt(x):
+    return lax.rsqrt(x)
+
+
+def square(x):
+    return jnp.square(x)
+
+
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+def sin(x):
+    return jnp.sin(x)
+
+
+def cos(x):
+    return jnp.cos(x)
+
+
+def tan(x):
+    return jnp.tan(x)
+
+
+def asin(x):
+    return jnp.arcsin(x)
+
+
+def acos(x):
+    return jnp.arccos(x)
+
+
+def atan(x):
+    return jnp.arctan(x)
+
+
+def sinh(x):
+    return jnp.sinh(x)
+
+
+def cosh(x):
+    return jnp.cosh(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+def floor(x):
+    return jnp.floor(x)
+
+
+def ceil(x):
+    return jnp.ceil(x)
+
+
+def round(x):  # noqa: A001
+    return jnp.round(x)
+
+
+def trunc(x):
+    return jnp.trunc(x)
+
+
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+def clip(x, min=None, max=None):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+def isnan(x):
+    return jnp.isnan(x)
+
+
+def isinf(x):
+    return jnp.isinf(x)
+
+
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+# ============================================================ comparison/logical
+
+
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+# ============================================================ matmul / linalg
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    """Reference: phi MatmulKernel. On TPU this is the MXU op — keep operands
+    large/batched; bf16 inputs hit the systolic array natively."""
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+def norm(x, p=2, axis=None, keepdim=False):
+    if p == "fro" or p == 2:
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    if p == 1:
+        return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    return jnp.power(
+        jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim), 1.0 / p
+    )
+
+
+def t(x):
+    return jnp.swapaxes(x, -1, -2) if x.ndim >= 2 else x
+
+
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+def cholesky(x, upper=False):
+    l = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(l, -1, -2) if upper else l
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+    )
+
+
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+# ============================================================ reductions
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
+    return jnp.sum(x, axis=axis, dtype=dtype, keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=axis, keepdims=keepdim, dtype=dtype)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return out.astype(_canon(jnp.dtype(dtype)))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim)
+    return out.astype(_canon(jnp.dtype(dtype)))
+
+
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+def cumprod(x, dim=None, dtype=None):
+    if dim is None:
+        x = x.reshape(-1)
+        dim = 0
+    return jnp.cumprod(x, axis=dim, dtype=dtype)
+
+
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdim)
+
+
+# ============================================================ manipulation
+
+
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+def concat(xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def stack(xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+def split(x, num_or_sections, axis=0):
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    sections = list(num_or_sections)
+    # paddle allows one -1 section
+    if -1 in sections:
+        known = builtins_sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = x.shape[axis] - known
+    idx = np.cumsum(sections)[:-1].tolist()
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+def builtins_sum(it):
+    total = 0
+    for v in it:
+        total += v
+    return total
+
+
+def chunk(x, chunks, axis=0):
+    return tuple(jnp.array_split(x, chunks, axis=axis))
+
+
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+def unsqueeze(x, axis):
+    if isinstance(axis, (list, tuple)):
+        for a in sorted(axis):
+            x = jnp.expand_dims(x, a)
+        return x
+    return jnp.expand_dims(x, axis)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return x.reshape((1,))
+    s = start_axis % nd
+    e = stop_axis % nd
+    shape = x.shape[:s] + (-1,) + x.shape[e + 1 :]
+    return jnp.reshape(x, shape)
+
+
+def tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def expand(x, shape):
+    shape = tuple(
+        x.shape[i - (len(shape) - x.ndim)] if s == -1 else s
+        for i, s in enumerate(shape)
+    )
+    return jnp.broadcast_to(x, shape)
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def where(condition, x, y):
+    return jnp.where(condition, x, y)
+
+
+def gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def gather_nd(x, index):
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    dims = list(range(x.ndim))
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
+    idx = [jnp.arange(s).reshape([-1 if i == d else 1 for i in dims]) for d, s in enumerate(indices.shape)]
+    idx[axis] = indices
+    idx = [jnp.broadcast_to(i, indices.shape) for i in idx]
+    vals = jnp.broadcast_to(values, indices.shape)
+    at = x.at[tuple(idx)]
+    if reduce == "add":
+        return at.add(vals)
+    if reduce == "multiply" or reduce == "mul":
+        return at.multiply(vals)
+    raise ValueError(f"unsupported reduce {reduce}")
+
+
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def masked_select(x, mask):
+    # dynamic output shape — not jittable; eager-only op (same caveat as
+    # reference's masked_select which is shape-dynamic)
+    return x[mask]
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(mask, value, x)
+
+
+def scatter(x, index, updates, overwrite=True):
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    if len(pad) == 2 * x.ndim:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        # paddle nn.functional.pad pads trailing spatial dims, given as
+        # [l, r, (t, b, ...)] for the last len(pad)//2 dims (NCHW)
+        n = len(pad) // 2
+        width = [(0, 0)] * (x.ndim - n)
+        for i in range(n):
+            width.append((pad[2 * (n - 1 - i)], pad[2 * (n - 1 - i) + 1]))
+    if mode == "constant":
+        return jnp.pad(x, width, mode="constant", constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, width, mode=jmode)
+
+
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def diag(x, offset=0):
+    return jnp.diag(x, k=offset)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def unbind(x, axis=0):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+def unstack(x, axis=0, num=None):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+def as_strided_slice(x, axes, starts, ends, strides=None):
+    idx = [slice(None)] * x.ndim
+    strides = strides or [1] * len(axes)
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(s, e, st)
+    return x[tuple(idx)]
+
+
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes)
+
+
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    out = jnp.searchsorted(sorted_sequence, values, side="right" if right else "left")
+    return out.astype(jnp.int32 if out_int32 else _canon(jnp.int64))
+
+
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+# ============================================================ sort / search
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):  # noqa: A002
+    if not largest:
+        vals, idx = lax.top_k(-jnp.moveaxis(x, axis, -1), k)
+        vals = -vals
+    else:
+        vals, idx = lax.top_k(jnp.moveaxis(x, axis, -1), k)
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx.astype(_canon(jnp.int64))
+
+
+def sort(x, axis=-1, descending=False):
+    out = jnp.sort(x, axis=axis)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+def argsort(x, axis=-1, descending=False):
+    out = jnp.argsort(x, axis=axis)
+    if descending:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(_canon(jnp.int64))
+
+
+def nonzero(x):
+    # dynamic shape — eager-only (reference: NonZeroKernel, also dynamic)
+    return jnp.stack(jnp.nonzero(x), axis=1).astype(_canon(jnp.int64))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False):
+    # dynamic shape — eager-only
+    res = jnp.unique(
+        x, return_index=return_index, return_inverse=return_inverse,
+        return_counts=return_counts,
+    )
+    return res
+
+
+# ============================================================ activations
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+def swish(x):
+    return jax.nn.silu(x)
+
+
+def mish(x):
+    return jax.nn.mish(x)
+
+
+def hardswish(x):
+    return jax.nn.hard_swish(x)
+
+
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def hardtanh(x, min=-1.0, max=1.0):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def selu(x):
+    return jax.nn.selu(x)
+
+
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+def softplus(x, beta=1.0, threshold=20.0):
+    return jnp.where(beta * x > threshold, x, (1.0 / beta) * jnp.log1p(jnp.exp(beta * x)))
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+def prelu(x, weight):
+    return jnp.where(x >= 0, x, weight * x)
+
+
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def swiglu(x, y=None):
+    """Reference: fused swiglu (python/paddle/incubate/nn/functional/swiglu)."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+# ============================================================ nn core ops
+
+
+def linear(x, weight, bias=None):
+    """Reference: phi FcKernel / matmul+add. weight layout [in, out] (paddle
+    convention, nn/layer/common.py Linear)."""
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def embedding(x, weight, padding_idx=None):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    return out
+
+
+def dropout(x, key, p=0.5, training=True, mode="upscale_in_train"):
+    if p == 0.0:
+        return x
+    keep = 1.0 - p
+    if not training:
+        # downscale_in_infer scales activations by keep-prob at inference
+        # (reference: phi DropoutKernel, python nn/functional/common.py)
+        if mode == "downscale_in_infer":
+            return (x * keep).astype(x.dtype)
+        return x
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+def layer_norm(x, weight=None, bias=None, epsilon=1e-5, begin_norm_axis=-1):
+    """Reference: phi LayerNormKernel. Normalizes over trailing dims starting
+    at begin_norm_axis (paddle semantics); weight/bias broadcast over them."""
+    if begin_norm_axis < 0:
+        begin_norm_axis += x.ndim
+    axes = tuple(range(begin_norm_axis, x.ndim))
+    # compute statistics in fp32 for bf16 stability (TPU practice)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    out = (xf - mean) * lax.rsqrt(var + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight.reshape(x.shape[begin_norm_axis:])
+    if bias is not None:
+        out = out + bias.reshape(x.shape[begin_norm_axis:])
+    return out
+
+
+def rms_norm(x, weight=None, epsilon=1e-6):
+    """Reference: fused_rms_norm (paddle/phi/kernels/fusion/). XLA fuses this
+    chain into one kernel on TPU; no custom kernel needed."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = (xf * lax.rsqrt(var + epsilon)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+def batch_norm(
+    x, running_mean, running_var, weight=None, bias=None,
+    training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+):
+    """Returns (out, new_mean, new_var). Reference: phi BatchNormKernel."""
+    if data_format == "NCHW":
+        axes = (0,) + tuple(range(2, x.ndim))
+        shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+    else:
+        axes = tuple(range(x.ndim - 1))
+        shape = [1] * (x.ndim - 1) + [x.shape[-1]]
+    if training:
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.var(xf, axis=axes)
+        n = x.size / x.shape[1 if data_format == "NCHW" else -1]
+        unbiased = var * n / jnp.maximum(n - 1, 1)
+        new_mean = momentum * running_mean + (1 - momentum) * mean
+        new_var = momentum * running_var + (1 - momentum) * unbiased
+    else:
+        mean, var = running_mean, running_var
+        new_mean, new_var = running_mean, running_var
+    out = (x - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape).astype(jnp.float32) + epsilon).astype(x.dtype)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out.astype(x.dtype), new_mean, new_var
+
+
+def group_norm(x, weight=None, bias=None, epsilon=1e-5, groups=1, data_format="NCHW"):
+    if data_format != "NCHW":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[0], x.shape[1]
+    g = x.reshape((n, groups, c // groups) + x.shape[2:])
+    axes = tuple(range(2, g.ndim))
+    mean = jnp.mean(g, axis=axes, keepdims=True)
+    var = jnp.var(g, axis=axes, keepdims=True)
+    out = ((g - mean) * lax.rsqrt(var + epsilon)).reshape(x.shape)
+    shape = [1, c] + [1] * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    if data_format != "NCHW":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + epsilon)
+    shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+# ============================================================ conv / pool
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    """Reference: phi Conv2dKernel (gpudnn). Lowers to XLA conv_general_dilated
+    which maps onto the MXU. Layout NCHW in the API; XLA relayouts internally."""
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    if isinstance(padding, str):
+        pad = padding.upper()  # "SAME"/"VALID"
+    else:
+        p = _pair(padding)
+        if len(p) == 4:
+            pad = [(p[0], p[1]), (p[2], p[3])]
+        else:
+            pad = [(p[0], p[0]), (p[1], p[1])]
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
+    )
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    x4 = x[:, :, None, :]
+    w4 = weight[:, :, None, :]
+    s = stride if isinstance(stride, int) else stride[0]
+    d = dilation if isinstance(dilation, int) else dilation[0]
+    p = padding if isinstance(padding, int) else padding[0]
+    out = conv2d(x4, w4, bias, stride=(1, s), padding=(0, p), dilation=(1, d),
+                 groups=groups)
+    return out[:, :, 0, :]
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1):
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    p = _pair(padding)
+    opad = _pair(output_padding)
+    # weight layout IOHW (paddle conv_transpose stores [in, out/groups, kh, kw])
+    kh, kw = weight.shape[2], weight.shape[3]
+    pad = [
+        (dilation[0] * (kh - 1) - p[0], dilation[0] * (kh - 1) - p[0] + opad[0]),
+        (dilation[1] * (kw - 1) - p[1], dilation[1] * (kw - 1) - p[1] + opad[1]),
+    ]
+    w = jnp.flip(weight, axis=(2, 3))
+    if groups > 1:
+        i, og = w.shape[0], w.shape[1]
+        w = w.reshape(groups, i // groups, og, kh, kw)
+        w = jnp.moveaxis(w, 2, 1).reshape(groups * og, i // groups, kh, kw)
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=pad, lhs_dilation=stride,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
+    )
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def _pool_pads(x, k, s, p, ceil_mode):
+    """Low/high spatial padding; ceil_mode adds extra high padding so the
+    last partial window is included (reference: phi pooling infermeta)."""
+    extra = [0, 0]
+    if ceil_mode:
+        for i, dim in enumerate((2, 3)):
+            size = x.shape[dim] + 2 * p[i]
+            rem = (size - k[i]) % s[i]
+            if rem:
+                extra[i] = s[i] - rem
+    return [(0, 0), (0, 0), (p[0], p[0] + extra[0]), (p[1], p[1] + extra[1])]
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCHW"):
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    p = _pair(padding)
+    dims = (1, 1) + k
+    strides = (1, 1) + s
+    pads = _pool_pads(x, k, s, p, ceil_mode)
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        init = -jnp.inf  # -inf init selects jax's differentiable max-pool path
+    else:
+        init = jnp.iinfo(x.dtype).min
+    return lax.reduce_window(x, init, lax.max, dims, strides, pads)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, data_format="NCHW"):
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    p = _pair(padding)
+    dims = (1, 1) + k
+    strides = (1, 1) + s
+    pads = _pool_pads(x, k, s, p, ceil_mode)
+    summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+    if exclusive and (p[0] or p[1] or ceil_mode):
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+        return summed / counts
+    return summed / (k[0] * k[1])
+
+
+def adaptive_avg_pool2d(x, output_size):
+    oh, ow = _pair(output_size)
+    n, c, h, w = x.shape
+    # paddle adaptive pooling: split into near-equal windows
+    if h % oh == 0 and w % ow == 0:
+        return jnp.mean(x.reshape(n, c, oh, h // oh, ow, w // ow), axis=(3, 5))
+    out = jax.image.resize(x, (n, c, oh, ow), method="linear")  # approx
+    return out
+
+
+def adaptive_max_pool2d(x, output_size):
+    oh, ow = _pair(output_size)
+    n, c, h, w = x.shape
+    assert h % oh == 0 and w % ow == 0, "adaptive_max_pool2d needs divisible sizes"
+    return jnp.max(x.reshape(n, c, oh, h // oh, ow, w // ow), axis=(3, 5))
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
+    n, c, h, w = x.shape
+    if size is None:
+        sf = _pair(scale_factor)
+        size = (int(h * sf[0]), int(w * sf[1]))
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic"}[mode]
+    return jax.image.resize(x, (n, c, size[0], size[1]), method=method)
+
+
+def pixel_shuffle(x, upscale_factor):
+    n, c, h, w = x.shape
+    r = upscale_factor
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _pair(paddings)
+    d = _pair(dilations)
+    n, c, h, w = x.shape
+    x = jnp.pad(x, [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
+    oh = (h + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+    ow = (w + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=k, window_strides=s, padding=[(0, 0), (0, 0)],
+        rhs_dilation=d, dimension_numbers=lax.conv_dimension_numbers(
+            x.shape, (1, c, k[0], k[1]), ("NCHW", "OIHW", "NCHW")),
+    )
+    return patches.reshape(n, c * k[0] * k[1], oh * ow)
+
+
+# ============================================================ losses
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1,
+                               ignore_index=-100):
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        return -jnp.sum(label * logp, axis=axis, keepdims=True)
+    lab = label
+    squeeze = False
+    if lab.ndim == logits.ndim:
+        lab = jnp.squeeze(lab, axis=axis)
+        squeeze = True
+    picked = jnp.take_along_axis(logp, jnp.expand_dims(jnp.maximum(lab, 0), axis), axis=axis)
+    loss = -picked
+    mask = jnp.expand_dims(lab == ignore_index, axis)
+    loss = jnp.where(mask, 0.0, loss)
+    return loss
+
+
+def cross_entropy(logits, label, soft_label=False, axis=-1, ignore_index=-100,
+                  reduction="mean", weight=None, label_smoothing=0.0):
+    """Reference: python/paddle/nn/functional/loss.py cross_entropy."""
+    num_classes = logits.shape[axis]
+    if label_smoothing > 0.0 and not soft_label:
+        onehot = jax.nn.one_hot(label, num_classes, dtype=logits.dtype)
+        soft = onehot * (1 - label_smoothing) + label_smoothing / num_classes
+        loss = softmax_with_cross_entropy(logits, soft, soft_label=True, axis=axis)
+        valid = jnp.ones(loss.shape, dtype=logits.dtype)
+    else:
+        loss = softmax_with_cross_entropy(
+            logits, label, soft_label=soft_label, axis=axis, ignore_index=ignore_index
+        )
+        if soft_label:
+            valid = jnp.ones(loss.shape, dtype=logits.dtype)
+        else:
+            lab = label
+            if lab.ndim == logits.ndim:
+                lab = jnp.squeeze(lab, axis=axis)
+            valid = jnp.expand_dims((lab != ignore_index).astype(logits.dtype), axis)
+    if weight is not None and not soft_label:
+        lab = label if label.ndim < logits.ndim else jnp.squeeze(label, axis=axis)
+        w = jnp.take(weight, jnp.maximum(lab, 0))
+        loss = loss * jnp.expand_dims(w, axis)
+        valid = valid * jnp.expand_dims(w, axis)
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1e-8)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def nll_loss(log_prob, label, weight=None, ignore_index=-100, reduction="mean"):
+    picked = -jnp.take_along_axis(log_prob, jnp.expand_dims(jnp.maximum(label, 0), -1), axis=-1)
+    picked = jnp.squeeze(picked, -1)
+    valid = (label != ignore_index).astype(log_prob.dtype)
+    if weight is not None:
+        w = jnp.take(weight, jnp.maximum(label, 0)) * valid
+    else:
+        w = valid
+    picked = picked * w
+    if reduction == "mean":
+        return jnp.sum(picked) / jnp.maximum(jnp.sum(w), 1e-8)
+    if reduction == "sum":
+        return jnp.sum(picked)
+    return picked
+
+
+def mse_loss(input, label, reduction="mean"):
+    loss = jnp.square(input - label)
+    return _reduce_loss(loss, reduction)
+
+
+def l1_loss(input, label, reduction="mean"):
+    loss = jnp.abs(input - label)
+    return _reduce_loss(loss, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    d = jnp.abs(input - label)
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce_loss(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    eps = 1e-12
+    loss = -(label * jnp.log(input + eps) + (1 - label) * jnp.log(1 - input + eps))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce_loss(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None):
+    max_val = jnp.clip(-logit, 0, None)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * label + 1.0
+        loss = (1 - label) * logit + log_w * (
+            jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val
+        )
+    else:
+        loss = (1 - label) * logit + max_val + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce_loss(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean"):
+    loss = label * (jnp.log(jnp.clip(label, 1e-12, None)) - input)
+    return _reduce_loss(loss, reduction)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot_ = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(x1 * x1, axis=axis))
+    n2 = jnp.sqrt(jnp.sum(x2 * x2, axis=axis))
+    return dot_ / jnp.maximum(n1 * n2, eps)
+
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+# ============================================================ attention
+
+
+def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, scale=None):
+    """Reference: paddle.nn.functional.scaled_dot_product_attention /
+    flash_attention (python/paddle/nn/functional/flash_attention.py:358).
+
+    Layout [batch, seq, heads, head_dim] (paddle flash-attn convention).
+    Computed at fp32 accumulation; XLA fuses; a Pallas flash kernel can be
+    swapped in via paddle_tpu.ops.pallas when available.
+    """
+    b, sq, h, d = q.shape
+    scale = scale or (1.0 / math.sqrt(d))
+    qT = jnp.swapaxes(q, 1, 2)  # b h s d
+    kT = jnp.swapaxes(k, 1, 2)
+    vT = jnp.swapaxes(v, 1, 2)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qT, kT).astype(jnp.float32) * scale
+    if is_causal:
+        sk = kT.shape[2]
+        causal = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        scores = jnp.where(causal, scores, -1e30)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            scores = jnp.where(attn_mask, scores, -1e30)
+        else:
+            scores = scores + attn_mask.astype(scores.dtype)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vT)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def rotary_embedding(q, k, cos, sin, position_ids=None):
+    """Reference: fused_rotary_position_embedding (incubate/nn/functional).
+    q,k: [b, s, h, d]; cos/sin: [s, d] or broadcastable."""
+
+    def rotate_half(x):
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        return jnp.concatenate([-x2, x1], axis=-1)
+
+    if position_ids is not None:
+        cos = jnp.take(cos, position_ids, axis=0)
+        sin = jnp.take(sin, position_ids, axis=0)
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    else:
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
+    q_out = q * cos + rotate_half(q) * sin
+    k_out = k * cos + rotate_half(k) * sin
+    return q_out.astype(q.dtype), k_out.astype(k.dtype)

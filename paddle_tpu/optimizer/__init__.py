@@ -1,0 +1,9 @@
+"""paddle_tpu.optimizer — reference: python/paddle/optimizer/."""
+
+from paddle_tpu.optimizer import lr  # noqa: F401
+from paddle_tpu.optimizer.clip import (  # noqa: F401
+    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+)
+from paddle_tpu.optimizer.optimizer import (  # noqa: F401
+    SGD, Adagrad, Adam, AdamW, Momentum, Optimizer, RMSProp,
+)

@@ -1,0 +1,72 @@
+"""Gradient clipping.
+
+Reference: python/paddle/nn/clip.py (ClipGradByValue, ClipGradByNorm,
+ClipGradByGlobalNorm — applied inside Optimizer._apply_optimize).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        return [
+            (p, Tensor._wrap(jnp.clip(g._value, self.min, self.max)))
+            for p, g in params_grads
+        ]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            n = jnp.sqrt(jnp.sum(jnp.square(g._value.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            out.append((p, Tensor._wrap((g._value * scale).astype(g.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global-norm clip. Under auto-parallel the sum reduces over sharded
+    grads transparently (GSPMD inserts the psum) — the reference needs an
+    explicit cross-mesh allreduce in HybridParallelOptimizer
+    (fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py)."""
+
+    def __init__(self, clip_norm=1.0):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        if not params_grads:
+            return params_grads
+        sq = [jnp.sum(jnp.square(g._value.astype(jnp.float32)))
+              for _, g in params_grads]
+        gn = jnp.sqrt(jnp.sum(jnp.stack(sq)))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(gn, 1e-12), 1.0)
+        return [(p, Tensor._wrap((g._value * scale).astype(g.dtype)))
+                for p, g in params_grads]
+
+    def functional(self, grads_tree):
+        """Pure version for the compiled train step."""
+        import jax
+
+        leaves = [g for g in jax.tree_util.tree_leaves(grads_tree) if g is not None]
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(gn, 1e-12), 1.0)
+        return jax.tree_util.tree_map(
+            lambda g: None if g is None else (g * scale).astype(g.dtype), grads_tree
+        )

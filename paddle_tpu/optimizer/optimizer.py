@@ -1,0 +1,326 @@
+"""Optimizers.
+
+Reference: python/paddle/optimizer/optimizer.py:128 (Optimizer base:
+accumulators, _apply_optimize, grad-clip integration), adam.py:58, adamw.py:49.
+
+TPU-native design: every optimizer's math lives in a pure functional core
+`_update(p, g, state, lr) -> (new_p, new_state)` over jax arrays. Eager
+`step()` runs it per-parameter through a jitted cache; the compiled training
+path (paddle_tpu.jit.TrainStep) calls `apply_gradients` on whole pytrees
+inside one XLA program with donated buffers — the analogue of the reference's
+fused multi-tensor adam kernels (phi/kernels/fused_adam_kernel), except XLA
+does the fusion.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.autograd.engine import no_grad
+from paddle_tpu.core.tensor import Tensor
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        from paddle_tpu.optimizer.lr import LRScheduler
+
+        self._lr = learning_rate
+        self._lr_scheduler = learning_rate if isinstance(learning_rate, LRScheduler) else None
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._weight_decay = 0.0 if weight_decay is None else float(weight_decay)
+        self._grad_clip = grad_clip
+        # name -> {param_id -> jax array}; mirrors reference accumulators
+        self._accumulators: Dict[int, Dict[str, jnp.ndarray]] = {}
+        self._step_count = 0
+
+    # ------------------------------------------------------------ lr
+
+    def get_lr(self) -> float:
+        if self._lr_scheduler is not None:
+            return float(self._lr_scheduler.get_lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if self._lr_scheduler is not None:
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = value
+
+    # ------------------------------------------------------------ state
+
+    def _state_for(self, p: Tensor) -> Dict[str, jnp.ndarray]:
+        st = self._accumulators.get(id(p))
+        if st is None:
+            st = self._init_state(p._value)
+            self._accumulators[id(p)] = st
+        return st
+
+    def _init_state(self, value) -> Dict[str, jnp.ndarray]:
+        return {}
+
+    def _update(self, p, g, state, lr, wd):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ stepping
+
+    @no_grad()
+    def step(self):
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("optimizer constructed without parameters")
+        grads = [(p, p.grad) for p in params
+                 if p.grad is not None and p.trainable]
+        if self._grad_clip is not None:
+            grads = self._grad_clip(grads)
+        lr = self.get_lr()
+        self._step_count += 1
+        for p, g in grads:
+            state = self._state_for(p)
+            decay = self._weight_decay if self._param_decays(p) else 0.0
+            keys = tuple(sorted(state))
+            new_p, new_vals = self._jit_update_impl(
+                keys, p._value, g._value, tuple(state[k] for k in keys),
+                jnp.asarray(lr, jnp.float32), jnp.asarray(decay, jnp.float32),
+                jnp.asarray(self._step_count, jnp.int32))
+            p._value = new_p
+            self._accumulators[id(p)] = dict(zip(keys, new_vals))
+
+    @partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2, 4))
+    def _jit_update_impl(self, keys, p, g, state_vals, lr, wd, step):
+        state = dict(zip(keys, state_vals))
+        new_p, new_state = self._update(p, g.astype(p.dtype), state, lr, wd,
+                                        step)
+        nkeys = tuple(sorted(new_state))
+        assert nkeys == keys, f"optimizer state keys changed: {keys}->{nkeys}"
+        return new_p, tuple(new_state[k] for k in nkeys)
+
+    def _param_decays(self, p: Tensor) -> bool:
+        return True
+
+    @no_grad()
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameter_list or []:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    # ------------------------------------------------------------ functional
+    # tree-level API used by the compiled train step (paddle_tpu.jit)
+
+    def init_state_tree(self, params_tree):
+        return jax.tree_util.tree_map(lambda v: self._init_state(v), params_tree)
+
+    def _decays_name(self, name: str) -> bool:
+        """Per-parameter decay predicate for the functional path (matches
+        eager _param_decays; AdamW consults apply_decay_param_fun)."""
+        return True
+
+    def apply_gradients(self, params_tree, grads_tree, state_tree, lr, step):
+        """Pure: returns (new_params_tree, new_state_tree). Runs inside jit.
+        When params_tree is a dict keyed by parameter name (the TrainStep
+        layout), per-parameter decay predicates apply."""
+
+        def upd(p, g, st, name=None):
+            if g is None:
+                return p, st
+            decay = self._weight_decay if (
+                name is None or self._decays_name(name)) else 0.0
+            return self._update(p, g.astype(p.dtype), st, lr, decay, step)
+
+        if isinstance(params_tree, dict) and all(
+                not isinstance(v, dict) for v in params_tree.values()):
+            out = {k: upd(params_tree[k], grads_tree.get(k),
+                          state_tree[k], name=k) for k in params_tree}
+            return ({k: v[0] for k, v in out.items()},
+                    {k: v[1] for k, v in out.items()})
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params_tree)
+        flat_g = treedef.flatten_up_to(grads_tree)
+        flat_s = treedef.flatten_up_to(state_tree)
+        out = [upd(p, g, s) for p, g, s in zip(flat_p, flat_g, flat_s)]
+        new_p = treedef.unflatten([o[0] for o in out])
+        new_s = treedef.unflatten([o[1] for o in out])
+        return new_p, new_s
+
+    # ------------------------------------------------------------ state dict
+
+    def state_dict(self):
+        out = {"step": self._step_count}
+        if self._lr_scheduler is not None:
+            out["lr_scheduler"] = self._lr_scheduler.state_dict()
+        for i, p in enumerate(self._parameter_list or []):
+            for k, v in self._accumulators.get(id(p), {}).items():
+                out[f"{i}.{k}"] = Tensor._wrap(v)
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.get("step", 0))
+        if self._lr_scheduler is not None and "lr_scheduler" in state:
+            self._lr_scheduler.set_state_dict(state["lr_scheduler"])
+        for i, p in enumerate(self._parameter_list or []):
+            st = {}
+            for k, v in state.items():
+                if isinstance(k, str) and k.startswith(f"{i}."):
+                    st[k.split(".", 1)[1]] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+            if st:
+                self._accumulators[id(p)] = st
+
+
+class SGD(Optimizer):
+    def _update(self, p, g, state, lr, wd, step):
+        g = g + wd * p
+        return (p - lr * g).astype(p.dtype), state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, value):
+        return {"velocity": jnp.zeros_like(value)}
+
+    def _update(self, p, g, state, lr, wd, step):
+        g = g + wd * p
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            new_p = p - lr * (g + self._momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p.astype(p.dtype), {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=True,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._multi_precision = multi_precision
+
+    def _init_state(self, value):
+        st = {
+            "moment1": jnp.zeros(value.shape, jnp.float32),
+            "moment2": jnp.zeros(value.shape, jnp.float32),
+        }
+        if self._multi_precision and value.dtype != jnp.float32 and jnp.issubdtype(value.dtype, jnp.floating):
+            # master weights (reference: amp.decorate master_weight /
+            # multi_precision adam kernels)
+            st["master"] = value.astype(jnp.float32)
+        return st
+
+    def _decayed_grad(self, p, g, wd):
+        return g + wd * p
+
+    def _adam_core(self, p32, g, state, lr, step):
+        g = g.astype(jnp.float32)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        bc1 = 1 - self._beta1**step.astype(jnp.float32)
+        bc2 = 1 - self._beta2**step.astype(jnp.float32)
+        update = (m / bc1) / (jnp.sqrt(v / bc2) + self._eps)
+        return p32 - lr * update, m, v
+
+    def _update(self, p, g, state, lr, wd, step):
+        step = jnp.asarray(step)
+        p32 = state.get("master", p.astype(jnp.float32))
+        g = self._decayed_grad(p32, g.astype(jnp.float32), wd)
+        new_p32, m, v = self._adam_core(p32, g, state, lr, step)
+        new_state = {"moment1": m, "moment2": v}
+        if "master" in state:
+            new_state["master"] = new_p32
+        return new_p32.astype(p.dtype), new_state
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference adamw.py:49)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 grad_clip=None, apply_decay_param_fun=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, multi_precision=multi_precision)
+        self._weight_decay = float(weight_decay)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _param_decays(self, p):
+        if self._apply_decay_param_fun is not None:
+            return self._apply_decay_param_fun(p.name)
+        return True
+
+    def _decays_name(self, name):
+        if self._apply_decay_param_fun is not None:
+            return self._apply_decay_param_fun(name)
+        return True
+
+    def _update(self, p, g, state, lr, wd, step):
+        step = jnp.asarray(step)
+        p32 = state.get("master", p.astype(jnp.float32))
+        new_p32, m, v = self._adam_core(p32, g.astype(jnp.float32), state, lr, step)
+        new_p32 = new_p32 - lr * wd * p32  # decoupled decay
+        new_state = {"moment1": m, "moment2": v}
+        if "master" in state:
+            new_state["master"] = new_p32
+        return new_p32.astype(p.dtype), new_state
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.01, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state(self, value):
+        st = {"mean_square": jnp.zeros(value.shape, jnp.float32),
+              "momentum": jnp.zeros(value.shape, jnp.float32)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros(value.shape, jnp.float32)
+        return st
+
+    def _update(self, p, g, state, lr, wd, step):
+        g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * g * g
+        new_state = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self._eps)
+            new_state["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * state["momentum"] + lr * g / denom
+        new_state["momentum"] = mom
+        return (p.astype(jnp.float32) - mom).astype(p.dtype), new_state
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.01, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, value):
+        return {"moment": jnp.full(value.shape, self._init_acc, jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd, step):
+        g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+        acc = state["moment"] + g * g
+        new_p = p.astype(jnp.float32) - lr * g / (jnp.sqrt(acc) + self._eps)
+        return new_p.astype(p.dtype), {"moment": acc}

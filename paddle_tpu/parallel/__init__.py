@@ -1,0 +1,33 @@
+"""paddle_tpu.parallel — the distributed stack.
+
+Reference: python/paddle/distributed/ (SURVEY.md §2.9-2.11). One device mesh
+underlies everything: collectives are XLA ops over mesh axes, parallelism
+strategies are sharding policies, and "process groups" are axis names.
+"""
+
+from paddle_tpu.parallel import collective  # noqa: F401
+from paddle_tpu.parallel.api import (  # noqa: F401
+    Partial, Placement, Replicate, Shard, dtensor_from_local, reshard,
+    shard_layer, shard_tensor, sharding_constraint,
+)
+from paddle_tpu.parallel.collective import (  # noqa: F401
+    Group, ReduceOp, all_gather, all_reduce, barrier, broadcast, new_group,
+)
+from paddle_tpu.parallel.data_parallel import (  # noqa: F401
+    DataParallel, group_sharded_parallel,
+)
+from paddle_tpu.parallel.env import (  # noqa: F401
+    ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized,
+)
+from paddle_tpu.parallel.fleet import (  # noqa: F401
+    DistributedStrategy, HybridCommunicateGroup, fleet,
+)
+from paddle_tpu.parallel.mesh import (  # noqa: F401
+    ProcessMesh, current_mesh, init_mesh, mesh_scope, set_mesh,
+)
+from paddle_tpu.parallel.moe import MoELayer  # noqa: F401
+from paddle_tpu.parallel.mp_layers import (  # noqa: F401
+    ColumnParallelLinear, GatherOp, ParallelCrossEntropy, RowParallelLinear,
+    ScatterOp, VocabParallelEmbedding,
+)
+from paddle_tpu.parallel.pipeline import pipeline_apply, stack_stage_params  # noqa: F401

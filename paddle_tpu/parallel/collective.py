@@ -1,0 +1,228 @@
+"""Collective communication API.
+
+Reference, three levels that all collapse onto XLA collectives here:
+  - python API: python/paddle/distributed/communication/ (all_reduce,
+    all_gather, all_to_all, reduce_scatter, broadcast, send/recv, barrier)
+  - dygraph ProcessGroup (paddle/phi/core/distributed/collective/
+    process_group.h:48, ProcessGroupNCCL process_group_nccl.h:37)
+  - static-graph c_* ops (paddle/fluid/operators/collective/)
+
+TPU-native: inside a shard_map/jit region these are jax.lax collectives over
+mesh axes (psum / all_gather / all_to_all / ppermute / psum_scatter) riding
+ICI. Outside a compiled region, "collectives" over a sharded jax.Array are
+resharding operations (device_put), which XLA implements with the same
+collectives — so the eager API works on DistTensors like the reference's
+eager ProcessGroup path. The ReduceOp/group surface mirrors paddle's.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.parallel.mesh import current_mesh
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group == a mesh axis (reference: new_group building an
+    NCCL ring; here rings are mesh axes with ICI neighbors)."""
+
+    def __init__(self, axis: str, mesh=None):
+        self.axis = axis
+        self.mesh = mesh
+
+    @property
+    def nranks(self):
+        m = self.mesh or current_mesh()
+        return m.shape[self.axis] if m else 1
+
+    world_size = nranks
+
+    def __repr__(self):
+        return f"Group(axis={self.axis!r}, nranks={self.nranks})"
+
+
+def new_group(ranks=None, axis: str = "dp") -> Group:
+    return Group(axis)
+
+
+def _axis_of(group) -> str:
+    if group is None:
+        return "dp"
+    if isinstance(group, Group):
+        return group.axis
+    return str(group)
+
+
+# ---------------------------------------------------------------------------
+# In-jit functional collectives (for shard_map regions: pipeline, custom TP).
+# These are the direct analogues of the reference's c_* kernels.
+# ---------------------------------------------------------------------------
+
+
+def psum(x, axis: str):
+    return lax.psum(x, axis)
+
+
+def pmean(x, axis: str):
+    return lax.pmean(x, axis)
+
+
+def pmax(x, axis: str):
+    return lax.pmax(x, axis)
+
+
+def all_gather_in(x, axis: str, tensor_axis: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis, axis=tensor_axis, tiled=tiled)
+
+
+def reduce_scatter_in(x, axis: str, tensor_axis: int = 0):
+    return lax.psum_scatter(x, axis, scatter_dimension=tensor_axis, tiled=True)
+
+
+def all_to_all_in(x, axis: str, split_axis: int, concat_axis: int):
+    return lax.all_to_all(x, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def ppermute(x, axis: str, perm):
+    return lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+# ---------------------------------------------------------------------------
+# Eager API over sharded arrays (paddle.distributed.* surface).
+# Semantics: the tensor is interpreted per mesh sharding; op == reshard.
+# ---------------------------------------------------------------------------
+
+
+def _mesh_or_raise():
+    m = current_mesh()
+    if m is None:
+        raise RuntimeError("no mesh active; call init_mesh() first")
+    return m
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """On a replicated-view tensor this is an identity (values equal across
+    the axis); on a Partial-view it completes the psum. Eager single-process
+    semantics: sum over the shards along the group axis if the tensor is
+    sharded there, else identity."""
+    m = _mesh_or_raise()
+    axis = _axis_of(group)
+    spec = _spec_of(tensor._value, m)
+    if spec is None or axis not in _axes_in_spec(spec):
+        return tensor  # replicated along the axis: allreduce is identity
+    # sharded along axis: interpret shards as partial contributions
+    n = m.shape[axis]
+    parts = _unshard_axis(tensor._value, m, axis)
+    red = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min,
+           "prod": jnp.prod, "avg": jnp.mean}[op](parts, axis=0)
+    out = jax.device_put(red, NamedSharding(m, _drop_axis(spec, axis)))
+    tensor._inplace_update(out)
+    return tensor
+
+
+def all_gather(tensor_list, tensor: Tensor, group=None, sync_op=True):
+    """Gather shards along the group axis (reference
+    communication/all_gather.py)."""
+    m = _mesh_or_raise()
+    axis = _axis_of(group)
+    parts = _unshard_axis(tensor._value, m, axis)
+    for i in range(parts.shape[0]):
+        tensor_list.append(Tensor._wrap(parts[i]))
+    return tensor_list
+
+
+def broadcast(tensor: Tensor, src=0, group=None, sync_op=True):
+    # single-process SPMD: data is already consistent; replicate sharding
+    m = _mesh_or_raise()
+    axis = _axis_of(group)
+    spec = _spec_of(tensor._value, m)
+    if spec is not None and axis in _axes_in_spec(spec):
+        v = _unshard_axis(tensor._value, m, axis)[src]
+        tensor._inplace_update(
+            jax.device_put(v, NamedSharding(m, _drop_axis(spec, axis))))
+    return tensor
+
+
+def barrier(group=None):
+    jax.block_until_ready(jnp.zeros(()))
+
+
+def get_rank(group=None) -> int:
+    from paddle_tpu.parallel.env import get_rank as _gr
+
+    return _gr()
+
+
+def get_world_size(group=None) -> int:
+    from paddle_tpu.parallel.env import get_world_size as _gw
+
+    return _gw()
+
+
+# ----------------------------------------------------------------- helpers
+
+
+def _spec_of(value, mesh) -> Optional[PartitionSpec]:
+    sh = getattr(value, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        return sh.spec
+    return None
+
+
+def _axes_in_spec(spec: PartitionSpec):
+    out = set()
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        for e in (entry if isinstance(entry, tuple) else (entry,)):
+            out.add(e)
+    return out
+
+
+def _drop_axis(spec: PartitionSpec, axis: str) -> PartitionSpec:
+    new = []
+    for entry in tuple(spec):
+        if entry is None:
+            new.append(None)
+        elif isinstance(entry, tuple):
+            kept = tuple(e for e in entry if e != axis)
+            new.append(kept if kept else None)
+        else:
+            new.append(None if entry == axis else entry)
+    return PartitionSpec(*new)
+
+
+def _unshard_axis(value, mesh, axis: str):
+    """Materialize the per-shard views along `axis` as a stacked array."""
+    spec = _spec_of(value, mesh)
+    if spec is None or axis not in _axes_in_spec(spec):
+        n = mesh.shape[axis]
+        return jnp.stack([value] * n)
+    # find tensor dim sharded by axis
+    for tdim, entry in enumerate(tuple(spec)):
+        entries = entry if isinstance(entry, tuple) else (entry,)
+        if entry is not None and axis in entries:
+            n = mesh.shape[axis]
+            full = jax.device_put(value, NamedSharding(mesh, _drop_axis(spec, axis)))
+            parts = jnp.split(full, n, axis=tdim)
+            return jnp.stack(parts)
+    raise AssertionError

@@ -1,0 +1,100 @@
+"""Data parallelism + ZeRO sharding stages.
+
+Reference:
+  - paddle.DataParallel (python/paddle/distributed/parallel.py:219) + C++
+    EagerReducer bucketed allreduce (fluid/distributed/collective/reducer.h:88)
+  - ZeRO: DygraphShardingOptimizer (stage 1,
+    fleet/meta_parallel/sharding/dygraph_sharding_optimizer.py:54),
+    group_sharded stage2/3 (group_sharded_stage2.py:47 / stage3.py:85),
+    entry paddle.distributed.sharding.group_sharded_parallel
+    (sharding/group_sharded.py:50).
+
+TPU-native: under GSPMD the gradient allreduce is emitted by XLA from the
+sharding layout — batch sharded over 'dp', params replicated (pure DP) or
+sharded over 'dp' (ZeRO-3 == fully-sharded parameters; ZeRO-1/2 == sharded
+optimizer state / grads). So the three stages reduce to PartitionSpec policy
+on params and optimizer accumulators — no reducer, no bucket fusion (XLA
+fuses collectives), no hand-rolled gather/release.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.parallel.api import sharding_constraint
+from paddle_tpu.parallel.mesh import current_mesh
+
+
+class DataParallel(Layer):
+    """Wrapper: shards the input batch over 'dp' and keeps parameters
+    replicated; grad sync is implicit under jit (GSPMD) and a no-op in
+    single-process eager (values already global)."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size_MB=25,
+                 last_comm_buffer_size_MB=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+
+    def forward(self, *inputs, **kwargs):
+        mesh = current_mesh()
+        if mesh is not None and "dp" in mesh.axis_names:
+            inputs = tuple(
+                sharding_constraint(x, P(*(["dp"] + [None] * (x.ndim - 1))))
+                if hasattr(x, "ndim") and x.ndim > 0 else x
+                for x in inputs
+            )
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+
+def _shard_param_spec(shape, dp_axis="dp") -> P:
+    """ZeRO-3 policy: shard the largest dim that divides evenly; else
+    replicate (small params stay replicated like the reference's
+    min-param-size threshold)."""
+    mesh = current_mesh()
+    if mesh is None:
+        return P()
+    n = mesh.shape.get(dp_axis, 1)
+    if n == 1 or not shape:
+        return P()
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for i in order:
+        if shape[i] % n == 0 and shape[i] >= n:
+            spec = [None] * len(shape)
+            spec[i] = dp_axis
+            return P(*spec)
+    return P()
+
+
+def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
+                           group=None, sync_buffers=False, buffer_max_size=2**23,
+                           segment_size=2**20, sync_comm=False):
+    """paddle.distributed.sharding.group_sharded_parallel (group_sharded.py:50).
+
+    level: "os" (ZeRO-1), "os_g" (ZeRO-2), "p_g_os" (ZeRO-3).
+    Marks parameter PartitionSpecs consumed by jit.TrainStep; optimizer state
+    inherits the param spec (stages 1/2) and params themselves shard at
+    stage 3.
+    """
+    assert level in ("os", "os_g", "p_g_os")
+    if level == "p_g_os":
+        for _, p in model.named_parameters():
+            p._sharding = _shard_param_spec(tuple(p.shape))
+    # os / os_g: optimizer state sharding is applied by TrainStep via the
+    # param specs on accumulators only; params stay replicated.
+    setattr(optimizer, "_zero_stage", {"os": 1, "os_g": 2, "p_g_os": 3}[level])
+    return model, optimizer, scaler

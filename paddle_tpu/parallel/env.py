@@ -1,0 +1,77 @@
+"""Distributed environment bootstrap.
+
+Reference: python/paddle/distributed/parallel.py (init_parallel_env:978,
+TCPStore rendezvous :1134, env contract PADDLE_TRAINER_ID/ENDPOINTS set by
+the launcher, launch/controllers/collective.py:133-139).
+
+TPU-native: one process per HOST, many chips per process (PJRT); rendezvous
+is the JAX coordination service (jax.distributed.initialize), fed by the same
+env-var contract. On a single host this is a no-op and world == the local
+chips driven as one SPMD program.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env(strategy=None):
+    """Multi-host: reads PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+    MASTER_ADDR:MASTER_PORT (same contract as the reference launcher) and
+    joins the JAX coordination service. Single host: no-op."""
+    global _initialized
+    if _initialized:
+        return
+    nnodes = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if nnodes > 1:
+        addr = os.environ.get("MASTER_ADDR", "127.0.0.1")
+        port = os.environ.get("MASTER_PORT", "8471")
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        jax.distributed.initialize(
+            coordinator_address=f"{addr}:{port}",
+            num_processes=nnodes,
+            process_id=rank,
+        )
+    _initialized = True
+
+
+def get_rank() -> int:
+    """Host-process index (reference: paddle.distributed.get_rank)."""
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    return jax.process_count()
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+class ParallelEnv:
+    """Reference: paddle.distributed.ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_rank()

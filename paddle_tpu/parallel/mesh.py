@@ -1,0 +1,115 @@
+"""Device mesh / ProcessMesh.
+
+Reference: paddle.distributed.ProcessMesh
+(python/paddle/distributed/auto_parallel/process_mesh.py:85) and the fleet
+hybrid topology (fleet/base/topology.py:70 CommunicateTopology /
+HybridCommunicateGroup, axis order pp->mp->sep->sharding->dp at :298).
+
+TPU-native: one jax.sharding.Mesh is the single source of truth for every
+parallelism axis; "comm groups" are mesh axes, and collectives lower to XLA
+ops over ICI. A process-global current mesh makes layer construction
+sharding-aware (create_parameter picks up PartitionSpecs).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+_current_mesh: Optional[Mesh] = None
+
+# canonical axis order, hybrid topology style: dp outermost (slowest-varying,
+# maps across hosts/DCN), then pp, then tp innermost (fastest, rides ICI) —
+# mirrors the reference's pp->mp->...->dp ordering rationale reversed for
+# TPU: tp wants the tightest ICI neighborhood.
+AXIS_ORDER = ("dp", "pp", "ep", "sp", "tp")
+
+
+def init_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """Create + install the global mesh. axes e.g. {"dp": 2, "pp": 2, "tp": 2}.
+
+    Axis sizes must multiply to the device count. Axes of size 1 are kept (so
+    sharding specs can always name them).
+    """
+    global _current_mesh
+    if devices is None:
+        devices = jax.devices()
+    names = [a for a in AXIS_ORDER if a in axes] + [
+        a for a in axes if a not in AXIS_ORDER
+    ]
+    sizes = [axes[a] for a in names]
+    n = int(np.prod(sizes))
+    if n != len(devices):
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))} needs {n} devices, "
+            f"have {len(devices)}"
+        )
+    arr = np.asarray(devices).reshape(sizes)
+    _current_mesh = Mesh(arr, tuple(names))
+    return _current_mesh
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _current_mesh
+
+
+def set_mesh(mesh: Optional[Mesh]):
+    global _current_mesh
+    _current_mesh = mesh
+
+
+@contextmanager
+def mesh_scope(mesh: Mesh):
+    global _current_mesh
+    prev = _current_mesh
+    _current_mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _current_mesh = prev
+
+
+class ProcessMesh:
+    """paddle.distributed.ProcessMesh-compatible facade over jax Mesh."""
+
+    def __init__(self, mesh=None, dim_names: Optional[List[str]] = None,
+                 shape: Optional[List[int]] = None):
+        if isinstance(mesh, Mesh):
+            self._mesh = mesh
+        else:
+            arr = np.asarray(mesh if mesh is not None else
+                             range(len(jax.devices())))
+            if shape is not None:
+                arr = arr.reshape(shape)
+            names = tuple(dim_names or [f"d{i}" for i in range(arr.ndim)])
+            devs = np.asarray(jax.devices())[arr]
+            self._mesh = Mesh(devs, names)
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def shape(self) -> List[int]:
+        return [self._mesh.shape[n] for n in self._mesh.axis_names]
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._mesh.axis_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return [d.id for d in self._mesh.devices.flat]
+
+    def get_dim_size(self, name: str) -> int:
+        return self._mesh.shape[name]
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessMesh) and self._mesh == other._mesh
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"

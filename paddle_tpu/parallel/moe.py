@@ -1,0 +1,110 @@
+"""Mixture-of-Experts with expert parallelism ('ep' mesh axis).
+
+Reference: python/paddle/incubate/distributed/models/moe/moe_layer.py
+(MoELayer:261 with MoEScatter:97/MoEGather:147 PyLayers over
+global_scatter/global_gather all-to-all kernels,
+phi/kernels/gpu/global_scatter_kernel.cu) and gates in moe/gate/ (gshard,
+switch).
+
+TPU-native: the classic one-hot dispatch/combine einsum formulation (GShard).
+Expert weights carry a leading expert axis sharded over 'ep'; the dispatch
+einsum contracts tokens against a [tokens, experts, capacity] mask, and GSPMD
+lowers the resharding to the same all-to-all the reference calls explicitly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.ops.registry import OPS, OpDef
+from paddle_tpu.parallel.api import sharding_constraint
+
+
+def _switch_moe(x, gate_w, w1, b1, w2, b2, capacity_factor=1.25,
+                activation="gelu"):
+    """Pure kernel: top-1 (switch) routing with capacity, dense dispatch.
+    x: [tokens, d]; gate_w: [d, E]; w1: [E, d, f]; w2: [E, f, d]."""
+    s, d = x.shape
+    e = gate_w.shape[1]
+    c = max(int(capacity_factor * s / e), 1)
+
+    logits = jnp.matmul(x.astype(jnp.float32), gate_w.astype(jnp.float32))
+    probs = jnp.exp(logits - jnp.log(jnp.sum(jnp.exp(logits), -1, keepdims=True)))
+    expert_idx = jnp.argmax(probs, axis=-1)                     # [s]
+    expert_prob = jnp.max(probs, axis=-1)                       # [s]
+    onehot = jnp.eye(e, dtype=jnp.float32)[expert_idx]          # [s, e]
+    # position of each token within its expert queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot          # [s, e]
+    pos_in_e = jnp.sum(pos, axis=-1)                            # [s]
+    keep = pos_in_e < c
+    pos_oh = jnp.eye(c, dtype=jnp.float32)[
+        jnp.clip(pos_in_e, 0, c - 1).astype(jnp.int32)]         # [s, c]
+    dispatch = (onehot * keep[:, None])[:, :, None] * pos_oh[:, None, :]
+    combine = dispatch * expert_prob[:, None, None]
+
+    xin = jnp.einsum("sec,sd->ecd", dispatch.astype(x.dtype), x)
+    h = jnp.einsum("ecd,edf->ecf", xin, w1) + b1[:, None, :]
+    h = _act(h, activation)
+    out_e = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
+    y = jnp.einsum("sec,ecd->sd", combine.astype(x.dtype), out_e)
+
+    # switch aux load-balancing loss (Fedus et al.)
+    frac_tokens = jnp.mean(onehot, axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+    return y, aux.astype(x.dtype)
+
+
+def _act(h, name):
+    import jax
+
+    return {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+            "silu": jax.nn.silu}[name](h)
+
+
+OPS["switch_moe"] = OpDef("switch_moe", _switch_moe, diff=True, method=False)
+
+
+class MoELayer(Layer):
+    """Switch-MoE FFN block. Expert weights sharded over 'ep'."""
+
+    def __init__(self, d_model, d_ffn, num_experts, capacity_factor=1.25,
+                 activation="gelu", name=None):
+        super().__init__()
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.activation = activation
+        self.gate = self.create_parameter(
+            [d_model, num_experts], default_initializer=I.Normal(0.0, 0.02))
+        self.w1 = self.create_parameter(
+            [num_experts, d_model, d_ffn],
+            default_initializer=I.Normal(0.0, 0.02),
+            attr={"sharding": P("ep", None, None)})
+        self.b1 = self.create_parameter(
+            [num_experts, d_ffn], is_bias=True,
+            attr={"sharding": P("ep", None)})
+        self.w2 = self.create_parameter(
+            [num_experts, d_ffn, d_model],
+            default_initializer=I.Normal(0.0, 0.02),
+            attr={"sharding": P("ep", None, None)})
+        self.b2 = self.create_parameter(
+            [num_experts, d_model], is_bias=True,
+            attr={"sharding": P("ep", None)})
+        self.aux_loss = None
+
+    def forward(self, x):
+        from paddle_tpu.ops.registry import dispatch
+
+        shape = x.shape
+        flat = x.reshape([-1, shape[-1]])
+        y, aux = dispatch("switch_moe",
+                          (flat, self.gate, self.w1, self.b1, self.w2, self.b2),
+                          {"capacity_factor": self.capacity_factor,
+                           "activation": self.activation})
+        self.aux_loss = aux
+        return y.reshape(shape)

@@ -1,0 +1,1 @@
+from paddle_tpu.utils import flags  # noqa: F401

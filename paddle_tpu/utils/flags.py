@@ -1,0 +1,59 @@
+"""Global runtime flags.
+
+TPU-native analogue of the reference's exported flag registry
+(paddle/common/flags.cc — ~185 PHI_DEFINE_EXPORTED_* flags, readable from Python
+via paddle.get_flags/set_flags). Here flags are a plain process-global dict;
+FLAGS_* environment variables seed the defaults at import, mirroring the
+reference's env-var override behaviour.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable, Mapping
+
+_FLAGS: Dict[str, Any] = {}
+_DEFS: Dict[str, Any] = {}
+
+
+def define_flag(name: str, default: Any, help_str: str = "") -> None:
+    """Register a flag with a default; env var of the same name overrides."""
+    _DEFS[name] = (default, help_str)
+    env = os.environ.get(name)
+    if env is not None:
+        if isinstance(default, bool):
+            _FLAGS[name] = env.lower() in ("1", "true", "yes", "on")
+        elif isinstance(default, int):
+            _FLAGS[name] = int(env)
+        elif isinstance(default, float):
+            _FLAGS[name] = float(env)
+        else:
+            _FLAGS[name] = env
+    else:
+        _FLAGS[name] = default
+
+
+def set_flags(flags: Mapping[str, Any]) -> None:
+    """Like paddle.set_flags (python/paddle/base/core.py)."""
+    for k, v in flags.items():
+        if k not in _FLAGS:
+            raise KeyError(f"unknown flag {k!r}")
+        _FLAGS[k] = v
+
+
+def get_flags(flags: Iterable[str] | str) -> Dict[str, Any]:
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: _FLAGS[k] for k in flags}
+
+
+def flag(name: str) -> Any:
+    return _FLAGS[name]
+
+
+# Load-bearing flags mirrored from the reference (paddle/common/flags.cc).
+define_flag("FLAGS_check_nan_inf", False, "scan op outputs for NaN/Inf")
+define_flag("FLAGS_eager_op_jit", True, "dispatch eager ops through per-op jit cache")
+define_flag("FLAGS_default_dtype", "float32", "default floating dtype")
+define_flag("FLAGS_amp_dtype", "bfloat16", "preferred low precision dtype on TPU")
+define_flag("FLAGS_log_compiles", False, "log XLA compilations")

@@ -1,0 +1,23 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The driver's real-TPU runs use bench.py / __graft_entry__.py; unit tests run
+on the XLA CPU backend with 8 virtual devices (SURVEY.md §4: "strictly better
+than the reference's fake-device story").
+"""
+
+import os
+
+os.environ.setdefault("JAX_NUM_CPU_DEVICES", "8")
+
+import jax
+
+# must happen before the CPU client is instantiated
+jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+import paddle_tpu  # noqa: E402
+
+paddle_tpu.set_device("cpu")

@@ -1,0 +1,105 @@
+"""OpTest harness.
+
+Reference: test/legacy_test/op_test.py:418 — define op + numpy inputs +
+expected; check_output runs through BOTH executors (dygraph
+_calc_dygraph_output:1201 and PIR _calc_pir_output:1343) and compares to
+numpy with per-dtype tolerances (:3002-3007); check_grad does
+numeric-vs-analytic comparison (:3075).
+
+TPU adaptation: "both universes" = eager dispatch AND the same op under
+jax.jit (the static path); grad check = tape backward vs numeric central
+difference.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Sequence
+
+import jax
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+DEFAULT_TOL = {
+    np.dtype(np.float32): 1e-5,
+    np.dtype(np.float16): 1e-2,
+    np.dtype("bfloat16") if hasattr(np, "bfloat16") else np.dtype(np.float32): 2e-2,
+    np.dtype(np.float64): 1e-7,
+}
+
+
+def check_output(op_name: str, np_ref: Callable, inputs: Sequence[np.ndarray],
+                 attrs: Dict = None, rtol=None, atol=1e-6):
+    """Run the dispatched op eagerly and under jit; compare both to np_ref."""
+    attrs = attrs or {}
+    fn = getattr(paddle._C_ops, op_name)
+    tin = [paddle.to_tensor(a) for a in inputs]
+    expected = np_ref(*inputs, **attrs)
+    if not isinstance(expected, (tuple, list)):
+        expected = (expected,)
+
+    # eager
+    out = fn(*tin, **attrs)
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    rtol_ = rtol or DEFAULT_TOL.get(np.dtype(inputs[0].dtype), 1e-5)
+    for o, e in zip(outs, expected):
+        np.testing.assert_allclose(
+            np.asarray(o.numpy(), dtype=np.asarray(e).dtype), e,
+            rtol=rtol_, atol=atol,
+            err_msg=f"{op_name} eager mismatch")
+
+    # static (jit over the raw impl)
+    from paddle_tpu.ops.registry import OPS
+
+    impl = OPS[op_name].impl
+    if not OPS[op_name].dynamic:
+        jit_out = jax.jit(lambda *vals: impl(*vals, **attrs))(
+            *[t._value for t in tin])
+        jouts = jit_out if isinstance(jit_out, (tuple, list)) else (jit_out,)
+        for o, e in zip(jouts, expected):
+            np.testing.assert_allclose(
+                np.asarray(o, dtype=np.asarray(e).dtype), e,
+                rtol=rtol_, atol=atol,
+                err_msg=f"{op_name} jit mismatch")
+
+
+def check_grad(op_name: str, inputs: Sequence[np.ndarray], attrs: Dict = None,
+               grad_input_idx: int = 0, eps=1e-3, rtol=5e-2, atol=1e-3,
+               reduce_fn=None):
+    """Numeric vs analytic gradient, scalar-loss reduction = sum (matching
+    reference check_grad's output-grad-of-ones)."""
+    attrs = attrs or {}
+    fn = getattr(paddle._C_ops, op_name)
+
+    def scalar_loss(*arrs):
+        tin = [paddle.to_tensor(a, stop_gradient=(i != grad_input_idx))
+               for i, a in enumerate(arrs)]
+        out = fn(*tin, **attrs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        if reduce_fn is not None:
+            out = reduce_fn(out)
+        return out.sum() if out.ndim > 0 else out, tin[grad_input_idx]
+
+    loss, target = scalar_loss(*inputs)
+    loss.backward()
+    analytic = target.grad.numpy()
+
+    # numeric central differences
+    base = [np.array(a, dtype=np.float64) for a in inputs]
+    x = base[grad_input_idx]
+    numeric = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        orig = x[idx]
+        x[idx] = orig + eps
+        lp, _ = scalar_loss(*[b.astype(np.float32) for b in base])
+        x[idx] = orig - eps
+        lm, _ = scalar_loss(*[b.astype(np.float32) for b in base])
+        x[idx] = orig
+        numeric[idx] = (float(lp) - float(lm)) / (2 * eps)
+        it.iternext()
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol,
+                               err_msg=f"{op_name} grad mismatch")

@@ -1,0 +1,137 @@
+"""to_static + TrainStep tests (reference: test/dygraph_to_static/)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+rng = np.random.default_rng(3)
+
+
+def _f(*shape):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.bn = nn.BatchNorm1D(16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        h = paddle.nn.functional.relu(self.fc1(x))
+        h = self.bn(h.unsqueeze(-1)).squeeze(-1) if False else h
+        return self.fc2(h)
+
+
+def test_to_static_parity():
+    paddle.seed(7)
+    net = SmallNet()
+    net.eval()
+    x = paddle.to_tensor(_f(4, 8))
+    eager = net(x).numpy()
+    static_net = paddle.jit.to_static(net)
+    out = static_net(x).numpy()
+    np.testing.assert_allclose(out, eager, rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_function():
+    @paddle.jit.to_static
+    def fn(a, b):
+        return paddle.tanh(a) + b * 2
+
+    a, b = paddle.to_tensor(_f(3)), paddle.to_tensor(_f(3))
+    np.testing.assert_allclose(fn(a, b).numpy(),
+                               np.tanh(a.numpy()) + b.numpy() * 2,
+                               rtol=1e-6)
+
+
+def test_to_static_recompiles_per_shape():
+    net = SmallNet().eval()
+    sf = paddle.jit.to_static(net)
+    sf(paddle.to_tensor(_f(2, 8)))
+    sf(paddle.to_tensor(_f(5, 8)))
+    assert len(sf._cache) == 2
+
+
+def test_batchnorm_buffer_update_under_jit():
+    bn = nn.BatchNorm1D(4)
+    bn.train()
+    sf = paddle.jit.to_static(bn)
+    before = bn._mean.numpy().copy()
+    sf(paddle.to_tensor(_f(16, 4) + 3.0))
+    after = bn._mean.numpy()
+    assert not np.allclose(before, after)
+
+
+def test_train_step_matches_eager():
+    paddle.seed(11)
+    x, y = _f(32, 8), rng.integers(0, 4, 32)
+    lossfn = nn.CrossEntropyLoss()
+
+    def make():
+        paddle.seed(42)
+        return SmallNet()
+
+    # eager
+    net_e = make()
+    opt_e = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net_e.parameters())
+    losses_e = []
+    for _ in range(5):
+        loss = lossfn(net_e(paddle.to_tensor(x)),
+                      paddle.to_tensor(y.astype(np.int32)))
+        loss.backward()
+        opt_e.step()
+        opt_e.clear_grad()
+        losses_e.append(float(loss))
+
+    # compiled
+    net_j = make()
+    opt_j = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net_j.parameters())
+    step = paddle.jit.TrainStep(net_j, lambda o, t: lossfn(o, t), opt_j)
+    losses_j = [float(step(paddle.to_tensor(x),
+                           paddle.to_tensor(y.astype(np.int32))))
+                for _ in range(5)]
+    np.testing.assert_allclose(losses_e, losses_j, rtol=1e-4, atol=1e-5)
+
+    # sync writes back
+    step.sync()
+    np.testing.assert_allclose(net_j.fc1.weight.numpy(),
+                               np.asarray(step.params["fc1.weight"]))
+
+
+def test_train_step_amp_bf16():
+    paddle.seed(5)
+    net = SmallNet()
+    opt = paddle.optimizer.Adam(parameters=net.parameters())
+    lossfn = nn.CrossEntropyLoss()
+    step = paddle.jit.TrainStep(net, lambda o, t: lossfn(o, t), opt,
+                                amp_level="O1")
+    x, y = _f(16, 8), rng.integers(0, 4, 16).astype(np.int32)
+    l0 = float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+    l1 = float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+    assert np.isfinite(l0) and l1 < l0 + 1.0
+
+
+def test_convnet_train_convergence():
+    """Mini end-to-end: tiny CNN learns a separable image task (the round-1
+    'minimum slice' — SURVEY.md §7 step 3)."""
+    paddle.seed(0)
+    n = 64
+    xs = rng.standard_normal((n, 1, 8, 8)).astype(np.float32)
+    ys = (xs.mean(axis=(1, 2, 3)) > 0).astype(np.int32)
+
+    net = nn.Sequential(
+        nn.Conv2D(1, 4, 3, padding=1), nn.ReLU(), nn.MaxPool2D(2),
+        nn.Flatten(), nn.Linear(4 * 4 * 4, 2))
+    opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                learning_rate=1e-2)
+    lossfn = nn.CrossEntropyLoss()
+    step = paddle.jit.TrainStep(net, lambda o, t: lossfn(o, t), opt)
+    first = float(step(paddle.to_tensor(xs), paddle.to_tensor(ys)))
+    for _ in range(60):
+        last = float(step(paddle.to_tensor(xs), paddle.to_tensor(ys)))
+    assert last < first * 0.5, (first, last)

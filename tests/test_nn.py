@@ -1,0 +1,221 @@
+"""Layer tests, with torch (CPU) as the parity oracle for conv/norm
+(reference: test/legacy_test/test_conv2d_op.py etc. compare to numpy;
+torch.nn.functional is a stricter oracle)."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+rng = np.random.default_rng(1)
+
+
+def _f(*shape):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+def test_linear():
+    layer = nn.Linear(8, 4)
+    x = paddle.to_tensor(_f(2, 8))
+    out = layer(x)
+    assert out.shape == [2, 4]
+    ref = x.numpy() @ layer.weight.numpy() + layer.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("stride,padding,dilation,groups", [
+    (1, 0, 1, 1), (2, 1, 1, 1), (1, 2, 2, 1), (1, 1, 1, 2),
+])
+def test_conv2d_vs_torch(stride, padding, dilation, groups):
+    x = _f(2, 4, 9, 9)
+    w = _f(6, 4 // groups, 3, 3)
+    b = _f(6)
+    out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                   paddle.to_tensor(b), stride=stride, padding=padding,
+                   dilation=dilation, groups=groups)
+    ref = tF.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                    stride=stride, padding=padding, dilation=dilation,
+                    groups=groups)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_transpose_vs_torch():
+    x = _f(2, 4, 5, 5)
+    w = _f(4, 3, 3, 3)  # [in, out, kh, kw]
+    out = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                             stride=2, padding=1)
+    ref = tF.conv_transpose2d(torch.tensor(x), torch.tensor(w), stride=2,
+                              padding=1)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_pools_vs_torch():
+    x = _f(2, 3, 8, 8)
+    out = F.max_pool2d(paddle.to_tensor(x), 2, 2)
+    ref = tF.max_pool2d(torch.tensor(x), 2, 2)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
+    out = F.avg_pool2d(paddle.to_tensor(x), 2, 2)
+    ref = tF.avg_pool2d(torch.tensor(x), 2, 2)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
+    out = F.adaptive_avg_pool2d(paddle.to_tensor(x), 2)
+    ref = tF.adaptive_avg_pool2d(torch.tensor(x), 2)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+
+def test_layer_norm_vs_torch():
+    x = _f(4, 10)
+    ln = nn.LayerNorm(10)
+    out = ln(paddle.to_tensor(x))
+    ref = tF.layer_norm(torch.tensor(x), (10,),
+                        torch.tensor(ln.weight.numpy()),
+                        torch.tensor(ln.bias.numpy()))
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_batch_norm_train_eval():
+    bn = nn.BatchNorm2D(3, momentum=0.9)
+    x = paddle.to_tensor(_f(4, 3, 5, 5))
+    bn.train()
+    out = bn(x)
+    xn = x.numpy()
+    mean = xn.mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(
+        bn._mean.numpy(), 0.1 * mean, rtol=1e-4, atol=1e-5)
+    ref = (xn - mean.reshape(1, 3, 1, 1)) / np.sqrt(
+        xn.var(axis=(0, 2, 3)).reshape(1, 3, 1, 1) + 1e-5)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-3, atol=1e-4)
+    bn.eval()
+    out2 = bn(x)  # uses running stats now
+    assert not np.allclose(out2.numpy(), out.numpy())
+
+
+def test_group_norm_vs_torch():
+    x = _f(2, 6, 4, 4)
+    gn = nn.GroupNorm(3, 6)
+    out = gn(paddle.to_tensor(x))
+    ref = tF.group_norm(torch.tensor(x), 3,
+                        torch.tensor(gn.weight.numpy()),
+                        torch.tensor(gn.bias.numpy()))
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    x = paddle.to_tensor(np.array([[1, 0, 3]]))
+    out = emb(x)
+    assert out.shape == [1, 3, 4]
+    np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(4))
+
+
+def test_dropout_modes():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([1000])
+    d.train()
+    y = d(x)
+    kept = float((y.numpy() != 0).mean())
+    assert 0.3 < kept < 0.7
+    # upscale: kept values are 2.0
+    nz = y.numpy()[y.numpy() != 0]
+    np.testing.assert_allclose(nz, 2.0)
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+
+def test_state_dict_roundtrip():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = m.state_dict()
+    assert set(sd) == {"0.weight", "0.bias", "2.weight", "2.bias"}
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2.set_state_dict(sd)
+    for k in sd:
+        np.testing.assert_allclose(m2.state_dict()[k].numpy(), sd[k].numpy())
+
+
+def test_save_load(tmp_path):
+    m = nn.Linear(3, 3)
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(m.state_dict(), path)
+    loaded = paddle.load(path)
+    m2 = nn.Linear(3, 3)
+    m2.set_state_dict(loaded)
+    np.testing.assert_allclose(m2.weight.numpy(), m.weight.numpy())
+
+
+def test_named_parameters_nested():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(2, 2)
+            self.blocks = nn.LayerList([nn.Linear(2, 2) for _ in range(2)])
+
+        def forward(self, x):
+            return self.blocks[1](self.blocks[0](self.fc(x)))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert "fc.weight" in names and "blocks.1.bias" in names
+    assert len(names) == 6
+
+
+def test_hooks():
+    layer = nn.Linear(2, 2)
+    calls = []
+    h1 = layer.register_forward_pre_hook(lambda l, args: calls.append("pre"))
+    h2 = layer.register_forward_post_hook(
+        lambda l, args, out: calls.append("post"))
+    layer(paddle.ones([1, 2]))
+    assert calls == ["pre", "post"]
+    h1.remove()
+    h2.remove()
+    layer(paddle.ones([1, 2]))
+    assert calls == ["pre", "post"]
+
+
+def test_transformer_encoder():
+    enc_layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(enc_layer, 2)
+    x = paddle.to_tensor(_f(2, 5, 16))
+    out = enc(x)
+    assert out.shape == [2, 5, 16]
+    # distinct layers after deepcopy (not shared weights)
+    p = list(enc.layers[0].named_parameters())[0][1]
+    q = list(enc.layers[1].named_parameters())[0][1]
+    assert p is not q
+
+
+def test_attention_causal_mask():
+    q = paddle.to_tensor(_f(1, 4, 2, 8))
+    out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+    assert out.shape == [1, 4, 2, 8]
+
+
+def test_losses_vs_torch():
+    logits = _f(6, 4)
+    labels = rng.integers(0, 4, 6)
+    out = F.cross_entropy(paddle.to_tensor(logits),
+                          paddle.to_tensor(labels.astype(np.int32)))
+    ref = tF.cross_entropy(torch.tensor(logits), torch.tensor(labels))
+    np.testing.assert_allclose(float(out), float(ref), rtol=1e-5)
+
+    out = F.cross_entropy(paddle.to_tensor(logits),
+                          paddle.to_tensor(labels.astype(np.int32)),
+                          label_smoothing=0.1)
+    ref = tF.cross_entropy(torch.tensor(logits), torch.tensor(labels),
+                           label_smoothing=0.1)
+    np.testing.assert_allclose(float(out), float(ref), rtol=1e-5)
+
+    x, y = _f(5, 3), _f(5, 3)
+    out = F.smooth_l1_loss(paddle.to_tensor(x), paddle.to_tensor(y))
+    ref = tF.smooth_l1_loss(torch.tensor(x), torch.tensor(y))
+    np.testing.assert_allclose(float(out), float(ref), rtol=1e-5)
+
+    logit, lab = _f(5), (rng.random(5) > 0.5).astype(np.float32)
+    out = F.binary_cross_entropy_with_logits(paddle.to_tensor(logit),
+                                             paddle.to_tensor(lab))
+    ref = tF.binary_cross_entropy_with_logits(torch.tensor(logit),
+                                              torch.tensor(lab))
+    np.testing.assert_allclose(float(out), float(ref), rtol=1e-5)

@@ -1,0 +1,240 @@
+"""Op unit tests via the OpTest harness (reference: test/legacy_test)."""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, check_output
+
+rng = np.random.default_rng(0)
+
+
+def _f(*shape):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+def _pos(*shape):
+    return (rng.random(shape).astype(np.float32) + 0.1)
+
+
+BINARY = [
+    ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+    ("maximum", np.maximum), ("minimum", np.minimum),
+    ("atan2", np.arctan2),
+]
+
+UNARY = [
+    ("abs", np.abs), ("neg", np.negative), ("exp", np.exp), ("tanh", np.tanh),
+    ("sin", np.sin), ("cos", np.cos), ("floor", np.floor), ("ceil", np.ceil),
+    ("sign", np.sign), ("square", np.square),
+    ("expm1", np.expm1), ("sinh", np.sinh), ("cosh", np.cosh),
+]
+
+
+@pytest.mark.parametrize("name,ref", BINARY, ids=[b[0] for b in BINARY])
+def test_binary(name, ref):
+    check_output(name, ref, [_f(3, 4), _f(3, 4)])
+    check_output(name, ref, [_f(3, 4), _f(4)])  # broadcast
+
+
+@pytest.mark.parametrize("name,ref", UNARY, ids=[u[0] for u in UNARY])
+def test_unary(name, ref):
+    check_output(name, ref, [_f(5, 3)])
+
+
+def test_divide():
+    check_output("divide", np.divide, [_f(3, 4), _pos(3, 4)])
+
+
+def test_log_family():
+    check_output("log", np.log, [_pos(4, 4)])
+    check_output("log1p", np.log1p, [_pos(4, 4)])
+    check_output("sqrt", np.sqrt, [_pos(4, 4)])
+    check_output("rsqrt", lambda x: 1 / np.sqrt(x), [_pos(4, 4)])
+
+
+def test_matmul():
+    a, b = _f(4, 8), _f(8, 5)
+    check_output("matmul", np.matmul, [a, b])
+    check_output("matmul",
+                 lambda x, y, transpose_x=False: np.matmul(x.T, y),
+                 [a.T.copy(), b], attrs={"transpose_x": True})
+    check_output("matmul",
+                 lambda x, y, transpose_y=False: np.matmul(x, y.T),
+                 [a, b.T.copy()], attrs={"transpose_y": True})
+    # batched
+    check_output("matmul", np.matmul, [_f(2, 3, 4), _f(2, 4, 5)])
+
+
+def test_reductions():
+    x = _f(3, 4, 5)
+    check_output("sum", lambda a, axis=None, keepdim=False:
+                 np.sum(a, axis=axis, keepdims=keepdim), [x],
+                 attrs={"axis": 1})
+    check_output("mean", lambda a, axis=None, keepdim=False:
+                 np.mean(a, axis=axis, keepdims=keepdim), [x],
+                 attrs={"axis": (0, 2), "keepdim": True})
+    check_output("max", lambda a, axis=None, keepdim=False:
+                 np.max(a, axis=axis, keepdims=keepdim), [x], attrs={"axis": 0})
+    check_output("prod", lambda a: np.prod(a, axis=None), [_f(2, 3)])
+    check_output("logsumexp", lambda a, axis=None, keepdim=False:
+                 np.log(np.sum(np.exp(a), axis=axis, keepdims=keepdim)), [x],
+                 attrs={"axis": 2})
+    check_output("argmax", lambda a, axis=None: np.argmax(a, axis=axis).astype(np.int32),
+                 [x], attrs={"axis": 1})
+    check_output("cumsum", lambda a, axis=None: np.cumsum(a, axis=axis), [x],
+                 attrs={"axis": 1})
+
+
+def test_manipulation():
+    x = _f(2, 3, 4)
+    check_output("reshape", lambda a, shape: a.reshape(shape), [x],
+                 attrs={"shape": (6, 4)})
+    check_output("transpose", lambda a, perm: np.transpose(a, perm), [x],
+                 attrs={"perm": (2, 0, 1)})
+    check_output("squeeze", lambda a, axis=None: np.squeeze(a, axis=axis),
+                 [_f(2, 1, 4)], attrs={"axis": 1})
+    check_output("unsqueeze", lambda a, axis: np.expand_dims(a, axis), [x],
+                 attrs={"axis": 1})
+    check_output("flatten", lambda a, start_axis=0, stop_axis=-1:
+                 a.reshape(2, 12), [x], attrs={"start_axis": 1})
+    check_output("tile", lambda a, repeat_times: np.tile(a, repeat_times),
+                 [_f(2, 3)], attrs={"repeat_times": (2, 2)})
+    check_output("flip", lambda a, axis: np.flip(a, axis), [x],
+                 attrs={"axis": 1})
+    check_output("tril", np.tril, [_f(4, 4)])
+    check_output("triu", np.triu, [_f(4, 4)])
+    check_output("roll", lambda a, shifts, axis=None: np.roll(a, shifts, axis),
+                 [x], attrs={"shifts": 2, "axis": 1})
+
+
+def test_concat_split():
+    import paddle_tpu as paddle
+
+    a, b = _f(2, 3), _f(2, 3)
+    out = paddle._C_ops.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+    np.testing.assert_allclose(out.numpy(), np.concatenate([a, b], 0), rtol=1e-6)
+
+    x = paddle.to_tensor(_f(6, 4))
+    parts = paddle._C_ops.split(x, 3, axis=0)
+    assert len(parts) == 3 and parts[0].shape == [2, 4]
+    parts = paddle._C_ops.split(x, [1, 2, -1], axis=0)
+    assert [p.shape[0] for p in parts] == [1, 2, 3]
+
+
+def test_gather_ops():
+    x = _f(5, 4)
+    idx = np.array([0, 2, 4])
+    check_output("gather", lambda a, i, axis=0: np.take(a, i, axis=axis),
+                 [x, idx])
+    check_output("index_select", lambda a, i, axis=0: np.take(a, i, axis=axis),
+                 [x, idx], attrs={"axis": 1} if False else {})
+    check_output(
+        "take_along_axis",
+        lambda a, i, axis: np.take_along_axis(a, i, axis=axis),
+        [x, np.array([[0, 1, 2, 3], [3, 2, 1, 0]])], attrs={"axis": 0})
+
+
+def test_where_masked():
+    x, y = _f(3, 4), _f(3, 4)
+    cond = x > 0
+    import paddle_tpu as paddle
+
+    out = paddle._C_ops.where(paddle.to_tensor(cond), paddle.to_tensor(x),
+                              paddle.to_tensor(y))
+    np.testing.assert_allclose(out.numpy(), np.where(cond, x, y))
+    check_output("masked_fill", lambda a, m, value: np.where(m, value, a),
+                 [x, cond], attrs={"value": 0.5})
+
+
+def test_comparison():
+    x, y = _f(3, 4), _f(3, 4)
+    check_output("equal", np.equal, [x, x.copy()])
+    check_output("greater_than", np.greater, [x, y])
+    check_output("less_equal", np.less_equal, [x, y])
+    check_output("isclose", np.isclose, [x, x + 1e-7])
+
+
+def test_topk_sort():
+    x = _f(4, 10)
+    check_output("sort", lambda a, axis=-1: np.sort(a, axis=axis), [x])
+    check_output(
+        "argsort",
+        lambda a, axis=-1: np.argsort(a, axis=axis).astype(np.int32), [x])
+    import paddle_tpu as paddle
+
+    vals, idx = paddle._C_ops.topk(paddle.to_tensor(x), 3)
+    ref = np.sort(x, axis=-1)[:, ::-1][:, :3]
+    np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+
+
+def test_activation_outputs():
+    x = _f(4, 6)
+    check_output("relu", lambda a: np.maximum(a, 0), [x])
+    check_output("sigmoid", lambda a: 1 / (1 + np.exp(-a)), [x])
+    check_output("softmax", lambda a, axis=-1:
+                 np.exp(a) / np.exp(a).sum(axis, keepdims=True), [x])
+    check_output("leaky_relu", lambda a, negative_slope=0.01:
+                 np.where(a > 0, a, negative_slope * a), [x])
+    check_output("softplus",
+                 lambda a, beta=1.0, threshold=20.0: np.log1p(np.exp(a)), [x])
+    check_output("hardtanh", lambda a, min=-1.0, max=1.0: np.clip(a, -1, 1), [x])
+
+
+def test_one_hot_cast():
+    idx = np.array([0, 2, 1])
+    check_output("one_hot", lambda a, num_classes: np.eye(num_classes,
+                 dtype=np.float32)[a], [idx], attrs={"num_classes": 4})
+    x = _f(3, 3)
+    check_output("cast", lambda a, dtype: a.astype(dtype), [x],
+                 attrs={"dtype": np.int32})
+
+
+def test_loss_ops():
+    logits = _f(8, 5)
+    labels = rng.integers(0, 5, 8)
+
+    def np_ce(lg, lb, **kw):
+        m = lg - lg.max(-1, keepdims=True)
+        logp = m - np.log(np.exp(m).sum(-1, keepdims=True))
+        return -logp[np.arange(len(lb)), lb].mean()
+
+    check_output("cross_entropy", np_ce, [logits, labels], rtol=1e-5)
+    check_output("mse_loss", lambda a, b: ((a - b) ** 2).mean(),
+                 [_f(4, 3), _f(4, 3)])
+
+
+# ----------------------------------------------------------- gradient checks
+
+
+def test_grad_elementwise():
+    check_grad("multiply", [_f(3, 3), _f(3, 3)], grad_input_idx=0)
+    check_grad("tanh", [_f(4)])
+    check_grad("exp", [_f(4) * 0.5])
+    check_grad("sigmoid", [_f(4)])
+
+
+def test_grad_matmul():
+    check_grad("matmul", [_f(3, 4), _f(4, 2)], grad_input_idx=0)
+    check_grad("matmul", [_f(3, 4), _f(4, 2)], grad_input_idx=1)
+
+
+def test_grad_reduce():
+    check_grad("mean", [_f(3, 4)], attrs={"axis": 1})
+    check_grad("sum", [_f(3, 4)])
+
+
+def test_grad_softmax():
+    check_grad("softmax", [_f(3, 5)], reduce_fn=lambda o: (o * o))
+
+
+def test_grad_layer_norm():
+    x = _f(2, 6)
+    w = _pos(6)
+    b = _f(6)
+    check_grad("layer_norm", [x, w, b], grad_input_idx=0, rtol=8e-2)
+
+
+def test_grad_conv2d():
+    x = _f(1, 2, 6, 6)
+    w = _f(3, 2, 3, 3) * 0.2
+    check_grad("conv2d", [x, w], grad_input_idx=1, attrs={"padding": 1})

@@ -1,0 +1,129 @@
+"""Optimizer tests: trajectory parity vs torch.optim (stricter than the
+reference's numpy-reference op tests for adam/momentum kernels)."""
+
+import numpy as np
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+rng = np.random.default_rng(2)
+
+
+def _quadratic_pair(opt_name, p_kwargs, t_cls, t_kwargs, steps=10):
+    """Run N steps minimizing ||Wx - y||^2 in both frameworks from identical
+    init; compare final weights."""
+    w0 = rng.standard_normal((4, 3)).astype(np.float32)
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    y = rng.standard_normal((8, 3)).astype(np.float32)
+
+    # paddle_tpu
+    w = paddle.to_tensor(w0.copy(), stop_gradient=False)
+    w.trainable = True
+    opt_cls = getattr(paddle.optimizer, opt_name)
+    opt = opt_cls(parameters=[w], **p_kwargs)
+    for _ in range(steps):
+        loss = ((paddle.matmul(paddle.to_tensor(x), w) -
+                 paddle.to_tensor(y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+    # torch
+    tw = torch.tensor(w0.copy(), requires_grad=True)
+    topt = t_cls([tw], **t_kwargs)
+    for _ in range(steps):
+        tloss = ((torch.tensor(x) @ tw - torch.tensor(y)) ** 2).mean()
+        tloss.backward()
+        topt.step()
+        topt.zero_grad()
+
+    np.testing.assert_allclose(w.numpy(), tw.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_sgd_vs_torch():
+    _quadratic_pair("SGD", {"learning_rate": 0.1}, torch.optim.SGD,
+                    {"lr": 0.1})
+
+
+def test_momentum_vs_torch():
+    _quadratic_pair("Momentum", {"learning_rate": 0.05, "momentum": 0.9},
+                    torch.optim.SGD, {"lr": 0.05, "momentum": 0.9})
+
+
+def test_adam_vs_torch():
+    _quadratic_pair("Adam", {"learning_rate": 0.01},
+                    torch.optim.Adam, {"lr": 0.01})
+
+
+def test_adamw_vs_torch():
+    _quadratic_pair("AdamW", {"learning_rate": 0.01, "weight_decay": 0.1},
+                    torch.optim.AdamW, {"lr": 0.01, "weight_decay": 0.1})
+
+
+def test_grad_clip_global_norm():
+    w = paddle.to_tensor(np.ones((2, 2), np.float32) * 10, stop_gradient=False)
+    w.trainable = True
+    clip = paddle.optimizer.ClipGradByGlobalNorm(1.0)
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w],
+                               grad_clip=clip)
+    (w.sum() * 10).backward()  # grad = 10s, gnorm = 20
+    opt.step()
+    # clipped grad = g / 20 -> update of 0.5 each
+    np.testing.assert_allclose(w.numpy(), 10 - 0.5, rtol=1e-5)
+
+
+def test_lr_scheduler():
+    sched = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    w = paddle.to_tensor(np.ones(1, np.float32), stop_gradient=False)
+    w.trainable = True
+    opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[w])
+    lrs = []
+    for i in range(5):
+        lrs.append(opt.get_lr())
+        sched.step()
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+
+def test_cosine_warmup():
+    base = paddle.optimizer.lr.CosineAnnealingDecay(0.1, T_max=10)
+    warm = paddle.optimizer.lr.LinearWarmup(base, warmup_steps=5,
+                                            start_lr=0.0, end_lr=0.1)
+    lrs = [warm.get_lr()]
+    for _ in range(6):
+        warm.step()
+        lrs.append(warm.get_lr())
+    assert lrs[0] == 0.0
+    np.testing.assert_allclose(lrs[5], 0.1, rtol=1e-6)
+    assert lrs[6] < 0.1
+
+
+def test_optimizer_state_dict():
+    w = paddle.to_tensor(np.ones((2,), np.float32), stop_gradient=False)
+    w.trainable = True
+    opt = paddle.optimizer.Adam(parameters=[w], learning_rate=0.1)
+    (w * 2).sum().backward()
+    opt.step()
+    sd = opt.state_dict()
+    assert sd["step"] == 1
+    opt2 = paddle.optimizer.Adam(parameters=[w], learning_rate=0.1)
+    opt2.set_state_dict(sd)
+    np.testing.assert_allclose(
+        np.asarray(opt2._accumulators[id(w)]["moment1"]),
+        np.asarray(opt._accumulators[id(w)]["moment1"]))
+
+
+def test_bf16_master_weights():
+    w0 = rng.standard_normal((4, 4)).astype(np.float32)
+    w = paddle.to_tensor(w0, dtype="bfloat16", stop_gradient=False)
+    w.trainable = True
+    opt = paddle.optimizer.Adam(parameters=[w], learning_rate=1e-3,
+                                multi_precision=True)
+    for _ in range(3):
+        (w.astype("float32") ** 2).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    st = opt._accumulators[id(w)]
+    assert "master" in st and str(st["master"].dtype) == "float32"
+    assert str(w.dtype) == "bfloat16"
