@@ -1,0 +1,82 @@
+"""Benchmark: flagship GPT training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: GPT (124M-class) causal-LM training tokens/sec/chip through the
+fully-compiled TrainStep (bf16 AMP, AdamW). vs_baseline = achieved MFU
+fraction of the 55% north-star target (BASELINE.md — the reference publishes
+no in-tree numbers, so the north-star MFU is the yardstick).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPT, GPTConfig, gpt_loss_fn
+
+    backend = jax.default_backend()
+    # GPT-2-small-class config; fits one v5e chip with AdamW fp32 state
+    cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
+                    num_heads=12, max_seq_len=1024, dropout=0.0)
+    batch, seq = 8, 1024
+    if backend == "cpu":  # CI / fallback sizing
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=256)
+        batch, seq = 2, 256
+
+    paddle.seed(0)
+    model = GPT(cfg)
+    n_params = sum(p.size for p in model.parameters())
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=3e-4, weight_decay=0.1)
+    step = paddle.jit.TrainStep(model, gpt_loss_fn, opt, amp_level="O1",
+                                amp_dtype="bfloat16")
+
+    rng = np.random.default_rng(0)
+    toks = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)))
+
+    # warmup (compile) + 2 steps
+    t0 = time.time()
+    loss = step(toks, toks)
+    jax.block_until_ready(step.params)
+    compile_s = time.time() - t0
+    for _ in range(2):
+        loss = step(toks, toks)
+    jax.block_until_ready(step.params)
+
+    iters = 10
+    t0 = time.time()
+    for _ in range(iters):
+        loss = step(toks, toks)
+    jax.block_until_ready(step.params)
+    dt = (time.time() - t0) / iters
+
+    tokens_per_sec = batch * seq / dt
+    # train FLOPs/token ~= 6 * n_params
+    flops_per_sec = 6.0 * n_params * tokens_per_sec
+    peak = {"tpu": 197e12, "cpu": 1e12}.get(backend, 197e12)  # v5e bf16 peak
+    mfu = flops_per_sec / peak
+
+    print(json.dumps({
+        "metric": "gpt124m_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.55, 4),
+    }))
+    # diagnostics on stderr-ish second line (driver reads line 1)
+    import sys
+
+    print(f"# backend={backend} params={n_params/1e6:.1f}M "
+          f"step={dt*1000:.1f}ms compile={compile_s:.1f}s "
+          f"loss={float(loss):.3f} mfu={mfu:.3f}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+"""High-level API: paddle.Model + callbacks + summary.
+
+Reference: python/paddle/hapi/model.py:1472 (Model.fit:2200 / evaluate /
+predict), hapi/callbacks.py (ProgressBar, ModelCheckpoint, EarlyStopping,
+LRScheduler), hapi/model_summary.py (paddle.summary).
+
+TPU-native: Model.prepare(jit=True) (default) trains through the compiled
+TrainStep — the whole fit loop runs one XLA executable per batch with donated
+state, instead of the reference's per-op eager dispatch.
+"""
+
+from paddle_tpu.hapi.callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRSchedulerCallback, ModelCheckpoint, ProgBarLogger,
+)
+from paddle_tpu.hapi.model import Model  # noqa: F401
+from paddle_tpu.hapi.summary import summary  # noqa: F401

@@ -1,0 +1,151 @@
+"""Training callbacks. Reference: python/paddle/hapi/callbacks.py."""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+
+class Callback:
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = params
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks: Optional[List[Callback]] = None):
+        self.callbacks = list(callbacks or [])
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            def call(*args, **kwargs):
+                for c in self.callbacks:
+                    getattr(c, name)(*args, **kwargs)
+
+            return call
+        raise AttributeError(name)
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq=10, verbose=2):
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t0 = time.time()
+        self._steps = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        self._steps += 1
+        if self.verbose and step % self.log_freq == 0:
+            loss = (logs or {}).get("loss")
+            print(f"Epoch {self._epoch} step {step}: loss="
+                  f"{loss:.4f}" if loss is not None else "")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._t0
+            extra = " ".join(f"{k}={v:.4f}" for k, v in (logs or {}).items()
+                             if isinstance(v, float))
+            print(f"Epoch {epoch} done in {dt:.1f}s {extra}")
+
+
+class ModelCheckpoint(Callback):
+    """Reference: hapi/callbacks.py ModelCheckpoint."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and (epoch + 1) % self.save_freq == 0:
+            self.model.save(f"{self.save_dir}/{epoch}")
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(f"{self.save_dir}/final")
+
+
+class EarlyStopping(Callback):
+    """Reference: hapi/callbacks.py EarlyStopping."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        if mode == "max" or (mode == "auto" and ("acc" in monitor or
+                                                 "auc" in monitor)):
+            self.better = lambda cur, best: cur > best + self.min_delta
+            self.best = -float("inf")
+        else:
+            self.better = lambda cur, best: cur < best - self.min_delta
+            self.best = float("inf")
+        self.wait = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if self.better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.model.stop_training = True
+
+
+class LRSchedulerCallback(Callback):
+    """Steps the optimizer's LRScheduler each epoch (by_step=False) or each
+    batch (by_step=True). Reference: hapi/callbacks.py LRScheduler."""
+
+    def __init__(self, by_step=False, by_epoch=True):
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = self.model._optimizer
+        return getattr(opt, "_lr_scheduler", None)
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if self.by_epoch and s is not None:
+            s.step()
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()

@@ -1,0 +1,224 @@
+"""paddle.Model — the high-level train/eval/predict engine.
+
+Reference: python/paddle/hapi/model.py:1472.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.metric import Metric
+from paddle_tpu.nn.layer import Layer
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+        self._use_jit = True
+        self.stop_training = False
+
+    # ------------------------------------------------------------- prepare
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, jit=True,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, (list, tuple)):
+            self._metrics = list(metrics)
+        else:
+            self._metrics = [metrics]
+        self._use_jit = jit
+        self._amp_level = None
+        if isinstance(amp_configs, str):
+            self._amp_level = amp_configs
+        elif isinstance(amp_configs, dict):
+            self._amp_level = amp_configs.get("level")
+        return self
+
+    # ------------------------------------------------------------- steps
+
+    def _ensure_train_step(self):
+        if self._train_step is None and self._use_jit:
+            from paddle_tpu.jit import TrainStep
+
+            self._train_step = TrainStep(
+                self.network, lambda out, *labels: self._loss(out, *labels),
+                self._optimizer, amp_level=self._amp_level)
+        return self._train_step
+
+    def train_batch(self, inputs, labels=None):
+        self.network.train()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        if self._use_jit:
+            step = self._ensure_train_step()
+            loss = step(*inputs, *labels)
+            return [float(loss)]
+        out = self.network(*inputs)
+        loss = self._loss(out, *labels)
+        loss.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        return [float(loss)]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        self._sync()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        with paddle.no_grad():
+            out = self.network(*inputs)
+            loss = self._loss(out, *labels) if self._loss else None
+        metrics = []
+        for m in self._metrics:
+            res = m.update(m.compute(out, *labels)) if hasattr(m, "compute") \
+                else m.update(out, *labels)
+            metrics.append(res)
+        return ([float(loss)] if loss is not None else []), metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        self._sync()
+        with paddle.no_grad():
+            out = self.network(*_to_list(inputs))
+        return out
+
+    def _sync(self):
+        if self._train_step is not None:
+            self._train_step.sync()
+
+    # ------------------------------------------------------------- loops
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None):
+        from paddle_tpu.hapi.callbacks import CallbackList, ProgBarLogger
+
+        loader = _as_loader(train_data, batch_size, shuffle, drop_last,
+                            num_workers)
+        eval_loader = (_as_loader(eval_data, batch_size, False, False, 0)
+                       if eval_data is not None else None)
+        cbks = CallbackList((callbacks or []) +
+                            ([ProgBarLogger(log_freq)] if verbose else []))
+        cbks.set_model(self)
+        self.stop_training = False
+        cbks.on_train_begin()
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                inputs, labels = _split_batch(batch)
+                cbks.on_train_batch_begin(step)
+                losses = self.train_batch(inputs, labels)
+                logs = {"loss": losses[0], "step": step, "epoch": epoch}
+                cbks.on_train_batch_end(step, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/epoch_{epoch}")
+            if self.stop_training:
+                break
+        cbks.on_train_end(logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = _as_loader(eval_data, batch_size, False, False, num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            inputs, labels = _split_batch(batch)
+            loss, _ = self.eval_batch(inputs, labels)
+            losses.extend(loss)
+        logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            logs[m.name()] = m.accumulate()
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None):
+        loader = _as_loader(test_data, batch_size, False, False, num_workers)
+        outputs = []
+        for batch in loader:
+            # datasets commonly yield (input, label) even at predict time;
+            # drop the trailing label like the reference's input-spec split
+            inputs, _ = _split_batch(batch, has_labels=isinstance(
+                batch, (list, tuple)) and len(batch) >= 2)
+            outputs.append(self.predict_batch(inputs))
+        if stack_outputs:
+            if outputs and isinstance(outputs[0], (tuple, list)):
+                n_out = len(outputs[0])
+                return [Tensor._wrap(np.concatenate(
+                    [o[i].numpy() for o in outputs])) for i in range(n_out)]
+            return [Tensor._wrap(np.concatenate(
+                [o.numpy() for o in outputs]))]
+        return outputs
+
+    # ------------------------------------------------------------- io
+
+    def save(self, path, training=True):
+        self._sync()
+        paddle.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            paddle.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = paddle.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        self._train_step = None  # rebuild with fresh params
+        import os
+
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(paddle.load(path + ".pdopt"))
+        return self
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from paddle_tpu.hapi.summary import summary
+
+        return summary(self.network, input_size, dtypes=dtype)
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _split_batch(batch, has_labels=True):
+    if isinstance(batch, (list, tuple)):
+        if has_labels and len(batch) >= 2:
+            return list(batch[:-1]), [batch[-1]]
+        return list(batch), []
+    return [batch], []
+
+
+def _as_loader(data, batch_size, shuffle, drop_last, num_workers):
+    if isinstance(data, DataLoader):
+        return data
+    if isinstance(data, Dataset):
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+    return data

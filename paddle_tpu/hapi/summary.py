@@ -1,0 +1,71 @@
+"""paddle.summary — model summary table.
+
+Reference: python/paddle/hapi/model_summary.py. Uses jax.eval_shape so no
+device compute happens (the reference runs a real forward).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer import Layer
+
+
+def summary(net: Layer, input_size, dtypes=None):
+    """Prints a per-layer table; returns {'total_params', 'trainable_params'}."""
+    if isinstance(input_size, tuple) and input_size and isinstance(
+            input_size[0], (list, tuple)):
+        sizes = [tuple(s) for s in input_size]
+    else:
+        sizes = [tuple(input_size)]
+    dtypes = dtypes or ["float32"] * len(sizes)
+
+    records = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(l, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+            n_params = sum(int(np.prod(p.shape))
+                           for p in l._parameters.values() if p is not None)
+            records.append((name, type(l).__name__,
+                            list(getattr(out, "shape", [])), n_params))
+
+        return hook
+
+    for name, layer in net.named_sublayers(include_self=False):
+        if not layer._sub_layers:  # leaves only
+            hooks.append(layer.register_forward_post_hook(
+                make_hook(name, layer)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        inputs = [paddle.zeros(list(s), dtype=d)
+                  for s, d in zip(sizes, dtypes)]
+        with paddle.no_grad():
+            net(*inputs)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if p.trainable)
+
+    line = "-" * 72
+    print(line)
+    print(f"{'Layer (type)':<34}{'Output Shape':<22}{'Param #':>12}")
+    print(line)
+    for name, cls, shape, n in records:
+        print(f"{name + ' (' + cls + ')':<34}{str(shape):<22}{n:>12,}")
+    print(line)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print(line)
+    return {"total_params": total, "trainable_params": trainable}

@@ -235,9 +235,36 @@ class TrainStep:
         self._step_i += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = default_generator.next_key()
+        step_i = jnp.asarray(self._step_i, jnp.int32)
+        # when training over a mesh, every input must live on the mesh's
+        # devices (the host-created key/scalars default to the global default
+        # device, which may be a different backend entirely)
+        from paddle_tpu.parallel.mesh import current_mesh
+
+        mesh = self._mesh or current_mesh()
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(mesh, P())
+            key = jax.device_put(key, rep)
+            lr = jax.device_put(lr, rep)
+            step_i = jax.device_put(step_i, rep)
+            # batch inputs: per-input PartitionSpecs (in_shardings), else
+            # dp-shard the leading axis when a dp axis exists, else replicate
+            specs = self._in_shardings
+            if specs is None:
+                if "dp" in mesh.axis_names:
+                    specs = [
+                        P(*(["dp"] + [None] * (v.ndim - 1))) if v.ndim > 0
+                        and v.shape[0] % mesh.shape["dp"] == 0 else P()
+                        for v in vals
+                    ]
+                else:
+                    specs = [P()] * len(vals)
+            vals = tuple(jax.device_put(v, NamedSharding(mesh, s))
+                         for v, s in zip(vals, specs))
         self.params, self.buffers, self.opt_state, loss = self._compiled(
-            self.params, self.buffers, self.opt_state, key, lr,
-            jnp.asarray(self._step_i, jnp.int32), vals)
+            self.params, self.buffers, self.opt_state, key, lr, step_i, vals)
         return Tensor._wrap(loss)
 
     def sync(self):

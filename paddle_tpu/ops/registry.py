@@ -130,10 +130,35 @@ def _jitted_fn(name: str, args_tpl, kwargs_tpl, cast_dtype):
     return f, (jax.jit(f) if not op.dynamic else f)
 
 
+# Incremented by static.program_guard / whenever symbolic tensors can exist;
+# keeps the symbolic-input scan off the hot eager path entirely.
+STATIC_SEEN = [False]
+
+
+def _any_symbolic(obj) -> bool:
+    from paddle_tpu.core.tensor import Tensor
+
+    if isinstance(obj, Tensor):
+        return type(obj._value).__name__ == "_Symbolic"
+    if isinstance(obj, (list, tuple)):
+        return any(_any_symbolic(e) for e in obj)
+    return False
+
+
 def dispatch(name: str, args, kwargs):
     """The generic ad_func (reference eager_gen.py:372 template)."""
     from paddle_tpu.core.tensor import Tensor
     from paddle_tpu.amp.state import current_cast_dtype
+
+    # static-graph build mode: ops on symbolic tensors record program nodes
+    # (the reference's two-universe split, SURVEY.md §1 L5a/L5b). The flag
+    # flips the first time a Program is created, so pure-eager users never
+    # pay the tree walk.
+    if STATIC_SEEN[0] and (
+            _any_symbolic(args) or _any_symbolic(tuple(kwargs.values()))):
+        from paddle_tpu.static.program import record_dispatch
+
+        return record_dispatch(name, args, kwargs)
 
     op = OPS[name]
     tensors: List[Tensor] = []

@@ -191,6 +191,13 @@ def _register_constraint_op():
 
     def _impl(x, spec=None, mesh=None):
         if in_static_trace():
+            # inside shard_map an abstract mesh with Manual/Auto axis types
+            # is ambient; a bare PartitionSpec resolves against it (a concrete
+            # NamedSharding would mis-type the manual axes). Plain jit has an
+            # empty abstract mesh -> use the concrete mesh.
+            am = jax.sharding.get_abstract_mesh()
+            if am.axis_names:
+                return jax.lax.with_sharding_constraint(x, spec)
             return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
         return x
 

@@ -1,0 +1,226 @@
+"""Profiler: host events + device traces + chrome-trace export.
+
+Reference three-tier design (SURVEY.md §5.1):
+  - host events: RecordEvent RAII (paddle/phi/core/platform/profiler/
+    event_tracing.h) + HostEventRecorder
+  - device events: CUPTI tracer (fluid/platform/profiler/cuda_tracer.cc)
+  - aggregation: paddle.profiler.Profiler (python/paddle/profiler/
+    profiler.py:358) with scheduler states, chrome-trace export, stats.
+
+TPU-native: device-side tracing delegates to jax.profiler (XLA/TPU Xplane —
+richer than CUPTI: per-fusion HLO timing), host events are recorded here and
+exported alongside as chrome-trace JSON; ProfilerState/make_scheduler mirror
+the reference API.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, List, Optional
+
+import jax
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class _HostEventRecorder:
+    """Reference: host_event_recorder.h — thread-local event buffers."""
+
+    def __init__(self):
+        self.events: List[dict] = []
+        self._lock = threading.Lock()
+        self.enabled = False
+
+    def record(self, name, t0, t1, event_type="UserDefined"):
+        if not self.enabled:
+            return
+        with self._lock:
+            self.events.append({
+                "name": name, "ts": t0 * 1e6, "dur": (t1 - t0) * 1e6,
+                "tid": threading.get_ident() % 100000,
+                "type": event_type,
+            })
+
+    def clear(self):
+        with self._lock:
+            self.events = []
+
+
+_recorder = _HostEventRecorder()
+
+
+class RecordEvent:
+    """RAII host event (reference event_tracing.h RecordEvent). Usable as a
+    context manager or decorator-style begin/end."""
+
+    def __init__(self, name: str, event_type: str = "UserDefined"):
+        self.name = name
+        self.event_type = event_type
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+
+    def end(self):
+        if self._t0 is not None:
+            _recorder.record(self.name, self._t0, time.perf_counter(),
+                             self.event_type)
+            self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(closed: int = 0, ready: int = 0, record: int = 1,
+                   repeat: int = 0, skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Reference: profiler.py make_scheduler — step-indexed state machine."""
+    cycle = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= cycle * repeat:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+class Profiler:
+    """Reference: python/paddle/profiler/profiler.py:358."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        self.targets = targets or [ProfilerTarget.CPU, ProfilerTarget.TPU]
+        if scheduler is None:
+            self.scheduler = lambda step: ProfilerState.RECORD
+        elif isinstance(scheduler, (tuple, list)):
+            lo, hi = scheduler
+            self.scheduler = lambda step: (
+                ProfilerState.RECORD if lo <= step < hi else ProfilerState.CLOSED)
+        else:
+            self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.step_num = 0
+        self.state = ProfilerState.CLOSED
+        self._device_trace_dir = None
+        self._device_active = False
+
+    # -------------------------------------------------------------- control
+
+    def start(self):
+        _recorder.enabled = True
+        _recorder.clear()
+        self.state = self.scheduler(self.step_num)
+        self._maybe_device(self.state)
+
+    def stop(self):
+        self._maybe_device(ProfilerState.CLOSED)
+        _recorder.enabled = False
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def step(self):
+        self.step_num += 1
+        new_state = self.scheduler(self.step_num)
+        if new_state != self.state:
+            self._maybe_device(new_state)
+        self.state = new_state
+
+    def _maybe_device(self, state):
+        want = state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if want and not self._device_active and ProfilerTarget.TPU in self.targets:
+            self._device_trace_dir = os.environ.get(
+                "PADDLE_TPU_TRACE_DIR", "/tmp/paddle_tpu_trace")
+            try:
+                jax.profiler.start_trace(self._device_trace_dir)
+                self._device_active = True
+            except Exception:
+                self._device_active = False
+        elif not want and self._device_active:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._device_active = False
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -------------------------------------------------------------- export
+
+    def export_chrome_tracing(self, path: str):
+        """Host events as chrome trace (reference
+        chrometracing_logger.cc); device Xplane dumps live in the
+        jax.profiler trace dir."""
+        events = [{
+            "name": e["name"], "ph": "X", "ts": e["ts"], "dur": e["dur"],
+            "pid": 0, "tid": e["tid"], "cat": e["type"],
+        } for e in _recorder.events]
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        return path
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        """Aggregated host-event table (reference profiler_statistic.py)."""
+        agg = {}
+        for e in _recorder.events:
+            a = agg.setdefault(e["name"], [0.0, 0])
+            a[0] += e["dur"] / 1e3
+            a[1] += 1
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
+        for name, (tot, n) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
+            lines.append(f"{name:<40}{n:>8}{tot:>12.3f}{tot / n:>12.3f}")
+        table = "\n".join(lines)
+        print(table)
+        return table
+
+
+def export_chrome_tracing(dir_name: str, worker_name: str = None):
+    """on_trace_ready factory (reference profiler.py export_chrome_tracing)."""
+
+    def handler(prof: Profiler):
+        os.makedirs(dir_name, exist_ok=True)
+        fname = f"{worker_name or 'worker'}_{int(time.time())}.json"
+        prof.export_chrome_tracing(os.path.join(dir_name, fname))
+
+    return handler

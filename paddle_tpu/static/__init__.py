@@ -1,0 +1,214 @@
+"""paddle_tpu.static — the static-graph user API.
+
+Reference: python/paddle/static/ (data(), Program guards, Executor,
+append_backward base/backward.py:1967, save/load_inference_model
+static/io.py).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from paddle_tpu.core.place import Place
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.static.program import (  # noqa: F401
+    Program, _Symbolic, default_main_program, default_startup_program,
+    is_symbolic, program_guard,
+)
+
+
+class InputSpec:
+    """Reference: python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
+    """Declare a feed placeholder in the current program
+    (reference: python/paddle/static/input.py data)."""
+    return default_main_program().add_feed(name, shape, dtype)
+
+
+def append_backward(loss: Tensor, parameter_list=None, no_grad_set=None):
+    """Static autodiff over the recorded program (reference:
+    base/backward.py:1967). Returns [(param, grad_symbol)]. Whole-program
+    reverse-mode comes from jax.grad over the replay — one source of truth
+    with the eager tape (both are jax.vjp underneath)."""
+    prog = loss._value.program
+    params = parameter_list
+    if params is None:
+        params = [Tensor._wrap(v) for v in []]
+    result = []
+    # differentiate replay wrt the recorded constants that are parameters
+    param_vids = []
+    for p in parameter_list or []:
+        for vid, t in prog.const_tensors.items():
+            if t is p:
+                param_vids.append((p, vid))
+                break
+
+    loss_vid = loss._value.vid
+
+    for p, vid in param_vids:
+        gvid = prog.new_value(prog.avals[vid])
+        prog.grad_map[vid] = gvid
+        result.append((p, gvid))
+    prog._backward_spec = {"loss": loss_vid,
+                           "params": [vid for _, vid in param_vids]}
+    return result
+
+
+class Executor:
+    """Reference: base/executor.py:1237. run() compiles the whole program to
+    one XLA executable per feed signature (the Plan/PirInterpreter collapse —
+    SURVEY.md §3.2)."""
+
+    def __init__(self, place: Optional[Place] = None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program: Optional[Program] = None, feed: Dict = None,
+            fetch_list: Sequence = None, return_numpy: bool = True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        fetch_ids = []
+        for t in fetch_list:
+            if is_symbolic(t):
+                fetch_ids.append(t._value.vid)
+            else:
+                raise ValueError("fetch_list entries must be program outputs")
+
+        feed_values = {}
+        for name, v in feed.items():
+            if isinstance(v, Tensor):
+                v = v._value
+            feed_values[name] = jax.numpy.asarray(v)
+
+        backward = getattr(program, "_backward_spec", None)
+        sig = (id(program), len(program.nodes), tuple(sorted(feed)),
+               tuple((feed_values[k].shape, str(feed_values[k].dtype))
+                     for k in sorted(feed)), tuple(fetch_ids),
+               backward is not None)
+        compiled = self._cache.get(sig)
+        if compiled is None:
+            # constants (parameter values) are jit INPUTS — updating a
+            # parameter between runs takes effect without recompiling
+            if backward is None:
+                def run_fn(fv, consts, rng_keys):
+                    return program.replay(fv, fetch_ids, constants=consts,
+                                          rng_keys=rng_keys)
+            else:
+                loss_vid = backward["loss"]
+                param_vids = backward["params"]
+
+                def run_fn(fv, consts, rng_keys):
+                    pvals = {vid: consts[vid] for vid in param_vids}
+                    rest = {vid: v for vid, v in consts.items()
+                            if vid not in pvals}
+
+                    def loss_fn(pv):
+                        merged = dict(rest)
+                        merged.update(pv)
+                        outs = program.replay(fv, fetch_ids + [loss_vid],
+                                              constants=merged,
+                                              rng_keys=rng_keys)
+                        return outs[-1], outs[:-1]
+
+                    (loss_v, fetches), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True)(pvals)
+                    grad_outs = [grads[vid] for vid in param_vids]
+                    return tuple(fetches) + tuple(grad_outs)
+
+            compiled = jax.jit(run_fn)
+            self._cache[sig] = compiled
+
+        from paddle_tpu.core.random import default_generator
+
+        rng_keys = [default_generator.next_key()
+                    for _ in program.rng_slots]
+        outs = compiled(feed_values, program.current_constants(), rng_keys)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor._wrap(o) for o in outs]
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor,
+                         program: Optional[Program] = None):
+    """Reference: python/paddle/static/io.py save_inference_model. Serializes
+    the recorded tape + constants."""
+    program = program or default_main_program()
+
+    def _serialize(v):
+        if jax.numpy.issubdtype(v.dtype, jax.dtypes.prng_key):
+            return ("__key__", np.asarray(jax.random.key_data(v)))
+        return np.asarray(v)
+
+    payload = {
+        "nodes": [(n.op_name, n.args_tpl, n.kwargs_tpl, n.input_ids,
+                   n.out_ids) for n in program.nodes],
+        "feeds": program.feeds,
+        "avals": {vid: (tuple(a.shape), str(a.dtype))
+                  for vid, a in program.avals.items()},
+        "constants": {vid: _serialize(v)
+                      for vid, v in program.current_constants().items()},
+        "rng_slots": program.rng_slots,
+        "fetch_ids": [t._value.vid for t in fetch_vars],
+        "feed_names": [t.name for t in feed_vars],
+        "next_id": program._next_id,
+    }
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump(payload, f)
+
+
+def load_inference_model(path_prefix: str, executor):
+    """Returns (program, feed_names, fetch_targets)."""
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        payload = pickle.load(f)
+    from paddle_tpu.static.program import Node
+
+    prog = Program()
+    prog.nodes = [Node(*t) for t in payload["nodes"]]
+    prog.feeds = payload["feeds"]
+    prog.avals = {}
+    for vid, (s, d) in payload["avals"].items():
+        try:
+            prog.avals[int(vid)] = jax.ShapeDtypeStruct(s, np.dtype(d))
+        except TypeError:  # extended dtypes (prng keys) — not fetchable
+            prog.avals[int(vid)] = None
+    def _deserialize(v):
+        if isinstance(v, tuple) and len(v) == 2 and v[0] == "__key__":
+            return jax.random.wrap_key_data(jax.numpy.asarray(v[1]))
+        return jax.numpy.asarray(v)
+
+    prog.constants = {int(vid): _deserialize(v)
+                      for vid, v in payload["constants"].items()}
+    prog.rng_slots = payload.get("rng_slots", [])
+    prog._next_id = payload["next_id"]
+    fetch_targets = []
+    for vid in payload["fetch_ids"]:
+        t = Tensor.__new__(Tensor)
+        Tensor.__init__(t, None, stop_gradient=True)
+        t._value = _Symbolic(prog, vid, prog.avals[vid])
+        fetch_targets.append(t)
+    return prog, payload["feed_names"], fetch_targets
+
+
+def global_scope():
+    return {}
+
+
+def scope_guard(scope):
+    from contextlib import nullcontext
+
+    return nullcontext()

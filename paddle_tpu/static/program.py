@@ -1,0 +1,271 @@
+"""Static-graph Program: a recorded op tape compiled to one XLA executable.
+
+Reference architecture being mirrored:
+  - Program/Block graph building under program_guard
+    (python/paddle/base/framework.py Program:5890, static/__init__)
+  - shape inference while building: paddle/phi/infermeta/* -> here
+    jax.eval_shape over the op impl (same function both universes)
+  - execution: StandaloneExecutor/PirInterpreter
+    (fluid/framework/new_executor/) -> here the whole Program replays inside
+    ONE jax.jit, which is where TPUs want the static universe to live
+    (SURVEY.md §7 step 4): no instruction-level interpreter, no stream
+    analysis — XLA owns scheduling.
+
+Mechanics: under program_guard, `static.data` creates symbolic Tensors
+(abstract aval, no buffer). The eager dispatcher routes any op touching a
+symbolic tensor to Program.record, which appends a node and returns symbolic
+outputs shaped by eval_shape. Executor.run jit-compiles the replay, keyed by
+feed signatures.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from paddle_tpu.core import dtype as dtype_mod
+from paddle_tpu.core.tensor import Tensor
+
+
+class Node:
+    __slots__ = ("op_name", "args_tpl", "kwargs_tpl", "input_ids", "out_ids")
+
+    def __init__(self, op_name, args_tpl, kwargs_tpl, input_ids, out_ids):
+        self.op_name = op_name
+        self.args_tpl = args_tpl
+        self.kwargs_tpl = kwargs_tpl
+        self.input_ids = input_ids
+        self.out_ids = out_ids
+
+
+class Program:
+    """Reference: base/framework.py Program:5890 (single-block form)."""
+
+    def __init__(self):
+        self.nodes: List[Node] = []
+        self.feeds: Dict[str, int] = {}      # name -> value id
+        self.avals: Dict[int, jax.ShapeDtypeStruct] = {}
+        self.constants: Dict[int, Any] = {}  # value id -> concrete array
+        # value id -> live Tensor (parameters): reads current value each run
+        self.const_tensors: Dict[int, Any] = {}
+        self.rng_slots: List[int] = []       # value ids fed fresh keys per run
+        self._next_id = 0
+        self.grad_map: Dict[int, int] = {}   # primal value id -> grad value id
+
+    def new_value(self, aval) -> int:
+        vid = self._next_id
+        self._next_id += 1
+        self.avals[vid] = aval
+        return vid
+
+    def add_feed(self, name, shape, dtype) -> "Tensor":
+        from paddle_tpu.ops.registry import STATIC_SEEN
+
+        STATIC_SEEN[0] = True
+        aval = jax.ShapeDtypeStruct(tuple(0 if s in (-1, None) else s
+                                          for s in shape),
+                                    dtype_mod.to_jax_dtype(dtype))
+        vid = self.new_value(aval)
+        self.feeds[name] = vid
+        t = Tensor.__new__(Tensor)
+        Tensor.__init__(t, None, stop_gradient=True, name=name)
+        t._value = _Symbolic(self, vid, aval)
+        return t
+
+    def clone(self, for_test=False):
+        """Independent copy (fresh node/constant containers; array values
+        shared). for_test=True rewrites training-mode attrs (dropout) to
+        inference behavior — the reference's clone(for_test=True) pruning."""
+        new = Program()
+        new.feeds = dict(self.feeds)
+        new.avals = dict(self.avals)
+        new.constants = dict(self.constants)
+        new.const_tensors = dict(self.const_tensors)
+        new.rng_slots = list(self.rng_slots)
+        new._next_id = self._next_id
+        new.grad_map = dict(self.grad_map)
+        for n in self.nodes:
+            kwargs_tpl = n.kwargs_tpl
+            if for_test and n.op_name == "dropout":
+                kwargs_tpl = tuple(
+                    (k, False if k == "training" else v)
+                    for k, v in kwargs_tpl)
+            new.nodes.append(Node(n.op_name, n.args_tpl, kwargs_tpl,
+                                  list(n.input_ids), list(n.out_ids)))
+        return new
+
+    def __repr__(self):
+        return (f"Program(nodes={len(self.nodes)}, feeds={list(self.feeds)})")
+
+    def current_constants(self) -> Dict[int, Any]:
+        """Constant values with live parameter tensors re-read (so optimizer
+        updates between runs take effect)."""
+        out = dict(self.constants)
+        for vid, t in self.const_tensors.items():
+            out[vid] = t._value
+        return out
+
+    # ---------------------------------------------------------------- replay
+
+    def replay(self, feed_values: Dict[str, Any], fetch_ids: Sequence[int],
+               constants: Optional[Dict[int, Any]] = None,
+               rng_keys: Optional[Sequence[Any]] = None):
+        """constants override lets the Executor pass parameter values as jit
+        INPUTS (not baked weights); rng_keys feed fresh randomness per run."""
+        from paddle_tpu.ops.registry import OPS, _fill
+
+        env: Dict[int, Any] = dict(self.constants)
+        if constants is not None:
+            env.update(constants)
+        if rng_keys is not None:
+            for vid, key in zip(self.rng_slots, rng_keys):
+                env[vid] = key
+        for name, vid in self.feeds.items():
+            env[vid] = feed_values[name]
+        for node in self.nodes:
+            tvals = [env[i] for i in node.input_ids]
+            kwargs = {k: _fill(v, tvals) for k, v in node.kwargs_tpl}
+            out = OPS[node.op_name].impl(*_fill(node.args_tpl, tvals), **kwargs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for vid, o in zip(node.out_ids, outs):
+                env[vid] = o
+        return tuple(env[i] for i in fetch_ids)
+
+
+class _Symbolic:
+    """Stand-in for a jax value inside a Program: shape/dtype only."""
+
+    __slots__ = ("program", "vid", "aval")
+
+    def __init__(self, program, vid, aval):
+        self.program = program
+        self.vid = vid
+        self.aval = aval
+
+    @property
+    def shape(self):
+        return self.aval.shape
+
+    @property
+    def dtype(self):
+        return self.aval.dtype
+
+    @property
+    def ndim(self):
+        return len(self.aval.shape)
+
+    def __repr__(self):
+        return f"<symbolic {self.aval.shape} {self.aval.dtype} v{self.vid}>"
+
+
+_default_main_program: Optional[Program] = None
+_default_startup_program: Program = Program()
+
+
+def default_main_program() -> Program:
+    global _default_main_program
+    if _default_main_program is None:
+        _default_main_program = Program()
+    return _default_main_program
+
+
+def default_startup_program() -> Program:
+    return _default_startup_program
+
+
+def in_static_build() -> bool:
+    return _default_main_program is not None and _building
+
+
+_building = False
+
+
+@contextmanager
+def program_guard(main_program: Program, startup_program: Program = None):
+    global _default_main_program, _building
+    prev, prev_b = _default_main_program, _building
+    _default_main_program = main_program
+    _building = True
+    try:
+        yield main_program
+    finally:
+        _default_main_program, _building = prev, prev_b
+
+
+def record_dispatch(name: str, args, kwargs) -> Any:
+    """Called by the eager dispatcher when an input is symbolic."""
+    from paddle_tpu.ops.registry import OPS, _fill, _template
+
+    # locate the program from any symbolic input
+    prog = None
+
+    def find(o):
+        nonlocal prog
+        if isinstance(o, Tensor) and isinstance(o._value, _Symbolic):
+            prog = o._value.program
+        elif isinstance(o, (list, tuple)):
+            for e in o:
+                find(e)
+
+    find(list(args))
+    find(list(kwargs.values()))
+    assert prog is not None
+
+    op = OPS[name]
+    rng_key_tensor = None
+    if op.rng:
+        from paddle_tpu.core.random import default_generator
+
+        # the key becomes an rng SLOT, fed fresh each Executor.run — never a
+        # baked constant (a frozen dropout mask would train every step with
+        # the same mask)
+        rng_key_tensor = Tensor._wrap(default_generator.next_key())
+        args = (args[0], rng_key_tensor) + tuple(args[1:])
+
+    tensors: List[Tensor] = []
+    args_tpl = _template(args, tensors)
+    kwargs_tpl = tuple((k, _template(v, tensors))
+                       for k, v in sorted(kwargs.items()))
+
+    input_ids = []
+    in_avals = []
+    for t in tensors:
+        if isinstance(t._value, _Symbolic):
+            input_ids.append(t._value.vid)
+            in_avals.append(t._value.aval)
+        else:
+            vid = prog.new_value(jax.ShapeDtypeStruct(t._value.shape,
+                                                      t._value.dtype))
+            if t is rng_key_tensor:
+                prog.rng_slots.append(vid)
+                prog.constants[vid] = t._value  # fallback if no keys fed
+            else:
+                prog.constants[vid] = t._value
+                prog.const_tensors[vid] = t  # live link: param updates flow
+            input_ids.append(vid)
+            in_avals.append(prog.avals[vid])
+
+    def f(*tvals):
+        return op.impl(*_fill(args_tpl, tvals),
+                       **{k: _fill(v, tvals) for k, v in kwargs_tpl})
+
+    out_aval = jax.eval_shape(f, *in_avals)  # the infermeta step
+    multi = isinstance(out_aval, (tuple, list))
+    out_avals = list(out_aval) if multi else [out_aval]
+    out_ids = [prog.new_value(a) for a in out_avals]
+    prog.nodes.append(Node(name, args_tpl, kwargs_tpl, input_ids, out_ids))
+
+    outs = []
+    for vid, aval in zip(out_ids, out_avals):
+        t = Tensor.__new__(Tensor)
+        Tensor.__init__(t, None, stop_gradient=True)
+        t._value = _Symbolic(prog, vid, aval)
+        outs.append(t)
+    return tuple(outs) if multi else outs[0]
+
+
+def is_symbolic(t) -> bool:
+    return isinstance(t, Tensor) and isinstance(t._value, _Symbolic)

@@ -1,0 +1,178 @@
+"""Vision datasets.
+
+Reference: python/paddle/vision/datasets/ (MNIST, CIFAR, ImageFolder...).
+This environment has zero egress, so the download path is stubbed: datasets
+load from a local `data_file` when given, else generate a deterministic
+synthetic sample set with the real shapes/classes (enough for pipeline and
+convergence tests; swap in real files in production).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Callable, Optional
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+
+class _SyntheticImageDataset(Dataset):
+    """Deterministic class-conditional gaussian images."""
+
+    def __init__(self, num_samples, image_shape, num_classes, transform=None,
+                 seed=0):
+        self.num_samples = num_samples
+        self.image_shape = image_shape
+        self.num_classes = num_classes
+        self.transform = transform
+        rng = np.random.default_rng(seed)
+        self._centers = rng.normal(128, 40, (num_classes,) + image_shape)
+        self._labels = rng.integers(0, num_classes, num_samples)
+        self._seed = seed
+
+    def __getitem__(self, idx):
+        label = int(self._labels[idx])
+        rng = np.random.default_rng(self._seed + idx)
+        img = np.clip(self._centers[label]
+                      + rng.normal(0, 25, self.image_shape), 0, 255)
+        img = img.astype(np.uint8)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+    def __len__(self):
+        return self.num_samples
+
+
+class MNIST(_SyntheticImageDataset):
+    """Reference: vision/datasets/mnist.py. Loads idx files from
+    image_path/label_path when provided; synthetic otherwise."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        if image_path and os.path.exists(image_path):
+            import gzip
+            import struct
+
+            with gzip.open(image_path, "rb") as f:
+                _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                self._images = np.frombuffer(f.read(), np.uint8).reshape(
+                    n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                f.read(8)
+                self._labels_real = np.frombuffer(f.read(), np.uint8)
+            self.transform = transform
+            self._real = True
+            return
+        self._real = False
+        n = 6000 if mode == "train" else 1000
+        super().__init__(n, (28, 28), 10, transform, seed=0 if mode == "train" else 1)
+
+    def __getitem__(self, idx):
+        if getattr(self, "_real", False):
+            img = self._images[idx]
+            if self.transform is not None:
+                img = self.transform(img)
+            return img, np.int64(self._labels_real[idx])
+        return super().__getitem__(idx)
+
+    def __len__(self):
+        if getattr(self, "_real", False):
+            return len(self._images)
+        return super().__len__()
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(_SyntheticImageDataset):
+    """Reference: vision/datasets/cifar.py. Loads the pickle batches from
+    data_file when given; synthetic otherwise."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if data_file and os.path.exists(data_file):
+            import tarfile
+
+            imgs, labels = [], []
+            with tarfile.open(data_file) as tf:
+                names = [n for n in tf.getnames()
+                         if ("data_batch" in n if mode == "train" else
+                             "test_batch" in n)]
+                for name in sorted(names):
+                    d = pickle.load(tf.extractfile(name), encoding="bytes")
+                    imgs.append(d[b"data"].reshape(-1, 3, 32, 32)
+                                .transpose(0, 2, 3, 1))
+                    labels.extend(d[b"labels"])
+            self._images = np.concatenate(imgs)
+            self._labels_real = np.asarray(labels, np.int64)
+            self.transform = transform
+            self._real = True
+            return
+        self._real = False
+        n = 5000 if mode == "train" else 1000
+        super().__init__(n, (32, 32, 3), 10, transform,
+                         seed=0 if mode == "train" else 1)
+
+    def __getitem__(self, idx):
+        if getattr(self, "_real", False):
+            img = self._images[idx]
+            if self.transform is not None:
+                img = self.transform(img)
+            return img, self._labels_real[idx]
+        return super().__getitem__(idx)
+
+    def __len__(self):
+        if getattr(self, "_real", False):
+            return len(self._images)
+        return super().__len__()
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if data_file and os.path.exists(data_file):
+            super().__init__(data_file, mode, transform, download, backend)
+            return
+        self._real = False
+        n = 5000 if mode == "train" else 1000
+        _SyntheticImageDataset.__init__(self, n, (32, 32, 3), 100, transform,
+                                        seed=0 if mode == "train" else 1)
+
+
+class ImageFolder(Dataset):
+    """Reference: vision/datasets/folder.py — directory-per-class layout."""
+
+    def __init__(self, root, transform=None, loader=None):
+        self.root = root
+        self.transform = transform
+        self.samples = []
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                self.samples.append((os.path.join(cdir, fname),
+                                     self.class_to_idx[c]))
+        self.loader = loader or self._default_loader
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        raise RuntimeError(
+            f"no image decoder for {path}; pass loader= (PIL not bundled)")
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+    def __len__(self):
+        return len(self.samples)

@@ -1,0 +1,93 @@
+"""Static-graph universe tests (reference: test/legacy_test static tests +
+OpTest's _calc_pir_output path)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import static
+
+rng = np.random.default_rng(7)
+
+
+def test_program_build_and_run():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        y = paddle.tanh(x) * 2.0
+    exe = static.Executor()
+    xs = rng.standard_normal((5, 4)).astype(np.float32)
+    out, = exe.run(main, feed={"x": xs}, fetch_list=[y])
+    np.testing.assert_allclose(out, np.tanh(xs) * 2, rtol=1e-6)
+
+
+def test_static_layer_parity_with_eager():
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 3))
+    net.eval()
+    xs = rng.standard_normal((4, 6)).astype(np.float32)
+    eager = net(paddle.to_tensor(xs)).numpy()
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 6], "float32")
+        y = net(x)
+    out, = static.Executor().run(main, feed={"x": xs}, fetch_list=[y])
+    np.testing.assert_allclose(out, eager, rtol=1e-5, atol=1e-6)
+
+
+def test_append_backward():
+    paddle.seed(1)
+    net = nn.Linear(4, 1)
+    xs = rng.standard_normal((8, 4)).astype(np.float32)
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [8, 4], "float32")
+        loss = paddle.mean(net(x) ** 2)
+    static.append_backward(loss, parameter_list=net.parameters())
+    outs = static.Executor().run(main, feed={"x": xs}, fetch_list=[loss])
+    loss_v, gw, gb = outs
+
+    # compare against eager grads
+    xt = paddle.to_tensor(xs)
+    l = paddle.mean(net(xt) ** 2)
+    l.backward()
+    np.testing.assert_allclose(loss_v, float(l), rtol=1e-5)
+    np.testing.assert_allclose(gw, net.weight.grad.numpy(), rtol=1e-4,
+                               atol=1e-6)
+    np.testing.assert_allclose(gb, net.bias.grad.numpy(), rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_save_load_inference_model(tmp_path):
+    paddle.seed(5)
+    net = nn.Linear(3, 2)
+    net.eval()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3], "float32")
+        y = paddle.nn.functional.softmax(net(x))
+    exe = static.Executor()
+    prefix = str(tmp_path / "model")
+    static.save_inference_model(prefix, [x], [y], exe, program=main)
+
+    prog2, feed_names, fetches = static.load_inference_model(prefix, exe)
+    xs = rng.standard_normal((2, 3)).astype(np.float32)
+    a, = exe.run(main, feed={"x": xs}, fetch_list=[y])
+    b, = exe.run(prog2, feed={"x": xs}, fetch_list=fetches)
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_executor_cache_reuse():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 2], "float32")
+        y = x * 3.0
+    exe = static.Executor()
+    xs = np.ones((2, 2), np.float32)
+    exe.run(main, feed={"x": xs}, fetch_list=[y])
+    exe.run(main, feed={"x": xs}, fetch_list=[y])
+    assert len(exe._cache) == 1
+    exe.run(main, feed={"x": np.ones((5, 2), np.float32)}, fetch_list=[y])
+    assert len(exe._cache) == 2

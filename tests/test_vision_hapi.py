@@ -1,0 +1,119 @@
+"""Vision models/datasets/transforms + hapi Model tests
+(reference: test/legacy_test/test_vision_models.py, hapi tests)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi import EarlyStopping, Model
+from paddle_tpu.io import DataLoader
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision import LeNet, datasets, resnet18, transforms
+
+
+def test_transforms_pipeline():
+    t = transforms.Compose([
+        transforms.Resize(16),
+        transforms.CenterCrop(12),
+        transforms.RandomHorizontalFlip(0.0),
+        transforms.ToTensor(),
+        transforms.Normalize([0.5], [0.5]),
+    ])
+    img = np.random.randint(0, 255, (28, 28), np.uint8)
+    out = t(img)
+    assert out.shape == (1, 12, 12)
+    assert out.dtype == np.float32
+    assert out.min() >= -1.01 and out.max() <= 1.01
+
+
+def test_mnist_synthetic():
+    ds = datasets.MNIST(mode="train", transform=transforms.ToTensor())
+    img, label = ds[0]
+    assert img.shape == (1, 28, 28)
+    assert 0 <= int(label) < 10
+    assert len(ds) == 6000
+    # deterministic
+    img2, label2 = ds[0]
+    np.testing.assert_allclose(img, img2)
+
+
+def test_cifar_synthetic():
+    ds = datasets.Cifar10(mode="test")
+    img, label = ds[0]
+    assert img.shape == (32, 32, 3)
+    assert len(ds) == 1000
+
+
+def test_resnet18_forward():
+    paddle.seed(0)
+    net = resnet18(num_classes=10)
+    net.eval()
+    x = paddle.randn([2, 3, 32, 32])
+    out = net(x)
+    assert out.shape == [2, 10]
+    n_params = sum(p.size for p in net.parameters())
+    assert 11_000_000 < n_params < 12_000_000  # ~11.2M like torchvision
+
+
+def test_lenet_train_quick():
+    paddle.seed(0)
+    net = LeNet()
+    x = paddle.randn([4, 1, 28, 28])
+    out = net(x)
+    assert out.shape == [4, 10]
+
+
+def test_hapi_model_fit_evaluate_predict(tmp_path):
+    paddle.seed(0)
+    ds = datasets.MNIST(mode="train", transform=transforms.Compose(
+        [transforms.ToTensor()]))
+    small = [ds[i] for i in range(64)]
+
+    class ListDataset(paddle.io.Dataset):
+        def __getitem__(self, i):
+            return small[i]
+
+        def __len__(self):
+            return len(small)
+
+    net = nn.Sequential(nn.Flatten(), nn.Linear(784, 32), nn.ReLU(),
+                        nn.Linear(32, 10))
+    model = Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(parameters=net.parameters(),
+                                        learning_rate=1e-3),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy())
+    model.fit(ListDataset(), batch_size=16, epochs=2, verbose=0)
+    logs = model.evaluate(ListDataset(), batch_size=16, verbose=0)
+    assert "loss" in logs and "accuracy" in logs
+    preds = model.predict(ListDataset(), batch_size=16, stack_outputs=True)
+    assert preds[0].shape[0] == 64
+
+    model.save(str(tmp_path / "ckpt"))
+    model2 = Model(nn.Sequential(nn.Flatten(), nn.Linear(784, 32), nn.ReLU(),
+                                 nn.Linear(32, 10)))
+    model2.prepare(optimizer=paddle.optimizer.Adam(
+        parameters=model2.network.parameters()), loss=nn.CrossEntropyLoss())
+    model2.load(str(tmp_path / "ckpt"))
+    np.testing.assert_allclose(
+        model2.network.state_dict()["1.weight"].numpy(),
+        model.network.state_dict()["1.weight"].numpy())
+
+
+def test_early_stopping():
+    net = nn.Linear(4, 2)
+    model = Model(net)
+    model.prepare(optimizer=paddle.optimizer.SGD(
+        parameters=net.parameters()), loss=nn.MSELoss())
+    es = EarlyStopping(monitor="loss", patience=0, mode="min")
+    es.set_model(model)
+    es.on_epoch_end(0, {"loss": 1.0})
+    es.on_epoch_end(1, {"loss": 2.0})  # worse -> stop
+    assert model.stop_training
+
+
+def test_summary():
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    info = paddle.summary(net, (1, 8))
+    assert info["total_params"] == 8 * 16 + 16 + 16 * 2 + 2
