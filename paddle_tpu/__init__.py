@@ -286,8 +286,11 @@ from paddle_tpu import jit  # noqa: E402,F401
 from paddle_tpu import nn  # noqa: E402,F401
 from paddle_tpu import optimizer  # noqa: E402,F401
 from paddle_tpu import parallel  # noqa: E402,F401
+from paddle_tpu import distribution  # noqa: E402,F401
 from paddle_tpu import metric  # noqa: E402,F401
 from paddle_tpu import profiler  # noqa: E402,F401
+from paddle_tpu import signal  # noqa: E402,F401
+from paddle_tpu.tensor import fft, linalg  # noqa: E402,F401
 from paddle_tpu import static  # noqa: E402,F401
 from paddle_tpu import vision  # noqa: E402,F401
 from paddle_tpu import hapi  # noqa: E402,F401

@@ -1,0 +1,295 @@
+// TCPStore: native rendezvous/bootstrap key-value store.
+//
+// Reference: paddle/phi/core/distributed/store/tcp_store.h:121 (+ tcp_utils) —
+// the master-socket KV server used by init_parallel_env for NCCL unique-id
+// exchange, with blocking wait/get and atomic add.
+//
+// TPU-native role: the same bootstrap problem exists for multi-host JAX
+// (exchanging coordinator addresses, barriers before jax.distributed
+// initialize, checkpoint coordination). This is a from-scratch
+// implementation: one server thread + epoll-free blocking accept loop with a
+// worker thread per client (host counts are small), length-prefixed binary
+// protocol, condition-variable wait for blocking GET/WAIT.
+//
+// Protocol (all little-endian):
+//   request : u8 op | u32 klen | key bytes | u32 vlen | value bytes
+//   ops     : 1=SET 2=GET(blocking) 3=ADD(i64 delta in value) 4=WAIT
+//             5=CHECK 6=DELETE
+//   response: u32 vlen | value bytes   (ADD returns i64; CHECK returns u8)
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t {
+  kSet = 1,
+  kGet = 2,
+  kAdd = 3,
+  kWait = 4,
+  kCheck = 5,
+  kDelete = 6,
+};
+
+struct Store {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> data;
+};
+
+bool read_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_value(int fd, const std::string& v) {
+  uint32_t len = static_cast<uint32_t>(v.size());
+  if (!write_all(fd, &len, 4)) return false;
+  return v.empty() || write_all(fd, v.data(), v.size());
+}
+
+struct Server {
+  int listen_fd = -1;
+  Store store;
+  std::vector<std::thread> workers;
+  std::thread accept_thread;
+  bool stopping = false;
+
+  void handle_client(int fd) {
+    for (;;) {
+      uint8_t op;
+      uint32_t klen;
+      if (!read_all(fd, &op, 1) || !read_all(fd, &klen, 4)) break;
+      std::string key(klen, '\0');
+      if (klen && !read_all(fd, &key[0], klen)) break;
+      uint32_t vlen;
+      if (!read_all(fd, &vlen, 4)) break;
+      std::string val(vlen, '\0');
+      if (vlen && !read_all(fd, &val[0], vlen)) break;
+
+      switch (op) {
+        case kSet: {
+          {
+            std::lock_guard<std::mutex> lk(store.mu);
+            store.data[key] = val;
+          }
+          store.cv.notify_all();
+          if (!send_value(fd, "")) return;
+          break;
+        }
+        case kGet: {
+          std::unique_lock<std::mutex> lk(store.mu);
+          store.cv.wait(lk, [&] { return stopping || store.data.count(key); });
+          if (stopping) return;
+          std::string out = store.data[key];
+          lk.unlock();
+          if (!send_value(fd, out)) return;
+          break;
+        }
+        case kAdd: {
+          int64_t delta = 0;
+          if (val.size() == 8) std::memcpy(&delta, val.data(), 8);
+          int64_t cur = 0;
+          {
+            std::lock_guard<std::mutex> lk(store.mu);
+            auto it = store.data.find(key);
+            if (it != store.data.end() && it->second.size() == 8)
+              std::memcpy(&cur, it->second.data(), 8);
+            cur += delta;
+            std::string v(8, '\0');
+            std::memcpy(&v[0], &cur, 8);
+            store.data[key] = v;
+          }
+          store.cv.notify_all();
+          std::string out(8, '\0');
+          std::memcpy(&out[0], &cur, 8);
+          if (!send_value(fd, out)) return;
+          break;
+        }
+        case kWait: {
+          std::unique_lock<std::mutex> lk(store.mu);
+          store.cv.wait(lk, [&] { return stopping || store.data.count(key); });
+          if (stopping) return;
+          lk.unlock();
+          if (!send_value(fd, "")) return;
+          break;
+        }
+        case kCheck: {
+          std::string out(1, '\0');
+          {
+            std::lock_guard<std::mutex> lk(store.mu);
+            out[0] = store.data.count(key) ? 1 : 0;
+          }
+          if (!send_value(fd, out)) return;
+          break;
+        }
+        case kDelete: {
+          {
+            std::lock_guard<std::mutex> lk(store.mu);
+            store.data.erase(key);
+          }
+          if (!send_value(fd, "")) return;
+          break;
+        }
+        default:
+          return;
+      }
+    }
+    ::close(fd);
+  }
+
+  void accept_loop() {
+    for (;;) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) break;  // listen_fd closed -> shutdown
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      workers.emplace_back([this, fd] { handle_client(fd); });
+    }
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;  // one request in flight at a time
+  std::string last;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ts_server_start(int port) {
+  auto* s = new Server();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+          0 ||
+      ::listen(s->listen_fd, 128) < 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  s->accept_thread = std::thread([s] { s->accept_loop(); });
+  return s;
+}
+
+int ts_server_port(void* handle) {
+  auto* s = static_cast<Server*>(handle);
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  if (::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len) <
+      0)
+    return -1;
+  return ntohs(addr.sin_port);
+}
+
+void ts_server_stop(void* handle) {
+  auto* s = static_cast<Server*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(s->store.mu);
+    s->stopping = true;
+  }
+  s->store.cv.notify_all();
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  for (auto& t : s->workers)
+    if (t.joinable()) t.detach();  // blocked clients: sockets closed below
+  delete s;
+}
+
+void* ts_client_connect(const char* host, int port, int timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  ::inet_pton(AF_INET, host, &addr.sin_addr);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms > 0 ? timeout_ms : 1);
+  for (;;) {
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0)
+      break;
+    if (std::chrono::steady_clock::now() > deadline) {
+      ::close(fd);
+      return nullptr;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* c = new Client();
+  c->fd = fd;
+  return c;
+}
+
+void ts_client_close(void* handle) {
+  auto* c = static_cast<Client*>(handle);
+  ::close(c->fd);
+  delete c;
+}
+
+// returns response length, or -1 on error. Response retrieved by ts_copy.
+long ts_request(void* handle, int op, const char* key, int klen,
+                const char* val, int vlen) {
+  auto* c = static_cast<Client*>(handle);
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint8_t op8 = static_cast<uint8_t>(op);
+  uint32_t kl = static_cast<uint32_t>(klen), vl = static_cast<uint32_t>(vlen);
+  if (!write_all(c->fd, &op8, 1) || !write_all(c->fd, &kl, 4) ||
+      (klen && !write_all(c->fd, key, klen)) || !write_all(c->fd, &vl, 4) ||
+      (vlen && !write_all(c->fd, val, vlen)))
+    return -1;
+  uint32_t rlen;
+  if (!read_all(c->fd, &rlen, 4)) return -1;
+  c->last.resize(rlen);
+  if (rlen && !read_all(c->fd, &c->last[0], rlen)) return -1;
+  return static_cast<long>(rlen);
+}
+
+void ts_copy(void* handle, char* out, long n) {
+  auto* c = static_cast<Client*>(handle);
+  std::memcpy(out, c->last.data(), static_cast<size_t>(n));
+}
+
+}  // extern "C"

@@ -1,0 +1,364 @@
+"""paddle.distribution — reference: python/paddle/distribution/ (20+
+distributions with sample/log_prob/entropy/kl_divergence).
+
+All math goes through the dispatched Tensor ops, so log_prob/rsample are
+differentiable w.r.t. distribution parameters on the eager tape (score
+function / reparameterization gradients), exactly like the reference's
+dygraph distributions.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.random import default_generator
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.registry import C_OPS as _C
+
+
+def _t(x) -> Tensor:
+    if isinstance(x, Tensor):
+        return x
+    return Tensor._wrap(jnp.asarray(x, jnp.float32))
+
+
+def _key():
+    return default_generator.next_key()
+
+
+def _bshape(*tensors):
+    return tuple(np.broadcast_shapes(*(tuple(t.shape) for t in tensors)))
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    def sample(self, shape=()):
+        with paddle.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _C.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return _C.broadcast_to(self.loc, self._batch_shape or (1,))
+
+    @property
+    def variance(self):
+        return _C.broadcast_to(_C.square(self.scale), self._batch_shape or (1,))
+
+    def rsample(self, shape=()):
+        full = tuple(shape) + self._batch_shape
+        eps = Tensor._wrap(jax.random.normal(_key(), full))
+        return self.loc + self.scale * eps
+
+    def log_prob(self, value):
+        v = _t(value)
+        var = _C.square(self.scale)
+        return (-_C.square(v - self.loc) / (var * 2.0)
+                - _C.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        out = _C.log(self.scale) + (0.5 + 0.5 * math.log(2 * math.pi))
+        return _C.broadcast_to(out, self._batch_shape or (1,))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(_bshape(self.low, self.high))
+
+    def rsample(self, shape=()):
+        full = tuple(shape) + self._batch_shape
+        u = Tensor._wrap(jax.random.uniform(_key(), full))
+        return self.low + (self.high - self.low) * u
+
+    def log_prob(self, value):
+        v = _t(value)
+        inside = _C.logical_and(v >= self.low, v < self.high)
+        lp = -_C.log(self.high - self.low)
+        neg_inf = Tensor._wrap(jnp.asarray(-jnp.inf))
+        return _C.where(inside, lp + v * 0.0, neg_inf + v * 0.0)
+
+    def entropy(self):
+        return _C.broadcast_to(_C.log(self.high - self.low),
+                               self._batch_shape or (1,))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None:
+            lg = _t(logits)
+            self.logits = _C.log_softmax(lg, axis=-1)
+        else:
+            p = _t(probs)
+            lg = _C.log(_C.clip(p, min=1e-30))
+            self.logits = lg - _C.logsumexp(lg, axis=-1, keepdim=True)
+        super().__init__(tuple(self.logits.shape[:-1]))
+
+    @property
+    def probs(self):
+        return _C.exp(self.logits)
+
+    def sample(self, shape=()):
+        out = jax.random.categorical(
+            _key(), self.logits._value,
+            shape=tuple(shape) + self._batch_shape)
+        return Tensor._wrap(out.astype(jnp.int32))
+
+    def log_prob(self, value):
+        idx = _t(value).astype("int32")
+        picked = _C.take_along_axis(self.logits, _C.unsqueeze(idx, -1),
+                                    axis=-1)
+        return _C.squeeze(picked, axis=-1)
+
+    def entropy(self):
+        p = _C.exp(self.logits)
+        return -_C.sum(p * self.logits, axis=-1)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self.probs_ = _C.clip(_t(probs), min=1e-7, max=1 - 1e-7)
+        else:
+            self.probs_ = _C.sigmoid(_t(logits))
+        super().__init__(tuple(self.probs_.shape))
+
+    def sample(self, shape=()):
+        out = jax.random.bernoulli(_key(), self.probs_._value,
+                                   tuple(shape) + self._batch_shape)
+        return Tensor._wrap(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _t(value)
+        return v * _C.log(self.probs_) + (1.0 - v) * _C.log1p(-self.probs_)
+
+    def entropy(self):
+        p = self.probs_
+        return -(p * _C.log(p) + (1.0 - p) * _C.log1p(-p))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    def rsample(self, shape=()):
+        u = Tensor._wrap(jax.random.exponential(
+            _key(), tuple(shape) + self._batch_shape))
+        return u / self.rate
+
+    def log_prob(self, value):
+        return _C.log(self.rate) - self.rate * _t(value)
+
+    def entropy(self):
+        return 1.0 - _C.log(self.rate)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        super().__init__(_bshape(self.concentration, self.rate))
+
+    def sample(self, shape=()):
+        g = jax.random.gamma(_key(), self.concentration._value,
+                             tuple(shape) + self._batch_shape)
+        return Tensor._wrap(g) / self.rate.detach()
+
+    def log_prob(self, value):
+        v = _t(value)
+        a, b = self.concentration, self.rate
+        return (a * _C.log(b) + (a - 1.0) * _C.log(v) - b * v - _C.lgamma(a))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(_bshape(self.alpha, self.beta))
+
+    def sample(self, shape=()):
+        out = jax.random.beta(_key(), self.alpha._value, self.beta._value,
+                              tuple(shape) + self._batch_shape)
+        return Tensor._wrap(out)
+
+    def log_prob(self, value):
+        v = _t(value)
+        a, b = self.alpha, self.beta
+        lbeta = _C.lgamma(a) + _C.lgamma(b) - _C.lgamma(a + b)
+        return (a - 1.0) * _C.log(v) + (b - 1.0) * _C.log1p(-v) - lbeta
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        super().__init__(tuple(self.concentration.shape[:-1]),
+                         tuple(self.concentration.shape[-1:]))
+
+    def sample(self, shape=()):
+        out = jax.random.dirichlet(_key(), self.concentration._value,
+                                   tuple(shape) + self._batch_shape)
+        return Tensor._wrap(out)
+
+    def log_prob(self, value):
+        v = _t(value)
+        a = self.concentration
+        lnorm = _C.sum(_C.lgamma(a), axis=-1) - _C.lgamma(_C.sum(a, axis=-1))
+        return _C.sum((a - 1.0) * _C.log(v), axis=-1) - lnorm
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = total_count
+        self.probs_ = _t(probs)
+        super().__init__(tuple(self.probs_.shape[:-1]),
+                         tuple(self.probs_.shape[-1:]))
+
+    def sample(self, shape=()):
+        logits = jnp.log(jnp.clip(self.probs_._value, 1e-30, None))
+        draws = jax.random.categorical(
+            _key(), logits,
+            shape=(self.total_count,) + tuple(shape) + self._batch_shape)
+        k = self.probs_.shape[-1]
+        onehot = jax.nn.one_hot(draws, k)
+        return Tensor._wrap(jnp.sum(onehot, axis=0))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(_bshape(self.loc, self.scale))
+
+    def rsample(self, shape=()):
+        eps = Tensor._wrap(jax.random.laplace(
+            _key(), tuple(shape) + self._batch_shape))
+        return self.loc + self.scale * eps
+
+    def log_prob(self, value):
+        v = _t(value)
+        return -_C.abs(v - self.loc) / self.scale - _C.log(self.scale * 2.0)
+
+    def entropy(self):
+        return 1.0 + _C.log(self.scale * 2.0)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(_bshape(self.loc, self.scale))
+
+    def rsample(self, shape=()):
+        eps = Tensor._wrap(jax.random.gumbel(
+            _key(), tuple(shape) + self._batch_shape))
+        return self.loc + self.scale * eps
+
+    def log_prob(self, value):
+        z = (_t(value) - self.loc) / self.scale
+        return -(z + _C.exp(-z)) - _C.log(self.scale)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.base = Normal(loc, scale)
+        super().__init__(self.base._batch_shape)
+
+    def rsample(self, shape=()):
+        return _C.exp(self.base.rsample(shape))
+
+    def log_prob(self, value):
+        v = _t(value)
+        return self.base.log_prob(_C.log(v)) - _C.log(v)
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    def sample(self, shape=()):
+        out = jax.random.poisson(_key(), self.rate._value,
+                                 tuple(shape) + self._batch_shape)
+        return Tensor._wrap(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _t(value)
+        return v * _C.log(self.rate) - self.rate - _C.lgamma(v + 1.0)
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = _t(probs)
+        super().__init__(tuple(self.probs_.shape))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), tuple(shape) + self._batch_shape)
+        out = jnp.floor(jnp.log1p(-u)
+                        / jnp.log1p(-jnp.asarray(self.probs_._value)))
+        return Tensor._wrap(out)
+
+    def log_prob(self, value):
+        v = _t(value)
+        return v * _C.log1p(-self.probs_) + _C.log(self.probs_)
+
+
+# --------------------------------------------------------------------- KL
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_p = _C.square(p.scale)
+        var_q = _C.square(q.scale)
+        return (_C.log(q.scale / p.scale)
+                + (var_p + _C.square(p.loc - q.loc)) / (var_q * 2.0) - 0.5)
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        pp = _C.exp(p.logits)
+        return _C.sum(pp * (p.logits - q.logits), axis=-1)
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        a, b = p.probs_, q.probs_
+        return (a * _C.log(a / b)
+                + (1.0 - a) * _C.log((1.0 - a) / (1.0 - b)))
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        return _C.log((q.high - q.low) / (p.high - p.low))
+    # generic fallback: monte-carlo estimate
+    s = p.sample((256,))
+    return _C.mean(p.log_prob(s) - q.log_prob(s), axis=0)

@@ -101,18 +101,20 @@ class EarlyStopping(Callback):
     """Reference: hapi/callbacks.py EarlyStopping."""
 
     def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
-                 min_delta=0, baseline=None, save_best_model=True):
+                 min_delta=0, baseline=None, save_best_model=True,
+                 save_dir=None):
         self.monitor = monitor
         self.patience = patience
         self.min_delta = abs(min_delta)
-        self.baseline = baseline
+        self.save_best_model = save_best_model
+        self.save_dir = save_dir
         if mode == "max" or (mode == "auto" and ("acc" in monitor or
                                                  "auc" in monitor)):
             self.better = lambda cur, best: cur > best + self.min_delta
-            self.best = -float("inf")
+            self.best = -float("inf") if baseline is None else baseline
         else:
             self.better = lambda cur, best: cur < best - self.min_delta
-            self.best = float("inf")
+            self.best = float("inf") if baseline is None else baseline
         self.wait = 0
 
     def on_epoch_end(self, epoch, logs=None):
@@ -122,6 +124,8 @@ class EarlyStopping(Callback):
         if self.better(cur, self.best):
             self.best = cur
             self.wait = 0
+            if self.save_best_model and self.save_dir:
+                self.model.save(f"{self.save_dir}/best_model")
         else:
             self.wait += 1
             if self.wait > self.patience:

@@ -15,7 +15,7 @@ from paddle_tpu.nn.layer import Layer
 
 def summary(net: Layer, input_size, dtypes=None):
     """Prints a per-layer table; returns {'total_params', 'trainable_params'}."""
-    if isinstance(input_size, tuple) and input_size and isinstance(
+    if isinstance(input_size, (tuple, list)) and input_size and isinstance(
             input_size[0], (list, tuple)):
         sizes = [tuple(s) for s in input_size]
     else:

@@ -160,6 +160,7 @@ class TrainStep:
         self._compiled = None
         self._mesh = mesh
         self._in_shardings = in_shardings
+        self._restore_opt_state()
         self._maybe_shard_state()
 
     # ---------------------------------------------------------------- sharding
@@ -268,9 +269,34 @@ class TrainStep:
         return Tensor._wrap(loss)
 
     def sync(self):
-        """Write compiled-side params/buffers back into the model Tensors."""
+        """Write compiled-side params/buffers back into the model Tensors and
+        the optimizer state back into its accumulators (so
+        optimizer.state_dict()/save-resume see trained moments, not the
+        init-time zeros)."""
         self.func.write_back(self.params, self.buffers)
+        name_to_tensor = dict(self.func._param_items)
+        for name, st in self.opt_state.items():
+            t = name_to_tensor.get(name)
+            if t is not None and isinstance(st, dict):
+                self.optimizer._accumulators[id(t)] = dict(st)
+        self.optimizer._step_count = self._step_i
         return self.model
+
+    def _restore_opt_state(self):
+        """Adopt pre-existing optimizer accumulators (e.g. loaded from a
+        checkpoint) instead of fresh zeros."""
+        name_to_tensor = dict(self.func._param_items)
+        restored = False
+        for name, t in name_to_tensor.items():
+            acc = self.optimizer._accumulators.get(id(t))
+            if acc:
+                cur = self.opt_state.get(name, {})
+                if set(acc) >= set(cur):
+                    self.opt_state[name] = {k: jnp.asarray(acc[k])
+                                            for k in cur}
+                    restored = True
+        if restored or self.optimizer._step_count:
+            self._step_i = self.optimizer._step_count
 
 
 class _nullcontext:

@@ -31,3 +31,10 @@ from paddle_tpu.parallel.mp_layers import (  # noqa: F401
     ScatterOp, VocabParallelEmbedding,
 )
 from paddle_tpu.parallel.pipeline import pipeline_apply, stack_stage_params  # noqa: F401
+from paddle_tpu.parallel.recompute import (  # noqa: F401,E402
+    GradientMerge, RecomputeLayer, recompute, recompute_sequential,
+)
+from paddle_tpu.parallel.ring_attention import RingAttention, ring_attention  # noqa: F401,E402
+from paddle_tpu.parallel.store import TCPStore, create_or_get_global_tcp_store  # noqa: F401,E402
+from paddle_tpu.parallel import checkpoint  # noqa: F401,E402
+from paddle_tpu.parallel.checkpoint import load_state_dict, save_state_dict  # noqa: F401,E402

@@ -1,0 +1,134 @@
+"""Recompute (activation checkpointing) + gradient accumulation.
+
+Reference: python/paddle/distributed/fleet/recompute/recompute.py:463
+(PyLayer that reruns forward in backward with RNG-state preservation,
+recompute_sequential:630, hybrid recompute_hybrid.py).
+
+TPU-native: jax.checkpoint (remat) IS recompute — XLA rematerializes the
+segment in the backward pass, trading FLOPs for HBM (the knob the reference
+implements by hand with a PyLayer + RNG tracker). Works in both universes:
+under jit.TrainStep it wraps the traced segment; in eager it wraps the op
+sequence recorded through the tape.
+"""
+
+from __future__ import annotations
+
+from functools import wraps
+from typing import Callable, Sequence
+
+import jax
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer import Layer
+
+
+def recompute(function: Callable, *args, use_reentrant=True, **kwargs):
+    """paddle.distributed.fleet.recompute / paddle.distributed.recompute.
+
+    Wraps `function(*args)` so its activations are rematerialized in
+    backward. When `function` is a Layer, its parameters become explicit
+    inputs of the checkpointed region so gradients flow to them (the
+    reference PyLayer saves them as ctx inputs, recompute.py:463)."""
+    from paddle_tpu.jit.functionalize import functionalize
+    from paddle_tpu.ops.registry import OPS, OpDef, dispatch
+
+    if isinstance(function, Layer):
+        func = functionalize(function)
+        pnames = [k for k, _ in func._param_items]
+        ptensors = [t for _, t in func._param_items]
+        n_p = len(pnames)
+
+        def raw(*tvals):
+            pvals = dict(zip(pnames, tvals[:n_p]))
+            bvals = func.buffer_values()
+            out, _ = func.apply(pvals, bvals, None, None,
+                                *tvals[n_p:], **kwargs)
+            return out
+
+        ckpt = jax.checkpoint(raw)
+        name = f"_recompute_layer_{id(function)}"
+        if name not in OPS:
+            OPS[name] = OpDef(name, ckpt, diff=True, dynamic=True,
+                              method=False)
+        return dispatch(name, tuple(ptensors) + tuple(args), {})
+
+    def pure(*vals):
+        from paddle_tpu.autograd.engine import no_grad
+
+        with no_grad():  # inner tape off; jax.vjp of ckpt differentiates
+            wrapped = [Tensor._wrap(v) for v in vals]
+            out = function(*wrapped, **kwargs)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    ckpt = jax.checkpoint(pure)
+    name = f"_recompute_{id(function)}"
+    if name not in OPS:
+        OPS[name] = OpDef(name, ckpt, diff=True, dynamic=True, method=False)
+    return dispatch(name, args, {})
+
+
+def recompute_sequential(ctx: dict, functions, *args):
+    """Reference: recompute_sequential:630 — checkpoint each segment of a
+    Sequential."""
+    segments = ctx.get("segments", 1) if ctx else 1
+    if isinstance(functions, Layer):
+        layers = list(functions)
+    else:
+        layers = list(functions)
+    n = len(layers)
+    seg_size = max(n // segments, 1)
+    out = args
+    for i in range(0, n, seg_size):
+        seg = layers[i:i + seg_size]
+
+        def seg_fn(*xs, _seg=seg):
+            y = xs[0] if len(xs) == 1 else xs
+            for l in _seg:
+                y = l(y)
+            return y
+
+        res = recompute(seg_fn, *(out if isinstance(out, tuple) else (out,)))
+        out = res
+    return out
+
+
+class RecomputeLayer(Layer):
+    """Wrap any sublayer so its forward is rematerialized in backward."""
+
+    def __init__(self, inner: Layer):
+        super().__init__()
+        self.inner = inner
+
+    def forward(self, *args):
+        return recompute(self.inner, *args)
+
+
+class GradientMerge:
+    """Gradient accumulation (reference: fleet gradient_merge pass /
+    DistributedStrategy gradient_merge). Accumulates k micro-batch grads
+    before each optimizer step."""
+
+    def __init__(self, optimizer, k_steps: int):
+        self.optimizer = optimizer
+        self.k_steps = k_steps
+        self._count = 0
+
+    def step(self):
+        self._count += 1
+        if self._count % self.k_steps == 0:
+            # average the accumulated grads
+            from paddle_tpu.autograd.engine import no_grad
+
+            with no_grad():
+                for p in self.optimizer._parameter_list or []:
+                    if p.grad is not None:
+                        p.grad = Tensor._wrap(p.grad._value / self.k_steps)
+            self.optimizer.step()
+            self.optimizer.clear_grad()
+            return True
+        return False  # grads keep accumulating in .grad
+
+    def clear_grad(self):
+        pass  # managed internally

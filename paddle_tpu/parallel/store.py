@@ -1,0 +1,208 @@
+"""TCPStore python API over the native C++ implementation.
+
+Reference: phi TCPStore (paddle/phi/core/distributed/store/tcp_store.h:121,
+Store base store/store.h:24) and its python exposure
+create_or_get_global_tcp_store (python/paddle/distributed/parallel.py:1134).
+
+The C++ core (paddle_tpu/csrc/tcp_store.cpp) is compiled on first use with
+g++ into paddle_tpu/lib/libtcpstore.so and bound via ctypes; a pure-python
+socket fallback keeps the API available if no toolchain is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+from typing import Optional
+
+_LIB = None
+_LIB_ERR = None
+
+_OP_SET, _OP_GET, _OP_ADD, _OP_WAIT, _OP_CHECK, _OP_DELETE = 1, 2, 3, 4, 5, 6
+
+
+def _load_lib():
+    global _LIB, _LIB_ERR
+    if _LIB is not None or _LIB_ERR is not None:
+        return _LIB
+    src = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                       "csrc", "tcp_store.cpp")
+    libdir = os.path.join(os.path.dirname(os.path.dirname(__file__)), "lib")
+    sopath = os.path.join(libdir, "libtcpstore.so")
+    try:
+        if not os.path.exists(sopath) or (
+                os.path.getmtime(sopath) < os.path.getmtime(src)):
+            os.makedirs(libdir, exist_ok=True)
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+                 src, "-o", sopath],
+                check=True, capture_output=True)
+        lib = ctypes.CDLL(sopath)
+        lib.ts_server_start.restype = ctypes.c_void_p
+        lib.ts_server_start.argtypes = [ctypes.c_int]
+        lib.ts_server_port.restype = ctypes.c_int
+        lib.ts_server_port.argtypes = [ctypes.c_void_p]
+        lib.ts_server_stop.argtypes = [ctypes.c_void_p]
+        lib.ts_client_connect.restype = ctypes.c_void_p
+        lib.ts_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                          ctypes.c_int]
+        lib.ts_client_close.argtypes = [ctypes.c_void_p]
+        lib.ts_request.restype = ctypes.c_long
+        lib.ts_request.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                   ctypes.c_char_p, ctypes.c_int,
+                                   ctypes.c_char_p, ctypes.c_int]
+        lib.ts_copy.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_long]
+        _LIB = lib
+    except Exception as e:  # no toolchain -> python fallback
+        _LIB_ERR = e
+    return _LIB
+
+
+class TCPStore:
+    """API-compatible with paddle.distributed's TCPStore: the master hosts
+    the KV server; every rank (master included) is a client."""
+
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 300.0):
+        self.host = host
+        self.is_master = is_master
+        self._server = None
+        self._py_impl = None
+        lib = _load_lib()
+        if lib is None:
+            self._py_impl = _PyStore(host, port, is_master, timeout)
+            self.port = self._py_impl.port
+            return
+        if is_master:
+            self._server = lib.ts_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            port = lib.ts_server_port(self._server)
+        self.port = port
+        self._client = lib.ts_client_connect(
+            host.encode(), port, int(timeout * 1000))
+        if not self._client:
+            if self._server:
+                lib.ts_server_stop(self._server)
+            raise TimeoutError(f"TCPStore: cannot reach {host}:{port}")
+
+    def _req(self, op: int, key: str, val: bytes = b"") -> bytes:
+        if self._py_impl is not None:
+            return self._py_impl.request(op, key, val)
+        lib = _LIB
+        k = key.encode()
+        n = lib.ts_request(self._client, op, k, len(k), val, len(val))
+        if n < 0:
+            raise RuntimeError("TCPStore request failed (server gone?)")
+        buf = ctypes.create_string_buffer(n)
+        lib.ts_copy(self._client, buf, n)
+        return buf.raw
+
+    # paddle Store interface (store.h:24)
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        self._req(_OP_SET, key, bytes(value))
+
+    def get(self, key: str) -> bytes:
+        return self._req(_OP_GET, key)
+
+    def add(self, key: str, amount: int) -> int:
+        out = self._req(_OP_ADD, key, struct.pack("<q", amount))
+        return struct.unpack("<q", out)[0]
+
+    def wait(self, keys) -> None:
+        if isinstance(keys, str):
+            keys = [keys]
+        for k in keys:
+            self._req(_OP_WAIT, k)
+
+    def check(self, key: str) -> bool:
+        return self._req(_OP_CHECK, key) == b"\x01"
+
+    def delete_key(self, key: str) -> None:
+        self._req(_OP_DELETE, key)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_py_impl", None) is not None:
+                self._py_impl.close()
+                return
+            if _LIB is not None:
+                if getattr(self, "_client", None):
+                    _LIB.ts_client_close(self._client)
+                    self._client = None
+                if getattr(self, "_server", None):
+                    _LIB.ts_server_stop(self._server)
+                    self._server = None
+        except Exception:
+            pass
+
+
+class _PyStore:
+    """Pure-python fallback (threading + dict); single-process only."""
+
+    _stores = {}
+    _lock = threading.Lock()
+
+    def __init__(self, host, port, is_master, timeout):
+        self.key = (host, port)
+        self.port = port
+        with _PyStore._lock:
+            if is_master:
+                _PyStore._stores[self.key] = {
+                    "data": {}, "cv": threading.Condition()}
+        self.timeout = timeout
+
+    @property
+    def _store(self):
+        return _PyStore._stores[self.key]
+
+    def request(self, op, key, val):
+        st = self._store
+        with st["cv"]:
+            if op == _OP_SET:
+                st["data"][key] = val
+                st["cv"].notify_all()
+                return b""
+            if op in (_OP_GET, _OP_WAIT):
+                ok = st["cv"].wait_for(lambda: key in st["data"],
+                                       timeout=self.timeout)
+                if not ok:
+                    raise TimeoutError(f"wait for {key!r} timed out")
+                return st["data"][key] if op == _OP_GET else b""
+            if op == _OP_ADD:
+                cur = struct.unpack("<q", st["data"].get(
+                    key, b"\x00" * 8))[0] + struct.unpack("<q", val)[0]
+                st["data"][key] = struct.pack("<q", cur)
+                st["cv"].notify_all()
+                return st["data"][key]
+            if op == _OP_CHECK:
+                return b"\x01" if key in st["data"] else b"\x00"
+            if op == _OP_DELETE:
+                st["data"].pop(key, None)
+                return b""
+        raise ValueError(op)
+
+    def close(self):
+        pass
+
+
+_global_store: Optional[TCPStore] = None
+
+
+def create_or_get_global_tcp_store() -> TCPStore:
+    """Reference: distributed/parallel.py:1134."""
+    global _global_store
+    if _global_store is None:
+        host = os.environ.get("MASTER_ADDR", "127.0.0.1")
+        port = int(os.environ.get("MASTER_PORT", "6170"))
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        _global_store = TCPStore(host, port, is_master=(rank == 0),
+                                 world_size=world)
+    return _global_store

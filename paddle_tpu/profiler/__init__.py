@@ -138,10 +138,14 @@ class Profiler:
 
     # -------------------------------------------------------------- control
 
+    @staticmethod
+    def _recording(state) -> bool:
+        return state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+
     def start(self):
-        _recorder.enabled = True
         _recorder.clear()
         self.state = self.scheduler(self.step_num)
+        _recorder.enabled = self._recording(self.state)
         self._maybe_device(self.state)
 
     def stop(self):
@@ -155,6 +159,9 @@ class Profiler:
         new_state = self.scheduler(self.step_num)
         if new_state != self.state:
             self._maybe_device(new_state)
+        # host recorder follows the same schedule as the device tracer, so
+        # CLOSED/READY/skip_first steps are excluded from the export
+        _recorder.enabled = self._recording(new_state)
         self.state = new_state
 
     def _maybe_device(self, state):

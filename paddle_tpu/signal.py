@@ -1,0 +1,81 @@
+"""paddle.signal — STFT/iSTFT. Reference: python/paddle/signal.py."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+
+def frame(x, frame_length, hop_length, axis=-1):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    n = v.shape[axis]
+    num = 1 + (n - frame_length) // hop_length
+    idx = (np.arange(frame_length)[None, :]
+           + hop_length * np.arange(num)[:, None])
+    out = jnp.take(v, jnp.asarray(idx), axis=axis)
+    return Tensor._wrap(out)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True):
+    """Reference: signal.py stft. x: [..., seq_len]."""
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones(win_length)
+    else:
+        win = window._value if isinstance(window, Tensor) else jnp.asarray(window)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (pad, n_fft - win_length - pad))
+    if center:
+        pad_width = [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        v = jnp.pad(v, pad_width, mode=pad_mode)
+    n = v.shape[-1]
+    num = 1 + (n - n_fft) // hop_length
+    idx = (np.arange(n_fft)[None, :] + hop_length * np.arange(num)[:, None])
+    frames = jnp.take(v, jnp.asarray(idx), axis=-1)  # [..., num, n_fft]
+    frames = frames * win
+    spec = jnp.fft.rfft(frames, n=n_fft) if onesided else jnp.fft.fft(frames, n=n_fft)
+    if normalized:
+        spec = spec / jnp.sqrt(n_fft)
+    # paddle layout: [..., n_fft//2+1, num_frames]
+    return Tensor._wrap(jnp.swapaxes(spec, -1, -2))
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones(win_length)
+    else:
+        win = window._value if isinstance(window, Tensor) else jnp.asarray(window)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (pad, n_fft - win_length - pad))
+    spec = jnp.swapaxes(v, -1, -2)  # [..., num, bins]
+    if normalized:
+        spec = spec * jnp.sqrt(n_fft)
+    frames = (jnp.fft.irfft(spec, n=n_fft) if onesided
+              else jnp.fft.ifft(spec, n=n_fft).real)
+    frames = frames * win
+    num = frames.shape[-2]
+    out_len = n_fft + hop_length * (num - 1)
+    out = jnp.zeros(frames.shape[:-2] + (out_len,))
+    norm = jnp.zeros(out_len)
+    for i in range(num):
+        s = i * hop_length
+        out = out.at[..., s:s + n_fft].add(frames[..., i, :])
+        norm = norm.at[s:s + n_fft].add(win * win)
+    out = out / jnp.maximum(norm, 1e-10)
+    if center:
+        out = out[..., n_fft // 2:out.shape[-1] - n_fft // 2]
+    if length is not None:
+        out = out[..., :length]
+    return Tensor._wrap(out)

@@ -41,30 +41,42 @@ def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
 
 def append_backward(loss: Tensor, parameter_list=None, no_grad_set=None):
     """Static autodiff over the recorded program (reference:
-    base/backward.py:1967). Returns [(param, grad_symbol)]. Whole-program
+    base/backward.py:1967). Returns [(param, grad_symbol)] where grad_symbol
+    is fetchable via Executor.run(fetch_list=[...]). Whole-program
     reverse-mode comes from jax.grad over the replay — one source of truth
     with the eager tape (both are jax.vjp underneath)."""
+    from paddle_tpu.core.tensor import Parameter
+
     prog = loss._value.program
-    params = parameter_list
-    if params is None:
-        params = [Tensor._wrap(v) for v in []]
-    result = []
-    # differentiate replay wrt the recorded constants that are parameters
+    if parameter_list is None:
+        # default: every recorded trainable parameter (reference semantics)
+        parameter_list = [t for t in prog.const_tensors.values()
+                          if isinstance(t, Parameter) and t.trainable]
+    no_grad_names = set(no_grad_set or ())
+
     param_vids = []
-    for p in parameter_list or []:
+    for p in parameter_list:
+        if p.name and p.name in no_grad_names:
+            continue
         for vid, t in prog.const_tensors.items():
             if t is p:
                 param_vids.append((p, vid))
                 break
 
     loss_vid = loss._value.vid
-
+    result = []
+    grad_vid_map = {}  # grad vid -> param vid
     for p, vid in param_vids:
         gvid = prog.new_value(prog.avals[vid])
         prog.grad_map[vid] = gvid
-        result.append((p, gvid))
+        grad_vid_map[gvid] = vid
+        g = Tensor.__new__(Tensor)
+        Tensor.__init__(g, None, stop_gradient=True)
+        g._value = _Symbolic(prog, gvid, prog.avals[vid])
+        result.append((p, g))
     prog._backward_spec = {"loss": loss_vid,
-                           "params": [vid for _, vid in param_vids]}
+                           "params": [vid for _, vid in param_vids],
+                           "grad_vids": grad_vid_map}
     return result
 
 
@@ -100,13 +112,16 @@ class Executor:
                tuple((feed_values[k].shape, str(feed_values[k].dtype))
                      for k in sorted(feed)), tuple(fetch_ids),
                backward is not None)
+        grad_vid_map = (backward or {}).get("grad_vids", {})
+        want_grads = [i for i in fetch_ids if i in grad_vid_map]
         compiled = self._cache.get(sig)
         if compiled is None:
             # constants (parameter values) are jit INPUTS — updating a
             # parameter between runs takes effect without recompiling
-            if backward is None:
+            reg_ids = [i for i in fetch_ids if i not in grad_vid_map]
+            if not want_grads:
                 def run_fn(fv, consts, rng_keys):
-                    return program.replay(fv, fetch_ids, constants=consts,
+                    return program.replay(fv, reg_ids, constants=consts,
                                           rng_keys=rng_keys)
             else:
                 loss_vid = backward["loss"]
@@ -120,15 +135,22 @@ class Executor:
                     def loss_fn(pv):
                         merged = dict(rest)
                         merged.update(pv)
-                        outs = program.replay(fv, fetch_ids + [loss_vid],
+                        outs = program.replay(fv, reg_ids + [loss_vid],
                                               constants=merged,
                                               rng_keys=rng_keys)
                         return outs[-1], outs[:-1]
 
-                    (loss_v, fetches), grads = jax.value_and_grad(
+                    (_, reg_outs), grads = jax.value_and_grad(
                         loss_fn, has_aux=True)(pvals)
-                    grad_outs = [grads[vid] for vid in param_vids]
-                    return tuple(fetches) + tuple(grad_outs)
+                    # assemble in the requested fetch order
+                    reg_iter = iter(reg_outs)
+                    outs = []
+                    for i in fetch_ids:
+                        if i in grad_vid_map:
+                            outs.append(grads[grad_vid_map[i]])
+                        else:
+                            outs.append(next(reg_iter))
+                    return tuple(outs)
 
             compiled = jax.jit(run_fn)
             self._cache[sig] = compiled

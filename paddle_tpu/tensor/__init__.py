@@ -1,0 +1,2 @@
+"""Extended tensor namespaces (linalg/fft) — reference: python/paddle/tensor/."""
+from paddle_tpu.tensor import fft, linalg  # noqa: F401

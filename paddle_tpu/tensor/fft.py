@@ -1,0 +1,53 @@
+"""paddle.fft — reference: python/paddle/fft.py. XLA FFT lowerings."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import OPS, OpDef, make_op_function
+
+
+def _reg(name, fn, diff=True):
+    OPS.setdefault(name, OpDef(name, fn, diff=diff, method=False))
+    return make_op_function(name)
+
+
+fft = _reg("fft_fft", lambda x, n=None, axis=-1, norm="backward":
+           jnp.fft.fft(x, n=n, axis=axis, norm=norm))
+ifft = _reg("fft_ifft", lambda x, n=None, axis=-1, norm="backward":
+            jnp.fft.ifft(x, n=n, axis=axis, norm=norm))
+fft2 = _reg("fft_fft2", lambda x, s=None, axes=(-2, -1), norm="backward":
+            jnp.fft.fft2(x, s=s, axes=axes, norm=norm))
+ifft2 = _reg("fft_ifft2", lambda x, s=None, axes=(-2, -1), norm="backward":
+             jnp.fft.ifft2(x, s=s, axes=axes, norm=norm))
+fftn = _reg("fft_fftn", lambda x, s=None, axes=None, norm="backward":
+            jnp.fft.fftn(x, s=s, axes=axes, norm=norm))
+ifftn = _reg("fft_ifftn", lambda x, s=None, axes=None, norm="backward":
+             jnp.fft.ifftn(x, s=s, axes=axes, norm=norm))
+rfft = _reg("fft_rfft", lambda x, n=None, axis=-1, norm="backward":
+            jnp.fft.rfft(x, n=n, axis=axis, norm=norm))
+irfft = _reg("fft_irfft", lambda x, n=None, axis=-1, norm="backward":
+             jnp.fft.irfft(x, n=n, axis=axis, norm=norm))
+rfft2 = _reg("fft_rfft2", lambda x, s=None, axes=(-2, -1), norm="backward":
+             jnp.fft.rfft2(x, s=s, axes=axes, norm=norm))
+irfft2 = _reg("fft_irfft2", lambda x, s=None, axes=(-2, -1), norm="backward":
+              jnp.fft.irfft2(x, s=s, axes=axes, norm=norm))
+hfft = _reg("fft_hfft", lambda x, n=None, axis=-1, norm="backward":
+            jnp.fft.hfft(x, n=n, axis=axis, norm=norm))
+ihfft = _reg("fft_ihfft", lambda x, n=None, axis=-1, norm="backward":
+             jnp.fft.ihfft(x, n=n, axis=axis, norm=norm))
+fftshift = _reg("fft_fftshift", lambda x, axes=None: jnp.fft.fftshift(x, axes))
+ifftshift = _reg("fft_ifftshift",
+                 lambda x, axes=None: jnp.fft.ifftshift(x, axes))
+
+
+def fftfreq(n, d=1.0, dtype=None):
+    from paddle_tpu.core.tensor import Tensor
+
+    return Tensor._wrap(jnp.fft.fftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None):
+    from paddle_tpu.core.tensor import Tensor
+
+    return Tensor._wrap(jnp.fft.rfftfreq(n, d).astype(dtype or jnp.float32))

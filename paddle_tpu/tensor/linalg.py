@@ -1,0 +1,128 @@
+"""paddle.linalg — reference: python/paddle/tensor/linalg.py. All ops lower
+to XLA's linalg lowerings (QR/SVD/eigh run on TPU via XLA custom calls)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import OPS, OpDef, make_op_function
+from paddle_tpu.ops import impl as _impl
+
+
+def _register(name, fn, diff=True, dynamic=False):
+    if name not in OPS:
+        OPS[name] = OpDef(name, fn, diff=diff, dynamic=dynamic, method=False)
+    return make_op_function(name)
+
+
+cholesky = _register("linalg_cholesky", _impl.cholesky)
+inv = _register("linalg_inv", _impl.inverse)
+triangular_solve = _register("linalg_triangular_solve", _impl.triangular_solve)
+norm = _register("linalg_norm", _impl.norm)
+
+
+def _qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def _svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def _eig(x):
+    # general eig has no TPU lowering; run on CPU like the reference's
+    # CPU-only EigKernel
+    return jnp.linalg.eig(x)
+
+
+def _eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, symmetrize_input=True)
+
+
+def _eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+def _eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x)
+
+
+def _matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+def _matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def _slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logabs])
+
+
+def _det(x):
+    return jnp.linalg.det(x)
+
+
+def _pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+def _solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+def _lstsq(x, y, rcond=None, driver=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+def _lu(x, pivot=True):
+    import jax.scipy.linalg as jsl
+
+    lu, piv = jsl.lu_factor(x)
+    return lu, piv.astype(jnp.int32)
+
+
+def _cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+def _cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+def _householder_product(x, tau):
+    import jax.lax.linalg as lxl
+
+    return lxl.householder_product(x, tau)
+
+
+qr = _register("linalg_qr", _qr)
+svd = _register("linalg_svd", _svd)
+eig = _register("linalg_eig", _eig, diff=False)
+eigh = _register("linalg_eigh", _eigh)
+eigvals = _register("linalg_eigvals", _eigvals, diff=False)
+eigvalsh = _register("linalg_eigvalsh", _eigvalsh)
+matrix_rank = _register("linalg_matrix_rank", _matrix_rank, diff=False)
+matrix_power = _register("linalg_matrix_power", _matrix_power)
+slogdet = _register("linalg_slogdet", _slogdet)
+det = _register("linalg_det", _det)
+pinv = _register("linalg_pinv", _pinv)
+solve = _register("linalg_solve", _solve)
+lstsq = _register("linalg_lstsq", _lstsq)
+lu = _register("linalg_lu", _lu)
+cond = _register("linalg_cond", _cond)
+cov = _register("linalg_cov", _cov)
+householder_product = _register("linalg_householder_product",
+                                _householder_product)
+
+# re-exports shared with the top-level namespace
+from paddle_tpu.ops.registry import C_OPS as _C  # noqa: E402
+
+matmul = _C.matmul
+dot = _C.dot
+multi_dot = _register("linalg_multi_dot",
+                      lambda xs: jnp.linalg.multi_dot(xs))

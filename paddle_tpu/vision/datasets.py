@@ -92,21 +92,28 @@ class Cifar10(_SyntheticImageDataset):
     """Reference: vision/datasets/cifar.py. Loads the pickle batches from
     data_file when given; synthetic otherwise."""
 
+    # archive member filter + label key differ between CIFAR-10 and CIFAR-100
+    _member_match = {"train": "data_batch", "test": "test_batch"}
+    _label_key = b"labels"
+
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=False, backend=None):
         if data_file and os.path.exists(data_file):
             import tarfile
 
             imgs, labels = [], []
+            match = self._member_match["train" if mode == "train" else "test"]
             with tarfile.open(data_file) as tf:
                 names = [n for n in tf.getnames()
-                         if ("data_batch" in n if mode == "train" else
-                             "test_batch" in n)]
+                         if os.path.basename(n).startswith(match)]
                 for name in sorted(names):
                     d = pickle.load(tf.extractfile(name), encoding="bytes")
                     imgs.append(d[b"data"].reshape(-1, 3, 32, 32)
                                 .transpose(0, 2, 3, 1))
-                    labels.extend(d[b"labels"])
+                    labels.extend(d[self._label_key])
+            if not imgs:
+                raise ValueError(
+                    f"no {match}* members found in {data_file}")
             self._images = np.concatenate(imgs)
             self._labels_real = np.asarray(labels, np.int64)
             self.transform = transform
@@ -132,6 +139,10 @@ class Cifar10(_SyntheticImageDataset):
 
 
 class Cifar100(Cifar10):
+    # CIFAR-100 archives hold members "train"/"test" keyed b"fine_labels"
+    _member_match = {"train": "train", "test": "test"}
+    _label_key = b"fine_labels"
+
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=False, backend=None):
         if data_file and os.path.exists(data_file):

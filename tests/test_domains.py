@@ -1,0 +1,215 @@
+"""linalg / fft / signal / distribution / TCPStore / recompute tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+rng = np.random.default_rng(11)
+
+
+def _f(*shape):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+# ---------------------------------------------------------------- linalg
+
+
+def test_linalg_qr_svd_solve():
+    a = _f(5, 5)
+    x = paddle.to_tensor(a)
+    q, r = paddle.linalg.qr(x)
+    np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-4)
+    u, s, vt = paddle.linalg.svd(x)
+    np.testing.assert_allclose(u.numpy() @ np.diag(s.numpy()) @ vt.numpy(),
+                               a, atol=1e-4)
+    b = _f(5, 2)
+    sol = paddle.linalg.solve(x, paddle.to_tensor(b))
+    np.testing.assert_allclose(a @ sol.numpy(), b, atol=1e-3)
+
+
+def test_linalg_eigh_det():
+    a = _f(4, 4)
+    sym = a + a.T
+    w, v = paddle.linalg.eigh(paddle.to_tensor(sym))
+    np.testing.assert_allclose(
+        v.numpy() @ np.diag(w.numpy()) @ v.numpy().T, sym, atol=1e-4)
+    d = paddle.linalg.det(paddle.to_tensor(a))
+    np.testing.assert_allclose(float(d), np.linalg.det(a), rtol=1e-4)
+
+
+def test_linalg_grad_flows():
+    x = paddle.to_tensor(_f(3, 3) + 3 * np.eye(3, dtype=np.float32),
+                         stop_gradient=False)
+    paddle.linalg.inv(x).sum().backward()
+    assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+
+# ---------------------------------------------------------------- fft
+
+
+def test_fft_roundtrip():
+    x = _f(16)
+    s = paddle.fft.fft(paddle.to_tensor(x))
+    back = paddle.fft.ifft(s)
+    np.testing.assert_allclose(back.numpy().real, x, atol=1e-5)
+    np.testing.assert_allclose(s.numpy(), np.fft.fft(x), atol=1e-3)
+
+
+def test_rfft():
+    x = _f(4, 16)
+    s = paddle.fft.rfft(paddle.to_tensor(x))
+    assert s.shape == [4, 9]
+    np.testing.assert_allclose(s.numpy(), np.fft.rfft(x), atol=1e-3)
+
+
+def test_stft_istft_roundtrip():
+    x = _f(1, 512)
+    spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=64, hop_length=16)
+    rec = paddle.signal.istft(spec, n_fft=64, hop_length=16, length=512)
+    np.testing.assert_allclose(rec.numpy(), x, atol=1e-4)
+
+
+# ---------------------------------------------------------------- dists
+
+
+def test_normal_distribution():
+    from paddle_tpu.distribution import Normal
+
+    paddle.seed(0)
+    d = Normal(1.0, 2.0)
+    s = d.sample((5000,))
+    assert abs(float(s.numpy().mean()) - 1.0) < 0.15
+    assert abs(float(s.numpy().std()) - 2.0) < 0.15
+    lp = d.log_prob(paddle.to_tensor(1.0))
+    np.testing.assert_allclose(float(lp), -np.log(2 * np.sqrt(2 * np.pi)),
+                               rtol=1e-5)
+
+
+def test_categorical_and_kl():
+    from paddle_tpu.distribution import Categorical, Normal, kl_divergence
+
+    p = Categorical(probs=paddle.to_tensor([0.2, 0.8]))
+    q = Categorical(probs=paddle.to_tensor([0.5, 0.5]))
+    kl = kl_divergence(p, q)
+    expected = 0.2 * np.log(0.4) + 0.8 * np.log(1.6)
+    np.testing.assert_allclose(float(kl), expected, rtol=1e-4)
+
+    n1, n2 = Normal(0.0, 1.0), Normal(1.0, 1.0)
+    np.testing.assert_allclose(float(kl_divergence(n1, n2)), 0.5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("dist_name,kwargs", [
+    ("Bernoulli", {"probs": 0.3}),
+    ("Exponential", {"rate": 2.0}),
+    ("Gamma", {"concentration": 2.0, "rate": 1.0}),
+    ("Beta", {"alpha": 2.0, "beta": 3.0}),
+    ("Laplace", {"loc": 0.0, "scale": 1.0}),
+    ("Gumbel", {"loc": 0.0, "scale": 1.0}),
+    ("Poisson", {"rate": 3.0}),
+    ("Geometric", {"probs": 0.5}),
+])
+def test_distribution_sample_logprob(dist_name, kwargs):
+    import paddle_tpu.distribution as D
+
+    d = getattr(D, dist_name)(**kwargs)
+    s = d.sample((10,))
+    assert s.shape[0] == 10
+    lp = d.log_prob(s)
+    assert np.isfinite(lp.numpy()).all()
+
+
+# ---------------------------------------------------------------- store
+
+
+def test_tcp_store_native():
+    from paddle_tpu.parallel.store import TCPStore, _load_lib
+
+    assert _load_lib() is not None, "native tcpstore failed to build"
+    master = TCPStore("127.0.0.1", 0, is_master=True)
+    client = TCPStore("127.0.0.1", master.port, is_master=False)
+    client.set("k", b"v1")
+    assert master.get("k") == b"v1"
+    assert client.add("counter", 2) == 2
+    assert master.add("counter", 40) == 42
+    assert master.check("k") and not master.check("missing")
+    master.delete_key("k")
+    assert not client.check("k")
+
+
+def test_tcp_store_blocking_wait():
+    import threading
+    import time
+
+    from paddle_tpu.parallel.store import TCPStore
+
+    master = TCPStore("127.0.0.1", 0, is_master=True)
+    client = TCPStore("127.0.0.1", master.port, is_master=False)
+    results = []
+
+    def waiter():
+        results.append(client.get("slow_key"))
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.1)
+    assert not results  # still blocked
+    master.set("slow_key", b"done")
+    th.join(5)
+    assert results == [b"done"]
+
+
+# ---------------------------------------------------------------- recompute
+
+
+def test_recompute_matches_plain():
+    from paddle_tpu.parallel import recompute
+
+    paddle.seed(2)
+    net = nn.Sequential(nn.Linear(8, 32), nn.GELU(), nn.Linear(32, 8))
+    x = paddle.to_tensor(_f(4, 8), stop_gradient=False)
+
+    out = recompute(net, x)
+    out.sum().backward()
+    g_rc = x.grad.numpy().copy()
+    w_rc = net[0].weight.grad.numpy().copy()
+
+    x2 = paddle.to_tensor(x.numpy(), stop_gradient=False)
+    for p in net.parameters():
+        p.clear_grad()
+    net(x2).sum().backward()
+    np.testing.assert_allclose(g_rc, x2.grad.numpy(), rtol=1e-5)
+    np.testing.assert_allclose(w_rc, net[0].weight.grad.numpy(), rtol=1e-5)
+
+
+def test_recompute_under_trainstep():
+    from paddle_tpu.parallel import RecomputeLayer
+
+    paddle.seed(3)
+    inner = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 8))
+    net = nn.Sequential(RecomputeLayer(inner), nn.Linear(8, 2))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    lossfn = nn.CrossEntropyLoss()
+    step = paddle.jit.TrainStep(net, lambda o, t: lossfn(o, t), opt)
+    x = paddle.to_tensor(_f(8, 8))
+    y = paddle.to_tensor(rng.integers(0, 2, 8).astype(np.int32))
+    l0 = float(step(x, y))
+    for _ in range(5):
+        l1 = float(step(x, y))
+    assert l1 < l0
+
+
+def test_gradient_merge():
+    from paddle_tpu.parallel import GradientMerge
+
+    w = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    w.trainable = True
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w])
+    gm = GradientMerge(opt, k_steps=2)
+    (w * 2).sum().backward()
+    assert not gm.step()  # accumulate only
+    np.testing.assert_allclose(w.numpy(), 1.0)
+    (w * 4).sum().backward()
+    assert gm.step()  # steps with averaged grad = (2+4)/2 = 3
+    np.testing.assert_allclose(w.numpy(), 1.0 - 3.0, rtol=1e-6)

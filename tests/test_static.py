@@ -45,8 +45,10 @@ def test_append_backward():
     with static.program_guard(main):
         x = static.data("x", [8, 4], "float32")
         loss = paddle.mean(net(x) ** 2)
-    static.append_backward(loss, parameter_list=net.parameters())
-    outs = static.Executor().run(main, feed={"x": xs}, fetch_list=[loss])
+    grads = static.append_backward(loss, parameter_list=net.parameters())
+    grad_syms = [g for _, g in grads]
+    outs = static.Executor().run(main, feed={"x": xs},
+                                 fetch_list=[loss] + grad_syms)
     loss_v, gw, gb = outs
 
     # compare against eager grads
