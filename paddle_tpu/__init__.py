@@ -287,6 +287,7 @@ from paddle_tpu import nn  # noqa: E402,F401
 from paddle_tpu import optimizer  # noqa: E402,F401
 from paddle_tpu import parallel  # noqa: E402,F401
 from paddle_tpu import distribution  # noqa: E402,F401
+from paddle_tpu import inference  # noqa: E402,F401
 from paddle_tpu import metric  # noqa: E402,F401
 from paddle_tpu import profiler  # noqa: E402,F401
 from paddle_tpu import signal  # noqa: E402,F401

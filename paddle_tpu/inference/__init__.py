@@ -1,0 +1,138 @@
+"""paddle_tpu.inference — the serving path.
+
+Reference: Paddle Inference AnalysisPredictor
+(paddle/fluid/inference/api/analysis_predictor.h:101 — load model →
+optimization passes → ZeroCopyRun) with Config (analysis_config.cc) and the
+python binding python/paddle/inference/.
+
+TPU-native collapse: "analysis passes + TRT subgraphs" become one XLA AOT
+compile of the loaded static Program; ZeroCopyRun = a cached compiled
+executable keyed by input signature, with device-resident inputs/outputs
+(PJRT buffers) for zero-copy semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+
+class Config:
+    """Reference: paddle_infer.Config (analysis_config.cc)."""
+
+    def __init__(self, model_path: Optional[str] = None,
+                 params_path: Optional[str] = None):
+        self.model_path = model_path
+        self._device = None
+        self._memory_optim = True
+        self._amp_dtype = None
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        pass  # no GPU in this stack
+
+    def enable_tpu(self, device_id: int = 0):
+        self._device = ("tpu", device_id)
+
+    def disable_gpu(self):
+        self._device = ("cpu", 0)
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def enable_memory_optim(self, flag=True):
+        self._memory_optim = flag
+
+    def enable_low_precision(self, dtype="bfloat16"):
+        """TPU analogue of enable_use_gpu+TRT fp16: cast weights to bf16."""
+        self._amp_dtype = dtype
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA always optimizes
+
+    def model_dir(self):
+        return self.model_path
+
+
+class Predictor:
+    """Reference: AnalysisPredictor. Loads a static Program
+    (static.save_inference_model output) and serves it."""
+
+    def __init__(self, config: Config):
+        from paddle_tpu import static
+
+        self.config = config
+        exe = static.Executor()
+        self.program, self.feed_names, self.fetch_targets = \
+            static.load_inference_model(config.model_path, exe)
+        if config._amp_dtype is not None:
+            import jax.numpy as jnp
+
+            from paddle_tpu.core.dtype import to_jax_dtype
+
+            d = to_jax_dtype(config._amp_dtype)
+            self.program.constants = {
+                vid: (v.astype(d) if hasattr(v, "dtype")
+                      and jnp.issubdtype(v.dtype, jnp.floating) else v)
+                for vid, v in self.program.constants.items()}
+        self._exe = exe
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._outputs: List = []
+
+    # zero-copy style handle API (paddle_infer tensor handles)
+    def get_input_names(self) -> List[str]:
+        return list(self.feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return [f"out_{i}" for i in range(len(self.fetch_targets))]
+
+    def get_input_handle(self, name: str):
+        return _InputHandle(self, name)
+
+    def get_output_handle(self, name: str):
+        idx = int(name.split("_")[-1])
+        return _OutputHandle(self, idx)
+
+    def run(self, inputs: Optional[List] = None):
+        """ZeroCopyRun (analysis_predictor.h:211). With `inputs` given,
+        behaves like predictor.run([x, ...]) -> [outputs]."""
+        if inputs is not None:
+            for name, v in zip(self.feed_names, inputs):
+                self._inputs[name] = v._value if isinstance(v, Tensor) else v
+        feed = {k: self._inputs[k] for k in self.feed_names}
+        outs = self._exe.run(self.program, feed=feed,
+                             fetch_list=self.fetch_targets,
+                             return_numpy=False)
+        self._outputs = outs
+        return outs
+
+    def try_shrink_memory(self):
+        pass
+
+
+class _InputHandle:
+    def __init__(self, predictor, name):
+        self._p = predictor
+        self._name = name
+
+    def copy_from_cpu(self, arr):
+        self._p._inputs[self._name] = np.asarray(arr)
+
+    def reshape(self, shape):
+        pass
+
+
+class _OutputHandle:
+    def __init__(self, predictor, idx):
+        self._p = predictor
+        self._idx = idx
+
+    def copy_to_cpu(self):
+        return np.asarray(self._p._outputs[self._idx]._value)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -1,0 +1,180 @@
+"""Autoregressive generation with a static KV cache.
+
+Reference: the reference's LLM serving path — block_multihead_attention
+(paged KV cache, python/paddle/incubate/nn/functional/) + PaddleNLP
+generation loops over masked_multihead_attention.
+
+TPU-native: the KV cache is a preallocated [b, max_len, h, d] buffer per
+layer updated with lax.dynamic_update_slice, so prefill + every decode step
+are TWO fixed-shape compiled programs (no recompilation as length grows —
+XLA requirement). Decode attends over the full cache with a position mask;
+the cache buffers are donated between steps (true in-place update in HBM).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.models.gpt import GPT, GPTConfig
+
+
+def _block_params(all_params, i):
+    pre = f"blocks.{i}."
+    return {k[len(pre):]: v for k, v in all_params.items()
+            if k.startswith(pre)}
+
+
+def _layer_norm(x, w, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), -1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w + b
+
+
+def _attn_with_cache(p, x, k_cache, v_cache, pos, n_heads):
+    """x: [b, t, H]; caches: [b, L, h, d]; pos: current write offset."""
+    b, t, hdim = x.shape
+    d = hdim // n_heads
+    qkv = x @ p["attn.qkv.weight"] + p["attn.qkv.bias"]
+    qkv = qkv.reshape(b, t, 3, n_heads, d)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+    L = k_cache.shape[1]
+    scale = 1.0 / np.sqrt(d)
+    qT = jnp.swapaxes(q, 1, 2).astype(jnp.float32)       # [b,h,t,d]
+    kT = jnp.swapaxes(k_cache, 1, 2).astype(jnp.float32)  # [b,h,L,d]
+    vT = jnp.swapaxes(v_cache, 1, 2).astype(jnp.float32)
+    s = jnp.einsum("bhtd,bhLd->bhtL", qT, kT) * scale
+    q_pos = pos + jnp.arange(t)[:, None]
+    k_pos = jnp.arange(L)[None, :]
+    mask = k_pos <= q_pos                                 # causal over cache
+    s = jnp.where(mask[None, None], s, -1e30)
+    probs = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhtL,bhLd->bhtd", probs, vT).astype(x.dtype)
+    out = jnp.swapaxes(out, 1, 2).reshape(b, t, hdim)
+    return out @ p["attn.out.weight"] + p["attn.out.bias"], k_cache, v_cache
+
+
+def _mlp(p, x):
+    h = jax.nn.gelu(x @ p["mlp.fc1.weight"] + p["mlp.fc1.bias"],
+                    approximate=True)
+    return h @ p["mlp.fc2.weight"] + p["mlp.fc2.bias"]
+
+
+def _forward_with_cache(params, cfg: GPTConfig, tokens, caches, pos):
+    """tokens: [b, t]; caches: list of (k, v); returns logits [b, t, V]."""
+    b, t = tokens.shape
+    x = (jnp.take(params["wte.weight"], tokens, axis=0)
+         + jnp.take(params["wpe.weight"], pos + jnp.arange(t), axis=0))
+    new_caches = []
+    for i in range(cfg.num_layers):
+        p = _block_params(params, i)
+        h = _layer_norm(x, p["ln1.weight"], p["ln1.bias"])
+        a, kc, vc = _attn_with_cache(p, h, caches[i][0], caches[i][1], pos,
+                                     cfg.num_heads)
+        x = x + a
+        h = _layer_norm(x, p["ln2.weight"], p["ln2.bias"])
+        x = x + _mlp(p, h)
+        new_caches.append((kc, vc))
+    x = _layer_norm(x, params["ln_f.weight"], params["ln_f.bias"])
+    logits = jnp.einsum("bth,vh->btv", x, params["wte.weight"])
+    return logits, new_caches
+
+
+def _sample(logits, key, temperature, top_k, top_p):
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_l = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_l, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+class GPTGenerator:
+    """Compiled prefill + decode loop.
+
+    gen = GPTGenerator(model); out = gen.generate(input_ids, max_new_tokens=...)
+    """
+
+    def __init__(self, model: GPT, max_len: Optional[int] = None):
+        from paddle_tpu.jit.functionalize import functionalize
+
+        self.model = model
+        self.cfg = model.cfg
+        assert not self.cfg.tensor_parallel, \
+            "GPTGenerator currently supports the single-chip/dense config"
+        self.max_len = max_len or self.cfg.max_seq_len
+        self.func = functionalize(model)
+        self.params = self.func.param_values()
+        cfg = self.cfg
+
+        @jax.jit
+        def prefill(params, tokens, caches):
+            logits, caches = _forward_with_cache(params, cfg, tokens, caches, 0)
+            return logits[:, -1], caches
+
+        @partial(jax.jit, donate_argnums=(2,),
+                 static_argnames=("temperature", "top_k", "top_p"))
+        def decode(params, token, caches, pos, key, temperature=1.0,
+                   top_k=None, top_p=None):
+            logits, caches = _forward_with_cache(
+                params, cfg, token[:, None], caches, pos)
+            nxt = _sample(logits[:, -1], key, temperature, top_k, top_p)
+            return nxt, caches
+
+        self._prefill = prefill
+        self._decode = decode
+
+    def _empty_caches(self, batch):
+        cfg = self.cfg
+        d = cfg.hidden_size // cfg.num_heads
+        shape = (batch, self.max_len, cfg.num_heads, d)
+        dt = self.params["wte.weight"].dtype
+        return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+                for _ in range(cfg.num_layers)]
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
+                 top_k=None, top_p=None, eos_token_id=None, seed=None):
+        from paddle_tpu.core.random import default_generator
+
+        ids = input_ids._value if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        if ids.ndim == 1:
+            ids = ids[None]
+        b, t = ids.shape
+        assert t + max_new_tokens <= self.max_len
+        caches = self._empty_caches(b)
+        last_logits, caches = self._prefill(self.params, ids, caches)
+        key = (jax.random.key(seed) if seed is not None
+               else default_generator.next_key())
+        tok = _sample(last_logits, key, temperature, top_k, top_p)
+        outs = [tok]
+        pos = t
+        for i in range(max_new_tokens - 1):
+            key = jax.random.fold_in(key, i)
+            tok, caches = self._decode(self.params, tok, caches,
+                                       jnp.asarray(pos, jnp.int32), key,
+                                       temperature=temperature, top_k=top_k,
+                                       top_p=top_p)
+            outs.append(tok)
+            pos += 1
+            if eos_token_id is not None and bool((tok == eos_token_id).all()):
+                break
+        gen = jnp.stack(outs, axis=1)
+        return Tensor._wrap(jnp.concatenate([ids, gen], axis=1))

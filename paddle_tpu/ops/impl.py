@@ -1255,11 +1255,28 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     flash_attention (python/paddle/nn/functional/flash_attention.py:358).
 
     Layout [batch, seq, heads, head_dim] (paddle flash-attn convention).
-    Computed at fp32 accumulation; XLA fuses; a Pallas flash kernel can be
-    swapped in via paddle_tpu.ops.pallas when available.
+    Computed at fp32 accumulation. When the shapes tile (d % 128 == 0,
+    seq % 128 == 0) and no mask/dropout is requested, dispatches to the
+    Pallas flash kernel (paddle_tpu/ops/pallas/flash_attention.py).
     """
     b, sq, h, d = q.shape
-    scale = scale or (1.0 / math.sqrt(d))
+    scale = scale if scale is not None else (1.0 / math.sqrt(d))
+
+    import jax as _jax
+
+    from paddle_tpu.utils.flags import flag
+
+    # flags are part of the per-op jit cache key (registry flags_version),
+    # so this read is re-evaluated after any set_flags. TPU-only: on other
+    # backends the interpret-mode kernel would be slower than the XLA path.
+    if (attn_mask is None and dropout_p == 0.0
+            and flag("FLAGS_use_flash_attention")
+            and _jax.default_backend() == "tpu"):
+        from paddle_tpu.ops.pallas.flash_attention import (
+            _block_shapes_ok, flash_attention)
+
+        if _block_shapes_ok(q, k, 128, 128, v=v):
+            return flash_attention(q, k, v, causal=is_causal, scale=scale)
     qT = jnp.swapaxes(q, 1, 2)  # b h s d
     kT = jnp.swapaxes(k, 1, 2)
     vT = jnp.swapaxes(v, 1, 2)

@@ -1,0 +1,181 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Reference: the reference wraps the CUDA flashattn library
+(paddle/phi/kernels/gpu/flash_attn_kernel.cu over third_party/flashattn,
+exposed via nn/functional/flash_attention.py:358). On TPU the kernel is
+written in Pallas: blocks of Q stream against K/V tiles held in VMEM with an
+online-softmax accumulator in fp32 — the attention matrix never exists in
+HBM. MXU does the two matmuls per tile; the VPU does the softmax algebra.
+
+Forward is the Pallas kernel; backward uses jax.custom_vjp with a
+rematerialized reference backward (block-sparse flash backward is a follow-up
+— forward is where serving/inference lives).
+
+Layout: [batch, seq, heads, head_dim] (paddle flash-attn convention).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific memory spaces; absent meanings fall back to defaults
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+                      causal: bool, scale: float, seq_k: int, seq_q: int):
+    """One (batch*head, q_block) program: stream K/V tiles, online softmax.
+
+    q_ref: [1, block_q, d]; k_ref/v_ref: [1, seq_k, d]; o_ref: [1, block_q, d]
+    (leading unit dim = the batch*head grid axis).
+    """
+    _, block_q, d = q_ref.shape
+    qi = pl.program_id(1)
+
+    q = q_ref[0].astype(jnp.float32) * scale
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    # bottom-right-aligned causal mask (matches the XLA path's
+    # tril(k=sk-sq)): query i attends keys <= i + (seq_k - seq_q)
+    causal_offset = seq_k - seq_q
+    q_pos = causal_offset + qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_tile = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_tile = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_tile, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        blk_max = jnp.max(s, axis=-1, keepdims=True)
+        new_m = jnp.maximum(m, blk_max)
+        p = jnp.exp(s - new_m)
+        corr = jnp.exp(m - new_m)
+        new_l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        new_acc = acc * corr + jax.lax.dot_general(
+            p, v_tile, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return new_m, new_l, new_acc
+
+    num_kb = seq_k // block_k
+    if causal:
+        # only tiles that intersect the causal region for this q block
+        num_kb_live = jnp.minimum(
+            causal_offset + (qi + 1) * block_q + block_k - 1, seq_k) // block_k
+        m, l, acc = jax.lax.fori_loop(0, num_kb_live, body, (m0, l0, acc0))
+    else:
+        m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
+                   block_k: int, interpret: bool):
+    """q/k/v: [b, s, h, d] -> out [b, s, h, d]."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+
+    qf = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
+    kf = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
+    vf = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
+
+    grid = (b * h, sq // block_q)
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_k=block_k, causal=causal, scale=scale,
+        seq_k=sk, seq_q=sq)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)
+
+
+def _reference(q, k, v, causal, scale):
+    qT = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+    kT = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vT = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qT, kT) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, vT)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, causal, scale),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _block_shapes_ok(q, k, block_q, block_k, v=None) -> bool:
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    return (sq % block_q == 0 and sk % block_k == 0 and d % 128 == 0
+            and q.shape[:1] + q.shape[2:] == k.shape[:1] + k.shape[2:]
+            and (v is None or tuple(v.shape) == tuple(k.shape)))
+
+
+def flash_attention(q, k, v, causal: bool = True, scale=None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool | None = None):
+    """Pallas flash attention with automatic fallback to the XLA reference
+    when shapes don't tile (same dispatch pattern as the reference's
+    sdp_kernel selection, nn/functional/flash_attention.py)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_q = min(block_q, q.shape[1])
+    block_k = min(block_k, k.shape[1])
+    if not _block_shapes_ok(q, k, block_q, block_k):
+        return _reference(q, k, v, causal, scale)
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)

@@ -111,7 +111,8 @@ def _hashable(obj) -> bool:
 
 
 @lru_cache(maxsize=8192)
-def _jitted_fn(name: str, args_tpl, kwargs_tpl, cast_dtype):
+def _jitted_fn(name: str, args_tpl, kwargs_tpl, cast_dtype,
+               flags_version: int = 0):
     """Build + cache a jitted closure for (op, static attrs). jax.jit adds its
     own shape/dtype-keyed cache under this, so each distinct input signature
     compiles once — the eager-mode analogue of the reference's kernel cache."""
@@ -186,7 +187,8 @@ def dispatch(name: str, args, kwargs):
         and _hashable(kwargs_tpl)
     )
     if use_jit:
-        raw_f, fast_f = _jitted_fn(name, args_tpl, kwargs_tpl, cast_dtype)
+        raw_f, fast_f = _jitted_fn(name, args_tpl, kwargs_tpl, cast_dtype,
+                                   flags.flags_version())
     else:
         def raw_f(*tvals):
             if cast_dtype is not None:

@@ -33,12 +33,22 @@ def define_flag(name: str, default: Any, help_str: str = "") -> None:
         _FLAGS[name] = default
 
 
+_VERSION = [0]
+
+
+def flags_version() -> int:
+    """Bumped on every set_flags; part of jit cache keys so flag-dependent
+    traced code (e.g. the flash-attention route) re-traces after a toggle."""
+    return _VERSION[0]
+
+
 def set_flags(flags: Mapping[str, Any]) -> None:
     """Like paddle.set_flags (python/paddle/base/core.py)."""
     for k, v in flags.items():
         if k not in _FLAGS:
             raise KeyError(f"unknown flag {k!r}")
         _FLAGS[k] = v
+    _VERSION[0] += 1
 
 
 def get_flags(flags: Iterable[str] | str) -> Dict[str, Any]:
@@ -57,3 +67,5 @@ define_flag("FLAGS_eager_op_jit", True, "dispatch eager ops through per-op jit c
 define_flag("FLAGS_default_dtype", "float32", "default floating dtype")
 define_flag("FLAGS_amp_dtype", "bfloat16", "preferred low precision dtype on TPU")
 define_flag("FLAGS_log_compiles", False, "log XLA compilations")
+define_flag("FLAGS_use_flash_attention", True,
+            "route attention through the Pallas flash kernel when shapes tile")

@@ -1,0 +1,175 @@
+"""Pallas flash attention, KV-cache generation, inference Predictor tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.models.generation import GPTGenerator
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.ops.pallas.flash_attention import (
+    _reference, flash_attention,
+)
+
+rng = np.random.default_rng(13)
+
+
+def _qkv(b=2, s=256, h=2, d=128):
+    return tuple(jnp.asarray(rng.standard_normal((b, s, h, d))
+                             .astype(np.float32)) for _ in range(3))
+
+
+class TestFlashAttention:
+    def test_causal_parity(self):
+        q, k, v = _qkv()
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        ref = _reference(q, k, v, True, 1 / np.sqrt(128))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_noncausal_parity(self):
+        q, k, v = _qkv(b=1, s=128)
+        out = flash_attention(q, k, v, causal=False, interpret=True)
+        ref = _reference(q, k, v, False, 1 / np.sqrt(128))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_grad_parity(self):
+        q, k, v = _qkv(b=1, s=128, h=1)
+
+        g = jax.grad(lambda q: flash_attention(
+            q, k, v, interpret=True).sum())(q)
+        gr = jax.grad(lambda q: _reference(
+            q, k, v, True, 1 / np.sqrt(128)).sum())(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gr), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_causal_cross_length(self):
+        """KV-decode shape: sq < sk must use bottom-right mask alignment."""
+        q, _, _ = _qkv(b=1, s=128, h=1)
+        _, k, v = _qkv(b=1, s=512, h=1)
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        ref = _reference(q, k, v, True, 1 / np.sqrt(128))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_v_shape_mismatch_falls_back(self):
+        q, k, _ = _qkv(b=1, s=128, h=1)
+        v = jnp.asarray(rng.standard_normal((1, 128, 1, 256))
+                        .astype(np.float32))
+        from paddle_tpu.ops.pallas.flash_attention import _block_shapes_ok
+
+        assert not _block_shapes_ok(q, k, 128, 128, v=v)
+
+    def test_fallback_on_odd_shapes(self):
+        q, k, v = _qkv(b=1, s=100, h=2, d=64)  # not tileable
+        out = flash_attention(q, k, v, causal=True)
+        ref = _reference(q, k, v, True, 1 / np.sqrt(64))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_sdpa_routes_to_flash(self):
+        """scaled_dot_product_attention dispatches to the Pallas kernel for
+        tileable shapes (and must agree with the XLA path)."""
+        from paddle_tpu.ops.impl import scaled_dot_product_attention
+
+        q, k, v = _qkv(b=1, s=128, h=1)
+        with_flash = scaled_dot_product_attention(q, k, v, is_causal=True)
+        paddle.set_flags({"FLAGS_use_flash_attention": False})
+        try:
+            without = scaled_dot_product_attention(q, k, v, is_causal=True)
+        finally:
+            paddle.set_flags({"FLAGS_use_flash_attention": True})
+        np.testing.assert_allclose(np.asarray(with_flash),
+                                   np.asarray(without), rtol=1e-4, atol=1e-5)
+
+
+class TestGeneration:
+    @pytest.fixture
+    def model(self):
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=4, max_seq_len=64, dropout=0.0)
+        m = GPT(cfg)
+        m.eval()
+        return m
+
+    def test_greedy_matches_full_forward(self, model):
+        gen = GPTGenerator(model)
+        ids = paddle.to_tensor(np.array([[1, 2, 3, 4]]))
+        out = gen.generate(ids, max_new_tokens=6, temperature=0.0)
+        assert out.shape == [1, 10]
+        # every generated token must equal the argmax of the full forward
+        toks = out.numpy()[0]
+        for i in range(4, 10):
+            logits = model(paddle.to_tensor(toks[None, :i]))
+            assert int(logits.numpy()[0, -1].argmax()) == int(toks[i]), i
+
+    def test_batched_sampled_generation(self, model):
+        gen = GPTGenerator(model)
+        ids = paddle.to_tensor(rng.integers(0, 64, (3, 5)))
+        out = gen.generate(ids, max_new_tokens=4, temperature=0.8, top_k=10,
+                           seed=1)
+        assert out.shape == [3, 9]
+        assert (out.numpy() >= 0).all() and (out.numpy() < 64).all()
+
+    def test_top_p_sampling(self, model):
+        gen = GPTGenerator(model)
+        ids = paddle.to_tensor(np.array([[1, 2]]))
+        out = gen.generate(ids, max_new_tokens=3, temperature=1.0, top_p=0.9,
+                           seed=7)
+        assert out.shape == [1, 5]
+
+
+class TestInferencePredictor:
+    def test_save_then_serve(self, tmp_path):
+        from paddle_tpu import inference, static
+
+        paddle.seed(4)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        net.eval()
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 8], "float32")
+            y = paddle.nn.functional.softmax(net(x))
+        exe = static.Executor()
+        prefix = str(tmp_path / "served")
+        static.save_inference_model(prefix, [x], [y], exe, program=main)
+
+        config = inference.Config(prefix)
+        predictor = inference.create_predictor(config)
+        assert predictor.get_input_names() == ["x"]
+
+        xs = rng.standard_normal((2, 8)).astype(np.float32)
+        inp = predictor.get_input_handle("x")
+        inp.copy_from_cpu(xs)
+        predictor.run()
+        out = predictor.get_output_handle(
+            predictor.get_output_names()[0]).copy_to_cpu()
+        ref = net(paddle.to_tensor(xs))
+        ref = paddle.nn.functional.softmax(ref).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_low_precision_serving(self, tmp_path):
+        from paddle_tpu import inference, static
+
+        net = nn.Linear(4, 2)
+        net.eval()
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4], "float32")
+            y = net(x)
+        exe = static.Executor()
+        prefix = str(tmp_path / "bf16")
+        static.save_inference_model(prefix, [x], [y], exe, program=main)
+
+        config = inference.Config(prefix)
+        config.enable_low_precision("bfloat16")
+        predictor = inference.create_predictor(config)
+        xs = rng.standard_normal((2, 4)).astype(np.float32)
+        outs = predictor.run([paddle.to_tensor(xs)])
+        ref = net(paddle.to_tensor(xs)).numpy()
+        np.testing.assert_allclose(np.asarray(outs[0]._value, np.float32),
+                                   ref, rtol=3e-2, atol=3e-2)
