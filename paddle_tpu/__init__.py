@@ -290,7 +290,10 @@ from paddle_tpu import distribution  # noqa: E402,F401
 from paddle_tpu import inference  # noqa: E402,F401
 from paddle_tpu import metric  # noqa: E402,F401
 from paddle_tpu import profiler  # noqa: E402,F401
+from paddle_tpu import geometric  # noqa: E402,F401
+from paddle_tpu import regularizer  # noqa: E402,F401
 from paddle_tpu import signal  # noqa: E402,F401
+from paddle_tpu import sparse  # noqa: E402,F401
 from paddle_tpu.tensor import fft, linalg  # noqa: E402,F401
 from paddle_tpu import static  # noqa: E402,F401
 from paddle_tpu import vision  # noqa: E402,F401

@@ -42,6 +42,7 @@ enum Op : uint8_t {
   kWait = 4,
   kCheck = 5,
   kDelete = 6,
+  kTryGet = 7,  // non-blocking: u8 present-flag + value
 };
 
 struct Store {
@@ -159,6 +160,19 @@ struct Server {
             store.data.erase(key);
           }
           if (!send_value(fd, "")) return;
+          break;
+        }
+        case kTryGet: {
+          std::string out(1, '\0');
+          {
+            std::lock_guard<std::mutex> lk(store.mu);
+            auto it = store.data.find(key);
+            if (it != store.data.end()) {
+              out[0] = 1;
+              out += it->second;
+            }
+          }
+          if (!send_value(fd, out)) return;
           break;
         }
         default:

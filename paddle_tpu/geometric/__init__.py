@@ -1,0 +1,100 @@
+"""paddle.geometric — graph message passing.
+
+Reference: python/paddle/geometric/ (send_u_recv/send_ue_recv over
+graph_send_recv kernels, segment ops).
+
+TPU-native: segment reductions via jax.ops.segment_* — XLA lowers to sorted
+scatter-adds which tile well; no custom kernels needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.registry import OPS, OpDef, make_op_function
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None):
+    n = out_size if out_size is not None else x.shape[0]
+    msgs = jnp.take(x, src_index, axis=0)
+    if reduce_op == "sum":
+        return jax.ops.segment_sum(msgs, dst_index, num_segments=n)
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(msgs, dst_index, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(dst_index, x.dtype),
+                                  dst_index, num_segments=n)
+        return s / jnp.maximum(cnt, 1)[:, None]
+    if reduce_op == "max":
+        return jax.ops.segment_max(msgs, dst_index, num_segments=n)
+    if reduce_op == "min":
+        return jax.ops.segment_min(msgs, dst_index, num_segments=n)
+    raise ValueError(reduce_op)
+
+
+def _send_ue_recv(x, e, src_index, dst_index, message_op="add",
+                  reduce_op="sum", out_size=None):
+    msgs = jnp.take(x, src_index, axis=0)
+    if message_op == "add":
+        msgs = msgs + e
+    elif message_op == "mul":
+        msgs = msgs * e
+    n = out_size if out_size is not None else x.shape[0]
+    if reduce_op == "sum":
+        return jax.ops.segment_sum(msgs, dst_index, num_segments=n)
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(msgs, dst_index, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(dst_index, x.dtype),
+                                  dst_index, num_segments=n)
+        return s / jnp.maximum(cnt, 1)[:, None]
+    if reduce_op == "max":
+        return jax.ops.segment_max(msgs, dst_index, num_segments=n)
+    raise ValueError(reduce_op)
+
+
+def _segment_sum(x, segment_ids, num_segments=None):
+    n = num_segments if num_segments is not None else int(segment_ids.max()) + 1
+    return jax.ops.segment_sum(x, segment_ids, num_segments=n)
+
+
+def _segment_mean(x, segment_ids, num_segments=None):
+    n = num_segments if num_segments is not None else int(segment_ids.max()) + 1
+    s = jax.ops.segment_sum(x, segment_ids, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones(x.shape[0], x.dtype), segment_ids,
+                              num_segments=n)
+    shape = (-1,) + (1,) * (x.ndim - 1)
+    return s / jnp.maximum(cnt, 1).reshape(shape)
+
+
+def _segment_max(x, segment_ids, num_segments=None):
+    n = num_segments if num_segments is not None else int(segment_ids.max()) + 1
+    return jax.ops.segment_max(x, segment_ids, num_segments=n)
+
+
+def _segment_min(x, segment_ids, num_segments=None):
+    n = num_segments if num_segments is not None else int(segment_ids.max()) + 1
+    return jax.ops.segment_min(x, segment_ids, num_segments=n)
+
+
+for _name, _fn in (("send_u_recv", _send_u_recv),
+                   ("send_ue_recv", _send_ue_recv),
+                   ("segment_sum", _segment_sum),
+                   ("segment_mean", _segment_mean),
+                   ("segment_max", _segment_max),
+                   ("segment_min", _segment_min)):
+        # dynamic=True: default num_segments derives from concrete index values
+    # (pass num_segments/out_size explicitly inside jit-traced code)
+    OPS.setdefault(f"geo_{_name}", OpDef(f"geo_{_name}", _fn, diff=True,
+                                         dynamic=True, method=False))
+
+send_u_recv = make_op_function("geo_send_u_recv")
+send_ue_recv = make_op_function("geo_send_ue_recv")
+segment_sum = make_op_function("geo_segment_sum")
+segment_mean = make_op_function("geo_segment_mean")
+segment_max = make_op_function("geo_segment_max")
+segment_min = make_op_function("geo_segment_min")

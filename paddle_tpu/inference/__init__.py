@@ -22,36 +22,41 @@ from paddle_tpu.core.tensor import Tensor
 
 
 class Config:
-    """Reference: paddle_infer.Config (analysis_config.cc)."""
+    """Reference: paddle_infer.Config (analysis_config.cc).
+
+    Single-backend stack: device selection, IR-optimization and
+    memory-optimization switches are API-compatible no-ops (XLA always
+    optimizes; placement follows the process device). The one live knob is
+    enable_low_precision (bf16 weight cast, the TRT-fp16 analogue).
+    `params_path` is accepted for signature parity — this format stores
+    weights inside the .pdmodel payload, so it is unused."""
 
     def __init__(self, model_path: Optional[str] = None,
                  params_path: Optional[str] = None):
         self.model_path = model_path
-        self._device = None
-        self._memory_optim = True
         self._amp_dtype = None
 
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
-        pass  # no GPU in this stack
+        pass
 
     def enable_tpu(self, device_id: int = 0):
-        self._device = ("tpu", device_id)
+        pass
 
     def disable_gpu(self):
-        self._device = ("cpu", 0)
+        pass
 
     def set_cpu_math_library_num_threads(self, n):
         pass
 
     def enable_memory_optim(self, flag=True):
-        self._memory_optim = flag
+        pass
 
     def enable_low_precision(self, dtype="bfloat16"):
         """TPU analogue of enable_use_gpu+TRT fp16: cast weights to bf16."""
         self._amp_dtype = dtype
 
     def switch_ir_optim(self, flag=True):
-        pass  # XLA always optimizes
+        pass
 
     def model_dir(self):
         return self.model_path

@@ -84,7 +84,10 @@ def _forward_with_cache(params, cfg: GPTConfig, tokens, caches, pos):
         x = x + _mlp(p, h)
         new_caches.append((kc, vc))
     x = _layer_norm(x, params["ln_f.weight"], params["ln_f.bias"])
-    logits = jnp.einsum("bth,vh->btv", x, params["wte.weight"])
+    if "lm_head.weight" in params:  # untied head (tie_embeddings=False)
+        logits = jnp.einsum("bth,hv->btv", x, params["lm_head.weight"])
+    else:
+        logits = jnp.einsum("bth,vh->btv", x, params["wte.weight"])
     return logits, new_caches
 
 
@@ -119,6 +122,10 @@ class GPTGenerator:
         self.cfg = model.cfg
         assert not self.cfg.tensor_parallel, \
             "GPTGenerator currently supports the single-chip/dense config"
+        assert self.cfg.moe_every == 0, \
+            "GPTGenerator does not support MoE blocks yet"
+        assert not self.cfg.sequence_parallel, \
+            "GPTGenerator does not support sequence-parallel configs"
         self.max_len = max_len or self.cfg.max_seq_len
         self.func = functionalize(model)
         self.params = self.func.param_values()
@@ -164,6 +171,9 @@ class GPTGenerator:
         key = (jax.random.key(seed) if seed is not None
                else default_generator.next_key())
         tok = _sample(last_logits, key, temperature, top_k, top_p)
+        finished = jnp.zeros((b,), bool)
+        if eos_token_id is not None:
+            finished = tok == eos_token_id
         outs = [tok]
         pos = t
         for i in range(max_new_tokens - 1):
@@ -172,9 +182,14 @@ class GPTGenerator:
                                        jnp.asarray(pos, jnp.int32), key,
                                        temperature=temperature, top_k=top_k,
                                        top_p=top_p)
+            if eos_token_id is not None:
+                # rows already finished keep emitting eos (pad), like the
+                # reference/HF contract
+                tok = jnp.where(finished, eos_token_id, tok)
+                finished = finished | (tok == eos_token_id)
             outs.append(tok)
             pos += 1
-            if eos_token_id is not None and bool((tok == eos_token_id).all()):
+            if eos_token_id is not None and bool(finished.all()):
                 break
         gen = jnp.stack(outs, axis=1)
         return Tensor._wrap(jnp.concatenate([ids, gen], axis=1))

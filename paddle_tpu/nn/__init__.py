@@ -18,6 +18,7 @@ from paddle_tpu.nn.loss import (  # noqa: F401
     BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, KLDivLoss, L1Loss, MSELoss,
     NLLLoss, SmoothL1Loss,
 )
+from paddle_tpu.nn.rnn import GRU, LSTM, SimpleRNN  # noqa: F401
 from paddle_tpu.nn.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerDecoder, TransformerDecoderLayer,
     TransformerEncoder, TransformerEncoderLayer,

@@ -1,0 +1,241 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU.
+
+Reference: python/paddle/nn/layer/rnn.py (RNNBase, LSTM:1284, GRU, cells) —
+backed by cudnn kernels on GPU.
+
+TPU-native: the time loop is ONE lax.scan per layer/direction, so the whole
+recurrence compiles into a single fused XLA while-loop with the gate matmuls
+on the MXU (no per-step dispatch). Layout: batch_first=False default like
+paddle ([seq, batch, input]) with time_major switch.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.ops.registry import OPS, OpDef, dispatch
+
+
+def _rnn_scan(cell_fn, x, init_states, w_ih, w_hh, b_ih, b_hh, reverse=False,
+              seq_lens=None):
+    """x: [T, B, I]; returns (out [T, B, H], final_states).
+
+    With seq_lens [B], padded steps (t >= len) hold the carry and emit zero
+    output (reference RNN sequence_length semantics); the reverse direction
+    reverses only the valid segment of each sequence."""
+    T = x.shape[0]
+    if seq_lens is not None and reverse:
+        # per-sequence reversal of the valid prefix: index len-1-t (clamped)
+        t_idx = jnp.arange(T)[:, None]                     # [T, 1]
+        src = jnp.clip(seq_lens[None, :] - 1 - t_idx, 0, T - 1)  # [T, B]
+        x = jnp.take_along_axis(x, src[:, :, None], axis=0)
+    elif reverse:
+        x = jnp.flip(x, axis=0)
+
+    def step(carry, inp):
+        xt, t = inp
+        new_carry, out = cell_fn(carry, xt, w_ih, w_hh, b_ih, b_hh)
+        if seq_lens is not None:
+            valid = (t < seq_lens)[:, None]  # [B, 1]
+            new_carry = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(valid, new, old), new_carry, carry)
+            out = jnp.where(valid, out, 0.0)
+        return new_carry, out
+
+    ts = jnp.arange(T)
+    final, outs = jax.lax.scan(step, init_states, (x, ts))
+    if reverse and seq_lens is not None:
+        t_idx = jnp.arange(T)[:, None]
+        src = jnp.clip(seq_lens[None, :] - 1 - t_idx, 0, T - 1)
+        valid = t_idx < seq_lens[None, :]
+        outs = jnp.where(valid[:, :, None],
+                         jnp.take_along_axis(outs, src[:, :, None], axis=0),
+                         0.0)
+    elif reverse:
+        outs = jnp.flip(outs, axis=0)
+    return outs, final
+
+
+def _lstm_cell(carry, xt, w_ih, w_hh, b_ih, b_hh):
+    h, c = carry
+    gates = xt @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    new_c = f * c + i * g
+    new_h = o * jnp.tanh(new_c)
+    return (new_h, new_c), new_h
+
+
+def _gru_cell(carry, xt, w_ih, w_hh, b_ih, b_hh):
+    h = carry
+    gi = xt @ w_ih.T + b_ih
+    gh = h @ w_hh.T + b_hh
+    ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+    hr, hz, hn = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    n = jnp.tanh(in_ + r * hn)
+    new_h = (1 - z) * n + z * h
+    return new_h, new_h
+
+
+def _simple_cell(carry, xt, w_ih, w_hh, b_ih, b_hh):
+    h = carry
+    new_h = jnp.tanh(xt @ w_ih.T + h @ w_hh.T + b_ih + b_hh)
+    return new_h, new_h
+
+
+_CELLS = {"LSTM": (_lstm_cell, 4), "GRU": (_gru_cell, 3),
+          "RNN_TANH": (_simple_cell, 1)}
+
+
+def _multi_layer_rnn(mode, x, states, weights, num_layers, bidirect,
+                     time_major, seq_lens=None, dropout=0.0, dropout_key=None):
+    """Pure impl registered as an op (so it jits/records like any other).
+
+    weights: flat tuple layer-major: per (layer, direction):
+    (w_ih, w_hh, b_ih, b_hh)."""
+    cell_fn, _ = _CELLS[mode]
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)  # -> [T, B, I]
+    ndir = 2 if bidirect else 1
+    finals = []
+    out = x
+    for layer in range(num_layers):
+        outs_dir = []
+        for d in range(ndir):
+            idx = (layer * ndir + d) * 4
+            w_ih, w_hh, b_ih, b_hh = weights[idx:idx + 4]
+            if mode == "LSTM":
+                h0 = states[0][layer * ndir + d]
+                c0 = states[1][layer * ndir + d]
+                init = (h0, c0)
+            else:
+                init = states[0][layer * ndir + d]
+            o, fin = _rnn_scan(cell_fn, out, init, w_ih, w_hh, b_ih, b_hh,
+                               reverse=(d == 1), seq_lens=seq_lens)
+            outs_dir.append(o)
+            finals.append(fin)
+        out = jnp.concatenate(outs_dir, axis=-1) if ndir == 2 else outs_dir[0]
+        if dropout > 0.0 and dropout_key is not None and layer < num_layers - 1:
+            # inter-layer dropout (reference RNNBase dropout semantics)
+            key = jax.random.fold_in(dropout_key, layer)
+            mask = jax.random.bernoulli(key, 1.0 - dropout, out.shape)
+            out = jnp.where(mask, out / (1.0 - dropout), 0.0).astype(out.dtype)
+    if not time_major:
+        out = jnp.swapaxes(out, 0, 1)
+    if mode == "LSTM":
+        h_n = jnp.stack([f[0] for f in finals])
+        c_n = jnp.stack([f[1] for f in finals])
+        return out, h_n, c_n
+    h_n = jnp.stack(finals)
+    return out, h_n
+
+
+class _RNNBase(Layer):
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        self.time_major = time_major
+        self.dropout = float(dropout)
+        _, gate_mult = _CELLS[self.MODE]
+        ndir = 2 if self.bidirect else 1
+        std = 1.0 / math.sqrt(hidden_size)
+        self._weight_names = []
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * ndir
+            for d in range(ndir):
+                sfx = f"l{layer}" + ("_reverse" if d else "")
+                for name, shape in (
+                        (f"weight_ih_{sfx}", [gate_mult * hidden_size, in_sz]),
+                        (f"weight_hh_{sfx}", [gate_mult * hidden_size, hidden_size]),
+                        (f"bias_ih_{sfx}", [gate_mult * hidden_size]),
+                        (f"bias_hh_{sfx}", [gate_mult * hidden_size])):
+                    p = self.create_parameter(
+                        shape, default_initializer=I.Uniform(-std, std))
+                    self.add_parameter(name, p)
+                    self._weight_names.append(name)
+
+    def _zero_states(self, batch):
+        ndir = 2 if self.bidirect else 1
+        n = self.num_layers * ndir
+        shape = (n, batch, self.hidden_size)
+        h = Tensor._wrap(jnp.zeros(shape, jnp.float32))
+        if self.MODE == "LSTM":
+            return h, Tensor._wrap(jnp.zeros(shape, jnp.float32))
+        return (h,)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        batch = inputs.shape[0] if not self.time_major else inputs.shape[1]
+        if initial_states is None:
+            states = self._zero_states(batch)
+        elif isinstance(initial_states, (tuple, list)):
+            states = tuple(initial_states)
+        else:
+            states = (initial_states,)
+        weights = tuple(self._parameters[n] for n in self._weight_names)
+        attrs = {"num_layers": self.num_layers,
+                 "bidirect": self.bidirect,
+                 "time_major": self.time_major}
+        args = [inputs, tuple(states), weights]
+        if sequence_length is not None:
+            sl = sequence_length if isinstance(sequence_length, Tensor) \
+                else Tensor._wrap(jnp.asarray(sequence_length))
+            attrs["seq_lens"] = sl
+        if self.dropout > 0.0 and self.training:
+            from paddle_tpu.core.random import default_generator
+
+            attrs["dropout"] = self.dropout
+            attrs["dropout_key"] = Tensor._wrap(default_generator.next_key())
+        out = dispatch(f"_rnn_{self.MODE}", tuple(args), attrs)
+        if self.MODE == "LSTM":
+            y, h, c = out
+            return y, (h, c)
+        y, h = out
+        return y, h
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+
+
+# register the pure impls as ops
+for _mode in _CELLS:
+    def _make(mode):
+        def f(x, states, weights, num_layers=1, bidirect=False,
+              time_major=False, seq_lens=None, dropout=0.0,
+              dropout_key=None):
+            return _multi_layer_rnn(mode, x, states, weights, num_layers,
+                                    bidirect, time_major, seq_lens=seq_lens,
+                                    dropout=dropout, dropout_key=dropout_key)
+
+        return f
+
+    OPS[f"_rnn_{_mode}"] = OpDef(f"_rnn_{_mode}", _make(_mode), diff=True,
+                                 method=False)

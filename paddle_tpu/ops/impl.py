@@ -1249,6 +1249,13 @@ def _reduce_loss(loss, reduction):
 # ============================================================ attention
 
 
+def _flash_enabled() -> bool:
+    """Flash dispatch gate (separate function so tests can patch it)."""
+    from paddle_tpu.utils.flags import flag
+
+    return flag("FLAGS_use_flash_attention") and jax.default_backend() == "tpu"
+
+
 def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
                                  is_causal=False, scale=None):
     """Reference: paddle.nn.functional.scaled_dot_product_attention /
@@ -1262,16 +1269,10 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     b, sq, h, d = q.shape
     scale = scale if scale is not None else (1.0 / math.sqrt(d))
 
-    import jax as _jax
-
-    from paddle_tpu.utils.flags import flag
-
     # flags are part of the per-op jit cache key (registry flags_version),
     # so this read is re-evaluated after any set_flags. TPU-only: on other
     # backends the interpret-mode kernel would be slower than the XLA path.
-    if (attn_mask is None and dropout_p == 0.0
-            and flag("FLAGS_use_flash_attention")
-            and _jax.default_backend() == "tpu"):
+    if (attn_mask is None and dropout_p == 0.0 and _flash_enabled()):
         from paddle_tpu.ops.pallas.flash_attention import (
             _block_shapes_ok, flash_attention)
 

@@ -3,15 +3,20 @@
 Reference: the reference wraps the CUDA flashattn library
 (paddle/phi/kernels/gpu/flash_attn_kernel.cu over third_party/flashattn,
 exposed via nn/functional/flash_attention.py:358). On TPU the kernel is
-written in Pallas: blocks of Q stream against K/V tiles held in VMEM with an
-online-softmax accumulator in fp32 — the attention matrix never exists in
-HBM. MXU does the two matmuls per tile; the VPU does the softmax algebra.
+written in Pallas: grid (batch*head, q_blocks, k_blocks) with the K axis
+innermost, VMEM scratch accumulators (running max / denom / output) carried
+across K tiles, fp32 online softmax — only one (block_q, d) Q tile and one
+(block_k, d) K/V tile are VMEM-resident per step, so memory is independent
+of sequence length and the attention matrix never exists in HBM. MXU does
+the two matmuls per tile; the VPU does the softmax algebra.
 
 Forward is the Pallas kernel; backward uses jax.custom_vjp with a
 rematerialized reference backward (block-sparse flash backward is a follow-up
 — forward is where serving/inference lives).
 
 Layout: [batch, seq, heads, head_dim] (paddle flash-attn convention).
+Causal masking is bottom-right aligned (tril k=sk-sq), matching the XLA
+reference path for cross-length (KV-decode) shapes.
 """
 
 from __future__ import annotations
@@ -23,72 +28,65 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-try:  # TPU-specific memory spaces; absent meanings fall back to defaults
+try:  # TPU-specific memory spaces (absent on pure-CPU builds)
     from jax.experimental.pallas import tpu as pltpu
-
-    _VMEM = pltpu.VMEM
 except Exception:  # pragma: no cover
     pltpu = None
-    _VMEM = None
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
-                      causal: bool, scale: float, seq_k: int, seq_q: int):
-    """One (batch*head, q_block) program: stream K/V tiles, online softmax.
-
-    q_ref: [1, block_q, d]; k_ref/v_ref: [1, seq_k, d]; o_ref: [1, block_q, d]
-    (leading unit dim = the batch*head grid axis).
-    """
-    _, block_q, d = q_ref.shape
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                      block_q: int, block_k: int, causal: bool, scale: float,
+                      seq_k: int, seq_q: int):
+    """One grid step: fold one K/V tile into this Q block's accumulators."""
+    d = q_ref.shape[-1]
     qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_kb = pl.num_programs(2)
 
-    q = q_ref[0].astype(jnp.float32) * scale
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+        l_ref[:] = jnp.zeros((block_q, 1), jnp.float32)
+        acc_ref[:] = jnp.zeros((block_q, d), jnp.float32)
 
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-
-    # bottom-right-aligned causal mask (matches the XLA path's
-    # tril(k=sk-sq)): query i attends keys <= i + (seq_k - seq_q)
+    # bottom-right-aligned causal offset: query i sees keys <= i + (sk - sq)
     causal_offset = seq_k - seq_q
-    q_pos = causal_offset + qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
+    q_start = causal_offset + qi * block_q
+    live = (ki * block_k <= q_start + block_q - 1) if causal else True
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k_tile = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_tile = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(live)
+    def _tile():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k_tile = k_ref[0].astype(jnp.float32)
+        v_tile = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_tile, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bq, bk]
         if causal:
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m = m_ref[:]
         blk_max = jnp.max(s, axis=-1, keepdims=True)
         new_m = jnp.maximum(m, blk_max)
         p = jnp.exp(s - new_m)
         corr = jnp.exp(m - new_m)
-        new_l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        new_acc = acc * corr + jax.lax.dot_general(
+        m_ref[:] = new_m
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
             p, v_tile, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return new_m, new_l, new_acc
 
-    num_kb = seq_k // block_k
-    if causal:
-        # only tiles that intersect the causal region for this q block
-        num_kb_live = jnp.minimum(
-            causal_offset + (qi + 1) * block_q + block_k - 1, seq_k) // block_k
-        m, l, acc = jax.lax.fori_loop(0, num_kb_live, body, (m0, l0, acc0))
-    else:
-        m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
-
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    @pl.when(ki == n_kb - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+                    ).astype(o_ref.dtype)
 
 
 def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
@@ -101,24 +99,37 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
     kf = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
     vf = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
 
-    grid = (b * h, sq // block_q)
+    grid = (b * h, sq // block_q, sk // block_k)
     kernel = functools.partial(
-        _flash_fwd_kernel, block_k=block_k, causal=causal, scale=scale,
-        seq_k=sk, seq_q=sq)
+        _flash_fwd_kernel, block_q=block_q, block_k=block_k, causal=causal,
+        scale=scale, seq_k=sk, seq_q=sq)
 
+    scratch = [
+        _scratch((block_q, 1)),
+        _scratch((block_q, 1)),
+        _scratch((block_q, d)),
+    ]
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qi, ki: (bh, qi, 0)),
+        scratch_shapes=scratch,
         interpret=interpret,
     )(qf, kf, vf)
     return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)
+
+
+def _scratch(shape):
+    if pltpu is not None:
+        return pltpu.VMEM(shape, jnp.float32)
+    return pl.pallas_call  # unreachable on CPU (interpret handles VMEM spec)
 
 
 def _reference(q, k, v, causal, scale):
@@ -176,6 +187,6 @@ def flash_attention(q, k, v, causal: bool = True, scale=None,
         interpret = jax.default_backend() != "tpu"
     block_q = min(block_q, q.shape[1])
     block_k = min(block_k, k.shape[1])
-    if not _block_shapes_ok(q, k, block_q, block_k):
+    if not _block_shapes_ok(q, k, block_q, block_k, v=v):
         return _reference(q, k, v, causal, scale)
     return _flash(q, k, v, causal, scale, block_q, block_k, interpret)

@@ -38,3 +38,6 @@ from paddle_tpu.parallel.ring_attention import RingAttention, ring_attention  # 
 from paddle_tpu.parallel.store import TCPStore, create_or_get_global_tcp_store  # noqa: F401,E402
 from paddle_tpu.parallel import checkpoint  # noqa: F401,E402
 from paddle_tpu.parallel.checkpoint import load_state_dict, save_state_dict  # noqa: F401,E402
+from paddle_tpu.parallel.auto_tuner import AutoTuner, candidate_configs  # noqa: F401,E402
+from paddle_tpu.parallel.elastic import ElasticManager, Watchdog  # noqa: F401,E402
+from paddle_tpu.parallel import launch as launch_module  # noqa: F401,E402

@@ -1,0 +1,97 @@
+"""Auto-tuner: search over parallel configurations.
+
+Reference: python/paddle/distributed/auto_tuner/ (grid/heuristic search over
+dp/mp/pp/sharding/micro-batch configs, launches trials, collects ips/mem —
+utils.py:476).
+
+TPU-native: a trial = build mesh + compiled TrainStep + timed steps in-proc
+(no subprocess relaunch needed — meshes are cheap to rebuild), pruned by
+divisibility heuristics. Returns configs ranked by throughput.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+
+@dataclass
+class TrialResult:
+    config: Dict[str, int]
+    ips: float = 0.0          # items/sec
+    step_ms: float = 0.0
+    error: Optional[str] = None
+
+    @property
+    def ok(self):
+        return self.error is None
+
+
+def candidate_configs(n_devices: int, axes=("dp", "tp", "pp"),
+                      max_degree: Optional[int] = None) -> List[Dict[str, int]]:
+    """All factorizations of n_devices over the axes (reference: the tuner's
+    prune_by_* heuristics collapse to divisibility here)."""
+    md = max_degree or n_devices
+    degrees = [d for d in range(1, n_devices + 1) if n_devices % d == 0
+               and d <= md]
+    out = []
+    for combo in itertools.product(degrees, repeat=len(axes)):
+        if int(np.prod(combo)) == n_devices:
+            out.append(dict(zip(axes, combo)))
+    return out
+
+
+class AutoTuner:
+    """tuner = AutoTuner(build_trial); best = tuner.tune(n_devices)
+
+    build_trial(config) -> (step_fn, batch) where step_fn(batch) runs one
+    training step (compiled); the tuner times it."""
+
+    def __init__(self, build_trial: Callable, warmup: int = 2, iters: int = 5,
+                 items_per_step: int = 1):
+        self.build_trial = build_trial
+        self.warmup = warmup
+        self.iters = iters
+        self.items_per_step = items_per_step
+        self.results: List[TrialResult] = []
+
+    def run_trial(self, config: Dict[str, int]) -> TrialResult:
+        try:
+            step_fn, batch = self.build_trial(config)
+            for _ in range(self.warmup):
+                out = step_fn(batch)
+            jax.block_until_ready(getattr(out, "_value", out))
+            t0 = time.perf_counter()
+            for _ in range(self.iters):
+                out = step_fn(batch)
+            jax.block_until_ready(getattr(out, "_value", out))
+            dt = (time.perf_counter() - t0) / self.iters
+            return TrialResult(config, ips=self.items_per_step / dt,
+                               step_ms=dt * 1e3)
+        except Exception as e:  # noqa: BLE001
+            return TrialResult(config, error=f"{type(e).__name__}: {e}")
+
+    def tune(self, n_devices: Optional[int] = None, axes=("dp", "tp"),
+             configs: Optional[List[Dict[str, int]]] = None) -> TrialResult:
+        if configs is None:
+            n = n_devices or len(jax.devices())
+            configs = candidate_configs(n, axes=axes)
+        self.results = [self.run_trial(c) for c in configs]
+        ok = [r for r in self.results if r.ok]
+        if not ok:
+            raise RuntimeError(
+                "all trials failed: "
+                + "; ".join(f"{r.config}: {r.error}" for r in self.results))
+        return max(ok, key=lambda r: r.ips)
+
+    def summary(self) -> str:
+        lines = [f"{'config':<30}{'step_ms':>10}{'ips':>12}  error"]
+        for r in sorted(self.results, key=lambda r: -r.ips):
+            lines.append(f"{str(r.config):<30}{r.step_ms:>10.2f}"
+                         f"{r.ips:>12.1f}  {r.error or ''}")
+        return "\n".join(lines)

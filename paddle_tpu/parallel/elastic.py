@@ -1,0 +1,146 @@
+"""Elastic training manager + failure detection.
+
+Reference: ElasticManager (python/paddle/distributed/fleet/elastic/
+manager.py:125) — etcd node registry with leases/heartbeats (:248-253),
+membership watch, scale in/out, local-trainer restart; comm watchdog
+CommTaskManager (phi/core/distributed/comm_task_manager.h:37, 30-min
+collective timeout).
+
+TPU-native: the registry runs over the native TCPStore (no etcd dependency)
+with heartbeat keys + TTL sweeping by the master; the watchdog wraps
+device-step completion (block_until_ready deadline) since XLA collectives
+surface hangs as never-completing executions.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from paddle_tpu.parallel.store import TCPStore
+
+
+class ElasticManager:
+    """Membership + heartbeat over the TCPStore.
+
+    Master sweeps heartbeats; a node missing `ttl` seconds is dropped and
+    `on_membership_change` fires (the hook that triggers re-scaling /
+    restart in the reference)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 rank: int = 0, is_master: Optional[bool] = None,
+                 heartbeat_interval: float = 1.0, ttl: float = 5.0):
+        self.rank = rank
+        self.is_master = (rank == 0) if is_master is None else is_master
+        self.store = TCPStore(host, port, is_master=self.is_master)
+        self.port = self.store.port
+        self.heartbeat_interval = heartbeat_interval
+        self.ttl = ttl
+        self._stop = threading.Event()
+        self._members: List[int] = []
+        self.on_membership_change: Optional[Callable[[List[int]], None]] = None
+        self._threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------------ lifecycle
+
+    def register(self):
+        """Join: heartbeat loop + (master) sweeper loop."""
+        self.store.set(f"node/{self.rank}", str(time.time()))
+        n = self.store.add("membership_version", 1)
+        t = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        if self.is_master:
+            t2 = threading.Thread(target=self._sweep_loop, daemon=True)
+            t2.start()
+            self._threads.append(t2)
+        return n
+
+    def exit(self):
+        self._stop.set()
+        try:
+            self.store.delete_key(f"node/{self.rank}")
+            self.store.add("membership_version", 1)
+        except Exception:
+            pass
+
+    def _heartbeat_loop(self):
+        while not self._stop.is_set():
+            try:
+                self.store.set(f"node/{self.rank}", str(time.time()))
+            except Exception:
+                return
+            self._stop.wait(self.heartbeat_interval)
+
+    def _sweep_loop(self):
+        while not self._stop.is_set():
+            members = self.current_members()
+            now = time.time()
+            changed = False
+            for r in members:
+                raw = self.store.try_get(f"node/{r}")  # non-blocking: a key
+                if raw is None:                        # deleted mid-sweep
+                    continue
+                try:
+                    ts = float(raw.decode())
+                except Exception:
+                    continue
+                if now - ts > self.ttl:
+                    self.store.delete_key(f"node/{r}")
+                    changed = True
+            members = self.current_members()
+            if members != self._members:
+                self._members = members
+                if self.on_membership_change is not None:
+                    self.on_membership_change(members)
+            if changed:
+                self.store.add("membership_version", 1)
+            self._stop.wait(self.heartbeat_interval)
+
+    # ------------------------------------------------------------ queries
+
+    def current_members(self, max_rank: int = 64) -> List[int]:
+        return [r for r in range(max_rank)
+                if self.store.check(f"node/{r}")]
+
+    def membership_version(self) -> int:
+        return self.store.add("membership_version", 0)
+
+
+class Watchdog:
+    """Hung-step detector (reference CommTaskManager: timeout on outstanding
+    collectives). Wraps any callable; if it doesn't finish within `timeout`
+    the on_timeout hook fires (default: raise in the caller thread)."""
+
+    def __init__(self, timeout: float = 1800.0,
+                 on_timeout: Optional[Callable[[str], None]] = None):
+        self.timeout = timeout
+        self.on_timeout = on_timeout
+        self.timed_out: List[str] = []
+
+    def run(self, fn: Callable, desc: str = "step"):
+        done = threading.Event()
+        result = {}
+
+        def target():
+            try:
+                result["value"] = fn()
+            except BaseException as e:  # noqa: BLE001
+                result["error"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=target, daemon=True)
+        t.start()
+        if not done.wait(self.timeout):
+            self.timed_out.append(desc)
+            if self.on_timeout is not None:
+                self.on_timeout(desc)
+                return None
+            raise TimeoutError(
+                f"{desc} exceeded watchdog timeout {self.timeout}s "
+                "(hung collective / device stall?)")
+        if "error" in result:
+            raise result["error"]
+        return result.get("value")

@@ -1,0 +1,124 @@
+"""Distributed launcher.
+
+Reference: python -m paddle.distributed.launch (launch/main.py:23) —
+controllers spawn per-rank processes with the PADDLE_TRAINER_* env contract
+(launch/controllers/collective.py:133-139), rendezvous via HTTP KVServer /
+etcd (controllers/master.py:73/186).
+
+TPU-native: ONE process per host (PJRT drives all local chips), so the
+launcher's job is the multi-host env contract: PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM / MASTER_ADDR:PORT consumed by
+parallel.env.init_parallel_env -> jax.distributed.initialize. Rendezvous
+uses the native TCPStore (parallel/store.py). For single-host simulation
+(tests), --nproc_per_node spawns N processes that rendezvous locally.
+
+Usage: python -m paddle_tpu.parallel.launch --nnodes 1 --nproc_per_node 2 \
+           train.py [args...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List
+
+
+def build_env(rank: int, world: int, master_addr: str, master_port: int,
+              base_env=None) -> dict:
+    env = dict(base_env if base_env is not None else os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_CURRENT_ENDPOINT": f"{master_addr}:{master_port + rank}",
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(
+            f"{master_addr}:{master_port + r}" for r in range(world)),
+        "MASTER_ADDR": master_addr,
+        "MASTER_PORT": str(master_port),
+    })
+    return env
+
+
+class LauncherInterface:
+    """Process supervision (reference: fleet/elastic/manager.py
+    LauncherInterface:57 — kill/rerun local trainers)."""
+
+    def __init__(self, procs: List[subprocess.Popen]):
+        self.procs = procs
+
+    def watch(self, poll_interval: float = 1.0) -> int:
+        """Wait for all ranks; on any failure, kill the rest (the reference
+        launcher's all-or-nothing semantics). Returns exit code."""
+        while True:
+            alive = False
+            for p in self.procs:
+                ret = p.poll()
+                if ret is None:
+                    alive = True
+                elif ret != 0:
+                    self.stop()
+                    return ret
+            if not alive:
+                return 0
+            time.sleep(poll_interval)
+
+    def stop(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 10
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(deadline - time.time(), 0.1))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def launch(script: str, script_args: List[str], nnodes: int = 1,
+           node_rank: int = 0, nproc_per_node: int = 1,
+           master_addr: str = "127.0.0.1", master_port: int = 6170,
+           log_dir: str = None) -> int:
+    procs = []
+    world = nnodes * nproc_per_node
+    for local in range(nproc_per_node):
+        rank = node_rank * nproc_per_node + local
+        env = build_env(rank, world, master_addr, master_port)
+        stdout = None
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            stdout = open(os.path.join(log_dir, f"worker.{rank}.log"), "w")
+        procs.append(subprocess.Popen(
+            [sys.executable, script] + list(script_args), env=env,
+            stdout=stdout, stderr=subprocess.STDOUT if stdout else None))
+    launcher = LauncherInterface(procs)
+    try:
+        return launcher.watch()
+    except KeyboardInterrupt:
+        launcher.stop()
+        return 130
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("paddle_tpu.parallel.launch")
+    parser.add_argument("--nnodes", type=int, default=1)
+    parser.add_argument("--node_rank", type=int,
+                        default=int(os.environ.get("NODE_RANK", "0")))
+    parser.add_argument("--nproc_per_node", type=int, default=1)
+    parser.add_argument("--master_addr", default=os.environ.get(
+        "MASTER_ADDR", "127.0.0.1"))
+    parser.add_argument("--master_port", type=int, default=int(
+        os.environ.get("MASTER_PORT", "6170")))
+    parser.add_argument("--log_dir", default=None)
+    parser.add_argument("script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    return launch(args.script, args.script_args, args.nnodes, args.node_rank,
+                  args.nproc_per_node, args.master_addr, args.master_port,
+                  args.log_dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,7 +21,8 @@ from typing import Optional
 _LIB = None
 _LIB_ERR = None
 
-_OP_SET, _OP_GET, _OP_ADD, _OP_WAIT, _OP_CHECK, _OP_DELETE = 1, 2, 3, 4, 5, 6
+(_OP_SET, _OP_GET, _OP_ADD, _OP_WAIT, _OP_CHECK, _OP_DELETE,
+ _OP_TRYGET) = 1, 2, 3, 4, 5, 6, 7
 
 
 def _load_lib():
@@ -124,6 +125,14 @@ class TCPStore:
     def check(self, key: str) -> bool:
         return self._req(_OP_CHECK, key) == b"\x01"
 
+    def try_get(self, key: str):
+        """Non-blocking get: returns bytes or None if absent (used by
+        liveness sweeps that must not block on deleted keys)."""
+        out = self._req(_OP_TRYGET, key)
+        if not out or out[0:1] != b"\x01":
+            return None
+        return out[1:]
+
     def delete_key(self, key: str) -> None:
         self._req(_OP_DELETE, key)
 
@@ -183,6 +192,10 @@ class _PyStore:
                 return st["data"][key]
             if op == _OP_CHECK:
                 return b"\x01" if key in st["data"] else b"\x00"
+            if op == _OP_TRYGET:
+                if key in st["data"]:
+                    return b"\x01" + st["data"][key]
+                return b""
             if op == _OP_DELETE:
                 st["data"].pop(key, None)
                 return b""

@@ -1,0 +1,181 @@
+"""Quantization: QAT fake-quant + PTQ observers.
+
+Reference: python/paddle/quantization/ (QuantConfig, QAT quanter insertion,
+PTQ observers) + fake_quantize ops (phi/kernels/fake_quantize_*).
+
+TPU-native: int8 is MXU-native on TPU; fake-quant in training simulates it,
+and the convert step materializes int8 weights + scales. Per-tensor abs-max
+quantization (the reference default).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Parameter, Tensor
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.ops.registry import OPS, OpDef, dispatch
+
+
+def _fake_quant(x, scale, bit_length=8):
+    """Simulated quantization with straight-through estimator."""
+    qmax = 2.0 ** (bit_length - 1) - 1
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    dq = q * s / qmax
+    # STE: forward uses dq, backward passes through
+    return x + jax.lax.stop_gradient(dq - x)
+
+
+OPS.setdefault("fake_quantize_dequantize",
+               OpDef("fake_quantize_dequantize", _fake_quant, diff=True,
+                     method=False))
+
+
+def fake_quantize_dequantize(x, scale, bit_length=8):
+    return dispatch("fake_quantize_dequantize", (x, scale),
+                    {"bit_length": bit_length})
+
+
+class AbsmaxObserver:
+    """PTQ observer collecting per-tensor abs-max (reference
+    quantization/observers/abs_max.py)."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._max = 0.0
+
+    def observe(self, x: Tensor):
+        self._max = max(self._max, float(jnp.abs(x._value).max()))
+
+    def scale(self) -> float:
+        return self._max or 1.0
+
+
+class FakeQuanterWithAbsMax(Layer):
+    """QAT quanter: tracks a running abs-max and fake-quantizes
+    (reference quanters/abs_max.py)."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.moving_rate = moving_rate
+        # scale < 0 marks "not yet observed": first batch sets it directly
+        self.register_buffer("scale",
+                             Tensor._wrap(-jnp.ones((), jnp.float32)))
+
+    def forward(self, x):
+        if self.training:
+            cur = jnp.abs(x._value).max().astype(jnp.float32)
+            prev = self.scale._value
+            new = jnp.where(prev < 0, cur,
+                            self.moving_rate * prev
+                            + (1 - self.moving_rate) * cur)
+            self.scale._value = new
+        # unobserved (eval before any training batch): calibrate on the fly
+        safe = jnp.where(self.scale._value < 0,
+                         jnp.abs(jnp.asarray(x._value)).max(),
+                         self.scale._value)
+        return fake_quantize_dequantize(x, Tensor._wrap(safe),
+                                        bit_length=self.quant_bits)
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quantized weights + activations (QAT)."""
+
+    def __init__(self, linear, q_config=None):
+        super().__init__()
+        self.weight = linear.weight
+        self.bias = linear.bias
+        self.activation_quanter = FakeQuanterWithAbsMax()
+        self.weight_quanter = FakeQuanterWithAbsMax()
+
+    def forward(self, x):
+        xq = self.activation_quanter(x)
+        wq = self.weight_quanter(self.weight)
+        return F.linear(xq, wq, self.bias)
+
+
+class QuantConfig:
+    """Reference: quantization/config.py QuantConfig."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._layer_types = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        self._layer_types[layer_type] = (activation, weight)
+
+
+class QAT:
+    """Quantization-aware training: swap Linear -> QuantedLinear
+    (reference quantization/qat.py)."""
+
+    def __init__(self, config: QuantConfig = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace=False):
+        from paddle_tpu.nn.layers import Linear
+
+        for name, sub in list(model.named_sublayers(include_self=True)):
+            for child_name, child in list(sub._sub_layers.items()):
+                if isinstance(child, Linear):
+                    sub._sub_layers[child_name] = QuantedLinear(child)
+        return model
+
+    def convert(self, model: Layer, inplace=False):
+        """Materialize int8 weights + scales for deployment."""
+        for _, sub in model.named_sublayers(include_self=True):
+            if isinstance(sub, QuantedLinear):
+                qmax = 2.0 ** (sub.weight_quanter.quant_bits - 1) - 1
+                s = float(jnp.abs(sub.weight._value).max()) / qmax
+                sub._int8_weight = np.asarray(
+                    jnp.clip(jnp.round(sub.weight._value / s), -qmax, qmax)
+                ).astype(np.int8)
+                sub._weight_scale = s
+        return model
+
+
+class PTQ:
+    """Post-training quantization: run calibration batches through observers
+    (reference quantization/ptq.py)."""
+
+    def __init__(self, config: QuantConfig = None):
+        self.config = config or QuantConfig()
+        self._observers = {}
+
+    def quantize(self, model: Layer, inplace=False):
+        from paddle_tpu.nn.layers import Linear
+
+        for name, sub in model.named_sublayers(include_self=True):
+            if isinstance(sub, Linear):
+                obs = AbsmaxObserver()
+                self._observers[name] = obs
+
+                def make_hook(o):
+                    def hook(layer, inputs):
+                        o.observe(inputs[0])
+
+                    return hook
+
+                sub.register_forward_pre_hook(make_hook(obs))
+        return model
+
+    def convert(self, model: Layer, inplace=False):
+        """Bake observed scales into fake-quant wrappers."""
+        from paddle_tpu.nn.layers import Linear
+
+        for name, sub in model.named_sublayers(include_self=True):
+            for child_name, child in list(sub._sub_layers.items()):
+                full = (name + "." if name else "") + child_name
+                if isinstance(child, Linear) and full in self._observers:
+                    q = QuantedLinear(child)
+                    q.activation_quanter.scale._value = jnp.asarray(
+                        self._observers[full].scale(), jnp.float32)
+                    q.eval()
+                    sub._sub_layers[child_name] = q
+        return model

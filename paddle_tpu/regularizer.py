@@ -1,0 +1,18 @@
+"""paddle.regularizer — reference: python/paddle/regularizer.py."""
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+
+class L1Decay(WeightDecayRegularizer):
+    """L1 weight decay; applied by optimizers as sign(p)*coeff."""
+
+
+class L2Decay(WeightDecayRegularizer):
+    """L2 weight decay; equivalent to Optimizer(weight_decay=coeff)."""

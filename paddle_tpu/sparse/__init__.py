@@ -1,0 +1,128 @@
+"""paddle.sparse — COO/CSR sparse tensors.
+
+Reference: python/paddle/sparse/ (SparseCooTensor/SparseCsrTensor creation,
+unary/binary/matmul ops over phi/kernels/sparse/).
+
+TPU-native: backed by jax.experimental.sparse.BCOO — XLA lowers sparse
+contractions to gather/scatter + dense MXU tiles, which is how sparse is
+done efficiently on TPU (there is no TPU CSR hardware path; the reference's
+cuSPARSE world has no analogue).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from paddle_tpu.core.tensor import Tensor
+
+
+class SparseCooTensor(Tensor):
+    """A Tensor whose _value is a BCOO matrix."""
+
+    @property
+    def nnz(self):
+        return int(self._value.nse)
+
+    def indices(self):
+        return Tensor._wrap(jnp.swapaxes(self._value.indices, 0, 1))
+
+    def values(self):
+        return Tensor._wrap(self._value.data)
+
+    def to_dense(self):
+        return Tensor._wrap(self._value.todense())
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return self
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={list(self._value.shape)}, "
+                f"nnz={self.nnz})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient=True):
+    """paddle.sparse.sparse_coo_tensor — indices [ndim, nnz]."""
+    idx = indices._value if isinstance(indices, Tensor) else jnp.asarray(indices)
+    val = values._value if isinstance(values, Tensor) else jnp.asarray(values)
+    if dtype is not None:
+        from paddle_tpu.core.dtype import to_jax_dtype
+
+        val = val.astype(to_jax_dtype(dtype))
+    mat = jsparse.BCOO((val, jnp.swapaxes(idx, 0, 1)),
+                       shape=tuple(shape) if shape is not None else None)
+    out = SparseCooTensor.__new__(SparseCooTensor)
+    Tensor.__init__(out, None, stop_gradient=stop_gradient)
+    out._value = mat
+    return out
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True):
+    """CSR creation; stored as BCOO internally (converted from CSR triplets)."""
+    crows = np.asarray(crows._value if isinstance(crows, Tensor) else crows)
+    cols = np.asarray(cols._value if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    idx = np.stack([rows, cols])
+    return sparse_coo_tensor(idx, values, shape, dtype, stop_gradient)
+
+
+def _coo_out(mat, stop_gradient=True):
+    out = SparseCooTensor.__new__(SparseCooTensor)
+    Tensor.__init__(out, None, stop_gradient=stop_gradient)
+    out._value = mat
+    return out
+
+
+def matmul(x, y):
+    """sparse @ dense (reference: sparse/matmul kernels)."""
+    xv = x._value
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    return Tensor._wrap(xv @ yv)
+
+
+def add(x, y):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return _coo_out(jsparse.bcoo_sum_duplicates(
+            jsparse.bcoo_concatenate([x._value, y._value], dimension=0)
+            if False else _bcoo_add(x._value, y._value)))
+    return Tensor._wrap(x._value.todense() + (
+        y._value.todense() if isinstance(y, SparseCooTensor) else y._value))
+
+
+def _bcoo_add(a, b):
+    cat_data = jnp.concatenate([a.data, b.data])
+    cat_idx = jnp.concatenate([a.indices, b.indices])
+    out = jsparse.BCOO((cat_data, cat_idx), shape=a.shape)
+    return jsparse.bcoo_sum_duplicates(out)
+
+
+def _unary(fn):
+    def op(x):
+        v = x._value
+        return _coo_out(jsparse.BCOO((fn(v.data), v.indices), shape=v.shape),
+                        stop_gradient=x.stop_gradient)
+
+    return op
+
+
+relu = _unary(jax.nn.relu)
+abs = _unary(jnp.abs)  # noqa: A001
+sin = _unary(jnp.sin)
+tanh = _unary(jnp.tanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+neg = _unary(jnp.negative)
+expm1 = _unary(jnp.expm1)
+
+
+def is_sparse_coo(x):
+    return isinstance(x, SparseCooTensor)
+
+
+def to_sparse_coo(dense: Tensor, sparse_dim=None):
+    mat = jsparse.BCOO.fromdense(dense._value)
+    return _coo_out(mat, stop_gradient=dense.stop_gradient)

@@ -1,0 +1,219 @@
+"""Launcher / elastic / auto-tuner / RNN / sparse / geometric / quantization
+tests (reference: test/legacy_test/test_fleet_elastic_manager.py with mocked
+etcd; here the real native store)."""
+
+import sys
+import time
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import parallel as dist
+
+rng = np.random.default_rng(17)
+
+
+def test_launcher_env_contract(tmp_path):
+    from paddle_tpu.parallel.launch import build_env, launch
+
+    env = build_env(2, 4, "10.0.0.1", 6170)
+    assert env["PADDLE_TRAINER_ID"] == "2"
+    assert env["PADDLE_TRAINERS_NUM"] == "4"
+    assert env["MASTER_ADDR"] == "10.0.0.1"
+    assert len(env["PADDLE_TRAINER_ENDPOINTS"].split(",")) == 4
+
+    # spawn 2 real processes that each assert their rank env and exit
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys\n"
+        "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+        "assert os.environ['PADDLE_TRAINERS_NUM'] == '2'\n"
+        "print('rank', rank, 'ok')\n")
+    ret = launch(str(script), [], nproc_per_node=2,
+                 log_dir=str(tmp_path / "logs"))
+    assert ret == 0
+    logs = sorted((tmp_path / "logs").glob("worker.*.log"))
+    assert len(logs) == 2
+    assert "ok" in logs[0].read_text()
+
+
+def test_launcher_failure_propagates(tmp_path):
+    from paddle_tpu.parallel.launch import launch
+
+    script = tmp_path / "bad.py"
+    script.write_text("import os, sys\n"
+                      "sys.exit(3 if os.environ['PADDLE_TRAINER_ID']=='1' else 0)\n")
+    ret = launch(str(script), [], nproc_per_node=2)
+    assert ret == 3
+
+
+def test_elastic_membership():
+    from paddle_tpu.parallel.elastic import ElasticManager
+
+    # generous ttl: pytest load can stall heartbeat threads briefly
+    master = ElasticManager(rank=0, heartbeat_interval=0.2, ttl=3.0)
+    master.register()
+    worker = ElasticManager(port=master.port, rank=1,
+                            heartbeat_interval=0.2, ttl=3.0)
+    worker.register()
+    time.sleep(0.5)
+    assert master.current_members() == [0, 1]
+    changes = []
+    master.on_membership_change = lambda m: changes.append(list(m))
+    worker.exit()  # clean leave
+    time.sleep(1.0)
+    assert master.current_members() == [0]
+    master.exit()
+
+
+def test_elastic_dead_node_swept():
+    from paddle_tpu.parallel.elastic import ElasticManager
+
+    master = ElasticManager(rank=0, heartbeat_interval=0.1, ttl=0.8)
+    master.register()
+    # fake node 5 writes one heartbeat then "dies" (no loop)
+    master.store.set("node/5", str(time.time()))
+    time.sleep(0.2)
+    assert 5 in master.current_members()
+    time.sleep(2.5)  # ttl expires, sweeper removes it
+    assert 5 not in master.current_members()
+    master.exit()
+
+
+def test_watchdog():
+    from paddle_tpu.parallel.elastic import Watchdog
+
+    wd = Watchdog(timeout=0.3)
+    assert wd.run(lambda: 42) == 42
+    with pytest.raises(TimeoutError):
+        wd.run(lambda: time.sleep(2), desc="hang")
+    assert wd.timed_out == ["hang"]
+
+
+def test_auto_tuner():
+    from paddle_tpu.parallel import AutoTuner, candidate_configs
+
+    cfgs = candidate_configs(8, axes=("dp", "tp"))
+    assert {"dp": 2, "tp": 4} in cfgs and {"dp": 8, "tp": 1} in cfgs
+
+    def build(config):
+        mesh = dist.init_mesh(dict(config))
+        net = nn.Linear(16, 16)
+        opt = paddle.optimizer.SGD(parameters=net.parameters())
+        step = paddle.jit.TrainStep(net, lambda o, t: ((o - t) ** 2).mean(),
+                                    opt, n_inputs=1, mesh=mesh)
+        x = paddle.randn([8, 16])
+        return (lambda batch: step(batch, batch)), x
+
+    tuner = AutoTuner(build, warmup=1, iters=2)
+    try:
+        best = tuner.run_trial({"dp": 2, "tp": 4})
+        assert best.ok and best.ips > 0
+    finally:
+        dist.set_mesh(None)
+
+
+# ------------------------------------------------------------- rnn
+
+
+def test_lstm_vs_torch():
+    paddle.seed(0)
+    m = nn.LSTM(8, 16, num_layers=2, direction="bidirect")
+    t = torch.nn.LSTM(8, 16, num_layers=2, bidirectional=True,
+                      batch_first=True)
+    t.load_state_dict({k: torch.tensor(p.numpy())
+                       for k, p in m.named_parameters()})
+    x = rng.standard_normal((3, 5, 8)).astype(np.float32)
+    out, (h, c) = m(paddle.to_tensor(x))
+    tout, (th, tc) = t(torch.tensor(x))
+    np.testing.assert_allclose(out.numpy(), tout.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(h.numpy(), th.detach().numpy(), atol=1e-5)
+
+
+def test_gru_grad():
+    m = nn.GRU(4, 8)
+    x = paddle.to_tensor(rng.standard_normal((2, 6, 4)).astype(np.float32),
+                         stop_gradient=False)
+    y, h = m(x)
+    y.sum().backward()
+    assert x.grad is not None
+    assert m._parameters["weight_ih_l0"].grad is not None
+
+
+# ------------------------------------------------------------- sparse/geo
+
+
+def test_sparse_coo():
+    st = paddle.sparse.sparse_coo_tensor([[0, 1, 2], [1, 0, 2]],
+                                         [1.0, 2.0, 3.0], shape=[3, 3])
+    dense = st.to_dense().numpy()
+    assert dense[0, 1] == 1.0 and dense[2, 2] == 3.0
+    assert st.nnz == 3
+    out = paddle.sparse.matmul(st, paddle.ones([3, 2]))
+    np.testing.assert_allclose(out.numpy(), dense @ np.ones((3, 2)))
+    r = paddle.sparse.relu(paddle.sparse.sparse_coo_tensor(
+        [[0], [0]], [-5.0], shape=[2, 2]))
+    assert r.to_dense().numpy()[0, 0] == 0.0
+
+
+def test_sparse_from_dense_roundtrip():
+    x = paddle.to_tensor(np.diag([1.0, 2.0, 3.0]).astype(np.float32))
+    st = paddle.sparse.to_sparse_coo(x)
+    np.testing.assert_allclose(st.to_dense().numpy(), x.numpy())
+
+
+def test_geometric_send_recv():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0]))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 3]))
+    out = paddle.geometric.send_u_recv(x, src, dst, reduce_op="sum")
+    expected = np.zeros((4, 3), np.float32)
+    expected[1] = x.numpy()[0] + x.numpy()[2]
+    expected[2] = x.numpy()[1]
+    expected[3] = x.numpy()[0]
+    np.testing.assert_allclose(out.numpy(), expected)
+    out = paddle.geometric.segment_sum(
+        x, paddle.to_tensor(np.array([0, 0, 1, 1])))
+    np.testing.assert_allclose(out.numpy()[0], x.numpy()[:2].sum(0))
+
+
+# ------------------------------------------------------------- quantization
+
+
+def test_qat_roundtrip():
+    from paddle_tpu.quantization import QAT
+
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    x = paddle.randn([4, 8])
+    ref = net(x).numpy()
+    qnet = QAT().quantize(net)
+    out = qnet(x)
+    # fake-quant should be close to fp at 8 bits
+    assert np.abs(out.numpy() - ref).max() < 0.3
+    # trains
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=qnet.parameters())
+    loss = (qnet(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    QAT().convert(qnet)
+    q0 = qnet[0]
+    assert q0._int8_weight.dtype == np.int8
+
+
+def test_ptq_observers():
+    from paddle_tpu.quantization import PTQ
+
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    ptq = PTQ()
+    ptq.quantize(net)
+    for _ in range(3):
+        net(paddle.randn([2, 4]))  # calibration
+    assert all(o._max > 0 for o in ptq._observers.values())
+    ptq.convert(net)
+    out = net(paddle.randn([2, 4]))
+    assert out.shape == [2, 2]
