@@ -286,7 +286,11 @@ from paddle_tpu import jit  # noqa: E402,F401
 from paddle_tpu import nn  # noqa: E402,F401
 from paddle_tpu import optimizer  # noqa: E402,F401
 from paddle_tpu import parallel  # noqa: E402,F401
+from paddle_tpu import audio  # noqa: E402,F401
+from paddle_tpu import device  # noqa: E402,F401
 from paddle_tpu import distribution  # noqa: E402,F401
+from paddle_tpu import incubate  # noqa: E402,F401
+from paddle_tpu import text  # noqa: E402,F401
 from paddle_tpu import inference  # noqa: E402,F401
 from paddle_tpu import metric  # noqa: E402,F401
 from paddle_tpu import profiler  # noqa: E402,F401
@@ -299,6 +303,7 @@ from paddle_tpu import static  # noqa: E402,F401
 from paddle_tpu import vision  # noqa: E402,F401
 from paddle_tpu import hapi  # noqa: E402,F401
 from paddle_tpu.hapi import Model, summary  # noqa: E402,F401
+from paddle_tpu.utils.flops import flops  # noqa: E402,F401
 from paddle_tpu.framework import io_api as _io_api  # noqa: E402
 save = _io_api.save
 load = _io_api.load

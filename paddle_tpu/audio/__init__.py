@@ -1,0 +1,3 @@
+"""paddle.audio — spectrogram features (reference: python/paddle/audio/)."""
+from paddle_tpu.audio import functional  # noqa: F401
+from paddle_tpu.audio.features import LogMelSpectrogram, MelSpectrogram, Spectrogram  # noqa: F401

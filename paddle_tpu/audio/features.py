@@ -1,0 +1,56 @@
+"""Audio feature layers (reference: python/paddle/audio/features/layers.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu import signal as _signal
+from paddle_tpu.audio import functional as AF
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.ops.registry import C_OPS as _C
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = AF.get_window(window, self.win_length)
+
+    def forward(self, x):
+        spec = _signal.stft(x, self.n_fft, self.hop_length, self.win_length,
+                            window=self.window, center=self.center,
+                            pad_mode=self.pad_mode)
+        mag = Tensor._wrap(jnp.abs(spec._value) ** self.power)
+        return mag
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, n_mels=64, f_min=50.0, f_max=None):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power)
+        self.fbank = AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)  # [..., bins, frames]
+        return _C.matmul(self.fbank, spec)
+
+
+class LogMelSpectrogram(MelSpectrogram):
+    def __init__(self, *args, ref_value=1.0, amin=1e-10, top_db=None,
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = super().forward(x)
+        return AF.power_to_db(mel, self.ref_value, self.amin, self.top_db)

@@ -1,0 +1,70 @@
+"""paddle.device — device/stream API (reference: python/paddle/device/).
+
+TPU-native: streams are implicit (PJRT orders execution per device;
+XLA handles overlap), so Stream/Event are thin synchronization wrappers:
+synchronize() == block until all dispatched work completes.
+"""
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu.core.place import (  # noqa: F401
+    CPUPlace, Place, TPUPlace, device_count, get_device, set_device,
+)
+
+
+def synchronize(device=None):
+    """Block until all queued device work is complete
+    (reference: paddle.device.synchronize)."""
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+class Stream:
+    """Execution-order token. PJRT serializes per-device launches, so
+    recording/waiting degrade to synchronize barriers."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def wait_event(self, event):
+        synchronize(self.device)
+
+    def wait_stream(self, stream):
+        synchronize(self.device)
+
+
+class Event:
+    def __init__(self, device=None, enable_timing=False):
+        self.device = device
+
+    def record(self, stream=None):
+        pass
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def query(self):
+        return True
+
+
+def current_stream(device=None):
+    return Stream(device)

@@ -1,0 +1,13 @@
+"""paddle.incubate — fused LLM ops + experimental features.
+
+Reference: python/paddle/incubate/ (nn/functional fused ops:
+fused_rms_norm, fused_rotary_position_embedding, swiglu, fused_moe,
+block_multihead_attention, masked_multihead_attention; asp; optimizers).
+
+TPU-native: these "fused kernels" are either XLA fusions of the stock impls
+(rms_norm, swiglu — XLA fuses the chains into single kernels) or the Pallas
+flash-attention path; the incubate namespace provides the reference's entry
+points over the same registry ops.
+"""
+
+from paddle_tpu.incubate import nn  # noqa: F401

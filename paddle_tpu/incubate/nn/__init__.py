@@ -1,0 +1,2 @@
+from paddle_tpu.incubate.nn import functional  # noqa: F401
+from paddle_tpu.nn.layers import RMSNorm as FusedRMSNorm  # noqa: F401

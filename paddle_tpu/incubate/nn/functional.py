@@ -1,0 +1,34 @@
+"""Fused-op entry points (reference: python/paddle/incubate/nn/functional/).
+
+On TPU these are XLA fusions or Pallas kernels of the registry ops — one
+implementation serves both the stock and the "fused" API names.
+"""
+
+from paddle_tpu.ops.registry import C_OPS as _C
+
+fused_rms_norm = _C.rms_norm
+fused_layer_norm = _C.layer_norm
+swiglu = _C.swiglu
+fused_rotary_position_embedding = _C.rotary_embedding
+
+
+def fused_multi_head_attention(q, k, v, causal=False, **kwargs):
+    """Routes to the flash-attention path when shapes tile."""
+    return _C.scaled_dot_product_attention(q, k, v, is_causal=causal)
+
+
+def variable_length_memory_efficient_attention(q, k, v, *args, **kwargs):
+    return _C.scaled_dot_product_attention(q, k, v, is_causal=True)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu"):
+    out = x if bias is None else x + bias
+    return getattr(_C, act_method)(out)
+
+
+def fused_linear(x, weight, bias=None):
+    return _C.linear(x, weight, bias)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train"):
+    return _C.dropout(x, p=p, training=training, mode=mode) + y

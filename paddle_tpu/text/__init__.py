@@ -1,0 +1,66 @@
+"""paddle.text — text data utilities (reference: python/paddle/text/).
+
+The reference ships dataset downloaders (Imdb, Conll05, WMT14...) — zero
+egress here, so this provides the processing utilities (vocabulary, ngram)
+and a synthetic dataset for pipeline tests.
+"""
+from __future__ import annotations
+
+from collections import Counter
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+
+class Vocab:
+    def __init__(self, counter=None, max_size=None, min_freq=1,
+                 unk_token="<unk>", pad_token="<pad>"):
+        self.unk_token = unk_token
+        self.pad_token = pad_token
+        self._itos = [pad_token, unk_token]
+        if counter:
+            for tok, freq in counter.most_common(max_size):
+                if freq >= min_freq and tok not in (unk_token, pad_token):
+                    self._itos.append(tok)
+        self._stoi = {t: i for i, t in enumerate(self._itos)}
+
+    @classmethod
+    def build_vocab(cls, iterator, **kwargs):
+        counter = Counter()
+        for tokens in iterator:
+            counter.update(tokens)
+        return cls(counter, **kwargs)
+
+    def __len__(self):
+        return len(self._itos)
+
+    def to_indices(self, tokens):
+        unk = self._stoi[self.unk_token]
+        if isinstance(tokens, str):
+            return self._stoi.get(tokens, unk)
+        return [self._stoi.get(t, unk) for t in tokens]
+
+    def to_tokens(self, indices):
+        if isinstance(indices, int):
+            return self._itos[indices]
+        return [self._itos[i] for i in indices]
+
+
+def ngrams(sequence, n):
+    return [tuple(sequence[i:i + n]) for i in range(len(sequence) - n + 1)]
+
+
+class SyntheticTextDataset(Dataset):
+    """Deterministic token sequences for pipeline tests."""
+
+    def __init__(self, num_samples=1000, seq_len=64, vocab_size=1000, seed=0):
+        rng = np.random.default_rng(seed)
+        self.data = rng.integers(0, vocab_size, (num_samples, seq_len))
+
+    def __getitem__(self, idx):
+        seq = self.data[idx]
+        return seq[:-1].astype(np.int64), seq[1:].astype(np.int64)
+
+    def __len__(self):
+        return len(self.data)

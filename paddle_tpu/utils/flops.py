@@ -1,0 +1,53 @@
+"""Per-layer FLOPs accounting (reference: python/paddle/utils/flops.py +
+hapi's paddle.flops)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _prod(s):
+    return int(np.prod(s)) if s else 1
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Count MACs*2 for the standard layers via a forward pass with hooks."""
+    import paddle_tpu as paddle
+    from paddle_tpu.nn import layers as L
+
+    records = []
+
+    def hook(layer, inputs, outputs):
+        x = inputs[0]
+        out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+        n = 0
+        if isinstance(layer, L.Linear):
+            n = 2 * _prod(x.shape) // x.shape[-1] * layer.weight.shape[0] * layer.weight.shape[1]
+        elif isinstance(layer, (L.Conv2D,)):
+            kh, kw = layer.weight.shape[2], layer.weight.shape[3]
+            cin = layer.weight.shape[1]
+            n = 2 * _prod(out.shape) * cin * kh * kw
+        elif isinstance(layer, L._BatchNormBase):
+            n = 2 * _prod(x.shape)
+        if n:
+            records.append((type(layer).__name__, n))
+
+    handles = []
+    for _, l in net.named_sublayers(include_self=False):
+        if not l._sub_layers:
+            handles.append(l.register_forward_post_hook(hook))
+    was = net.training
+    net.eval()
+    try:
+        with paddle.no_grad():
+            net(paddle.zeros(list(input_size)))
+    finally:
+        for h in handles:
+            h.remove()
+        if was:
+            net.train()
+    total = sum(n for _, n in records)
+    if print_detail:
+        for name, n in records:
+            print(f"{name:<20}{n:>16,}")
+        print(f"{'Total FLOPs':<20}{total:>16,}")
+    return total
