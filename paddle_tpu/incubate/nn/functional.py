@@ -17,8 +17,26 @@ def fused_multi_head_attention(q, k, v, causal=False, **kwargs):
     return _C.scaled_dot_product_attention(q, k, v, is_causal=causal)
 
 
-def variable_length_memory_efficient_attention(q, k, v, *args, **kwargs):
-    return _C.scaled_dot_product_attention(q, k, v, is_causal=True)
+def variable_length_memory_efficient_attention(q, k, v, seq_lens=None,
+                                               kv_seq_lens=None, mask=None,
+                                               scale=None, causal=True):
+    """Variable-length attention: seq_lens/mask build a key-padding mask
+    (reference incubate op semantics). Layout [b, s, h, d]."""
+    attn_mask = None
+    if mask is not None:
+        attn_mask = mask
+    elif kv_seq_lens is not None or seq_lens is not None:
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import Tensor
+
+        lens = kv_seq_lens if kv_seq_lens is not None else seq_lens
+        lv = lens._value if isinstance(lens, Tensor) else jnp.asarray(lens)
+        sk = k.shape[1]
+        valid = jnp.arange(sk)[None, :] < lv[:, None]        # [b, sk]
+        attn_mask = Tensor._wrap(valid[:, None, None, :])    # [b, 1, 1, sk]
+    return _C.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                           is_causal=causal, scale=scale)
 
 
 def fused_bias_act(x, bias=None, act_method="gelu"):

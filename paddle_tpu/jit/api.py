@@ -167,7 +167,12 @@ class TrainStep:
 
     def _maybe_shard_state(self):
         """Apply per-param PartitionSpecs (set by parallel layers) when a mesh
-        is active — params/opt-state land sharded in HBM before step 1."""
+        is active — params/opt-state land sharded in HBM before step 1.
+
+        ZeRO stages (reference group_sharded levels, SURVEY.md §2.10): with
+        optimizer._zero_stage 1/2 the optimizer ACCUMULATORS shard over 'dp'
+        even where parameters stay replicated; stage 3 shards the parameters
+        themselves (specs already set by group_sharded_parallel)."""
         from paddle_tpu.parallel.mesh import current_mesh
 
         mesh = self._mesh or current_mesh()
@@ -176,14 +181,26 @@ class TrainStep:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         shardings = self.func.param_shardings()
+        zero_stage = getattr(self.optimizer, "_zero_stage", 0)
 
-        def put(name, v):
-            spec = shardings.get(name) or P()
+        def put(name, v, spec=None):
+            spec = spec if spec is not None else (shardings.get(name) or P())
             return jax.device_put(v, NamedSharding(mesh, spec))
+
+        def acc_spec(name, v):
+            base = shardings.get(name)
+            if base is not None and any(e is not None for e in tuple(base)):
+                return base  # follows the param's own sharding
+            if zero_stage in (1, 2) and "dp" in mesh.axis_names:
+                from paddle_tpu.parallel.data_parallel import _shard_param_spec
+
+                return _shard_param_spec(tuple(v.shape), mesh=mesh)
+            return P()
 
         self.params = {k: put(k, v) for k, v in self.params.items()}
         self.opt_state = {
-            k: {sk: put(k, sv) if sv.shape == self.params[k].shape else sv
+            k: {sk: put(k, sv, acc_spec(k, sv))
+                if sv.shape == self.params[k].shape else sv
                 for sk, sv in st.items()}
             for k, st in self.opt_state.items()
         }

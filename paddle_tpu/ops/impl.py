@@ -1315,3 +1315,140 @@ def rotary_embedding(q, k, cos, sin, position_ids=None):
     q_out = q * cos + rotate_half(q) * sin
     k_out = k * cos + rotate_half(k) * sin
     return q_out.astype(q.dtype), k_out.astype(k.dtype)
+
+
+# ============================================================ statistics+
+
+
+def histogram(x, bins=100, min=0, max=0):  # noqa: A002
+    """min == max == 0 means full data range (paddle semantics)."""
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    else:
+        lo, hi = min, max
+    return _histogram_fixed(x, bins, lo, hi)
+
+
+def _histogram_fixed(x, bins, lo, hi):
+    edges = jnp.linspace(lo, hi, bins + 1)
+    idx = jnp.clip(jnp.searchsorted(edges, x.ravel(), side="right") - 1,
+                   0, bins - 1)
+    inside = (x.ravel() >= lo) & (x.ravel() <= hi)
+    return jnp.zeros(bins, jnp.int32).at[idx].add(inside.astype(jnp.int32))
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim)
+
+
+def nanquantile(x, q, axis=None, keepdim=False):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim)
+
+
+def nansum(x, axis=None, keepdim=False):
+    return jnp.nansum(x, axis=axis, keepdims=keepdim)
+
+
+def kthvalue(x, k, axis=None, keepdim=False):
+    if axis is None:
+        axis = -1  # paddle semantics: default = last dim
+    idxs = jnp.argsort(x, axis=axis)
+    vals = jnp.take_along_axis(x, idxs, axis=axis)  # one sort, both outputs
+    taken = jnp.take(vals, k - 1, axis=axis)
+    itaken = jnp.take(idxs, k - 1, axis=axis)
+    if keepdim:
+        taken = jnp.expand_dims(taken, axis)
+        itaken = jnp.expand_dims(itaken, axis)
+    return taken, itaken.astype(_canon(jnp.int64))
+
+
+def mode(x, axis=-1, keepdim=False):
+    """Returns (values, indices) like paddle.mode."""
+
+    def mode_1d(v):
+        vals, counts = jnp.unique_counts(v, size=v.shape[0], fill_value=v[0])
+        winner = vals[jnp.argmax(counts)]
+        # paddle returns the LAST index of the modal value
+        pos = jnp.where(v == winner, jnp.arange(v.shape[0]), -1)
+        return winner, jnp.max(pos)
+
+    out_v = jnp.apply_along_axis(lambda v: mode_1d(v)[0], axis, x)
+    out_i = jnp.apply_along_axis(lambda v: mode_1d(v)[1], axis, x)
+    if keepdim:
+        out_v = jnp.expand_dims(out_v, axis)
+        out_i = jnp.expand_dims(out_i, axis)
+    return out_v, out_i.astype(_canon(jnp.int64))
+
+
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.cumlogsumexp(x, axis=axis)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1):
+    return jnp.trapezoid(y, x=x, dx=1.0 if dx is None else dx, axis=axis)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+def angle(x):
+    return jnp.angle(x)
+
+
+def conj(x):
+    return jnp.conj(x)
+
+
+def real(x):
+    return jnp.real(x)
+
+
+def imag(x):
+    return jnp.imag(x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+def vander(x, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+def renorm(x, p, axis, max_norm):
+    dims = [d for d in range(x.ndim) if d != axis]
+    norms = jnp.sum(jnp.abs(x) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor

@@ -61,11 +61,11 @@ class DataParallel(Layer):
         pass
 
 
-def _shard_param_spec(shape, dp_axis="dp") -> P:
+def _shard_param_spec(shape, dp_axis="dp", mesh=None) -> P:
     """ZeRO-3 policy: shard the largest dim that divides evenly; else
     replicate (small params stay replicated like the reference's
     min-param-size threshold)."""
-    mesh = current_mesh()
+    mesh = mesh if mesh is not None else current_mesh()
     if mesh is None:
         return P()
     n = mesh.shape.get(dp_axis, 1)

@@ -1,6 +1,6 @@
 """paddle_tpu.vision — reference: python/paddle/vision/."""
-from paddle_tpu.vision import datasets, models, transforms  # noqa: F401
+from paddle_tpu.vision import datasets, models, ops, transforms  # noqa: F401
 from paddle_tpu.vision.models import (  # noqa: F401
-    LeNet, ResNet, VGG, resnet18, resnet34, resnet50, resnet101, resnet152,
+    LeNet, MobileNetV2, ResNet, VGG, mobilenet_v2, resnet18, resnet34, resnet50, resnet101, resnet152,
     resnext50_32x4d, vgg16, vgg19, wide_resnet50_2,
 )

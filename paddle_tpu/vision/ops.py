@@ -1,0 +1,162 @@
+"""Vision ops: nms, roi_align, box utilities, deform_conv fallback.
+
+Reference: python/paddle/vision/ops.py (nms, roi_align, deform_conv2d,
+box_coder) over phi detection kernels.
+
+TPU-native notes: NMS is sequential by nature — implemented as a
+fixed-iteration lax.while-style loop (jittable, O(n^2) mask math which
+vectorizes on the VPU); roi_align uses gather + bilinear weights.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.registry import OPS, OpDef, dispatch
+
+
+def _box_iou(boxes):
+    """boxes: [n, 4] (x1, y1, x2, y2) -> [n, n] IoU."""
+    area = jnp.maximum(boxes[:, 2] - boxes[:, 0], 0) * jnp.maximum(
+        boxes[:, 3] - boxes[:, 1], 0)
+    lt = jnp.maximum(boxes[:, None, :2], boxes[None, :, :2])
+    rb = jnp.minimum(boxes[:, None, 2:], boxes[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+def _nms(boxes, iou_threshold=0.3, scores=None):
+    """Returns keep mask [n] (fixed shape — callers index eagerly)."""
+    n = boxes.shape[0]
+    if scores is None:
+        order = jnp.arange(n)
+    else:
+        order = jnp.argsort(-scores)
+    sboxes = boxes[order]
+    iou = _box_iou(sboxes)
+
+    def body(i, keep):
+        # suppress j > i if kept[i] and iou > thresh
+        row = (iou[i] > iou_threshold) & (jnp.arange(n) > i) & keep[i]
+        return keep & ~row
+
+    keep_sorted = jax.lax.fori_loop(0, n, body, jnp.ones(n, bool))
+    keep = jnp.zeros(n, bool).at[order].set(keep_sorted)
+    return keep
+
+
+OPS.setdefault("vision_nms_mask", OpDef("vision_nms_mask", _nms, diff=False,
+                                        method=False))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """paddle.vision.ops.nms — returns kept indices sorted by score."""
+    bv = boxes._value if isinstance(boxes, Tensor) else jnp.asarray(boxes)
+    sv = scores._value if isinstance(scores, Tensor) else scores
+    if category_idxs is not None:
+        # category-aware: offset boxes per category so they never overlap
+        cv = (category_idxs._value if isinstance(category_idxs, Tensor)
+              else jnp.asarray(category_idxs))
+        offset = (cv.astype(bv.dtype) * (bv.max() + 1.0))[:, None]
+        bv = bv + offset
+    keep = _nms(bv, iou_threshold, sv)
+    idxs = np.nonzero(np.asarray(keep))[0]
+    if sv is not None:
+        idxs = idxs[np.argsort(-np.asarray(sv)[idxs])]
+    if top_k is not None:
+        idxs = idxs[:top_k]
+    return Tensor._wrap(jnp.asarray(idxs.astype(np.int64)))
+
+
+def _roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               sampling_ratio=-1, aligned=True):
+    """x: [N, C, H, W]; boxes: [R, 4]; boxes_num: [N] rois per image."""
+    n, c, h, w = x.shape
+    r = boxes.shape[0]
+    oh, ow = (output_size if isinstance(output_size, (tuple, list))
+              else (output_size, output_size))
+    # map each roi to its batch image
+    img_idx = jnp.repeat(jnp.arange(boxes_num.shape[0]), boxes_num,
+                         total_repeat_length=r)
+    offset = 0.5 if aligned else 0.0
+    x1 = boxes[:, 0] * spatial_scale - offset
+    y1 = boxes[:, 1] * spatial_scale - offset
+    x2 = boxes[:, 2] * spatial_scale - offset
+    y2 = boxes[:, 3] * spatial_scale - offset
+    roi_w = jnp.maximum(x2 - x1, 1e-3)
+    roi_h = jnp.maximum(y2 - y1, 1e-3)
+    bin_w = roi_w / ow
+    bin_h = roi_h / oh
+    s = sampling_ratio if sampling_ratio > 0 else 2
+    # sample grid: [R, oh, ow, s, s] y/x coordinates
+    iy = (jnp.arange(oh)[None, :, None] * bin_h[:, None, None]
+          + y1[:, None, None]
+          + (jnp.arange(s)[None, None, :] + 0.5) / s * bin_h[:, None, None])
+    ix = (jnp.arange(ow)[None, :, None] * bin_w[:, None, None]
+          + x1[:, None, None]
+          + (jnp.arange(s)[None, None, :] + 0.5) / s * bin_w[:, None, None])
+
+    def bilinear(img, ys, xs):
+        ys = jnp.clip(ys, 0, h - 1)
+        xs = jnp.clip(xs, 0, w - 1)
+        y0 = jnp.floor(ys).astype(jnp.int32)
+        x0 = jnp.floor(xs).astype(jnp.int32)
+        y1_ = jnp.minimum(y0 + 1, h - 1)
+        x1_ = jnp.minimum(x0 + 1, w - 1)
+        wy = ys - y0
+        wx = xs - x0
+        v00 = img[:, y0, :][:, :, x0]
+        v01 = img[:, y0, :][:, :, x1_]
+        v10 = img[:, y1_, :][:, :, x0]
+        v11 = img[:, y1_, :][:, :, x1_]
+        return (v00 * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
+                + v01 * (1 - wy)[None, :, None] * wx[None, None, :]
+                + v10 * wy[None, :, None] * (1 - wx)[None, None, :]
+                + v11 * wy[None, :, None] * wx[None, None, :])
+
+    def per_roi(ridx):
+        img = x[img_idx[ridx]]  # [C, H, W]
+        ys = iy[ridx].reshape(-1)  # [oh*s]
+        xs = ix[ridx].reshape(-1)  # [ow*s]
+        vals = bilinear(img, ys, xs)  # [C, oh*s, ow*s]
+        vals = vals.reshape(c, oh, s, ow, s)
+        return vals.mean(axis=(2, 4))
+
+    return jax.vmap(per_roi)(jnp.arange(r))
+
+
+OPS.setdefault("vision_roi_align", OpDef("vision_roi_align", _roi_align,
+                                         diff=True, method=False))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    return dispatch("vision_roi_align", (x, boxes, boxes_num),
+                    {"output_size": tuple(output_size) if isinstance(
+                        output_size, (tuple, list)) else output_size,
+                     "spatial_scale": spatial_scale,
+                     "sampling_ratio": sampling_ratio, "aligned": aligned})
+
+
+def box_area(boxes):
+    bv = boxes._value if isinstance(boxes, Tensor) else jnp.asarray(boxes)
+    return Tensor._wrap((bv[:, 2] - bv[:, 0]) * (bv[:, 3] - bv[:, 1]))
+
+
+def box_iou(boxes1, boxes2):
+    b1 = boxes1._value if isinstance(boxes1, Tensor) else jnp.asarray(boxes1)
+    b2 = boxes2._value if isinstance(boxes2, Tensor) else jnp.asarray(boxes2)
+    a1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+    a2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+    lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
+    rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return Tensor._wrap(inter / jnp.maximum(a1[:, None] + a2[None, :] - inter,
+                                            1e-9))

@@ -1,0 +1,138 @@
+"""Vision ops (nms/roi_align/box utils), statistics ops, MobileNetV2,
+ZeRO opt-state sharding tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import parallel as dist
+from paddle_tpu.vision.ops import box_iou, nms, roi_align
+
+rng = np.random.default_rng(23)
+
+
+def test_nms_basic():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30],
+                      [21, 21, 29, 29]], np.float32)
+    scores = np.array([0.9, 0.8, 0.7, 0.95], np.float32)
+    keep = nms(paddle.to_tensor(boxes), 0.5, paddle.to_tensor(scores))
+    # box1 suppressed by box0; box2 suppressed by box3 (higher score)
+    assert sorted(keep.numpy().tolist()) == [0, 3]
+    # sorted by score descending
+    assert keep.numpy().tolist() == [3, 0]
+
+
+def test_nms_category_aware():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+    scores = np.array([0.9, 0.8], np.float32)
+    cats = np.array([0, 1])
+    keep = nms(paddle.to_tensor(boxes), 0.5, paddle.to_tensor(scores),
+               category_idxs=paddle.to_tensor(cats), categories=[0, 1])
+    assert len(keep.numpy()) == 2  # different categories: both kept
+
+
+def test_box_iou():
+    a = paddle.to_tensor(np.array([[0, 0, 10, 10]], np.float32))
+    b = paddle.to_tensor(np.array([[0, 0, 10, 10], [5, 5, 15, 15],
+                                   [20, 20, 30, 30]], np.float32))
+    iou = box_iou(a, b).numpy()[0]
+    np.testing.assert_allclose(iou[0], 1.0, rtol=1e-5)
+    np.testing.assert_allclose(iou[1], 25.0 / 175.0, rtol=1e-4)
+    assert iou[2] == 0.0
+
+
+def test_roi_align_constant_and_ramp():
+    """Constant image -> constant output; linear ramp -> bin-center values."""
+    const = np.full((1, 1, 8, 8), 3.5, np.float32)
+    rois = np.array([[0, 0, 8, 8]], np.float32)
+    out = roi_align(paddle.to_tensor(const), paddle.to_tensor(rois),
+                    paddle.to_tensor(np.array([1])), output_size=4,
+                    aligned=False)
+    np.testing.assert_allclose(out.numpy(), 3.5, rtol=1e-5)
+    # ramp along width: averaging bilinear samples of a linear fn is exact
+    ramp = np.broadcast_to(np.arange(8, dtype=np.float32),
+                           (1, 1, 8, 8)).copy()
+    out = roi_align(paddle.to_tensor(ramp), paddle.to_tensor(rois),
+                    paddle.to_tensor(np.array([1])), output_size=4,
+                    aligned=False)
+    # bin centers along w: 1, 3, 5, 7 -> ramp values clipped by border
+    got = out.numpy()[0, 0, 0]
+    np.testing.assert_allclose(got, [1.0, 3.0, 5.0, 6.875], atol=0.15)
+
+
+def test_roi_align_grad():
+    x = paddle.to_tensor(rng.standard_normal((1, 2, 8, 8)).astype(np.float32),
+                         stop_gradient=False)
+    rois = paddle.to_tensor(np.array([[1, 1, 6, 6]], np.float32))
+    out = roi_align(x, rois, paddle.to_tensor(np.array([1])), output_size=2)
+    out.sum().backward()
+    assert x.grad is not None and np.abs(x.grad.numpy()).sum() > 0
+
+
+def test_statistics_ops():
+    x = rng.standard_normal(200).astype(np.float32)
+    h = paddle.histogram(paddle.to_tensor(x), bins=16)
+    assert int(h.numpy().sum()) == 200
+    q = paddle.quantile(paddle.to_tensor(x), 0.5)
+    np.testing.assert_allclose(float(q), np.quantile(x, 0.5), rtol=1e-5)
+    v, i = paddle.kthvalue(paddle.to_tensor(x), 10)
+    np.testing.assert_allclose(float(v), np.sort(x)[9], rtol=1e-6)
+    d = paddle.diff(paddle.to_tensor(x))
+    np.testing.assert_allclose(d.numpy(), np.diff(x), rtol=1e-6)
+    lc = paddle.logcumsumexp(paddle.to_tensor(x[:10]))
+    np.testing.assert_allclose(lc.numpy(),
+                               np.log(np.cumsum(np.exp(x[:10]))), rtol=1e-4)
+    b = paddle.bucketize(paddle.to_tensor(np.array([0.5, 2.5])),
+                         paddle.to_tensor(np.array([0.0, 1.0, 2.0, 3.0])))
+    assert b.numpy().tolist() == [1, 3]
+
+
+def test_mobilenet_v2():
+    from paddle_tpu.vision import mobilenet_v2
+
+    paddle.seed(0)
+    m = mobilenet_v2(num_classes=10)
+    m.eval()
+    out = m(paddle.randn([2, 3, 32, 32]))
+    assert out.shape == [2, 10]
+    n = sum(p.size for p in m.parameters())
+    assert 2.0e6 < n < 3.6e6  # ~2.2M + classifier
+
+
+def test_zero_stage2_shards_opt_state():
+    """ZeRO-2: optimizer accumulators shard over dp while params replicate."""
+    mesh = dist.init_mesh({"dp": 8})
+    try:
+        net = nn.Linear(16, 16)
+        opt = paddle.optimizer.Adam(parameters=net.parameters())
+        net, opt, _ = dist.group_sharded_parallel(net, opt, level="os_g")
+        step = paddle.jit.TrainStep(net, lambda o, t: ((o - t) ** 2).mean(),
+                                    opt, mesh=mesh)
+        from jax.sharding import PartitionSpec as P
+
+        # params replicated, moments sharded on dp
+        wspec = step.params["weight"].sharding.spec
+        assert not any(e is not None for e in tuple(wspec))
+        m1 = step.opt_state["weight"]["moment1"]
+        assert "dp" in str(m1.sharding.spec)
+        x = paddle.randn([8, 16])
+        loss = step(x, x)
+        assert np.isfinite(float(loss))
+    finally:
+        dist.set_mesh(None)
+
+
+def test_zero_stage3_shards_params():
+    mesh = dist.init_mesh({"dp": 8})
+    try:
+        net = nn.Linear(16, 16)
+        opt = paddle.optimizer.Adam(parameters=net.parameters())
+        net, opt, _ = dist.group_sharded_parallel(net, opt, level="p_g_os")
+        step = paddle.jit.TrainStep(net, lambda o, t: ((o - t) ** 2).mean(),
+                                    opt, mesh=mesh)
+        assert "dp" in str(step.params["weight"].sharding.spec)
+        loss = step(paddle.randn([8, 16]), paddle.randn([8, 16]))
+        assert np.isfinite(float(loss))
+    finally:
+        dist.set_mesh(None)
