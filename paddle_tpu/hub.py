@@ -1,0 +1,34 @@
+"""paddle.hub — model loading from local repos (reference:
+python/paddle/hapi/hub.py). Zero-egress environment: only source='local'."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no hubconf.py in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def list(repo_dir, source="local"):  # noqa: A001
+    assert source == "local", "only source='local' (no egress)"
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local"):  # noqa: A001
+    assert source == "local"
+    return getattr(_load_hubconf(repo_dir), model).__doc__
+
+
+def load(repo_dir, model, source="local", **kwargs):
+    assert source == "local", "only source='local' (no egress)"
+    return getattr(_load_hubconf(repo_dir), model)(**kwargs)

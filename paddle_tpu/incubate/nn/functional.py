@@ -19,7 +19,7 @@ def fused_multi_head_attention(q, k, v, causal=False, **kwargs):
 
 def variable_length_memory_efficient_attention(q, k, v, seq_lens=None,
                                                kv_seq_lens=None, mask=None,
-                                               scale=None, causal=True):
+                                               scale=None, causal=False):
     """Variable-length attention: seq_lens/mask build a key-padding mask
     (reference incubate op semantics). Layout [b, s, h, d]."""
     attn_mask = None
@@ -32,6 +32,7 @@ def variable_length_memory_efficient_attention(q, k, v, seq_lens=None,
 
         lens = kv_seq_lens if kv_seq_lens is not None else seq_lens
         lv = lens._value if isinstance(lens, Tensor) else jnp.asarray(lens)
+        lv = lv.reshape(-1)  # reference documents shape [batch, 1]
         sk = k.shape[1]
         valid = jnp.arange(sk)[None, :] < lv[:, None]        # [b, sk]
         attn_mask = Tensor._wrap(valid[:, None, None, :])    # [b, 1, 1, sk]

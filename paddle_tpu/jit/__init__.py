@@ -3,4 +3,5 @@
 from paddle_tpu.jit.api import (  # noqa: F401
     StaticFunction, TrainStep, eval_step, load, save, to_static,
 )
+from paddle_tpu.jit.control_flow import cond, scan, switch_case, while_loop  # noqa: F401
 from paddle_tpu.jit.functionalize import Functionalized, functionalize  # noqa: F401

@@ -1,0 +1,149 @@
+"""Structured control flow: cond / while_loop / switch_case / scan.
+
+Reference: python/paddle/static/nn/control_flow.py (while_loop:755,
+cond:1637 building PIR if/while ops) and the SOT graph-break machinery for
+dygraph control flow.
+
+TPU-native: these map straight onto lax.cond/while_loop/switch/scan and work
+in BOTH universes — eagerly, cond/switch_case/scan record ONE tape node whose
+backward is the captured jax.vjp, so eager gradients flow through them; under
+jit/functionalize they trace to XLA control-flow ops. while_loop is
+forward-only for reverse-mode AD (lax.while_loop has no VJP — use `scan` or
+a bounded python loop when gradients through the iteration are needed).
+Branch/body functions are written in the eager Tensor API.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.registry import OPS, OpDef, dispatch
+
+
+def _unwrap(tree):
+    return jax.tree_util.tree_map(
+        lambda t: t._value if isinstance(t, Tensor) else t, tree,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _wrap(tree):
+    return jax.tree_util.tree_map(
+        lambda v: Tensor._wrap(v) if hasattr(v, "shape") else v, tree)
+
+
+def _lift(fn):
+    """Branch/body -> pure fn over jax values. Inner tape recording is off:
+    the WHOLE control-flow op records as one node (its vjp differentiates),
+    so inner nodes must not land on the tape."""
+    from paddle_tpu.autograd.engine import no_grad
+
+    def pure(*vals):
+        with no_grad():
+            out = fn(*_wrap(vals))
+        return _unwrap(out)
+
+    return pure
+
+
+def _dispatch_ctrl(kind: str, key_fns, impl, tensor_args: tuple):
+    """Route a built control-flow closure through the dispatcher as a
+    differentiable op (same pattern as parallel.recompute). The op returns a
+    FLAT tuple of arrays (dispatch requirement); the result is re-nested to
+    the impl's original structure with Tensor leaves."""
+    treedef_box = [None]
+
+    def flat_impl(*vals):
+        out = impl(*vals)
+        flat, treedef = jax.tree_util.tree_flatten(out)
+        treedef_box[0] = treedef
+        return tuple(flat) if len(flat) != 1 else flat[0]
+
+    name = f"_{kind}_" + "_".join(str(id(f)) for f in key_fns)
+    if name not in OPS:
+        OPS[name] = OpDef(name, flat_impl, diff=True, dynamic=True,
+                          method=False)
+    else:
+        OPS[name].impl = flat_impl  # rebind: closure captures this call's attrs
+    out = dispatch(name, tensor_args, {})
+    leaves = list(out) if isinstance(out, tuple) else [out]
+    return jax.tree_util.tree_unflatten(treedef_box[0], leaves)
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, operands=()):
+    """paddle.static.nn.cond — both branches traced (XLA requirement), one
+    executed. Differentiable w.r.t. `operands` in both universes."""
+    p = pred._value if isinstance(pred, Tensor) else jnp.asarray(pred)
+
+    def impl(ops_tuple):
+        return lax.cond(p, _lift(true_fn), _lift(false_fn), *ops_tuple)
+
+    return _dispatch_ctrl("cond", (true_fn, false_fn), impl,
+                          (tuple(operands),))
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence):
+    """paddle.static.nn.while_loop. loop_vars must keep fixed shapes/dtypes
+    across iterations (XLA static-shape rule); the body may return a list or
+    a tuple (both are paddle conventions). Forward-only for reverse-mode AD
+    — see module docstring."""
+    init = _unwrap(tuple(loop_vars))
+
+    def c(vals):
+        out = _lift(cond_fn)(*vals)
+        return out if not hasattr(out, "shape") else jnp.squeeze(out)
+
+    def b(vals):
+        out = _lift(body_fn)(*vals)
+        if isinstance(out, (list, tuple)):
+            return tuple(out)
+        return (out,)
+
+    out = lax.while_loop(c, b, init)
+    return list(_wrap(out))
+
+
+def switch_case(branch_index, branch_fns, default=None):
+    """paddle.static.nn.switch_case. Differentiable w.r.t. closure operands
+    is NOT supported (branches take no operands in the paddle API)."""
+    idx = branch_index._value if isinstance(branch_index, Tensor) \
+        else jnp.asarray(branch_index)
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+        # map arbitrary keys onto 0..n-1 (+ default at n)
+        idx = sum(jnp.where(idx == k, i, 0) for i, k in enumerate(keys)) \
+            + jnp.where(jnp.isin(idx, jnp.asarray(keys)), 0, len(keys))
+        if default is not None:
+            fns = fns + [default]
+    else:
+        fns = list(branch_fns)
+        if default is not None:
+            fns = fns + [default]
+    out = lax.switch(jnp.clip(idx, 0, len(fns) - 1),
+                     [_lift(f) for f in fns])
+    return _wrap(out)
+
+
+def scan(body_fn: Callable, init, xs, length=None):
+    """jax-style scan for fast sequential models. Differentiable in both
+    universes (records one tape node eagerly)."""
+
+    def impl(init_v, xs_v):
+        def b(carry, x):
+            from paddle_tpu.autograd.engine import no_grad
+
+            with no_grad():
+                c, y = body_fn(_wrap(carry), _wrap(x))
+            return _unwrap(c), _unwrap(y)
+
+        return lax.scan(b, init_v, xs_v, length=length)
+
+    init_arg = tuple(init) if isinstance(init, (list, tuple)) else init
+    xs_arg = tuple(xs) if isinstance(xs, (list, tuple)) else xs
+    carry, ys = _dispatch_ctrl("scan", (body_fn,), impl, (init_arg, xs_arg))
+    return carry, ys

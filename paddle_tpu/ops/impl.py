@@ -1372,8 +1372,11 @@ def mode(x, axis=-1, keepdim=False):
         pos = jnp.where(v == winner, jnp.arange(v.shape[0]), -1)
         return winner, jnp.max(pos)
 
-    out_v = jnp.apply_along_axis(lambda v: mode_1d(v)[0], axis, x)
-    out_i = jnp.apply_along_axis(lambda v: mode_1d(v)[1], axis, x)
+    moved = jnp.moveaxis(x, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vs, idxs = jax.vmap(mode_1d)(flat)  # one pass computes both outputs
+    out_v = vs.reshape(moved.shape[:-1])
+    out_i = idxs.reshape(moved.shape[:-1])
     if keepdim:
         out_v = jnp.expand_dims(out_v, axis)
         out_i = jnp.expand_dims(out_i, axis)

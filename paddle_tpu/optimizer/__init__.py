@@ -5,5 +5,6 @@ from paddle_tpu.optimizer.clip import (  # noqa: F401
     ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
 )
 from paddle_tpu.optimizer.optimizer import (  # noqa: F401
-    SGD, Adagrad, Adam, AdamW, Momentum, Optimizer, RMSProp,
+    SGD, Adagrad, Adam, AdamW, ExponentialMovingAverage, Lamb, LookAhead,
+    Momentum, Optimizer, RMSProp,
 )

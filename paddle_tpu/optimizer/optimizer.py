@@ -324,3 +324,136 @@ class Adagrad(Optimizer):
         acc = state["moment"] + g * g
         new_p = p.astype(jnp.float32) - lr * g / (jnp.sqrt(acc) + self._eps)
         return new_p.astype(p.dtype), {"moment": acc}
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments for large-batch training
+    (reference: python/paddle/optimizer/lamb.py)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _param_decays(self, p):
+        if self._exclude_fn is not None:
+            return not self._exclude_fn(p)
+        return True
+
+    def _decays_name(self, name):
+        # functional (TrainStep) path: the predicate receives the parameter
+        # NAME (the compiled step has no Tensor objects)
+        if self._exclude_fn is not None:
+            return not self._exclude_fn(name)
+        return True
+
+    def _init_state(self, value):
+        return {"moment1": jnp.zeros(value.shape, jnp.float32),
+                "moment2": jnp.zeros(value.shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd, step):
+        g = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        step = jnp.asarray(step).astype(jnp.float32)
+        mhat = m / (1 - self._beta1**step)
+        vhat = v / (1 - self._beta2**step)
+        update = mhat / (jnp.sqrt(vhat) + self._eps) + wd * p32
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+        u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
+        trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        return (p32 - lr * trust * update).astype(p.dtype), \
+            {"moment1": m, "moment2": v}
+
+
+class LookAhead(Optimizer):
+    """k-step lookahead wrapper (reference:
+    python/paddle/incubate/optimizer/lookahead.py)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._parameter_list = inner_optimizer._parameter_list
+        self._weight_decay = getattr(inner_optimizer, "_weight_decay", 0.0)
+        # slow weights snapshot the parameters at construction — lazy init
+        # would capture already-advanced fast weights
+        self._slow = {id(p): jnp.array(p._value)
+                      for p in self._parameter_list or []}
+        self._steps = 0
+        self._grad_clip = None
+        self._lr_scheduler = getattr(inner_optimizer, "_lr_scheduler", None)
+        self._accumulators = {}
+        self._step_count = 0
+
+    def get_lr(self):
+        return self.inner.get_lr()
+
+    @no_grad()
+    def step(self):
+        self.inner.step()
+        self._steps += 1
+        if self._steps % self.k == 0:
+            for p in self._parameter_list or []:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (p._value - slow)
+                self._slow[id(p)] = slow
+                p._value = slow
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # functional (TrainStep) path: slow weights live in the optimizer state
+    def _init_state(self, value):
+        st = self.inner._init_state(value)
+        # copy=True: the slow slot must be its OWN buffer — sharing the
+        # param's buffer would double-donate it in the compiled step
+        st["slow"] = jnp.array(value, dtype=jnp.float32, copy=True)
+        return st
+
+    def _decays_name(self, name):
+        return self.inner._decays_name(name)
+
+    def _update(self, p, g, state, lr, wd, step):
+        inner_state = {k: v for k, v in state.items() if k != "slow"}
+        new_p, new_inner = self.inner._update(p, g, inner_state, lr, wd, step)
+        slow = state["slow"]
+        sync = (jnp.asarray(step) % self.k) == 0
+        blended = slow + self.alpha * (new_p.astype(jnp.float32) - slow)
+        new_slow = jnp.where(sync, blended, slow)
+        new_p = jnp.where(sync, blended.astype(new_p.dtype), new_p)
+        new_inner["slow"] = new_slow
+        return new_p, new_inner
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference:
+    python/paddle/incubate/optimizer/... / static ExponentialMovingAverage).
+    apply()/restore() swap EMA weights in and out for evaluation."""
+
+    def __init__(self, parameters, decay=0.999):
+        self._params = list(parameters)
+        self.decay = decay
+        self._ema = {id(p): jnp.array(p._value) for p in self._params}
+        self._backup = {}
+
+    @no_grad()
+    def update(self):
+        d = self.decay
+        for p in self._params:
+            self._ema[id(p)] = d * self._ema[id(p)] + (1 - d) * p._value
+
+    def apply(self):
+        for p in self._params:
+            self._backup[id(p)] = p._value
+            p._value = self._ema[id(p)]
+
+    def restore(self):
+        for p in self._params:
+            p._value = self._backup.pop(id(p))

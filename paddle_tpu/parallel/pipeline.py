@@ -40,7 +40,8 @@ def stack_stage_params(param_dicts):
 
 
 def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params,
-                   x_micro, mesh: Mesh, num_micro: int | None = None):
+                   x_micro, mesh: Mesh, num_micro: int | None = None,
+                   remat: bool = False):
     """Run micro-batches through the stage pipeline.
 
     stage_fn(stage_params, h) -> h : one stage's computation (may itself be
@@ -49,8 +50,15 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params,
         (total_stages = npp * stages_per_device).
     x_micro: [num_micro, micro_batch, ...] inputs (replicated w.r.t. 'pp').
 
+    remat=True rematerializes each stage call in backward (the reference's
+    recompute-in-pipeline combination), bounding activation memory to one
+    micro-batch per stage — the GPipe memory profile with recompute, which
+    is what 1F1B buys; the schedule itself stays GPipe-shaped.
+
     Returns [num_micro, micro_batch, ...] last-stage outputs.
     """
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
     npp = mesh.shape["pp"]
     if num_micro is None:
         num_micro = x_micro.shape[0]

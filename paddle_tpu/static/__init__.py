@@ -15,6 +15,7 @@ import numpy as np
 
 from paddle_tpu.core.place import Place
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.static import nn  # noqa: F401
 from paddle_tpu.static.program import (  # noqa: F401
     Program, _Symbolic, default_main_program, default_startup_program,
     is_symbolic, program_guard,

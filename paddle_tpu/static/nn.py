@@ -1,0 +1,3 @@
+"""paddle.static.nn — control flow (reference: static/nn/control_flow.py).
+Maps to lax control-flow ops; usable in both universes."""
+from paddle_tpu.jit.control_flow import cond, switch_case, while_loop  # noqa: F401

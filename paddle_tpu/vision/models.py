@@ -251,6 +251,17 @@ class _InvertedResidual(Layer):
         return x + out if self.use_res else out
 
 
+def _make_divisible(v, divisor=8, min_value=None):
+    """Reference channel rounding (mobilenetv2.py) — keeps state_dict shapes
+    compatible for non-unit scales."""
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
 class MobileNetV2(Layer):
     """Reference: vision/models/mobilenetv2.py."""
 
@@ -261,12 +272,12 @@ class MobileNetV2(Layer):
             (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
             (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
         ]
-        inp = int(32 * scale)
-        last = int(1280 * max(1.0, scale))
+        inp = _make_divisible(32 * scale, 8)
+        last = _make_divisible(1280 * max(1.0, scale), 8)
         feats = [nn.Conv2D(3, inp, 3, stride=2, padding=1, bias_attr=False),
                  nn.BatchNorm2D(inp), nn.ReLU6()]
         for t, c, n, s in cfg:
-            oup = int(c * scale)
+            oup = _make_divisible(c * scale, 8)
             for i in range(n):
                 feats.append(_InvertedResidual(inp, oup, s if i == 0 else 1, t))
                 inp = oup

@@ -1,0 +1,144 @@
+"""Lamb/LookAhead/EMA, control flow, hub, pipeline remat tests."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import parallel as dist
+
+rng = np.random.default_rng(29)
+
+
+def test_lamb_converges():
+    paddle.seed(0)
+    w = paddle.to_tensor(rng.standard_normal((8, 8)).astype(np.float32),
+                         stop_gradient=False)
+    w.trainable = True
+    opt = paddle.optimizer.Lamb(parameters=[w], learning_rate=0.05)
+    first = None
+    for _ in range(20):
+        loss = (w ** 2).sum()
+        if first is None:
+            first = float(loss)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float((w ** 2).sum()) < first * 0.3
+
+
+def test_lookahead_slow_weights():
+    w = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+    w.trainable = True
+    inner = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    la = paddle.optimizer.LookAhead(inner, alpha=0.5, k=2)
+    vals = []
+    for _ in range(2):
+        (w * 2.0).sum().backward()
+        la.step()
+        la.clear_grad()
+        vals.append(float(w.numpy()[0]))
+    # after k=2 inner steps (1.0 -> 0.8 -> 0.6), slow update: 1 + 0.5*(0.6-1)
+    np.testing.assert_allclose(vals[-1], 0.8, rtol=1e-5)
+
+
+def test_ema_apply_restore():
+    w = paddle.to_tensor(np.zeros(3, np.float32), stop_gradient=False)
+    w.trainable = True
+    ema = paddle.optimizer.ExponentialMovingAverage([w], decay=0.5)
+    w._value = w._value + 2.0
+    ema.update()  # ema = 0.5*0 + 0.5*2 = 1
+    ema.apply()
+    np.testing.assert_allclose(w.numpy(), 1.0)
+    ema.restore()
+    np.testing.assert_allclose(w.numpy(), 2.0)
+
+
+def test_cond_and_while_eager_and_jit():
+    x = paddle.to_tensor([3.0])
+    hi = paddle.jit.cond(paddle.to_tensor(True), lambda a: a * 2,
+                         lambda a: a * 0, (x,))
+    np.testing.assert_allclose(hi.numpy() if not isinstance(hi, (list, tuple))
+                               else hi[0].numpy(), [6.0])
+    i, s = paddle.jit.while_loop(lambda i, s: i < 4,
+                                 lambda i, s: (i + 1, s + i * i),
+                                 [paddle.to_tensor(0), paddle.to_tensor(0)])
+    assert int(s) == 0 + 1 + 4 + 9
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x, steps):
+            def body(i, h):
+                return i + 1, self.fc(h)
+
+            _, out = paddle.jit.while_loop(
+                lambda i, h: i < steps, body,
+                [paddle.to_tensor(0), x])
+            return out
+
+    net = Net().eval()
+    sf = paddle.jit.to_static(net)
+    out = sf(paddle.ones([1, 4]), paddle.to_tensor(3))
+    # == fc applied 3 times
+    ref = paddle.ones([1, 4])
+    for _ in range(3):
+        ref = net.fc(ref)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+
+def test_switch_case():
+    out = paddle.jit.switch_case(
+        paddle.to_tensor(1),
+        [lambda: paddle.to_tensor([10.0]), lambda: paddle.to_tensor([20.0]),
+         lambda: paddle.to_tensor([30.0])])
+    np.testing.assert_allclose(out.numpy(), [20.0])
+
+
+def test_hub_local(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_model(width=4):\n"
+        "    '''A tiny model.'''\n"
+        "    import paddle_tpu.nn as nn\n"
+        "    return nn.Linear(width, width)\n")
+    models = paddle.hub.list(str(tmp_path))
+    assert "tiny_model" in models
+    assert "tiny" in paddle.hub.help(str(tmp_path), "tiny_model")
+    m = paddle.hub.load(str(tmp_path), "tiny_model", width=6)
+    assert m.weight.shape == [6, 6]
+
+
+def test_pipeline_remat_parity():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+
+    mesh = dist.init_mesh({"dp": 2, "pp": 2, "tp": 2})
+    try:
+        d = 8
+        ws = [rng.standard_normal((d, d)).astype(np.float32) * 0.3
+              for _ in range(2)]
+        stacked = stack_stage_params([{"w": w} for w in ws])
+        x = jnp.asarray(rng.standard_normal((2, 2, d)).astype(np.float32))
+
+        def stage_fn(p, h):
+            return jnp.tanh(h @ p["w"])
+
+        out_plain = pipeline_apply(stage_fn, stacked, x, mesh)
+        out_remat = pipeline_apply(stage_fn, stacked, x, mesh, remat=True)
+        np.testing.assert_allclose(np.asarray(out_plain),
+                                   np.asarray(out_remat), rtol=1e-6)
+
+        g1 = jax.grad(lambda p: pipeline_apply(
+            stage_fn, p, x, mesh).sum())(stacked)
+        g2 = jax.grad(lambda p: pipeline_apply(
+            stage_fn, p, x, mesh, remat=True).sum())(stacked)
+        np.testing.assert_allclose(np.asarray(g1["w"]), np.asarray(g2["w"]),
+                                   rtol=1e-5)
+    finally:
+        dist.set_mesh(None)
