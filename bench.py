@@ -21,15 +21,24 @@ def main():
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import GPT, GPTConfig, gpt_loss_fn
 
+    import os
+    import sys
+
     backend = jax.default_backend()
     # GPT-2-small-class config; fits one v5e chip with AdamW fp32 state
-    cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
-                    num_heads=12, max_seq_len=1024, dropout=0.0)
-    batch, seq = 8, 1024
+    layers = int(os.environ.get("BENCH_LAYERS", "12"))
+    hidden = int(os.environ.get("BENCH_HIDDEN", "768"))
+    cfg = GPTConfig(vocab_size=32768, hidden_size=hidden, num_layers=layers,
+                    num_heads=max(hidden // 64, 1), max_seq_len=1024,
+                    dropout=0.0)
+    batch, seq = int(os.environ.get("BENCH_BATCH", "8")), 1024
     if backend == "cpu":  # CI / fallback sizing
         cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
                         num_heads=4, max_seq_len=256)
         batch, seq = 2, 256
+    print(f"# bench config: layers={cfg.num_layers} "
+          f"hidden={cfg.hidden_size} batch={batch} backend={backend}",
+          file=sys.stderr, flush=True)
 
     paddle.seed(0)
     model = GPT(cfg)
@@ -43,6 +52,7 @@ def main():
     toks = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)))
 
     # warmup (compile) + 2 steps
+    print("# compiling train step...", file=sys.stderr, flush=True)
     t0 = time.time()
     loss = step(toks, toks)
     jax.block_until_ready(step.params)
@@ -70,9 +80,6 @@ def main():
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.55, 4),
     }))
-    # diagnostics on stderr-ish second line (driver reads line 1)
-    import sys
-
     print(f"# backend={backend} params={n_params/1e6:.1f}M "
           f"step={dt*1000:.1f}ms compile={compile_s:.1f}s "
           f"loss={float(loss):.3f} mfu={mfu:.3f}", file=sys.stderr)

@@ -344,15 +344,89 @@ def eval_step(model: Layer, n_inputs: int = 1):
 
 
 def save(layer, path, input_spec=None):
-    """jit.save — reference python/paddle/jit/api.py jit.save. V1: state_dict
-    + class info; AOT XLA export lands with the serving module."""
+    """jit.save — reference python/paddle/jit/api.py jit.save (traced program
+    + params for deployment).
+
+    With input_spec (list of static.InputSpec), the layer's forward is AOT-
+    exported as a serialized StableHLO module (jax.export) alongside the
+    state_dict — the compiled artifact survives process/version boundaries,
+    the analogue of the reference's saved inference program. Without
+    input_spec, only state_dict + class info are saved."""
     from paddle_tpu.framework import io_api
 
-    io_api.save({"state_dict": layer.state_dict(),
-                 "class": type(layer).__name__}, path)
+    payload = {"state_dict": layer.state_dict(),
+               "class": type(layer).__name__}
+    if input_spec is not None:
+        from jax import export as jexport
+
+        from paddle_tpu.core.dtype import to_jax_dtype
+
+        func = functionalize(layer)
+        was_training = layer.training
+        layer.eval()
+        try:
+            def fwd(params, buffers, *args):
+                out, _ = func.apply(params, buffers, None, False, *args)
+                return out
+
+            # dynamic dims (-1/None) become jax.export symbolic dims so the
+            # exported module serves any size along them
+            sym_names = iter("abcdefghijklmnop")
+            avals = []
+            for spec in input_spec:
+                dims = []
+                for s_ in spec.shape:
+                    if s_ in (-1, None):
+                        dims.append(next(sym_names))
+                    else:
+                        dims.append(str(s_))
+                shape = jexport.symbolic_shape(",".join(dims)) \
+                    if any(not d.isdigit() for d in dims) \
+                    else tuple(int(d) for d in dims)
+                avals.append(jax.ShapeDtypeStruct(
+                    shape, to_jax_dtype(getattr(spec, "dtype", "float32"))))
+            exported = jexport.export(jax.jit(fwd))(
+                {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                 for k, v in func.param_values().items()},
+                {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                 for k, v in func.buffer_values().items()}, *avals)
+            payload["stablehlo"] = exported.serialize()
+            payload["param_names"] = list(func.param_values().keys())
+            payload["buffer_names"] = list(func.buffer_values().keys())
+            payload["input_shapes"] = [list(spec.shape)
+                                       for spec in input_spec]
+        finally:
+            if was_training:
+                layer.train()
+    io_api.save(payload, path)
 
 
 def load(path):
+    """Returns the saved payload; if a StableHLO module was exported, the
+    payload contains a ready `run(*inputs)` callable rehydrated via
+    jax.export.deserialize (params baked in at call time)."""
     from paddle_tpu.framework import io_api
 
-    return io_api.load(path)
+    payload = io_api.load(path)
+    blob = payload.get("stablehlo")
+    if blob is not None:
+        from jax import export as jexport
+
+        exported = jexport.deserialize(blob)
+        state = payload["state_dict"]
+        # only the PARAMETER entries were traced as the module's first arg;
+        # state_dict also holds persistable buffers (e.g. BN stats)
+        names = payload.get("param_names")
+        bnames = payload.get("buffer_names", [])
+        params = {k: t._value for k, t in state.items()
+                  if names is None or k in names}
+        buffers = {k: state[k]._value for k in bnames}
+
+        def run(*inputs):
+            vals = [i._value if isinstance(i, Tensor) else jnp.asarray(i)
+                    for i in inputs]
+            out = exported.call(params, buffers, *vals)
+            return jax.tree_util.tree_map(lambda v: Tensor._wrap(v), out)
+
+        payload["run"] = run
+    return payload

@@ -97,3 +97,37 @@ class Recall(Metric):
     def accumulate(self):
         denom = self.tp + self.fn
         return self.tp / denom if denom else 0.0
+
+
+class Auc(Metric):
+    """ROC-AUC via histogram buckets (reference: metric/metrics.py Auc —
+    same bucketed estimator)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        p = np.asarray(preds.numpy() if isinstance(preds, Tensor) else preds)
+        if p.ndim == 2:  # [N, 2] class probabilities
+            p = p[:, 1]
+        l = np.asarray(labels.numpy() if isinstance(labels, Tensor)
+                       else labels).reshape(-1)
+        idx = np.clip((p * self.num_thresholds).astype(int), 0,
+                      self.num_thresholds)
+        np.add.at(self._stat_pos, idx, l == 1)
+        np.add.at(self._stat_neg, idx, l == 0)
+
+    def accumulate(self):
+        tot_pos = tot_neg = auc = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            new_pos = tot_pos + self._stat_pos[i]
+            new_neg = tot_neg + self._stat_neg[i]
+            auc += (new_neg - tot_neg) * (tot_pos + new_pos) / 2.0
+            tot_pos, tot_neg = new_pos, new_neg
+        denom = tot_pos * tot_neg
+        return float(auc / denom) if denom else 0.0

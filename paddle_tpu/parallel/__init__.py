@@ -11,7 +11,8 @@ from paddle_tpu.parallel.api import (  # noqa: F401
     shard_layer, shard_tensor, sharding_constraint,
 )
 from paddle_tpu.parallel.collective import (  # noqa: F401
-    Group, ReduceOp, all_gather, all_reduce, barrier, broadcast, new_group,
+    Group, P2POp, ReduceOp, all_gather, all_reduce, barrier, batch_isend_irecv,
+    broadcast, irecv, isend, new_group, recv, send, send_in,
 )
 from paddle_tpu.parallel.data_parallel import (  # noqa: F401
     DataParallel, group_sharded_parallel,

@@ -226,3 +226,55 @@ def _unshard_axis(value, mesh, axis: str):
             parts = jnp.split(full, n, axis=tdim)
             return jnp.stack(parts)
     raise AssertionError
+
+
+# ---------------------------------------------------------------------------
+# p2p API (reference: paddle.distributed.{send,recv,isend,irecv,
+# batch_isend_irecv} + P2pHelper pp_utils/p2p_communication.py). In the
+# compiled universe these are ppermute edges over a mesh axis.
+# ---------------------------------------------------------------------------
+
+
+class P2POp:
+    """One edge of a batched p2p exchange (reference batch_isend_irecv)."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op  # "isend" | "irecv"
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def send_in(x, axis: str, dst_offset: int = 1):
+    """In-jit: send this rank's block `dst_offset` ranks forward along the
+    axis ring; returns what this rank RECEIVES (collective_permute
+    semantics — every rank participates)."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + dst_offset) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Not implementable faithfully in single-process SPMD: there is no
+    out-of-band p2p channel between "ranks" of one XLA program. Inside
+    compiled code use `send_in` (ppermute) on a mesh axis — the pipeline
+    module (parallel/pipeline.py) shows the pattern."""
+    raise NotImplementedError(
+        "point-to-point send/recv maps onto lax.ppermute inside compiled "
+        "programs: use paddle_tpu.parallel.collective.send_in (see "
+        "parallel/pipeline.py) instead of batch_isend_irecv")
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """See batch_isend_irecv: p2p is a compiled-program concept on TPU."""
+    raise NotImplementedError(
+        "use paddle_tpu.parallel.collective.send_in inside compiled code")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "use paddle_tpu.parallel.collective.send_in inside compiled code")
+
+
+isend = send
+irecv = recv

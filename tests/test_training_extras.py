@@ -142,3 +142,50 @@ def test_pipeline_remat_parity():
                                    rtol=1e-5)
     finally:
         dist.set_mesh(None)
+
+
+def test_auc_metric():
+    from paddle_tpu.metric import Auc
+
+    auc = Auc()
+    auc.update(np.array([0.1, 0.2, 0.8, 0.9]), np.array([0, 0, 1, 1]))
+    assert auc.accumulate() == 1.0
+    auc.reset()
+    auc.update(np.array([0.9, 0.8, 0.2, 0.1]), np.array([0, 0, 1, 1]))
+    assert auc.accumulate() == 0.0
+
+
+def test_jit_save_aot_roundtrip(tmp_path):
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net.eval()
+    x = paddle.randn([1, 4])
+    ref = net(x).numpy()
+    path = str(tmp_path / "aot_model")
+    paddle.jit.save(net, path, input_spec=[InputSpec([1, 4])])
+    loaded = paddle.jit.load(path)
+    assert "run" in loaded
+    np.testing.assert_allclose(loaded["run"](x).numpy(), ref, rtol=1e-5)
+
+
+def test_eager_cond_scan_grads():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = paddle.jit.cond(paddle.to_tensor(True), lambda a: a * 2,
+                        lambda a: a * 0, (x,))
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+    xs = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    carry, ys = paddle.jit.scan(lambda c, v: (c + v, c * v),
+                                paddle.to_tensor(0.0), xs)
+    carry.backward()
+    np.testing.assert_allclose(xs.grad.numpy(), [1.0, 1.0, 1.0])
+
+
+def test_while_loop_list_body():
+    i, s = paddle.jit.while_loop(lambda i, s: i < 3,
+                                 lambda i, s: [i + 1, s + i],
+                                 [paddle.to_tensor(0), paddle.to_tensor(0)])
+    assert int(i) == 3 and int(s) == 3
