@@ -1,0 +1,124 @@
+"""Custom C++ op extensions.
+
+Reference: paddle.utils.cpp_extension (python/paddle/utils/cpp_extension/ —
+setup-less `load()` JIT-building user C++/CUDA ops) + the PD_BUILD_OP ABI
+(paddle/phi/api/ext/op_meta_info.h:1145).
+
+TPU-native design: custom C++ runs on the HOST (there is no user-written
+device code outside Pallas), so a custom op = a compiled shared library
+whose functions are invoked through `jax.pure_callback` — callable from
+eager AND inside jit/shard_map programs, with the output shape declared up
+front (the infermeta contract). Device-side custom kernels are written in
+Pallas instead (see paddle_tpu/ops/pallas/).
+
+    lib = cpp_extension.load(name="my_ops", sources=["my_ops.cpp"])
+    my_op = cpp_extension.custom_op(
+        lambda x: lib_call(lib.my_kernel, x), out_like=lambda x: x)
+    y = my_op(tensor)   # works under jit; grads via custom_vjp if given
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from typing import Callable, Optional, Sequence
+
+import jax
+import numpy as np
+
+from paddle_tpu.ops.registry import OPS, OpDef, dispatch
+
+
+def load(name: str, sources: Sequence[str], extra_cflags: Sequence[str] = (),
+         extra_ldflags: Sequence[str] = (), build_directory: str = None,
+         verbose: bool = False):
+    """Compile C++ sources into a shared library and dlopen it (the
+    reference's setup-less jit build, utils/cpp_extension/load)."""
+    build_dir = build_directory or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
+    os.makedirs(build_dir, exist_ok=True)
+    # flags are part of the artifact name: changed cflags/ldflags must not
+    # reuse a stale binary
+    tag = hashlib.sha1(" ".join(list(extra_cflags) + list(extra_ldflags))
+                       .encode()).hexdigest()[:8]
+    sopath = os.path.join(build_dir, f"lib{name}.{tag}.so")
+    newest_src = max(os.path.getmtime(s) for s in sources)
+    if not os.path.exists(sopath) or os.path.getmtime(sopath) < newest_src:
+        cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
+               + list(extra_cflags) + list(sources)
+               + ["-o", sopath] + list(extra_ldflags))
+        if verbose:
+            print(" ".join(cmd))
+        res = subprocess.run(cmd, capture_output=True, text=True)
+        if res.returncode != 0:
+            raise RuntimeError(f"cpp_extension build failed:\n{res.stderr}")
+    return ctypes.CDLL(sopath)
+
+
+def elementwise_call(cfunc, x: np.ndarray) -> np.ndarray:
+    """Invoke `void f(const float* in, float* out, int64_t n)` on an array."""
+    x = np.ascontiguousarray(x, dtype=np.float32)
+    out = np.empty_like(x)
+    cfunc.argtypes = [ctypes.POINTER(ctypes.c_float),
+                      ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+    cfunc(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+          out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+          ctypes.c_int64(x.size))
+    return out
+
+
+def custom_op(host_fn: Callable, out_like: Callable = None,
+              out_shape_dtype: Callable = None, name: Optional[str] = None,
+              vjp: Optional[Callable] = None):
+    """Register a host-side function as a framework op.
+
+    host_fn(*numpy_arrays) -> numpy array(s); runs on the host via
+    jax.pure_callback so it composes with jit/eager. Shape inference:
+    `out_like(*avals)` returns the input whose shape/dtype the output
+    mirrors, or `out_shape_dtype(*avals)` returns ShapeDtypeStruct(s).
+    Optional `vjp(inputs, cotangent) -> input cotangents` (host fn) makes
+    the op differentiable — the PD_BUILD_OP backward analogue.
+    """
+    op_name = name or f"custom_{host_fn.__name__}_{id(host_fn)}"
+
+    def impl(*vals):
+        if out_shape_dtype is not None:
+            result_shape = out_shape_dtype(*vals)
+        else:
+            src = out_like(*vals) if out_like is not None else vals[0]
+            result_shape = jax.ShapeDtypeStruct(src.shape, src.dtype)
+        return jax.pure_callback(host_fn, result_shape, *vals,
+                                 vmap_method="sequential")
+
+    if vjp is not None:
+        @jax.custom_vjp
+        def op_with_grad(*vals):
+            return impl(*vals)
+
+        def fwd(*vals):
+            return impl(*vals), vals
+
+        def bwd(res, g):
+            shapes = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                           for v in res)
+            out = jax.pure_callback(vjp, shapes, res, g,
+                                    vmap_method="sequential")
+            return tuple(out)
+
+        op_with_grad.defvjp(fwd, bwd)
+        final_impl = op_with_grad
+        diff = True
+    else:
+        final_impl = impl
+        diff = False
+
+    OPS[op_name] = OpDef(op_name, final_impl, diff=diff, dynamic=False,
+                         method=False)
+
+    def op(*tensors, **kwargs):
+        return dispatch(op_name, tensors, kwargs)
+
+    op.__name__ = op_name
+    return op

@@ -189,3 +189,38 @@ def test_while_loop_list_body():
                                  lambda i, s: [i + 1, s + i],
                                  [paddle.to_tensor(0), paddle.to_tensor(0)])
     assert int(i) == 3 and int(s) == 3
+
+
+def test_dataloader_multiprocess_workers():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class SlowDS(Dataset):
+        def __getitem__(self, i):
+            return np.full((4,), i, np.float32), np.int64(i % 3)
+
+        def __len__(self):
+            return 20
+
+    loader = DataLoader(SlowDS(), batch_size=4, num_workers=2)
+    batches = list(loader)
+    assert len(batches) == 5
+    # order preserved across workers
+    np.testing.assert_allclose(batches[0][0].numpy()[:, 0], [0, 1, 2, 3])
+    np.testing.assert_allclose(batches[4][0].numpy()[:, 0], [16, 17, 18, 19])
+
+
+def test_dataloader_worker_error_propagates():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class BadDS(Dataset):
+        def __getitem__(self, i):
+            if i == 5:
+                raise ValueError("boom at 5")
+            return np.zeros(2, np.float32)
+
+        def __len__(self):
+            return 8
+
+    loader = DataLoader(BadDS(), batch_size=4, num_workers=2)
+    with pytest.raises(RuntimeError, match="boom at 5"):
+        list(loader)
