@@ -290,6 +290,8 @@ from paddle_tpu import audio  # noqa: E402,F401
 from paddle_tpu import device  # noqa: E402,F401
 from paddle_tpu import distribution  # noqa: E402,F401
 from paddle_tpu import hub  # noqa: E402,F401
+from paddle_tpu import onnx  # noqa: E402,F401
+from paddle_tpu import sysconfig  # noqa: E402,F401
 from paddle_tpu import incubate  # noqa: E402,F401
 from paddle_tpu import text  # noqa: E402,F401
 from paddle_tpu import inference  # noqa: E402,F401

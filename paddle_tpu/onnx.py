@@ -1,0 +1,18 @@
+"""paddle.onnx — export stub.
+
+Reference: paddle.onnx.export (python/paddle/onnx/export.py, backed by the
+external paddle2onnx package). In this stack the portable compiled artifact
+is StableHLO (paddle.jit.save with input_spec) — the XLA-world equivalent of
+an ONNX export; a true ONNX emitter would need an ONNX runtime/converter
+dependency this environment doesn't ship.
+"""
+
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export is not available (no paddle2onnx/onnx dependency in "
+        "this build). Use paddle_tpu.jit.save(layer, path, input_spec=...) "
+        "to produce a portable serialized StableHLO module instead."
+    )

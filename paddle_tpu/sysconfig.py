@@ -1,0 +1,13 @@
+"""paddle.sysconfig — include/lib paths (reference: python/paddle/sysconfig.py)."""
+
+import os
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    return os.path.join(_ROOT, "csrc")
+
+
+def get_lib():
+    return os.path.join(_ROOT, "lib")

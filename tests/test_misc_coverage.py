@@ -1,0 +1,152 @@
+"""Breadth coverage: samplers, transforms, callbacks, fleet topology, amp
+decorate, lr schedulers, misc API."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import parallel as dist
+
+rng = np.random.default_rng(31)
+
+
+def test_distributed_batch_sampler_partitions():
+    from paddle_tpu.io import DistributedBatchSampler, TensorDataset
+
+    ds = TensorDataset([np.arange(20)])
+    s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=0)
+    s1 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert len(i0) == len(i1) == 10
+    assert set(i0).isdisjoint(i1)
+    assert set(i0) | set(i1) == set(range(20))
+    # shuffle deterministic per epoch
+    s0.set_epoch(1)
+    a = [i for b in s0 for i in b]
+    s0.set_epoch(1)
+    assert a == [i for b in s0 for i in b]
+
+
+def test_random_split_and_subset():
+    from paddle_tpu.io import TensorDataset, random_split
+
+    ds = TensorDataset([np.arange(10)])
+    a, b = random_split(ds, [7, 3])
+    assert len(a) == 7 and len(b) == 3
+
+
+def test_transforms_random_crop_flip():
+    from paddle_tpu.vision import transforms
+
+    img = rng.integers(0, 255, (10, 10, 3)).astype(np.uint8)
+    out = transforms.RandomCrop(8)(img)
+    assert out.shape == (8, 8, 3)
+    out = transforms.RandomCrop(10, padding=2)(img)
+    assert out.shape == (10, 10, 3)
+    flipped = transforms.RandomHorizontalFlip(1.0)(img)
+    np.testing.assert_array_equal(flipped, img[:, ::-1])
+    v = transforms.RandomVerticalFlip(1.0)(img)
+    np.testing.assert_array_equal(v, img[::-1])
+
+
+def test_lr_scheduler_callback():
+    from paddle_tpu.hapi import LRSchedulerCallback, Model
+    from paddle_tpu.io import TensorDataset
+
+    net = nn.Linear(4, 2)
+    sched = paddle.optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.5)
+    opt = paddle.optimizer.SGD(learning_rate=sched,
+                               parameters=net.parameters())
+    m = Model(net)
+    m.prepare(optimizer=opt, loss=nn.MSELoss(), jit=False)
+    xs = rng.standard_normal((8, 4)).astype(np.float32)
+    ys = rng.standard_normal((8, 2)).astype(np.float32)
+    m.fit(TensorDataset([xs, ys]), batch_size=4, epochs=3, verbose=0,
+          callbacks=[LRSchedulerCallback(by_epoch=True)])
+    np.testing.assert_allclose(sched.get_lr(), 0.1 * 0.5**3)
+
+
+def test_model_checkpoint_callback(tmp_path):
+    from paddle_tpu.hapi import Model, ModelCheckpoint
+    from paddle_tpu.io import TensorDataset
+
+    net = nn.Linear(4, 2)
+    m = Model(net)
+    m.prepare(optimizer=paddle.optimizer.SGD(parameters=net.parameters()),
+              loss=nn.MSELoss(), jit=False)
+    xs = rng.standard_normal((4, 4)).astype(np.float32)
+    ys = rng.standard_normal((4, 2)).astype(np.float32)
+    m.fit(TensorDataset([xs, ys]), batch_size=4, epochs=1, verbose=0,
+          callbacks=[ModelCheckpoint(save_dir=str(tmp_path))])
+    assert (tmp_path / "final.pdparams").exists()
+
+
+def test_amp_decorate_o2():
+    net = nn.Linear(4, 4)
+    paddle.amp.decorate(net, level="O2", dtype="bfloat16")
+    assert str(net.weight.dtype) == "bfloat16"
+
+
+def test_fleet_groups_and_env():
+    strategy = dist.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2}
+    dist.fleet.init(is_collective=True, strategy=strategy)
+    try:
+        hcg = dist.fleet.get_hybrid_communicate_group()
+        g = hcg.get_model_parallel_group()
+        assert g.nranks == 2
+        assert dist.get_world_size() == 1  # single host process
+        env = dist.ParallelEnv()
+        assert env.rank == 0
+    finally:
+        dist.set_mesh(None)
+
+
+def test_onnx_stub_points_to_stablehlo():
+    with pytest.raises(NotImplementedError, match="StableHLO"):
+        paddle.onnx.export(nn.Linear(2, 2), "/tmp/x")
+
+
+def test_sysconfig_paths():
+    import os
+
+    assert os.path.isdir(paddle.sysconfig.get_include())
+
+
+def test_tensor_misc_methods():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.T.shape == [2, 2]
+    np.testing.assert_allclose(x.T.numpy(), x.numpy().T)
+    assert x.numel() == 4
+    assert isinstance(x.is_leaf, bool)
+    y = x.clone()
+    y._inplace_update(y._value * 0)
+    np.testing.assert_allclose(x.numpy()[0, 0], 1.0)  # clone is independent
+    assert paddle.is_tensor(x) and not paddle.is_tensor(5)
+    np.testing.assert_allclose(paddle.shape(x).numpy(), [2, 2])
+
+
+def test_grad_scaler_fp16_flow():
+    w = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    w.trainable = True
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+    loss = (w * 3).sum()
+    scaler.scale(loss).backward()
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(w.numpy(), 1.0 - 0.3, rtol=1e-6)
+
+
+def test_inf_grad_skips_step():
+    w = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    w.trainable = True
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+    w.grad = paddle.to_tensor(np.array([np.inf, 1.0], np.float32))
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(w.numpy(), 1.0)  # step skipped
+    assert scaler.get_scale() < 8.0  # backed off
