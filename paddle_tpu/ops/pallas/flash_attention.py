@@ -10,9 +10,9 @@ across K tiles, fp32 online softmax — only one (block_q, d) Q tile and one
 of sequence length and the attention matrix never exists in HBM. MXU does
 the two matmuls per tile; the VPU does the softmax algebra.
 
-Forward is the Pallas kernel; backward uses jax.custom_vjp with a
-rematerialized reference backward (block-sparse flash backward is a follow-up
-— forward is where serving/inference lives).
+Forward and backward are Pallas kernels (FlashAttention-2 style backward:
+a dQ kernel accumulating over K tiles and a dK/dV kernel accumulating over
+Q tiles, both recomputing P from the saved per-row log-sum-exp).
 
 Layout: [batch, seq, heads, head_dim] (paddle flash-attn convention).
 Causal masking is bottom-right aligned (tril k=sk-sq), matching the XLA
@@ -38,9 +38,43 @@ DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                      block_q: int, block_k: int, causal: bool, scale: float,
-                      seq_k: int, seq_q: int):
+def _tile_scores(q_ref, k_ref, qi, ki, block_q, block_k, causal, scale,
+                 seq_k, seq_q):
+    """Shared per-tile scaled+masked scores (ONE definition of the causal
+    mask for fwd and both bwd kernels)."""
+    q = q_ref[0].astype(jnp.float32)
+    k_tile = k_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k_tile, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_start = (seq_k - seq_q) + qi * block_q
+        q_pos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    return q, k_tile, s
+
+
+def _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+              block_q, block_k, causal, scale, seq_k, seq_q):
+    """Shared backward tile math: recompute P from lse, form dS."""
+    q, k_tile, s = _tile_scores(q_ref, k_ref, qi, ki, block_q, block_k,
+                                causal, scale, seq_k, seq_q)
+    v_tile = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0].astype(jnp.float32)[:, None]
+    delta = delta_ref[0].astype(jnp.float32)[:, None]
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(do, v_tile, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    return q, k_tile, do, p, ds
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                      acc_ref, *, block_q: int, block_k: int, causal: bool,
+                      scale: float, seq_k: int, seq_q: int):
     """One grid step: fold one K/V tile into this Q block's accumulators."""
     d = q_ref.shape[-1]
     qi = pl.program_id(1)
@@ -60,18 +94,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(live)
     def _tile():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k_tile = k_ref[0].astype(jnp.float32)
+        _, _, s = _tile_scores(q_ref, k_ref, qi, ki, block_q, block_k,
+                               causal, scale, seq_k, seq_q)
         v_tile = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_tile, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [bq, bk]
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         m = m_ref[:]
         blk_max = jnp.max(s, axis=-1, keepdims=True)
         new_m = jnp.maximum(m, blk_max)
@@ -87,11 +112,22 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     def _finish():
         o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
                     ).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # log-sum-exp per row, saved for the backward kernels
+            lse_ref[0] = (m_ref[:]
+                          + jnp.log(jnp.maximum(l_ref[:], 1e-30)))[:, 0]
+
+
+def _fwd_kernel_nolse(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                      **kw):
+    """Inference variant: no lse output (saves a discarded HBM write)."""
+    _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, None, m_ref, l_ref,
+                      acc_ref, **kw)
 
 
 def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
-                   block_k: int, interpret: bool):
-    """q/k/v: [b, s, h, d] -> out [b, s, h, d]."""
+                   block_k: int, interpret: bool, with_lse: bool = False):
+    """q/k/v: [b, s, h, d] -> out [b, s, h, d] (+ lse [b*h, sq] fp32)."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
 
@@ -100,28 +136,36 @@ def _flash_forward(q, k, v, causal: bool, scale: float, block_q: int,
     vf = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
 
     grid = (b * h, sq // block_q, sk // block_k)
-    kernel = functools.partial(
-        _flash_fwd_kernel, block_q=block_q, block_k=block_k, causal=causal,
-        scale=scale, seq_k=sk, seq_q=sq)
+    common = dict(block_q=block_q, block_k=block_k, causal=causal,
+                  scale=scale, seq_k=sk, seq_q=sq)
 
     scratch = [
         _scratch((block_q, 1)),
         _scratch((block_q, 1)),
         _scratch((block_q, d)),
     ]
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+    ]
+    o_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
+    if with_lse:
+        out, lse = pl.pallas_call(
+            functools.partial(_flash_fwd_kernel, **common),
+            out_shape=(jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+                       jax.ShapeDtypeStruct((b * h, sq), jnp.float32)),
+            grid=grid, in_specs=in_specs,
+            out_specs=(o_spec,
+                       pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi))),
+            scratch_shapes=scratch, interpret=interpret,
+        )(qf, kf, vf)
+        return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2), lse
     out = pl.pallas_call(
-        kernel,
+        functools.partial(_fwd_kernel_nolse, **common),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda bh, qi, ki: (bh, qi, 0)),
-        scratch_shapes=scratch,
-        interpret=interpret,
+        grid=grid, in_specs=in_specs, out_specs=o_spec,
+        scratch_shapes=scratch, interpret=interpret,
     )(qf, kf, vf)
     return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)
 
@@ -130,6 +174,135 @@ def _scratch(shape):
     if pltpu is not None:
         return pltpu.VMEM(shape, jnp.float32)
     return pl.pallas_call  # unreachable on CPU (interpret handles VMEM spec)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, acc_ref, *, block_q, block_k, causal, scale,
+                         seq_k, seq_q):
+    """dQ_i = scale * sum_j dS_ij K_j, dS = P * (dO V^T - delta).
+    Grid (bh, qi, ki); accumulate over ki in VMEM scratch."""
+    d = q_ref.shape[-1]
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_kb = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros((block_q, d), jnp.float32)
+
+    causal_offset = seq_k - seq_q
+    q_start = causal_offset + qi * block_q
+    live = (ki * block_k <= q_start + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _tile():
+        _, k_t, _, _, ds = _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                     delta_ref, qi, ki, block_q, block_k,
+                                     causal, scale, seq_k, seq_q)
+        acc_ref[:] += scale * jax.lax.dot_general(
+            ds, k_t, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_kb - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, block_q, block_k,
+                          causal, scale, seq_k, seq_q):
+    """dV_j = P^T dO; dK_j = scale * dS^T Q. Grid (bh, ki, qi); accumulate
+    over qi in VMEM scratch."""
+    d = q_ref.shape[-1]
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    n_qb = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros((block_k, d), jnp.float32)
+        dv_acc[:] = jnp.zeros((block_k, d), jnp.float32)
+
+    causal_offset = seq_k - seq_q
+    q_start = causal_offset + qi * block_q
+    # this q block contributes iff its LAST query can see this k tile
+    live = (q_start + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(live)
+    def _tile():
+        q, _, do, p, ds = _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                    delta_ref, qi, ki, block_q, block_k,
+                                    causal, scale, seq_k, seq_q)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc[:] += scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_qb - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, do, lse, causal, scale, block_q, block_k,
+                    interpret):
+    """Returns (dq, dk, dv) in the [b, s, h, d] layout."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    flat = lambda t, s: jnp.swapaxes(t, 1, 2).reshape(b * h, s, d)
+    qf, kf, vf = flat(q, sq), flat(k, sk), flat(v, sk)
+    of, dof = flat(o, sq), flat(do, sq)
+    # delta_i = rowsum(dO_i * O_i) — cheap elementwise, XLA fuses it
+    delta = jnp.sum(of.astype(jnp.float32) * dof.astype(jnp.float32), axis=-1)
+
+    common = dict(block_q=block_q, block_k=block_k, causal=causal,
+                  scale=scale, seq_k=sk, seq_q=sq)
+
+    # ---- dQ: grid (bh, qi, ki) -------------------------------------------
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **common),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=(b * h, sq // block_q, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qi, ki: (bh, qi, 0)),
+        scratch_shapes=[_scratch((block_q, d))],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    # ---- dK/dV: grid (bh, ki, qi) ----------------------------------------
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **common),
+        out_shape=(jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)),
+        grid=(b * h, sk // block_k, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, ki, qi: (bh, qi)),
+            pl.BlockSpec((1, block_q), lambda bh, ki, qi: (bh, qi)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+        ),
+        scratch_shapes=[_scratch((block_k, d)), _scratch((block_k, d))],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    unflat = lambda t, s: jnp.swapaxes(t.reshape(b, h, s, d), 1, 2)
+    return unflat(dq, sq), unflat(dk, sk), unflat(dv, sk)
 
 
 def _reference(q, k, v, causal, scale):
@@ -152,15 +325,15 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                              interpret, with_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, causal, scale),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _flash_backward(q, k, v, o, g, lse, causal, scale, block_q,
+                           block_k, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -187,6 +360,11 @@ def flash_attention(q, k, v, causal: bool = True, scale=None,
         interpret = jax.default_backend() != "tpu"
     block_q = min(block_q, q.shape[1])
     block_k = min(block_k, k.shape[1])
+    if causal and q.shape[1] > k.shape[1]:
+        # bottom-right alignment gives early queries ZERO visible keys; the
+        # backward lse recomputation is ill-defined for such rows (fp32
+        # absorbs log(l) into -1e30) — use the XLA path for this shape
+        return _reference(q, k, v, causal, scale)
     if not _block_shapes_ok(q, k, block_q, block_k, v=v):
         return _reference(q, k, v, causal, scale)
     return _flash(q, k, v, causal, scale, block_q, block_k, interpret)

@@ -181,3 +181,39 @@ class TestInferencePredictor:
         ref = net(paddle.to_tensor(xs)).numpy()
         np.testing.assert_allclose(np.asarray(outs[0]._value, np.float32),
                                    ref, rtol=3e-2, atol=3e-2)
+
+
+class TestFlashBackwardKernel:
+    def test_all_grads_parity_causal(self):
+        q, k, v = _qkv(b=1, s=256, h=2, d=128)
+
+        def loss_flash(q, k, v):
+            return (flash_attention(q, k, v, causal=True,
+                                    interpret=True) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (_reference(q, k, v, True, 1 / np.sqrt(128)) ** 2).sum()
+
+        g = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4)
+
+    def test_grads_cross_length(self):
+        q, _, _ = _qkv(b=1, s=128, h=1)
+        _, k, v = _qkv(b=1, s=384, h=1)
+        g = jax.grad(lambda k: flash_attention(
+            q, k, v, causal=True, interpret=True).sum())(k)
+        gr = jax.grad(lambda k: _reference(
+            q, k, v, True, 1 / np.sqrt(128)).sum())(k)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gr), rtol=2e-3,
+                                   atol=2e-4)
+
+    def test_bf16_grads_finite(self):
+        q, k, v = _qkv(b=1, s=128, h=1)
+        qb = q.astype(jnp.bfloat16)
+        g = jax.grad(lambda q: flash_attention(
+            q, k.astype(jnp.bfloat16), v.astype(jnp.bfloat16),
+            interpret=True).astype(jnp.float32).sum())(qb)
+        assert np.isfinite(np.asarray(g, dtype=np.float32)).all()
