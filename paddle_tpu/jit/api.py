@@ -289,13 +289,21 @@ class TrainStep:
         """Write compiled-side params/buffers back into the model Tensors and
         the optimizer state back into its accumulators (so
         optimizer.state_dict()/save-resume see trained moments, not the
-        init-time zeros)."""
-        self.func.write_back(self.params, self.buffers)
+        init-time zeros).
+
+        Writes back COPIES: the next __call__ donates self.params /
+        self.buffers / self.opt_state to XLA, which (on TPU, where donation
+        is honored) would otherwise delete the very buffers the model and
+        optimizer now point at — breaking the sync-then-keep-training
+        pattern (periodic checkpointing)."""
+        copy = lambda tree: jax.tree_util.tree_map(jnp.copy, tree)
+        self.func.write_back(copy(self.params), copy(self.buffers))
         name_to_tensor = dict(self.func._param_items)
         for name, st in self.opt_state.items():
             t = name_to_tensor.get(name)
             if t is not None and isinstance(st, dict):
-                self.optimizer._accumulators[id(t)] = dict(st)
+                self.optimizer._accumulators[id(t)] = {
+                    k: jnp.copy(v) for k, v in st.items()}
         self.optimizer._step_count = self._step_i
         return self.model
 

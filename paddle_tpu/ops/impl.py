@@ -903,10 +903,13 @@ def batch_norm(
         xf = x.astype(jnp.float32)
         mean = jnp.mean(xf, axis=axes)
         var = jnp.var(xf, axis=axes)
-        n = x.size / x.shape[1 if data_format == "NCHW" else -1]
-        unbiased = var * n / jnp.maximum(n - 1, 1)
+        # running_var uses the BIASED batch variance (divide by N, no Bessel
+        # correction), matching the reference phi kernel
+        # (paddle/phi/kernels/cpu/batch_norm_kernel.cc:128-157) — the torch
+        # convention (unbiased) would make eval outputs / ported checkpoints
+        # diverge from reference-trained behavior.
         new_mean = momentum * running_mean + (1 - momentum) * mean
-        new_var = momentum * running_var + (1 - momentum) * unbiased
+        new_var = momentum * running_var + (1 - momentum) * var
     else:
         mean, var = running_mean, running_var
         new_mean, new_var = running_mean, running_var

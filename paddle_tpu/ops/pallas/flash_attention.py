@@ -342,9 +342,29 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def _block_shapes_ok(q, k, block_q, block_k, v=None) -> bool:
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    return (sq % block_q == 0 and sk % block_k == 0 and d % 128 == 0
+    # d % 8 == 0: Mosaic pads sub-128 lane dims, so head_dim 64 (the GPT
+    # 512/8 flagship and most small/medium models) runs the flash kernel
+    # instead of silently falling back to the O(seq^2) XLA path.
+    return (sq % block_q == 0 and sk % block_k == 0 and d % 8 == 0
             and q.shape[:1] + q.shape[2:] == k.shape[:1] + k.shape[2:]
             and (v is None or tuple(v.shape) == tuple(k.shape)))
+
+
+_FALLBACK_WARNED: set = set()
+
+
+def _log_fallback(q, k, block_q, block_k):
+    """The silent-fallback condition is a dead-kernel bug magnet — warn once
+    per shape so it is visible which configs miss the flash path."""
+    key = (tuple(q.shape), tuple(k.shape), block_q, block_k)
+    if key not in _FALLBACK_WARNED:
+        _FALLBACK_WARNED.add(key)
+        import warnings
+
+        warnings.warn(
+            f"flash_attention: shapes q={tuple(q.shape)} k={tuple(k.shape)} "
+            f"don't tile (block_q={block_q}, block_k={block_k}); using the "
+            "O(seq^2) XLA reference path", stacklevel=3)
 
 
 def flash_attention(q, k, v, causal: bool = True, scale=None,
@@ -364,7 +384,9 @@ def flash_attention(q, k, v, causal: bool = True, scale=None,
         # bottom-right alignment gives early queries ZERO visible keys; the
         # backward lse recomputation is ill-defined for such rows (fp32
         # absorbs log(l) into -1e30) — use the XLA path for this shape
+        _log_fallback(q, k, block_q, block_k)
         return _reference(q, k, v, causal, scale)
     if not _block_shapes_ok(q, k, block_q, block_k, v=v):
+        _log_fallback(q, k, block_q, block_k)
         return _reference(q, k, v, causal, scale)
     return _flash(q, k, v, causal, scale, block_q, block_k, interpret)

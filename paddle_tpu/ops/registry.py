@@ -146,8 +146,13 @@ def _any_symbolic(obj) -> bool:
     return False
 
 
-def dispatch(name: str, args, kwargs):
-    """The generic ad_func (reference eager_gen.py:372 template)."""
+def dispatch(name: str, args, kwargs, _op=None):
+    """The generic ad_func (reference eager_gen.py:372 template).
+
+    `_op`: an unregistered OpDef dispatched directly (no OPS entry) — used
+    for one-shot closures like recompute segments, which would otherwise pin
+    their captured function in the registry forever. Direct ops never use
+    the name-keyed jit cache."""
     from paddle_tpu.core.tensor import Tensor
     from paddle_tpu.amp.state import current_cast_dtype
 
@@ -159,9 +164,9 @@ def dispatch(name: str, args, kwargs):
             _any_symbolic(args) or _any_symbolic(tuple(kwargs.values()))):
         from paddle_tpu.static.program import record_dispatch
 
-        return record_dispatch(name, args, kwargs)
+        return record_dispatch(name, args, kwargs, _op=_op)
 
-    op = OPS[name]
+    op = _op if _op is not None else OPS[name]
     tensors: List[Tensor] = []
     if op.rng:
         from paddle_tpu.core.random import default_generator
@@ -182,6 +187,7 @@ def dispatch(name: str, args, kwargs):
 
     use_jit = (
         flags.flag("FLAGS_eager_op_jit")
+        and _op is None
         and not op.dynamic
         and _hashable(args_tpl)
         and _hashable(kwargs_tpl)

@@ -90,7 +90,11 @@ class Optimizer:
             p._value = new_p
             self._accumulators[id(p)] = dict(zip(keys, new_vals))
 
-    @partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2, 4))
+    # donate only the optimizer state (arg 4), which this object exclusively
+    # owns. The parameter buffer (arg 2) is shared storage — Tensor.detach()
+    # and any externally held reference alias it, and donation would delete
+    # it under them on TPU (paddle/torch detach semantics keep it live).
+    @partial(jax.jit, static_argnums=(0, 1), donate_argnums=(4,))
     def _jit_update_impl(self, keys, p, g, state_vals, lr, wd, step):
         state = dict(zip(keys, state_vals))
         new_p, new_state = self._update(p, g.astype(p.dtype), state, lr, wd,

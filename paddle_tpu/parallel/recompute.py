@@ -30,7 +30,7 @@ def recompute(function: Callable, *args, use_reentrant=True, **kwargs):
     inputs of the checkpointed region so gradients flow to them (the
     reference PyLayer saves them as ctx inputs, recompute.py:463)."""
     from paddle_tpu.jit.functionalize import functionalize
-    from paddle_tpu.ops.registry import OPS, OpDef, dispatch
+    from paddle_tpu.ops.registry import OpDef, dispatch
 
     if isinstance(function, Layer):
         func = functionalize(function)
@@ -45,12 +45,13 @@ def recompute(function: Callable, *args, use_reentrant=True, **kwargs):
                                 *tvals[n_p:], **kwargs)
             return out
 
+        # dispatched as an unregistered OpDef: registering per-callable ops
+        # in OPS pinned every checkpointed closure forever (one leaked entry
+        # per segment per step under recompute_sequential)
         ckpt = jax.checkpoint(raw)
-        name = f"_recompute_layer_{id(function)}"
-        if name not in OPS:
-            OPS[name] = OpDef(name, ckpt, diff=True, dynamic=True,
-                              method=False)
-        return dispatch(name, tuple(ptensors) + tuple(args), {})
+        op = OpDef("_recompute_layer", ckpt, diff=True, dynamic=True,
+                   method=False)
+        return dispatch(op.name, tuple(ptensors) + tuple(args), {}, _op=op)
 
     def pure(*vals):
         from paddle_tpu.autograd.engine import no_grad
@@ -63,10 +64,8 @@ def recompute(function: Callable, *args, use_reentrant=True, **kwargs):
         return out._value if isinstance(out, Tensor) else out
 
     ckpt = jax.checkpoint(pure)
-    name = f"_recompute_{id(function)}"
-    if name not in OPS:
-        OPS[name] = OpDef(name, ckpt, diff=True, dynamic=True, method=False)
-    return dispatch(name, args, {})
+    op = OpDef("_recompute", ckpt, diff=True, dynamic=True, method=False)
+    return dispatch(op.name, args, {}, _op=op)
 
 
 def recompute_sequential(ctx: dict, functions, *args):

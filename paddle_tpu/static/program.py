@@ -31,14 +31,19 @@ from paddle_tpu.core.tensor import Tensor
 
 
 class Node:
-    __slots__ = ("op_name", "args_tpl", "kwargs_tpl", "input_ids", "out_ids")
+    __slots__ = ("op_name", "args_tpl", "kwargs_tpl", "input_ids", "out_ids",
+                 "impl")
 
-    def __init__(self, op_name, args_tpl, kwargs_tpl, input_ids, out_ids):
+    def __init__(self, op_name, args_tpl, kwargs_tpl, input_ids, out_ids,
+                 impl=None):
         self.op_name = op_name
         self.args_tpl = args_tpl
         self.kwargs_tpl = kwargs_tpl
         self.input_ids = input_ids
         self.out_ids = out_ids
+        # impl: set for direct (unregistered) ops — e.g. recompute segments —
+        # whose name has no OPS entry to look up at replay
+        self.impl = impl
 
 
 class Program:
@@ -128,7 +133,8 @@ class Program:
         for node in self.nodes:
             tvals = [env[i] for i in node.input_ids]
             kwargs = {k: _fill(v, tvals) for k, v in node.kwargs_tpl}
-            out = OPS[node.op_name].impl(*_fill(node.args_tpl, tvals), **kwargs)
+            impl = node.impl if node.impl is not None else OPS[node.op_name].impl
+            out = impl(*_fill(node.args_tpl, tvals), **kwargs)
             outs = out if isinstance(out, (tuple, list)) else (out,)
             for vid, o in zip(node.out_ids, outs):
                 env[vid] = o
@@ -195,8 +201,9 @@ def program_guard(main_program: Program, startup_program: Program = None):
         _default_main_program, _building = prev, prev_b
 
 
-def record_dispatch(name: str, args, kwargs) -> Any:
-    """Called by the eager dispatcher when an input is symbolic."""
+def record_dispatch(name: str, args, kwargs, _op=None) -> Any:
+    """Called by the eager dispatcher when an input is symbolic. `_op`: an
+    unregistered OpDef dispatched directly (see registry.dispatch)."""
     from paddle_tpu.ops.registry import OPS, _fill, _template
 
     # locate the program from any symbolic input
@@ -214,7 +221,7 @@ def record_dispatch(name: str, args, kwargs) -> Any:
     find(list(kwargs.values()))
     assert prog is not None
 
-    op = OPS[name]
+    op = _op if _op is not None else OPS[name]
     rng_key_tensor = None
     if op.rng:
         from paddle_tpu.core.random import default_generator
@@ -256,7 +263,8 @@ def record_dispatch(name: str, args, kwargs) -> Any:
     multi = isinstance(out_aval, (tuple, list))
     out_avals = list(out_aval) if multi else [out_aval]
     out_ids = [prog.new_value(a) for a in out_avals]
-    prog.nodes.append(Node(name, args_tpl, kwargs_tpl, input_ids, out_ids))
+    prog.nodes.append(Node(name, args_tpl, kwargs_tpl, input_ids, out_ids,
+                           impl=op.impl if _op is not None else None))
 
     outs = []
     for vid, aval in zip(out_ids, out_avals):

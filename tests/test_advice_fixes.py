@@ -1,0 +1,94 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_batch_norm_running_var_is_biased():
+    # reference phi kernel (batch_norm_kernel.cc:128-157) updates running_var
+    # with the BIASED batch variance (divide by N) — not torch's unbiased.
+    bn = nn.BatchNorm1D(4, momentum=0.9)
+    x = np.random.RandomState(0).randn(8, 4).astype("float32")
+    bn(paddle.to_tensor(x))
+    batch_var = x.var(axis=0)  # biased (ddof=0)
+    expected = 0.9 * np.ones(4) + 0.1 * batch_var
+    np.testing.assert_allclose(np.asarray(bn._variance._value), expected,
+                               rtol=1e-5)
+
+
+def test_recompute_does_not_grow_op_registry():
+    from paddle_tpu.ops.registry import OPS
+    from paddle_tpu.parallel import recompute_sequential
+
+    net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 8))
+    x = paddle.to_tensor(np.random.randn(2, 8).astype("float32"))
+    before = len(OPS)
+    for _ in range(5):
+        out = recompute_sequential({"segments": 2}, net, x)
+        out.sum().backward()
+        for p in net.parameters():
+            p.clear_gradient()
+    assert len(OPS) == before, "recompute leaked OPS registry entries"
+
+
+def test_recompute_gradients_still_match():
+    from paddle_tpu.parallel import recompute
+
+    net = nn.Linear(6, 3)
+    x = paddle.to_tensor(np.random.RandomState(1).randn(4, 6).astype("float32"))
+    out = recompute(net, x)
+    out.sum().backward()
+    g_ckpt = np.asarray(net.weight.grad._value)
+    net.weight.clear_gradient()
+    net(x).sum().backward()
+    np.testing.assert_allclose(g_ckpt, np.asarray(net.weight.grad._value),
+                               rtol=1e-6)
+
+
+def test_trainstep_sync_then_keep_training():
+    # ADVICE #1: sync() must not hand the model aliases of step-donated
+    # buffers; the sync-then-keep-training (periodic checkpoint) pattern.
+    model = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, lambda out, y: ((out - y) ** 2).mean(),
+                                opt)
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    y = paddle.to_tensor(np.zeros((2, 2), "float32"))
+    step(x, y)
+    step.sync()
+    sd = {k: np.asarray(v._value) for k, v in model.state_dict().items()}
+    loss2 = step(x, y)  # donates step-owned buffers again
+    step.sync()
+    for k, v in model.state_dict().items():
+        assert np.all(np.isfinite(np.asarray(v._value)))
+    assert float(loss2) > 0
+
+
+def test_detached_param_survives_optimizer_step():
+    # ADVICE #2: detach() shares storage; opt.step() must not delete it.
+    model = nn.Linear(4, 2)
+    view = model.weight.detach()
+    before = np.asarray(view._value).copy()
+    opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                parameters=model.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    model(x).sum().backward()
+    opt.step()
+    # the detached view still reads the ORIGINAL storage (paddle semantics)
+    np.testing.assert_allclose(np.asarray(view._value), before)
+
+
+def test_flash_gate_accepts_head_dim_64():
+    from paddle_tpu.ops.pallas.flash_attention import _block_shapes_ok
+    import jax.numpy as jnp
+
+    q = jnp.zeros((1, 256, 8, 64))
+    assert _block_shapes_ok(q, q, 128, 128, v=q)
+    q96 = jnp.zeros((1, 256, 8, 96))
+    assert _block_shapes_ok(q96, q96, 128, 128, v=q96)
+    q63 = jnp.zeros((1, 256, 8, 63))
+    assert not _block_shapes_ok(q63, q63, 128, 128, v=q63)
