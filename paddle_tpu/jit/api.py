@@ -317,7 +317,10 @@ class TrainStep:
             if acc:
                 cur = self.opt_state.get(name, {})
                 if set(acc) >= set(cur):
-                    self.opt_state[name] = {k: jnp.asarray(acc[k])
+                    # copy: the compiled step donates opt_state; adopting the
+                    # optimizer's accumulator arrays by reference would let
+                    # the first step delete them under the optimizer
+                    self.opt_state[name] = {k: jnp.copy(jnp.asarray(acc[k]))
                                             for k in cur}
                     restored = True
         if restored or self.optimizer._step_count:

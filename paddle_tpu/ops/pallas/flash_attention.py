@@ -350,6 +350,46 @@ def _block_shapes_ok(q, k, block_q, block_k, v=None) -> bool:
             and (v is None or tuple(v.shape) == tuple(k.shape)))
 
 
+DEFAULT_CHECK_SHAPES = ((1, 256, 4, 64), (2, 512, 8, 64), (1, 256, 4, 128))
+
+
+def validate_against_reference(shapes=DEFAULT_CHECK_SHAPES, interpret=None,
+                               tol_out=2e-3, tol_grad=5e-2, seed=0):
+    """Run the Pallas kernels (fwd + bwd) against the XLA reference path and
+    return {"max_abs_err", "shapes": [[b,s,h,d,err_o,err_g],...], "pass"}.
+
+    Single source of truth for the kernel-vs-reference criterion — used by
+    both the bench ladder's on-hardware check and the TPU pytest tier, so
+    the two can't drift apart."""
+    import numpy as np
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    rng = np.random.default_rng(seed)
+    worst = 0.0
+    checked = []
+    ok = True
+    for (b, s, h, d) in shapes:
+        q, k, v = (jnp.asarray(rng.standard_normal((b, s, h, d)),
+                               jnp.float32) for _ in range(3))
+        scale = 1.0 / math.sqrt(d)
+        o_f = _flash(q, k, v, True, scale, 128, 128, interpret)
+        o_r = _reference(q, k, v, True, scale)
+        g_f = jax.grad(lambda *a: jnp.sum(
+            _flash(*a, True, scale, 128, 128, interpret) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        g_r = jax.grad(lambda *a: jnp.sum(
+            _reference(*a, True, scale) ** 2), argnums=(0, 1, 2))(q, k, v)
+        err_o = float(jnp.max(jnp.abs(o_f - o_r)))
+        err_g = max(float(jnp.max(jnp.abs(x - y)))
+                    for x, y in zip(g_f, g_r))
+        worst = max(worst, err_o, err_g)
+        ok = ok and err_o < tol_out and err_g < tol_grad
+        checked.append([b, s, h, d, err_o, err_g])
+    return {"max_abs_err": worst, "shapes": checked, "pass": ok,
+            "interpret": interpret}
+
+
 _FALLBACK_WARNED: set = set()
 
 

@@ -164,7 +164,9 @@ class Optimizer:
             out["lr_scheduler"] = self._lr_scheduler.state_dict()
         for i, p in enumerate(self._parameter_list or []):
             for k, v in self._accumulators.get(id(p), {}).items():
-                out[f"{i}.{k}"] = Tensor._wrap(v)
+                # copy: the jitted update donates accumulator arrays, which
+                # would delete the caller's snapshot under them on TPU
+                out[f"{i}.{k}"] = Tensor._wrap(jnp.copy(v))
         return out
 
     def set_state_dict(self, state):
@@ -175,7 +177,8 @@ class Optimizer:
             st = {}
             for k, v in state.items():
                 if isinstance(k, str) and k.startswith(f"{i}."):
-                    st[k.split(".", 1)[1]] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                    st[k.split(".", 1)[1]] = jnp.copy(
+                        v._value if isinstance(v, Tensor) else jnp.asarray(v))
             if st:
                 self._accumulators[id(p)] = st
 

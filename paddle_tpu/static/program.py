@@ -99,7 +99,8 @@ class Program:
                     (k, False if k == "training" else v)
                     for k, v in kwargs_tpl)
             new.nodes.append(Node(n.op_name, n.args_tpl, kwargs_tpl,
-                                  list(n.input_ids), list(n.out_ids)))
+                                  list(n.input_ids), list(n.out_ids),
+                                  impl=n.impl))
         return new
 
     def __repr__(self):

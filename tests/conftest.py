@@ -7,17 +7,40 @@ than the reference's fake-device story").
 
 import os
 
+import pytest
+
+TPU_MODE = os.environ.get("PADDLE_TPU_TESTS") == "1"
+
 os.environ.setdefault("JAX_NUM_CPU_DEVICES", "8")
 
 import jax
 
-# must happen before the CPU client is instantiated
-jax.config.update("jax_num_cpu_devices", 8)
-try:
-    jax.config.update("jax_platforms", "cpu")
-except Exception:
-    pass
+if not TPU_MODE:
+    # must happen before the CPU client is instantiated
+    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
 
 import paddle_tpu  # noqa: E402
 
-paddle_tpu.set_device("cpu")
+if not TPU_MODE:
+    paddle_tpu.set_device("cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: hardware smoke test — runs only with PADDLE_TPU_TESTS=1 "
+        "(one-command TPU tier: PADDLE_TPU_TESTS=1 pytest -m tpu tests/)")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if "tpu" in item.keywords and not TPU_MODE:
+            item.add_marker(pytest.mark.skip(
+                reason="TPU hardware tier (set PADDLE_TPU_TESTS=1)"))
+        elif "tpu" not in item.keywords and TPU_MODE:
+            item.add_marker(pytest.mark.skip(
+                reason="CPU-mesh test skipped in TPU hardware mode"))
