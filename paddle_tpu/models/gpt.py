@@ -193,7 +193,8 @@ def gpt_loss_fn(logits, labels):
 
 
 def build_pipeline_train_step(cfg: GPTConfig, mesh: Mesh, num_micro: int = 4,
-                              lr: float = 1e-3):
+                              lr: float = 1e-3, schedule: str = "gpipe",
+                              v: int | None = None):
     """Returns (step_fn, state) where step_fn(state, tokens, labels) ->
     (new_state, loss) is jitted over the mesh with dp/pp/tp shardings.
 
@@ -202,11 +203,34 @@ def build_pipeline_train_step(cfg: GPTConfig, mesh: Mesh, num_micro: int = 4,
     leading stage axis sharded over 'pp' and rotated with ppermute
     (parallel.pipeline). tp shardings on block params ride GSPMD-auto inside
     the shard_map body.
+
+    schedule: 'gpipe' (fwd scan + autodiff), 'interleave' (VPP, v chunks per
+    device, ~v-fold bubble cut), or '1f1b' (fused fwd+bwd, O(pp) activation
+    stash) — parallel/pipeline_schedules.py; reference
+    fleet/meta_parallel/pipeline_parallel.py:684,1308.
     """
     from paddle_tpu.jit.functionalize import functionalize
     from paddle_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+    from paddle_tpu.parallel.pipeline_schedules import (
+        interleave_permutation, pipeline_1f1b, pipeline_apply_interleave,
+    )
 
-    assert cfg.num_layers % mesh.shape["pp"] == 0
+    if schedule not in ("gpipe", "1f1b", "interleave"):
+        raise ValueError(
+            f"unknown pipeline schedule {schedule!r}: "
+            "expected 'gpipe', '1f1b', or 'interleave'")
+    npp = mesh.shape["pp"]
+    assert cfg.num_layers % npp == 0
+    group = 1
+    if schedule == "interleave":
+        # v chunks per device; each virtual stage is a chain of `group`
+        # consecutive blocks (group = num_layers / (v*pp))
+        v = v or cfg.num_layers // npp
+        if cfg.num_layers % (v * npp) != 0:
+            raise ValueError(
+                f"num_layers {cfg.num_layers} not divisible by v*pp = "
+                f"{v}*{npp}")
+        group = cfg.num_layers // (v * npp)
 
     model = GPT(cfg)
     func = functionalize(model)
@@ -215,12 +239,26 @@ def build_pipeline_train_step(cfg: GPTConfig, mesh: Mesh, num_micro: int = 4,
     block_names = sorted(
         {k.split(".", 2)[2] for k in all_params if k.startswith("blocks.")})
     n_layers = cfg.num_layers
-    block_dicts = [
-        {bn: all_params[f"blocks.{i}.{bn}"] for bn in block_names}
-        for i in range(n_layers)
-    ]
-    stacked = stack_stage_params(block_dicts)
-    outer = {k: v for k, v in all_params.items() if not k.startswith("blocks.")}
+    if schedule == "interleave":
+        # [V, group, ...] in DEVICE-MAJOR virtual-stage order so the
+        # P('pp')-sharded stack keeps each device's v chunks local (no
+        # per-step resharding); virtual stage j = blocks j*group..+group
+        perm = interleave_permutation(npp, v)
+        stacked = {
+            bn: jnp.stack([
+                jnp.stack([all_params[f"blocks.{j * group + g}.{bn}"]
+                           for g in range(group)])
+                for j in perm])
+            for bn in block_names
+        }
+    else:
+        block_dicts = [
+            {bn: all_params[f"blocks.{i}.{bn}"] for bn in block_names}
+            for i in range(n_layers)
+        ]
+        stacked = stack_stage_params(block_dicts)
+    outer = {k: v_ for k, v_ in all_params.items()
+             if not k.startswith("blocks.")}
 
     block_func = functionalize(model.blocks[0])
 
@@ -228,41 +266,81 @@ def build_pipeline_train_step(cfg: GPTConfig, mesh: Mesh, num_micro: int = 4,
         out, _ = block_func.apply(block_params, {}, None, True, h)
         return out
 
-    def stacked_spec(name, v):
+    if schedule == "interleave":
+        base_stage_fn = stage_fn
+
+        def stage_fn(group_params, h):  # noqa: F811 — chain of `group` blocks
+            if group == 1:
+                return base_stage_fn(
+                    jax.tree_util.tree_map(lambda a: a[0], group_params), h)
+            h, _ = jax.lax.scan(
+                lambda c, p: (base_stage_fn(p, c), None), h, group_params)
+            return h
+
+    def stacked_spec(name, val):
         """Stage axis sharded on 'pp'; weight matrices additionally
-        tensor-parallel on 'tp' (column for qkv/fc1, row for out/fc2)."""
+        tensor-parallel on 'tp' (column for qkv/fc1, row for out/fc2).
+        Interleave stacks carry an extra (unsharded) group axis."""
+        extra = (None,) if schedule == "interleave" else ()
         if mesh.shape.get("tp", 1) > 1:
             if any(s in name for s in ("qkv.weight", "fc1.weight")):
-                return P("pp", None, "tp")
+                return P("pp", *extra, None, "tp")
             if any(s in name for s in ("out.weight", "fc2.weight")):
-                return P("pp", "tp", None)
+                return P("pp", *extra, "tp", None)
             if any(s in name for s in ("qkv.bias", "fc1.bias")):
-                return P("pp", "tp")
+                return P("pp", *extra, "tp")
         return P("pp")
 
-    def fwd(outer_p, stacked_p, tokens, labels):
-        # embedding (replicated across pp; dp-sharded batch)
+    def embed(outer_p, tokens):
         s = tokens.shape[-1]
         x = (jnp.take(outer_p["wte.weight"], tokens, axis=0)
              + jnp.take(outer_p["wpe.weight"], jnp.arange(s), axis=0))
-        x = jax.lax.with_sharding_constraint(
+        return jax.lax.with_sharding_constraint(
             x, NamedSharding(mesh, P(None, "dp", None, None)))
-        y = pipeline_apply(stage_fn, stacked_p, x, mesh, num_micro=num_micro)
-        # final norm + tied head + loss
+
+    def head_loss(outer_p, y, labels):
+        """Final norm + tied head + CE; y/labels may be all micro-batches
+        ([m,b,s,...]) or one ([b,s,...])."""
         xf = y.astype(jnp.float32)
         mu = jnp.mean(xf, -1, keepdims=True)
         var = jnp.mean(jnp.square(xf - mu), -1, keepdims=True)
         xn = ((xf - mu) * jax.lax.rsqrt(var + 1e-5)).astype(y.dtype)
         xn = xn * outer_p["ln_f.weight"] + outer_p["ln_f.bias"]
-        logits = jnp.einsum("mbsh,vh->mbsv", xn, outer_p["wte.weight"])
+        logits = jnp.einsum("...sh,vh->...sv", xn, outer_p["wte.weight"])
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)
         return jnp.mean(nll)
 
+    def fwd(outer_p, stacked_p, tokens, labels):
+        x = embed(outer_p, tokens)
+        if schedule == "interleave":
+            y = pipeline_apply_interleave(stage_fn, stacked_p, x, mesh, v=v,
+                                          num_micro=num_micro,
+                                          layout="device")
+        else:
+            y = pipeline_apply(stage_fn, stacked_p, x, mesh,
+                               num_micro=num_micro)
+        return head_loss(outer_p, y, labels)
+
+    def grads_1f1b(outer_p, stacked_p, tokens, labels):
+        """Fused-schedule path: pipeline_1f1b returns grads directly; the
+        embedding closes the loop through an explicit vjp on dx, and the
+        tied head/ln_f grads add to the embedding's."""
+        x, emb_vjp = jax.vjp(lambda op: embed(op, tokens), outer_p)
+        loss, g_stacked, g_head, dx = pipeline_1f1b(
+            stage_fn, stacked_p, x, labels, head_loss, outer_p, mesh,
+            num_micro=num_micro)
+        g_emb = emb_vjp(dx)[0]
+        g_outer = jax.tree_util.tree_map(jnp.add, g_head, g_emb)
+        return loss, (g_outer, g_stacked)
+
     def step(state, tokens, labels):
         outer_p, stacked_p = state
-        loss, grads = jax.value_and_grad(fwd, argnums=(0, 1))(
-            outer_p, stacked_p, tokens, labels)
+        if schedule == "1f1b":
+            loss, grads = grads_1f1b(outer_p, stacked_p, tokens, labels)
+        else:
+            loss, grads = jax.value_and_grad(fwd, argnums=(0, 1))(
+                outer_p, stacked_p, tokens, labels)
         g_outer, g_stacked = grads
         new_outer = jax.tree_util.tree_map(
             lambda p, g: (p - lr * g).astype(p.dtype), outer_p, g_outer)

@@ -32,6 +32,11 @@ from paddle_tpu.parallel.mp_layers import (  # noqa: F401
     ScatterOp, VocabParallelEmbedding,
 )
 from paddle_tpu.parallel.pipeline import pipeline_apply, stack_stage_params  # noqa: F401
+from paddle_tpu.parallel.pipeline_schedules import (  # noqa: F401
+    pipeline_1f1b,
+    pipeline_apply_interleave,
+    schedule_stats,
+)
 from paddle_tpu.parallel.recompute import (  # noqa: F401,E402
     GradientMerge, RecomputeLayer, recompute, recompute_sequential,
 )

@@ -33,6 +33,18 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 
+def varying(v, axis: str = "pp"):
+    """Mark a value as axis-varying for shard_map's vma type system (no-op
+    if already varying). Shared by the pipeline schedules and ring
+    attention — one site to fix when the experimental vma API moves."""
+    try:
+        if axis in jax.typeof(v).vma:
+            return v
+    except Exception:
+        pass
+    return lax.pcast(v, (axis,), to="varying")
+
+
 def stack_stage_params(param_dicts):
     """[{name: array}, ...] per stage -> {name: array[S, ...]} stacked."""
     keys = list(param_dicts[0].keys())
@@ -69,15 +81,7 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params,
     assert total_stages % npp == 0, (
         f"stage count {total_stages} must divide pp={npp}")
 
-    def _varying(v):
-        """Mark a value as pp-varying for shard_map's vma type system (no-op
-        if already varying)."""
-        try:
-            if "pp" in jax.typeof(v).vma:
-                return v
-        except Exception:
-            pass
-        return lax.pcast(v, ("pp",), to="varying")
+    _varying = varying
 
     def per_device(params_local, x):
         pp = lax.axis_index("pp")

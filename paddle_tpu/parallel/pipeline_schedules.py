@@ -1,0 +1,430 @@
+"""1F1B and interleaved (VPP) pipeline schedules over the 'pp' mesh axis.
+
+Reference: fleet/meta_parallel/pipeline_parallel.py:684
+(forward_backward_pipeline, Megatron 1F1B), :1308
+(PipelineParallelWithInterleave), and the static multi-Job Plan passes
+(distributed/passes/pipeline_scheduler_pass/__init__.py:32-38 — FThenB /
+1F1B / VPP / ZBH1).
+
+TPU-native design — the whole schedule is ONE compiled XLA program:
+a host-side simulator lays out the static (tick, device) -> work tables,
+which are baked into a lax.scan whose body every device executes SPMD,
+selecting its work by table lookup and rotating activations/grads around
+the ring with lax.ppermute over ICI.
+
+Three schedules:
+  * gpipe       (parallel/pipeline.py): fwd scan, autodiff backward.
+                Bubble (pp-1)/(m+pp-1); activation stash O(m).
+  * interleave  (this file): v chunks of the layer stack per device at
+                virtual stages c*pp+d. Differentiable like gpipe.
+                Bubble ~ (pp-1)/(v*m+pp-1) — the schedule that beats
+                GPipe's bubble. Stash O(m) (autodiff).
+  * 1f1b        (this file): FUSED forward+backward — warmup / steady
+                1F1B / cooldown, backward by per-stage recompute+vjp, loss
+                computed at the last stage so backward starts while
+                forwards continue. Activation stash 2*pp-1 micro-batches
+                instead of m: the 1F1B memory profile. Not composable with
+                outer autodiff (it IS the derivative) — returns grads.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+# ----------------------------------------------------------------- simulators
+
+class Schedule(NamedTuple):
+    """Static (tick, device) work tables produced by a simulator."""
+    tables: dict          # name -> np.ndarray [T, pp] int32
+    total_ticks: int
+    busy_slots: int       # stage-compute work items actually scheduled
+    total_slots: int      # tick slots available (incl. idle)
+    stash_size: int       # activation stash per device (micro-batches)
+    arrival_slots: int
+
+
+def simulate_interleave(pp: int, v: int, m: int) -> Schedule:
+    """Greedy forward schedule for v chunks/device (virtual stages
+    j = c*pp + d). Each tick a device runs ONE virtual stage on one
+    micro-batch; activations always permute +1 around the ring. Priority:
+    highest virtual stage first (drains late chunks so early micro-batches
+    finish; reproduces the Megatron interleave bubble ~(pp-1)/(v*m))."""
+    V = v * pp
+    done = {}                      # (j, i) -> finish tick
+    remaining = {(j, i) for j in range(V) for i in range(m)}
+    # arrival buffer bookkeeping per device: (j, i) -> slot
+    arr_slot = {}
+    free_slots = [list() for _ in range(pp)]
+    max_slots = [0] * pp
+    rows = {k: [] for k in ("work_j", "work_mb", "valid", "from_x",
+                            "rd_slot", "wr_valid", "wr_slot", "wr_is_new")}
+    incoming = [None] * pp         # payload in flight: (j_next, i) arriving
+    t = 0
+    while remaining or any(incoming):
+        row = {k: [0] * pp for k in rows}
+        # 1) arrivals land in each device's buffer
+        for d in range(pp):
+            if incoming[d] is not None:
+                j, i = incoming[d]
+                if free_slots[d]:
+                    s = free_slots[d].pop()
+                else:
+                    s = max_slots[d]
+                    max_slots[d] += 1
+                arr_slot[(j, i)] = s
+                row["wr_valid"][d] = 1
+                row["wr_slot"][d] = s
+            incoming[d] = None
+        # 2) each device picks the ready item with the highest virtual stage
+        for d in range(pp):
+            ready = [
+                (j, i) for (j, i) in remaining
+                if j % pp == d and (j == 0 or done.get((j - 1, i), t) < t)
+            ]
+            if not ready:
+                row["valid"][d] = 0
+                continue
+            j, i = max(ready, key=lambda w: (w[0], -w[1]))
+            remaining.discard((j, i))
+            done[(j, i)] = t
+            row["valid"][d] = 1
+            row["work_j"][d] = j
+            row["work_mb"][d] = i
+            if j == 0:
+                row["from_x"][d] = 1
+            else:
+                s = arr_slot.pop((j, i))
+                row["rd_slot"][d] = s
+                free_slots[d].append(s)
+            if j < V - 1:
+                incoming[(d + 1) % pp] = (j + 1, i)
+        for k in rows:
+            rows[k].append(row[k])
+        t += 1
+        assert t < 4 * (V * m + pp), "interleave schedule did not converge"
+    tables = {k: np.asarray(vv, np.int32) for k, vv in rows.items()}
+    return Schedule(tables, t, V * m, t * pp, m, max(max_slots or [1]) or 1)
+
+
+def simulate_1f1b(pp: int, m: int) -> Schedule:
+    """Closed-form 1F1B timeline with dual work slots per tick (one F and
+    one B per device per tick; both are real work in the steady state):
+
+      F on device d, micro-batch i : tick i + d
+      B on device d, micro-batch i : tick i + 2*(pp-1) - d
+        (last stage backs up the same tick it forwards: loss is local)
+
+    Stash in flight on device d = 2*(pp-1-d)+1  ->  stash 2*pp-1."""
+    T = m + 2 * pp - 2
+    ft = -np.ones((T, pp), np.int32)
+    bt = -np.ones((T, pp), np.int32)
+    for d in range(pp):
+        for i in range(m):
+            ft[i + d, d] = i
+            bt[i + 2 * (pp - 1) - d, d] = i
+    S = 2 * pp - 1
+    tables = {
+        "f_mb": ft, "b_mb": bt,
+        "f_slot": np.where(ft >= 0, ft % S, 0).astype(np.int32),
+        "b_slot": np.where(bt >= 0, bt % S, 0).astype(np.int32),
+    }
+    return Schedule(tables, T, 2 * m * pp, 2 * T * pp, S, 1)
+
+
+def schedule_stats(pp: int, m: int, schedule: str = "gpipe", v: int = 1):
+    """Step-count accounting used by the bubble tests: slots are uniform
+    stage-compute units; bubble = idle fraction of the fwd+bwd timeline."""
+    if schedule == "gpipe":
+        ticks = 2 * (m + pp - 1)        # fwd scan + autodiff mirror
+        busy = 2 * m
+        return {"total_ticks": ticks, "bubble": 1 - busy / ticks,
+                "stash_micro_batches": m}
+    if schedule == "interleave":
+        sim = simulate_interleave(pp, v, m)
+        busy_per_dev = v * m            # fwd; autodiff mirrors the timeline
+        return {"total_ticks": 2 * sim.total_ticks,
+                "bubble": 1 - busy_per_dev / sim.total_ticks,
+                "stash_micro_batches": m}
+    if schedule == "1f1b":
+        sim = simulate_1f1b(pp, m)
+        return {"total_ticks": sim.total_ticks,
+                "bubble": 1 - m / sim.total_ticks,
+                "stash_micro_batches": sim.stash_size}
+    raise ValueError(f"unknown schedule {schedule!r}")
+
+
+from paddle_tpu.parallel.pipeline import varying as _varying  # noqa: E402
+
+
+# ----------------------------------------------------------- interleave apply
+
+def interleave_permutation(pp: int, v: int) -> list:
+    """Device-major stacking order for interleaved params: position
+    p = d*v + c holds virtual stage j = c*pp + d. Stored this way, a
+    P('pp')-sharded [V,...] stack keeps each device's v chunks LOCAL —
+    no per-step resharding (layer-order storage would move nearly every
+    block parameter over ICI each step)."""
+    return [c * pp + d for d in range(pp) for c in range(v)]
+
+
+def pipeline_apply_interleave(stage_fn: Callable[[Any, Any], Any],
+                              stacked_params, x_micro, mesh: Mesh,
+                              v: int = 2, num_micro: int | None = None,
+                              remat: bool = False, layout: str = "layer"):
+    """Differentiable interleaved-VPP pipeline: like
+    pipeline.pipeline_apply but each device owns v chunks of the stage
+    stack at virtual stages c*pp+d, cutting the bubble by ~v.
+
+    stacked_params leaves have leading dim V = v*pp; layout='layer' means
+    index L = virtual stage L (convenient, but pays a reshard per step on a
+    P('pp')-sharded stack), layout='device' means the caller pre-permuted
+    with interleave_permutation (device-major; sharded stacks stay local).
+    Stage output shape must equal its input shape.
+    Returns [num_micro, ...] last-stage outputs."""
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
+    npp = mesh.shape["pp"]
+    if num_micro is None:
+        num_micro = x_micro.shape[0]
+    leaf = jax.tree_util.tree_leaves(stacked_params)[0]
+    V = leaf.shape[0]
+    assert V == v * npp, f"stage count {V} != v*pp = {v}*{npp}"
+    sim = simulate_interleave(npp, v, num_micro)
+    T = sim.total_ticks
+    A = max(sim.arrival_slots, 1)
+    tab = {k: jnp.asarray(val) for k, val in sim.tables.items()}
+
+    if layout == "layer":
+        perm = np.asarray(interleave_permutation(npp, v))
+        re = jax.tree_util.tree_map(lambda a: a[perm], stacked_params)
+    elif layout == "device":
+        re = stacked_params
+    else:
+        raise ValueError(f"unknown layout {layout!r}")
+
+    def per_device(params_local, x):
+        d = lax.axis_index("pp")
+        # local slice of the device-major [V,...] stack = this device's v
+        # chunks, chunk c at local index c
+        mb_shape = x.shape[1:]
+
+        def tick(carry, trow):
+            arr_buf, outbuf, incoming = carry
+            # land last tick's permuted payload
+            wr = jnp.where(trow["wr_valid"][d] > 0,
+                           lax.dynamic_update_index_in_dim(
+                               arr_buf, incoming, trow["wr_slot"][d], 0),
+                           arr_buf)
+            arr_buf = wr
+            j = trow["work_j"][d]
+            mb = trow["work_mb"][d]
+            valid = trow["valid"][d] > 0
+            h_x = lax.dynamic_index_in_dim(x, jnp.clip(mb, 0, num_micro - 1),
+                                           0, keepdims=False)
+            h_a = lax.dynamic_index_in_dim(arr_buf, trow["rd_slot"][d], 0,
+                                           keepdims=False)
+            h = jnp.where(trow["from_x"][d] > 0, _varying(h_x), h_a)
+            chunk = jnp.clip(j // npp, 0, v - 1)
+            p_c = jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, chunk, 0,
+                                                   keepdims=False),
+                params_local)
+            y = stage_fn(p_c, h)
+            y = jnp.where(valid, y, jnp.zeros_like(y))
+            # last virtual stage writes its output
+            is_out = valid & (j == V - 1)
+            upd = lax.dynamic_update_index_in_dim(
+                outbuf, y, jnp.clip(mb, 0, num_micro - 1), 0)
+            outbuf = jnp.where(is_out, upd, outbuf)
+            nxt = lax.ppermute(y, "pp", [(i, (i + 1) % npp)
+                                         for i in range(npp)])
+            return (arr_buf, outbuf, nxt), None
+
+        z = jnp.zeros(mb_shape, x.dtype)
+        init = (_varying(jnp.zeros((A,) + mb_shape, x.dtype)),
+                _varying(jnp.zeros((num_micro,) + mb_shape, x.dtype)),
+                _varying(z))
+        (_, outbuf, _), _ = lax.scan(tick, init, tab)
+        return outbuf
+
+    mapped = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), re), P()),
+        out_specs=P("pp"),
+        axis_names=frozenset({"pp"}),
+    )
+    out_all = mapped(re, x_micro)
+    # P('pp') concatenation: only the last device's block holds outputs
+    return out_all[(npp - 1) * num_micro:]
+
+
+# ------------------------------------------------------------- fused 1F1B
+
+def pipeline_1f1b(stage_fn: Callable[[Any, Any], Any], stacked_params,
+                  x_micro, labels_micro,
+                  head_fn: Callable[[Any, Any, Any], Any], head_params,
+                  mesh: Mesh, num_micro: int | None = None):
+    """Fused forward+backward with the Megatron 1F1B schedule
+    (reference pipeline_parallel.py:684 warmup/steady/cooldown).
+
+    Per tick every device runs one F and one B work slot (masked outside
+    the steady state). Backward recomputes the stage under jax.vjp from a
+    stashed stage input — the stash holds at most 2*pp-1 micro-batches (the
+    1F1B memory profile; GPipe autodiff stashes all m). The last stage
+    computes loss locally (head_fn) so backward starts while earlier
+    micro-batches are still forwarding.
+
+    head_fn(head_params, y, labels) -> scalar mean loss for ONE micro-batch.
+    Returns (mean_loss, grads_stacked, grads_head, dx_micro). NOT
+    differentiable — it already IS the backward (use its outputs directly).
+    """
+    npp = mesh.shape["pp"]
+    if num_micro is None:
+        num_micro = x_micro.shape[0]
+    m = num_micro
+    total_stages = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    assert total_stages % npp == 0
+    s_local = total_stages // npp
+    sim = simulate_1f1b(npp, m)
+    S = sim.stash_size
+    tab = {k: jnp.asarray(val) for k, val in sim.tables.items()}
+    fwd_perm = [(i, (i + 1) % npp) for i in range(npp)]
+    bwd_perm = [(i, (i - 1) % npp) for i in range(npp)]
+
+    def per_device(params_local, head_p, x, labels):
+        d = lax.axis_index("pp")
+        is_first = d == 0
+        is_last = d == npp - 1
+        # head params arrive replicated (unvarying). Differentiating the
+        # pp-varying per-device loss w.r.t. an UNVARYING input makes the
+        # shard_map transpose insert a psum over 'pp' — mixing every
+        # device's (masked-out) head recompute into the gradient. Cast to
+        # varying so head grads stay device-local until the final psum.
+        head_p = jax.tree_util.tree_map(_varying, head_p)
+        mb_shape = x.shape[1:]
+        z = jnp.zeros(mb_shape, x.dtype)
+
+        def dev_fn(pl, h):
+            """This device's stage = chain of its s_local blocks."""
+            if s_local == 1:
+                return stage_fn(jax.tree_util.tree_map(lambda a: a[0], pl),
+                                h)
+            h = _varying(h)
+            h, _ = lax.scan(lambda c, p: (stage_fn(p, c), None), h, pl)
+            return h
+
+        def tick(carry, trow):
+            (stash, f_in, g_in, gparams, ghead, loss_acc, dx_buf) = carry
+
+            # ---------------- F slot
+            f_mb = trow["f_mb"][d]
+            f_valid = f_mb >= 0
+            mb_c = jnp.clip(f_mb, 0, m - 1)
+            h_x = lax.dynamic_index_in_dim(x, mb_c, 0, keepdims=False)
+            h = jnp.where(is_first, _varying(h_x), f_in)
+            stash = jnp.where(
+                f_valid,
+                lax.dynamic_update_index_in_dim(stash, h, trow["f_slot"][d],
+                                                0),
+                stash)
+            y = dev_fn(params_local, h)
+            y = jnp.where(f_valid, y, jnp.zeros_like(y))
+
+            # ---------------- B slot (recompute + vjp from stashed input)
+            b_mb = trow["b_mb"][d]
+            b_valid = b_mb >= 0
+            bmb_c = jnp.clip(b_mb, 0, m - 1)
+            h_b = lax.dynamic_index_in_dim(stash, trow["b_slot"][d], 0,
+                                           keepdims=False)
+            y_b, stage_vjp = jax.vjp(dev_fn, params_local, h_b)
+            lbl = lax.dynamic_index_in_dim(labels, bmb_c, 0, keepdims=False)
+
+            # head fwd+bwd only where it contributes: last device, valid B.
+            # Inside shard_map the predicate is device-local, so lax.cond
+            # genuinely skips the head (often the most expensive op —
+            # vocab-sized logits) on the other pp-1 devices every tick.
+            def head_branch(op):
+                hp, yy, ll = op
+                loss_i, (ghp, gyl) = jax.value_and_grad(
+                    lambda hp_, yy_: head_fn(hp_, yy_, ll),
+                    argnums=(0, 1))(hp, yy)
+                # 1/m: the pipeline loss is the mean over micro-batches
+                return loss_i / m, jax.tree_util.tree_map(
+                    lambda g: g / m, ghp), gyl / m
+
+            def skip_branch(op):
+                hp, yy, ll = op
+                # fresh zeros are unvarying; match the head branch's
+                # pp-varying output types for cond
+                return (_varying(jnp.zeros((), jnp.float32)),
+                        jax.tree_util.tree_map(
+                            lambda a: _varying(jnp.zeros_like(a)), hp),
+                        _varying(jnp.zeros_like(yy)))
+
+            loss_i, g_head_i, gy_last = lax.cond(
+                b_valid & is_last, head_branch, skip_branch,
+                (head_p, y_b, lbl))
+            gy = jnp.where(is_last, gy_last, g_in)
+            gp_i, gh = stage_vjp(gy)
+            mask = jnp.where(b_valid, 1.0, 0.0)
+            gparams = jax.tree_util.tree_map(
+                lambda acc, g: acc + mask * g, gparams, gp_i)
+            ghead = jax.tree_util.tree_map(jnp.add, ghead, g_head_i)
+            loss_acc = loss_acc + loss_i
+            gh = jnp.where(b_valid, gh, jnp.zeros_like(gh))
+            dx_upd = lax.dynamic_update_index_in_dim(dx_buf, gh, bmb_c, 0)
+            dx_buf = jnp.where(b_valid & is_first, dx_upd, dx_buf)
+
+            f_in_next = lax.ppermute(y, "pp", fwd_perm)
+            g_in_next = lax.ppermute(gh, "pp", bwd_perm)
+            return (stash, f_in_next, g_in_next, gparams, ghead, loss_acc,
+                    dx_buf), None
+
+        init = (
+            _varying(jnp.zeros((S,) + mb_shape, x.dtype)),      # stash
+            _varying(z),                                        # f_in
+            _varying(z),                                        # g_in
+            jax.tree_util.tree_map(
+                lambda a: _varying(jnp.zeros_like(a)), params_local),
+            jax.tree_util.tree_map(
+                lambda a: _varying(jnp.zeros_like(a)), head_p),
+            _varying(jnp.zeros((), jnp.float32)),
+            _varying(jnp.zeros((m,) + mb_shape, x.dtype)),
+        )
+        (stash, _, _, gparams, ghead, loss_acc, dx_buf), _ = lax.scan(
+            tick, init, tab)
+        # replicate the cross-device results: loss/ghead live on the last
+        # device, dx on the first — psum of masked values replicates them
+        last_mask = jnp.where(is_last, 1.0, 0.0)
+        first_mask = jnp.where(is_first, 1.0, 0.0)
+        loss = lax.psum(loss_acc * last_mask, "pp")
+        ghead = jax.tree_util.tree_map(
+            lambda g: lax.psum(g * last_mask, "pp"), ghead)
+        dx = lax.psum(dx_buf * first_mask, "pp")
+        return loss, gparams, ghead, dx
+
+    mapped = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), stacked_params),
+                  jax.tree_util.tree_map(lambda _: P(), head_params),
+                  P(), P()),
+        out_specs=(P(),
+                   jax.tree_util.tree_map(lambda _: P("pp"), stacked_params),
+                   jax.tree_util.tree_map(lambda _: P(), head_params),
+                   P()),
+        axis_names=frozenset({"pp"}),
+    )
+    return mapped(stacked_params, head_params, x_micro, labels_micro)

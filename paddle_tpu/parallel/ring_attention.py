@@ -65,8 +65,10 @@ def _ring_attention_local(q, k, v, axis: str, causal: bool, scale):
         v_nxt = lax.ppermute(v_cur, axis, perm)
         return (k_nxt, v_nxt, new_m, new_l, new_o), None
 
-    def _varying(x):  # mark accumulators sp-varying for the vma type system
-        return lax.pcast(x, (axis,), to="varying") if axis not in jax.typeof(x).vma else x
+    from paddle_tpu.parallel.pipeline import varying
+
+    def _varying(x):  # mark accumulators sp-varying
+        return varying(x, axis)
 
     m0 = _varying(jnp.full((b, h, sq), -jnp.inf, jnp.float32))
     l0 = _varying(jnp.zeros((b, h, sq), jnp.float32))
