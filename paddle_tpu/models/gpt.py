@@ -267,15 +267,12 @@ def build_pipeline_train_step(cfg: GPTConfig, mesh: Mesh, num_micro: int = 4,
         return out
 
     if schedule == "interleave":
+        from paddle_tpu.parallel.pipeline import chain_stages
+
         base_stage_fn = stage_fn
 
         def stage_fn(group_params, h):  # noqa: F811 — chain of `group` blocks
-            if group == 1:
-                return base_stage_fn(
-                    jax.tree_util.tree_map(lambda a: a[0], group_params), h)
-            h, _ = jax.lax.scan(
-                lambda c, p: (base_stage_fn(p, c), None), h, group_params)
-            return h
+            return chain_stages(base_stage_fn, group_params, h)
 
     def stacked_spec(name, val):
         """Stage axis sharded on 'pp'; weight matrices additionally

@@ -45,6 +45,20 @@ def varying(v, axis: str = "pp"):
     return lax.pcast(v, (axis,), to="varying")
 
 
+def chain_stages(stage_fn, stacked_local, h, axis: str = "pp"):
+    """Run h through stage_fn once per leading-axis entry of stacked_local
+    (scan; length-1 fast path). The carry is cast axis-varying for the vma
+    type system. Shared by pipeline_apply, the 1F1B dev_fn, and the GPT
+    interleave chunk chain."""
+    n = jax.tree_util.tree_leaves(stacked_local)[0].shape[0]
+    if n == 1:
+        return stage_fn(jax.tree_util.tree_map(lambda a: a[0],
+                                               stacked_local), h)
+    h = varying(h, axis)
+    h, _ = lax.scan(lambda c, p: (stage_fn(p, c), None), h, stacked_local)
+    return h
+
+
 def stack_stage_params(param_dicts):
     """[{name: array}, ...] per stage -> {name: array[S, ...]} stacked."""
     keys = list(param_dicts[0].keys())
@@ -88,14 +102,7 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params,
         s_local = jax.tree_util.tree_leaves(params_local)[0].shape[0]
 
         def chain(h):
-            if s_local == 1:
-                return stage_fn(
-                    jax.tree_util.tree_map(lambda a: a[0], params_local), h)
-            # carry becomes pp-varying after the first stage; mark it so
-            h = _varying(h)
-            h, _ = lax.scan(
-                lambda c, p: (stage_fn(p, c), None), h, params_local)
-            return h
+            return chain_stages(stage_fn, params_local, h)
 
         # probe output structure once to size buffers
         mb_shape = x.shape[1:]

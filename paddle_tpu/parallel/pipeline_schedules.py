@@ -70,7 +70,7 @@ def simulate_interleave(pp: int, v: int, m: int) -> Schedule:
     free_slots = [list() for _ in range(pp)]
     max_slots = [0] * pp
     rows = {k: [] for k in ("work_j", "work_mb", "valid", "from_x",
-                            "rd_slot", "wr_valid", "wr_slot", "wr_is_new")}
+                            "rd_slot", "wr_valid", "wr_slot")}
     incoming = [None] * pp         # payload in flight: (j_next, i) arriving
     t = 0
     while remaining or any(incoming):
@@ -157,7 +157,11 @@ def schedule_stats(pp: int, m: int, schedule: str = "gpipe", v: int = 1):
         busy_per_dev = v * m            # fwd; autodiff mirrors the timeline
         return {"total_ticks": 2 * sim.total_ticks,
                 "bubble": 1 - busy_per_dev / sim.total_ticks,
-                "stash_micro_batches": m}
+                # autodiff saves one stage-input residual per tick: ~v*m
+                # per device (chunks are 1/v the layers, so in LAYER units
+                # this is ~m, same as gpipe — but in micro-batch-input
+                # units it is v*m)
+                "stash_micro_batches": v * m}
     if schedule == "1f1b":
         sim = simulate_1f1b(pp, m)
         return {"total_ticks": sim.total_ticks,
@@ -166,7 +170,7 @@ def schedule_stats(pp: int, m: int, schedule: str = "gpipe", v: int = 1):
     raise ValueError(f"unknown schedule {schedule!r}")
 
 
-from paddle_tpu.parallel.pipeline import varying as _varying  # noqa: E402
+from paddle_tpu.parallel.pipeline import chain_stages, varying as _varying  # noqa: E402
 
 
 # ----------------------------------------------------------- interleave apply
@@ -319,12 +323,7 @@ def pipeline_1f1b(stage_fn: Callable[[Any, Any], Any], stacked_params,
 
         def dev_fn(pl, h):
             """This device's stage = chain of its s_local blocks."""
-            if s_local == 1:
-                return stage_fn(jax.tree_util.tree_map(lambda a: a[0], pl),
-                                h)
-            h = _varying(h)
-            h, _ = lax.scan(lambda c, p: (stage_fn(p, c), None), h, pl)
-            return h
+            return chain_stages(stage_fn, pl, h)
 
         def tick(carry, trow):
             (stash, f_in, g_in, gparams, ghead, loss_acc, dx_buf) = carry

@@ -1,0 +1,156 @@
+"""Multi-device numerical-equivalence suite (VERDICT round-1 item #3).
+
+For each parallelism strategy: N-device loss AND gradients must equal the
+single-device computation for the same global batch — the class of test
+that catches transposed shardings or wrong psums which "loss is finite"
+checks miss. Reference pattern: test/auto_parallel/ reshard matrix +
+test/collective/fleet/ hybrid scripts.
+
+PP grads-vs-single-device live in test_pipeline_schedules.py (all three
+schedules vs a single-device chain); ring attention fwd+grad parity in
+test_longcontext_ckpt.py. This file covers TP+SP, DP, ZeRO-1/2/3, EP/MoE.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import parallel as dist
+from paddle_tpu.jit.functionalize import functionalize
+from paddle_tpu.models.gpt import GPT, GPTConfig, gpt_loss_fn
+
+rng = np.random.default_rng(0)
+
+CFG = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+           max_seq_len=16, dropout=0.0)
+ATOL = 2e-4
+
+
+def _loss_and_grads(model, tokens):
+    """Functional loss + per-parameter grads of a GPT (tokens = labels)."""
+    func = functionalize(model)
+
+    def loss_fn(params):
+        out, _ = func.apply(params, func.buffer_values(), None, False,
+                            tokens)
+        logits = out[0] if isinstance(out, tuple) else out
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, tokens._value[..., None], axis=-1)
+        return jnp.mean(nll)
+
+    # under jit so the tp/sp sharding-constraint ops resolve on the mesh
+    return jax.jit(jax.value_and_grad(loss_fn))(func.param_values())
+
+
+def _assert_tree_close(actual, expected, atol=ATOL, rtol=2e-3):
+    a_keys, e_keys = set(actual), set(expected)
+    assert a_keys == e_keys, (a_keys - e_keys, e_keys - a_keys)
+    for k in sorted(e_keys):
+        np.testing.assert_allclose(
+            np.asarray(actual[k]), np.asarray(expected[k]),
+            atol=atol, rtol=rtol, err_msg=k)
+
+
+# ------------------------------------------------------------------ TP / SP
+
+@pytest.mark.parametrize("sp", [False, True])
+def test_tp_loss_and_grads_match_dense(sp):
+    tokens = paddle.to_tensor(rng.integers(0, 64, (4, 16)))
+    paddle.seed(11)
+    dense = GPT(GPTConfig(**CFG))
+    dense.eval()
+    ref_loss, ref_grads = _loss_and_grads(dense, tokens)
+
+    mesh = dist.init_mesh({"dp": 2, "tp": 4})
+    try:
+        paddle.seed(11)
+        tp = GPT(GPTConfig(**CFG, tensor_parallel=True,
+                           sequence_parallel=sp))
+        tp.eval()
+        loss, grads = _loss_and_grads(tp, tokens)
+        np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-5,
+                                   rtol=1e-5)
+        _assert_tree_close(grads, ref_grads)
+    finally:
+        dist.set_mesh(None)
+
+
+# ----------------------------------------------------------------------- DP
+
+def test_dp_trainstep_matches_single_device():
+    tokens = paddle.to_tensor(rng.integers(0, 64, (8, 16)))
+
+    def one_step(mesh):
+        paddle.seed(7)
+        model = GPT(GPTConfig(**CFG))
+        opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                     learning_rate=1e-2)
+        step = paddle.jit.TrainStep(model, gpt_loss_fn, opt, mesh=mesh)
+        losses = [float(step(tokens, tokens)) for _ in range(2)]
+        step.sync()
+        return losses, {k: np.asarray(v._value)
+                        for k, v in model.state_dict().items()}
+
+    ref_losses, ref_sd = one_step(None)
+    mesh = dist.init_mesh({"dp": 8})
+    try:
+        dp_losses, dp_sd = one_step(mesh)
+    finally:
+        dist.set_mesh(None)
+    np.testing.assert_allclose(dp_losses, ref_losses, atol=1e-5, rtol=1e-5)
+    _assert_tree_close(dp_sd, ref_sd)
+
+
+# ------------------------------------------------------------- ZeRO stages
+
+@pytest.mark.parametrize("level", ["os", "os_g", "p_g_os"])
+def test_zero_stage_matches_single_device(level):
+    tokens = paddle.to_tensor(rng.integers(0, 64, (8, 16)))
+
+    def run(mesh, sharded):
+        paddle.seed(13)
+        model = GPT(GPTConfig(**CFG))
+        opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                     learning_rate=1e-2)
+        if sharded:
+            model, opt, _ = dist.group_sharded_parallel(model, opt,
+                                                        level=level)
+        step = paddle.jit.TrainStep(model, gpt_loss_fn, opt, mesh=mesh)
+        losses = [float(step(tokens, tokens)) for _ in range(2)]
+        step.sync()
+        return losses, {k: np.asarray(v._value)
+                        for k, v in model.state_dict().items()}
+
+    ref_losses, ref_sd = run(None, False)
+    mesh = dist.init_mesh({"dp": 8})
+    try:
+        z_losses, z_sd = run(mesh, True)
+    finally:
+        dist.set_mesh(None)
+    np.testing.assert_allclose(z_losses, ref_losses, atol=1e-5, rtol=1e-5)
+    _assert_tree_close(z_sd, ref_sd)
+
+
+# --------------------------------------------------------------------- MoE
+
+def test_moe_ep_loss_and_grads_match_single_device():
+    cfg = dict(CFG, moe_every=2, moe_experts=4)
+    tokens = paddle.to_tensor(rng.integers(0, 64, (4, 16)))
+    paddle.seed(17)
+    single = GPT(GPTConfig(**cfg))
+    single.eval()
+    ref_loss, ref_grads = _loss_and_grads(single, tokens)
+
+    mesh = dist.init_mesh({"dp": 4, "ep": 2})
+    try:
+        paddle.seed(17)
+        ep = GPT(GPTConfig(**cfg))
+        ep.eval()
+        loss, grads = _loss_and_grads(ep, tokens)
+        np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-5,
+                                   rtol=1e-5)
+        _assert_tree_close(grads, ref_grads)
+    finally:
+        dist.set_mesh(None)
