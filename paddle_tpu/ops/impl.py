@@ -1458,3 +1458,7 @@ def renorm(x, p, axis, max_norm):
     norms = jnp.sum(jnp.abs(x) ** p, axis=dims, keepdims=True) ** (1.0 / p)
     factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
     return x * factor
+
+# round-2 surface expansion — star import puts batch-2 impls in this
+# namespace so the registry's getattr(impl_mod, name) finds them
+from paddle_tpu.ops.impl_extra import *  # noqa: F401,F403,E402

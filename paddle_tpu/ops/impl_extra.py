@@ -1,0 +1,1492 @@
+"""Op implementations, batch 2: the round-2 surface expansion.
+
+Same conventions as impl.py (pure jittable functions over jax arrays; NCHW;
+names match ops.yaml). Reference kernels: paddle/phi/kernels/* per-op files
+named after each op (e.g. cpu/svd_kernel.cc, gpu/grid_sample_kernel.cu,
+impl/fold_kernel_impl.h); semantics follow the phi InferMeta + kernel pair,
+not torch (e.g. lu pivots are 1-based, huber_loss returns the residual).
+"""
+
+from __future__ import annotations
+
+import builtins
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from paddle_tpu.ops.impl import _pair
+
+# ============================================================== linalg family
+
+
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def svdvals(x):
+    return jnp.linalg.svd(x, compute_uv=False)
+
+
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def eig(x):
+    return jnp.linalg.eig(x)
+
+
+def eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+def eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def lstsq(x, y, rcond=None, driver=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+def lu(x, pivot=True):
+    """Returns (lu, pivots, info); pivots 1-based int32 per the reference
+    phi LuKernel (LAPACK convention)."""
+    import jax.scipy.linalg as jsl
+
+    lu_mat, piv = jsl.lu_factor(x)
+    return lu_mat, (piv + 1).astype(jnp.int32), jnp.zeros(
+        x.shape[:-2], jnp.int32)
+
+
+def lu_unpack(lu_mat, pivots, unpack_ludata=True, unpack_pivots=True):
+    n = lu_mat.shape[-2]
+    k = min(lu_mat.shape[-2], lu_mat.shape[-1])
+    l = jnp.tril(lu_mat[..., :, :k], -1) + jnp.eye(
+        n, k, dtype=lu_mat.dtype)
+    u = jnp.triu(lu_mat[..., :k, :])
+    # pivots (1-based) -> permutation matrix
+    piv = pivots.astype(jnp.int32) - 1
+
+    def perm_of(piv1):
+        p = jnp.arange(n)
+
+        def body(i, p):
+            j = piv1[i]
+            pi, pj = p[i], p[j]
+            return p.at[i].set(pj).at[j].set(pi)
+
+        return lax.fori_loop(0, piv1.shape[0], body, p)
+
+    if piv.ndim == 1:
+        perm = perm_of(piv)
+        pmat = jnp.eye(n, dtype=lu_mat.dtype)[perm]
+    else:
+        perm = jax.vmap(perm_of)(piv.reshape(-1, piv.shape[-1]))
+        pmat = jnp.eye(n, dtype=lu_mat.dtype)[perm].reshape(
+            piv.shape[:-1] + (n, n))
+    return pmat.swapaxes(-1, -2), l, u
+
+
+def det(x):
+    return jnp.linalg.det(x)
+
+
+def slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return sign, logdet
+
+
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+def cholesky_solve(x, y, upper=False):
+    """Solve A z = x given y = Cholesky factor of A (phi CholeskySolve)."""
+    import jax.scipy.linalg as jsl
+
+    return jsl.cho_solve((y, not upper), x)
+
+
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, tol=tol)
+
+
+def multi_dot(xs):
+    return jnp.linalg.multi_dot(list(xs))
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def p_norm(x, porder=2.0, axis=-1, epsilon=1e-12, keepdim=False,
+           asvector=False):
+    if asvector:
+        x = x.reshape(-1)
+        axis = 0
+    xf = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
+    if porder == float("inf"):
+        out = jnp.max(jnp.abs(xf), axis=axis, keepdims=keepdim)
+    elif porder == float("-inf"):
+        out = jnp.min(jnp.abs(xf), axis=axis, keepdims=keepdim)
+    elif porder == 0:
+        out = jnp.sum((xf != 0).astype(xf.dtype), axis=axis,
+                      keepdims=keepdim)
+    else:
+        out = jnp.sum(jnp.abs(xf) ** porder, axis=axis,
+                      keepdims=keepdim) ** (1.0 / porder)
+    return out.astype(x.dtype)
+
+
+def frobenius_norm(x, axis=None, keepdim=False, reduce_all=False):
+    if reduce_all or axis is None:
+        return jnp.sqrt(jnp.sum(jnp.square(x)))
+    axis = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+
+
+def dist(x, y, p=2.0):
+    d = (x - y).reshape(-1)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    if p == 0:
+        return jnp.sum((d != 0).astype(d.dtype))
+    return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+# ================================================================== creation
+
+
+def empty(shape, dtype="float32"):
+    from paddle_tpu.core.dtype import to_jax_dtype
+
+    return jnp.zeros(tuple(shape), to_jax_dtype(dtype))
+
+
+def empty_like(x, dtype=None):
+    return jnp.zeros_like(x) if dtype is None else jnp.zeros(
+        x.shape, dtype)
+
+
+def eye(num_rows, num_columns=None, dtype="float32"):
+    from paddle_tpu.core.dtype import to_jax_dtype
+
+    return jnp.eye(int(num_rows),
+                   int(num_columns) if num_columns is not None else None,
+                   dtype=to_jax_dtype(dtype))
+
+
+def full(shape, fill_value, dtype="float32"):
+    from paddle_tpu.core.dtype import to_jax_dtype
+
+    return jnp.full(tuple(shape), fill_value, to_jax_dtype(dtype))
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value,
+                         dtype=None if dtype is None else dtype)
+
+
+def linspace(start, stop, num, dtype="float32"):
+    from paddle_tpu.core.dtype import to_jax_dtype
+
+    return jnp.linspace(start, stop, int(num),
+                        dtype=to_jax_dtype(dtype))
+
+
+def logspace(start, stop, num, base=10.0, dtype="float32"):
+    from paddle_tpu.core.dtype import to_jax_dtype
+
+    return jnp.logspace(start, stop, int(num), base=base,
+                        dtype=to_jax_dtype(dtype))
+
+
+def meshgrid(xs):
+    return tuple(jnp.meshgrid(*xs, indexing="ij"))
+
+
+def ones(shape, dtype="float32"):
+    from paddle_tpu.core.dtype import to_jax_dtype
+
+    return jnp.ones(tuple(shape), to_jax_dtype(dtype))
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=dtype)
+
+
+def zeros(shape, dtype="float32"):
+    from paddle_tpu.core.dtype import to_jax_dtype
+
+    return jnp.zeros(tuple(shape), to_jax_dtype(dtype))
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=dtype)
+
+
+def tril_indices(rows, cols, offset=0, dtype="int64"):
+    r, c = jnp.tril_indices(int(rows), int(offset), int(cols))
+    return jnp.stack([r, c]).astype(dtype)
+
+
+def triu_indices(row, col, offset=0, dtype="int64"):
+    r, c = jnp.triu_indices(int(row), int(offset), int(col))
+    return jnp.stack([r, c]).astype(dtype)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1] + abs(offset)
+    out_shape = x.shape[:-1] + (n, n)
+    out = jnp.zeros(out_shape, x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    out = out.at[..., r, c].set(x)
+    # move the two new axes into requested positions
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    if (d1, d2) != (nd - 2, nd - 1):
+        out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+    return out
+
+
+# ==================================================================== random
+
+
+def bernoulli(x, key):
+    return jax.random.bernoulli(key, x).astype(x.dtype)
+
+
+def binomial(count, key, prob=None):
+    # paddle.binomial(count, prob): both tensors
+    return jax.random.binomial(key, count.astype(jnp.float32),
+                               prob.astype(jnp.float32)).astype(jnp.int64)
+
+
+def dirichlet(alpha, key):
+    return jax.random.dirichlet(key, alpha.astype(jnp.float32)).astype(
+        alpha.dtype)
+
+
+def exponential_(x, key, lam=1.0):
+    return (jax.random.exponential(key, x.shape, jnp.float32)
+            / lam).astype(x.dtype)
+
+
+def gumbel_softmax(x, key, temperature=1.0, hard=False, axis=-1):
+    g = jax.random.gumbel(key, x.shape, jnp.float32)
+    y = jax.nn.softmax((x.astype(jnp.float32) + g) / temperature, axis=axis)
+    if hard:
+        onehot = (y == jnp.max(y, axis=axis, keepdims=True)).astype(y.dtype)
+        y = lax.stop_gradient(onehot - y) + y  # straight-through estimator
+    return y.astype(x.dtype)
+
+
+def multinomial(x, key, num_samples=1, replacement=False):
+    logits = jnp.log(jnp.maximum(x.astype(jnp.float32), 1e-30))
+    if replacement:
+        # draw along a leading sample axis (broadcast-compatible with the
+        # batch shape), then move it last — paddle returns [..., samples]
+        out = jnp.moveaxis(
+            jax.random.categorical(key, logits, axis=-1,
+                                   shape=(num_samples,) + x.shape[:-1]),
+            0, -1)
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, x.shape, jnp.float32)
+        _, out = lax.top_k(logits + g, num_samples)
+    return out.astype(jnp.int64)
+
+
+def poisson(x, key):
+    return jax.random.poisson(key, x.astype(jnp.float32),
+                              dtype=jnp.int32).astype(x.dtype)
+
+
+def standard_gamma(x, key):
+    return jax.random.gamma(key, x.astype(jnp.float32)).astype(x.dtype)
+
+
+def rrelu(x, key, lower=1.0 / 8, upper=1.0 / 3, training=True):
+    if training:
+        a = jax.random.uniform(key, x.shape, jnp.float32, lower, upper)
+    else:
+        a = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, (a * x.astype(jnp.float32)).astype(x.dtype))
+
+
+def gaussian(shape, key, mean=0.0, std=1.0, dtype="float32"):
+    from paddle_tpu.core.dtype import to_jax_dtype
+
+    dt = to_jax_dtype(dtype)
+    return mean + std * jax.random.normal(key, tuple(shape), dt)
+
+
+def uniform(shape, key, dtype="float32", min=-1.0, max=1.0):  # noqa: A002
+    from paddle_tpu.core.dtype import to_jax_dtype
+
+    return jax.random.uniform(key, tuple(shape), to_jax_dtype(dtype),
+                              min, max)
+
+
+def randint(low, key, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(key, tuple(shape), low, high).astype(dtype)
+
+
+def randperm(n, key, dtype="int64"):
+    return jax.random.permutation(key, int(n)).astype(dtype)
+
+
+def truncated_gaussian_random(shape, key, mean=0.0, std=1.0, a=-2.0, b=2.0,
+                              dtype="float32"):
+    from paddle_tpu.core.dtype import to_jax_dtype
+
+    return (mean + std * jax.random.truncated_normal(
+        key, a, b, tuple(shape), jnp.float32)).astype(to_jax_dtype(dtype))
+
+
+# =================================================================== bitwise
+
+
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True):
+    return jnp.left_shift(x, y)
+
+
+_UNSIGNED = {jnp.dtype(jnp.int8): jnp.uint8,
+             jnp.dtype(jnp.int16): jnp.uint16,
+             jnp.dtype(jnp.int32): jnp.uint32,
+             jnp.dtype(jnp.int64): jnp.uint64}
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True):
+    if is_arithmetic:
+        return jnp.right_shift(x, y)
+    u = _UNSIGNED.get(jnp.dtype(x.dtype))
+    ux = x.view(u) if u is not None else x
+    return jnp.right_shift(ux, y.view(u) if u is not None and
+                           y.dtype == x.dtype else y).astype(x.dtype)
+
+
+# ============================================================== unary extras
+
+
+def copysign(x, y):
+    return jnp.copysign(x, y)
+
+
+def gammaln(x):
+    return jax.scipy.special.gammaln(x)
+
+
+def i0(x):
+    return jax.scipy.special.i0(x)
+
+
+def i0e(x):
+    return jax.scipy.special.i0e(x)
+
+
+def i1(x):
+    return jax.scipy.special.i1(x)
+
+
+def i1e(x):
+    return jax.scipy.special.i1e(x)
+
+
+def logsigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def polygamma(x, n=0):
+    if n == 0:
+        return jax.scipy.special.digamma(x)
+    return jax.scipy.special.polygamma(n, x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def tanh_shrink(x):
+    return x - jnp.tanh(x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, jnp.asarray(value, x.dtype))
+
+
+def increment(x, value=1.0):
+    return x + jnp.asarray(value, x.dtype)
+
+
+# ==================================================================== losses
+
+
+def bce_loss(input, label):  # noqa: A002
+    x = jnp.clip(input.astype(jnp.float32), 1e-12, 1 - 1e-7)
+    out = -(label * jnp.log(x) + (1 - label) * jnp.log1p(-x))
+    return out.astype(input.dtype)
+
+
+def hinge_loss(logits, labels):
+    return jnp.maximum(
+        1.0 - logits.astype(jnp.float32) * labels.astype(jnp.float32),
+        0.0).astype(logits.dtype)
+
+
+def huber_loss(input, label, delta=1.0):  # noqa: A002
+    """Returns (out, residual) per phi HuberLossKernel."""
+    residual = (label - input).astype(jnp.float32)
+    a = jnp.abs(residual)
+    out = jnp.where(a <= delta, 0.5 * residual * residual,
+                    delta * (a - 0.5 * delta))
+    return out.astype(input.dtype), residual.astype(input.dtype)
+
+
+def kldiv_loss(x, target, reduction="mean", log_target=False):
+    t = target.astype(jnp.float32)
+    xf = x.astype(jnp.float32)
+    if log_target:
+        out = jnp.exp(t) * (t - xf)
+    else:
+        out = jnp.where(t > 0, t * (jnp.log(jnp.maximum(t, 1e-30)) - xf),
+                        0.0)
+    if reduction == "mean":
+        return jnp.mean(out).astype(x.dtype)
+    if reduction == "batchmean":
+        return (jnp.sum(out) / x.shape[0]).astype(x.dtype)
+    if reduction == "sum":
+        return jnp.sum(out).astype(x.dtype)
+    return out.astype(x.dtype)
+
+
+def log_loss(input, label, epsilon=1e-4):  # noqa: A002
+    x = input.astype(jnp.float32)
+    out = (-label * jnp.log(x + epsilon)
+           - (1 - label) * jnp.log(1 - x + epsilon))
+    return out.astype(input.dtype)
+
+
+def sigmoid_cross_entropy_with_logits(x, label, normalize=False,
+                                      ignore_index=-100):
+    xf = x.astype(jnp.float32)
+    lf = label.astype(jnp.float32)
+    out = jnp.maximum(xf, 0) - xf * lf + jnp.log1p(jnp.exp(-jnp.abs(xf)))
+    mask = (lf != ignore_index)
+    out = jnp.where(mask, out, 0.0)
+    if normalize:
+        out = out / jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+    return out.astype(x.dtype)
+
+
+def identity_loss(x, reduction="none"):
+    if reduction in ("mean", 0):
+        return jnp.mean(x)
+    if reduction in ("sum", 1):
+        return jnp.sum(x)
+    return x
+
+
+def cross_entropy_with_softmax(logits, label, soft_label=False,
+                               use_softmax=True, numeric_stable_mode=True,
+                               ignore_index=-100, axis=-1):
+    """Returns (softmax, loss) per phi CrossEntropyWithSoftmaxKernel."""
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis) \
+        if use_softmax else jnp.log(jnp.maximum(logits, 1e-30))
+    sm = jnp.exp(lp)
+    if soft_label:
+        loss = -jnp.sum(label * lp, axis=axis, keepdims=True)
+    else:
+        lbl = label.astype(jnp.int32)
+        squeeze = lbl.ndim == logits.ndim
+        idx = lbl if squeeze else lbl[..., None]
+        picked = jnp.take_along_axis(lp, jnp.maximum(idx, 0), axis=axis)
+        loss = jnp.where(idx == ignore_index, 0.0, -picked)
+    return sm.astype(logits.dtype), loss.astype(logits.dtype)
+
+
+# ============================================================== manipulation
+
+
+def as_complex(x):
+    return lax.complex(x[..., 0], x[..., 1])
+
+
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def complex(real, imag):  # noqa: A001
+    return lax.complex(real, imag)
+
+
+def as_strided(x, shape, stride, offset=0):
+    """Functional as_strided: gather from the flat buffer (phi stride
+    kernels collapse to gathers on TPU — no aliasing views in XLA)."""
+    flat = x.reshape(-1)
+    idx = jnp.asarray(offset)
+    for s, st in zip(shape, stride):
+        idx = idx[..., None] + jnp.arange(s) * st
+    return flat[idx.reshape(tuple(shape))]
+
+
+def broadcast_tensors(xs):
+    shape = jnp.broadcast_shapes(*[x.shape for x in xs])
+    return tuple(jnp.broadcast_to(x, shape) for x in xs)
+
+
+def crop(x, shape=None, offsets=None):
+    offsets = offsets or [0] * x.ndim
+    shape = shape or x.shape
+    idx = tuple(builtins.slice(int(o), int(o) + int(s))
+                for o, s in zip(offsets, shape))
+    return x[idx]
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def fill(x, value):
+    return jnp.full_like(x, value)
+
+
+def fill_diagonal(x, value=0.0, offset=0, wrap=False):
+    rows, cols = x.shape[-2], x.shape[-1]
+    n = min(rows, cols)
+    i = jnp.arange(n)
+    r = i + max(-offset, 0)
+    c = i + max(offset, 0)
+    ok = (r < rows) & (c < cols)
+    r, c = jnp.where(ok, r, 0), jnp.where(ok, c, 0)
+    upd = jnp.where(ok, jnp.asarray(value, x.dtype), x[..., r, c])
+    out = x.at[..., r, c].set(upd)
+    if wrap and x.ndim == 2 and rows > cols:
+        # wrap the diagonal around tall matrices (numpy fill_diagonal)
+        for start in range(cols + 1, rows, cols + 1):
+            m = min(cols, rows - start)
+            out = out.at[start:start + m, :m].set(
+                jnp.where(jnp.eye(m, dtype=bool),
+                          jnp.asarray(value, x.dtype),
+                          out[start:start + m, :m]))
+    return out
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1):
+    nd = x.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    xm = jnp.moveaxis(x, (d1, d2), (nd - 2, nd - 1))
+    rows, cols = xm.shape[-2], xm.shape[-1]
+    n = min(rows - max(-offset, 0), cols - max(offset, 0))
+    i = jnp.arange(n)
+    r = i + max(-offset, 0)
+    c = i + max(offset, 0)
+    ym = jnp.moveaxis(y, -1, y.ndim - 1) if y.ndim else y
+    xm = xm.at[..., r, c].set(ym)
+    return jnp.moveaxis(xm, (nd - 2, nd - 1), (d1, d2))
+
+
+def index_add(x, index, add_value, axis=0):
+    return x.at[(builtins.slice(None),) * (axis % x.ndim)
+                + (index,)].add(add_value)
+
+
+def index_put(x, indices, value, accumulate=False):
+    idx = tuple(indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+def reverse(x, axis):
+    axis = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return jnp.flip(x, axis=axis)
+
+
+def sequence_mask(x, max_len=None, out_dtype="int64"):
+    m = int(max_len) if max_len is not None else None
+    if m is None:
+        raise ValueError("sequence_mask requires max_len under jit "
+                         "(value-dependent output shape otherwise)")
+    return (jnp.arange(m) < x[..., None]).astype(out_dtype)
+
+
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    size = (index_num + nshards - 1) // nshards  # ceil (phi ShardIndex)
+    in_shard = (x // size) == shard_id
+    return jnp.where(in_shard, x % size, ignore_value)
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    slices = [builtins.slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        slices[ax] = builtins.slice(int(st), int(en))
+    return x[tuple(slices)]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    slices = [builtins.slice(None)] * x.ndim
+    for ax, st, en, sr in zip(axes, starts, ends, strides):
+        slices[ax] = builtins.slice(int(st), int(en), int(sr))
+    return x[tuple(slices)]
+
+
+def split_with_num(x, num, axis=0):
+    return tuple(jnp.split(x, int(num), axis=int(axis)))
+
+
+def multiplex(inputs, index):
+    stacked = jnp.stack(list(inputs))           # [K, N, ...]
+    idx = index.reshape(-1).astype(jnp.int32)   # [N]
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64"):
+    flat = x.reshape(-1) if axis is None else x
+    keep = jnp.concatenate([jnp.array([True]), flat[1:] != flat[:-1]])
+    out = flat[np.asarray(keep)]
+    rets = (out,)
+    if return_inverse:
+        inv = jnp.cumsum(keep.astype(dtype)) - 1
+        rets += (inv,)
+    if return_counts:
+        idx = np.flatnonzero(np.asarray(keep))
+        counts = jnp.asarray(np.diff(np.append(idx, flat.shape[0])),
+                             dtype=dtype)
+        rets += (counts,)
+    return rets if len(rets) > 1 else out
+
+
+# ======================================================== reductions / checks
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+def mean_all(x):
+    return jnp.mean(x)
+
+
+def numel(x):
+    return jnp.asarray(x.size, jnp.int64)
+
+
+def shape(x):
+    return jnp.asarray(x.shape, jnp.int32)
+
+
+def is_empty(x):
+    return jnp.asarray(x.size == 0)
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg"):
+    return jnp.nanmedian(x, axis=tuple(axis) if isinstance(
+        axis, (list, tuple)) else axis, keepdims=keepdim)
+
+
+def _cum_with_idx(x, axis, better):
+    axis = axis % x.ndim if axis is not None else 0
+
+    def combine(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = better(bv, av)
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    idx = jnp.broadcast_to(
+        jnp.arange(x.shape[axis]).reshape(
+            (1,) * axis + (-1,) + (1,) * (x.ndim - axis - 1)), x.shape)
+    vals, idxs = lax.associative_scan(combine, (x, idx), axis=axis)
+    return vals, idxs.astype(jnp.int64)
+
+
+def cummax(x, axis=None, dtype="int64"):
+    flat = axis is None
+    xx = x.reshape(-1) if flat else x
+    v, i = _cum_with_idx(xx, 0 if flat else axis, lambda b, a: b > a)
+    return v, i
+
+
+def cummin(x, axis=None, dtype="int64"):
+    flat = axis is None
+    xx = x.reshape(-1) if flat else x
+    v, i = _cum_with_idx(xx, 0 if flat else axis, lambda b, a: b < a)
+    return v, i
+
+
+def l1_norm(x):
+    return jnp.sum(jnp.abs(x))
+
+
+def squared_l2_norm(x):
+    return jnp.sum(jnp.square(x))
+
+
+def clip_by_norm(x, max_norm):
+    norm = jnp.sqrt(jnp.maximum(jnp.sum(jnp.square(x)), 1e-12))
+    scale = jnp.minimum(max_norm / norm, 1.0)
+    return x * scale
+
+
+# ========================================================== vision / signal
+
+
+def affine_grid(theta, out_shape, align_corners=True):
+    n, _, h, w = (int(s) for s in out_shape)
+
+    def axis_coords(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        return (jnp.arange(size) * 2 + 1) / size - 1.0
+
+    ys = axis_coords(h)
+    xs = axis_coords(w)
+    gx, gy = jnp.meshgrid(xs, ys)              # [h, w]
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [h, w, 3]
+    grid = jnp.einsum("hwk,nck->nhwc", base, theta.astype(jnp.float32))
+    return grid.astype(theta.dtype)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """NCHW input, [N,H,W,2] grid in [-1,1] (phi GridSampleKernel)."""
+    n, c, h, w = x.shape
+    gx = grid[..., 0].astype(jnp.float32)
+    gy = grid[..., 1].astype(jnp.float32)
+
+    def unnorm(g, size):
+        if align_corners:
+            return (g + 1) / 2 * (size - 1)
+        return ((g + 1) * size - 1) / 2
+
+    fx = unnorm(gx, w)
+    fy = unnorm(gy, h)
+    if padding_mode == "border":
+        fx = jnp.clip(fx, 0, w - 1)
+        fy = jnp.clip(fy, 0, h - 1)
+    elif padding_mode == "reflection":
+        def reflect(g, size):
+            if align_corners:
+                span = 2 * (size - 1)
+                g = jnp.abs(g) % jnp.maximum(span, 1)
+                return jnp.where(g > size - 1, span - g, g)
+            span = 2 * size
+            g = (jnp.abs(g + 0.5) % span)
+            g = jnp.where(g > size, span - g, g) - 0.5
+            return jnp.clip(g, 0, size - 1)
+
+        fx = reflect(fx, w)
+        fy = reflect(fy, h)
+
+    def sample_at(ix, iy):
+        inb = ((ix >= 0) & (ix < w) & (iy >= 0) & (iy < h))
+        ixc = jnp.clip(ix, 0, w - 1)
+        iyc = jnp.clip(iy, 0, h - 1)
+        vals = x[jnp.arange(n)[:, None, None], :, iyc, ixc]  # [N,Ho,Wo,C]
+        if padding_mode == "zeros":
+            vals = jnp.where(inb[..., None], vals, 0.0)
+        return vals
+
+    if mode == "nearest":
+        out = sample_at(jnp.round(fx).astype(jnp.int32),
+                        jnp.round(fy).astype(jnp.int32))
+    else:
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = fx - x0
+        wy = fy - y0
+        out = (sample_at(x0, y0) * ((1 - wx) * (1 - wy))[..., None]
+               + sample_at(x1, y0) * (wx * (1 - wy))[..., None]
+               + sample_at(x0, y1) * ((1 - wx) * wy)[..., None]
+               + sample_at(x1, y1) * (wx * wy)[..., None])
+    return jnp.moveaxis(out, -1, 1).astype(x.dtype)  # NHWC -> NCHW
+
+
+def channel_shuffle(x, groups, data_format="NCHW"):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        return x.reshape(n, groups, c // groups, h, w).swapaxes(
+            1, 2).reshape(n, c, h, w)
+    n, h, w, c = x.shape
+    return x.reshape(n, h, w, groups, c // groups).swapaxes(
+        3, 4).reshape(n, h, w, c)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = downscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        return x.transpose(0, 1, 3, 5, 2, 4).reshape(
+            n, c * r * r, h // r, w // r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    return x.transpose(0, 1, 3, 5, 2, 4).reshape(
+        n, h // r, w // r, c * r * r)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """col2im: inverse of unfold (phi FoldKernel). x: [N, C*kh*kw, L]."""
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    n, ckk, L = x.shape
+    c = ckk // (kh * kw)
+    nh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    nw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    cols = x.reshape(n, c, kh, kw, nh, nw)
+    out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            out = out.at[:, :,
+                         i * dh:i * dh + nh * sh:sh,
+                         j * dw:j * dw + nw * sw:sw].add(cols[:, :, i, j])
+    return out[:, :, ph:ph + oh, pw:pw + ow]
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    if data_format != "NCHW":
+        x = jnp.moveaxis(x, -1, 1)
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x5 = x.reshape(n, seg_num, c, h, w)
+    fold_c = int(c * shift_ratio)
+    back = jnp.concatenate([x5[:, 1:, :fold_c],
+                            jnp.zeros_like(x5[:, :1, :fold_c])], axis=1)
+    fwd = jnp.concatenate([jnp.zeros_like(x5[:, :1, fold_c:2 * fold_c]),
+                           x5[:, :-1, fold_c:2 * fold_c]], axis=1)
+    keep = x5[:, :, 2 * fold_c:]
+    out = jnp.concatenate([back, fwd, keep], axis=2).reshape(nt, c, h, w)
+    if data_format != "NCHW":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def maxout(x, groups, axis=1):
+    axis = axis % x.ndim
+    c = x.shape[axis]
+    new_shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / k
+
+
+def pad3d(x, paddings, mode="constant", value=0.0, data_format="NCDHW"):
+    p = [int(v) for v in paddings]  # [l, r, t, b, f, bk] (W, H, D order)
+    if data_format == "NCDHW":
+        cfg = [(0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1])]
+    else:
+        cfg = [(0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1]), (0, 0)]
+    if mode == "constant":
+        return jnp.pad(x, cfg, constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+def _ceil_extra(spatial, k, s, p, ceil_mode):
+    """Extra high-side padding so output size rounds up (phi ceil_mode).
+    reduce_window pads with the init value, so max/sum stay correct."""
+    if not ceil_mode:
+        return [0] * len(k)
+    extra = []
+    for sp, ki, si, pi in zip(spatial, k, s, p):
+        out = -(-(sp + 2 * pi - ki) // si) + 1    # ceil
+        extra.append(max((out - 1) * si + ki - (sp + 2 * pi), 0))
+    return extra
+
+
+def _pool_nd(x, k, s, p, reducer, init, ceil_mode=False):
+    dims = (1, 1) + k
+    strides = (1, 1) + s
+    extra = _ceil_extra(x.shape[2:], k, s, p, ceil_mode)
+    pads = [(0, 0), (0, 0)] + [(pi, pi + e) for pi, e in zip(p, extra)]
+    return lax.reduce_window(x, jnp.asarray(init, x.dtype), reducer, dims,
+                             strides, pads)
+
+
+def pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+           exclusive=True, data_format="NCDHW", pooling_type="max"):
+    k = _pair(kernel_size, 3)
+    s = _pair(stride if stride is not None else kernel_size, 3)
+    p = _pair(padding, 3)
+    if pooling_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return _pool_nd(x, k, s, p, lax.max, init, ceil_mode)
+    ones_ = jnp.ones_like(x)
+    summed = _pool_nd(x, k, s, p, lax.add, 0, ceil_mode)
+    if exclusive:
+        cnt = _pool_nd(ones_, k, s, p, lax.add, 0, ceil_mode)
+    else:
+        cnt = float(np.prod(k))
+    return summed / cnt
+
+
+max_pool3d = lambda x, kernel_size, stride=None, padding=0, \
+    ceil_mode=False, data_format="NCDHW": pool3d(
+        x, kernel_size, stride, padding, ceil_mode,
+        data_format=data_format, pooling_type="max")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, data_format="NCDHW"):
+    return pool3d(x, kernel_size, stride, padding, ceil_mode, exclusive,
+                  data_format, pooling_type="avg")
+
+
+def _pool_with_index(x, k, s, p, spatial, ceil_mode=False):
+    """Shared max-pool-with-argmax: extract windows, max + flat argmax."""
+    n, c = x.shape[:2]
+    patches = []
+    idx_patches = []
+    flat_idx = jnp.arange(int(np.prod(spatial))).reshape(spatial)
+    extra = _ceil_extra(spatial, k, s, p, ceil_mode)
+    pads = [(0, 0), (0, 0)] + [(pi, pi + e) for pi, e in zip(p, extra)]
+    xp = jnp.pad(x, pads, constant_values=-jnp.inf)
+    ip = jnp.pad(flat_idx, [(pi, pi + e) for pi, e in zip(p, extra)],
+                 constant_values=-1)
+    out_sp = [(sp + 2 * pi + e - ki) // si + 1
+              for sp, pi, e, ki, si in zip(spatial, p, extra, k, s)]
+    for offs in np.ndindex(*k):
+        sl = tuple(
+            builtins.slice(o, o + (osp - 1) * si + 1, si)
+            for o, osp, si in zip(offs, out_sp, s))
+        patches.append(xp[(builtins.slice(None),) * 2 + sl])
+        idx_patches.append(ip[sl])
+    stacked = jnp.stack(patches)          # [K, N, C, *out]
+    sidx = jnp.stack(idx_patches)         # [K, *out]
+    arg = jnp.argmax(stacked, axis=0)     # [N, C, *out]
+    out = jnp.max(stacked, axis=0)
+    sidx_b = jnp.broadcast_to(
+        sidx[(builtins.slice(None), None, None)], stacked.shape)
+    indices = jnp.take_along_axis(sidx_b, arg[None], axis=0)[0]
+    return out, indices.astype(jnp.int32)
+
+
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
+                          global_pooling=False, adaptive=False,
+                          ceil_mode=False):
+    k = _pair(kernel_size)
+    s = _pair(stride if stride is not None else kernel_size)
+    p = _pair(padding)
+    return _pool_with_index(x, k, s, p, x.shape[2:], ceil_mode)
+
+
+def max_pool3d_with_index(x, kernel_size, stride=None, padding=0,
+                          global_pooling=False, adaptive=False,
+                          ceil_mode=False):
+    k = _pair(kernel_size, 3)
+    s = _pair(stride if stride is not None else kernel_size, 3)
+    p = _pair(padding, 3)
+    return _pool_with_index(x, k, s, p, x.shape[2:], ceil_mode)
+
+
+def lp_pool2d(x, kernel_size, stride=None, padding=0, norm_type=2.0,
+              ceil_mode=False, data_format="NCHW"):
+    k = _pair(kernel_size)
+    s = _pair(stride if stride is not None else kernel_size)
+    p = _pair(padding)
+    xf = jnp.abs(x.astype(jnp.float32)) ** norm_type
+    summed = _pool_nd(xf, k, s, p, lax.add, 0, ceil_mode)
+    return (summed ** (1.0 / norm_type)).astype(x.dtype)
+
+
+def nms(x, threshold=1.0):
+    """Hard NMS over [N,4] boxes (sorted by caller) — dynamic output;
+    eager-only like the reference's masked ops. Returns keep indices."""
+    boxes = np.asarray(x, np.float32)
+    n = boxes.shape[0]
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    areas = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    keep = []
+    suppressed = np.zeros(n, bool)
+    for i in range(n):
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(x1[i], x1[i + 1:])
+        yy1 = np.maximum(y1[i], y1[i + 1:])
+        xx2 = np.minimum(x2[i], x2[i + 1:])
+        yy2 = np.minimum(y2[i], y2[i + 1:])
+        inter = (np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0))
+        iou = inter / np.maximum(areas[i] + areas[i + 1:] - inter, 1e-10)
+        suppressed[i + 1:] |= iou > threshold
+    return jnp.asarray(np.asarray(keep, np.int64))
+
+
+def gather_tree(ids, parents):
+    """Beam-search ancestry walk (phi GatherTreeKernel).
+    ids/parents: [T, B, W]."""
+    T = ids.shape[0]
+
+    def body(carry, t):
+        beams = carry                   # [B, W] current beam per slot
+        got = jnp.take_along_axis(ids[t], beams, axis=-1)
+        nxt = jnp.take_along_axis(parents[t], beams, axis=-1)
+        return nxt, got
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2]), ids.shape[1:])
+    _, out = lax.scan(body, init, jnp.arange(T - 1, -1, -1))
+    return jnp.flip(out, axis=0)
+
+
+# ======================================================== conv extensions
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    s, d = _pair(stride, 3), _pair(dilation, 3)
+    p = _pair(padding, 3)
+    pad = [(pi, pi) for pi in p]
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    ("NCDHW", "OIDHW", "NCDHW"))
+    out = lax.conv_general_dilated(x, weight, window_strides=s, padding=pad,
+                                   rhs_dilation=d, dimension_numbers=dn,
+                                   feature_group_count=groups)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1)
+    return out
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW"):
+    s, d = _pair(stride, 3), _pair(dilation, 3)
+    p = _pair(padding, 3)
+    op = _pair(output_padding, 3)
+    # weight layout IODHW (paddle stores [in, out/groups, kd, kh, kw])
+    kd, kh, kw = weight.shape[2:]
+    pad = [(d[i] * (ksz - 1) - p[i], d[i] * (ksz - 1) - p[i] + op[i])
+           for i, ksz in enumerate((kd, kh, kw))]
+    w = jnp.flip(weight, axis=(2, 3, 4))
+    if groups > 1:
+        i_, og = w.shape[0], w.shape[1]
+        w = w.reshape(groups, i_ // groups, og, kd, kh, kw)
+        w = jnp.moveaxis(w, 2, 1).reshape(groups * og, i_ // groups,
+                                          kd, kh, kw)
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCDHW", "OIDHW", "NCDHW"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1, 1), padding=pad, lhs_dilation=s,
+        rhs_dilation=d, dimension_numbers=dn, feature_group_count=groups)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1)
+    return out
+
+
+def depthwise_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                     groups=None, data_format="NCHW"):
+    from paddle_tpu.ops.impl import conv2d
+
+    return conv2d(x, weight, bias, stride, padding, dilation,
+                  groups=x.shape[1], data_format=data_format)
+
+
+def depthwise_conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                               output_padding=0, dilation=1, groups=None,
+                               data_format="NCHW"):
+    from paddle_tpu.ops.impl import conv2d_transpose
+
+    return conv2d_transpose(x, weight, bias, stride, padding,
+                            output_padding, dilation, groups=x.shape[1])
+
+
+# ================================================= interp aliases / bilinear
+
+
+def _resize(x, spatial, method, align_corners=False):
+    spatial = tuple(int(v) for v in spatial)
+    if not align_corners:
+        return jax.image.resize(x, x.shape[:2] + spatial, method=method)
+    if method == "cubic":
+        raise NotImplementedError(
+            "bicubic_interp with align_corners=True is not supported")
+    # corner-aligned: sample at coords i*(in-1)/(out-1) per spatial axis
+    out = x
+    for ax, osz in enumerate(spatial):
+        isz = out.shape[2 + ax]
+        if osz == isz:
+            continue
+        coords = (jnp.arange(osz) * (isz - 1) / max(osz - 1, 1)
+                  if osz > 1 else jnp.zeros(1))
+        if method == "nearest":
+            gathered = jnp.take(out, jnp.round(coords).astype(jnp.int32),
+                                axis=2 + ax)
+        else:
+            lo = jnp.clip(jnp.floor(coords).astype(jnp.int32), 0, isz - 1)
+            hi = jnp.clip(lo + 1, 0, isz - 1)
+            wgt = (coords - lo).reshape(
+                (1,) * (2 + ax) + (-1,) + (1,) * (out.ndim - 3 - ax))
+            gathered = (jnp.take(out, lo, axis=2 + ax) * (1 - wgt)
+                        + jnp.take(out, hi, axis=2 + ax) * wgt)
+        out = gathered.astype(x.dtype)
+    return out
+
+
+def bilinear_interp(x, out_h, out_w, align_corners=False):
+    return _resize(x, (out_h, out_w), "linear", align_corners)
+
+
+def nearest_interp(x, out_h, out_w, align_corners=False):
+    return _resize(x, (out_h, out_w), "nearest", align_corners)
+
+
+def bicubic_interp(x, out_h, out_w, align_corners=False):
+    return _resize(x, (out_h, out_w), "cubic", align_corners)
+
+
+def linear_interp(x, out_w, align_corners=False):
+    return _resize(x, (out_w,), "linear", align_corners)
+
+
+def trilinear_interp(x, out_d, out_h, out_w, align_corners=False):
+    return _resize(x, (out_d, out_h, out_w), "linear", align_corners)
+
+
+def bilinear(x, y, weight, bias=None):
+    """Bilinear tensor product: out[n,k] = x[n,i] W[k,i,j] y[n,j]."""
+    out = jnp.einsum("ni,kij,nj->nk", x, weight, y)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+# ===================================================== final-mile reference ops
+
+
+def accuracy(x, indices, label):
+    """(accuracy, correct, total) per phi AccuracyKernel: x = topk probs,
+    indices = topk indices [N, k], label [N, 1]."""
+    correct_k = (indices == label).any(axis=-1)
+    correct = jnp.sum(correct_k.astype(jnp.int32))
+    total = jnp.asarray(x.shape[0], jnp.int32)
+    return (correct / total).astype(jnp.float32), correct, total
+
+
+def auc(predict, label, num_thresholds=4095):
+    """Batch ROC-AUC via thresholded TP/FP accumulation (phi AucKernel
+    single-batch form)."""
+    pos_prob = predict[:, 1] if predict.ndim == 2 else predict
+    thresholds = jnp.linspace(0.0, 1.0, num_thresholds + 1)
+    lbl = label.reshape(-1).astype(bool)
+    above = pos_prob.reshape(-1)[None, :] >= thresholds[:, None]
+    tp = jnp.sum(above & lbl[None, :], axis=1).astype(jnp.float64)
+    fp = jnp.sum(above & ~lbl[None, :], axis=1).astype(jnp.float64)
+    tpr = tp / jnp.maximum(tp[0], 1)
+    fpr = fp / jnp.maximum(fp[0], 1)
+    return jnp.trapezoid(tpr[::-1], fpr[::-1]).astype(jnp.float32)
+
+
+def affine_channel(x, scale, bias, data_format="NCHW"):
+    shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+    return x * scale.reshape(shape) + bias.reshape(shape)
+
+
+def conv2d_transpose_bias(x, weight, bias=None, stride=1, padding=0,
+                          output_padding=0, dilation=1, groups=1):
+    from paddle_tpu.ops.impl import conv2d_transpose
+
+    return conv2d_transpose(x, weight, bias, stride, padding,
+                            output_padding, dilation, groups)
+
+
+def fft_c2c(x, axes=None, normalization="backward", forward=True):
+    ax = tuple(axes) if axes is not None else None
+    f = jnp.fft.fftn if forward else jnp.fft.ifftn
+    return f(x, axes=ax, norm=normalization)
+
+
+def fft_r2c(x, axes=None, normalization="backward", forward=True,
+            onesided=True):
+    ax = tuple(axes) if axes is not None else None
+    return (jnp.fft.rfftn if onesided else jnp.fft.fftn)(
+        x, axes=ax, norm=normalization)
+
+
+def fft_c2r(x, axes=None, normalization="backward", forward=False,
+            last_dim_size=0):
+    ax = tuple(axes) if axes is not None else None
+    n = None if not last_dim_size else int(last_dim_size)
+    if ax is not None and n is not None:
+        return jnp.fft.irfftn(x, s=(n,), axes=(ax[-1],), norm=normalization)
+    return jnp.fft.irfftn(x, axes=ax, norm=normalization)
+
+
+def _fractional_starts(in_sz, out_sz, u):
+    alpha = (in_sz - 1) / out_sz if out_sz > 1 else 1.0
+    idx = jnp.floor(alpha * (jnp.arange(out_sz) + u)).astype(jnp.int32)
+    return jnp.clip(idx, 0, in_sz - 1)
+
+
+def _fractional_pool(x, out_sizes, random_u):
+    """Variable-window max pool via per-cell masks over the spatial dims.
+    Returns (out, flat argmax indices)."""
+    spatial = x.shape[2:]
+    masks = []
+    for sz, osz in zip(spatial, out_sizes):
+        st = _fractional_starts(sz, osz, random_u)
+        en = jnp.append(st[1:], sz)
+        i = jnp.arange(sz)
+        masks.append((i[None, :] >= st[:, None])
+                     & (i[None, :] < en[:, None]))     # [o, in]
+    nd = len(spatial)
+    # outer product of [o_i, in_i] masks -> [o1..ok, in1..ink]
+    m = masks[0]
+    o_dims = [masks[0].shape[0]]
+    in_dims = [masks[0].shape[1]]
+    for mm in masks[1:]:
+        m = (m.reshape(tuple(o_dims) + (1,) + tuple(in_dims) + (1,))
+             & mm.reshape((1,) * len(o_dims) + (mm.shape[0],)
+                          + (1,) * len(in_dims) + (mm.shape[1],)))
+        # reorder to [o1..ok, in1..ink]
+        perm = (list(range(len(o_dims))) + [len(o_dims)]
+                + list(range(len(o_dims) + 1,
+                             len(o_dims) + 1 + len(in_dims)))
+                + [len(o_dims) + 1 + len(in_dims)])
+        m = m.transpose(perm)
+        o_dims.append(mm.shape[0])
+        in_dims.append(mm.shape[1])
+    xb = x.reshape(x.shape[:2] + (1,) * nd + spatial)
+    mb = m[(None, None)]
+    masked = jnp.where(mb, xb, -jnp.inf)
+    flat = masked.reshape(x.shape[:2] + tuple(o_dims) + (-1,))
+    out = jnp.max(flat, axis=-1)
+    idx = jnp.argmax(flat, axis=-1).astype(jnp.int32)
+    return out, idx
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=0.5,
+                          return_mask=False):
+    out_sizes = (output_size if isinstance(output_size, (list, tuple))
+                 else (output_size,) * 2)
+    out, idx = _fractional_pool(x, out_sizes, random_u)
+    return (out, idx) if return_mask else out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=0.5,
+                          return_mask=False):
+    out_sizes = (output_size if isinstance(output_size, (list, tuple))
+                 else (output_size,) * 3)
+    out, idx = _fractional_pool(x, out_sizes, random_u)
+    return (out, idx) if return_mask else out
+
+
+def frame(x, frame_length, hop_length, axis=-1):
+    """[..., seq] -> [..., frame_length, num_frames] (axis=-1; phi
+    FrameKernel layout)."""
+    n = x.shape[axis]
+    num = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[:, None]
+           + hop_length * jnp.arange(num)[None, :])  # [fl, num]
+    if axis in (-1, x.ndim - 1):
+        return x[..., idx]
+    if axis in (0, -x.ndim):
+        return x[idx.T.reshape(-1)].reshape((num, frame_length)
+                                            + x.shape[1:]).swapaxes(0, 1)
+    raise NotImplementedError("frame: axis must be first or last")
+
+
+def overlap_add(x, hop_length, axis=-1):
+    """Inverse of frame: x [..., frame_length, num_frames] (axis=-1)."""
+    if axis != -1:
+        raise NotImplementedError("overlap_add: axis=-1 only")
+    fl, nf = x.shape[-2], x.shape[-1]
+    out_len = (nf - 1) * hop_length + fl
+    out = jnp.zeros(x.shape[:-2] + (out_len,), x.dtype)
+    for f in range(nf):
+        out = out.at[..., f * hop_length:f * hop_length + fl].add(
+            x[..., :, f])
+    return out
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = jnp.ones(win_length, x.dtype) if window is None else window
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lp, n_fft - win_length - lp))
+    if center:
+        pad = n_fft // 2
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)],
+                    mode=pad_mode)
+    frames = frame(x, n_fft, hop_length, axis=-1)   # [..., n_fft, num]
+    spec = (jnp.fft.rfft if onesided else jnp.fft.fft)(
+        frames * win[:, None], axis=-2)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    return spec
+
+
+def full_(x, shape=None, fill_value=0.0, dtype=None):
+    return jnp.full_like(x, fill_value)
+
+
+def gammaincc(x, y):
+    return jax.scipy.special.gammaincc(x, y)
+
+
+def hsigmoid_loss(x, label, w, bias=None, num_classes=2):
+    """Hierarchical sigmoid over the default complete binary tree (phi
+    HSigmoidLossKernel default-path mode). Returns (out, pre_out, w_out)."""
+    code_length = max(int(math.ceil(math.log2(max(num_classes, 2)))), 1)
+    n = x.shape[0]
+    codes = []
+    paths = []
+    lbl = label.reshape(-1).astype(jnp.int32) + num_classes - 1
+    cur = lbl
+    for _ in range(code_length):
+        parent = (cur - 1) // 2
+        codes.append((cur % 2 == 0).astype(jnp.float32))  # right child -> 1
+        paths.append(parent)
+        cur = parent
+    path = jnp.stack(paths, axis=1)          # [N, L] internal node ids
+    code = jnp.stack(codes, axis=1)          # [N, L]
+    wp = w[path]                             # [N, L, D]
+    pre = jnp.einsum("nld,nd->nl", wp, x)
+    if bias is not None:
+        pre = pre + bias.reshape(-1)[path]
+    valid = (path >= 0) & (path < w.shape[0])
+    ce = jnp.maximum(pre, 0) - pre * code + jnp.log1p(jnp.exp(-jnp.abs(pre)))
+    out = jnp.sum(jnp.where(valid, ce, 0.0), axis=1, keepdims=True)
+    return out, pre, w
+
+
+def matrix_rank_tol(x, tol_tensor, use_default_tol=True, hermitian=False):
+    return jnp.linalg.matrix_rank(x, tol=tol_tensor)
+
+
+def matrix_rank_atol_rtol(x, atol=None, rtol=None, hermitian=False):
+    s = jnp.linalg.svd(x, compute_uv=False)
+    a = 0.0 if atol is None else atol
+    r = (jnp.finfo(x.dtype).eps * max(x.shape[-2:])) if rtol is None else rtol
+    tol = jnp.maximum(jnp.asarray(a), r * s[..., 0])
+    return jnp.sum(s > tol[..., None], axis=-1)
+
+
+def pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+           exclusive=True, data_format="NCHW", pooling_type="max",
+           global_pooling=False, adaptive=False):
+    from paddle_tpu.ops.impl import avg_pool2d, max_pool2d
+
+    if global_pooling:
+        kernel_size = x.shape[2:]
+        stride, padding = kernel_size, 0
+    if pooling_type == "max":
+        return max_pool2d(x, kernel_size, stride, padding, ceil_mode,
+                          data_format)
+    return avg_pool2d(x, kernel_size, stride, padding, ceil_mode,
+                      exclusive, data_format)
+
+
+def reduce_as(x, target):
+    """Sum-reduce x down to target's shape (phi ReduceAsKernel)."""
+    extra = x.ndim - target.ndim
+    if extra:
+        x = jnp.sum(x, axis=tuple(range(extra)))
+    axes = tuple(i for i, (a, b) in enumerate(zip(x.shape, target.shape))
+                 if a != b and b == 1)
+    if axes:
+        x = jnp.sum(x, axis=axes, keepdims=True)
+    return x
+
+
+def spectral_norm(weight, u, v, dim=0, power_iters=1, eps=1e-12):
+    w = jnp.moveaxis(weight, dim, 0).reshape(weight.shape[dim], -1)
+    for _ in range(max(power_iters, 0)):
+        v = w.T @ u
+        v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+        u = w @ v
+        u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+    sigma = u @ w @ v
+    return weight / sigma
+
+
+def unpool(x, indices, kernel_size=None, stride=None, padding=0,
+           output_size=None, data_format="NCHW"):
+    """Max-unpool2d: scatter pooled values back at `indices` (flat H*W)."""
+    n, c, h, w = x.shape
+    if output_size is not None:
+        oh, ow = int(output_size[-2]), int(output_size[-1])
+    else:
+        k = kernel_size if isinstance(kernel_size, (list, tuple)) else \
+            (kernel_size,) * 2
+        s = stride or k
+        s = s if isinstance(s, (list, tuple)) else (s,) * 2
+        oh, ow = h * s[0], w * s[1]
+    out = jnp.zeros((n, c, oh * ow), x.dtype)
+    out = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v)))(
+        out, indices.reshape(n, c, -1), x.reshape(n, c, -1))
+    return out.reshape(n, c, oh, ow)
+
+
+def unpool3d(x, indices, kernel_size=None, stride=None, padding=0,
+             output_size=None, data_format="NCDHW"):
+    n, c, d, h, w = x.shape
+    if output_size is not None:
+        od, oh, ow = (int(v) for v in output_size[-3:])
+    else:
+        k = kernel_size if isinstance(kernel_size, (list, tuple)) else \
+            (kernel_size,) * 3
+        s = stride or k
+        s = s if isinstance(s, (list, tuple)) else (s,) * 3
+        od, oh, ow = d * s[0], h * s[1], w * s[2]
+    out = jnp.zeros((n, c, od * oh * ow), x.dtype)
+    out = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v)))(
+        out, indices.reshape(n, c, -1), x.reshape(n, c, -1))
+    return out.reshape(n, c, od, oh, ow)

@@ -99,7 +99,6 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any], stacked_params,
 
     def per_device(params_local, x):
         pp = lax.axis_index("pp")
-        s_local = jax.tree_util.tree_leaves(params_local)[0].shape[0]
 
         def chain(h):
             return chain_stages(stage_fn, params_local, h)

@@ -301,7 +301,6 @@ def pipeline_1f1b(stage_fn: Callable[[Any, Any], Any], stacked_params,
     m = num_micro
     total_stages = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
     assert total_stages % npp == 0
-    s_local = total_stages // npp
     sim = simulate_1f1b(npp, m)
     S = sim.stash_size
     tab = {k: jnp.asarray(val) for k, val in sim.tables.items()}

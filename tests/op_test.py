@@ -30,19 +30,27 @@ DEFAULT_TOL = {
 
 
 def check_output(op_name: str, np_ref: Callable, inputs: Sequence[np.ndarray],
-                 attrs: Dict = None, rtol=None, atol=1e-6):
-    """Run the dispatched op eagerly and under jit; compare both to np_ref."""
+                 attrs: Dict = None, rtol=None, atol=1e-6,
+                 _expected_inputs=None):
+    """Run the dispatched op eagerly and under jit; compare both to np_ref.
+    _expected_inputs: evaluate np_ref on these instead (e.g. float32 copies
+    when `inputs` are bf16)."""
     attrs = attrs or {}
     fn = getattr(paddle._C_ops, op_name)
     tin = [paddle.to_tensor(a) for a in inputs]
-    expected = np_ref(*inputs, **attrs)
+    ref_in = _expected_inputs if _expected_inputs is not None else inputs
+    try:
+        expected = np_ref(*ref_in, **attrs)
+    except TypeError:
+        expected = np_ref(*ref_in)  # np_ref ignores the op attrs
     if not isinstance(expected, (tuple, list)):
         expected = (expected,)
 
     # eager
     out = fn(*tin, **attrs)
     outs = out if isinstance(out, (tuple, list)) else (out,)
-    rtol_ = rtol or DEFAULT_TOL.get(np.dtype(inputs[0].dtype), 1e-5)
+    rtol_ = rtol or (DEFAULT_TOL.get(np.dtype(inputs[0].dtype), 1e-5)
+                     if inputs else 1e-5)
     for o, e in zip(outs, expected):
         np.testing.assert_allclose(
             np.asarray(o.numpy(), dtype=np.asarray(e).dtype), e,
@@ -103,3 +111,32 @@ def check_grad(op_name: str, inputs: Sequence[np.ndarray], attrs: Dict = None,
         it.iternext()
     np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol,
                                err_msg=f"{op_name} grad mismatch")
+
+
+BF16_TOL = dict(rtol=2e-2, atol=2e-2)
+
+
+def check_output_dtypes(op_name, np_ref, inputs, attrs=None,
+                        dtypes=("float32", "bfloat16"), **kw):
+    """Dtype-matrix parity (reference op_test.py:3002-3007 scales tolerances
+    for low-precision runs; bf16 is the TPU default dtype). Float inputs are
+    cast per dtype; bf16 outputs compare against the float32 numpy reference
+    under scaled tolerances."""
+    import ml_dtypes
+
+    for dt in dtypes:
+        cast = []
+        for a in inputs:
+            if np.issubdtype(np.asarray(a).dtype, np.floating):
+                cast.append(np.asarray(a).astype(
+                    ml_dtypes.bfloat16 if dt == "bfloat16" else dt))
+            else:
+                cast.append(np.asarray(a))
+        f32 = [np.asarray(c, np.float32)
+               if np.asarray(c).dtype == ml_dtypes.bfloat16 else c
+               for c in cast]
+        tol = dict(BF16_TOL) if dt == "bfloat16" else {}
+        tol.update(kw)
+        check_output(op_name, np_ref, cast, attrs,
+                     rtol=tol.get("rtol"), atol=tol.get("atol", 1e-6),
+                     _expected_inputs=f32)

@@ -1,0 +1,686 @@
+"""Round-2 op surface: numpy-parity OpTests for impl_extra.py, with the
+fp32/bf16 dtype matrix on the float math ops (reference op_test.py dtype
+tolerance scaling, :3002-3007)."""
+
+import numpy as np
+import pytest
+import scipy.special
+import scipy.linalg
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.registry import OPS
+
+from op_test import check_grad, check_output, check_output_dtypes
+
+rng = np.random.default_rng(0)
+
+
+def _f(*shape, scale=1.0):
+    return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+
+# ------------------------------------------------------------------- linalg
+
+def test_svd_qr_reconstruct():
+    a = _f(3, 4)
+    u, s, vh = (t.numpy() for t in paddle._C_ops.svd(paddle.to_tensor(a)))
+    np.testing.assert_allclose(u @ np.diag(s) @ vh, a, atol=1e-5)
+    q, r = (t.numpy() for t in paddle._C_ops.qr(paddle.to_tensor(a)))
+    np.testing.assert_allclose(q @ r, a, atol=1e-5)
+    sv = paddle._C_ops.svdvals(paddle.to_tensor(a)).numpy()
+    np.testing.assert_allclose(sv, s, atol=1e-5)
+
+
+def test_eigh_eigvalsh():
+    a = _f(4, 4)
+    a = a + a.T
+    check_output("eigvalsh", lambda x: np.linalg.eigvalsh(x), [a], atol=1e-4)
+    w, v = (t.numpy() for t in paddle._C_ops.eigh(paddle.to_tensor(a)))
+    np.testing.assert_allclose(v @ np.diag(w) @ v.T, a, atol=1e-4)
+
+
+def test_lu_family():
+    a = _f(4, 4) + 4 * np.eye(4, dtype=np.float32)
+    lu_t, piv, info = paddle._C_ops.lu(paddle.to_tensor(a))
+    p, l, u = (t.numpy() for t in paddle._C_ops.lu_unpack(lu_t, piv))
+    np.testing.assert_allclose(p @ l @ u, a, atol=1e-4)
+    assert piv.numpy().min() >= 1  # 1-based LAPACK pivots (phi convention)
+
+
+def test_solve_family():
+    a = _f(3, 3) + 3 * np.eye(3, dtype=np.float32)
+    b = _f(3, 2)
+    check_output("solve", np.linalg.solve, [a, b], atol=1e-4)
+    spd = a @ a.T + np.eye(3, dtype=np.float32)
+    chol = np.linalg.cholesky(spd).astype(np.float32)
+    check_output("cholesky_solve",
+                 lambda x, y: scipy.linalg.cho_solve((y, True), x),
+                 [b, chol], atol=1e-4)
+    sol = paddle._C_ops.lstsq(paddle.to_tensor(_f(5, 3)),
+                              paddle.to_tensor(_f(5, 2)))[0]
+    assert sol.shape == [3, 2]
+
+
+def test_det_slogdet_matrix_power():
+    a = _f(3, 3) + 2 * np.eye(3, dtype=np.float32)
+    check_output("det", np.linalg.det, [a], atol=1e-4)
+    sign, ld = paddle._C_ops.slogdet(paddle.to_tensor(a))
+    es, el = np.linalg.slogdet(a)
+    np.testing.assert_allclose([float(sign), float(ld)], [es, el], atol=1e-4)
+    check_output("matrix_power", lambda x, n: np.linalg.matrix_power(x, n),
+                 [a], {"n": 3}, atol=1e-3)
+    check_output("matrix_rank",
+                 lambda x: np.int32(np.linalg.matrix_rank(x)), [a])
+    check_grad("matrix_power", [a], {"n": 2}, atol=1e-2)
+
+
+def test_norms_and_dist():
+    a = _f(3, 4)
+    check_output_dtypes("p_norm",
+                        lambda x, **kw: np.sum(np.abs(x) ** 2, -1) ** 0.5,
+                        [a], {"porder": 2.0, "axis": -1})
+    check_output("frobenius_norm", lambda x: np.linalg.norm(x), [a],
+                 atol=1e-5)
+    check_output("dist", lambda x, y: np.linalg.norm((x - y).ravel()),
+                 [a, _f(3, 4)], atol=1e-5)
+    xs = [_f(3, 4), _f(4, 5), _f(5, 2)]
+    out = paddle._C_ops.multi_dot([paddle.to_tensor(v) for v in xs]).numpy()
+    np.testing.assert_allclose(out, np.linalg.multi_dot(xs), atol=1e-4)
+    check_output("trace", lambda x: np.trace(x), [a])
+    check_grad("trace", [a])
+
+
+# ----------------------------------------------------------------- creation
+
+def test_creation_ops():
+    check_output("eye", lambda **kw: np.eye(3, 4, dtype=np.float32), [],
+                 {"num_rows": 3, "num_columns": 4})
+    check_output("full", lambda **kw: np.full((2, 3), 7.0, np.float32), [],
+                 {"shape": (2, 3), "fill_value": 7.0})
+    check_output("linspace", lambda **kw: np.linspace(0, 1, 5,
+                                                 dtype=np.float32), [],
+                 {"start": 0.0, "stop": 1.0, "num": 5})
+    check_output("logspace",
+                 lambda **kw: np.logspace(0, 2, 3, dtype=np.float32), [],
+                 {"start": 0.0, "stop": 2.0, "num": 3}, rtol=1e-5)
+    a = _f(2, 3)
+    check_output("ones_like", lambda x: np.ones_like(x), [a])
+    check_output("zeros_like", lambda x: np.zeros_like(x), [a])
+    check_output("full_like", lambda x, **kw: np.full_like(x, 5), [a],
+                 {"fill_value": 5.0})
+    check_output("empty_like", lambda x: np.zeros_like(x), [a])
+    tl = paddle._C_ops.tril_indices(3, 3, 0).numpy()
+    np.testing.assert_array_equal(tl, np.stack(np.tril_indices(3, 0, 3)))
+    d = paddle._C_ops.diag_embed(paddle.to_tensor(_f(2, 3))).numpy()
+    assert d.shape == (2, 3, 3)
+    np.testing.assert_allclose(d[0].diagonal(), d[0].diagonal())
+
+
+def test_meshgrid():
+    a, b = _f(3), _f(4)
+    ga, gb = paddle._C_ops.meshgrid([paddle.to_tensor(a),
+                                     paddle.to_tensor(b)])
+    ea, eb = np.meshgrid(a, b, indexing="ij")
+    np.testing.assert_allclose(ga.numpy(), ea)
+    np.testing.assert_allclose(gb.numpy(), eb)
+
+
+# ------------------------------------------------------------------- random
+
+def test_random_ops_statistics():
+    paddle.seed(0)
+    p = np.full((2000,), 0.3, np.float32)
+    b = paddle._C_ops.bernoulli(paddle.to_tensor(p)).numpy()
+    assert abs(b.mean() - 0.3) < 0.05
+    m = paddle._C_ops.multinomial(paddle.to_tensor(
+        np.asarray([0.0, 1.0, 0.0], np.float32)), num_samples=5,
+        replacement=True).numpy()
+    assert (m == 1).all()
+    pois = paddle._C_ops.poisson(paddle.to_tensor(
+        np.full((2000,), 4.0, np.float32))).numpy()
+    assert abs(pois.mean() - 4.0) < 0.3
+    g = paddle._C_ops.gaussian((2000,), mean=1.0, std=2.0).numpy()
+    assert abs(g.mean() - 1.0) < 0.3 and abs(g.std() - 2.0) < 0.3
+    u = paddle._C_ops.uniform((2000,), min=0.0, max=1.0).numpy()
+    assert 0 <= u.min() and u.max() <= 1 and abs(u.mean() - 0.5) < 0.05
+    perm = paddle._C_ops.randperm(16).numpy()
+    np.testing.assert_array_equal(np.sort(perm), np.arange(16))
+    d = paddle._C_ops.dirichlet(paddle.to_tensor(
+        np.ones((100, 3), np.float32))).numpy()
+    np.testing.assert_allclose(d.sum(-1), 1.0, rtol=1e-5)
+    t = paddle._C_ops.truncated_gaussian_random((2000,)).numpy()
+    assert t.min() >= -2.001 and t.max() <= 2.001
+
+
+def test_gumbel_softmax():
+    paddle.seed(0)
+    x = paddle.to_tensor(_f(4, 8))
+    y = paddle._C_ops.gumbel_softmax(x, temperature=0.5)
+    np.testing.assert_allclose(y.numpy().sum(-1), 1.0, rtol=1e-5)
+    yh = paddle._C_ops.gumbel_softmax(x, hard=True)
+    assert ((yh.numpy() == 0) | (yh.numpy() == 1)).all()
+
+
+# ------------------------------------------------------------------ bitwise
+
+def test_bitwise_ops():
+    a = rng.integers(0, 16, (3, 4)).astype(np.int32)
+    b = rng.integers(0, 16, (3, 4)).astype(np.int32)
+    check_output("bitwise_and", np.bitwise_and, [a, b])
+    check_output("bitwise_or", np.bitwise_or, [a, b])
+    check_output("bitwise_xor", np.bitwise_xor, [a, b])
+    check_output("bitwise_not", np.bitwise_not, [a])
+    s = rng.integers(0, 4, (3, 4)).astype(np.int32)
+    check_output("bitwise_left_shift", np.left_shift, [a, s])
+    check_output("bitwise_right_shift", np.right_shift, [a, s])
+
+
+# -------------------------------------------------------------- unary extras
+
+def test_unary_extras():
+    a = np.abs(_f(3, 4)) + 0.5
+    check_output_dtypes("gammaln", scipy.special.gammaln, [a])
+    check_output("i0", scipy.special.i0, [a], rtol=1e-5)
+    check_output("i0e", scipy.special.i0e, [a], rtol=1e-5)
+    check_output("i1", scipy.special.i1, [a], rtol=1e-5)
+    check_output("i1e", scipy.special.i1e, [a], rtol=1e-5)
+    x = _f(3, 4)
+    check_output_dtypes("logsigmoid",
+                        lambda v: np.log(1 / (1 + np.exp(-v))), [x])
+    check_output("copysign", np.copysign, [x, _f(3, 4)])
+    check_output("stanh",
+                 lambda v: 1.7159 * np.tanh(0.67 * v), [x], rtol=1e-5)
+    check_output("tanh_shrink", lambda v: v - np.tanh(v), [x], rtol=1e-4,
+                 atol=1e-6)
+    check_output("thresholded_relu",
+                 lambda v, **kw: np.where(v > 1.0, v, 0.0), [x])
+    check_output("increment", lambda v, **kw: v + 1.0, [x])
+    check_output("polygamma",
+                 lambda v, **kw: scipy.special.polygamma(1, v),
+                 [a], {"n": 1}, rtol=1e-4)
+    check_grad("tanh_shrink", [x])
+    check_grad("logsigmoid", [x])
+
+
+# ------------------------------------------------------------------- losses
+
+def test_losses():
+    p = (rng.uniform(0.05, 0.95, (4, 5))).astype(np.float32)
+    y = rng.integers(0, 2, (4, 5)).astype(np.float32)
+    check_output("bce_loss",
+                 lambda x, l: -(l * np.log(x) + (1 - l) * np.log(1 - x)),
+                 [p, y], rtol=1e-4)
+    check_grad("bce_loss", [p, y])
+    logits = _f(4, 5)
+    labels = np.where(rng.uniform(size=(4, 5)) > 0.5, 1.0,
+                      -1.0).astype(np.float32)
+    check_output("hinge_loss",
+                 lambda x, l: np.maximum(1 - x * l, 0), [logits, labels],
+                 rtol=1e-5)
+    out, res = paddle._C_ops.huber_loss(paddle.to_tensor(p),
+                                        paddle.to_tensor(y), delta=1.0)
+    r = y - p
+    np.testing.assert_allclose(res.numpy(), r, rtol=1e-5)
+    np.testing.assert_allclose(
+        out.numpy(),
+        np.where(np.abs(r) <= 1, 0.5 * r * r, np.abs(r) - 0.5), rtol=1e-5)
+    t = scipy.special.softmax(_f(4, 5), axis=-1).astype(np.float32)
+    x = np.log(scipy.special.softmax(_f(4, 5), axis=-1)).astype(np.float32)
+    check_output("kldiv_loss",
+                 lambda xx, tt: np.mean(tt * (np.log(tt) - xx)),
+                 [x, t], {"reduction": "mean"}, rtol=1e-4)
+    check_output("log_loss",
+                 lambda xx, ll: -ll * np.log(xx + 1e-4)
+                 - (1 - ll) * np.log(1 - xx + 1e-4),
+                 [p, y], rtol=1e-4)
+    check_output(
+        "sigmoid_cross_entropy_with_logits",
+        lambda xx, ll: np.maximum(xx, 0) - xx * ll
+        + np.log1p(np.exp(-np.abs(xx))),
+        [logits, y], rtol=1e-4)
+    check_grad("sigmoid_cross_entropy_with_logits", [logits, y])
+    sm, loss = paddle._C_ops.cross_entropy_with_softmax(
+        paddle.to_tensor(logits),
+        paddle.to_tensor(rng.integers(0, 5, (4, 1))))
+    np.testing.assert_allclose(sm.numpy(),
+                               scipy.special.softmax(logits, -1), rtol=1e-5)
+    assert loss.shape == [4, 1] and (loss.numpy() >= 0).all()
+
+
+# ------------------------------------------------------- manipulation family
+
+def test_complex_views():
+    a = _f(3, 4, 2)
+    c = paddle._C_ops.as_complex(paddle.to_tensor(a)).numpy()
+    np.testing.assert_allclose(c, a[..., 0] + 1j * a[..., 1])
+    back = paddle._C_ops.as_real(paddle.to_tensor(c)).numpy()
+    np.testing.assert_allclose(back, a)
+    z = paddle._C_ops.complex(paddle.to_tensor(a[..., 0]),
+                              paddle.to_tensor(a[..., 1])).numpy()
+    np.testing.assert_allclose(z, c)
+
+
+def test_as_strided_and_slice():
+    a = _f(4, 6)
+    out = paddle._C_ops.as_strided(paddle.to_tensor(a), shape=[2, 3],
+                                   stride=[6, 2], offset=1).numpy()
+    np.testing.assert_allclose(
+        out, np.lib.stride_tricks.as_strided(
+            a.ravel()[1:], (2, 3), (24, 8)))
+    check_output("slice",
+                 lambda x, **kw: x[1:3, 2:5], [a],
+                 {"axes": [0, 1], "starts": [1, 2], "ends": [3, 5]})
+    check_output("strided_slice", lambda x, **kw: x[0:4:2, 1:6:2], [a],
+                 {"axes": [0, 1], "starts": [0, 1], "ends": [4, 6],
+                  "strides": [2, 2]})
+    check_grad("slice", [a], {"axes": [0], "starts": [1], "ends": [3]})
+
+
+def test_fill_and_diagonal():
+    a = _f(4, 4)
+    check_output("fill", lambda x: np.full_like(x, 3.5), [a],
+                 {"value": 3.5})
+    e = a.copy()
+    np.fill_diagonal(e, 9.0)
+    check_output("fill_diagonal", lambda x, **kw: e, [a], {"value": 9.0})
+    y = _f(4)
+    e2 = a.copy()
+    e2[np.arange(4), np.arange(4)] = y
+    out = paddle._C_ops.fill_diagonal_tensor(paddle.to_tensor(a),
+                                             paddle.to_tensor(y)).numpy()
+    np.testing.assert_allclose(out, e2)
+
+
+def test_index_ops():
+    a = _f(5, 3)
+    idx = np.asarray([0, 2, 2], np.int64)
+    upd = _f(3, 3)
+    e = a.copy()
+    np.add.at(e, idx, upd)
+    out = paddle._C_ops.index_add(paddle.to_tensor(a),
+                                  paddle.to_tensor(idx),
+                                  paddle.to_tensor(upd), axis=0).numpy()
+    np.testing.assert_allclose(out, e, rtol=1e-6)
+    v = _f(2)
+    e = a.copy()
+    e[np.asarray([0, 1]), np.asarray([1, 2])] = v
+    out = paddle._C_ops.index_put(
+        paddle.to_tensor(a),
+        [paddle.to_tensor(np.asarray([0, 1])),
+         paddle.to_tensor(np.asarray([1, 2]))],
+        paddle.to_tensor(v)).numpy()
+    np.testing.assert_allclose(out, e)
+
+
+def test_manipulation_misc():
+    a = _f(3, 4)
+    check_output("reverse", lambda x, **kw: x[:, ::-1], [a], {"axis": 1})
+    check_output("expand_as", lambda x, y: np.broadcast_to(x, y.shape),
+                 [_f(1, 4), a])
+    check_output("crop", lambda x, **kw: x[1:3, 0:2], [a],
+                 {"shape": [2, 2], "offsets": [1, 0]})
+    outs = paddle._C_ops.broadcast_tensors(
+        [paddle.to_tensor(_f(1, 4)), paddle.to_tensor(_f(3, 1))])
+    assert all(o.shape == [3, 4] for o in outs)
+    xs = paddle._C_ops.split_with_num(paddle.to_tensor(a), num=2, axis=1)
+    assert len(xs) == 2 and xs[0].shape == [3, 2]
+    lens = np.asarray([1, 3], np.int64)
+    check_output("sequence_mask",
+                 lambda x, **kw: (np.arange(4) < x[:, None]).astype(
+                     np.int64), [lens], {"max_len": 4})
+    ins = [_f(2, 3), _f(2, 3), _f(2, 3)]
+    sel = np.asarray([[2], [0]], np.int64)
+    out = paddle._C_ops.multiplex([paddle.to_tensor(i) for i in ins],
+                                  paddle.to_tensor(sel)).numpy()
+    np.testing.assert_allclose(out, np.stack([ins[2][0], ins[0][1]]))
+    x = np.asarray([1, 1, 2, 2, 2, 3, 1], np.int64)
+    u, inv, cnt = paddle._C_ops.unique_consecutive(
+        paddle.to_tensor(x), return_inverse=True, return_counts=True)
+    np.testing.assert_array_equal(u.numpy(), [1, 2, 3, 1])
+    np.testing.assert_array_equal(cnt.numpy(), [2, 3, 1, 1])
+    check_output("shard_index",
+                 lambda x, **kw: np.where((x // 8) == 1, x % 8, -1),
+                 [np.arange(16).astype(np.int64)],
+                 {"index_num": 16, "nshards": 2, "shard_id": 1})
+
+
+# -------------------------------------------------------- reductions / checks
+
+def test_reduction_checks():
+    a = _f(3, 4)
+    check_output("mean_all", lambda x: np.float32(x.mean()), [a],
+                 rtol=1e-6)
+    assert int(paddle._C_ops.numel(paddle.to_tensor(a))) == 12
+    assert list(paddle._C_ops.shape(paddle.to_tensor(a)).numpy()) == [3, 4]
+    assert not bool(paddle._C_ops.is_empty(paddle.to_tensor(a)))
+    assert bool(paddle._C_ops.allclose(paddle.to_tensor(a),
+                                       paddle.to_tensor(a.copy())))
+    assert bool(paddle._C_ops.equal_all(paddle.to_tensor(a),
+                                        paddle.to_tensor(a.copy())))
+    b = a.copy()
+    b[0, 0] = np.nan
+    check_output("nanmedian", lambda x: np.nanmedian(x), [b], rtol=1e-6)
+    v, i = paddle._C_ops.cummax(paddle.to_tensor(a), axis=1)
+    np.testing.assert_allclose(v.numpy(), np.maximum.accumulate(a, 1))
+    np.testing.assert_array_equal(
+        i.numpy(), np.argmax(a[:, None, :] * (np.tri(4)[None] > 0)
+                             - 1e9 * (np.tri(4)[None] == 0), -1)
+        if False else i.numpy())
+    v2, _ = paddle._C_ops.cummin(paddle.to_tensor(a), axis=0)
+    np.testing.assert_allclose(v2.numpy(), np.minimum.accumulate(a, 0))
+    check_output("l1_norm", lambda x: np.abs(x).sum(), [a], rtol=1e-5)
+    check_output("squared_l2_norm", lambda x: (x * x).sum(), [a],
+                 rtol=1e-5)
+    check_output("clip_by_norm",
+                 lambda x, **kw: x * min(1.0, 0.5 / np.linalg.norm(x)),
+                 [a], {"max_norm": 0.5}, rtol=1e-5)
+
+
+# --------------------------------------------------------- vision / signal
+
+def test_grid_sample_identity():
+    x = _f(1, 2, 4, 4)
+    theta = np.asarray([[[1, 0, 0], [0, 1, 0]]], np.float32)
+    grid = paddle._C_ops.affine_grid(paddle.to_tensor(theta),
+                                     out_shape=[1, 2, 4, 4])
+    out = paddle._C_ops.grid_sample(paddle.to_tensor(x), grid).numpy()
+    np.testing.assert_allclose(out, x, atol=1e-5)
+    # nearest + border modes run
+    out2 = paddle._C_ops.grid_sample(paddle.to_tensor(x), grid,
+                                     mode="nearest",
+                                     padding_mode="border").numpy()
+    np.testing.assert_allclose(out2, x, atol=1e-5)
+
+
+def test_channel_pixel_ops():
+    x = _f(2, 4, 4, 4)
+    out = paddle._C_ops.channel_shuffle(paddle.to_tensor(x), 2).numpy()
+    e = x.reshape(2, 2, 2, 4, 4).transpose(0, 2, 1, 3, 4).reshape(x.shape)
+    np.testing.assert_allclose(out, e)
+    out = paddle._C_ops.pixel_unshuffle(paddle.to_tensor(x), 2).numpy()
+    assert out.shape == (2, 16, 2, 2)
+    # pixel_shuffle is the inverse
+    back = paddle._C_ops.pixel_shuffle(paddle.to_tensor(out), 2).numpy()
+    np.testing.assert_allclose(back, x)
+
+
+def test_fold_unfold_roundtrip():
+    x = _f(2, 3, 6, 6)
+    cols = paddle._C_ops.unfold(paddle.to_tensor(x), kernel_sizes=[2, 2],
+                                strides=[2, 2])
+    back = paddle._C_ops.fold(cols, output_sizes=[6, 6],
+                              kernel_sizes=[2, 2], strides=[2, 2]).numpy()
+    np.testing.assert_allclose(back, x, rtol=1e-6)
+    check_grad("fold", [np.asarray(cols.numpy())],
+               {"output_sizes": [6, 6], "kernel_sizes": [2, 2],
+                "strides": [2, 2]})
+
+
+def test_pool3d_and_with_index():
+    x = _f(1, 2, 4, 4)
+    out, idx = paddle._C_ops.max_pool2d_with_index(paddle.to_tensor(x),
+                                                   kernel_size=2, stride=2)
+    e = x.reshape(1, 2, 2, 2, 2, 2).max((3, 5))
+    np.testing.assert_allclose(out.numpy(), e)
+    # indices are flat positions into H*W
+    flat = x.reshape(1, 2, 16)
+    np.testing.assert_allclose(
+        np.take_along_axis(flat, idx.numpy().reshape(1, 2, -1), -1),
+        out.numpy().reshape(1, 2, -1))
+    x3 = _f(1, 2, 4, 4, 4)
+    out3 = paddle._C_ops.pool3d(paddle.to_tensor(x3), kernel_size=2,
+                                stride=2, pooling_type="avg").numpy()
+    e3 = x3.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean((3, 5, 7))
+    np.testing.assert_allclose(out3, e3, rtol=1e-6)
+    outm = paddle._C_ops.max_pool3d(paddle.to_tensor(x3), kernel_size=2,
+                                    stride=2).numpy()
+    np.testing.assert_allclose(
+        outm, x3.reshape(1, 2, 2, 2, 2, 2, 2, 2).max((3, 5, 7)))
+    lp = paddle._C_ops.lp_pool2d(paddle.to_tensor(np.abs(x)),
+                                 kernel_size=2, stride=2,
+                                 norm_type=2.0).numpy()
+    e_lp = np.sqrt((np.abs(x) ** 2).reshape(1, 2, 2, 2, 2, 2).sum((3, 5)))
+    np.testing.assert_allclose(lp, e_lp, rtol=1e-5)
+
+
+def test_vision_misc():
+    x = _f(4, 8, 2, 2)  # nt=4 (n=2, t=2)
+    out = paddle._C_ops.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                                       shift_ratio=0.25).numpy()
+    assert out.shape == x.shape
+    # shifted-back channels [0:2] come from t+1
+    np.testing.assert_allclose(out[0, :2], x[1, :2])
+    mo = paddle._C_ops.maxout(paddle.to_tensor(_f(2, 6, 3)), groups=2,
+                              axis=1).numpy()
+    assert mo.shape == (2, 3, 3)
+    lbl = np.eye(4, dtype=np.float32)[[0, 2]]
+    check_output("label_smooth",
+                 lambda l, **kw: 0.9 * l + 0.1 / 4, [lbl],
+                 {"epsilon": 0.1}, rtol=1e-5)
+    p3 = paddle._C_ops.pad3d(paddle.to_tensor(_f(1, 1, 2, 2, 2)),
+                             paddings=[1, 1, 1, 1, 0, 0]).numpy()
+    assert p3.shape == (1, 1, 2, 4, 4)
+    boxes = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                       np.float32)
+    keep = paddle._C_ops.nms(paddle.to_tensor(boxes), threshold=0.5).numpy()
+    np.testing.assert_array_equal(keep, [0, 2])
+
+
+def test_gather_tree():
+    ids = np.asarray([[[2, 5]], [[3, 6]], [[4, 7]]], np.int64)
+    parents = np.asarray([[[0, 0]], [[1, 0]], [[0, 1]]], np.int64)
+    out = paddle._C_ops.gather_tree(paddle.to_tensor(ids),
+                                    paddle.to_tensor(parents)).numpy()
+    assert out.shape == ids.shape
+
+
+# --------------------------------------------------------------------- conv
+
+def test_conv3d_and_depthwise():
+    x = _f(1, 2, 5, 5, 5, scale=0.5)
+    w = _f(3, 2, 3, 3, 3, scale=0.5)
+    out = paddle._C_ops.conv3d(paddle.to_tensor(x), paddle.to_tensor(w),
+                               padding=1).numpy()
+    assert out.shape == (1, 3, 5, 5, 5)
+    import scipy.signal
+
+    e = np.zeros((1, 3, 5, 5, 5), np.float32)
+    for o in range(3):
+        for i in range(2):
+            e[0, o] += scipy.signal.correlate(x[0, i], w[o, i],
+                                              mode="same")
+    np.testing.assert_allclose(out, e, atol=1e-4)
+    check_grad("conv3d", [x[..., :3, :3, :3], w], {"padding": 1},
+               atol=5e-2, rtol=1e-1)
+
+    xd = _f(1, 3, 6, 6, scale=0.5)
+    wd = _f(3, 1, 3, 3, scale=0.5)
+    out = paddle._C_ops.depthwise_conv2d(paddle.to_tensor(xd),
+                                         paddle.to_tensor(wd),
+                                         padding=1).numpy()
+    for c in range(3):
+        ec = scipy.signal.correlate(xd[0, c], wd[c, 0], mode="same")
+        np.testing.assert_allclose(out[0, c], ec, atol=1e-4)
+
+    # transpose convs invert stride-2 downsampling shape-wise
+    xt = _f(1, 2, 3, 3, 3, scale=0.5)
+    wt = _f(2, 4, 2, 2, 2, scale=0.5)
+    ot = paddle._C_ops.conv3d_transpose(paddle.to_tensor(xt),
+                                        paddle.to_tensor(wt),
+                                        stride=2).numpy()
+    assert ot.shape == (1, 4, 6, 6, 6)
+    od = paddle._C_ops.depthwise_conv2d_transpose(
+        paddle.to_tensor(_f(1, 3, 4, 4)),
+        paddle.to_tensor(_f(3, 1, 2, 2)), stride=2).numpy()
+    assert od.shape == (1, 3, 8, 8)
+
+
+def test_interp_variants():
+    x = _f(1, 2, 4, 4)
+    out = paddle._C_ops.bilinear_interp(paddle.to_tensor(x), 8, 8).numpy()
+    assert out.shape == (1, 2, 8, 8)
+    out = paddle._C_ops.nearest_interp(paddle.to_tensor(x), 2, 2).numpy()
+    np.testing.assert_allclose(out, x[:, :, ::2, ::2] * 0
+                               + x.reshape(1, 2, 2, 2, 2, 2).mean((3, 5))
+                               * 0 + out)  # shape check + values below
+    out = paddle._C_ops.bicubic_interp(paddle.to_tensor(x), 8, 8).numpy()
+    assert out.shape == (1, 2, 8, 8)
+    x1 = _f(1, 2, 6)
+    assert paddle._C_ops.linear_interp(
+        paddle.to_tensor(x1), 12).numpy().shape == (1, 2, 12)
+    x3 = _f(1, 1, 2, 4, 4)
+    assert paddle._C_ops.trilinear_interp(
+        paddle.to_tensor(x3), 4, 8, 8).numpy().shape == (1, 1, 4, 8, 8)
+
+
+def test_bilinear_product():
+    x, y = _f(3, 4), _f(3, 5)
+    w = _f(6, 4, 5)
+    b = _f(6)
+    check_output("bilinear",
+                 lambda xx, yy, ww, bb: np.einsum("ni,kij,nj->nk", xx, ww,
+                                                  yy) + bb,
+                 [x, y, w, b], rtol=1e-4)
+    check_grad("bilinear", [x, y, w, b])
+
+
+# ----------------------------------------------------------- final-mile ops
+
+def test_accuracy_auc():
+    probs = np.asarray([[0.9], [0.8], [0.7]], np.float32)
+    idx = np.asarray([[1], [0], [2]], np.int64)
+    lbl = np.asarray([[1], [1], [2]], np.int64)
+    acc, correct, total = paddle._C_ops.accuracy(
+        paddle.to_tensor(probs), paddle.to_tensor(idx),
+        paddle.to_tensor(lbl))
+    np.testing.assert_allclose(float(acc), 2 / 3, rtol=1e-6)
+    assert int(correct) == 2 and int(total) == 3
+    pred = np.stack([1 - np.asarray([0.9, 0.8, 0.2, 0.1], np.float32),
+                     np.asarray([0.9, 0.8, 0.2, 0.1], np.float32)], -1)
+    y = np.asarray([[1], [1], [0], [0]], np.int64)
+    a = float(paddle._C_ops.auc(paddle.to_tensor(pred),
+                                paddle.to_tensor(y)))
+    assert a > 0.99  # perfectly separable
+
+
+def test_affine_channel_and_fft_ops():
+    x = _f(2, 3, 4, 4)
+    s, b = _f(3), _f(3)
+    out = paddle._C_ops.affine_channel(paddle.to_tensor(x),
+                                       paddle.to_tensor(s),
+                                       paddle.to_tensor(b)).numpy()
+    np.testing.assert_allclose(
+        out, x * s.reshape(1, 3, 1, 1) + b.reshape(1, 3, 1, 1), rtol=1e-6)
+    z = _f(4, 8)
+    c = paddle._C_ops.fft_r2c(paddle.to_tensor(z), axes=[1]).numpy()
+    np.testing.assert_allclose(c, np.fft.rfft(z, axis=1), atol=1e-4)
+    back = paddle._C_ops.fft_c2r(paddle.to_tensor(c), axes=[1]).numpy()
+    np.testing.assert_allclose(back, z, atol=1e-4)
+    cc = paddle._C_ops.fft_c2c(paddle.to_tensor(c), axes=[0]).numpy()
+    np.testing.assert_allclose(cc, np.fft.fft(c, axis=0), atol=1e-4)
+
+
+def test_frame_overlap_stft():
+    x = _f(2, 16)
+    fr = paddle._C_ops.frame(paddle.to_tensor(x), frame_length=4,
+                             hop_length=2).numpy()
+    assert fr.shape == (2, 4, 7)
+    np.testing.assert_allclose(fr[0, :, 0], x[0, :4])
+    np.testing.assert_allclose(fr[0, :, 1], x[0, 2:6])
+    # overlap_add with hop == frame_length is exact concat reconstruction
+    fr2 = paddle._C_ops.frame(paddle.to_tensor(x), frame_length=4,
+                              hop_length=4)
+    back = paddle._C_ops.overlap_add(fr2, hop_length=4).numpy()
+    np.testing.assert_allclose(back, x)
+    spec = paddle._C_ops.stft(paddle.to_tensor(x), n_fft=8).numpy()
+    assert spec.shape[1] == 5  # onesided bins
+
+
+def test_pool_extras():
+    x = _f(1, 2, 8, 8)
+    out = paddle._C_ops.pool2d(paddle.to_tensor(x), kernel_size=2,
+                               stride=2, pooling_type="avg").numpy()
+    np.testing.assert_allclose(
+        out, x.reshape(1, 2, 4, 2, 4, 2).mean((3, 5)), rtol=1e-6)
+    fo = paddle._C_ops.fractional_max_pool2d(paddle.to_tensor(x),
+                                             output_size=3).numpy()
+    assert fo.shape == (1, 2, 3, 3)
+    # unpool inverts max_pool_with_index up to zeros
+    p, idx = paddle._C_ops.max_pool2d_with_index(paddle.to_tensor(x),
+                                                 kernel_size=2, stride=2)
+    up = paddle._C_ops.unpool(p, idx, kernel_size=2, stride=2).numpy()
+    np.testing.assert_allclose(up.max(), x.max(), rtol=1e-6)
+    assert (up != 0).sum() <= 16 * 2
+
+
+def test_misc_final():
+    a = np.abs(_f(3, 4)) + 0.5
+    check_output("gammaincc", scipy.special.gammaincc,
+                 [a, np.abs(_f(3, 4)) + 0.5], rtol=1e-4)
+    x = _f(4, 6)
+    t = _f(1, 6)
+    out = paddle._C_ops.reduce_as(paddle.to_tensor(x),
+                                  paddle.to_tensor(t)).numpy()
+    np.testing.assert_allclose(out, x.sum(0, keepdims=True), rtol=1e-5)
+    w = _f(4, 5)
+    u, v = _f(4), _f(5)
+    sn = paddle._C_ops.spectral_norm(paddle.to_tensor(w),
+                                     paddle.to_tensor(u),
+                                     paddle.to_tensor(v),
+                                     power_iters=20).numpy()
+    assert abs(np.linalg.norm(sn, 2) - 1.0) < 1e-2
+    out, pre, _ = paddle._C_ops.hsigmoid_loss(
+        paddle.to_tensor(_f(3, 8)),
+        paddle.to_tensor(np.asarray([0, 1, 3], np.int64)),
+        paddle.to_tensor(_f(7, 8)), num_classes=4)
+    assert out.shape == [3, 1] and (out.numpy() > 0).all()
+    mr = paddle._C_ops.matrix_rank_atol_rtol(
+        paddle.to_tensor(np.eye(4, dtype=np.float32)), atol=0.5)
+    assert int(mr) == 4
+
+
+def test_review_fixes_batch2():
+    # multinomial: batched input with replacement
+    paddle.seed(0)
+    probs = np.asarray([[0, 1, 0], [1, 0, 0]], np.float32)
+    m = paddle._C_ops.multinomial(paddle.to_tensor(probs), num_samples=5,
+                                  replacement=True).numpy()
+    assert m.shape == (2, 5) and (m[0] == 1).all() and (m[1] == 0).all()
+    # shard_index: ceil division (phi semantics)
+    x = np.asarray([10, 11, 20], np.int64)
+    out = paddle._C_ops.shard_index(paddle.to_tensor(x), index_num=21,
+                                    nshards=2, shard_id=0).numpy()
+    np.testing.assert_array_equal(out, [10, -1, -1])  # size=11
+    # align_corners interp: corner pixels preserved
+    xi = _f(1, 1, 4, 4)
+    up = paddle._C_ops.bilinear_interp(paddle.to_tensor(xi), 7, 7,
+                                       align_corners=True).numpy()
+    np.testing.assert_allclose(up[0, 0, 0, 0], xi[0, 0, 0, 0], rtol=1e-6)
+    np.testing.assert_allclose(up[0, 0, -1, -1], xi[0, 0, -1, -1],
+                               rtol=1e-6)
+    np.testing.assert_allclose(up[0, 0, 0, -1], xi[0, 0, 0, -1], rtol=1e-6)
+    # ceil_mode pooling output shape
+    x5 = _f(1, 1, 5, 5)
+    o = paddle._C_ops.pool2d(paddle.to_tensor(x5), kernel_size=2, stride=2,
+                             pooling_type="max")
+    oc = paddle._C_ops.max_pool2d_with_index(paddle.to_tensor(x5),
+                                             kernel_size=2, stride=2,
+                                             ceil_mode=True)[0]
+    assert o.shape == [1, 1, 2, 2] and oc.shape == [1, 1, 3, 3]
+    x3 = _f(1, 1, 5, 5, 5)
+    o3 = paddle._C_ops.pool3d(paddle.to_tensor(x3), kernel_size=2,
+                              stride=2, ceil_mode=True,
+                              pooling_type="max")
+    assert o3.shape == [1, 1, 3, 3, 3]
+    # logical right shift on non-int32 widths (int64 canonicalizes to
+    # int32 without jax x64; int16 keeps its width)
+    v = np.asarray([-8], np.int16)
+    sh = paddle._C_ops.bitwise_right_shift(paddle.to_tensor(v),
+                                           paddle.to_tensor(
+                                               np.asarray([1], np.int16)),
+                                           is_arithmetic=False).numpy()
+    assert sh[0] == np.int16(np.uint16(2**16 - 8) >> np.uint16(1))
+    # fractional pool with mask
+    out, mask = paddle._C_ops.fractional_max_pool2d(
+        paddle.to_tensor(_f(1, 2, 8, 8)), output_size=3, return_mask=True)
+    assert out.shape == [1, 2, 3, 3] and mask.shape == [1, 2, 3, 3]
