@@ -4,6 +4,8 @@ On TPU these are XLA fusions or Pallas kernels of the registry ops — one
 implementation serves both the stock and the "fused" API names.
 """
 
+import jax
+
 from paddle_tpu.ops.registry import C_OPS as _C
 
 fused_rms_norm = _C.rms_norm
@@ -51,3 +53,37 @@ def fused_linear(x, weight, bias=None):
 
 def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train"):
     return _C.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def block_multihead_attention(q, k_pool, v_pool, block_table, pos,
+                              scale=None):
+    """Paged-KV decode attention (reference:
+    python/paddle/incubate/nn/functional/block_multihead_attention.py).
+    See models/generation.py for the cache layout."""
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models.generation import (
+        block_multihead_attention as _impl,
+    )
+
+    unwrap = lambda t: t._value if isinstance(t, Tensor) else t
+    out = _impl(unwrap(q), unwrap(k_pool), unwrap(v_pool),
+                unwrap(block_table), unwrap(pos), scale=scale)
+    return Tensor._wrap(out) if isinstance(q, Tensor) else out
+
+
+def masked_multihead_attention(x, cache_kv, pos, scale=None):
+    """One-token decode attention over a dense [2, b, L, h, d] cache
+    (reference incubate masked_multihead_attention: the non-paged serving
+    kernel). x: [b, h*d] query input; pos: scalar or per-sequence [b]
+    offsets of the current token."""
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models.generation import masked_cache_attention
+
+    unwrap = lambda t: t._value if isinstance(t, Tensor) else t
+    xv, cache = unwrap(x), unwrap(cache_kv)
+    k_cache, v_cache = cache[0], cache[1]
+    b, L, h, d = k_cache.shape
+    out = masked_cache_attention(xv.reshape(b, 1, h, d), k_cache, v_cache,
+                                 unwrap(pos), scale=scale)
+    out = out.reshape(b, h * d)
+    return Tensor._wrap(out) if isinstance(x, Tensor) else out

@@ -37,6 +37,28 @@ def _layer_norm(x, w, b, eps=1e-5):
     return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w + b
 
 
+def masked_cache_attention(q, k_cache, v_cache, pos, scale=None):
+    """Causal attention of [b, t, h, d] queries at offset `pos` over a
+    [b, L, h, d] cache — the single attention core shared by the dense
+    cache, the paged cache, and incubate.masked_multihead_attention.
+    `pos` may be a scalar offset or per-sequence [b] offsets.
+    Returns [b, t, h*d]."""
+    b, t, h, d = q.shape
+    L = k_cache.shape[1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    qT = jnp.swapaxes(q, 1, 2).astype(jnp.float32)        # [b,h,t,d]
+    kT = jnp.swapaxes(k_cache, 1, 2).astype(jnp.float32)  # [b,h,L,d]
+    vT = jnp.swapaxes(v_cache, 1, 2).astype(jnp.float32)
+    s = jnp.einsum("bhtd,bhLd->bhtL", qT, kT) * scale
+    pos_arr = jnp.asarray(pos)
+    q_pos = pos_arr.reshape(-1, 1, 1) + jnp.arange(t)[None, :, None]
+    mask = jnp.arange(L)[None, None, :] <= q_pos          # [b|1, t, L]
+    s = jnp.where(mask[:, None], s, -1e30)
+    probs = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhtL,bhLd->bhtd", probs, vT).astype(q.dtype)
+    return jnp.swapaxes(out, 1, 2).reshape(b, t, h * d)
+
+
 def _attn_with_cache(p, x, k_cache, v_cache, pos, n_heads):
     """x: [b, t, H]; caches: [b, L, h, d]; pos: current write offset."""
     b, t, hdim = x.shape
@@ -46,23 +68,24 @@ def _attn_with_cache(p, x, k_cache, v_cache, pos, n_heads):
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
-    L = k_cache.shape[1]
-    scale = 1.0 / np.sqrt(d)
-    qT = jnp.swapaxes(q, 1, 2).astype(jnp.float32)       # [b,h,t,d]
-    kT = jnp.swapaxes(k_cache, 1, 2).astype(jnp.float32)  # [b,h,L,d]
-    vT = jnp.swapaxes(v_cache, 1, 2).astype(jnp.float32)
-    s = jnp.einsum("bhtd,bhLd->bhtL", qT, kT) * scale
-    q_pos = pos + jnp.arange(t)[:, None]
-    k_pos = jnp.arange(L)[None, :]
-    mask = k_pos <= q_pos                                 # causal over cache
-    s = jnp.where(mask[None, None], s, -1e30)
-    probs = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhtL,bhLd->bhtd", probs, vT).astype(x.dtype)
-    out = jnp.swapaxes(out, 1, 2).reshape(b, t, hdim)
+    out = masked_cache_attention(q, k_cache, v_cache, pos)
     return out @ p["attn.out.weight"] + p["attn.out.bias"], k_cache, v_cache
 
 
 def _mlp(p, x):
+    if "mlp.gate" in p:  # switch-MoE block: same routing math as training
+        from paddle_tpu.parallel.moe import _switch_moe
+
+        b, t, hdim = x.shape
+        n_experts = p["mlp.gate"].shape[1]
+        # capacity_factor = E makes capacity >= token count: serving must
+        # not drop tokens (decode batches are tiny, so the training-time
+        # capacity formula would zero out colliding tokens' MLP output)
+        y, _aux = _switch_moe(x.reshape(-1, hdim), p["mlp.gate"],
+                              p["mlp.w1"], p["mlp.b1"], p["mlp.w2"],
+                              p["mlp.b2"],
+                              capacity_factor=float(n_experts))
+        return y.reshape(b, t, hdim)
     h = jax.nn.gelu(x @ p["mlp.fc1.weight"] + p["mlp.fc1.bias"],
                     approximate=True)
     return h @ p["mlp.fc2.weight"] + p["mlp.fc2.bias"]
@@ -118,18 +141,35 @@ class GPTGenerator:
     def __init__(self, model: GPT, max_len: Optional[int] = None):
         from paddle_tpu.jit.functionalize import functionalize
 
+        from paddle_tpu.parallel.mesh import current_mesh
+
         self.model = model
         self.cfg = model.cfg
-        assert not self.cfg.tensor_parallel, \
-            "GPTGenerator currently supports the single-chip/dense config"
-        assert self.cfg.moe_every == 0, \
-            "GPTGenerator does not support MoE blocks yet"
-        assert not self.cfg.sequence_parallel, \
-            "GPTGenerator does not support sequence-parallel configs"
         self.max_len = max_len or self.cfg.max_seq_len
         self.func = functionalize(model)
         self.params = self.func.param_values()
         cfg = self.cfg
+        # sharded serving: with an active mesh, params keep their tp/ep
+        # shardings (mp layers set PartitionSpecs; GSPMD inserts the same
+        # collectives the reference's sharded masked-MHA path runs by hand)
+        # and the KV caches shard over heads on 'tp'. sequence_parallel
+        # affects training activation sharding only — the cached decode path
+        # computes the identical function without the sp constraints.
+        self.mesh = current_mesh()
+        self._cache_spec = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            shardings = self.func.param_shardings()
+            self.params = {
+                k: jax.device_put(
+                    v, NamedSharding(self.mesh, shardings.get(k) or P()))
+                for k, v in self.params.items()
+            }
+            if "tp" in self.mesh.axis_names and cfg.num_heads % \
+                    self.mesh.shape["tp"] == 0:
+                self._cache_spec = NamedSharding(
+                    self.mesh, P(None, None, "tp", None))
 
         @jax.jit
         def prefill(params, tokens, caches):
@@ -148,28 +188,58 @@ class GPTGenerator:
         self._prefill = prefill
         self._decode = decode
 
+    def _to_mesh(self, v):
+        """Replicate host values onto the mesh (params live there)."""
+        if self.mesh is None:
+            return v
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(v, NamedSharding(self.mesh, P()))
+
     def _empty_caches(self, batch):
         cfg = self.cfg
         d = cfg.hidden_size // cfg.num_heads
         shape = (batch, self.max_len, cfg.num_heads, d)
         dt = self.params["wte.weight"].dtype
-        return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
-                for _ in range(cfg.num_layers)]
+
+        def z():
+            buf = jnp.zeros(shape, dt)
+            if self._cache_spec is not None:
+                buf = jax.device_put(buf, self._cache_spec)
+            return buf
+
+        return [(z(), z()) for _ in range(cfg.num_layers)]
+
+    def _make_state(self, batch):
+        return self._empty_caches(batch)
+
+    def _prefill_call(self, ids, state):
+        last_logits, state = self._prefill(self.params, ids, state)
+        return last_logits, state
+
+    def _decode_call(self, tok, state, pos, key, temperature, top_k, top_p):
+        return self._decode(self.params, tok, state, pos, key,
+                            temperature=temperature, top_k=top_k,
+                            top_p=top_p)
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_k=None, top_p=None, eos_token_id=None, seed=None):
+        """Shared prefill + sample + decode loop; subclasses supply the
+        cache state and the prefill/decode callables (template method —
+        the eos/padding contract lives in exactly one place)."""
         from paddle_tpu.core.random import default_generator
 
         ids = input_ids._value if isinstance(input_ids, Tensor) \
             else jnp.asarray(input_ids)
         if ids.ndim == 1:
             ids = ids[None]
+        ids = self._to_mesh(ids)
         b, t = ids.shape
         assert t + max_new_tokens <= self.max_len
-        caches = self._empty_caches(b)
-        last_logits, caches = self._prefill(self.params, ids, caches)
-        key = (jax.random.key(seed) if seed is not None
-               else default_generator.next_key())
+        state = self._make_state(b)
+        last_logits, state = self._prefill_call(ids, state)
+        key = self._to_mesh(jax.random.key(seed) if seed is not None
+                            else default_generator.next_key())
         tok = _sample(last_logits, key, temperature, top_k, top_p)
         finished = jnp.zeros((b,), bool)
         if eos_token_id is not None:
@@ -178,10 +248,9 @@ class GPTGenerator:
         pos = t
         for i in range(max_new_tokens - 1):
             key = jax.random.fold_in(key, i)
-            tok, caches = self._decode(self.params, tok, caches,
-                                       jnp.asarray(pos, jnp.int32), key,
-                                       temperature=temperature, top_k=top_k,
-                                       top_p=top_p)
+            tok, state = self._decode_call(
+                tok, state, self._to_mesh(jnp.asarray(pos, jnp.int32)),
+                key, temperature, top_k, top_p)
             if eos_token_id is not None:
                 # rows already finished keep emitting eos (pad), like the
                 # reference/HF contract
@@ -193,3 +262,181 @@ class GPTGenerator:
                 break
         gen = jnp.stack(outs, axis=1)
         return Tensor._wrap(jnp.concatenate([ids, gen], axis=1))
+
+
+# ===================================================================== paged KV
+
+class PagedKVCache:
+    """Block-table KV cache — the reference's block_multihead_attention
+    layout (python/paddle/incubate/nn/functional/block_multihead_attention.py:
+    paged KV pools indexed by a per-sequence block table).
+
+    Pools: [num_blocks, block_size, h, d]; block_table: [b, blocks_per_seq]
+    int32 ids into the pool. This static allocator assigns each sequence a
+    contiguous run of blocks; the indirection (gather pages by table) is the
+    serving-framework contract that lets a dynamic allocator reuse and share
+    blocks without touching the attention kernel.
+    """
+
+    def __init__(self, batch, max_len, n_heads, head_dim, n_layers, dtype,
+                 block_size=64, sharding=None):
+        assert max_len % block_size == 0
+        self.block_size = block_size
+        self.blocks_per_seq = max_len // block_size
+        num_blocks = batch * self.blocks_per_seq
+        self.block_table = jnp.arange(num_blocks, dtype=jnp.int32).reshape(
+            batch, self.blocks_per_seq)
+        shape = (num_blocks, block_size, n_heads, head_dim)
+
+        def z():
+            buf = jnp.zeros(shape, dtype)
+            if sharding is not None:
+                buf = jax.device_put(buf, sharding)
+            return buf
+
+        self.pools = [(z(), z()) for _ in range(n_layers)]
+
+
+def paged_write_prefill(pool, block_table, kv, block_size):
+    """Write [b, t, h, d] prefill keys/values through the block table."""
+    b, t = kv.shape[:2]
+    n_full, rem = divmod(t, block_size)
+    for j in range(n_full):
+        chunk = kv[:, j * block_size:(j + 1) * block_size]
+        pool = pool.at[block_table[:, j]].set(chunk)
+    if rem:
+        chunk = kv[:, n_full * block_size:]
+        pool = pool.at[block_table[:, n_full], :rem].set(chunk)
+    return pool
+
+
+def paged_write_token(pool, block_table, kv_tok, pos, block_size):
+    """Write one [b, h, d] token at position `pos` (traced scalar)."""
+    blk = jnp.take(block_table, pos // block_size, axis=1)     # [b]
+    return pool.at[blk, pos % block_size].set(kv_tok)
+
+
+def paged_gather(pool, block_table):
+    """[num_blocks, bs, h, d] gathered to [b, max_len, h, d]."""
+    pages = pool[block_table]                 # [b, bps, bs, h, d]
+    b, bps, bs = pages.shape[:3]
+    return pages.reshape(b, bps * bs, *pages.shape[3:])
+
+
+def block_multihead_attention(q, k_pool, v_pool, block_table, pos,
+                              scale=None):
+    """Decode-step attention over a paged KV cache (reference
+    incubate/nn/functional/block_multihead_attention.py analogue).
+    q: [b, t, h, d]; returns [b, t, h*d]."""
+    b, t, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    k = paged_gather(k_pool, block_table)
+    v = paged_gather(v_pool, block_table)
+    return masked_cache_attention(q, k, v, pos, scale=scale)
+
+
+def _attn_paged(p, x, k_pool, v_pool, block_table, pos, n_heads,
+                block_size):
+    b, t, hdim = x.shape
+    d = hdim // n_heads
+    qkv = x @ p["attn.qkv.weight"] + p["attn.qkv.bias"]
+    qkv = qkv.reshape(b, t, 3, n_heads, d)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    if t == 1:
+        k_pool = paged_write_token(k_pool, block_table, k[:, 0], pos,
+                                   block_size)
+        v_pool = paged_write_token(v_pool, block_table, v[:, 0], pos,
+                                   block_size)
+    else:
+        k_pool = paged_write_prefill(k_pool, block_table, k, block_size)
+        v_pool = paged_write_prefill(v_pool, block_table, v, block_size)
+    out = block_multihead_attention(q, k_pool, v_pool, block_table, pos)
+    return out @ p["attn.out.weight"] + p["attn.out.bias"], k_pool, v_pool
+
+
+def _forward_paged(params, cfg: GPTConfig, tokens, cache: "PagedKVCache",
+                   pos):
+    b, t = tokens.shape
+    x = (jnp.take(params["wte.weight"], tokens, axis=0)
+         + jnp.take(params["wpe.weight"], pos + jnp.arange(t), axis=0))
+    new_pools = []
+    for i in range(cfg.num_layers):
+        p = _block_params(params, i)
+        h = _layer_norm(x, p["ln1.weight"], p["ln1.bias"])
+        a, kp, vp = _attn_paged(p, h, cache.pools[i][0], cache.pools[i][1],
+                                cache.block_table, pos, cfg.num_heads,
+                                cache.block_size)
+        x = x + a
+        h = _layer_norm(x, p["ln2.weight"], p["ln2.bias"])
+        x = x + _mlp(p, h)
+        new_pools.append((kp, vp))
+    cache.pools = new_pools
+    x = _layer_norm(x, params["ln_f.weight"], params["ln_f.bias"])
+    if "lm_head.weight" in params:
+        return jnp.einsum("bth,hv->btv", x, params["lm_head.weight"]), cache
+    return jnp.einsum("bth,vh->btv", x, params["wte.weight"]), cache
+
+
+class PagedGPTGenerator(GPTGenerator):
+    """GPTGenerator over the paged block-table KV cache. Same contract;
+    the cache is a PagedKVCache and the attention runs through
+    block_multihead_attention."""
+
+    def __init__(self, model: GPT, max_len: Optional[int] = None,
+                 block_size: int = 64):
+        super().__init__(model, max_len=max_len)
+        bs = min(block_size, self.max_len)
+        while self.max_len % bs:   # largest divisor <= requested
+            bs -= 1
+        self.block_size = bs
+        cfg = self.cfg
+
+        def prefill(params, tokens, pools, table):
+            cache = _CacheView(pools, table, self.block_size)
+            logits, cache = _forward_paged(params, cfg, tokens, cache, 0)
+            return logits[:, -1], cache.pools
+
+        def decode(params, token, pools, table, pos, key, temperature=1.0,
+                   top_k=None, top_p=None):
+            cache = _CacheView(pools, table, self.block_size)
+            logits, cache = _forward_paged(params, cfg, token[:, None],
+                                           cache, pos)
+            nxt = _sample(logits[:, -1], key, temperature, top_k, top_p)
+            return nxt, cache.pools
+
+        self._prefill_paged = jax.jit(prefill)
+        self._decode_paged = jax.jit(
+            decode, donate_argnums=(2,),
+            static_argnames=("temperature", "top_k", "top_p"))
+
+    def _make_state(self, batch):
+        cfg = self.cfg
+        cache = PagedKVCache(batch, self.max_len, cfg.num_heads,
+                             cfg.hidden_size // cfg.num_heads,
+                             cfg.num_layers,
+                             self.params["wte.weight"].dtype,
+                             block_size=self.block_size,
+                             sharding=self._cache_spec)
+        return (cache.pools, self._to_mesh(cache.block_table))
+
+    def _prefill_call(self, ids, state):
+        pools, table = state
+        last_logits, pools = self._prefill_paged(self.params, ids, pools,
+                                                 table)
+        return last_logits, (pools, table)
+
+    def _decode_call(self, tok, state, pos, key, temperature, top_k, top_p):
+        pools, table = state
+        tok, pools = self._decode_paged(self.params, tok, pools, table,
+                                        pos, key, temperature=temperature,
+                                        top_k=top_k, top_p=top_p)
+        return tok, (pools, table)
+
+
+class _CacheView:
+    """Lightweight pools+table holder used inside the jitted fns."""
+
+    def __init__(self, pools, block_table, block_size):
+        self.pools = list(pools)
+        self.block_table = block_table
+        self.block_size = block_size

@@ -1,0 +1,189 @@
+"""Parallel + paged text generation (VERDICT round-1 item #5).
+
+The round-1 generator asserted out tensor_parallel / sequence_parallel /
+MoE configs; these tests pin: tp=2 greedy decode produces IDENTICAL tokens
+to the dense single-device path, MoE decode matches a naive full-forward
+argmax loop, and the paged block-table cache (block_multihead_attention
+analogue) reproduces the dense cache exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import parallel as dist
+from paddle_tpu.models.generation import GPTGenerator, PagedGPTGenerator
+from paddle_tpu.models.gpt import GPT, GPTConfig
+
+rng = np.random.default_rng(0)
+CFG = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+           max_seq_len=64, dropout=0.0)
+
+
+def _dense_greedy(seed=3, **cfg_kw):
+    paddle.seed(seed)
+    model = GPT(GPTConfig(**CFG, **cfg_kw))
+    model.eval()
+    gen = GPTGenerator(model)
+    ids = paddle.to_tensor(rng.integers(0, 64, (2, 8)))
+    return model, ids, gen.generate(ids, max_new_tokens=8,
+                                    temperature=0.0).numpy()
+
+
+def test_tp_greedy_matches_dense():
+    r = np.random.default_rng(1)
+    ids_np = r.integers(0, 64, (2, 8))
+    paddle.seed(3)
+    dense = GPT(GPTConfig(**CFG))
+    dense.eval()
+    ref = GPTGenerator(dense).generate(paddle.to_tensor(ids_np),
+                                       max_new_tokens=8,
+                                       temperature=0.0).numpy()
+    mesh = dist.init_mesh({"dp": 4, "tp": 2})
+    try:
+        paddle.seed(3)
+        tp = GPT(GPTConfig(**CFG, tensor_parallel=True))
+        tp.eval()
+        out = GPTGenerator(tp).generate(paddle.to_tensor(ids_np),
+                                        max_new_tokens=8,
+                                        temperature=0.0).numpy()
+    finally:
+        dist.set_mesh(None)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_sp_config_accepted():
+    mesh = dist.init_mesh({"dp": 4, "tp": 2})
+    try:
+        paddle.seed(3)
+        sp = GPT(GPTConfig(**CFG, tensor_parallel=True,
+                           sequence_parallel=True))
+        sp.eval()
+        out = GPTGenerator(sp).generate(
+            paddle.to_tensor(rng.integers(0, 64, (2, 8))),
+            max_new_tokens=4, temperature=0.0)
+        assert out.shape == [2, 12]
+    finally:
+        dist.set_mesh(None)
+
+
+def test_moe_greedy_matches_full_forward():
+    paddle.seed(5)
+    model = GPT(GPTConfig(**dict(CFG, moe_every=2, moe_experts=4)))
+    model.eval()
+    gen = GPTGenerator(model)
+    ids_np = rng.integers(0, 64, (1, 6))
+    out = gen.generate(paddle.to_tensor(ids_np), max_new_tokens=6,
+                       temperature=0.0).numpy()
+    # naive loop: full forward each step, argmax
+    cur = ids_np.copy()
+    for _ in range(6):
+        logits = model(paddle.to_tensor(cur)).numpy()
+        nxt = logits[:, -1].argmax(-1)
+        cur = np.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(out, cur)
+
+
+def test_paged_matches_dense_cache():
+    paddle.seed(7)
+    model = GPT(GPTConfig(**CFG))
+    model.eval()
+    ids = paddle.to_tensor(rng.integers(0, 64, (2, 8)))
+    ref = GPTGenerator(model).generate(ids, max_new_tokens=8,
+                                       temperature=0.0).numpy()
+    paged = PagedGPTGenerator(model, block_size=16).generate(
+        ids, max_new_tokens=8, temperature=0.0).numpy()
+    np.testing.assert_array_equal(paged, ref)
+
+
+def test_paged_under_tp():
+    mesh = dist.init_mesh({"dp": 4, "tp": 2})
+    try:
+        paddle.seed(9)
+        tp = GPT(GPTConfig(**CFG, tensor_parallel=True))
+        tp.eval()
+        ids = paddle.to_tensor(rng.integers(0, 64, (2, 8)))
+        out = PagedGPTGenerator(tp, block_size=16).generate(
+            ids, max_new_tokens=4, temperature=0.0)
+        assert out.shape == [2, 12]
+    finally:
+        dist.set_mesh(None)
+
+
+def test_block_multihead_attention_functional():
+    from paddle_tpu.incubate.nn import functional as IF
+    from paddle_tpu.models.generation import (
+        PagedKVCache, paged_write_prefill,
+    )
+
+    b, L, h, d = 2, 32, 2, 8
+    cache = PagedKVCache(b, L, h, d, 1, jnp.float32, block_size=8)
+    k = jnp.asarray(rng.standard_normal((b, 5, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, 5, h, d)), jnp.float32)
+    kp = paged_write_prefill(cache.pools[0][0], cache.block_table, k, 8)
+    vp = paged_write_prefill(cache.pools[0][1], cache.block_table, v, 8)
+    q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.float32)
+    out = IF.block_multihead_attention(q, kp, vp, cache.block_table,
+                                       jnp.asarray(4))
+    # reference: dense attention over the 5 valid positions
+    s = jnp.einsum("bthd,bLhd->bhtL", q, k) / np.sqrt(d)
+    probs = jax.nn.softmax(s, axis=-1)
+    ref = jnp.einsum("bhtL,bLhd->bthd", probs, v).reshape(b, 1, h * d)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_moe_decode_no_token_drop():
+    """Serving must never zero a token's MLP because of capacity (review
+    finding): many sequences routing to one expert still all compute."""
+    from paddle_tpu.models.generation import _mlp
+
+    d, e = 8, 4
+    r = np.random.default_rng(0)
+    p = {"mlp.gate": jnp.asarray(np.zeros((d, e), np.float32)
+                                 + np.eye(d, e) * 5),  # all -> expert argmax
+         "mlp.w1": jnp.asarray(r.standard_normal((e, d, 16)), jnp.float32),
+         "mlp.b1": jnp.zeros((e, 16), jnp.float32),
+         "mlp.w2": jnp.asarray(r.standard_normal((e, 16, d)), jnp.float32),
+         "mlp.b2": jnp.zeros((e, d), jnp.float32)}
+    # 6 identical tokens -> all route to the same expert
+    x = jnp.broadcast_to(jnp.asarray(r.standard_normal(d), jnp.float32),
+                         (6, 1, d))
+    y = _mlp(p, x)
+    # every token gets the SAME (nonzero) expert output — none dropped
+    assert float(jnp.abs(y[0]).sum()) > 0
+    np.testing.assert_allclose(np.asarray(y), np.asarray(
+        jnp.broadcast_to(y[0], y.shape)), rtol=1e-5)
+
+
+def test_masked_multihead_attention_per_sequence_pos():
+    from paddle_tpu.incubate.nn import functional as IF
+
+    b, L, h, d = 3, 16, 2, 4
+    r = np.random.default_rng(2)
+    cache = jnp.asarray(r.standard_normal((2, b, L, h, d)), jnp.float32)
+    x = jnp.asarray(r.standard_normal((b, h * d)), jnp.float32)
+    pos = jnp.asarray([3, 7, 11], jnp.int32)   # per-sequence offsets
+    out = IF.masked_multihead_attention(x, cache, pos)
+    assert out.shape == (b, h * d)
+    # row 0 must ignore cache positions > 3: perturbing them is a no-op
+    cache2 = cache.at[0, 0, 10].add(100.0)
+    out2 = IF.masked_multihead_attention(x, cache2, pos)
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(out2[0]),
+                               rtol=1e-6)
+    # row 2 (pos=11) DOES see its own position 10
+    cache3 = cache.at[0, 2, 10].add(100.0)
+    out3 = IF.masked_multihead_attention(x, cache3, pos)
+    assert not np.allclose(np.asarray(out[2]), np.asarray(out3[2]))
+
+
+def test_paged_block_size_non_divisible():
+    paddle.seed(0)
+    model = GPT(GPTConfig(**dict(CFG, max_seq_len=48)))
+    model.eval()
+    g = PagedGPTGenerator(model, block_size=20)  # 48 % 20 != 0 -> adjusts
+    assert 48 % g.block_size == 0
+    out = g.generate(paddle.to_tensor(rng.integers(0, 64, (1, 6))),
+                     max_new_tokens=4, temperature=0.0)
+    assert out.shape == [1, 10]
