@@ -362,3 +362,106 @@ def kl_divergence(p: Distribution, q: Distribution):
     # generic fallback: monte-carlo estimate
     s = p.sample((256,))
     return _C.mean(p.log_prob(s) - q.log_prob(s), axis=0)
+
+
+# ======================================================= KL registry + extras
+# Reference: python/paddle/distribution/kl.py (register_kl decorator +
+# dispatch by most-derived type pair). The closed-form pairs above migrate
+# into the registry; user-registered pairs take precedence over the
+# monte-carlo fallback.
+
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator registering a KL(p||q) implementation for a type pair."""
+
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return deco
+
+
+def _dispatch_kl(p, q):
+    best = None
+    best_score = None
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if isinstance(p, cp) and isinstance(q, cq):
+            score = (len(type(p).__mro__) - len(cp.__mro__),
+                     len(type(q).__mro__) - len(cq.__mro__))
+            if best_score is None or score < best_score:
+                best, best_score = fn, score
+    return best
+
+
+_builtin_kl = kl_divergence
+
+
+def kl_divergence(p: Distribution, q: Distribution):  # noqa: F811
+    fn = _dispatch_kl(p, q)
+    if fn is not None:
+        return fn(p, q)
+    return _builtin_kl(p, q)
+
+
+class TransformedDistribution(Distribution):
+    """base distribution pushed through a chain of transforms (reference
+    distribution/transformed_distribution.py)."""
+
+    def __init__(self, base: Distribution, transforms):
+        from paddle_tpu.distribution.transform import ChainTransform
+
+        self.base = base
+        ts = transforms if isinstance(transforms, (list, tuple)) \
+            else [transforms]
+        self.transform = ChainTransform(list(ts))
+        super().__init__(base._batch_shape, base._event_shape)
+
+    def rsample(self, shape=()):
+        return self.transform.forward(self.base.rsample(shape))
+
+    def sample(self, shape=()):
+        with paddle.no_grad():
+            return self.rsample(shape)
+
+    def log_prob(self, value):
+        x = self.transform.inverse(value)
+        ld = self.transform.forward_log_det_jacobian(x)
+        return self.base.log_prob(x) - ld
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims (reference independent.py)."""
+
+    def __init__(self, base: Distribution, reinterpreted_batch_rank=1):
+        self.base = base
+        self.rank = reinterpreted_batch_rank
+        bs = base._batch_shape
+        super().__init__(bs[:len(bs) - self.rank],
+                         bs[len(bs) - self.rank:] + base._event_shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        axes = list(range(len(lp.shape) - self.rank, len(lp.shape)))
+        return _C.sum(lp, axis=axes)
+
+    def entropy(self):
+        e = self.base.entropy()
+        axes = list(range(len(e.shape) - self.rank, len(e.shape)))
+        return _C.sum(e, axis=axes)
+
+
+from paddle_tpu.distribution import transform  # noqa: F401,E402
+from paddle_tpu.distribution.transform import (  # noqa: F401,E402
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform,
+    SigmoidTransform, SoftmaxTransform, StackTransform,
+    StickBreakingTransform, TanhTransform, Transform,
+)

@@ -1,0 +1,235 @@
+"""paddle.distribution.transform — bijectors + TransformedDistribution.
+
+Reference: python/paddle/distribution/transform.py (Transform base with
+forward/inverse/log_det_jacobian and Type variance classes) and
+transformed_distribution.py. Jax-native: transforms are pure functions of
+Tensor values; log-dets compose additively through ChainTransform.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.registry import C_OPS as _C
+
+
+def _t(x):
+    from paddle_tpu import to_tensor
+
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+class Transform:
+    """Bijector: forward/inverse + forward_log_det_jacobian."""
+
+    _domain_event_dim = 0
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc, self.scale = _t(loc), _t(scale)
+
+    def forward(self, x):
+        return self.loc + self.scale * x
+
+    def inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return _C.log(_C.abs(self.scale)) * _C.ones_like(x)
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return _C.exp(x)
+
+    def inverse(self, y):
+        return _C.log(y)
+
+    def forward_log_det_jacobian(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return _C.sigmoid(x)
+
+    def inverse(self, y):
+        return _C.log(y) - _C.log(1.0 - y)
+
+    def forward_log_det_jacobian(self, x):
+        # stable: log sigmoid'(x) = -softplus(-x) - softplus(x)
+        return -_C.softplus(-x) - _C.softplus(x)
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return _C.tanh(x)
+
+    def inverse(self, y):
+        return _C.atanh(y)
+
+    def forward_log_det_jacobian(self, x):
+        # log(1 - tanh(x)^2) = 2*(log2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - _C.softplus(-2.0 * x))
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def forward(self, x):
+        return x ** self.power
+
+    def inverse(self, y):
+        return y ** (1.0 / self.power)
+
+    def forward_log_det_jacobian(self, x):
+        return _C.log(_C.abs(self.power * x ** (self.power - 1.0)))
+
+
+class AbsTransform(Transform):
+    def forward(self, x):
+        return _C.abs(x)
+
+    def inverse(self, y):
+        return y  # principal branch
+
+    def forward_log_det_jacobian(self, x):
+        return _C.zeros_like(x)
+
+
+class SoftmaxTransform(Transform):
+    _domain_event_dim = 1
+
+    def forward(self, x):
+        return _C.softmax(x, axis=-1)
+
+    def inverse(self, y):
+        return _C.log(y)
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def forward(self, x):
+        batch = tuple(x.shape)[:len(tuple(x.shape))
+                               - len(self.in_event_shape)]
+        return x.reshape(list(batch + self.out_event_shape))
+
+    def inverse(self, y):
+        batch = tuple(y.shape)[:len(tuple(y.shape))
+                               - len(self.out_event_shape)]
+        return y.reshape(list(batch + self.in_event_shape))
+
+    def forward_log_det_jacobian(self, x):
+        # volume-preserving: zero with ALL event dims reduced
+        axes = list(range(len(tuple(x.shape)) - len(self.in_event_shape),
+                          len(tuple(x.shape))))
+        return _C.sum(x * 0.0, axis=axes)
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = reinterpreted_batch_rank
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        ld = self.base.forward_log_det_jacobian(x)
+        axes = list(range(len(ld.shape) - self.rank, len(ld.shape)))
+        return _C.sum(ld, axis=axes)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ld = t.forward_log_det_jacobian(x)
+            total = ld if total is None else total + ld
+            x = t.forward(x)
+        return total
+
+
+class StackTransform(Transform):
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def forward(self, x):
+        parts = x.unbind(axis=self.axis)
+        return _C.stack([t.forward(p) for t, p in
+                         zip(self.transforms, parts)], axis=self.axis)
+
+    def inverse(self, y):
+        parts = y.unbind(axis=self.axis)
+        return _C.stack([t.inverse(p) for t, p in
+                         zip(self.transforms, parts)], axis=self.axis)
+
+    def forward_log_det_jacobian(self, x):
+        parts = x.unbind(axis=self.axis)
+        return _C.stack([t.forward_log_det_jacobian(p) for t, p in
+                         zip(self.transforms, parts)], axis=self.axis)
+
+
+class StickBreakingTransform(Transform):
+    """R^(K-1) -> K-simplex (reference transform.py StickBreakingTransform)."""
+
+    _domain_event_dim = 1
+
+    def forward(self, x):
+        v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        k = v.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1.0))
+        z = jnp.reciprocal(1.0 + jnp.exp(-(v - offset)))  # sigmoid shifted
+        zpad = jnp.concatenate([z, jnp.ones(v.shape[:-1] + (1,))], -1)
+        cum = jnp.cumprod(1.0 - z, axis=-1)
+        cumpad = jnp.concatenate([jnp.ones(v.shape[:-1] + (1,)), cum], -1)
+        return Tensor._wrap(zpad * cumpad)
+
+    def inverse(self, y):
+        v = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+        k = v.shape[-1]
+        cum = 1.0 - jnp.cumsum(v, axis=-1)
+        z = v[..., :-1] / jnp.concatenate(
+            [jnp.ones(v.shape[:-1] + (1,)), cum[..., :-2]], -1)
+        offset = jnp.log(jnp.arange(k - 1, 0, -1.0))
+        return Tensor._wrap(jnp.log(z) - jnp.log1p(-z) + offset)

@@ -23,3 +23,20 @@ from paddle_tpu.nn.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerDecoder, TransformerDecoderLayer,
     TransformerEncoder, TransformerEncoderLayer,
 )
+
+from paddle_tpu.nn.layers_extra import (  # noqa: F401,E402
+    AdaptiveAvgPool1D, AdaptiveAvgPool3D, AdaptiveMaxPool1D,
+    AdaptiveMaxPool3D, AlphaDropout, AvgPool1D, AvgPool3D, Bilinear,
+    ChannelShuffle, Conv1DTranspose, Conv3D, Conv3DTranspose,
+    CosineSimilarity, Dropout3D, Fold, MaxPool1D, MaxPool3D, MaxUnPool2D,
+    MaxUnPool3D, Pad1D, Pad2D, Pad3D, PairwiseDistance, PixelShuffle,
+    PixelUnshuffle, SpectralNorm, Unfold, UpsamplingBilinear2D,
+    UpsamplingNearest2D, ZeroPad2D,
+)
+from paddle_tpu.nn.loss import (  # noqa: F401,E402
+    CTCLoss, CosineEmbeddingLoss, GaussianNLLLoss, HSigmoidLoss,
+    HingeEmbeddingLoss, HuberLoss, MarginRankingLoss, MultiLabelSoftMarginLoss,
+    MultiMarginLoss, PoissonNLLLoss, SoftMarginLoss, TripletMarginLoss,
+    TripletMarginWithDistanceLoss,
+)
+from paddle_tpu.nn import utils  # noqa: F401,E402

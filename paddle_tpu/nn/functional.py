@@ -104,3 +104,12 @@ def normalize(x, p=2, axis=1, epsilon=1e-12):
 
     n = C_OPS.norm(x, p=p, axis=axis, keepdim=True)
     return x / C_OPS.clip(n, min=epsilon)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean"):
+    """CTC loss (reference nn/functional/loss.py ctc_loss over the warpctc
+    kernel). Dispatches the registered `warpctc` op so gradients record on
+    the autograd tape."""
+    return _C.warpctc(log_probs, labels, input_lengths, label_lengths,
+                      blank=blank, reduction=reduction)

@@ -1,0 +1,416 @@
+"""nn layer breadth, batch 2: conv 3D/transpose variants, padding, 1D/3D
+pooling, vision reshuffles, distance layers, and extended dropout.
+
+Reference: python/paddle/nn/layer/{conv.py, pooling.py, common.py,
+vision.py, distance.py}. Functional bodies dispatch through the op
+registry (ops/impl*.py) like the batch-1 layers."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.nn.layers import _init_from_attr
+from paddle_tpu.ops.registry import C_OPS as _C
+
+
+class _ConvNd(Layer):
+    _op = None
+    _nd = 2
+    _transpose = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format=None,
+                 output_padding=0):
+        super().__init__()
+        k = (kernel_size if isinstance(kernel_size, (list, tuple))
+             else (kernel_size,) * self._nd)
+        if self._transpose:
+            w_shape = [in_channels, out_channels // groups, *k]
+        else:
+            w_shape = [out_channels, in_channels // groups, *k]
+        w_init, _ = _init_from_attr(weight_attr, I.XavierNormal())
+        self.weight = self.create_parameter(
+            w_shape, default_initializer=w_init)
+        self.bias = None
+        if bias_attr is not False:
+            b_init, _ = _init_from_attr(bias_attr, I.Constant(0.0))
+            self.bias = self.create_parameter(
+                [out_channels], is_bias=True, default_initializer=b_init)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._output_padding = output_padding
+
+    def forward(self, x):
+        kw = dict(stride=self._stride, padding=self._padding,
+                  dilation=self._dilation, groups=self._groups)
+        if self._transpose:
+            kw["output_padding"] = self._output_padding
+        fn = getattr(_C, self._op)
+        return fn(x, self.weight, self.bias, **kw)
+
+
+class Conv3D(_ConvNd):
+    _op = "conv3d"
+    _nd = 3
+
+
+class Conv1DTranspose(_ConvNd):
+    _op = "conv1d_transpose"
+    _nd = 1
+    _transpose = True
+
+
+class Conv3DTranspose(_ConvNd):
+    _op = "conv3d_transpose"
+    _nd = 3
+    _transpose = True
+
+
+# ------------------------------------------------------------------ padding
+
+
+class _PadNd(Layer):
+    _nd = 2
+
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format=None):
+        super().__init__()
+        self.padding = ([padding] * (2 * self._nd)
+                        if isinstance(padding, int) else list(padding))
+        self.mode = mode
+        self.value = value
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode=self.mode, value=self.value)
+
+
+class Pad1D(_PadNd):
+    _nd = 1
+
+
+class Pad2D(_PadNd):
+    _nd = 2
+
+
+class Pad3D(_PadNd):
+    _nd = 3
+
+
+class ZeroPad2D(Pad2D):
+    def __init__(self, padding, data_format=None):
+        super().__init__(padding, mode="constant", value=0.0)
+
+
+# ------------------------------------------------------------------ pooling
+
+
+class _Pool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, return_mask=False):
+        super().__init__()
+        self.k, self.s, self.p = kernel_size, stride, padding
+        self.ceil_mode = ceil_mode
+        self.exclusive = exclusive
+
+    def _pool2d(self, x, op, **extra):
+        v = x.unsqueeze(2)  # [n, c, 1, L]
+        out = op(v, (1, self.k),
+                 stride=(1, self.s if self.s is not None else self.k),
+                 padding=(0, self.p), ceil_mode=self.ceil_mode, **extra)
+        return out.squeeze(2)
+
+
+class MaxPool1D(_Pool1D):
+    def forward(self, x):
+        return self._pool2d(x, _C.max_pool2d)
+
+
+class AvgPool1D(_Pool1D):
+    def forward(self, x):
+        return self._pool2d(x, _C.avg_pool2d, exclusive=self.exclusive)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NCDHW"):
+        super().__init__()
+        self.k, self.s, self.p = kernel_size, stride, padding
+        self.ceil_mode = ceil_mode
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        if self.return_mask:
+            return _C.max_pool3d_with_index(x, self.k, self.s, self.p,
+                                            ceil_mode=self.ceil_mode)
+        return _C.max_pool3d(x, self.k, self.s, self.p,
+                             ceil_mode=self.ceil_mode)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, data_format="NCDHW"):
+        super().__init__()
+        self.k, self.s, self.p = kernel_size, stride, padding
+        self.ceil_mode = ceil_mode
+        self.exclusive = exclusive
+
+    def forward(self, x):
+        return _C.avg_pool3d(x, self.k, self.s, self.p,
+                             ceil_mode=self.ceil_mode,
+                             exclusive=self.exclusive)
+
+
+class _AdaptivePoolNd(Layer):
+    def __init__(self, output_size, return_mask=False):
+        super().__init__()
+        self.output_size = output_size
+
+
+class AdaptiveAvgPool1D(_AdaptivePoolNd):
+    def forward(self, x):
+        v = x.unsqueeze(2)
+        out = _C.adaptive_avg_pool2d(v, (1, self.output_size))
+        return out.squeeze(2)
+
+
+class AdaptiveMaxPool1D(_AdaptivePoolNd):
+    def forward(self, x):
+        v = x.unsqueeze(2)
+        out = _C.adaptive_max_pool2d(v, (1, self.output_size))
+        return out.squeeze(2)
+
+
+class AdaptiveAvgPool3D(_AdaptivePoolNd):
+    def forward(self, x):
+        o = (self.output_size if isinstance(self.output_size, (list, tuple))
+             else (self.output_size,) * 3)
+        # adaptive = stride/kernel derived per output cell; exact when
+        # sizes divide (the common case); pooled via pool3d
+        d, h, w = x.shape[2:]
+        k = (d // o[0], h // o[1], w // o[2])
+        return _C.pool3d(x, k, stride=k, pooling_type="avg")
+
+
+class AdaptiveMaxPool3D(_AdaptivePoolNd):
+    def forward(self, x):
+        o = (self.output_size if isinstance(self.output_size, (list, tuple))
+             else (self.output_size,) * 3)
+        d, h, w = x.shape[2:]
+        k = (d // o[0], h // o[1], w // o[2])
+        return _C.pool3d(x, k, stride=k, pooling_type="max")
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None):
+        super().__init__()
+        self.k, self.s, self.p = kernel_size, stride, padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return _C.unpool(x, indices, kernel_size=self.k, stride=self.s,
+                         padding=self.p, output_size=self.output_size)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None):
+        super().__init__()
+        self.k, self.s, self.p = kernel_size, stride, padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return _C.unpool3d(x, indices, kernel_size=self.k, stride=self.s,
+                           padding=self.p, output_size=self.output_size)
+
+
+# ------------------------------------------------------------ vision layers
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format="NCHW"):
+        super().__init__()
+        self.factor = upscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return _C.pixel_shuffle(x, self.factor)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW"):
+        super().__init__()
+        self.factor = downscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return _C.pixel_unshuffle(x, self.factor,
+                                  data_format=self.data_format)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW"):
+        super().__init__()
+        self.groups = groups
+        self.data_format = data_format
+
+    def forward(self, x):
+        return _C.channel_shuffle(x, self.groups,
+                                  data_format=self.data_format)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1):
+        super().__init__()
+        self.kw = dict(kernel_sizes=kernel_sizes, strides=strides,
+                       paddings=paddings, dilations=dilations)
+
+    def forward(self, x):
+        return _C.unfold(x, **self.kw)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1):
+        super().__init__()
+        self.kw = dict(output_sizes=output_sizes, kernel_sizes=kernel_sizes,
+                       strides=strides, paddings=paddings,
+                       dilations=dilations)
+
+    def forward(self, x):
+        return _C.fold(x, **self.kw)
+
+
+class UpsamplingBilinear2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
+        super().__init__()
+        self.size, self.scale = size, scale_factor
+
+    def forward(self, x):
+        if self.size is not None:
+            return _C.bilinear_interp(x, self.size[0], self.size[1],
+                                      align_corners=True)
+        h, w = x.shape[2:]
+        return _C.bilinear_interp(x, int(h * self.scale),
+                                  int(w * self.scale), align_corners=True)
+
+
+class UpsamplingNearest2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
+        super().__init__()
+        self.size, self.scale = size, scale_factor
+
+    def forward(self, x):
+        if self.size is not None:
+            return _C.nearest_interp(x, self.size[0], self.size[1])
+        h, w = x.shape[2:]
+        return _C.nearest_interp(x, int(h * self.scale),
+                                 int(w * self.scale))
+
+
+# --------------------------------------------------------- distance / misc
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self.axis, self.eps = axis, eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, axis=self.axis, eps=self.eps)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False):
+        super().__init__()
+        self.p, self.eps, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        d = x - y + self.eps
+        return _C.p_norm(d, porder=self.p, axis=-1, keepdim=self.keepdim)
+
+
+class Bilinear(Layer):
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        w_init, _ = _init_from_attr(weight_attr, I.XavierNormal())
+        self.weight = self.create_parameter(
+            [out_features, in1_features, in2_features],
+            default_initializer=w_init)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [out_features], is_bias=True,
+                default_initializer=I.Constant(0.0))
+
+    def forward(self, x1, x2):
+        return _C.bilinear(x1, x2, self.weight, self.bias)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        # drop whole channels (feature maps), like the reference Dropout3D
+        b, c = x.shape[0], x.shape[1]
+        mask_shape = [b, c] + [1] * (len(x.shape) - 2)
+        keep = _C.dropout(Tensor._wrap(jnp.ones(mask_shape, "float32")),
+                          p=self.p, training=True)
+        return x * keep
+
+
+class AlphaDropout(Layer):
+    """SELU-preserving dropout (reference nn/layer/common.py AlphaDropout)."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        alpha_p = -1.7580993408473766
+        keep = 1.0 - self.p
+        mask = _C.dropout(Tensor._wrap(
+            jnp.ones(tuple(x.shape), "float32")), p=self.p,
+            training=True) * keep  # re-scale back to a 0/1 mask
+        a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+        b = -a * alpha_p * (1 - keep)
+        return a * (x * mask + alpha_p * (1.0 - mask)) + b
+
+
+class SpectralNorm(Layer):
+    """Power-iteration spectral normalization of a weight (reference
+    nn/layer/norm.py SpectralNorm)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12):
+        super().__init__()
+        self.dim, self.power_iters, self.eps = dim, power_iters, eps
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        self.weight_u = self.create_parameter(
+            [h], default_initializer=I.Normal(0.0, 1.0))
+        self.weight_v = self.create_parameter(
+            [w], default_initializer=I.Normal(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        return _C.spectral_norm(weight, self.weight_u, self.weight_v,
+                                dim=self.dim, power_iters=self.power_iters,
+                                eps=self.eps)

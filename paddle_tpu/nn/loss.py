@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from paddle_tpu.nn import functional as F
 from paddle_tpu.nn.layer import Layer
+from paddle_tpu.ops.registry import C_OPS as _C
 
 
 class CrossEntropyLoss(Layer):
@@ -97,3 +98,212 @@ class KLDivLoss(Layer):
 
     def forward(self, input, label):
         return F.kl_div(input, label, reduction=self.reduction)
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return out.mean()
+    if reduction == "sum":
+        return out.sum()
+    return out
+
+
+class HuberLoss(Layer):
+    """Reference: nn/layer/loss.py HuberLoss (phi huber_loss kernel)."""
+
+    def __init__(self, reduction="mean", delta=1.0):
+        super().__init__()
+        self.reduction, self.delta = reduction, delta
+
+    def forward(self, input, label):
+        out, _res = _C.huber_loss(input, label, delta=self.delta)
+        return _reduce(out, self.reduction)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean"):
+        super().__init__()
+        self.log_input, self.full = log_input, full
+        self.epsilon, self.reduction = epsilon, reduction
+
+    def forward(self, input, label):
+        if self.log_input:
+            out = _C.exp(input) - label * input
+        else:
+            out = input - label * _C.log(input + self.epsilon)
+        if self.full:
+            # Stirling approximation for label! (only where label > 1)
+            stirling = (label * _C.log(label) - label
+                        + 0.5 * _C.log(2 * 3.141592653589793 * label))
+            out = out + _C.where(label > 1, stirling,
+                                 _C.zeros_like(label))
+        return _reduce(out, self.reduction)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean"):
+        super().__init__()
+        self.full, self.epsilon, self.reduction = full, epsilon, reduction
+
+    def forward(self, input, label, variance):
+        var = _C.clip(variance, self.epsilon, 3.4e38)
+        out = 0.5 * (_C.log(var) + _C.square(input - label) / var)
+        if self.full:
+            out = out + 0.5 * 1.8378770664093453  # log(2*pi)
+        return _reduce(out, self.reduction)
+
+
+class MarginRankingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean"):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def forward(self, input, other, label):
+        out = _C.relu(-label * (input - other) + self.margin)
+        return _reduce(out, self.reduction)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        # stable form: log(1+exp(-m)) = -logsigmoid(m), no float32 overflow
+        out = -_C.logsigmoid(label * input)
+        return _reduce(out, self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean"):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        out = -(label * _C.logsigmoid(input)
+                + (1 - label) * _C.logsigmoid(-input))
+        if self.weight is not None:
+            out = out * self.weight
+        return _reduce(out.mean(axis=-1), self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean"):
+        super().__init__()
+        self.p, self.margin = p, margin
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        c = input.shape[-1]
+        picked = _C.take_along_axis(input, label.reshape([-1, 1]), 1)
+        m = _C.relu(self.margin - picked + input) ** self.p
+        if self.weight is not None:  # per-class weight of the TRUE class
+            m = m * _C.take_along_axis(
+                self.weight.reshape([1, -1]), label.reshape([-1, 1]), 1)
+        onehot = _C.one_hot(label, c)
+        out = (m * (1.0 - onehot)).sum(axis=-1) / c
+        return _reduce(out, self.reduction)
+
+
+class HingeEmbeddingLoss(Layer):
+    def __init__(self, margin=1.0, reduction="mean"):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def forward(self, input, label):
+        out = _C.where(label == 1.0, input,
+                       _C.relu(self.margin - input))
+        return _reduce(out, self.reduction)
+
+
+class CosineEmbeddingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean"):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def forward(self, input1, input2, label):
+        cos = _C.cosine_similarity(input1, input2, axis=-1)
+        out = _C.where(label == 1.0, 1.0 - cos,
+                       _C.relu(cos - self.margin))
+        return _reduce(out, self.reduction)
+
+
+class TripletMarginLoss(Layer):
+    def __init__(self, margin=1.0, p=2.0, epsilon=1e-6, swap=False,
+                 reduction="mean"):
+        super().__init__()
+        self.margin, self.p, self.eps = margin, p, epsilon
+        self.swap, self.reduction = swap, reduction
+
+    def forward(self, anchor, positive, negative):
+        dp = _C.p_norm(anchor - positive + self.eps, porder=self.p, axis=-1)
+        dn = _C.p_norm(anchor - negative + self.eps, porder=self.p, axis=-1)
+        if self.swap:
+            dn2 = _C.p_norm(positive - negative + self.eps, porder=self.p,
+                            axis=-1)
+            dn = _C.minimum(dn, dn2)
+        out = _C.relu(dp - dn + self.margin)
+        return _reduce(out, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean"):
+        super().__init__()
+        self.dist = distance_function or (
+            lambda a, b: _C.p_norm(a - b + 1e-6, porder=2.0, axis=-1))
+        self.margin, self.swap, self.reduction = margin, swap, reduction
+
+    def forward(self, anchor, positive, negative):
+        dp = self.dist(anchor, positive)
+        dn = self.dist(anchor, negative)
+        if self.swap:
+            dn = _C.minimum(dn, self.dist(positive, negative))
+        out = _C.relu(dp - dn + self.margin)
+        return _reduce(out, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference nn/layer/loss.py HSigmoidLoss; phi hsigmoid_loss)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False):
+        super().__init__()
+        from paddle_tpu.nn import initializer as I
+        from paddle_tpu.nn.layers import _init_from_attr
+
+        self.num_classes = num_classes
+        w_init, _ = _init_from_attr(weight_attr, I.XavierNormal())
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], default_initializer=w_init)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [num_classes - 1], is_bias=True,
+                default_initializer=I.Constant(0.0))
+
+    def forward(self, input, label):
+        out, _pre, _w = _C.hsigmoid_loss(input, label, self.weight,
+                                         self.bias,
+                                         num_classes=self.num_classes)
+        return out
+
+
+class CTCLoss(Layer):
+    """Connectionist temporal classification (reference nn/layer/loss.py
+    CTCLoss over the warpctc kernel) — log-semiring alpha recursion under
+    lax.scan, TPU-compatible (static shapes, no host sync)."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        from paddle_tpu.nn.functional import ctc_loss
+
+        return ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                        blank=self.blank, reduction=self.reduction)

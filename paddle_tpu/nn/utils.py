@@ -1,0 +1,167 @@
+"""paddle.nn.utils — weight_norm / spectral_norm reparameterizations and
+gradient clipping helpers.
+
+Reference: python/paddle/nn/utils/{weight_norm_hook.py,
+spectral_norm_hook.py, clip_grad_norm_.py, clip_grad_value_.py,
+transform_parameters.py}.
+
+TPU-native: reparameterizations recompute the effective weight in a
+forward-pre hook (a pure function of the stored parameters — traces
+cleanly into jit/TrainStep); clipping operates on .grad in eager mode.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.ops.registry import C_OPS as _C
+
+
+def _norm_except(w: Tensor, dim: int) -> Tensor:
+    axes = tuple(i for i in range(len(w.shape)) if i != dim)
+    return _C.sqrt(_C.sum(_C.square(w), axis=list(axes), keepdim=True))
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0) -> Layer:
+    """Reparameterize `name` as g * v/||v|| (reference weight_norm_hook.py).
+    Adds `{name}_g` and `{name}_v` parameters; the effective weight is
+    recomputed before every forward."""
+    w = getattr(layer, name)
+    dim = dim if dim is not None else 0
+    g = layer.create_parameter(list(_norm_except(w, dim).shape))
+    with __import__("paddle_tpu").no_grad():
+        g._value = _norm_except(w, dim)._value
+    v = layer.create_parameter(list(w.shape))
+    with __import__("paddle_tpu").no_grad():
+        v._value = w._value
+    setattr(layer, f"{name}_g", g)
+    setattr(layer, f"{name}_v", v)
+    # the original param must stop being a leaf parameter
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        vv = getattr(lyr, f"{name}_v")
+        gg = getattr(lyr, f"{name}_g")
+        eff = vv * (gg / _norm_except(vv, dim))
+        object.__setattr__(lyr, name, eff)
+        return inputs
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_hook = (handle, name, dim)
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight") -> Layer:
+    handle, pname, dim = layer._weight_norm_hook
+    handle.remove()
+    v = getattr(layer, f"{pname}_v")
+    g = getattr(layer, f"{pname}_g")
+    eff = v * (g / _norm_except(v, dim))
+    w = layer.create_parameter(list(eff.shape))
+    with __import__("paddle_tpu").no_grad():
+        w._value = eff._value
+    setattr(layer, pname, w)
+    for extra in (f"{pname}_v", f"{pname}_g"):
+        if extra in layer._parameters:
+            del layer._parameters[extra]
+    del layer._weight_norm_hook
+    return layer
+
+
+def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations=1,
+                  eps=1e-12, dim=None) -> Layer:
+    """Reparameterize `name` as W/sigma(W) via power iteration (reference
+    spectral_norm_hook.py)."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 1 if type(layer).__name__ in (
+            "Linear", "Conv1DTranspose", "Conv2DTranspose",
+            "Conv3DTranspose") else 0
+    h = w.shape[dim]
+    width = int(np.prod(w.shape)) // h
+    rng = np.random.default_rng(0)
+    u = layer.create_parameter([h])
+    v = layer.create_parameter([width])
+    with __import__("paddle_tpu").no_grad():
+        u._value = jnp.asarray(rng.standard_normal(h), jnp.float32)
+        v._value = jnp.asarray(rng.standard_normal(width), jnp.float32)
+    u.stop_gradient = True
+    v.stop_gradient = True
+    setattr(layer, f"{name}_u", u)
+    setattr(layer, f"{name}_v", v)
+    orig = layer.create_parameter(list(w.shape))
+    with __import__("paddle_tpu").no_grad():
+        orig._value = w._value
+    setattr(layer, f"{name}_orig", orig)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        ww = getattr(lyr, f"{name}_orig")
+        eff = _C.spectral_norm(ww, getattr(lyr, f"{name}_u"),
+                               getattr(lyr, f"{name}_v"), dim=dim,
+                               power_iters=n_power_iterations, eps=eps)
+        object.__setattr__(lyr, name, eff)
+        return inputs
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._spectral_norm_hook = (handle, name)
+    return layer
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global-norm gradient clip (reference clip_grad_norm_)."""
+    import paddle_tpu as paddle
+
+    params = [p for p in (parameters if isinstance(parameters, (list, tuple))
+                          else [parameters]) if p.grad is not None]
+    if not params:
+        return Tensor._wrap(jnp.zeros(()))
+    with paddle.no_grad():
+        if norm_type == float("inf"):
+            total = jnp.max(jnp.stack(
+                [jnp.max(jnp.abs(p.grad._value)) for p in params]))
+        else:
+            total = jnp.sum(jnp.stack(
+                [jnp.sum(jnp.abs(p.grad._value) ** norm_type)
+                 for p in params])) ** (1.0 / norm_type)
+        if error_if_nonfinite and not bool(jnp.isfinite(total)):
+            raise RuntimeError("non-finite gradient norm")
+        scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+        for p in params:
+            p.grad._value = p.grad._value * scale
+    return Tensor._wrap(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    import paddle_tpu as paddle
+
+    with paddle.no_grad():
+        for p in (parameters if isinstance(parameters, (list, tuple))
+                  else [parameters]):
+            if p.grad is not None:
+                p.grad._value = jnp.clip(p.grad._value, -clip_value,
+                                         clip_value)
+
+
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    return Tensor._wrap(jnp.concatenate(
+        [p._value.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec: Tensor, parameters):
+    import paddle_tpu as paddle
+
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    with paddle.no_grad():
+        for p in parameters:
+            n = int(np.prod(p.shape))
+            p._value = v[off:off + n].reshape(tuple(p.shape)).astype(
+                p._value.dtype)
+            off += n

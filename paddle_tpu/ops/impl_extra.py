@@ -952,8 +952,9 @@ def _pool_nd(x, k, s, p, reducer, init, ceil_mode=False):
     strides = (1, 1) + s
     extra = _ceil_extra(x.shape[2:], k, s, p, ceil_mode)
     pads = [(0, 0), (0, 0)] + [(pi, pi + e) for pi, e in zip(p, extra)]
-    return lax.reduce_window(x, jnp.asarray(init, x.dtype), reducer, dims,
-                             strides, pads)
+    # init stays a PYTHON scalar: jax's differentiable max-pool path
+    # pattern-matches the -inf init value, and an abstract array breaks it
+    return lax.reduce_window(x, init, reducer, dims, strides, pads)
 
 
 def pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -963,12 +964,12 @@ def pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     p = _pair(padding, 3)
     if pooling_type == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
-            jnp.iinfo(x.dtype).min
+            int(jnp.iinfo(x.dtype).min)
         return _pool_nd(x, k, s, p, lax.max, init, ceil_mode)
     ones_ = jnp.ones_like(x)
-    summed = _pool_nd(x, k, s, p, lax.add, 0, ceil_mode)
+    summed = _pool_nd(x, k, s, p, lax.add, 0.0, ceil_mode)
     if exclusive:
-        cnt = _pool_nd(ones_, k, s, p, lax.add, 0, ceil_mode)
+        cnt = _pool_nd(ones_, k, s, p, lax.add, 0.0, ceil_mode)
     else:
         cnt = float(np.prod(k))
     return summed / cnt
@@ -1039,7 +1040,7 @@ def lp_pool2d(x, kernel_size, stride=None, padding=0, norm_type=2.0,
     s = _pair(stride if stride is not None else kernel_size)
     p = _pair(padding)
     xf = jnp.abs(x.astype(jnp.float32)) ** norm_type
-    summed = _pool_nd(xf, k, s, p, lax.add, 0, ceil_mode)
+    summed = _pool_nd(xf, k, s, p, lax.add, 0.0, ceil_mode)
     return (summed ** (1.0 / norm_type)).astype(x.dtype)
 
 
@@ -1490,3 +1491,85 @@ def unpool3d(x, indices, kernel_size=None, stride=None, padding=0,
     out = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v)))(
         out, indices.reshape(n, c, -1), x.reshape(n, c, -1))
     return out.reshape(n, c, od, oh, ow)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1):
+    from paddle_tpu.ops.impl import conv2d_transpose
+
+    s = stride if isinstance(stride, int) else stride[0]
+    p = padding if isinstance(padding, int) else padding[0]
+    d = dilation if isinstance(dilation, int) else dilation[0]
+    op = output_padding if isinstance(output_padding, int) \
+        else output_padding[0]
+    out = conv2d_transpose(x[:, :, None, :], weight[:, :, None, :], bias,
+                           stride=(1, s), padding=(0, p),
+                           output_padding=(0, op), dilation=(1, d),
+                           groups=groups)
+    return out[:, :, 0, :]
+
+
+def warpctc(log_probs, labels, input_lengths, label_lengths, blank=0,
+            reduction="mean"):
+    """CTC loss — log-semiring alpha recursion (reference: the warpctc
+    kernel behind nn/functional/loss.py ctc_loss). log_probs: [T, B, C]
+    log-softmax outputs; labels: [B, S]. One lax.scan over time with a
+    static [B, 2S+1] lattice — jittable, differentiable via autodiff."""
+    # reference warpctc applies softmax internally to unscaled logits;
+    # log_softmax is idempotent for already-normalized input
+    lp = jax.nn.log_softmax(jnp.asarray(log_probs).astype(jnp.float32), -1)
+    lab = jnp.asarray(labels).astype(jnp.int32)
+    in_len = jnp.asarray(input_lengths).astype(jnp.int32)
+    lab_len = jnp.asarray(label_lengths).astype(jnp.int32)
+    if lp.ndim == 2:
+        lp = lp[:, None]
+        lab = lab[None] if lab.ndim == 1 else lab
+    T, B, C = lp.shape
+    S = lab.shape[1]
+    NEG = -1e30
+
+    # extended label sequence: blank, l1, blank, l2, ... blank  [B, 2S+1]
+    ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    ext_valid = jnp.arange(2 * S + 1)[None, :] < (2 * lab_len + 1)[:, None]
+    same_as_prev = jnp.concatenate(
+        [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+    can_skip = (jnp.arange(2 * S + 1)[None, :] % 2 == 1) & ~same_as_prev
+
+    alpha0 = jnp.full((B, 2 * S + 1), NEG)
+    alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(B), ext[:, 0]])
+    has1 = (2 * lab_len + 1) > 1
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(has1, lp[0, jnp.arange(B), ext[:, 1]], NEG))
+
+    def step(alpha, lp_t):
+        stay = alpha
+        prev1 = jnp.concatenate(
+            [jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate(
+            [jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(can_skip, prev2, NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2)
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)     # [B, 2S+1]
+        new = jnp.where(ext_valid, merged + emit, NEG)
+        return new, new
+
+    _, alphas = lax.scan(step, alpha0, lp[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T,B,2S+1]
+    t_idx = jnp.clip(in_len - 1, 0, T - 1)
+    a_T = alphas[t_idx, jnp.arange(B)]                        # [B, 2S+1]
+    sL = 2 * lab_len
+    last_blank = jnp.take_along_axis(a_T, sL[:, None], axis=1)[:, 0]
+    last_label = jnp.where(
+        lab_len > 0,
+        jnp.take_along_axis(a_T, jnp.maximum(sL - 1, 0)[:, None],
+                            axis=1)[:, 0],
+        -1e30)  # empty label: only the all-blank path exists
+    nll = -jnp.logaddexp(last_blank, last_label)
+    if reduction == "mean":
+        # warpctc convention: per-sample loss / label_length, batch mean
+        return jnp.mean(nll / jnp.maximum(lab_len.astype(jnp.float32),
+                                          1.0))
+    if reduction == "sum":
+        return jnp.sum(nll)
+    return nll

@@ -1,0 +1,387 @@
+"""nn + distribution breadth (VERDICT round-1 item #6): conv/pad/pool
+variants, the extended loss zoo, nn.utils reparameterizations, and
+distribution transforms + KL registry — parity vs numpy/scipy references.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.special
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+rng = np.random.default_rng(0)
+
+
+def _t(*shape, scale=1.0):
+    return paddle.to_tensor((rng.standard_normal(shape) * scale)
+                            .astype(np.float32))
+
+
+# ------------------------------------------------------------------- layers
+
+def test_conv3d_layers():
+    c3 = nn.Conv3D(2, 4, 3, padding=1)
+    out = c3(_t(1, 2, 5, 5, 5))
+    assert out.shape == [1, 4, 5, 5, 5]
+    out.sum().backward()
+    assert c3.weight.grad is not None
+    ct = nn.Conv3DTranspose(2, 3, 2, stride=2)
+    assert ct(_t(1, 2, 3, 3, 3)).shape == [1, 3, 6, 6, 6]
+    c1t = nn.Conv1DTranspose(2, 3, 2, stride=2)
+    assert c1t(_t(1, 2, 5)).shape == [1, 3, 10]
+
+
+def test_pad_layers():
+    x = _t(1, 2, 4, 4)
+    assert nn.Pad2D([1, 1, 2, 2])(x).shape == [1, 2, 8, 6]
+    assert nn.ZeroPad2D(1)(x).shape == [1, 2, 6, 6]
+    x1 = _t(1, 2, 6)
+    assert nn.Pad1D([1, 1], mode="replicate")(x1).shape == [1, 2, 8]
+    x3 = _t(1, 1, 2, 2, 2)
+    assert nn.Pad3D(1)(x3).shape == [1, 1, 4, 4, 4]
+    out = nn.Pad2D([1, 0, 0, 0], mode="reflect")(x)
+    np.testing.assert_allclose(out.numpy()[..., 0], x.numpy()[..., 1])
+
+
+def test_pool_layers():
+    x1 = _t(2, 3, 8)
+    np.testing.assert_allclose(
+        nn.MaxPool1D(2, 2)(x1).numpy(),
+        x1.numpy().reshape(2, 3, 4, 2).max(-1), rtol=1e-6)
+    np.testing.assert_allclose(
+        nn.AvgPool1D(2, 2)(x1).numpy(),
+        x1.numpy().reshape(2, 3, 4, 2).mean(-1), rtol=1e-6)
+    assert nn.AdaptiveAvgPool1D(4)(x1).shape == [2, 3, 4]
+    assert nn.AdaptiveMaxPool1D(2)(x1).shape == [2, 3, 2]
+    x3 = _t(1, 2, 4, 4, 4)
+    np.testing.assert_allclose(
+        nn.MaxPool3D(2, 2)(x3).numpy(),
+        x3.numpy().reshape(1, 2, 2, 2, 2, 2, 2, 2).max((3, 5, 7)),
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        nn.AvgPool3D(2, 2)(x3).numpy(),
+        x3.numpy().reshape(1, 2, 2, 2, 2, 2, 2, 2).mean((3, 5, 7)),
+        rtol=1e-6)
+    assert nn.AdaptiveAvgPool3D(2)(x3).shape == [1, 2, 2, 2, 2]
+    assert nn.AdaptiveMaxPool3D(2)(x3).shape == [1, 2, 2, 2, 2]
+    # unpool inverts pooling positions
+    x = _t(1, 1, 4, 4)
+    mp = nn.MaxPool2D(2, 2)
+    pooled = paddle._C_ops.max_pool2d_with_index(x, 2, 2)
+    up = nn.MaxUnPool2D(2, 2)(pooled[0], pooled[1])
+    assert up.shape == [1, 1, 4, 4]
+    np.testing.assert_allclose(up.numpy().max(), x.numpy().max())
+
+
+def test_vision_layers():
+    x = _t(1, 8, 4, 4)
+    ps = nn.PixelShuffle(2)(x)
+    assert ps.shape == [1, 2, 8, 8]
+    back = nn.PixelUnshuffle(2)(ps)
+    np.testing.assert_allclose(back.numpy(), x.numpy())
+    assert nn.ChannelShuffle(2)(x).shape == [1, 8, 4, 4]
+    u = nn.Unfold([2, 2], strides=2)(_t(1, 2, 4, 4))
+    f = nn.Fold([4, 4], [2, 2], strides=2)(u)
+    assert f.shape == [1, 2, 4, 4]
+    assert nn.UpsamplingBilinear2D(scale_factor=2)(x).shape == [1, 8, 8, 8]
+    assert nn.UpsamplingNearest2D(size=(8, 8))(x).shape == [1, 8, 8, 8]
+
+
+def test_distance_and_misc_layers():
+    a, b = _t(4, 8), _t(4, 8)
+    cs = nn.CosineSimilarity(axis=1)(a, b).numpy()
+    e = np.sum(a.numpy() * b.numpy(), 1) / (
+        np.linalg.norm(a.numpy(), axis=1) * np.linalg.norm(b.numpy(),
+                                                           axis=1))
+    np.testing.assert_allclose(cs, e, rtol=1e-4)
+    pd = nn.PairwiseDistance()(a, b).numpy()
+    np.testing.assert_allclose(
+        pd, np.linalg.norm(a.numpy() - b.numpy() + 1e-6, axis=1),
+        rtol=1e-4)
+    bl = nn.Bilinear(8, 8, 3)
+    assert bl(a, b).shape == [4, 3]
+    d3 = nn.Dropout3D(0.5)
+    d3.eval()
+    xi = _t(1, 2, 2, 2, 2)
+    np.testing.assert_allclose(d3(xi).numpy(), xi.numpy())  # eval: identity
+    d3.train()
+    out = d3(_t(1, 8, 4, 4, 4)).numpy()
+    # whole channels drop together
+    per_chan = out.reshape(8, -1)
+    assert all((c == 0).all() or (c != 0).any() for c in per_chan)
+    ad = nn.AlphaDropout(0.3)
+    out = ad(_t(100, 100))
+    assert np.isfinite(out.numpy()).all()
+
+
+# ------------------------------------------------------------------- losses
+
+def test_loss_zoo():
+    x, y = _t(4, 5), _t(4, 5)
+    np.testing.assert_allclose(
+        float(nn.HuberLoss(delta=1.0)(x, y)),
+        float(np.mean(np.where(np.abs(y.numpy() - x.numpy()) <= 1,
+                               0.5 * (y.numpy() - x.numpy()) ** 2,
+                               np.abs(y.numpy() - x.numpy()) - 0.5))),
+        rtol=1e-5)
+    lbl = paddle.to_tensor(np.where(rng.uniform(size=(4, 5)) > 0.5, 1.0,
+                                    -1.0).astype(np.float32))
+    np.testing.assert_allclose(
+        float(nn.SoftMarginLoss()(x, lbl)),
+        float(np.mean(np.log1p(np.exp(-lbl.numpy() * x.numpy())))),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        float(nn.HingeEmbeddingLoss()(x, lbl)),
+        float(np.mean(np.where(lbl.numpy() == 1, x.numpy(),
+                               np.maximum(0, 1 - x.numpy())))), rtol=1e-5)
+    a, p, n = _t(4, 8), _t(4, 8), _t(4, 8)
+    tm = float(nn.TripletMarginLoss(margin=1.0)(a, p, n))
+    dp = np.linalg.norm(a.numpy() - p.numpy() + 1e-6, axis=1)
+    dn = np.linalg.norm(a.numpy() - n.numpy() + 1e-6, axis=1)
+    np.testing.assert_allclose(tm, np.mean(np.maximum(dp - dn + 1, 0)),
+                               rtol=1e-4)
+    np.testing.assert_allclose(
+        float(nn.TripletMarginWithDistanceLoss()(a, p, n)), tm, rtol=1e-4)
+    # margin ranking
+    o = _t(4, 5)
+    np.testing.assert_allclose(
+        float(nn.MarginRankingLoss(margin=0.5)(x, o, lbl)),
+        float(np.mean(np.maximum(
+            -lbl.numpy() * (x.numpy() - o.numpy()) + 0.5, 0))), rtol=1e-5)
+    # poisson / gaussian nll
+    rate = paddle.to_tensor(np.abs(rng.standard_normal((4, 5))
+                                   ).astype(np.float32) + 0.5)
+    np.testing.assert_allclose(
+        float(nn.PoissonNLLLoss(log_input=True, full=False)(x, rate)),
+        float(np.mean(np.exp(x.numpy()) - rate.numpy() * x.numpy())),
+        rtol=1e-4)
+    var = paddle.to_tensor(np.full((4, 5), 0.5, np.float32))
+    np.testing.assert_allclose(
+        float(nn.GaussianNLLLoss()(x, y, var)),
+        float(np.mean(0.5 * (np.log(0.5)
+                             + (x.numpy() - y.numpy()) ** 2 / 0.5))),
+        rtol=1e-4)
+    # multilabel / cosine embedding
+    ml = paddle.to_tensor(rng.integers(0, 2, (4, 5)).astype(np.float32))
+    out = float(nn.MultiLabelSoftMarginLoss()(x, ml))
+    sig = 1 / (1 + np.exp(-x.numpy()))
+    e = -(ml.numpy() * np.log(sig) + (1 - ml.numpy()) * np.log(1 - sig))
+    np.testing.assert_allclose(out, e.mean(), rtol=1e-4)
+    lab1 = paddle.to_tensor(np.where(rng.uniform(size=(4,)) > 0.5, 1.0,
+                                     -1.0).astype(np.float32))
+    ce = float(nn.CosineEmbeddingLoss(margin=0.1)(a, p, lab1))
+    assert np.isfinite(ce)
+    mm = nn.MultiMarginLoss()
+    out = float(mm(x, paddle.to_tensor(rng.integers(0, 5, (4,)))))
+    assert np.isfinite(out) and out >= 0
+    hs = nn.HSigmoidLoss(8, 6)
+    out = hs(_t(3, 8), paddle.to_tensor(rng.integers(0, 6, (3,))))
+    assert out.shape == [3, 1] and (out.numpy() > 0).all()
+
+
+def test_ctc_loss_against_manual():
+    """Tiny case checked against brute-force path enumeration."""
+    T, C = 4, 3  # blank=0, symbols {1, 2}
+    logits = rng.standard_normal((T, 1, C)).astype(np.float32)
+    logp = np.log(scipy.special.softmax(logits, -1))
+    label = np.asarray([[1, 2]], np.int64)
+
+    # brute force: sum over all alignments of length T collapsing to [1,2]
+    def collapse(path):
+        out = []
+        prev = None
+        for s in path:
+            if s != 0 and s != prev:
+                out.append(s)
+            prev = s
+        return out
+
+    import itertools
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        if collapse(path) == [1, 2]:
+            total += np.exp(sum(logp[t, 0, path[t]] for t in range(T)))
+    expected_nll = -np.log(total)
+
+    loss = nn.CTCLoss(blank=0, reduction="none")(
+        paddle.to_tensor(logp), paddle.to_tensor(label),
+        paddle.to_tensor(np.asarray([T])),
+        paddle.to_tensor(np.asarray([2])))
+    np.testing.assert_allclose(float(loss), expected_nll, rtol=1e-4)
+    # differentiable
+    lp_t = paddle.to_tensor(logp.astype(np.float32))
+    lp_t.stop_gradient = False
+    nn.CTCLoss()(lp_t, paddle.to_tensor(label),
+                 paddle.to_tensor(np.asarray([T])),
+                 paddle.to_tensor(np.asarray([2]))).backward()
+    assert np.isfinite(lp_t.grad.numpy()).all()
+
+
+# ----------------------------------------------------------------- nn.utils
+
+def test_weight_norm_roundtrip():
+    lin = nn.Linear(6, 4)
+    w0 = lin.weight.numpy().copy()
+    nn.utils.weight_norm(lin, "weight")
+    x = _t(2, 6)
+    y1 = lin(x)
+    # effective weight equals the original at init
+    np.testing.assert_allclose(y1.numpy(),
+                               x.numpy() @ w0 + lin.bias.numpy(),
+                               rtol=1e-5)
+    # grads flow to g and v
+    y1.sum().backward()
+    assert lin.weight_g.grad is not None and lin.weight_v.grad is not None
+    nn.utils.remove_weight_norm(lin, "weight")
+    np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5)
+
+
+def test_spectral_norm_hook():
+    paddle.seed(123)
+    lin = nn.Linear(6, 4)
+    nn.utils.spectral_norm(lin, "weight", n_power_iterations=50)
+    _ = lin(_t(2, 6))
+    sigma = np.linalg.norm(np.asarray(lin.weight.numpy()), 2)
+    np.testing.assert_allclose(sigma, 1.0, rtol=5e-2)
+
+
+def test_clip_grad_helpers():
+    lin = nn.Linear(4, 4)
+    lin(_t(2, 4)).sum().backward()
+    total = nn.utils.clip_grad_norm_(list(lin.parameters()), max_norm=0.1)
+    norms = np.sqrt(sum(float((p.grad.numpy() ** 2).sum())
+                        for p in lin.parameters()))
+    assert norms <= 0.11
+    assert float(total) > 0
+    nn.utils.clip_grad_value_(list(lin.parameters()), 1e-3)
+    for p in lin.parameters():
+        assert np.abs(p.grad.numpy()).max() <= 1e-3 + 1e-9
+    vec = nn.utils.parameters_to_vector(list(lin.parameters()))
+    assert vec.shape[0] == 4 * 4 + 4
+    nn.utils.vector_to_parameters(vec * 0 + 1.0, list(lin.parameters()))
+    np.testing.assert_allclose(lin.weight.numpy(), 1.0)
+
+
+# ------------------------------------------------------------- distributions
+
+def test_transformed_distribution_lognormal():
+    import paddle_tpu.distribution as D
+
+    base = D.Normal(paddle.to_tensor(0.0), paddle.to_tensor(1.0))
+    ln = D.TransformedDistribution(base, [D.ExpTransform()])
+    ref = D.LogNormal(paddle.to_tensor(0.0), paddle.to_tensor(1.0))
+    v = paddle.to_tensor(np.asarray([0.5, 1.0, 2.0], np.float32))
+    np.testing.assert_allclose(ln.log_prob(v).numpy(),
+                               ref.log_prob(v).numpy(), rtol=1e-5)
+    paddle.seed(0)
+    s = ln.sample((100,))
+    assert (s.numpy() > 0).all()
+
+
+def test_affine_sigmoid_chain():
+    import paddle_tpu.distribution as D
+
+    tr = D.ChainTransform([D.AffineTransform(1.0, 2.0),
+                           D.SigmoidTransform()])
+    x = paddle.to_tensor(np.asarray([0.1, -0.4], np.float32))
+    y = tr.forward(x)
+    back = tr.inverse(y)
+    np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    ld = tr.forward_log_det_jacobian(x)
+    # numeric jacobian diag
+    eps = 1e-4
+    for i in range(2):
+        xp = x.numpy().copy()
+        xp[i] += eps
+        num = (tr.forward(paddle.to_tensor(xp)).numpy()[i]
+               - y.numpy()[i]) / eps
+        np.testing.assert_allclose(float(ld.numpy()[i]), np.log(abs(num)),
+                                   rtol=1e-2)
+
+
+def test_register_kl_and_builtin():
+    import paddle_tpu.distribution as D
+
+    p = D.Normal(paddle.to_tensor(0.0), paddle.to_tensor(1.0))
+    q = D.Normal(paddle.to_tensor(1.0), paddle.to_tensor(2.0))
+    base = float(D.kl_divergence(p, q))
+    expected = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    np.testing.assert_allclose(base, expected, rtol=1e-5)
+
+    class MyDist(D.Normal):
+        pass
+
+    @D.register_kl(MyDist, MyDist)
+    def _kl_my(p, q):
+        return paddle.to_tensor(42.0)
+
+    a = MyDist(paddle.to_tensor(0.0), paddle.to_tensor(1.0))
+    assert float(D.kl_divergence(a, a)) == 42.0
+    # subclass falls back to the (Normal, Normal) registry entry
+    b = D.Normal(paddle.to_tensor(1.0), paddle.to_tensor(2.0))
+    assert np.isfinite(float(D.kl_divergence(a, b)))
+
+
+def test_stickbreaking_simplex():
+    import paddle_tpu.distribution as D
+
+    t = D.StickBreakingTransform()
+    x = paddle.to_tensor(rng.standard_normal((5, 3)).astype(np.float32))
+    y = t.forward(x)
+    np.testing.assert_allclose(y.numpy().sum(-1), 1.0, rtol=1e-5)
+    back = t.inverse(y)
+    np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_review_fixes_nn_breadth():
+    # warpctc: raw logits == log-softmax input (internal normalization)
+    T, B, C = 6, 1, 4
+    logits = rng.standard_normal((T, B, C)).astype(np.float32) * 3
+    logp = np.log(scipy.special.softmax(logits, -1))
+    lab = np.asarray([[1, 2]], np.int64)
+    args = (paddle.to_tensor(np.asarray([T])),
+            paddle.to_tensor(np.asarray([2])))
+    l1 = float(nn.CTCLoss()(paddle.to_tensor(logits),
+                            paddle.to_tensor(lab), *args))
+    l2 = float(nn.CTCLoss()(paddle.to_tensor(logp.astype(np.float32)),
+                            paddle.to_tensor(lab), *args))
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+    # empty label: loss = -sum log P(blank)
+    lab0 = np.zeros((1, 2), np.int64)
+    l0 = float(nn.CTCLoss(reduction="none")(
+        paddle.to_tensor(logp.astype(np.float32)), paddle.to_tensor(lab0),
+        paddle.to_tensor(np.asarray([T])),
+        paddle.to_tensor(np.asarray([0]))))
+    np.testing.assert_allclose(l0, -logp[:, 0, 0].sum(), rtol=1e-5)
+    # SoftMarginLoss stable at large margins
+    big = paddle.to_tensor(np.asarray([[-100.0]], np.float32))
+    one = paddle.to_tensor(np.asarray([[1.0]], np.float32))
+    v = float(nn.SoftMarginLoss()(big, one))
+    np.testing.assert_allclose(v, 100.0, rtol=1e-5)
+    # MultiMarginLoss weight applied
+    x = _t(3, 4)
+    lbl = paddle.to_tensor(np.asarray([0, 1, 2]))
+    w = paddle.to_tensor(np.asarray([2.0, 1.0, 1.0, 1.0], np.float32))
+    lw = float(nn.MultiMarginLoss(weight=w)(x, lbl))
+    lu = float(nn.MultiMarginLoss()(x, lbl))
+    assert lw != lu
+    # SigmoidTransform log-det stable in the tail
+    import paddle_tpu.distribution as D
+    ld = D.SigmoidTransform().forward_log_det_jacobian(
+        paddle.to_tensor(np.asarray([-100.0], np.float32)))
+    np.testing.assert_allclose(float(ld), -100.0, rtol=1e-5)
+    # ReshapeTransform log-det reduces all event dims
+    rt = D.ReshapeTransform((2, 3), (6,))
+    ld = rt.forward_log_det_jacobian(_t(5, 2, 3))
+    assert tuple(ld.shape) == (5,)
+    # AvgPool1D exclusive=False divides by the full kernel at borders
+    x1 = paddle.to_tensor(np.ones((1, 1, 4), np.float32))
+    incl = nn.AvgPool1D(3, 1, padding=1, exclusive=False)(x1).numpy()
+    excl = nn.AvgPool1D(3, 1, padding=1, exclusive=True)(x1).numpy()
+    np.testing.assert_allclose(incl[0, 0, 0], 2 / 3, rtol=1e-6)
+    np.testing.assert_allclose(excl[0, 0, 0], 1.0, rtol=1e-6)

@@ -138,7 +138,7 @@ COLLAPSED = {
     "lstm": "nn.rnn LSTM", "gru": "nn.rnn GRU", "gru_unit": "nn.rnn GRUCell",
     "rnn": "nn.rnn RNN", "beam_search": "models.generation",
     "top_p_sampling": "models.generation.sample",
-    "ctc_align": "warpctc roadmap", "warpctc": "loss roadmap",
+    "ctc_align": "warpctc (alignment variant roadmap)",
     "warprnnt": "loss roadmap",
     "crf_decoding": "text roadmap", "viterbi_decode": "text roadmap",
     "chunk_eval": "metric roadmap", "edit_distance": "text roadmap",
