@@ -77,6 +77,7 @@ class GradNode:
     __slots__ = (
         "name",
         "vjp_fn",
+        "raw_f",
         "inputs",
         "out_avals",
         "holder",
@@ -85,9 +86,13 @@ class GradNode:
     )
 
     def __init__(self, name: str, vjp_fn, inputs: Sequence[Any], out_avals,
-                 multi_output: bool = False):
+                 multi_output: bool = False, raw_f=None):
         self.name = name
         self.vjp_fn = vjp_fn
+        # raw_f: the op's pure function of its tensor inputs — kept so
+        # create_graph=True can re-differentiate the backward (the
+        # reference records grad-of-grad nodes, general_grad.h)
+        self.raw_f = raw_f
         self.inputs = list(inputs)  # Tensor objects, aligned with vjp outputs
         self.out_avals = out_avals  # [(shape, dtype)] per forward output
         self.holder: Dict[int, Any] = {}  # out_idx -> accumulated cotangent
@@ -98,22 +103,9 @@ class GradNode:
         cur = self.holder.get(idx)
         self.holder[idx] = grad if cur is None else cur + grad
 
-    def materialize_out_grads(self) -> List[Any]:
-        grads = []
-        for i, (shape, dtype) in enumerate(self.out_avals):
-            g = self.holder.get(i)
-            if g is None:
-                if jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(
-                    dtype, jnp.complexfloating
-                ):
-                    g = jnp.zeros(shape, dtype)
-                else:
-                    g = np.zeros(shape, dtype=float0)
-            grads.append(g)
-        return grads
-
     def release(self):
         self.vjp_fn = None
+        self.raw_f = None
         self.inputs = []
         self.holder = {}
 
@@ -125,12 +117,38 @@ def _is_float0(g) -> bool:
     return getattr(g, "dtype", None) == float0
 
 
+def _vjp_dispatch(node: "GradNode", cot_tensors):
+    """Run a node's backward THROUGH the dispatcher so it records its own
+    GradNodes (create_graph=True; reference general_grad.h grad-of-grad).
+    Inputs of the new op: the node's forward inputs (second-order grads
+    flow through the residuals) + the output cotangents."""
+    from paddle_tpu.ops.registry import OpDef, dispatch
+
+    n_in = len(node.inputs)
+    raw_f = node.raw_f
+    multi = node.multi_output
+
+    def impl(*vals):
+        in_vals, cot_vals = vals[:n_in], vals[n_in:]
+        _, vjp_f = jax.vjp(raw_f, *in_vals)
+        cot = tuple(cot_vals) if multi else cot_vals[0]
+        gs = vjp_f(cot)
+        return tuple(gs) if len(gs) != 1 else gs[0]
+
+    op = OpDef(f"_grad_{node.name}", impl, diff=True, dynamic=True,
+               method=False)
+    out = dispatch(op.name, tuple(node.inputs) + tuple(cot_tensors), {},
+                   _op=op)
+    return out if isinstance(out, tuple) else (out,)
+
+
 def run_backward(
     tensors: Sequence[Any],
     grad_tensors: Sequence[Any] = None,
     retain_graph: bool = False,
     inputs: Optional[Sequence[Any]] = None,
     accumulate_into_grad: bool = True,
+    create_graph: bool = False,
 ):
     """Reverse-mode walk. If `inputs` given, returns their grads (paddle.grad
     semantics, reference general_grad.h); otherwise writes `.grad` on leaves.
@@ -172,6 +190,10 @@ def run_backward(
             gval = jnp.ones(t.shape, t.dtype)
         else:
             gval = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        if create_graph:
+            # cotangents live as graph Tensors so grad math records;
+            # user-provided grad tensors keep their own history
+            gval = g if isinstance(g, Tensor) else Tensor._wrap(gval)
         if t._grad_node is None:
             _accumulate_leaf(t, gval, capture, capture_ids, accumulate_into_grad)
             continue
@@ -202,20 +224,34 @@ def run_backward(
                 f"GradNode {node.name} already released; pass retain_graph=True "
                 "to backward() to allow a second backward pass."
             )
-        out_grads = node.materialize_out_grads()
-        # jax.vjp returns a function of ONE cotangent matching the primal
-        # output structure (tuple for multi-output ops)
-        cot = tuple(out_grads) if node.multi_output else out_grads[0]
-        in_grads = node.vjp_fn(cot)
-        if not isinstance(in_grads, (tuple, list)):
-            in_grads = (in_grads,)
+        if create_graph:
+            # dispatch the backward as a differentiable op over
+            # (forward inputs, cotangents): its outputs carry GradNodes
+            # float0 placeholders (int outputs) stay raw: they are only
+            # valid as cotangents, never as traced primal inputs
+            out_grads = [
+                g if isinstance(g, Tensor) or _is_float0(g)
+                else Tensor._wrap(g)
+                for g in _materialize(node, as_tensor=True)
+            ]
+            in_grads = _vjp_dispatch(node, out_grads)
+        else:
+            out_grads = _materialize(node, as_tensor=False)
+            # jax.vjp takes ONE cotangent matching the primal output
+            # structure (tuple for multi-output ops)
+            cot = tuple(out_grads) if node.multi_output else out_grads[0]
+            in_grads = node.vjp_fn(cot)
+            if not isinstance(in_grads, (tuple, list)):
+                in_grads = (in_grads,)
         for t, g in zip(node.inputs, in_grads):
-            if g is None or _is_float0(g) or t.stop_gradient:
+            raw_g = g._value if isinstance(g, Tensor) else g
+            if raw_g is None or _is_float0(raw_g) or t.stop_gradient:
                 continue
             for hook in t._hooks:
-                new = hook(Tensor._wrap(g))
+                new = hook(g if isinstance(g, Tensor) else Tensor._wrap(g))
                 if new is not None:
-                    g = new._value if isinstance(new, Tensor) else new
+                    g = new if create_graph else (
+                        new._value if isinstance(new, Tensor) else new)
             prod = t._grad_node
             if prod is None:
                 _accumulate_leaf(t, g, capture, capture_ids, accumulate_into_grad)
@@ -225,7 +261,7 @@ def run_backward(
                 cons_count[id(pnode)] -= 1
                 if cons_count[id(pnode)] == 0:
                     queue.append(pnode)
-        if not retain_graph:
+        if not retain_graph and not create_graph:
             node.release()
         else:
             node.holder = {}
@@ -235,17 +271,35 @@ def run_backward(
     return None
 
 
+def _materialize(node: "GradNode", as_tensor: bool):
+    """Accumulated output cotangents, zero-filled for unused outputs."""
+    from paddle_tpu.core.tensor import Tensor
+
+    grads = []
+    for i, (shape, dtype) in enumerate(node.out_avals):
+        g = node.holder.get(i)
+        if g is None:
+            if jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(
+                    dtype, jnp.complexfloating):
+                g = jnp.zeros(shape, dtype)
+                if as_tensor:
+                    g = Tensor._wrap(g)
+            else:
+                g = np.zeros(shape, dtype=float0)
+        grads.append(g)
+    return grads
+
+
 def _accumulate_leaf(t, g, capture, capture_ids, accumulate_into_grad):
     from paddle_tpu.core.tensor import Tensor
 
+    g_t = g if isinstance(g, Tensor) else Tensor._wrap(g)
     if capture_ids is not None and id(t) in capture_ids:
         prev = capture.get(id(t))
-        capture[id(t)] = Tensor._wrap(g if prev is None else prev._value + g)
+        capture[id(t)] = g_t if prev is None else prev + g_t
     if accumulate_into_grad:
-        if t.grad is None:
-            t.grad = Tensor._wrap(g)
-        else:
-            t.grad = Tensor._wrap(t.grad._value + g)
+        t.grad = g_t if t.grad is None else Tensor._wrap(
+            t.grad._value + (g_t._value if isinstance(g_t, Tensor) else g_t))
 
 
 def backward(tensors, grad_tensors=None, retain_graph=False):
@@ -265,12 +319,9 @@ def grad(
     create_graph=False,
     allow_unused=False,
 ):
-    """paddle.grad — partial-graph gradients (reference general_grad.h)."""
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True in eager mode is not supported; use the "
-            "functional API (paddle_tpu.jit) for higher-order AD."
-        )
+    """paddle.grad — partial-graph gradients (reference general_grad.h).
+    With create_graph=True the backward itself records on the tape
+    (grad-of-grad nodes), so the returned grads are differentiable."""
     if not isinstance(outputs, (list, tuple)):
         outputs = [outputs]
     if not isinstance(inputs, (list, tuple)):
@@ -278,13 +329,14 @@ def grad(
     if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
         grad_outputs = [grad_outputs]
     if retain_graph is None:
-        retain_graph = False
+        retain_graph = bool(create_graph)
     res = run_backward(
         outputs,
         grad_outputs,
         retain_graph=retain_graph,
         inputs=inputs,
         accumulate_into_grad=False,
+        create_graph=create_graph,
     )
     if not allow_unused:
         for t, g in zip(inputs, res):

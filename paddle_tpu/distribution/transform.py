@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import math
 
+import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.tensor import Tensor
@@ -233,3 +234,17 @@ class StickBreakingTransform(Transform):
             [jnp.ones(v.shape[:-1] + (1,)), cum[..., :-2]], -1)
         offset = jnp.log(jnp.arange(k - 1, 0, -1.0))
         return Tensor._wrap(jnp.log(z) - jnp.log1p(-z) + offset)
+
+    def forward_log_det_jacobian(self, x):
+        # y_i = z_i * prod_{j<i}(1-z_j): log|J| = sum_i [log z_i(1-z_i)
+        # + log prod_{j<i}(1-z_j)]
+        v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        # same shifted-sigmoid offset as forward()
+        offset = jnp.log(jnp.arange(v.shape[-1], 0, -1.0))
+        a = v - offset
+        logz = jax.nn.log_sigmoid(a)
+        log1mz = jax.nn.log_sigmoid(-a)
+        prefix = jnp.concatenate(
+            [jnp.zeros(v.shape[:-1] + (1,)),
+             jnp.cumsum(log1mz, axis=-1)[..., :-1]], -1)
+        return Tensor._wrap(jnp.sum(logz + log1mz + prefix, axis=-1))

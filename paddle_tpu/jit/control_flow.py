@@ -36,6 +36,52 @@ def _wrap(tree):
         lambda v: Tensor._wrap(v) if hasattr(v, "shape") else v, tree)
 
 
+def _captured_symbolic(fns):
+    """Symbolic Tensors captured in the closures of branch/body functions.
+    The reference's PIR if/while ops auto-capture outer block values as
+    block inputs (control_flow.py); here the captured tensors become hidden
+    inputs of the recorded op, temporarily rebound to traced values while
+    the branch executes."""
+    from paddle_tpu.static.program import is_symbolic
+
+    seen = []
+
+    def add(v):
+        if isinstance(v, Tensor) and is_symbolic(v) and \
+                all(v is not s for s in seen):
+            seen.append(v)
+
+    for fn in fns:
+        for cell in (getattr(fn, "__closure__", None) or ()):
+            try:
+                add(cell.cell_contents)
+            except ValueError:
+                continue
+        code = getattr(fn, "__code__", None)
+        if code is not None:  # module/test-global symbolic tensors
+            for name in code.co_names:
+                add(getattr(fn, "__globals__", {}).get(name))
+    return seen
+
+
+class _bind:
+    """Temporarily swap captured Tensors' values (symbolic -> traced)."""
+
+    def __init__(self, tensors, vals):
+        self.tensors = list(tensors)
+        self.vals = list(vals)
+
+    def __enter__(self):
+        self.saved = [t._value for t in self.tensors]
+        for t, v in zip(self.tensors, self.vals):
+            t._value = v
+
+    def __exit__(self, *a):
+        for t, v in zip(self.tensors, self.saved):
+            t._value = v
+        return False
+
+
 def _lift(fn):
     """Branch/body -> pure fn over jax values. Inner tape recording is off:
     the WHOLE control-flow op records as one node (its vjp differentiates),
@@ -50,11 +96,17 @@ def _lift(fn):
     return pure
 
 
-def _dispatch_ctrl(kind: str, key_fns, impl, tensor_args: tuple):
+def _dispatch_ctrl(kind: str, key_fns, impl, tensor_args: tuple,
+                   diff: bool = True):
     """Route a built control-flow closure through the dispatcher as a
-    differentiable op (same pattern as parallel.recompute). The op returns a
-    FLAT tuple of arrays (dispatch requirement); the result is re-nested to
-    the impl's original structure with Tensor leaves."""
+    differentiable op (same direct-OpDef pattern as parallel.recompute — no
+    OPS registry entry, so per-call closures can't pin the registry). In
+    static-program build mode the symbolic inputs record a Program node
+    carrying this impl, replayed inside the Executor's compiled program
+    (the reference's PIR if/while ops, control_flow.py:755,1637).
+
+    The op returns a FLAT tuple of arrays (dispatch requirement); the
+    result is re-nested to the impl's original structure."""
     treedef_box = [None]
 
     def flat_impl(*vals):
@@ -63,27 +115,44 @@ def _dispatch_ctrl(kind: str, key_fns, impl, tensor_args: tuple):
         treedef_box[0] = treedef
         return tuple(flat) if len(flat) != 1 else flat[0]
 
-    name = f"_{kind}_" + "_".join(str(id(f)) for f in key_fns)
-    if name not in OPS:
-        OPS[name] = OpDef(name, flat_impl, diff=True, dynamic=True,
-                          method=False)
-    else:
-        OPS[name].impl = flat_impl  # rebind: closure captures this call's attrs
-    out = dispatch(name, tensor_args, {})
+    op = OpDef(f"_{kind}", flat_impl, diff=diff, dynamic=True, method=False)
+    out = dispatch(op.name, tensor_args, {}, _op=op)
+    if treedef_box[0] is None:
+        # symbolic recording path: the impl ran only under eval_shape;
+        # recover the structure from a second abstract evaluation
+        import jax as _jax
+
+        vals = jax.tree_util.tree_map(
+            lambda t: _jax.ShapeDtypeStruct(tuple(t.shape), t.dtype)
+            if isinstance(t, Tensor) else t,
+            tensor_args,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        _jax.eval_shape(flat_impl, *vals)
     leaves = list(out) if isinstance(out, tuple) else [out]
     return jax.tree_util.tree_unflatten(treedef_box[0], leaves)
 
 
 def cond(pred, true_fn: Callable, false_fn: Callable, operands=()):
     """paddle.static.nn.cond — both branches traced (XLA requirement), one
-    executed. Differentiable w.r.t. `operands` in both universes."""
-    p = pred._value if isinstance(pred, Tensor) else jnp.asarray(pred)
+    executed. Differentiable w.r.t. `operands` in both universes, and
+    recordable into a static Program when `pred`/`operands` are symbolic
+    (the pred is a tensor INPUT of the op, not a baked closure value).
 
-    def impl(ops_tuple):
-        return lax.cond(p, _lift(true_fn), _lift(false_fn), *ops_tuple)
+    Outer program variables referenced by the branches are auto-captured as
+    hidden op inputs and SNAPSHOTTED at cond() time — rebinding the python
+    variable afterwards does not change the recorded program (same contract
+    as the reference's PIR block capture)."""
+    if not isinstance(pred, Tensor):
+        pred = Tensor._wrap(jnp.asarray(pred))
+    captured = _captured_symbolic((true_fn, false_fn))
+
+    def impl(pred_v, ops_tuple, cap_vals):
+        with _bind(captured, cap_vals):
+            return lax.cond(jnp.squeeze(pred_v), _lift(true_fn),
+                            _lift(false_fn), *ops_tuple)
 
     return _dispatch_ctrl("cond", (true_fn, false_fn), impl,
-                          (tuple(operands),))
+                          (pred, tuple(operands), tuple(captured)))
 
 
 def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence):
@@ -91,8 +160,6 @@ def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence):
     across iterations (XLA static-shape rule); the body may return a list or
     a tuple (both are paddle conventions). Forward-only for reverse-mode AD
     — see module docstring."""
-    init = _unwrap(tuple(loop_vars))
-
     def c(vals):
         out = _lift(cond_fn)(*vals)
         return out if not hasattr(out, "shape") else jnp.squeeze(out)
@@ -103,30 +170,54 @@ def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence):
             return tuple(out)
         return (out,)
 
-    out = lax.while_loop(c, b, init)
-    return list(_wrap(out))
+    captured = _captured_symbolic((cond_fn, body_fn))
+
+    def impl(vars_tuple, cap_vals):
+        with _bind(captured, cap_vals):
+            return lax.while_loop(c, b, vars_tuple)
+
+    # diff=False: lax.while_loop has no VJP (module docstring); recordable
+    # into static Programs like cond
+    out = _dispatch_ctrl("while", (cond_fn, body_fn), impl,
+                         (tuple(loop_vars), tuple(captured)), diff=False)
+    return list(out) if isinstance(out, (tuple, list)) else [out]
 
 
 def switch_case(branch_index, branch_fns, default=None):
     """paddle.static.nn.switch_case. Differentiable w.r.t. closure operands
     is NOT supported (branches take no operands in the paddle API)."""
-    idx = branch_index._value if isinstance(branch_index, Tensor) \
-        else jnp.asarray(branch_index)
+    if not isinstance(branch_index, Tensor):
+        branch_index = Tensor._wrap(jnp.asarray(branch_index))
     if isinstance(branch_fns, dict):
         keys = sorted(branch_fns)
         fns = [branch_fns[k] for k in keys]
-        # map arbitrary keys onto 0..n-1 (+ default at n)
-        idx = sum(jnp.where(idx == k, i, 0) for i, k in enumerate(keys)) \
-            + jnp.where(jnp.isin(idx, jnp.asarray(keys)), 0, len(keys))
         if default is not None:
             fns = fns + [default]
+
+        def impl(idx_v, cap_vals):
+            # map arbitrary keys onto 0..n-1 (+ default at n)
+            mapped = sum(jnp.where(idx_v == k, i, 0)
+                         for i, k in enumerate(keys)) \
+                + jnp.where(jnp.isin(idx_v, jnp.asarray(keys)), 0,
+                            len(keys))
+            with _bind(captured, cap_vals):
+                return lax.switch(jnp.clip(jnp.squeeze(mapped), 0,
+                                           len(fns) - 1),
+                                  [_lift(f) for f in fns])
     else:
         fns = list(branch_fns)
         if default is not None:
             fns = fns + [default]
-    out = lax.switch(jnp.clip(idx, 0, len(fns) - 1),
-                     [_lift(f) for f in fns])
-    return _wrap(out)
+
+        def impl(idx_v, cap_vals):
+            with _bind(captured, cap_vals):
+                return lax.switch(jnp.clip(jnp.squeeze(idx_v), 0,
+                                           len(fns) - 1),
+                                  [_lift(f) for f in fns])
+
+    captured = _captured_symbolic(tuple(fns))
+    return _dispatch_ctrl("switch_case", tuple(fns), impl,
+                          (branch_index, tuple(captured)), diff=False)
 
 
 def scan(body_fn: Callable, init, xs, length=None):

@@ -118,6 +118,7 @@ class _Pool1D(Layer):
         self.k, self.s, self.p = kernel_size, stride, padding
         self.ceil_mode = ceil_mode
         self.exclusive = exclusive
+        self.return_mask = return_mask
 
     def _pool2d(self, x, op, **extra):
         v = x.unsqueeze(2)  # [n, c, 1, L]
@@ -129,6 +130,12 @@ class _Pool1D(Layer):
 
 class MaxPool1D(_Pool1D):
     def forward(self, x):
+        if self.return_mask:
+            out, idx = _C.max_pool2d_with_index(
+                x.unsqueeze(2), (1, self.k),
+                stride=(1, self.s if self.s is not None else self.k),
+                padding=(0, self.p), ceil_mode=self.ceil_mode)
+            return out.squeeze(2), idx.squeeze(2)
         return self._pool2d(x, _C.max_pool2d)
 
 
@@ -171,6 +178,7 @@ class _AdaptivePoolNd(Layer):
     def __init__(self, output_size, return_mask=False):
         super().__init__()
         self.output_size = output_size
+        self.return_mask = return_mask
 
 
 class AdaptiveAvgPool1D(_AdaptivePoolNd):
@@ -182,6 +190,12 @@ class AdaptiveAvgPool1D(_AdaptivePoolNd):
 
 class AdaptiveMaxPool1D(_AdaptivePoolNd):
     def forward(self, x):
+        if self.return_mask:
+            L = x.shape[-1]
+            k = L // self.output_size
+            out, idx = _C.max_pool2d_with_index(x.unsqueeze(2), (1, k),
+                                                stride=(1, k))
+            return out.squeeze(2), idx.squeeze(2)
         v = x.unsqueeze(2)
         out = _C.adaptive_max_pool2d(v, (1, self.output_size))
         return out.squeeze(2)
@@ -204,6 +218,8 @@ class AdaptiveMaxPool3D(_AdaptivePoolNd):
              else (self.output_size,) * 3)
         d, h, w = x.shape[2:]
         k = (d // o[0], h // o[1], w // o[2])
+        if self.return_mask:
+            return _C.max_pool3d_with_index(x, k, stride=k)
         return _C.pool3d(x, k, stride=k, pooling_type="max")
 
 
@@ -411,6 +427,15 @@ class SpectralNorm(Layer):
         self.weight_v.stop_gradient = True
 
     def forward(self, weight):
+        import paddle_tpu as paddle
+        from paddle_tpu.nn.utils import power_iterate
+
+        with paddle.no_grad():
+            w2d = jnp.moveaxis(weight._value, self.dim, 0).reshape(
+                weight.shape[self.dim], -1)
+            nu, nv = power_iterate(w2d, self.weight_u._value,
+                                   self.weight_v._value,
+                                   self.power_iters, self.eps)
+            self.weight_u._value, self.weight_v._value = nu, nv
         return _C.spectral_norm(weight, self.weight_u, self.weight_v,
-                                dim=self.dim, power_iters=self.power_iters,
-                                eps=self.eps)
+                                dim=self.dim, power_iters=0, eps=self.eps)

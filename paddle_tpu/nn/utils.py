@@ -25,17 +25,37 @@ def _norm_except(w: Tensor, dim: int) -> Tensor:
     return _C.sqrt(_C.sum(_C.square(w), axis=list(axes), keepdim=True))
 
 
+def _effective_weight(v: Tensor, g: Tensor, dim: int) -> Tensor:
+    """weight-norm reparameterization g * v/||v|| (single definition shared
+    by the forward hook and remove_weight_norm)."""
+    return v * (g / _norm_except(v, dim))
+
+
+def power_iterate(w2d, u, v, iters: int, eps: float):
+    """Power-iteration update of the spectral u/v vectors (pure jnp; run
+    under no_grad and PERSISTED into the buffers each forward, matching the
+    reference SpectralNorm semantics where one iteration per step
+    converges over training)."""
+    for _ in range(max(iters, 0)):
+        v = w2d.T @ u
+        v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+        u = w2d @ v
+        u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+    return u, v
+
+
 def weight_norm(layer: Layer, name: str = "weight", dim: int = 0) -> Layer:
     """Reparameterize `name` as g * v/||v|| (reference weight_norm_hook.py).
     Adds `{name}_g` and `{name}_v` parameters; the effective weight is
     recomputed before every forward."""
     w = getattr(layer, name)
     dim = dim if dim is not None else 0
+    import paddle_tpu as paddle
+
     g = layer.create_parameter(list(_norm_except(w, dim).shape))
-    with __import__("paddle_tpu").no_grad():
-        g._value = _norm_except(w, dim)._value
     v = layer.create_parameter(list(w.shape))
-    with __import__("paddle_tpu").no_grad():
+    with paddle.no_grad():
+        g._value = _norm_except(w, dim)._value
         v._value = w._value
     setattr(layer, f"{name}_g", g)
     setattr(layer, f"{name}_v", v)
@@ -44,9 +64,8 @@ def weight_norm(layer: Layer, name: str = "weight", dim: int = 0) -> Layer:
         del layer._parameters[name]
 
     def hook(lyr, inputs):
-        vv = getattr(lyr, f"{name}_v")
-        gg = getattr(lyr, f"{name}_g")
-        eff = vv * (gg / _norm_except(vv, dim))
+        eff = _effective_weight(getattr(lyr, f"{name}_v"),
+                                getattr(lyr, f"{name}_g"), dim)
         object.__setattr__(lyr, name, eff)
         return inputs
 
@@ -56,13 +75,14 @@ def weight_norm(layer: Layer, name: str = "weight", dim: int = 0) -> Layer:
 
 
 def remove_weight_norm(layer: Layer, name: str = "weight") -> Layer:
+    import paddle_tpu as paddle
+
     handle, pname, dim = layer._weight_norm_hook
     handle.remove()
-    v = getattr(layer, f"{pname}_v")
-    g = getattr(layer, f"{pname}_g")
-    eff = v * (g / _norm_except(v, dim))
+    eff = _effective_weight(getattr(layer, f"{pname}_v"),
+                            getattr(layer, f"{pname}_g"), dim)
     w = layer.create_parameter(list(eff.shape))
-    with __import__("paddle_tpu").no_grad():
+    with paddle.no_grad():
         w._value = eff._value
     setattr(layer, pname, w)
     for extra in (f"{pname}_v", f"{pname}_g"):
@@ -83,10 +103,12 @@ def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations=1,
             "Conv3DTranspose") else 0
     h = w.shape[dim]
     width = int(np.prod(w.shape)) // h
+    import paddle_tpu as paddle
+
     rng = np.random.default_rng(0)
     u = layer.create_parameter([h])
     v = layer.create_parameter([width])
-    with __import__("paddle_tpu").no_grad():
+    with paddle.no_grad():
         u._value = jnp.asarray(rng.standard_normal(h), jnp.float32)
         v._value = jnp.asarray(rng.standard_normal(width), jnp.float32)
     u.stop_gradient = True
@@ -101,10 +123,21 @@ def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations=1,
         del layer._parameters[name]
 
     def hook(lyr, inputs):
+        import paddle_tpu as paddle
+
         ww = getattr(lyr, f"{name}_orig")
-        eff = _C.spectral_norm(ww, getattr(lyr, f"{name}_u"),
-                               getattr(lyr, f"{name}_v"), dim=dim,
-                               power_iters=n_power_iterations, eps=eps)
+        uu = getattr(lyr, f"{name}_u")
+        vv = getattr(lyr, f"{name}_v")
+        # PERSIST the power-iteration state: with it, the reference's
+        # default of one iteration per forward converges over training
+        with paddle.no_grad():
+            w2d = jnp.moveaxis(ww._value, dim, 0).reshape(
+                ww.shape[dim], -1)
+            nu, nv = power_iterate(w2d, uu._value, vv._value,
+                                   n_power_iterations, eps)
+            uu._value, vv._value = nu, nv
+        eff = _C.spectral_norm(ww, uu, vv, dim=dim, power_iters=0,
+                               eps=eps)
         object.__setattr__(lyr, name, eff)
         return inputs
 

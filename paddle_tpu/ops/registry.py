@@ -227,7 +227,7 @@ def dispatch(name: str, args, kwargs, _op=None):
         if float_out:
             node = engine.GradNode(
                 name, vjp_fn, tensors, [(o.shape, o.dtype) for o in outs],
-                multi_output=multi,
+                multi_output=multi, raw_f=raw_f,
             )
 
     wrapped = []

@@ -385,3 +385,37 @@ def test_review_fixes_nn_breadth():
     excl = nn.AvgPool1D(3, 1, padding=1, exclusive=True)(x1).numpy()
     np.testing.assert_allclose(incl[0, 0, 0], 2 / 3, rtol=1e-6)
     np.testing.assert_allclose(excl[0, 0, 0], 1.0, rtol=1e-6)
+
+
+def test_review_fixes_round2():
+    # spectral_norm converges with the DEFAULT 1 power iteration because
+    # u/v persist across forwards
+    paddle.seed(1)
+    lin = nn.Linear(6, 4)
+    nn.utils.spectral_norm(lin, "weight")  # n_power_iterations=1
+    for _ in range(60):
+        lin(_t(2, 6))
+    sigma = np.linalg.norm(np.asarray(lin.weight.numpy()), 2)
+    np.testing.assert_allclose(sigma, 1.0, rtol=5e-2)
+    # return_mask on 1D/adaptive max pools
+    x = _t(2, 3, 8)
+    out, idx = nn.MaxPool1D(2, 2, return_mask=True)(x)
+    assert out.shape == [2, 3, 4] and idx.shape == [2, 3, 4]
+    out, idx = nn.AdaptiveMaxPool1D(4, return_mask=True)(x)
+    assert out.shape == [2, 3, 4]
+    out, idx = nn.AdaptiveMaxPool3D(2, return_mask=True)(_t(1, 2, 4, 4, 4))
+    assert out.shape == [1, 2, 2, 2, 2]
+    # stick-breaking log-det: numeric jacobian determinant check
+    import paddle_tpu.distribution as D
+    t = D.StickBreakingTransform()
+    xv = np.asarray([0.3, -0.6], np.float32)
+    ld = float(t.forward_log_det_jacobian(paddle.to_tensor(xv)))
+    eps = 1e-3
+    J = np.zeros((2, 2))
+    for i in range(2):
+        xp = xv.copy(); xp[i] += eps
+        xm = xv.copy(); xm[i] -= eps
+        J[:, i] = (t.forward(paddle.to_tensor(xp)).numpy()[:2]
+                   - t.forward(paddle.to_tensor(xm)).numpy()[:2]) / (2 * eps)
+    np.testing.assert_allclose(ld, np.log(abs(np.linalg.det(J))),
+                               rtol=2e-2)

@@ -1,0 +1,120 @@
+"""Static-universe control flow (VERDICT round-1 item #7): cond /
+while_loop / switch_case recorded as Program nodes and replayed inside the
+Executor's compiled program — the reference's PIR if/while ops
+(static/nn/control_flow.py:755,1637).
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+rng = np.random.default_rng(0)
+
+
+def test_static_cond_through_executor():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 8])
+        pred = (x.mean() > 0.0)
+        y = static.nn.cond(pred, lambda: x * 2.0, lambda: x - 10.0)
+        z = y.sum()
+    exe = static.Executor()
+    pos = np.abs(rng.standard_normal((4, 8))).astype("float32")
+    neg = -pos
+    out_pos = exe.run(prog, feed={"x": pos}, fetch_list=[z])[0]
+    out_neg = exe.run(prog, feed={"x": neg}, fetch_list=[z])[0]
+    np.testing.assert_allclose(out_pos, (pos * 2).sum(), rtol=1e-5)
+    np.testing.assert_allclose(out_neg, (neg - 10).sum(), rtol=1e-5)
+
+
+def test_static_cond_with_operands():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [3])
+        y = static.data("y", [3])
+        out = static.nn.cond(x.sum() > y.sum(),
+                             lambda a, b: a - b,
+                             lambda a, b: b - a, operands=(x, y))
+    exe = static.Executor()
+    a = np.asarray([3.0, 3, 3], np.float32)
+    b = np.asarray([1.0, 1, 1], np.float32)
+    got = exe.run(prog, feed={"x": a, "y": b}, fetch_list=[out])[0]
+    np.testing.assert_allclose(got, a - b)
+    got = exe.run(prog, feed={"x": b, "y": a}, fetch_list=[out])[0]
+    np.testing.assert_allclose(got, a - b)  # swapped: still bigger-smaller
+
+
+def test_static_while_loop_through_executor():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2])
+        i = paddle.to_tensor(np.asarray(0, np.int32))
+        # double x until its sum exceeds 100 (data-dependent trip count)
+        out = static.nn.while_loop(
+            lambda i, v: v.sum() < 100.0,
+            lambda i, v: [i + 1, v * 2.0],
+            [i, x])
+        iters, vals = out[0], out[1]
+    exe = static.Executor()
+    res = exe.run(prog, feed={"x": np.asarray([1.0, 1.0], np.float32)},
+                  fetch_list=[iters, vals])
+    # 2 * 2^k >= 100 -> k = 6 (128)
+    assert int(res[0]) == 6
+    np.testing.assert_allclose(res[1], [64.0, 64.0])
+    res = exe.run(prog, feed={"x": np.asarray([40.0, 40.0], np.float32)},
+                  fetch_list=[iters, vals])
+    assert int(res[0]) == 1
+
+
+def test_static_switch_case_through_executor():
+    prog = static.Program()
+    with static.program_guard(prog):
+        idx = static.data("idx", [1], dtype="int32")
+        out = static.nn.switch_case(
+            idx, [lambda: paddle.to_tensor(np.float32(10.0)),
+                  lambda: paddle.to_tensor(np.float32(20.0))],
+            default=lambda: paddle.to_tensor(np.float32(-1.0)))
+    exe = static.Executor()
+    for i, expected in [(0, 10.0), (1, 20.0), (5, -1.0)]:
+        got = exe.run(prog, feed={"idx": np.asarray([i], np.int32)},
+                      fetch_list=[out])[0]
+        np.testing.assert_allclose(got, expected)
+
+
+def test_static_model_with_branch_trains():
+    """The Done criterion: a static net with a data-dependent branch runs
+    through the Executor (clone-for-test etc. untouched)."""
+    paddle.seed(0)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [8, 4])
+        w = paddle.to_tensor(rng.standard_normal((4, 2)).astype("float32"))
+        h = x @ w
+        # scale activations only when their magnitude explodes; captured
+        # `h` is snapshotted at cond() time (keep a distinct result name)
+        h2 = static.nn.cond(h.abs().mean() > 1.0,
+                            lambda: h * 0.5, lambda: h)
+        loss = (h2 ** 2).mean()
+    exe = static.Executor()
+    small = (rng.standard_normal((8, 4)) * 0.01).astype("float32")
+    large = (rng.standard_normal((8, 4)) * 100).astype("float32")
+    l_small = exe.run(prog, feed={"x": small}, fetch_list=[loss])[0]
+    l_large = exe.run(prog, feed={"x": large}, fetch_list=[loss])[0]
+    assert np.isfinite(l_small) and np.isfinite(l_large)
+    assert l_large > l_small
+
+
+def test_eager_cond_gradients_still_flow():
+    x = paddle.to_tensor(np.asarray([2.0, 3.0], np.float32))  # sum > 0
+    x.stop_gradient = False
+    y = static.nn.cond(x.sum() > 0,
+                       lambda a: (a ** 2).sum(),
+                       lambda a: a.sum(), operands=(x,))
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])  # true branch
+    x2 = paddle.to_tensor(np.asarray([-2.0, -3.0], np.float32))
+    x2.stop_gradient = False
+    static.nn.cond(x2.sum() > 0, lambda a: (a ** 2).sum(),
+                   lambda a: a.sum(), operands=(x2,)).backward()
+    np.testing.assert_allclose(x2.grad.numpy(), [1.0, 1.0])  # false branch
