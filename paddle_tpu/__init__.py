@@ -318,3 +318,8 @@ def DataParallel(model, *args, **kwargs):
     from paddle_tpu.parallel.data_parallel import DataParallel as _DP
 
     return _DP(model, *args, **kwargs)
+
+from paddle_tpu import strings  # noqa: F401,E402
+from paddle_tpu.core.selected_rows import (  # noqa: F401,E402
+    SelectedRows, get_tensor_from_selected_rows, merge_selected_rows,
+)

@@ -1,0 +1,104 @@
+"""SelectedRows: the sparse row-gradient tensor.
+
+Reference: paddle/phi/core/selected_rows.h + kernels/selected_rows/ (the
+sparse-gradient representation embedding/adam use for huge vocab tables).
+
+TPU-native reading: inside compiled programs dense scatter-adds are what
+XLA wants (the MXU-side embedding grad IS a dense scatter); SelectedRows
+earns its keep at the FRAMEWORK boundary — optimizer row updates, gradient
+merging, and host-side embedding-table workflows — so the type, its merge
+kernels, and the optimizer row-apply path live here, and Embedding layers
+can opt in with sparse=True.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+
+class SelectedRows:
+    """rows: int64 [n] indices into a [height, ...] dense table;
+    value: [n, ...] the rows' values."""
+
+    def __init__(self, rows, value, height: int):
+        self.rows = jnp.asarray(
+            rows._value if isinstance(rows, Tensor) else rows, jnp.int32)
+        self.value = (value._value if isinstance(value, Tensor)
+                      else jnp.asarray(value))
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return [self.height] + list(self.value.shape[1:])
+
+    def to_dense(self) -> Tensor:
+        """get_tensor_from_selected_rows (phi kernel of the same name)."""
+        dense = jnp.zeros((self.height,) + self.value.shape[1:],
+                          self.value.dtype)
+        return Tensor._wrap(dense.at[self.rows].add(self.value))
+
+    def merge(self) -> "SelectedRows":
+        """merge_selected_rows: dedup rows, summing duplicates (phi
+        MergeSelectedRows kernel — required before optimizer row-apply)."""
+        uniq, inv = np.unique(np.asarray(self.rows), return_inverse=True)
+        merged = jnp.zeros((len(uniq),) + self.value.shape[1:],
+                           self.value.dtype)
+        merged = merged.at[jnp.asarray(inv)].add(self.value)
+        return SelectedRows(jnp.asarray(uniq, jnp.int32), merged,
+                            self.height)
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"rows={np.asarray(self.rows).tolist()[:8]}..., "
+                f"value.shape={tuple(self.value.shape)})")
+
+
+def merge_selected_rows(sr: SelectedRows) -> SelectedRows:
+    return sr.merge()
+
+
+def get_tensor_from_selected_rows(sr: SelectedRows) -> Tensor:
+    return sr.to_dense()
+
+
+def embedding_sparse_grad(weight: Tensor, ids: Tensor, out_grad) -> \
+        SelectedRows:
+    """The embedding backward as SelectedRows (reference selected_rows
+    embedding_grad kernel): rows = the looked-up ids, values = the output
+    cotangents — no [vocab, dim] dense buffer materialized."""
+    idv = ids._value if isinstance(ids, Tensor) else jnp.asarray(ids)
+    g = out_grad._value if isinstance(out_grad, Tensor) \
+        else jnp.asarray(out_grad)
+    flat_ids = idv.reshape(-1)
+    flat_g = g.reshape((int(np.prod(idv.shape)),)
+                       + tuple(g.shape[idv.ndim:]))
+    return SelectedRows(flat_ids.astype(jnp.int32), flat_g,
+                        weight.shape[0]).merge()
+
+
+def apply_rows_sgd(param: Tensor, grad: SelectedRows, lr: float) -> None:
+    """Sparse SGD row update (reference selected_rows sgd kernel): only the
+    touched rows move — the big-vocab embedding optimizer path."""
+    sr = grad.merge()
+    new = param._value.at[sr.rows].add(-lr * sr.value.astype(
+        param._value.dtype))
+    param._value = new
+
+
+def apply_rows_adam(param: Tensor, grad: SelectedRows, m, v, lr: float,
+                    beta1=0.9, beta2=0.999, eps=1e-8, step: int = 1):
+    """Sparse Adam row update (reference selected_rows adam kernel).
+    m/v: dense accumulators [height, ...]; returns updated (m, v)."""
+    sr = grad.merge()
+    g = sr.value.astype(param._value.dtype)
+    m_rows = m[sr.rows] * beta1 + (1 - beta1) * g
+    v_rows = v[sr.rows] * beta2 + (1 - beta2) * g * g
+    mh = m_rows / (1 - beta1 ** step)
+    vh = v_rows / (1 - beta2 ** step)
+    upd = lr * mh / (jnp.sqrt(vh) + eps)
+    param._value = param._value.at[sr.rows].add(-upd)
+    return m.at[sr.rows].set(m_rows), v.at[sr.rows].set(v_rows)

@@ -331,7 +331,10 @@ class Parameter(Tensor):
     """Trainable parameter (reference: paddle EagerParamBase,
     python/paddle/base/framework.py)."""
 
-    __slots__ = ("optimize_attr", "regularizer", "is_distributed", "_sharding")
+    # sparse_grad: SelectedRows left by sparse=True embeddings
+    # (core/selected_rows.py)
+    __slots__ = ("optimize_attr", "regularizer", "is_distributed",
+                 "_sharding", "sparse_grad")
 
     def __init__(self, value, trainable: bool = True, name: str = ""):
         super().__init__(value, stop_gradient=not trainable, name=name)

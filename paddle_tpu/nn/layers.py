@@ -58,12 +58,58 @@ class Embedding(Layer):
         self.num_embeddings = num_embeddings
         self.embedding_dim = embedding_dim
         self.padding_idx = padding_idx
+        self.sparse = sparse
         w_init, w_shard = _init_from_attr(weight_attr, I.Normal(0.0, 1.0))
         self.weight = self.create_parameter(
             [num_embeddings, embedding_dim], default_initializer=w_init,
             attr={"sharding": w_shard} if w_shard else None)
+        if sparse:
+            # sparse=True: alongside the dense .grad, backward also leaves
+            # a SelectedRows grad (rows = the batch's ids) on
+            # weight.sparse_grad for the selected_rows optimizer kernels
+            # (reference selected_rows embedding_grad; see
+            # core/selected_rows.py for the TPU collapse rationale)
+            self.weight.sparse_grad = None
+
+            def to_selected_rows(g):
+                # the hook sees each DENSE weight-grad contribution;
+                # restrict it to the union of rows touched since the last
+                # accumulation cycle and MERGE across contributions
+                # (multiple forwards before one backward — reference
+                # selected_rows embedding_grad semantics)
+                import jax.numpy as _jnp
+                import numpy as _np
+
+                from paddle_tpu.core.selected_rows import SelectedRows
+
+                if self._pending_ids:
+                    rows = _np.unique(_np.concatenate(
+                        [_np.asarray(i._value).reshape(-1)
+                         for i in self._pending_ids]))
+                    sr = SelectedRows(rows.astype(_np.int32),
+                                      g._value[rows],
+                                      self.weight.shape[0])
+                    prev = self.weight.sparse_grad
+                    if prev is not None:
+                        sr = SelectedRows(
+                            _jnp.concatenate([prev.rows, sr.rows]),
+                            _jnp.concatenate([prev.value, sr.value]),
+                            self.weight.shape[0]).merge()
+                    self.weight.sparse_grad = sr
+                self._cycle_done = True
+                return None  # dense grad flows unchanged
+
+            self.weight.register_hook(to_selected_rows)
+            self._pending_ids = []
+            self._cycle_done = False
 
     def forward(self, x):
+        if self.sparse:
+            if self._cycle_done:  # first forward after a backward
+                self._pending_ids = []
+                self.weight.sparse_grad = None
+                self._cycle_done = False
+            self._pending_ids.append(x)
         return F.embedding(x, self.weight, padding_idx=self.padding_idx)
 
     def extra_repr(self):

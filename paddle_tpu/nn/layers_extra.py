@@ -192,6 +192,11 @@ class AdaptiveMaxPool1D(_AdaptivePoolNd):
     def forward(self, x):
         if self.return_mask:
             L = x.shape[-1]
+            if L % self.output_size:
+                raise ValueError(
+                    "AdaptiveMaxPool1D(return_mask=True) requires the "
+                    f"input length ({L}) to divide output_size "
+                    f"({self.output_size})")
             k = L // self.output_size
             out, idx = _C.max_pool2d_with_index(x.unsqueeze(2), (1, k),
                                                 stride=(1, k))
@@ -427,9 +432,18 @@ class SpectralNorm(Layer):
         self.weight_v.stop_gradient = True
 
     def forward(self, weight):
+        import jax as _jax
+
         import paddle_tpu as paddle
         from paddle_tpu.nn.utils import power_iterate
 
+        if isinstance(weight._value, _jax.core.Tracer):
+            # under tracing: keep the iteration inside the traced program,
+            # never persist tracer values into the buffers
+            return _C.spectral_norm(weight, self.weight_u, self.weight_v,
+                                    dim=self.dim,
+                                    power_iters=self.power_iters,
+                                    eps=self.eps)
         with paddle.no_grad():
             w2d = jnp.moveaxis(weight._value, self.dim, 0).reshape(
                 weight.shape[self.dim], -1)

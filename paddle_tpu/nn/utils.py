@@ -123,21 +123,29 @@ def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations=1,
         del layer._parameters[name]
 
     def hook(lyr, inputs):
+        import jax as _jax
+
         import paddle_tpu as paddle
 
         ww = getattr(lyr, f"{name}_orig")
         uu = getattr(lyr, f"{name}_u")
         vv = getattr(lyr, f"{name}_v")
-        # PERSIST the power-iteration state: with it, the reference's
-        # default of one iteration per forward converges over training
-        with paddle.no_grad():
-            w2d = jnp.moveaxis(ww._value, dim, 0).reshape(
-                ww.shape[dim], -1)
-            nu, nv = power_iterate(w2d, uu._value, vv._value,
-                                   n_power_iterations, eps)
-            uu._value, vv._value = nu, nv
-        eff = _C.spectral_norm(ww, uu, vv, dim=dim, power_iters=0,
-                               eps=eps)
+        if isinstance(ww._value, _jax.core.Tracer):
+            # traced forward: iterate inside the program, never persist
+            # tracer values into the buffers
+            eff = _C.spectral_norm(ww, uu, vv, dim=dim,
+                                   power_iters=n_power_iterations, eps=eps)
+        else:
+            # PERSIST the power-iteration state: the reference's default
+            # of one iteration per forward converges over training
+            with paddle.no_grad():
+                w2d = jnp.moveaxis(ww._value, dim, 0).reshape(
+                    ww.shape[dim], -1)
+                nu, nv = power_iterate(w2d, uu._value, vv._value,
+                                       n_power_iterations, eps)
+                uu._value, vv._value = nu, nv
+            eff = _C.spectral_norm(ww, uu, vv, dim=dim, power_iters=0,
+                                   eps=eps)
         object.__setattr__(lyr, name, eff)
         return inputs
 

@@ -21,6 +21,7 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec
 
@@ -254,26 +255,73 @@ def send_in(x, axis: str, dst_offset: int = 1):
     return lax.ppermute(x, axis, perm)
 
 
-def batch_isend_irecv(p2p_op_list):
-    """Not implementable faithfully in single-process SPMD: there is no
-    out-of-band p2p channel between "ranks" of one XLA program. Inside
-    compiled code use `send_in` (ppermute) on a mesh axis — the pipeline
-    module (parallel/pipeline.py) shows the pattern."""
-    raise NotImplementedError(
-        "point-to-point send/recv maps onto lax.ppermute inside compiled "
-        "programs: use paddle_tpu.parallel.collective.send_in (see "
-        "parallel/pipeline.py) instead of batch_isend_irecv")
+# Eager multi-process p2p rides the bootstrap TCPStore (the Gloo-class
+# fallback channel: correct, host-side, not ICI-fast). Inside compiled
+# programs p2p is lax.ppermute on a mesh axis (`send_in`; the pipeline
+# module shows the pattern) — that is the TPU-native fast path.
+
+_P2P_SEQ: dict = {}
+
+
+def _p2p_store():
+    from paddle_tpu.parallel import env as _env
+    from paddle_tpu.parallel.store import create_or_get_global_tcp_store
+
+    if not _env.is_initialized() or _env.get_world_size() <= 1:
+        raise RuntimeError(
+            "eager send/recv needs a multi-process launch world "
+            "(paddle_tpu.parallel.launch + init_parallel_env); inside "
+            "compiled programs use parallel.collective.send_in "
+            "(lax.ppermute — see parallel/pipeline.py)")
+    return create_or_get_global_tcp_store(), _env.get_rank()
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    """See batch_isend_irecv: p2p is a compiled-program concept on TPU."""
-    raise NotImplementedError(
-        "use paddle_tpu.parallel.collective.send_in inside compiled code")
+    """Eager p2p over the store (reference distributed.send; the
+    reference's Gloo CPU path plays the same role off-NCCL)."""
+    import pickle
+
+    store, rank = _p2p_store()
+    seq = _P2P_SEQ.setdefault(("s", rank, dst), 0)
+    _P2P_SEQ[("s", rank, dst)] = seq + 1
+    arr = np.asarray(tensor._value if isinstance(tensor, Tensor)
+                     else tensor)
+    store.set(f"p2p/{rank}->{dst}/{seq}",
+              pickle.dumps((arr.dtype.str, arr.shape, arr.tobytes())))
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "use paddle_tpu.parallel.collective.send_in inside compiled code")
+    """Blocking receive; writes into `tensor` and returns it."""
+    import pickle
+
+    store, rank = _p2p_store()
+    seq = _P2P_SEQ.setdefault(("r", src, rank), 0)
+    _P2P_SEQ[("r", src, rank)] = seq + 1
+    key = f"p2p/{src}->{rank}/{seq}"
+    store.wait([key])
+    dtype, shape, raw = pickle.loads(store.get(key))
+    try:
+        store.delete_key(key)  # bounded store; stale keys can't resurrect
+    except Exception:
+        pass
+    arr = np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(shape)
+    if isinstance(tensor, Tensor):
+        tensor._value = jnp.asarray(arr)
+        return tensor
+    return jnp.asarray(arr)
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Execute a batch of P2POps over the store channel (reference
+    batch_isend_irecv). Sends run first so paired recvs can't deadlock
+    within one rank's batch."""
+    for op in p2p_op_list:
+        if op.op in ("isend", "send"):
+            send(op.tensor, op.peer)
+    for op in p2p_op_list:
+        if op.op in ("irecv", "recv"):
+            recv(op.tensor, op.peer)
+    return []
 
 
 isend = send

@@ -32,20 +32,38 @@ def init_parallel_env(strategy=None):
         addr = os.environ.get("MASTER_ADDR", "127.0.0.1")
         port = os.environ.get("MASTER_PORT", "8471")
         rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
-        jax.distributed.initialize(
-            coordinator_address=f"{addr}:{port}",
-            num_processes=nnodes,
-            process_id=rank,
-        )
+        try:
+            jax.distributed.initialize(
+                coordinator_address=f"{addr}:{port}",
+                num_processes=nnodes,
+                process_id=rank,
+            )
+        except RuntimeError as e:
+            if "must be called before" not in str(e):
+                raise  # real coordinator failure: surface it
+            # backend already initialized (e.g. arrays created at import).
+            # The store-backed world (rendezvous, eager send/recv, launcher
+            # heartbeats) works regardless; only jax multi-host arrays need
+            # the coordination service, and get_rank/world fall back to the
+            # launcher env contract.
+            global _env_world
+            _env_world = (rank, nnodes)
     _initialized = True
+
+
+_env_world = None
 
 
 def get_rank() -> int:
     """Host-process index (reference: paddle.distributed.get_rank)."""
+    if _env_world is not None:
+        return _env_world[0]
     return jax.process_index()
 
 
 def get_world_size() -> int:
+    if _env_world is not None:
+        return _env_world[1]
     return jax.process_count()
 
 

@@ -1,0 +1,75 @@
+"""String tensors + kernels.
+
+Reference: paddle/phi/core/string_tensor.h + kernels/strings/ (the phi
+strings surface is small: lower/upper case conversion with an optional
+utf8 mode, plus construction/copy).
+
+TPU-native reading: strings never touch the MXU — the reference runs
+these kernels on CPU too. StringTensor here wraps a numpy object array on
+host with the same API shape (shape/numpy/lower/upper), keeping parity for
+text preprocessing pipelines feeding tokenized int tensors to the device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class StringTensor:
+    """A host-side tensor of python strings (phi StringTensor analogue)."""
+
+    def __init__(self, data, name: str = ""):
+        if isinstance(data, StringTensor):
+            self._data = data._data.copy()
+        else:
+            self._data = np.asarray(data, dtype=object)
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    def numpy(self) -> np.ndarray:
+        return self._data
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        return StringTensor(out) if isinstance(out, np.ndarray) else out
+
+    def __len__(self):
+        return len(self._data)
+
+    def __eq__(self, other):
+        o = other._data if isinstance(other, StringTensor) else other
+        return np.asarray(self._data == o)
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data!r})"
+
+
+def _map(st: StringTensor, fn) -> StringTensor:
+    flat = [fn(s) for s in st._data.reshape(-1)]
+    return StringTensor(
+        np.asarray(flat, dtype=object).reshape(st._data.shape))
+
+
+def to_string_tensor(data, name: str = "") -> StringTensor:
+    """Construction kernel (phi strings empty/copy family)."""
+    return StringTensor(data, name)
+
+
+def lower(st: StringTensor, use_utf8_encoding: bool = False) -> StringTensor:
+    """phi strings lower kernel. use_utf8_encoding=False restricts to
+    ASCII case folding like the reference's charcases mode."""
+    if use_utf8_encoding:
+        return _map(st, str.lower)
+    return _map(st, lambda s: "".join(
+        c.lower() if ord(c) < 128 else c for c in s))
+
+
+def upper(st: StringTensor, use_utf8_encoding: bool = False) -> StringTensor:
+    """phi strings upper kernel."""
+    if use_utf8_encoding:
+        return _map(st, str.upper)
+    return _map(st, lambda s: "".join(
+        c.upper() if ord(c) < 128 else c for c in s))

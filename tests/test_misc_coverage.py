@@ -104,8 +104,10 @@ def test_fleet_groups_and_env():
         dist.set_mesh(None)
 
 
-def test_onnx_stub_points_to_stablehlo():
-    with pytest.raises(NotImplementedError, match="StableHLO"):
+def test_onnx_export_requires_input_spec():
+    # onnx.export is real now (see test_onnx_sr_strings.py); without an
+    # input_spec it cannot trace and must say so
+    with pytest.raises(ValueError, match="input_spec"):
         paddle.onnx.export(nn.Linear(2, 2), "/tmp/x")
 
 

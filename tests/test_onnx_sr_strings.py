@@ -1,0 +1,286 @@
+"""ONNX export, SelectedRows, strings tensors, and eager p2p (VERDICT
+round-1 items #8/#9 + weak #74)."""
+
+import pickle
+import struct
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+
+rng = np.random.default_rng(0)
+
+
+# --------------------------------------------------------------------- onnx
+
+def _read_varint(buf, i):
+    out = shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+
+
+def _walk(buf):
+    """Minimal protobuf wire reader: yields (field, wire, payload)."""
+    i = 0
+    while i < len(buf):
+        tag, i = _read_varint(buf, i)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            val, i = _read_varint(buf, i)
+            yield field, wire, val
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            yield field, wire, buf[i:i + ln]
+            i += ln
+        elif wire == 5:
+            yield field, wire, buf[i:i + 4]
+            i += 4
+        else:
+            raise ValueError(f"wire {wire}")
+
+
+def test_onnx_export_mlp(tmp_path):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4),
+                        nn.Softmax())
+    path = paddle.onnx.export(net, str(tmp_path / "mlp"),
+                              input_spec=[static.InputSpec([-1, 8])])
+    buf = open(path, "rb").read()
+    fields = dict()
+    graph = None
+    opset = None
+    for f, w, v in _walk(buf):
+        fields[f] = v
+        if f == 7:
+            graph = v
+        if f == 8:
+            opset = v
+    assert graph is not None and opset is not None
+    assert fields[1] == 8  # ir_version
+    node_ops = []
+    n_inits = n_inputs = n_outputs = 0
+    for f, w, v in _walk(graph):
+        if f == 1:  # node
+            for f2, w2, v2 in _walk(v):
+                if f2 == 4:
+                    node_ops.append(v2.decode())
+        elif f == 5:
+            n_inits += 1
+        elif f == 11:
+            n_inputs += 1
+        elif f == 12:
+            n_outputs += 1
+    # Linear = MatMul+Add; the graph: 2x(MatMul,Add), Relu, Softmax
+    assert node_ops.count("MatMul") == 2
+    assert node_ops.count("Add") == 2
+    assert "Relu" in node_ops and "Softmax" in node_ops
+    assert n_inits == 4          # 2 weights + 2 biases
+    assert n_inputs == 1 and n_outputs == 1
+
+
+def test_onnx_export_initializer_values(tmp_path):
+    paddle.seed(1)
+    lin = nn.Linear(3, 2)
+    path = paddle.onnx.export(lin, str(tmp_path / "lin"),
+                              input_spec=[static.InputSpec([1, 3])])
+    buf = open(path, "rb").read()
+    raws = []
+    for f, w, v in _walk(buf):
+        if f == 7:
+            for f2, w2, t in _walk(v):
+                if f2 == 5:  # initializer TensorProto
+                    fields = {}
+                    dims = []
+                    for f3, w3, v3 in _walk(t):
+                        if f3 == 1:
+                            dims.append(v3)
+                        elif f3 == 9:
+                            fields["raw"] = v3
+                    raws.append((dims, fields.get("raw")))
+    vals = {tuple(d): np.frombuffer(r, np.float32) for d, r in raws}
+    np.testing.assert_allclose(vals[(3, 2)],
+                               lin.weight.numpy().reshape(-1), rtol=1e-6)
+    np.testing.assert_allclose(vals[(2,)], lin.bias.numpy(), rtol=1e-6)
+
+
+def test_onnx_unsupported_op_raises(tmp_path):
+    class Weird(nn.Layer):
+        def forward(self, x):
+            return paddle._C_ops.erfinv(x)
+
+    with pytest.raises(NotImplementedError, match="erfinv"):
+        paddle.onnx.export(Weird(), str(tmp_path / "w"),
+                           input_spec=[static.InputSpec([2, 2])])
+
+
+# ------------------------------------------------------------- SelectedRows
+
+def test_selected_rows_roundtrip():
+    sr = paddle.SelectedRows([1, 3, 1], np.asarray(
+        [[1.0, 1], [2, 2], [5, 5]], np.float32), height=5)
+    dense = sr.to_dense().numpy()
+    assert dense.shape == (5, 2)
+    np.testing.assert_allclose(dense[1], [6, 6])  # duplicate rows summed
+    np.testing.assert_allclose(dense[3], [2, 2])
+    m = paddle.merge_selected_rows(sr)
+    assert sorted(np.asarray(m.rows).tolist()) == [1, 3]
+    np.testing.assert_allclose(
+        paddle.get_tensor_from_selected_rows(m).numpy(), dense)
+
+
+def test_sparse_embedding_grad():
+    from paddle_tpu.core.selected_rows import apply_rows_sgd
+
+    paddle.seed(0)
+    emb = nn.Embedding(10, 4, sparse=True)
+    w0 = emb.weight.numpy().copy()
+    ids = paddle.to_tensor(np.asarray([[1, 3], [1, 7]]))
+    out = emb(ids)
+    out.sum().backward()
+    sr = emb.weight.sparse_grad
+    assert sr is not None
+    assert sorted(np.asarray(sr.rows).tolist()) == [1, 3, 7]
+    # SelectedRows grad == dense grad on the touched rows
+    dense_g = emb.weight.grad.numpy()
+    np.testing.assert_allclose(sr.to_dense().numpy(), dense_g, rtol=1e-6)
+    # row-sparse SGD touches only those rows
+    apply_rows_sgd(emb.weight, sr, lr=0.5)
+    w1 = emb.weight.numpy()
+    np.testing.assert_allclose(w1[0], w0[0])
+    np.testing.assert_allclose(w1[1], w0[1] - 0.5 * dense_g[1], rtol=1e-5)
+
+
+def test_sparse_adam_rows():
+    from paddle_tpu.core.selected_rows import SelectedRows, apply_rows_adam
+
+    p = paddle.to_tensor(np.zeros((6, 3), np.float32))
+    m = jnp.zeros((6, 3))
+    v = jnp.zeros((6, 3))
+    sr = SelectedRows([2], np.ones((1, 3), np.float32), 6)
+    m, v = apply_rows_adam(p, sr, m, v, lr=0.1)
+    assert np.abs(p.numpy()[2]).sum() > 0
+    np.testing.assert_allclose(p.numpy()[[0, 1, 3, 4, 5]], 0.0)
+
+
+# ------------------------------------------------------------------ strings
+
+def test_string_tensor_kernels():
+    st = paddle.strings.to_string_tensor([["Hello WORLD", "Ähnlich Ok"]])
+    assert st.shape == [1, 2]
+    low = paddle.strings.lower(st)
+    assert low.numpy()[0, 0] == "hello world"
+    # ascii mode leaves non-ascii chars untouched (phi charcases mode)
+    assert low.numpy()[0, 1] == "Ähnlich ok"
+    lowu = paddle.strings.lower(st, use_utf8_encoding=True)
+    assert lowu.numpy()[0, 1] == "ähnlich ok"
+    up = paddle.strings.upper(st)
+    assert up.numpy()[0, 0] == "HELLO WORLD"
+    assert (st == st).all()
+
+
+# ---------------------------------------------------------------- eager p2p
+
+def test_send_recv_requires_world():
+    with pytest.raises(RuntimeError, match="multi-process launch world"):
+        paddle.distributed.send(paddle.to_tensor(np.ones(2, "float32")), 1)
+
+
+def test_send_recv_over_store_two_processes(tmp_path):
+    """Two real processes exchange tensors through the native TCP store."""
+    import subprocess
+    import sys
+
+    script = tmp_path / "p2p_worker.py"
+    script.write_text(
+        """
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.parallel import env as penv
+from paddle_tpu.parallel import collective as C
+
+penv.init_parallel_env()
+rank = penv.get_rank()
+if rank == 0:
+    t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    C.send(t, dst=1)
+    back = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    C.recv(back, src=1)
+    assert np.allclose(back.numpy(), 2 * np.arange(6).reshape(2, 3))
+    print("RANK0 OK")
+else:
+    buf = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    C.recv(buf, src=0)
+    C.send(buf * 2.0, dst=0)
+    print("RANK1 OK")
+""")
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    procs = []
+    for rank in range(2):
+        env = dict(
+            __import__("os").environ,
+            PADDLE_TRAINER_ID=str(rank), PADDLE_TRAINERS_NUM="2",
+            MASTER_ADDR="127.0.0.1", MASTER_PORT=str(port),
+            PYTHONPATH="/root/repo",
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = [p.communicate(timeout=120)[0].decode() for p in procs]
+    assert all(p.returncode == 0 for p in procs), "\n====\n".join(outs)
+    assert "RANK0 OK" in outs[0] and "RANK1 OK" in outs[1]
+
+
+def test_sparse_embedding_two_forwards():
+    """Multiple forwards before one backward merge their sparse grads
+    (review finding)."""
+    paddle.seed(2)
+    emb = nn.Embedding(20, 4, sparse=True)
+    a = paddle.to_tensor(np.asarray([[1, 2]]))
+    b = paddle.to_tensor(np.asarray([[2, 5]]))
+    (emb(a).sum() + emb(b).sum()).backward()
+    sr = emb.weight.sparse_grad
+    assert sorted(np.asarray(sr.rows).tolist()) == [1, 2, 5]
+    np.testing.assert_allclose(sr.to_dense().numpy(),
+                               emb.weight.grad.numpy(), rtol=1e-6)
+
+
+def test_onnx_reducesum_axes_as_input(tmp_path):
+    class Net(nn.Layer):
+        def forward(self, x):
+            return x.sum(axis=1)
+
+    path = paddle.onnx.export(Net(), str(tmp_path / "rs"),
+                              input_spec=[static.InputSpec([2, 3])])
+    buf = open(path, "rb").read()
+    # the ReduceSum node must carry TWO inputs (data + axes initializer)
+    for f, w, v in _walk(buf):
+        if f == 7:
+            for f2, w2, nd in _walk(v):
+                if f2 == 1:
+                    ins = []
+                    op = None
+                    for f3, w3, v3 in _walk(nd):
+                        if f3 == 1:
+                            ins.append(v3)
+                        if f3 == 4:
+                            op = v3.decode()
+                    if op == "ReduceSum":
+                        assert len(ins) == 2
+                        return
+    raise AssertionError("no ReduceSum node found")
