@@ -2,3 +2,8 @@
 from paddle_tpu.models.gpt import (  # noqa: F401
     GPT, GPTBlock, GPTConfig, build_pipeline_train_step, gpt_loss_fn,
 )
+from paddle_tpu.models.ernie import (  # noqa: F401
+    ErnieConfig, ErnieForPretraining, ErnieForSequenceClassification,
+    ErnieForTokenClassification, ErnieModel, ernie_pretrain_loss_fn,
+    mask_tokens,
+)
