@@ -1,0 +1,465 @@
+// Parameter-server sparse table service.
+//
+// Reference: paddle/fluid/distributed/ps/ — BrpcPsServer/Client
+// (ps/service/brpc_ps_server.h), MemorySparseTable (ps/table/
+// memory_sparse_table.h) with per-row accessors (ctr_accessor), serving
+// trillion-parameter embeddings from host RAM over RPC.
+//
+// TPU-native redesign: the dense math lives on the TPU in XLA programs; the
+// sparse embedding world stays a host-RAM keyed table behind a small TCP
+// service (DCN in a pod). brpc collapses to the same length-prefixed socket
+// protocol the TCPStore uses (tcp_store.cpp); accessors collapse to per-row
+// optimizer rules (sgd / adagrad / adam) applied at PUSH time, so a pull
+// always returns ready-to-embed weights.
+//
+// Concurrency: keys are hash-sharded across NSHARD sub-tables, each with its
+// own mutex — concurrent PULL/PUSH from many trainer threads scale without a
+// global lock. Rows are lazily initialized (uniform [-init, init], per-key
+// deterministic seed, so every trainer pulling key k first sees the same
+// vector).
+//
+// Protocol (little-endian, one request per round-trip):
+//   u8 op | u32 table_id | u32 nkeys | i64 keys[n] | u32 payload_len | bytes
+//   ops: 1=CREATE (payload: u32 dim | u8 opt | f32 lr | f32 init)
+//        2=PULL   (-> f32 values[n*dim])
+//        3=PUSH   (payload: f32 grads[n*dim])
+//        4=STAT   (-> u64 nrows)
+//        5=SAVE   (payload: path -> u64 nrows written)
+//        6=LOAD   (payload: path -> u64 nrows read)
+//        7=CLEAR
+//   response: u32 len | bytes
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kNumShards = 16;
+
+enum Op : uint8_t {
+  kCreate = 1,
+  kPull = 2,
+  kPush = 3,
+  kStat = 4,
+  kSave = 5,
+  kLoad = 6,
+  kClear = 7,
+};
+
+enum Optim : uint8_t { kSGD = 0, kAdagrad = 1, kAdam = 2 };
+
+bool read_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// xorshift-style per-key deterministic init so every trainer sees the same
+// first-pull vector without any cross-trainer coordination.
+float init_val(int64_t key, uint32_t i, float range) {
+  uint64_t x = static_cast<uint64_t>(key) * 0x9E3779B97F4A7C15ull + i + 1;
+  x ^= x >> 33;
+  x *= 0xFF51AFD7ED558CCDull;
+  x ^= x >> 33;
+  double u = static_cast<double>(x >> 11) / 9007199254740992.0;  // [0,1)
+  return static_cast<float>((2.0 * u - 1.0) * range);
+}
+
+struct Row {
+  std::vector<float> w;
+  std::vector<float> m;  // adagrad G / adam m
+  std::vector<float> v;  // adam v
+  int64_t step = 0;
+};
+
+struct Shard {
+  std::mutex mu;
+  std::unordered_map<int64_t, Row> rows;
+};
+
+struct Table {
+  uint32_t dim = 0;
+  uint8_t opt = kSGD;
+  float lr = 0.01f;
+  float init = 0.01f;
+  Shard shards[kNumShards];
+
+  Shard& shard(int64_t key) {
+    return shards[static_cast<uint64_t>(key) % kNumShards];
+  }
+
+  Row& row(Shard& s, int64_t key) {
+    auto it = s.rows.find(key);
+    if (it != s.rows.end()) return it->second;
+    Row r;
+    r.w.resize(dim);
+    for (uint32_t i = 0; i < dim; ++i) r.w[i] = init_val(key, i, init);
+    return s.rows.emplace(key, std::move(r)).first->second;
+  }
+
+  void update(Row& r, const float* g) {
+    switch (opt) {
+      case kSGD:
+        for (uint32_t i = 0; i < dim; ++i) r.w[i] -= lr * g[i];
+        break;
+      case kAdagrad: {
+        if (r.m.empty()) r.m.assign(dim, 0.f);
+        for (uint32_t i = 0; i < dim; ++i) {
+          r.m[i] += g[i] * g[i];
+          r.w[i] -= lr * g[i] / (std::sqrt(r.m[i]) + 1e-8f);
+        }
+        break;
+      }
+      case kAdam: {
+        if (r.m.empty()) {
+          r.m.assign(dim, 0.f);
+          r.v.assign(dim, 0.f);
+        }
+        r.step += 1;
+        const float b1 = 0.9f, b2 = 0.999f;
+        float c1 = 1.f - std::pow(b1, static_cast<float>(r.step));
+        float c2 = 1.f - std::pow(b2, static_cast<float>(r.step));
+        for (uint32_t i = 0; i < dim; ++i) {
+          r.m[i] = b1 * r.m[i] + (1 - b1) * g[i];
+          r.v[i] = b2 * r.v[i] + (1 - b2) * g[i] * g[i];
+          r.w[i] -= lr * (r.m[i] / c1) / (std::sqrt(r.v[i] / c2) + 1e-8f);
+        }
+        break;
+      }
+    }
+  }
+
+  size_t size() {
+    size_t n = 0;
+    for (auto& s : shards) {
+      std::lock_guard<std::mutex> lk(s.mu);
+      n += s.rows.size();
+    }
+    return n;
+  }
+};
+
+struct PsServer {
+  int listen_fd = -1;
+  std::mutex tables_mu;
+  std::unordered_map<uint32_t, Table> tables;
+  std::thread accept_thread;
+  std::mutex fds_mu;
+  std::vector<int> client_fds;
+  std::atomic<int> active_clients{0};
+
+  Table* table(uint32_t id) {
+    std::lock_guard<std::mutex> lk(tables_mu);
+    auto it = tables.find(id);
+    return it == tables.end() ? nullptr : &it->second;
+  }
+
+  void handle_client(int fd) {
+    std::vector<int64_t> keys;
+    std::vector<char> payload, resp;
+    for (;;) {
+      uint8_t op;
+      uint32_t tid, nkeys, plen;
+      if (!read_all(fd, &op, 1) || !read_all(fd, &tid, 4) ||
+          !read_all(fd, &nkeys, 4))
+        break;
+      // sanity caps: a desynced client must not drive multi-GB allocations
+      // (bad_alloc escaping a worker thread would std::terminate the server)
+      if (nkeys > (1u << 24)) break;
+      keys.resize(nkeys);
+      if (nkeys && !read_all(fd, keys.data(), size_t{nkeys} * 8)) break;
+      if (!read_all(fd, &plen, 4)) break;
+      if (plen > (1u << 30)) break;
+      payload.resize(plen);
+      if (plen && !read_all(fd, payload.data(), plen)) break;
+      resp.clear();
+      std::string err;
+
+      switch (op) {
+        case kCreate: {
+          if (plen < 13) {
+            err = "CREATE: short payload";
+            break;
+          }
+          uint32_t dim;
+          uint8_t optim;
+          float lr, init;
+          std::memcpy(&dim, payload.data(), 4);
+          std::memcpy(&optim, payload.data() + 4, 1);
+          std::memcpy(&lr, payload.data() + 5, 4);
+          std::memcpy(&init, payload.data() + 9, 4);
+          std::lock_guard<std::mutex> lk(tables_mu);
+          Table& t = tables[tid];
+          if (t.dim != 0 && t.dim != dim) {
+            // re-creating with a different dim would leave old rows whose
+            // vectors mismatch the new dim (OOB on pull/push) — refuse
+            err = "CREATE: table exists with different dim";
+            break;
+          }
+          t.dim = dim;
+          t.opt = optim;
+          t.lr = lr;
+          t.init = init;
+          break;
+        }
+        case kPull: {
+          Table* t = table(tid);
+          if (!t || t->dim == 0) {
+            err = "PULL: no such table";
+            break;
+          }
+          resp.resize(static_cast<size_t>(nkeys) * t->dim * 4);
+          float* out = reinterpret_cast<float*>(resp.data());
+          for (uint32_t i = 0; i < nkeys; ++i) {
+            Shard& s = t->shard(keys[i]);
+            std::lock_guard<std::mutex> lk(s.mu);
+            Row& r = t->row(s, keys[i]);
+            std::memcpy(out + static_cast<size_t>(i) * t->dim, r.w.data(),
+                        t->dim * 4);
+          }
+          break;
+        }
+        case kPush: {
+          Table* t = table(tid);
+          if (!t || t->dim == 0) {
+            err = "PUSH: no such table";
+            break;
+          }
+          if (plen != static_cast<size_t>(nkeys) * t->dim * 4) {
+            err = "PUSH: grads size mismatch";
+            break;
+          }
+          const float* g = reinterpret_cast<const float*>(payload.data());
+          for (uint32_t i = 0; i < nkeys; ++i) {
+            Shard& s = t->shard(keys[i]);
+            std::lock_guard<std::mutex> lk(s.mu);
+            Row& r = t->row(s, keys[i]);
+            t->update(r, g + static_cast<size_t>(i) * t->dim);
+          }
+          break;
+        }
+        case kStat: {
+          Table* t = table(tid);
+          uint64_t n = t ? t->size() : 0;
+          resp.resize(8);
+          std::memcpy(resp.data(), &n, 8);
+          break;
+        }
+        case kSave: {
+          // format: u32 dim | per row: i64 key | f32 w[dim] | u8 has_state |
+          //   [f32 m[dim] | f32 v[dim] | i64 step]  — optimizer state rides
+          // along so a restore does not reset adagrad/adam dynamics
+          Table* t = table(tid);
+          uint64_t n = 0;
+          if (t) {
+            std::string path(payload.begin(), payload.end());
+            FILE* f = std::fopen(path.c_str(), "wb");
+            if (f) {
+              std::fwrite(&t->dim, 4, 1, f);
+              for (auto& s : t->shards) {
+                std::lock_guard<std::mutex> lk(s.mu);
+                for (auto& kv : s.rows) {
+                  const Row& r = kv.second;
+                  std::fwrite(&kv.first, 8, 1, f);
+                  std::fwrite(r.w.data(), 4, t->dim, f);
+                  uint8_t has = r.m.empty() ? 0 : 1;
+                  std::fwrite(&has, 1, 1, f);
+                  if (has) {
+                    std::fwrite(r.m.data(), 4, t->dim, f);
+                    if (r.v.size() == t->dim)
+                      std::fwrite(r.v.data(), 4, t->dim, f);
+                    else {
+                      std::vector<float> z(t->dim, 0.f);
+                      std::fwrite(z.data(), 4, t->dim, f);
+                    }
+                    std::fwrite(&r.step, 8, 1, f);
+                  }
+                  ++n;
+                }
+              }
+              std::fclose(f);
+            }
+          }
+          resp.resize(8);
+          std::memcpy(resp.data(), &n, 8);
+          break;
+        }
+        case kLoad: {
+          Table* t = table(tid);
+          uint64_t n = 0;
+          if (t) {
+            std::string path(payload.begin(), payload.end());
+            FILE* f = std::fopen(path.c_str(), "rb");
+            if (f) {
+              uint32_t dim = 0;
+              if (std::fread(&dim, 4, 1, f) == 1 && dim == t->dim) {
+                int64_t key;
+                std::vector<float> w(dim);
+                while (std::fread(&key, 8, 1, f) == 1 &&
+                       std::fread(w.data(), 4, dim, f) == dim) {
+                  Row r;
+                  r.w = w;
+                  uint8_t has = 0;
+                  if (std::fread(&has, 1, 1, f) != 1) break;
+                  if (has) {
+                    r.m.resize(dim);
+                    r.v.resize(dim);
+                    if (std::fread(r.m.data(), 4, dim, f) != dim ||
+                        std::fread(r.v.data(), 4, dim, f) != dim ||
+                        std::fread(&r.step, 8, 1, f) != 1)
+                      break;
+                  }
+                  Shard& s = t->shard(key);
+                  std::lock_guard<std::mutex> lk(s.mu);
+                  s.rows[key] = std::move(r);
+                  ++n;
+                }
+              } else {
+                err = "LOAD: dim mismatch or bad file";
+              }
+              std::fclose(f);
+            } else {
+              err = "LOAD: cannot open file";
+            }
+          }
+          resp.resize(8);
+          std::memcpy(resp.data(), &n, 8);
+          break;
+        }
+        case kClear: {
+          Table* t = table(tid);
+          if (t)
+            for (auto& s : t->shards) {
+              std::lock_guard<std::mutex> lk(s.mu);
+              s.rows.clear();
+            }
+          break;
+        }
+        default:
+          goto done;  // unknown op: drop the connection (deregister below)
+      }
+
+      // response: u8 status (0 ok / 1 error) | u32 len | bytes
+      uint8_t status = err.empty() ? 0 : 1;
+      if (status) resp.assign(err.begin(), err.end());
+      uint32_t rlen = static_cast<uint32_t>(resp.size());
+      if (!write_all(fd, &status, 1) || !write_all(fd, &rlen, 4) ||
+          (rlen && !write_all(fd, resp.data(), rlen)))
+        break;
+    }
+  done:
+    // deregister-then-close under the lock: stop() may only shutdown() fds
+    // still registered, else a kernel-reused fd number could be hit
+    {
+      std::lock_guard<std::mutex> lk(fds_mu);
+      client_fds.erase(std::find(client_fds.begin(), client_fds.end(), fd));
+      ::close(fd);
+    }
+    active_clients.fetch_sub(1);  // LAST touch of the server object
+  }
+
+  void accept_loop() {
+    for (;;) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) break;
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      {
+        std::lock_guard<std::mutex> lk(fds_mu);
+        client_fds.push_back(fd);
+      }
+      // detached + active-count reaping: joinable threads would pin their
+      // ~8MB stacks until server stop on long-lived many-connection servers
+      active_clients.fetch_add(1);
+      std::thread([this, fd] { handle_client(fd); }).detach();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ps_server_start(int port) {
+  auto* s = new PsServer();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+          0 ||
+      ::listen(s->listen_fd, 128) < 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  s->accept_thread = std::thread([s] { s->accept_loop(); });
+  return s;
+}
+
+int ps_server_port(void* handle) {
+  auto* s = static_cast<PsServer*>(handle);
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  if (::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len) <
+      0)
+    return -1;
+  return ntohs(addr.sin_port);
+}
+
+void ps_server_stop(void* handle) {
+  auto* s = static_cast<PsServer*>(handle);
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  // wake workers blocked in recv, then wait for the active count to drain
+  // (workers are detached; the count decrement is their last server touch)
+  {
+    std::lock_guard<std::mutex> lk(s->fds_mu);
+    for (int fd : s->client_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  while (s->active_clients.load() > 0)
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  delete s;
+}
+
+}  // extern "C"

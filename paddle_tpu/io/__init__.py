@@ -360,3 +360,7 @@ def _worker_loop(dataset, collate_fn, index_q, out_q):
             out_q.put((i, collate_fn([dataset[j] for j in indices])))
         except Exception:
             out_q.put((i, _WorkerError(traceback.format_exc())))
+
+from paddle_tpu.io.ps_dataset import (  # noqa: F401,E402
+    InMemoryDataset, QueueDataset,
+)

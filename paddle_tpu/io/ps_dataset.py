@@ -1,0 +1,138 @@
+"""Slot-based dataset feed for parameter-server training.
+
+Reference: fleet dataset world — InMemoryDataset/QueueDataset
+(python/paddle/distributed/fleet/dataset/dataset.py:410/1389) fed by
+data_feed.cc MultiSlot readers (paddle/fluid/framework/data_feed.cc), the
+input pipeline of the PS trainers (data_set.cc, device_worker.h).
+
+TPU-native collapse: the C++ MultiSlot pipe-command reader world becomes a
+small host-side parser producing padded numpy batches (the TPU step consumes
+fixed-shape arrays; ragged slots pad to the batch max). Record format, one
+example per line:
+
+    slot:value slot:value ...
+
+where a sparse slot's values are int64 feature signs (repeated slot tokens
+append) and a dense slot's values are floats. Declared via use_var specs:
+("name", "sparse"|"dense").
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from typing import Dict, Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+SlotSpec = Tuple[str, str]  # (name, "sparse"|"dense")
+
+
+def _parse_line(line: str, specs: Sequence[SlotSpec]):
+    rec: Dict[str, list] = {name: [] for name, _ in specs}
+    kinds = dict(specs)
+    for tok in line.split():
+        if ":" not in tok:
+            continue
+        slot, val = tok.split(":", 1)
+        if slot not in rec:
+            continue
+        rec[slot].append(
+            int(val) if kinds[slot] == "sparse" else float(val))
+    return rec
+
+
+def _batchify(records: List[Dict[str, list]], specs: Sequence[SlotSpec]):
+    """Pad sparse slots to the batch max length (pad id 0); dense slots
+    must be fixed-length per slot."""
+    out: Dict[str, np.ndarray] = {}
+    for name, kind in specs:
+        vals = [r[name] for r in records]
+        if kind == "sparse":
+            width = max((len(v) for v in vals), default=1) or 1
+            arr = np.zeros((len(vals), width), np.int64)
+            for i, v in enumerate(vals):
+                arr[i, :len(v)] = v
+            out[name] = arr
+        else:
+            out[name] = np.asarray(vals, np.float32)
+    return out
+
+
+class DatasetBase:
+    def __init__(self):
+        self._specs: List[SlotSpec] = []
+        self._files: List[str] = []
+        self._batch_size = 1
+        self._drop_last = False
+
+    def init(self, use_var: Sequence[SlotSpec], batch_size: int = 1,
+             drop_last: bool = False, **kwargs):
+        self._specs = list(use_var)
+        self._batch_size = batch_size
+        self._drop_last = drop_last
+
+    def set_filelist(self, files: Sequence[str]):
+        missing = [f for f in files if not os.path.exists(f)]
+        if missing:
+            raise FileNotFoundError(f"dataset files not found: {missing}")
+        self._files = list(files)
+
+    def _iter_records(self) -> Iterator[Dict[str, list]]:
+        for path in self._files:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield _parse_line(line, self._specs)
+
+    def _iter_batches(self, records: Iterator[Dict[str, list]]):
+        buf: List[Dict[str, list]] = []
+        for rec in records:
+            buf.append(rec)
+            if len(buf) == self._batch_size:
+                yield _batchify(buf, self._specs)
+                buf = []
+        if buf and not self._drop_last:
+            yield _batchify(buf, self._specs)
+
+
+class InMemoryDataset(DatasetBase):
+    """Load-then-shuffle dataset (reference dataset.py:410): reads every
+    record into host RAM, supports local_shuffle, then batch iteration."""
+
+    def __init__(self):
+        super().__init__()
+        self._records: List[Dict[str, list]] = []
+        self._rng = random.Random(0)
+
+    def load_into_memory(self):
+        self._records = list(self._iter_records())
+
+    def local_shuffle(self, seed: int = None):
+        if seed is not None:
+            self._rng = random.Random(seed)
+        self._rng.shuffle(self._records)
+
+    def global_shuffle(self, fleet=None, thread_num: int = 1):
+        """Single-host collapse: same as local_shuffle (the reference
+        shuffles across trainers through the PS; with one trainer the two
+        coincide)."""
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._records = []
+
+    def get_memory_data_size(self) -> int:
+        return len(self._records)
+
+    def __iter__(self):
+        return self._iter_batches(iter(self._records))
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset (reference dataset.py:1389): batches flow straight
+    from the files, nothing retained."""
+
+    def __iter__(self):
+        return self._iter_batches(self._iter_records())

@@ -47,3 +47,7 @@ from paddle_tpu.parallel.checkpoint import load_state_dict, save_state_dict  # n
 from paddle_tpu.parallel.auto_tuner import AutoTuner, candidate_configs  # noqa: F401,E402
 from paddle_tpu.parallel.elastic import ElasticManager, Watchdog  # noqa: F401,E402
 from paddle_tpu.parallel import launch as launch_module  # noqa: F401,E402
+from paddle_tpu.parallel import ps  # noqa: F401,E402
+from paddle_tpu.parallel.ps import (  # noqa: F401,E402
+    PsClient, PsServer, SparseEmbedding,
+)

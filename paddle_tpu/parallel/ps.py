@@ -1,0 +1,358 @@
+"""Parameter-server world: sparse embedding tables served from host RAM.
+
+Reference: paddle/fluid/distributed/ps/ — BrpcPsServer/Client
+(ps/service/brpc_ps_server.h:41), MemorySparseTable (ps/table/
+memory_sparse_table.h), python orchestration the_one_ps.py; trainer-side
+pull/push via fleet_wrapper (paddle/fluid/framework/fleet/fleet_wrapper.h).
+
+TPU-native redesign (see csrc/ps_table.cpp): dense compute stays in XLA on
+chip; the sparse half is a host-RAM keyed table behind a tiny TCP service.
+The trainer-side cycle per minibatch is the reference's:
+
+    pull(unique ids) -> device gather/train step -> push(grad rows)
+
+SparseEmbedding packages that cycle as a Layer: forward pulls rows and runs
+a differentiable on-device gather; `push_gradients()` (or
+PsOptimizer.step()) sends the accumulated row gradients back, where the
+table applies its per-row optimizer (sgd/adagrad/adam) — the accessor
+collapse. Server-side optimizer state means trainers stay stateless, so
+elastic scale in/out of workers needs no optimizer reshard.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+_OP_CREATE, _OP_PULL, _OP_PUSH, _OP_STAT, _OP_SAVE, _OP_LOAD, _OP_CLEAR = (
+    1, 2, 3, 4, 5, 6, 7)
+_OPTIM = {"sgd": 0, "adagrad": 1, "adam": 2}
+
+_LIB = None
+_LIB_ERR: Optional[str] = None
+
+
+def _load_lib():
+    global _LIB, _LIB_ERR
+    if _LIB is not None or _LIB_ERR is not None:
+        return _LIB
+    src = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                       "csrc", "ps_table.cpp")
+    libdir = os.path.join(os.path.dirname(os.path.dirname(__file__)), "lib")
+    sopath = os.path.join(libdir, "libpstable.so")
+    try:
+        if not os.path.exists(sopath) or (
+                os.path.getmtime(sopath) < os.path.getmtime(src)):
+            os.makedirs(libdir, exist_ok=True)
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+                 src, "-o", sopath],
+                check=True, capture_output=True)
+        lib = ctypes.CDLL(sopath)
+        lib.ps_server_start.restype = ctypes.c_void_p
+        lib.ps_server_start.argtypes = [ctypes.c_int]
+        lib.ps_server_port.restype = ctypes.c_int
+        lib.ps_server_port.argtypes = [ctypes.c_void_p]
+        lib.ps_server_stop.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+    except Exception as e:  # pragma: no cover - toolchain always present
+        _LIB_ERR = str(e)
+    return _LIB
+
+
+class PsServer:
+    """Native sparse-table server (one per PS node). port=0 picks a free
+    port (read it back from .port)."""
+
+    def __init__(self, port: int = 0):
+        lib = _load_lib()
+        if lib is None:
+            raise RuntimeError(f"ps_table native lib unavailable: {_LIB_ERR}")
+        self._h = lib.ps_server_start(port)
+        if not self._h:
+            raise RuntimeError(f"PsServer: cannot bind port {port}")
+        self.port = lib.ps_server_port(self._h)
+
+    def stop(self):
+        if self._h:
+            _LIB.ps_server_stop(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class PsClient:
+    """Socket client; thread-safe (one in-flight request per client)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 timeout_s: float = 30.0):
+        import socket
+        import time
+
+        self._mu = threading.Lock()
+        deadline = time.time() + timeout_s
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port), timeout=5)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.05)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def _request(self, op: int, table_id: int, keys: np.ndarray,
+                 payload: bytes) -> bytes:
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+        hdr = struct.pack("<BII", op, table_id, keys.size)
+        msg = hdr + keys.tobytes() + struct.pack("<I", len(payload)) + payload
+        with self._mu:
+            self._sock.sendall(msg)
+            status = self._recv(1)[0]
+            rlen = struct.unpack("<I", self._recv(4))[0]
+            body = self._recv(rlen) if rlen else b""
+        if status:
+            raise RuntimeError(f"ps server error: {body.decode()}")
+        return body
+
+    def _recv(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("ps server closed connection")
+            buf += chunk
+        return buf
+
+    def create_table(self, table_id: int, dim: int, optimizer: str = "sgd",
+                     lr: float = 0.01, init_range: float = 0.01):
+        payload = struct.pack("<IBff", dim, _OPTIM[optimizer], lr, init_range)
+        self._request(_OP_CREATE, table_id, np.empty(0, np.int64), payload)
+
+    def pull(self, table_id: int, keys) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+        out = self._request(_OP_PULL, table_id, keys, b"")
+        vals = np.frombuffer(out, dtype=np.float32)
+        return vals.reshape(keys.size, -1).copy()
+
+    def push(self, table_id: int, keys, grads: np.ndarray):
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        self._request(_OP_PUSH, table_id, keys, grads.tobytes())
+
+    def stat(self, table_id: int) -> int:
+        out = self._request(_OP_STAT, table_id, np.empty(0, np.int64), b"")
+        return struct.unpack("<Q", out)[0]
+
+    def save(self, table_id: int, path: str) -> int:
+        out = self._request(_OP_SAVE, table_id, np.empty(0, np.int64),
+                            path.encode())
+        return struct.unpack("<Q", out)[0]
+
+    def load(self, table_id: int, path: str) -> int:
+        out = self._request(_OP_LOAD, table_id, np.empty(0, np.int64),
+                            path.encode())
+        return struct.unpack("<Q", out)[0]
+
+    def clear(self, table_id: int):
+        self._request(_OP_CLEAR, table_id, np.empty(0, np.int64), b"")
+
+    def close(self):
+        try:
+            self._sock.close()
+        except Exception:
+            pass
+
+
+class ShardedPsClient:
+    """Key-sharded client over MULTIPLE PS servers (the reference topology:
+    every trainer connects to every server; keys hash-shard across servers,
+    ps/table/memory_sparse_table.h). Exposes the same pull/push/... surface
+    as PsClient so SparseEmbedding works against either."""
+
+    def __init__(self, endpoints: List[str], timeout_s: float = 30.0):
+        if not endpoints:
+            raise ValueError("ShardedPsClient needs >= 1 endpoint")
+        self.clients = []
+        for ep in endpoints:
+            host, port = ep.rsplit(":", 1)
+            self.clients.append(PsClient(host, int(port),
+                                         timeout_s=timeout_s))
+
+    def _route(self, keys: np.ndarray):
+        """returns per-server (indices, keys) partitions."""
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+        srv = (keys.astype(np.uint64) % np.uint64(len(self.clients))
+               ).astype(np.int64)
+        return [(np.nonzero(srv == i)[0], keys[srv == i])
+                for i in range(len(self.clients))]
+
+    def create_table(self, table_id, dim, optimizer="sgd", lr=0.01,
+                     init_range=0.01):
+        for c in self.clients:
+            c.create_table(table_id, dim, optimizer, lr, init_range)
+
+    def pull(self, table_id, keys) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+        out = None
+        for c, (idx, part) in zip(self.clients, self._route(keys)):
+            if part.size == 0:
+                continue
+            vals = c.pull(table_id, part)
+            if out is None:
+                out = np.empty((keys.size, vals.shape[1]), np.float32)
+            out[idx] = vals
+        return out if out is not None else np.empty((0, 0), np.float32)
+
+    def push(self, table_id, keys, grads: np.ndarray):
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        for c, (idx, part) in zip(self.clients, self._route(keys)):
+            if part.size:
+                c.push(table_id, part, grads[idx])
+
+    def stat(self, table_id) -> int:
+        return sum(c.stat(table_id) for c in self.clients)
+
+    def save(self, table_id, path: str) -> int:
+        return sum(c.save(table_id, f"{path}.shard{i}")
+                   for i, c in enumerate(self.clients))
+
+    def load(self, table_id, path: str) -> int:
+        return sum(c.load(table_id, f"{path}.shard{i}")
+                   for i, c in enumerate(self.clients))
+
+    def clear(self, table_id):
+        for c in self.clients:
+            c.clear(table_id)
+
+    def close(self):
+        for c in self.clients:
+            c.close()
+
+
+_next_table_id = [0]
+
+
+class SparseEmbedding:
+    """Distributed embedding backed by a PS sparse table.
+
+    Reference analogue: paddle.static.nn.sparse_embedding /
+    fleet DistributedLookupTable (pull_sparse+push_sparse in
+    fleet_wrapper.h). Forward pulls the touched rows and gathers on device
+    (differentiable); after backward, push_gradients() sends the row grads
+    to the server, which applies its per-row optimizer.
+
+    Not a nn.Layer: its weight is intentionally NOT a local Parameter (the
+    table lives on the server, optimizer included), so local optimizers
+    must not see it.
+    """
+
+    def __init__(self, client: PsClient, num_embeddings_hint: int, dim: int,
+                 table_id: Optional[int] = None, optimizer: str = "adagrad",
+                 lr: float = 0.05, init_range: float = 0.01):
+        self.client = client
+        self.dim = dim
+        if table_id is None:
+            table_id = _next_table_id[0]
+            _next_table_id[0] += 1
+        self.table_id = table_id
+        client.create_table(table_id, dim, optimizer, lr, init_range)
+        self._pending: List = []  # (unique_keys, weight_tensor)
+
+    def __call__(self, ids):
+        import paddle_tpu as paddle
+        from paddle_tpu.core.tensor import Tensor
+
+        ids_np = np.asarray(ids._value if isinstance(ids, Tensor) else ids)
+        uniq, inverse = np.unique(ids_np, return_inverse=True)
+        rows = self.client.pull(self.table_id, uniq)      # [n_unique, dim]
+        w = paddle.to_tensor(rows)
+        w.stop_gradient = False
+        self._pending.append((uniq, w))
+        inv = paddle.to_tensor(inverse.reshape(ids_np.shape).astype("int32"))
+        from paddle_tpu.ops.registry import C_OPS
+
+        return C_OPS.gather(w, inv.reshape([-1]), axis=0).reshape(
+            list(ids_np.shape) + [self.dim])
+
+    def push_gradients(self):
+        """Send accumulated row grads to the server (one minibatch cycle)."""
+        for uniq, w in self._pending:
+            if w.grad is not None:
+                self.client.push(self.table_id, uniq,
+                                 np.asarray(w.grad._value))
+        self._pending.clear()
+
+
+# ---------------------------------------------------------------- fleet PS
+
+class PsRole:
+    """Role env contract, reference launch/controllers/ps.py:
+    TRAINING_ROLE=PSERVER|TRAINER, PADDLE_PSERVERS_IP_PORT_LIST,
+    PADDLE_TRAINER_ID."""
+
+    def __init__(self):
+        self.role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self.server_endpoints = [e for e in eps.split(",") if e]
+        self.trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.server_id = int(os.environ.get("PADDLE_PSERVER_ID", "0"))
+
+    def is_server(self) -> bool:
+        return self.role == "PSERVER"
+
+    def is_worker(self) -> bool:
+        return self.role == "TRAINER"
+
+
+_SERVER: Optional[PsServer] = None
+_WORKER: Optional[ShardedPsClient] = None
+
+
+def run_server(port: Optional[int] = None) -> PsServer:
+    """Start THIS node's sparse-table server (reference fleet.run_server).
+    The endpoint is picked by PADDLE_PSERVER_ID (this server's index into
+    PADDLE_PSERVERS_IP_PORT_LIST)."""
+    global _SERVER
+    if _SERVER is None:
+        if port is None:
+            role = PsRole()
+            eps = role.server_endpoints or ["127.0.0.1:0"]
+            me = eps[role.server_id % len(eps)]
+            port = int(me.rsplit(":", 1)[1])
+        _SERVER = PsServer(port)
+    return _SERVER
+
+
+def init_worker(endpoints: Optional[List[str]] = None) -> ShardedPsClient:
+    """Connect this trainer to ALL PS endpoints, key-sharded (reference
+    fleet.init_worker: every trainer holds a channel to every server)."""
+    global _WORKER
+    if _WORKER is None:
+        if endpoints is None:
+            endpoints = PsRole().server_endpoints or ["127.0.0.1:0"]
+        _WORKER = ShardedPsClient(endpoints)
+    return _WORKER
+
+
+def stop_worker():
+    global _WORKER
+    if _WORKER is not None:
+        _WORKER.close()
+        _WORKER = None
+
+
+def stop_server():
+    global _SERVER
+    if _SERVER is not None:
+        _SERVER.stop()
+        _SERVER = None

@@ -1,0 +1,229 @@
+"""Parameter-server world: native sparse table + embedding + dataset feed.
+
+Reference: paddle/fluid/distributed/ps/ (brpc PS server, MemorySparseTable,
+accessors) and fleet dataset feeds (fleet/dataset/dataset.py:410/1389).
+Here: csrc/ps_table.cpp server + parallel/ps.py client/embedding +
+io/ps_dataset.py feeds.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.parallel.ps import PsClient, PsServer, SparseEmbedding
+
+
+@pytest.fixture(scope="module")
+def ps():
+    server = PsServer(0)
+    client = PsClient("127.0.0.1", server.port)
+    yield server, client
+    client.close()
+    server.stop()
+
+
+def test_pull_is_deterministic_and_persistent(ps):
+    _, client = ps
+    client.create_table(1, dim=8, optimizer="sgd", lr=0.1, init_range=0.05)
+    keys = np.array([3, 77, 123456789], np.int64)
+    a = client.pull(1, keys)
+    b = client.pull(1, keys)
+    assert a.shape == (3, 8)
+    np.testing.assert_array_equal(a, b)          # same rows on re-pull
+    assert np.abs(a).max() <= 0.05 and np.abs(a).std() > 0
+    # distinct keys get distinct vectors
+    assert not np.allclose(a[0], a[1])
+    assert client.stat(1) == 3
+
+
+def test_push_applies_sgd_update(ps):
+    _, client = ps
+    client.create_table(2, dim=4, optimizer="sgd", lr=0.5, init_range=0.0)
+    keys = np.array([10, 20], np.int64)
+    w0 = client.pull(2, keys)                    # zeros (init_range=0)
+    np.testing.assert_array_equal(w0, np.zeros((2, 4)))
+    g = np.ones((2, 4), np.float32)
+    client.push(2, keys, g)
+    w1 = client.pull(2, keys)
+    np.testing.assert_allclose(w1, -0.5 * np.ones((2, 4)), atol=1e-6)
+
+
+def test_adagrad_update_scales_by_accumulator(ps):
+    _, client = ps
+    client.create_table(3, dim=2, optimizer="adagrad", lr=1.0, init_range=0.0)
+    keys = np.array([5], np.int64)
+    g = np.full((1, 2), 2.0, np.float32)
+    client.push(3, keys, g)
+    w1 = client.pull(3, keys)
+    # G = 4 -> step = lr*g/sqrt(G) = 2/2 = 1
+    np.testing.assert_allclose(w1, [[-1.0, -1.0]], atol=1e-5)
+    client.push(3, keys, g)
+    w2 = client.pull(3, keys)
+    # G = 8 -> extra step 2/sqrt(8)
+    np.testing.assert_allclose(w2 - w1, [[-2 / np.sqrt(8)] * 2], atol=1e-5)
+
+
+def test_save_load_roundtrip(ps, tmp_path):
+    _, client = ps
+    client.create_table(4, dim=4, optimizer="sgd", lr=0.1, init_range=0.02)
+    keys = np.arange(50, dtype=np.int64)
+    w = client.pull(4, keys)
+    path = str(tmp_path / "table4.bin")
+    assert client.save(4, path) == 50
+    client.clear(4)
+    assert client.stat(4) == 0
+    assert client.load(4, path) == 50
+    np.testing.assert_array_equal(client.pull(4, keys), w)
+
+
+def test_concurrent_pull_push(ps):
+    import threading
+
+    _, client = ps
+    client.create_table(5, dim=4, optimizer="sgd", lr=0.01, init_range=0.01)
+    server, _ = ps
+    errs = []
+
+    def worker():
+        try:
+            c = PsClient("127.0.0.1", server.port)
+            ks = np.random.default_rng().integers(0, 1000, 64)
+            for _ in range(20):
+                vals = c.pull(5, ks)
+                c.push(5, ks, np.ones_like(vals))
+            c.close()
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert not errs
+
+
+def test_sparse_embedding_trains(ps):
+    """End-to-end PS cycle: pull -> device step -> push converges on a toy
+    regression (each id's embedding row must learn its target)."""
+    _, client = ps
+    emb = SparseEmbedding(client, 1000, dim=8, table_id=100,
+                          optimizer="adagrad", lr=0.3)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 50, (16,))
+    targets = np.take(rng.standard_normal((50, 8)).astype("float32"), ids,
+                      axis=0)
+    t = paddle.to_tensor(targets)
+    first = last = None
+    for i in range(40):
+        out = emb(paddle.to_tensor(ids))
+        loss = ((out - t) ** 2).mean()
+        loss.backward()
+        emb.push_gradients()
+        if i == 0:
+            first = float(loss)
+        last = float(loss)
+    assert last < first * 0.1, (first, last)
+
+
+def test_in_memory_dataset(tmp_path):
+    from paddle_tpu.io import InMemoryDataset
+
+    f = tmp_path / "part-0"
+    f.write_text("label:1 ids:3 ids:7 dense:0.5 dense:1.5\n"
+                 "label:0 ids:9 dense:0.1 dense:0.2\n"
+                 "label:1 ids:2 ids:4 ids:8 dense:0.9 dense:1.1\n")
+    ds = InMemoryDataset()
+    ds.init(use_var=[("label", "dense"), ("ids", "sparse"),
+                     ("dense", "dense")], batch_size=2)
+    ds.set_filelist([str(f)])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 3
+    batches = list(ds)
+    assert len(batches) == 2
+    b0 = batches[0]
+    assert b0["ids"].shape == (2, 2) and b0["ids"].dtype == np.int64
+    np.testing.assert_array_equal(b0["ids"], [[3, 7], [9, 0]])
+    np.testing.assert_allclose(b0["dense"], [[0.5, 1.5], [0.1, 0.2]])
+    ds.local_shuffle(seed=1)
+    assert ds.get_memory_data_size() == 3
+    ds.release_memory()
+    assert ds.get_memory_data_size() == 0
+
+
+def test_queue_dataset_streams(tmp_path):
+    from paddle_tpu.io import QueueDataset
+
+    f = tmp_path / "part-0"
+    f.write_text("\n".join(f"x:{i} y:{i % 2}" for i in range(10)) + "\n")
+    ds = QueueDataset()
+    ds.init(use_var=[("x", "sparse"), ("y", "dense")], batch_size=4,
+            drop_last=True)
+    ds.set_filelist([str(f)])
+    batches = list(ds)
+    assert len(batches) == 2                     # drop_last drops the 2-rec tail
+    assert batches[0]["x"].shape == (4, 1)
+
+
+def test_error_responses(ps):
+    _, client = ps
+    with pytest.raises(RuntimeError, match="no such table"):
+        client.pull(999, np.array([1], np.int64))
+    client.create_table(50, dim=4)
+    with pytest.raises(RuntimeError, match="different dim"):
+        client.create_table(50, dim=8)
+    with pytest.raises(RuntimeError, match="size mismatch"):
+        client.push(50, np.array([1], np.int64),
+                    np.ones((1, 2), np.float32))
+
+
+def test_save_load_preserves_optimizer_state(ps, tmp_path):
+    """A restore must not reset adagrad accumulators (post-restore step
+    sizes match an unbroken run)."""
+    _, client = ps
+    client.create_table(6, dim=2, optimizer="adagrad", lr=1.0, init_range=0.0)
+    keys = np.array([7], np.int64)
+    g = np.full((1, 2), 2.0, np.float32)
+    client.push(6, keys, g)                      # G=4
+    path = str(tmp_path / "t6.bin")
+    client.save(6, path)
+    w_saved = client.pull(6, keys)
+    client.push(6, keys, g)                      # unbroken run: G=8
+    w_unbroken = client.pull(6, keys)
+    client.clear(6)
+    client.load(6, path)
+    np.testing.assert_array_equal(client.pull(6, keys), w_saved)
+    client.push(6, keys, g)                      # restored run: must also G=8
+    np.testing.assert_allclose(client.pull(6, keys), w_unbroken, atol=1e-6)
+
+
+def test_sharded_client_partitions_keys():
+    from paddle_tpu.parallel.ps import ShardedPsClient
+
+    s1, s2 = PsServer(0), PsServer(0)
+    try:
+        cli = ShardedPsClient([f"127.0.0.1:{s1.port}",
+                               f"127.0.0.1:{s2.port}"])
+        cli.create_table(1, dim=4, optimizer="sgd", lr=0.5, init_range=0.0)
+        keys = np.arange(20, dtype=np.int64)
+        w = cli.pull(1, keys)
+        assert w.shape == (20, 4)
+        # rows land on exactly one server each, all keys covered
+        n1, n2 = cli.clients[0].stat(1), cli.clients[1].stat(1)
+        assert n1 + n2 == 20 and n1 > 0 and n2 > 0
+        cli.push(1, keys, np.ones((20, 4), np.float32))
+        np.testing.assert_allclose(cli.pull(1, keys), -0.5 * np.ones((20, 4)),
+                                   atol=1e-6)
+        cli.close()
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+def test_ps_role_and_fleet_env(monkeypatch):
+    from paddle_tpu.parallel.ps import PsRole
+
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST",
+                       "127.0.0.1:7001,127.0.0.1:7002")
+    role = PsRole()
+    assert role.is_server() and not role.is_worker()
+    assert role.server_endpoints == ["127.0.0.1:7001", "127.0.0.1:7002"]
