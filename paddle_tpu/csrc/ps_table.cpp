@@ -135,7 +135,7 @@ struct Table {
         for (uint32_t i = 0; i < dim; ++i) r.w[i] -= lr * g[i];
         break;
       case kAdagrad: {
-        if (r.m.empty()) r.m.assign(dim, 0.f);
+        if (r.m.size() != dim) r.m.assign(dim, 0.f);
         for (uint32_t i = 0; i < dim; ++i) {
           r.m[i] += g[i] * g[i];
           r.w[i] -= lr * g[i] / (std::sqrt(r.m[i]) + 1e-8f);
@@ -143,10 +143,10 @@ struct Table {
         break;
       }
       case kAdam: {
-        if (r.m.empty()) {
-          r.m.assign(dim, 0.f);
-          r.v.assign(dim, 0.f);
-        }
+        // size checks (not just empty): a row trained under another
+        // optimizer must not index a mis-sized state vector
+        if (r.m.size() != dim) r.m.assign(dim, 0.f);
+        if (r.v.size() != dim) r.v.assign(dim, 0.f);
         r.step += 1;
         const float b1 = 0.9f, b2 = 0.999f;
         float c1 = 1.f - std::pow(b1, static_cast<float>(r.step));
@@ -220,12 +220,22 @@ struct PsServer {
           std::memcpy(&optim, payload.data() + 4, 1);
           std::memcpy(&lr, payload.data() + 5, 4);
           std::memcpy(&init, payload.data() + 9, 4);
+          if (dim == 0 || dim > (1u << 16)) {
+            err = "CREATE: dim out of range";
+            break;
+          }
           std::lock_guard<std::mutex> lk(tables_mu);
           Table& t = tables[tid];
           if (t.dim != 0 && t.dim != dim) {
             // re-creating with a different dim would leave old rows whose
             // vectors mismatch the new dim (OOB on pull/push) — refuse
             err = "CREATE: table exists with different dim";
+            break;
+          }
+          if (t.dim != 0 && t.opt != optim && t.size() > 0) {
+            // switching optimizers mid-training would misinterpret rows'
+            // accumulated state — refuse unless the table is empty
+            err = "CREATE: table exists with different optimizer";
             break;
           }
           t.dim = dim;
@@ -238,6 +248,10 @@ struct PsServer {
           Table* t = table(tid);
           if (!t || t->dim == 0) {
             err = "PULL: no such table";
+            break;
+          }
+          if (static_cast<size_t>(nkeys) * t->dim * 4 > (size_t{1} << 30)) {
+            err = "PULL: response too large";
             break;
           }
           resp.resize(static_cast<size_t>(nkeys) * t->dim * 4);
@@ -286,7 +300,11 @@ struct PsServer {
           if (t) {
             std::string path(payload.begin(), payload.end());
             FILE* f = std::fopen(path.c_str(), "wb");
-            if (f) {
+            if (!f) {
+              err = "SAVE: cannot open file";
+              break;
+            }
+            {
               std::fwrite(&t->dim, 4, 1, f);
               for (auto& s : t->shards) {
                 std::lock_guard<std::mutex> lk(s.mu);

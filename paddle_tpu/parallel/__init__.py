@@ -51,3 +51,4 @@ from paddle_tpu.parallel import ps  # noqa: F401,E402
 from paddle_tpu.parallel.ps import (  # noqa: F401,E402
     PsClient, PsServer, SparseEmbedding,
 )
+from paddle_tpu.parallel import rpc  # noqa: F401,E402

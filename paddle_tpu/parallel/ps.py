@@ -138,9 +138,14 @@ class PsClient:
                      lr: float = 0.01, init_range: float = 0.01):
         payload = struct.pack("<IBff", dim, _OPTIM[optimizer], lr, init_range)
         self._request(_OP_CREATE, table_id, np.empty(0, np.int64), payload)
+        self._dims = getattr(self, "_dims", {})
+        self._dims[table_id] = dim
 
     def pull(self, table_id: int, keys) -> np.ndarray:
         keys = np.ascontiguousarray(keys, dtype=np.int64)
+        if keys.size == 0:
+            dim = getattr(self, "_dims", {}).get(table_id, 0)
+            return np.empty((0, dim), np.float32)
         out = self._request(_OP_PULL, table_id, keys, b"")
         vals = np.frombuffer(out, dtype=np.float32)
         return vals.reshape(keys.size, -1).copy()
@@ -198,20 +203,24 @@ class ShardedPsClient:
 
     def create_table(self, table_id, dim, optimizer="sgd", lr=0.01,
                      init_range=0.01):
+        self._dims = getattr(self, "_dims", {})
+        self._dims[table_id] = dim
         for c in self.clients:
             c.create_table(table_id, dim, optimizer, lr, init_range)
 
     def pull(self, table_id, keys) -> np.ndarray:
         keys = np.ascontiguousarray(keys, dtype=np.int64)
-        out = None
-        for c, (idx, part) in zip(self.clients, self._route(keys)):
-            if part.size == 0:
-                continue
-            vals = c.pull(table_id, part)
-            if out is None:
-                out = np.empty((keys.size, vals.shape[1]), np.float32)
-            out[idx] = vals
-        return out if out is not None else np.empty((0, 0), np.float32)
+        parts = self._route(keys)
+        results = [c.pull(table_id, part) if part.size else None
+                   for c, (_idx, part) in zip(self.clients, parts)]
+        dim = getattr(self, "_dims", {}).get(table_id)
+        if dim is None:  # table created out-of-band: infer from a result
+            dim = next((r.shape[1] for r in results if r is not None), 0)
+        out = np.empty((keys.size, dim), np.float32)
+        for (idx, _part), r in zip(parts, results):
+            if r is not None:
+                out[idx] = r
+        return out
 
     def push(self, table_id, keys, grads: np.ndarray):
         grads = np.ascontiguousarray(grads, dtype=np.float32)
@@ -270,14 +279,18 @@ class SparseEmbedding:
 
     def __call__(self, ids):
         import paddle_tpu as paddle
+        from paddle_tpu.autograd.engine import is_grad_enabled
         from paddle_tpu.core.tensor import Tensor
 
         ids_np = np.asarray(ids._value if isinstance(ids, Tensor) else ids)
         uniq, inverse = np.unique(ids_np, return_inverse=True)
         rows = self.client.pull(self.table_id, uniq)      # [n_unique, dim]
         w = paddle.to_tensor(rows)
-        w.stop_gradient = False
-        self._pending.append((uniq, w))
+        if is_grad_enabled():
+            # record for push_gradients; forward-only (inference) use must
+            # not accumulate pending rows unboundedly
+            w.stop_gradient = False
+            self._pending.append((uniq, w))
         inv = paddle.to_tensor(inverse.reshape(ids_np.shape).astype("int32"))
         from paddle_tpu.ops.registry import C_OPS
 

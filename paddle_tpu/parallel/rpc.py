@@ -1,0 +1,305 @@
+"""paddle.distributed.rpc: minimal p2p RPC between named workers.
+
+Reference: python/paddle/distributed/rpc/rpc.py (init_rpc:87, rpc_sync:220,
+rpc_async:268, shutdown:318, WorkerInfo get_worker_info/get_all_worker_infos)
+over the brpc agent in paddle/fluid/distributed/rpc/.
+
+TPU-native redesign: brpc collapses to one listener socket per worker with
+pickled (fn, args, kwargs) frames; rendezvous rides the native TCPStore
+(parallel/store.py -> csrc/tcp_store.cpp) instead of a dedicated master —
+the same store that bootstraps collective training, so PS/RPC/collective
+worlds share one bootstrap path. Calls execute in a thread pool on the
+callee; rpc_async returns a concurrent.futures.Future.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from paddle_tpu.parallel.store import TCPStore
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+def _send_frame(sock, payload: bytes):
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def _recv_frame(sock) -> bytes:
+    hdr = _recv_n(sock, 4)
+    (n,) = struct.unpack("<I", hdr)
+    return _recv_n(sock, n)
+
+
+def _recv_n(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return buf
+
+
+def _local_ip() -> str:
+    """This host's address as peers should dial it: the launcher env
+    (reference PADDLE_CURRENT_ENDPOINT / POD_IP contract), else the outbound
+    interface address, else loopback (single-host)."""
+    import os
+
+    ep = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+    if ep:
+        return ep.rsplit(":", 1)[0]
+    ip = os.environ.get("POD_IP", "")
+    if ip:
+        return ip
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("10.255.255.255", 1))  # no packet sent; routes only
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+class RpcAgent:
+    """One RPC endpoint: a listener + client connections to peers.
+
+    Object-level (not module-global) so tests can run several workers in
+    one process; init_rpc() manages the module-level current agent.
+    """
+
+    def __init__(self, name: str, rank: int, world_size: int,
+                 store: TCPStore, max_workers: int = 8):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self._store = store
+        self._pool = ThreadPoolExecutor(max_workers=max_workers)
+        self._conns: Dict[str, socket.socket] = {}
+        self._conns_mu = threading.Lock()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("0.0.0.0", 0))
+        self._listener.listen(64)
+        self.port = self._listener.getsockname()[1]
+        self._stopping = False
+        self._serve_thread = threading.Thread(target=self._serve, daemon=True)
+        self._serve_thread.start()
+
+        # register + collect peers through the store (per-rank key makes the
+        # world enumerable for get_all_worker_infos)
+        self.ip = _local_ip()
+        store.set(f"rpc/worker/{name}",
+                  pickle.dumps(WorkerInfo(name, rank, self.ip, self.port)))
+        store.set(f"rpc/rank/{rank}", name.encode())
+        store.add("rpc/registered", 1)
+        self._infos: Dict[str, WorkerInfo] = {}
+
+    # --------------------------------------------------------------- server
+
+    def _serve(self):
+        while not self._stopping:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                break
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        try:
+            while True:
+                frame = _recv_frame(conn)
+                fn, args, kwargs = pickle.loads(frame)
+                try:
+                    result = (True, fn(*args, **(kwargs or {})))
+                except Exception as e:  # noqa: BLE001 — forwarded to caller
+                    result = (False, e)
+                _send_frame(conn, pickle.dumps(result))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    # --------------------------------------------------------------- client
+
+    def _worker_info(self, name: str) -> WorkerInfo:
+        if name not in self._infos:
+            raw = self._store.get(f"rpc/worker/{name}")
+            self._infos[name] = pickle.loads(raw)
+        return self._infos[name]
+
+    def get_all_worker_infos(self) -> List[WorkerInfo]:
+        """Blocking: resolves every rank's registration (reference
+        rpc.py get_all_worker_infos)."""
+        infos = []
+        for r in range(self.world_size):
+            name = self._store.get(f"rpc/rank/{r}").decode()
+            infos.append(self._worker_info(name))
+        return sorted(infos, key=lambda w: w.rank)
+
+    def _connect(self, name: str):
+        """returns (socket, per-connection lock): requests to one peer are
+        serialized (send+recv under the lock keeps responses matched);
+        different peers proceed concurrently. The blocking dial happens
+        OUTSIDE the global map lock so one unreachable peer cannot stall
+        traffic to healthy ones."""
+        with self._conns_mu:
+            entry = self._conns.get(name)
+        if entry is not None:
+            return entry
+        info = self._worker_info(name)
+        s = socket.create_connection((info.ip, info.port), timeout=30)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with self._conns_mu:
+            if name in self._conns:   # lost the race: use the winner's
+                s.close()
+            else:
+                self._conns[name] = (s, threading.Lock())
+            return self._conns[name]
+
+    def _drop_conn(self, name: str, conn):
+        """Tear down a connection after a timeout/failure so the next call
+        redials instead of inheriting a desynced stream."""
+        with self._conns_mu:
+            if self._conns.get(name, (None,))[0] is conn:
+                del self._conns[name]
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def rpc_sync(self, to: str, fn, args=(), kwargs=None,
+                 timeout: float = 180.0):
+        # outer wait is slack: the SOCKET timeout inside call() must fire
+        # first so the connection is torn down before the caller returns
+        return self.rpc_async(to, fn, args, kwargs,
+                              timeout).result(timeout + 10)
+
+    def rpc_async(self, to: str, fn, args=(), kwargs=None,
+                  timeout: float = 180.0) -> Future:
+        payload = pickle.dumps((fn, args, kwargs))
+
+        def call():
+            conn, lock = self._connect(to)
+            with lock:
+                try:
+                    conn.settimeout(timeout)
+                    _send_frame(conn, payload)
+                    resp = _recv_frame(conn)
+                except (socket.timeout, TimeoutError):
+                    # a hung peer must not pin this connection's lock forever
+                    self._drop_conn(to, conn)
+                    raise TimeoutError(
+                        f"rpc to {to!r} timed out after {timeout}s")
+                except (ConnectionError, OSError):
+                    self._drop_conn(to, conn)
+                    raise
+            ok, value = pickle.loads(resp)
+            if not ok:
+                raise value
+            return value
+
+        return self._pool.submit(call)
+
+    def shutdown(self):
+        """Graceful: barrier so no peer is torn down while others still
+        call into it (reference rpc.py shutdown barrier)."""
+        self._store.add("rpc/done", 1)
+        self._store.wait("rpc/done")
+        import time
+
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            raw = self._store.try_get("rpc/done")
+            if raw is not None and struct.unpack("<q", raw)[0] >= \
+                    self.world_size:
+                break
+            time.sleep(0.01)
+        self._stop()
+
+    def _stop(self):
+        self._stopping = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._conns_mu:
+            for s, _lk in self._conns.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+        self._pool.shutdown(wait=False)
+
+
+# ------------------------------------------------------------- module API
+
+_AGENT: Optional[RpcAgent] = None
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None) -> RpcAgent:
+    """Reference signature rpc.py:87. master_endpoint "ip:port"; rank 0
+    hosts the store there."""
+    global _AGENT
+    import os
+
+    rank = rank if rank is not None else int(
+        os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world_size = world_size if world_size is not None else int(
+        os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:0")
+    host, port = master_endpoint.rsplit(":", 1)
+    store = TCPStore(host, int(port), is_master=(rank == 0),
+                     world_size=world_size)
+    _AGENT = RpcAgent(name, rank, world_size, store)
+    return _AGENT
+
+
+def _require_agent() -> RpcAgent:
+    if _AGENT is None:
+        raise RuntimeError("call paddle.distributed.rpc.init_rpc first")
+    return _AGENT
+
+
+def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = 180.0):
+    return _require_agent().rpc_sync(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=(), kwargs=None, timeout: float = 180.0):
+    return _require_agent().rpc_async(to, fn, args, kwargs, timeout)
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    return _require_agent()._worker_info(name)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    a = _require_agent()
+    return WorkerInfo(a.name, a.rank, a.ip, a.port)
+
+
+def shutdown():
+    global _AGENT
+    if _AGENT is not None:
+        _AGENT.shutdown()
+        _AGENT = None

@@ -218,6 +218,18 @@ def test_sharded_client_partitions_keys():
         s2.stop()
 
 
+def test_empty_pull_and_inference_mode(ps):
+    _, client = ps
+    client.create_table(60, dim=4)
+    out = client.pull(60, np.empty(0, np.int64))
+    assert out.shape == (0, 4)
+    emb = SparseEmbedding(client, 100, dim=4, table_id=61)
+    with paddle.no_grad():
+        for _ in range(3):
+            emb(paddle.to_tensor(np.array([1, 2, 3])))
+    assert not emb._pending          # forward-only use must not accumulate
+
+
 def test_ps_role_and_fleet_env(monkeypatch):
     from paddle_tpu.parallel.ps import PsRole
 
