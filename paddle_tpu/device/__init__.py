@@ -68,3 +68,11 @@ class Event:
 
 def current_stream(device=None):
     return Stream(device)
+
+
+from paddle_tpu.device import manager  # noqa: E402,F401
+from paddle_tpu.device.manager import (  # noqa: E402,F401
+    DeviceInterface, DeviceManager, get_all_custom_device_type,
+    is_compiled_with_custom_device, load_custom_runtime_libs,
+    register_custom_device, register_pjrt_plugin,
+)

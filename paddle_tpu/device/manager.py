@@ -1,0 +1,180 @@
+"""Device manager + custom-device (plugin) registration.
+
+Reference: phi DeviceManager (paddle/phi/backends/device_manager.h:134),
+DeviceInterface C ABI (device_base.h:26), runtime plugin loading
+LoadCustomRuntimeLib (device_manager.h:298) driven by CUSTOM_DEVICE_ROOT,
+and the fake test device (phi/backends/custom/fake_cpu_device.h).
+
+TPU-native redesign: the pluggable-backend mechanism of the XLA world is
+the PJRT plugin ABI — a vendor ships libpjrt_<name>.so and the framework
+points the runtime at it. So:
+
+  * register_pjrt_plugin(name, library_path) — the LoadCustomRuntimeLib
+    analogue: registers a PJRT plugin with JAX (and exports
+    PJRT_NAMES_AND_LIBRARY_PATHS for child processes).
+  * load_custom_runtime_libs(root) — CUSTOM_DEVICE_ROOT directory scan:
+    every libpjrt_*.so found is registered under its inferred name.
+  * DeviceInterface + register_custom_device — a python-level device
+    descriptor for parity/testing (the fake_cpu_device story): a custom
+    type backed by an existing jax platform, visible through DeviceManager
+    enumeration APIs.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+
+
+@dataclass
+class DeviceInterface:
+    """Python-level device descriptor (reference device_base.h:26 — the
+    C ABI's metadata + hooks surface, collapsed to what the PJRT world
+    needs)."""
+
+    device_type: str
+    backend: str = "cpu"        # jax platform serving this type
+    priority: int = 90
+    library_path: Optional[str] = None
+    extra: dict = field(default_factory=dict)
+
+    def visible_devices(self) -> List:
+        try:
+            return jax.devices(self.backend)
+        except RuntimeError:
+            return []
+
+
+class DeviceManager:
+    """Process-wide registry (reference DeviceManager singleton,
+    device_manager.h:134)."""
+
+    _custom: Dict[str, DeviceInterface] = {}
+    _plugins: Dict[str, str] = {}
+
+    # ---------------------------------------------------------- plugins
+
+    @classmethod
+    def register_pjrt_plugin(cls, name: str, library_path: str,
+                             make_default: bool = False) -> bool:
+        """Register a PJRT plugin shared library under `name`.
+
+        Returns True if the plugin was handed to the live JAX runtime,
+        False if only the env contract was exported (e.g. jax already
+        initialized its backends — child processes still pick it up)."""
+        cls._plugins[name] = library_path
+        # env contract consumed by PJRT at client init (and inherited by
+        # spawned workers — the launcher analogue of CUSTOM_DEVICE_ROOT)
+        pairs = [f"{n}:{p}" for n, p in cls._plugins.items()]
+        os.environ["PJRT_NAMES_AND_LIBRARY_PATHS"] = ",".join(pairs)
+        try:
+            from jax._src import xla_bridge
+
+            xla_bridge.register_plugin(name, library_path=library_path,
+                                       priority=500 if make_default else 400)
+            return True
+        except Exception:
+            return False
+
+    @classmethod
+    def load_custom_runtime_libs(cls, root: Optional[str] = None) -> List[str]:
+        """Scan `root` (default $CUSTOM_DEVICE_ROOT) for libpjrt_<name>.so
+        and register each (reference LoadCustomRuntimeLib scanning
+        CUSTOM_DEVICE_ROOT, device_manager.h:298)."""
+        root = root or os.environ.get("CUSTOM_DEVICE_ROOT", "")
+        loaded = []
+        if not root or not os.path.isdir(root):
+            return loaded
+        for path in sorted(glob.glob(os.path.join(root, "libpjrt_*.so"))):
+            name = os.path.basename(path)[len("libpjrt_"):-len(".so")]
+            cls.register_pjrt_plugin(name, path)
+            loaded.append(name)
+        return loaded
+
+    # ------------------------------------------------- custom (fake) devices
+
+    @classmethod
+    def register_custom_device(cls, iface: DeviceInterface):
+        """Register a python-level custom device type (the test/parity
+        analogue of PD_REGISTER_PLUGIN_KERNEL's fake device)."""
+        cls._custom[iface.device_type] = iface
+
+    @classmethod
+    def unregister_custom_device(cls, device_type: str):
+        cls._custom.pop(device_type, None)
+
+    # ---------------------------------------------------------- queries
+
+    @classmethod
+    def get_all_device_types(cls) -> List[str]:
+        base = sorted({d.platform for d in jax.devices()})
+        return base + sorted(cls._custom)
+
+    @classmethod
+    def get_all_custom_device_types(cls) -> List[str]:
+        return sorted(cls._custom)
+
+    @classmethod
+    def is_custom_device(cls, device_type: str) -> bool:
+        return device_type in cls._custom
+
+    @classmethod
+    def get_device_interface(cls, device_type: str) -> DeviceInterface:
+        if device_type in cls._custom:
+            return cls._custom[device_type]
+        raise ValueError(f"unknown custom device type {device_type!r} "
+                         f"(registered: {sorted(cls._custom)})")
+
+    @classmethod
+    def device_count(cls, device_type: str) -> int:
+        if device_type in cls._custom:
+            return len(cls._custom[device_type].visible_devices())
+        try:
+            return len(jax.devices(device_type))
+        except RuntimeError:
+            return 0
+
+    @classmethod
+    def devices(cls, device_type: str) -> List:
+        if device_type in cls._custom:
+            return cls._custom[device_type].visible_devices()
+        return jax.devices(device_type)
+
+    @classmethod
+    def synchronize(cls, device_type: Optional[str] = None):
+        (jax.device_put(0.0) + 0).block_until_ready()
+
+
+# module-level convenience (reference python surface
+# paddle.device.custom / paddle.base.core device manager bindings)
+
+def register_pjrt_plugin(name: str, library_path: str, **kw) -> bool:
+    return DeviceManager.register_pjrt_plugin(name, library_path, **kw)
+
+
+def load_custom_runtime_libs(root: Optional[str] = None) -> List[str]:
+    return DeviceManager.load_custom_runtime_libs(root)
+
+
+def register_custom_device(device_type: str, backend: str = "cpu",
+                           **extra) -> DeviceInterface:
+    iface = DeviceInterface(device_type=device_type, backend=backend,
+                            extra=extra)
+    DeviceManager.register_custom_device(iface)
+    return iface
+
+
+def get_all_custom_device_type() -> List[str]:
+    """Reference name: paddle.device.get_all_custom_device_type."""
+    return DeviceManager.get_all_custom_device_types()
+
+
+def is_compiled_with_custom_device(device_type: str) -> bool:
+    """Reference: paddle.device.is_compiled_with_custom_device — here
+    'compiled with' means a plugin or python descriptor is registered."""
+    return (device_type in DeviceManager._custom
+            or device_type in DeviceManager._plugins)

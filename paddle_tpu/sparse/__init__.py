@@ -110,13 +110,140 @@ def _unary(fn):
 
 
 relu = _unary(jax.nn.relu)
+relu6 = _unary(lambda v: jnp.clip(v, 0, 6))
+leaky_relu = _unary(lambda v: jnp.where(v >= 0, v, 0.01 * v))
 abs = _unary(jnp.abs)  # noqa: A001
 sin = _unary(jnp.sin)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
 tanh = _unary(jnp.tanh)
+asinh = _unary(jnp.arcsinh)
+atanh = _unary(jnp.arctanh)
 sqrt = _unary(jnp.sqrt)
 square = _unary(jnp.square)
+log1p = _unary(jnp.log1p)
 neg = _unary(jnp.negative)
 expm1 = _unary(jnp.expm1)
+deg2rad = _unary(jnp.deg2rad)
+rad2deg = _unary(jnp.rad2deg)
+
+
+def pow(x, factor):  # noqa: A001
+    return _unary(lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    from paddle_tpu.core.dtype import to_jax_dtype
+
+    v = x._value
+    data = (v.data.astype(to_jax_dtype(value_dtype))
+            if value_dtype is not None else v.data)
+    idx = (v.indices.astype(to_jax_dtype(index_dtype))
+           if index_dtype is not None else v.indices)
+    return _coo_out(jsparse.BCOO((data, idx), shape=v.shape),
+                    stop_gradient=x.stop_gradient)
+
+
+def coalesce(x):
+    """Merge duplicate indices (reference sparse_coo coalesce kernel)."""
+    return _coo_out(jsparse.bcoo_sum_duplicates(x._value),
+                    stop_gradient=x.stop_gradient)
+
+
+def subtract(x, y):
+    return add(x, _unary(jnp.negative)(y) if isinstance(y, SparseCooTensor)
+               else Tensor._wrap(-y._value))
+
+
+def multiply(x, y):
+    """Elementwise; sparse*sparse intersects patterns (computed through the
+    dense form — XLA fuses; TPU has no cuSPARSE-style path to save)."""
+    xd = x._value.todense() if isinstance(x, SparseCooTensor) else x._value
+    yd = y._value.todense() if isinstance(y, SparseCooTensor) else y._value
+    return to_sparse_coo(Tensor._wrap(xd * yd))
+
+
+def divide(x, y):
+    xd = x._value.todense() if isinstance(x, SparseCooTensor) else x._value
+    yd = y._value.todense() if isinstance(y, SparseCooTensor) else y._value
+    return Tensor._wrap(xd / yd)
+
+
+def mv(x, vec):
+    """sparse matrix @ dense vector (reference sparse/mv kernel)."""
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    return Tensor._wrap(x._value @ v)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    """beta*input + alpha*(x @ y) (reference sparse/addmm kernel)."""
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    iv = input._value if isinstance(input, Tensor) else jnp.asarray(input)
+    prod = xv @ yv                         # BCOO @ dense lowers via XLA
+    iv = iv.todense() if isinstance(iv, jsparse.BCOO) else iv
+    return Tensor._wrap(beta * iv + alpha * prod)
+
+
+def masked_matmul(x, y, mask: "SparseCooTensor"):
+    """(x @ y) evaluated ONLY at mask's nonzero positions (reference
+    sparse/masked_matmul). TPU shape: gather the needed rows/cols and do
+    per-nnz dot products — O(nnz*k) instead of O(m*n*k)."""
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    idx = mask._value.indices                     # [nnz, 2]
+    rows = jnp.take(xv, idx[:, 0], axis=0)        # [nnz, k]
+    cols = jnp.take(yv, idx[:, 1], axis=1)        # [k, nnz]
+    vals = jnp.sum(rows * jnp.swapaxes(cols, 0, 1), axis=-1)
+    sg = (getattr(x, "stop_gradient", True)
+          and getattr(y, "stop_gradient", True))
+    return _coo_out(jsparse.BCOO((vals, idx), shape=mask._value.shape),
+                    stop_gradient=sg)
+
+
+def transpose(x, perm):
+    v = x._value
+    idx = v.indices[:, jnp.asarray(perm)]
+    shape = tuple(v.shape[p] for p in perm)
+    return _coo_out(jsparse.bcoo_sum_duplicates(
+        jsparse.BCOO((v.data, idx), shape=shape)),
+        stop_gradient=x.stop_gradient)
+
+
+def reshape(x, shape):
+    """Via linearized indices (pure index arithmetic, stays sparse)."""
+    v = x._value
+    old = jnp.asarray(v.shape)
+    lin = jnp.zeros(v.nse, dtype=jnp.int64)
+    for d in range(len(v.shape)):
+        lin = lin * old[d] + v.indices[:, d]
+    shape = [int(s) for s in shape]
+    if shape.count(-1) > 1:
+        raise ValueError("reshape accepts at most one -1 dim")
+    if -1 in shape:
+        total = int(np.prod(v.shape))
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = total // known
+    shape = tuple(shape)
+    new_idx = []
+    rem = lin
+    for s in reversed(shape):
+        new_idx.append(rem % s)
+        rem = rem // s
+    idx = jnp.stack(list(reversed(new_idx)), axis=1)
+    return _coo_out(jsparse.BCOO((v.data, idx.astype(v.indices.dtype)),
+                                 shape=shape), stop_gradient=x.stop_gradient)
+
+
+def sum(x, axis=None, keepdim=False):  # noqa: A001
+    d = x._value.todense()
+    return Tensor._wrap(jnp.sum(d, axis=axis, keepdims=keepdim))
+
+
+def is_same_shape(x, y) -> bool:
+    return tuple(x._value.shape) == tuple(y._value.shape)
 
 
 def is_sparse_coo(x):
@@ -126,3 +253,6 @@ def is_sparse_coo(x):
 def to_sparse_coo(dense: Tensor, sparse_dim=None):
     mat = jsparse.BCOO.fromdense(dense._value)
     return _coo_out(mat, stop_gradient=dense.stop_gradient)
+
+
+from paddle_tpu.sparse import nn  # noqa: E402,F401

@@ -1,0 +1,77 @@
+"""Device manager / custom-device plugin registration.
+
+Reference: phi DeviceManager (paddle/phi/backends/device_manager.h:134),
+LoadCustomRuntimeLib CUSTOM_DEVICE_ROOT scan (device_manager.h:298), fake
+test device (phi/backends/custom/fake_cpu_device.h). Here: PJRT-plugin
+registration + python-level custom device descriptors.
+"""
+
+import os
+
+import pytest
+
+from paddle_tpu.device import (
+    DeviceInterface, DeviceManager, get_all_custom_device_type,
+    is_compiled_with_custom_device, load_custom_runtime_libs,
+    register_custom_device,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    yield
+    for t in list(DeviceManager._custom):
+        DeviceManager.unregister_custom_device(t)
+
+
+def test_register_custom_device_enumerates():
+    register_custom_device("fake_npu", backend="cpu")
+    assert "fake_npu" in get_all_custom_device_type()
+    assert DeviceManager.is_custom_device("fake_npu")
+    assert is_compiled_with_custom_device("fake_npu")
+    # backed by the cpu platform: visible devices + count agree
+    n = DeviceManager.device_count("fake_npu")
+    assert n >= 1
+    assert len(DeviceManager.devices("fake_npu")) == n
+    assert "fake_npu" in DeviceManager.get_all_device_types()
+
+
+def test_unknown_custom_device_raises():
+    with pytest.raises(ValueError, match="unknown custom device"):
+        DeviceManager.get_device_interface("nonexistent_xpu")
+    assert DeviceManager.device_count("nonexistent_xpu") == 0
+
+
+def test_plugin_registration_env_contract(tmp_path, monkeypatch):
+    """register_pjrt_plugin exports PJRT_NAMES_AND_LIBRARY_PATHS (the
+    child-process contract) even when the live runtime refuses late
+    registration."""
+    monkeypatch.delenv("PJRT_NAMES_AND_LIBRARY_PATHS", raising=False)
+    fake = tmp_path / "libpjrt_mynpu.so"
+    fake.write_bytes(b"\x7fELF")
+    DeviceManager.register_pjrt_plugin("mynpu", str(fake))
+    try:
+        env = os.environ["PJRT_NAMES_AND_LIBRARY_PATHS"]
+        assert f"mynpu:{fake}" in env
+        assert is_compiled_with_custom_device("mynpu")
+    finally:
+        DeviceManager._plugins.pop("mynpu", None)
+
+
+def test_custom_runtime_root_scan(tmp_path, monkeypatch):
+    (tmp_path / "libpjrt_alpha.so").write_bytes(b"\x7fELF")
+    (tmp_path / "libpjrt_beta.so").write_bytes(b"\x7fELF")
+    (tmp_path / "libother.so").write_bytes(b"\x7fELF")
+    monkeypatch.setenv("CUSTOM_DEVICE_ROOT", str(tmp_path))
+    try:
+        loaded = load_custom_runtime_libs()
+        assert loaded == ["alpha", "beta"]
+    finally:
+        DeviceManager._plugins.pop("alpha", None)
+        DeviceManager._plugins.pop("beta", None)
+
+
+def test_device_interface_dataclass():
+    iface = DeviceInterface(device_type="npu", backend="cpu", priority=10)
+    assert iface.device_type == "npu" and iface.priority == 10
+    assert isinstance(iface.visible_devices(), list)

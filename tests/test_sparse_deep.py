@@ -1,0 +1,240 @@
+"""Sparse deepening: op breadth + conv/pool/attention layers.
+
+Reference: python/paddle/sparse/ (unary.py, binary.py, nn/) over
+phi/kernels/sparse/. TPU collapse notes in paddle_tpu/sparse/nn.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse as S
+
+
+def _rand_coo(shape, density=0.3, seed=0):
+    rng = np.random.default_rng(seed)
+    dense = rng.standard_normal(shape).astype("float32")
+    dense[rng.random(shape) > density] = 0.0
+    return S.to_sparse_coo(paddle.to_tensor(dense)), dense
+
+
+def test_unary_breadth():
+    x, d = _rand_coo((6, 8))
+    for name in ["sin", "tan", "asin", "atan", "sinh", "tanh", "asinh",
+                 "sqrt", "square", "log1p", "expm1", "deg2rad", "rad2deg",
+                 "relu", "relu6", "leaky_relu"]:
+        out = getattr(S, name)(x)
+        assert S.is_sparse_coo(out)
+        ref = {
+            "asin": lambda v: np.arcsin(np.clip(v, -1, 1)),
+            "sqrt": lambda v: np.sqrt(np.abs(v)) * 0 + np.sqrt(
+                np.where(v > 0, v, 0)),
+        }.get(name)
+        if name in ("sin", "tanh", "square", "expm1"):
+            np.testing.assert_allclose(
+                np.asarray(out.to_dense()._value),
+                getattr(np, name if name != "square" else "square")(d),
+                atol=1e-5)
+
+
+def test_pow_cast_coalesce():
+    x, d = _rand_coo((4, 5))
+    np.testing.assert_allclose(np.asarray(S.pow(x, 3).to_dense()._value),
+                               d ** 3, atol=1e-5)
+    c = S.cast(x, value_dtype="float16", index_dtype="int32")
+    assert "float16" in str(c.values()._value.dtype)
+    dup = S.sparse_coo_tensor([[0, 0], [1, 1]], [1.0, 2.0], shape=[2, 2])
+    co = S.coalesce(dup)
+    assert co.nnz == 1
+    np.testing.assert_allclose(np.asarray(co.to_dense()._value),
+                               [[0, 3.0], [0, 0]])
+
+
+def test_binary_ops():
+    x, dx = _rand_coo((5, 6), seed=1)
+    y, dy = _rand_coo((5, 6), seed=2)
+    np.testing.assert_allclose(
+        np.asarray(S.subtract(x, y).to_dense()._value), dx - dy, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(S.multiply(x, y).to_dense()._value), dx * dy, atol=1e-6)
+    v = paddle.to_tensor(np.arange(6, dtype="float32"))
+    np.testing.assert_allclose(np.asarray(S.mv(x, v)._value), dx @ np.arange(6),
+                               rtol=1e-5)
+    z = paddle.to_tensor(np.ones((6, 3), "float32"))
+    inp = paddle.to_tensor(np.ones((5, 3), "float32"))
+    np.testing.assert_allclose(np.asarray(S.addmm(inp, x, z, 0.5, 2.0)._value),
+                               0.5 + 2.0 * (dx @ np.ones((6, 3))), rtol=1e-5)
+
+
+def test_masked_matmul():
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((4, 6)).astype("float32")
+    b = rng.standard_normal((6, 5)).astype("float32")
+    mask_d = (rng.random((4, 5)) < 0.4).astype("float32")
+    mask = S.to_sparse_coo(paddle.to_tensor(mask_d))
+    out = S.masked_matmul(paddle.to_tensor(a), paddle.to_tensor(b), mask)
+    np.testing.assert_allclose(np.asarray(out.to_dense()._value),
+                               (a @ b) * mask_d, rtol=1e-4, atol=1e-5)
+
+
+def test_transpose_reshape_sum():
+    x, d = _rand_coo((3, 4, 5))
+    t = S.transpose(x, [2, 0, 1])
+    np.testing.assert_allclose(np.asarray(t.to_dense()._value),
+                               np.transpose(d, (2, 0, 1)))
+    r = S.reshape(x, [12, 5])
+    np.testing.assert_allclose(np.asarray(r.to_dense()._value),
+                               d.reshape(12, 5))
+    np.testing.assert_allclose(np.asarray(S.sum(x, axis=-1)._value),
+                               d.sum(-1), rtol=1e-5)
+    assert S.is_same_shape(x, x) and not S.is_same_shape(x, t)
+
+
+def test_sparse_conv3d_and_subm():
+    rng = np.random.default_rng(0)
+    dense = np.zeros((1, 4, 4, 4, 2), "float32")
+    # a few active voxels
+    for (i, j, k) in [(0, 0, 0), (1, 2, 3), (3, 3, 1)]:
+        dense[0, i, j, k] = rng.standard_normal(2)
+    x = S.to_sparse_coo(paddle.to_tensor(dense))
+    conv = S.nn.Conv3D(2, 4, kernel_size=3, padding=1)
+    out = conv(x)
+    assert S.is_sparse_coo(out)
+    assert tuple(out.to_dense().shape) == (1, 4, 4, 4, 4)
+
+    sub = S.nn.SubmConv3D(2, 4, kernel_size=3, padding=1)
+    sout = sub(x)
+    sd = np.asarray(sout.to_dense()._value)
+    active = np.any(dense != 0, axis=-1)
+    # submanifold property: inactive sites stay exactly zero
+    assert np.all(sd[~active] == 0)
+    assert np.any(sd[active] != 0)
+
+
+def test_sparse_conv2d_matches_dense_conv():
+    import jax
+
+    rng = np.random.default_rng(0)
+    dense = rng.standard_normal((2, 8, 8, 3)).astype("float32")
+    dense[rng.random((2, 8, 8)) > 0.3] = 0
+    x = S.to_sparse_coo(paddle.to_tensor(dense))
+    conv = S.nn.Conv2D(3, 5, kernel_size=3, padding=1)
+    out = np.asarray(conv(x).to_dense()._value)
+    w = np.asarray(conv.weight._value)
+    b = np.asarray(conv.bias._value)
+    dn = jax.lax.conv_dimension_numbers(dense.shape, w.shape,
+                                        ("NHWC", "HWIO", "NHWC"))
+    ref = np.asarray(jax.lax.conv_general_dilated(
+        dense, w, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn)) + b
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_maxpool3d():
+    x, d = _rand_coo((1, 4, 4, 4, 2), density=0.5)
+    out = S.nn.MaxPool3D(kernel_size=2)(x)
+    dd = np.asarray(out.to_dense()._value)
+    assert dd.shape == (1, 2, 2, 2, 2)
+    ref = d.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(2, 4, 6))
+    np.testing.assert_allclose(dd, np.maximum(ref, 0) + np.minimum(ref, 0)
+                               * (ref < 0) * 0 if False else
+                               np.where(np.isfinite(ref), ref, 0),
+                               rtol=1e-6)
+
+
+def test_sparse_softmax_rows():
+    d = np.array([[1.0, 0.0, 2.0], [0.0, 3.0, 0.0]], "float32")
+    x = S.to_sparse_coo(paddle.to_tensor(d))
+    out = np.asarray(S.nn.Softmax()(x).to_dense()._value)
+    # row 0 normalizes over {1, 2}; structural zeros stay zero
+    e = np.exp([1.0, 2.0])
+    np.testing.assert_allclose(out[0], [e[0] / e.sum(), 0, e[1] / e.sum()],
+                               rtol=1e-5)
+    np.testing.assert_allclose(out[1], [0, 1.0, 0], rtol=1e-6)
+
+
+def test_sparse_attention_matches_masked_dense():
+    rng = np.random.default_rng(0)
+    b, h, s, dm = 1, 2, 4, 8
+    q, k, v = (rng.standard_normal((b, h, s, dm)).astype("float32")
+               for _ in range(3))
+    mask_d = np.tril(np.ones((s, s), "float32"))         # causal pattern
+    mask_bh = np.broadcast_to(mask_d, (b * h, s, s)).copy()
+    mask = S.to_sparse_coo(paddle.to_tensor(mask_bh))
+    out = np.asarray(S.nn.functional.attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        mask)._value)
+    # dense reference with -inf masking
+    scores = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(dm)
+    scores = np.where(mask_d[None, None] > 0, scores, -1e30)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bhkd->bhqd", p, v)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_subm_conv_preserves_shape_without_padding_arg():
+    """Submanifold conv must keep input shape/sites regardless of the
+    padding argument (review regression)."""
+    dense = np.zeros((1, 6, 6, 6, 2), "float32")
+    dense[0, 2, 3, 4] = [1.0, 2.0]
+    x = S.to_sparse_coo(paddle.to_tensor(dense))
+    out = S.nn.functional.subm_conv3d(
+        x, paddle.to_tensor(np.random.default_rng(0).standard_normal(
+            (3, 3, 3, 2, 4)).astype("float32")))
+    d = np.asarray(out.to_dense()._value)
+    assert d.shape == (1, 6, 6, 6, 4)
+    active = np.any(dense != 0, axis=-1)
+    assert np.all(d[~active] == 0)
+    with pytest.raises(ValueError, match="stride=1"):
+        S.nn.functional.subm_conv3d(
+            x, paddle.to_tensor(np.ones((3, 3, 3, 2, 4), "float32")),
+            stride=2)
+
+
+def test_maxpool_negative_active_sites():
+    """Structural zeros must not dominate all-negative active values."""
+    dense = np.zeros((1, 2, 2, 2, 1), "float32")
+    dense[0, 0, 0, 0, 0] = -2.5
+    x = S.to_sparse_coo(paddle.to_tensor(dense))
+    out = np.asarray(S.nn.functional.max_pool3d(x, 2).to_dense()._value)
+    assert out.shape == (1, 1, 1, 1, 1)
+    np.testing.assert_allclose(out[0, 0, 0, 0, 0], -2.5)
+
+
+def test_sparse_reshape_infers_minus_one():
+    x, d = _rand_coo((3, 4))
+    r = S.reshape(x, [-1, 6])
+    np.testing.assert_allclose(np.asarray(r.to_dense()._value),
+                               d.reshape(-1, 6))
+    with pytest.raises(ValueError, match="at most one -1"):
+        S.reshape(x, [-1, -1])
+
+
+def test_sparse_attention_key_padding_mask():
+    rng = np.random.default_rng(0)
+    b, h, s, dm = 1, 1, 4, 8
+    q, k, v = (rng.standard_normal((b, h, s, dm)).astype("float32")
+               for _ in range(3))
+    full = np.ones((b * h, s, s), "float32")
+    mask = S.to_sparse_coo(paddle.to_tensor(full))
+    kp = np.zeros((b, s), "float32")
+    kp[0, -1] = -1e30                       # exclude last key
+    out = np.asarray(S.nn.functional.attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v), mask,
+        key_padding_mask=paddle.to_tensor(kp))._value)
+    scores = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(dm)
+    scores[..., -1] = -1e30
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bhkd->bhqd", p, v)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_batchnorm_active_sites():
+    x, d = _rand_coo((16, 4), density=0.5)
+    bn = S.nn.BatchNorm(4)
+    out = bn(x)
+    assert S.is_sparse_coo(out)
+    bn.eval()
+    out2 = bn(x)
+    assert np.isfinite(np.asarray(out2.to_dense()._value)).all()
