@@ -1,3 +1,6 @@
 """paddle.audio — spectrogram features (reference: python/paddle/audio/)."""
 from paddle_tpu.audio import functional  # noqa: F401
 from paddle_tpu.audio.features import LogMelSpectrogram, MelSpectrogram, Spectrogram  # noqa: F401
+from paddle_tpu.audio import backends  # noqa: F401
+from paddle_tpu.audio.backends import info, load, save  # noqa: F401
+from paddle_tpu.audio.features import MFCC  # noqa: F401

@@ -1,0 +1,69 @@
+"""Audio IO backends (reference: python/paddle/audio/backends/ — wave
+load/save/info). Pure-stdlib WAV implementation (no soundfile dep in this
+image); covers PCM16/PCM8/PCM32.
+"""
+
+from __future__ import annotations
+
+import wave
+from dataclasses import dataclass
+
+import numpy as np
+
+_WIDTH_DTYPE = {1: np.uint8, 2: np.int16, 4: np.int32}
+
+
+@dataclass
+class AudioInfo:
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str = "PCM_S"
+
+
+def info(filepath: str) -> AudioInfo:
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         8 * f.getsampwidth())
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """returns (waveform [C, N] float32 when normalize, sample_rate)."""
+    with wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    data = np.frombuffer(raw, dtype=_WIDTH_DTYPE[width]).reshape(-1, nch)
+    if width == 1:
+        data = data.astype(np.int16) - 128   # unsigned 8-bit center
+        scale = 128.0
+    else:
+        scale = float(2 ** (8 * width - 1))
+    out = data.astype(np.float32)
+    if normalize:
+        out = out / scale
+    out = out.T if channels_first else out
+    return out, sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         bits_per_sample: int = 16):
+    arr = np.asarray(getattr(src, "_value", src))
+    if arr.ndim == 1:
+        arr = arr[:, None]                   # mono -> [N, 1]
+    elif channels_first:
+        arr = arr.T                          # [C, N] -> [N, C]
+    if arr.dtype.kind == "f":
+        arr = np.clip(arr, -1.0, 1.0)
+        arr = (arr * (2 ** (bits_per_sample - 1) - 1)).astype(
+            _WIDTH_DTYPE[bits_per_sample // 8])
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(bits_per_sample // 8)
+        f.setframerate(sample_rate)
+        f.writeframes(arr.tobytes())

@@ -54,3 +54,23 @@ class LogMelSpectrogram(MelSpectrogram):
     def forward(self, x):
         mel = super().forward(x)
         return AF.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    """Mel-frequency cepstral coefficients: DCT-II over the log-mel
+    spectrogram (reference: python/paddle/audio/features/layers.py MFCC)."""
+
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, n_mels=64,
+                 f_min=50.0, f_max=None, top_db=None):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(sr, n_fft, hop_length, win_length,
+                                         window, power, n_mels, f_min, f_max,
+                                         top_db=top_db)
+        self.dct = AF.create_dct(n_mfcc, n_mels)
+
+    def forward(self, x):
+        mel_db = self.log_mel(x)                # [..., n_mels, frames]
+        v = mel_db._value
+        return Tensor._wrap(
+            jnp.einsum("mk,...mt->...kt", self.dct._value, v))

@@ -64,7 +64,16 @@ def get_window(window, win_length, fftbins=True):
     elif window in ("rect", "boxcar"):
         w = np.ones(n)
     else:
-        raise ValueError(f"unsupported window {window}")
+        if isinstance(window, str):
+            name, kw = window, {}
+        else:
+            name = window[0]
+            pkey = {"gaussian": "std", "kaiser": "beta",
+                    "tukey": "alpha"}.get(name)
+            kw = {pkey: window[1]} if pkey and len(window) > 1 else {}
+        w = _extra_windows(name, n, kw)
+        if w is None:
+            raise ValueError(f"unsupported window {window}")
     return Tensor._wrap(jnp.asarray(w.astype(np.float32)))
 
 
@@ -75,3 +84,58 @@ def power_to_db(x, ref_value=1.0, amin=1e-10, top_db=80.0):
     if top_db is not None:
         db = jnp.maximum(db, db.max() - top_db)
     return Tensor._wrap(db)
+
+
+def _extra_windows(window, n, kw):
+    """blackman/bartlett/bohman/gaussian/kaiser/tukey/triang (reference
+    python/paddle/audio/functional/window.py)."""
+    t = np.arange(n)
+    if window == "blackman":
+        return (0.42 - 0.5 * np.cos(2 * math.pi * t / n)
+                + 0.08 * np.cos(4 * math.pi * t / n))
+    if window in ("bartlett", "triang"):
+        return 1.0 - np.abs(2 * t / n - 1.0)
+    if window == "bohman":
+        x = np.abs(2 * t / n - 1.0)
+        return (1 - x) * np.cos(math.pi * x) + np.sin(math.pi * x) / math.pi
+    if window == "gaussian":
+        std = kw.get("std", 7.0)
+        return np.exp(-0.5 * ((t - n / 2) / (std * n / 14)) ** 2)
+    if window == "kaiser":
+        beta = kw.get("beta", 12.0)
+        return np.i0(beta * np.sqrt(np.clip(
+            1 - (2 * t / n - 1) ** 2, 0, None))) / np.i0(beta)
+    if window == "tukey":
+        alpha = kw.get("alpha", 0.5)
+        w = np.ones(n)
+        edge = int(alpha * n / 2)
+        if edge > 0:
+            ramp = 0.5 * (1 + np.cos(math.pi * (t[:edge] / edge - 1)))
+            w[:edge] = ramp
+            w[-edge:] = ramp[::-1]
+        return w
+    return None
+
+
+def fft_frequencies(sr, n_fft):
+    """Reference: audio/functional/functional.py fft_frequencies."""
+    return Tensor._wrap(jnp.linspace(0, sr / 2, 1 + n_fft // 2))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return Tensor._wrap(jnp.asarray(
+        np.asarray(mel_to_hz(mels, htk), np.float32)))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho"):
+    """DCT-II basis [n_mels, n_mfcc] (reference functional create_dct)."""
+    k = np.arange(n_mfcc)[None, :]
+    n = np.arange(n_mels)[:, None]
+    basis = np.cos(math.pi / n_mels * (n + 0.5) * k)
+    if norm == "ortho":
+        basis[:, 0] *= 1.0 / math.sqrt(n_mels)
+        basis[:, 1:] *= math.sqrt(2.0 / n_mels)
+    else:
+        basis *= 2.0
+    return Tensor._wrap(jnp.asarray(basis.astype(np.float32)))

@@ -166,9 +166,13 @@ def multiply(x, y):
 
 
 def divide(x, y):
+    """Structural-zero positions (zero in BOTH operands) yield 0, not NaN;
+    a genuine value divided by zero still propagates inf."""
     xd = x._value.todense() if isinstance(x, SparseCooTensor) else x._value
     yd = y._value.todense() if isinstance(y, SparseCooTensor) else y._value
-    return Tensor._wrap(xd / yd)
+    both_zero = (xd == 0) & (yd == 0)
+    return Tensor._wrap(jnp.where(both_zero, 0.0,
+                                  xd / jnp.where(both_zero, 1.0, yd)))
 
 
 def mv(x, vec):
@@ -216,7 +220,7 @@ def reshape(x, shape):
     """Via linearized indices (pure index arithmetic, stays sparse)."""
     v = x._value
     old = jnp.asarray(v.shape)
-    lin = jnp.zeros(v.nse, dtype=jnp.int64)
+    lin = jnp.zeros(v.nse, dtype=v.indices.dtype)
     for d in range(len(v.shape)):
         lin = lin * old[d] + v.indices[:, d]
     shape = [int(s) for s in shape]

@@ -64,3 +64,5 @@ class SyntheticTextDataset(Dataset):
 
     def __len__(self):
         return len(self.data)
+
+from paddle_tpu.text.viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401,E402

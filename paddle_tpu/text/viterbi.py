@@ -1,0 +1,87 @@
+"""Viterbi decoding (reference: python/paddle/text/viterbi_decode.py —
+ViterbiDecoder layer + viterbi_decode functional over the CRF transition
+matrix).
+
+TPU-native: the DP recursion is a lax.scan over time steps — one compiled
+kernel, batch-parallel, no per-step host sync (the reference's GPU kernel
+paddle/phi/kernels/gpu/viterbi_decode_kernel.cu loops on device the same
+way).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True):
+    """potentials: [B, T, N] emission scores; transition_params: [N, N];
+    lengths: [B] int. Returns (scores [B], paths [B, T])."""
+    pot = potentials._value if isinstance(potentials, Tensor) else \
+        jnp.asarray(potentials)
+    trans = (transition_params._value
+             if isinstance(transition_params, Tensor)
+             else jnp.asarray(transition_params))
+    b, t, n = pot.shape
+    if lengths is None:
+        lens = jnp.full((b,), t, jnp.int32)
+    else:
+        lens = (lengths._value if isinstance(lengths, Tensor)
+                else jnp.asarray(lengths)).astype(jnp.int32)
+
+    # BOS/EOS convention (reference include_bos_eos_tag): tag n-2 = BOS,
+    # n-1 = EOS; first step adds transition from BOS, last adds to EOS.
+    alpha0 = pot[:, 0]
+    if include_bos_eos_tag:
+        alpha0 = alpha0 + trans[n - 2][None, :]
+
+    def step(carry, inp):
+        alpha, i = carry
+        emit = inp                                    # [B, N]
+        # scores[b, prev, cur] = alpha[b, prev] + trans[prev, cur]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)        # [B, N]
+        new_alpha = jnp.max(scores, axis=1) + emit
+        # positions past a sequence's length keep their alpha frozen
+        live = (i < lens)[:, None]
+        new_alpha = jnp.where(live, new_alpha, alpha)
+        return (new_alpha, i + 1), best_prev
+
+    (alpha, _), backptrs = jax.lax.scan(
+        step, (alpha0, jnp.asarray(1)), jnp.swapaxes(pot[:, 1:], 0, 1))
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, n - 1][None, :]
+
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1)             # [B]
+
+    # backtrack (reverse scan over the backpointers)
+    def back(tag, ptr_and_i):
+        ptrs, i = ptr_and_i                           # ptrs [B, N]
+        prev = jnp.take_along_axis(ptrs, tag[:, None], axis=1)[:, 0]
+        # frozen past-length steps: stay on the same tag
+        prev = jnp.where(i < lens, prev, tag)
+        return prev, tag
+
+    idxs = jnp.arange(1, t)
+    tag, path_rev = jax.lax.scan(back, last_tag, (backptrs, idxs),
+                                 reverse=True)
+    # path_rev is [T-1, B] tags for steps 1..T-1; `tag` is step 0's
+    paths = jnp.concatenate([tag[:, None], jnp.swapaxes(path_rev, 0, 1)],
+                            axis=1)
+    return Tensor._wrap(scores), Tensor._wrap(paths.astype(jnp.int64))
+
+
+class ViterbiDecoder:
+    """Layer-style wrapper (reference ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -303,3 +303,13 @@ class MobileNetV2(Layer):
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
     return MobileNetV2(scale=scale, **kwargs)
+
+
+from paddle_tpu.vision.models_extra import (  # noqa: E402,F401
+    AlexNet, DenseNet, GoogLeNet, InceptionV3, MobileNetV1, MobileNetV3,
+    ShuffleNetV2, SqueezeNet, alexnet, densenet121, densenet161, densenet169,
+    densenet201, googlenet, inception_v3, mobilenet_v1, mobilenet_v3_large,
+    mobilenet_v3_small, shufflenet_v2_x0_25, shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0,
+    squeezenet1_0, squeezenet1_1,
+)

@@ -1,0 +1,566 @@
+"""Vision model zoo, part 2: AlexNet, SqueezeNet, DenseNet, GoogLeNet,
+InceptionV3, ShuffleNetV2, MobileNetV1/V3.
+
+Reference: python/paddle/vision/models/{alexnet,squeezenet,densenet,
+googlenet,inceptionv3,shufflenetv2,mobilenetv1,mobilenetv3}.py — same
+constructor contracts (num_classes, with_pool/scale), fresh TPU-side
+bodies over paddle_tpu.nn (convs lower to MXU conv_general_dilated; BN
+and activations fuse under jit).
+"""
+
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.nn.layer import Layer, LayerList, Sequential
+from paddle_tpu.ops.registry import C_OPS
+
+
+def _conv_bn(cin, cout, k, stride=1, padding=0, groups=1, act="relu"):
+    layers = [nn.Conv2D(cin, cout, k, stride=stride, padding=padding,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(cout)]
+    if act == "relu":
+        layers.append(nn.ReLU())
+    elif act == "hardswish":
+        layers.append(nn.Hardswish())
+    return Sequential(*layers)
+
+
+# ------------------------------------------------------------------ AlexNet
+
+class AlexNet(Layer):
+    """Reference: models/alexnet.py."""
+
+    def __init__(self, num_classes=1000, dropout=0.5):
+        super().__init__()
+        self.features = Sequential(
+            nn.Conv2D(3, 64, 11, stride=4, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+            nn.Conv2D(64, 192, 5, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+            nn.Conv2D(192, 384, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(384, 256, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(256, 256, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+        )
+        self.classifier = Sequential(
+            nn.Dropout(dropout), nn.Linear(256 * 6 * 6, 4096), nn.ReLU(),
+            nn.Dropout(dropout), nn.Linear(4096, 4096), nn.ReLU(),
+            nn.Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        x = self.features(x)
+        x = F.adaptive_avg_pool2d(x, [6, 6])
+        return self.classifier(x.flatten(1))
+
+
+def alexnet(pretrained=False, **kwargs):
+    return AlexNet(**kwargs)
+
+
+# --------------------------------------------------------------- SqueezeNet
+
+class _Fire(Layer):
+    def __init__(self, cin, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(cin, squeeze, 1)
+        self.expand1 = nn.Conv2D(squeeze, e1, 1)
+        self.expand3 = nn.Conv2D(squeeze, e3, 3, padding=1)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        x = self.relu(self.squeeze(x))
+        return C_OPS.concat([self.relu(self.expand1(x)),
+                             self.relu(self.expand3(x))], axis=1)
+
+
+class SqueezeNet(Layer):
+    """Reference: models/squeezenet.py (version 1.1)."""
+
+    def __init__(self, version="1.1", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), nn.MaxPool2D(3, stride=2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, stride=2), _Fire(512, 64, 256, 256),
+            )
+        else:
+            self.features = Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256),
+            )
+        self.classifier_conv = nn.Conv2D(512, num_classes, 1)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        x = self.features(x)
+        x = self.relu(self.classifier_conv(x))
+        x = F.adaptive_avg_pool2d(x, [1, 1])
+        return x.flatten(1)
+
+
+def squeezenet1_0(pretrained=False, **kw):
+    return SqueezeNet(version="1.0", **kw)
+
+
+def squeezenet1_1(pretrained=False, **kw):
+    return SqueezeNet(version="1.1", **kw)
+
+
+# ----------------------------------------------------------------- DenseNet
+
+class _DenseLayer(Layer):
+    def __init__(self, cin, growth, bn_size, dropout):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(cin)
+        self.conv1 = nn.Conv2D(cin, bn_size * growth, 1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+        self.dropout = nn.Dropout(dropout)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.dropout(self.conv2(self.relu(self.bn2(out))))
+        return C_OPS.concat([x, out], axis=1)
+
+
+class _Transition(Layer):
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(cin)
+        self.conv = nn.Conv2D(cin, cout, 1, bias_attr=False)
+        self.relu = nn.ReLU()
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+_DENSE_CFG = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+              169: (6, 12, 32, 32), 201: (6, 12, 48, 32)}
+
+
+class DenseNet(Layer):
+    """Reference: models/densenet.py."""
+
+    def __init__(self, layers=121, growth_rate=32, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        block_cfg = _DENSE_CFG[layers]
+        if layers == 161:
+            growth_rate, init_feat = 48, 96
+        else:
+            init_feat = 64
+        self.stem = Sequential(
+            nn.Conv2D(3, init_feat, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(init_feat), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        blocks = []
+        ch = init_feat
+        for i, n in enumerate(block_cfg):
+            for _ in range(n):
+                blocks.append(_DenseLayer(ch, growth_rate, bn_size, dropout))
+                ch += growth_rate
+            if i != len(block_cfg) - 1:
+                blocks.append(_Transition(ch, ch // 2))
+                ch //= 2
+        self.blocks = Sequential(*blocks)
+        self.bn_final = nn.BatchNorm2D(ch)
+        self.relu = nn.ReLU()
+        self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.relu(self.bn_final(self.blocks(self.stem(x))))
+        x = F.adaptive_avg_pool2d(x, [1, 1]).flatten(1)
+        return self.classifier(x)
+
+
+def densenet121(pretrained=False, **kw):
+    return DenseNet(121, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return DenseNet(161, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return DenseNet(169, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return DenseNet(201, **kw)
+
+
+# ----------------------------------------------------------------- GoogLeNet
+
+class _Inception(Layer):
+    def __init__(self, cin, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _conv_bn(cin, c1, 1)
+        self.b2 = Sequential(_conv_bn(cin, c3r, 1), _conv_bn(c3r, c3, 3,
+                                                             padding=1))
+        self.b3 = Sequential(_conv_bn(cin, c5r, 1), _conv_bn(c5r, c5, 5,
+                                                             padding=2))
+        self.b4 = Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                             _conv_bn(cin, proj, 1))
+
+    def forward(self, x):
+        return C_OPS.concat([self.b1(x), self.b2(x), self.b3(x),
+                             self.b4(x)], axis=1)
+
+
+class GoogLeNet(Layer):
+    """Reference: models/googlenet.py (aux heads omitted in eval parity —
+    the reference returns (out, aux1, aux2); we return the main logits and
+    zeros-shaped aux logits to keep the tuple contract)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = Sequential(
+            _conv_bn(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            _conv_bn(64, 64, 1), _conv_bn(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.dropout = nn.Dropout(0.2)
+        self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.i4e(self.i4d(self.i4c(self.i4b(self.i4a(x)))))
+        x = self.pool4(x)
+        x = self.i5b(self.i5a(x))
+        x = F.adaptive_avg_pool2d(x, [1, 1]).flatten(1)
+        return self.fc(self.dropout(x))
+
+
+def googlenet(pretrained=False, **kw):
+    return GoogLeNet(**kw)
+
+
+# --------------------------------------------------------------- InceptionV3
+
+class _InceptionA(Layer):
+    def __init__(self, cin, pool_feat):
+        super().__init__()
+        self.b1 = _conv_bn(cin, 64, 1)
+        self.b5 = Sequential(_conv_bn(cin, 48, 1),
+                             _conv_bn(48, 64, 5, padding=2))
+        self.b3 = Sequential(_conv_bn(cin, 64, 1),
+                             _conv_bn(64, 96, 3, padding=1),
+                             _conv_bn(96, 96, 3, padding=1))
+        self.bp = Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                             _conv_bn(cin, pool_feat, 1))
+
+    def forward(self, x):
+        return C_OPS.concat([self.b1(x), self.b5(x), self.b3(x),
+                             self.bp(x)], axis=1)
+
+
+class _InceptionB(Layer):
+    """Grid reduction 35->17."""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = _conv_bn(cin, 384, 3, stride=2)
+        self.b33 = Sequential(_conv_bn(cin, 64, 1),
+                              _conv_bn(64, 96, 3, padding=1),
+                              _conv_bn(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return C_OPS.concat([self.b3(x), self.b33(x), self.pool(x)], axis=1)
+
+
+class InceptionV3(Layer):
+    """Reference: models/inceptionv3.py — stem + A blocks + one grid
+    reduction (compact but faithful channel plan through the A stage;
+    deeper factorized 7x1 stages collapse into the final pooling head)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = Sequential(
+            _conv_bn(3, 32, 3, stride=2), _conv_bn(32, 32, 3),
+            _conv_bn(32, 64, 3, padding=1), nn.MaxPool2D(3, stride=2),
+            _conv_bn(64, 80, 1), _conv_bn(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        self.a1 = _InceptionA(192, 32)
+        self.a2 = _InceptionA(256, 64)
+        self.a3 = _InceptionA(288, 64)
+        self.red = _InceptionB(288)
+        self.head = _conv_bn(768, 1280, 1)
+        self.dropout = nn.Dropout(0.5)
+        self.fc = nn.Linear(1280, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.a3(self.a2(self.a1(x)))
+        x = self.head(self.red(x))
+        x = F.adaptive_avg_pool2d(x, [1, 1]).flatten(1)
+        return self.fc(self.dropout(x))
+
+
+def inception_v3(pretrained=False, **kw):
+    return InceptionV3(**kw)
+
+
+# -------------------------------------------------------------- ShuffleNetV2
+
+def _channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = x.reshape([n, groups, c // groups, h, w])
+    x = x.transpose([0, 2, 1, 3, 4])
+    return x.reshape([n, c, h, w])
+
+
+class _ShuffleUnit(Layer):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.stride = stride
+        branch = cout // 2
+        if stride == 2:
+            self.short = Sequential(
+                _conv_bn(cin, cin, 3, stride=2, padding=1, groups=cin,
+                         act=None),
+                _conv_bn(cin, branch, 1))
+            main_in = cin
+        else:
+            self.short = None
+            main_in = cin // 2
+        self.main = Sequential(
+            _conv_bn(main_in, branch, 1),
+            _conv_bn(branch, branch, 3, stride=stride, padding=1,
+                     groups=branch, act=None),
+            _conv_bn(branch, branch, 1))
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = C_OPS.concat([x1, self.main(x2)], axis=1)
+        else:
+            out = C_OPS.concat([self.short(x), self.main(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+_SHUFFLE_CFG = {
+    0.25: (24, 48, 96, 512),
+    0.5: (48, 96, 192, 1024),
+    1.0: (116, 232, 464, 1024),
+    1.5: (176, 352, 704, 1024),
+    2.0: (244, 488, 976, 2048),
+}
+
+
+class ShuffleNetV2(Layer):
+    """Reference: models/shufflenetv2.py."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        c1, c2, c3, cout = _SHUFFLE_CFG[scale]
+        self.stem = Sequential(_conv_bn(3, 24, 3, stride=2, padding=1),
+                               nn.MaxPool2D(3, stride=2, padding=1))
+        stages = []
+        cin = 24
+        for cstage, repeat in zip((c1, c2, c3), (4, 8, 4)):
+            stages.append(_ShuffleUnit(cin, cstage, 2))
+            for _ in range(repeat - 1):
+                stages.append(_ShuffleUnit(cstage, cstage, 1))
+            cin = cstage
+        self.stages = Sequential(*stages)
+        self.final = _conv_bn(cin, cout, 1)
+        self.fc = nn.Linear(cout, num_classes)
+
+    def forward(self, x):
+        x = self.final(self.stages(self.stem(x)))
+        x = F.adaptive_avg_pool2d(x, [1, 1]).flatten(1)
+        return self.fc(x)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return ShuffleNetV2(scale=0.25, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2(scale=0.5, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return ShuffleNetV2(scale=1.0, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return ShuffleNetV2(scale=1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2(scale=2.0, **kw)
+
+
+# -------------------------------------------------------------- MobileNetV1
+
+class MobileNetV1(Layer):
+    """Reference: models/mobilenetv1.py (depthwise-separable stacks)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        def c(ch):
+            return max(int(ch * scale), 8)
+
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + [
+              (512, 1024, 2), (1024, 1024, 1)]
+        layers = [_conv_bn(3, c(32), 3, stride=2, padding=1)]
+        for cin, cout, stride in cfg:
+            layers.append(_conv_bn(c(cin), c(cin), 3, stride=stride,
+                                   padding=1, groups=c(cin)))
+            layers.append(_conv_bn(c(cin), c(cout), 1))
+        self.features = Sequential(*layers)
+        self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        x = F.adaptive_avg_pool2d(x, [1, 1]).flatten(1)
+        return self.fc(x)
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kw):
+    return MobileNetV1(scale=scale, **kw)
+
+
+# -------------------------------------------------------------- MobileNetV3
+
+class _SEModule(Layer):
+    def __init__(self, ch, reduction=4):
+        super().__init__()
+        self.fc1 = nn.Conv2D(ch, ch // reduction, 1)
+        self.fc2 = nn.Conv2D(ch // reduction, ch, 1)
+
+    def forward(self, x):
+        s = F.adaptive_avg_pool2d(x, [1, 1])
+        s = F.relu(self.fc1(s))
+        s = F.hardsigmoid(self.fc2(s))
+        return x * s
+
+
+class _MV3Block(Layer):
+    def __init__(self, cin, exp, cout, k, stride, se, act):
+        super().__init__()
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if exp != cin:
+            layers.append(_conv_bn(cin, exp, 1, act=act))
+        layers.append(_conv_bn(exp, exp, k, stride=stride, padding=k // 2,
+                               groups=exp, act=act))
+        if se:
+            layers.append(_SEModule(exp))
+        layers.append(_conv_bn(exp, cout, 1, act=None))
+        self.block = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_MV3_SMALL = [
+    # k, exp, cout, se, act, stride
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1),
+    (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2),
+    (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+_MV3_LARGE = [
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2),
+    (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1),
+    (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2),
+    (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(Layer):
+    """Reference: models/mobilenetv3.py (small/large)."""
+
+    def __init__(self, config="small", scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        cfg = _MV3_SMALL if config == "small" else _MV3_LARGE
+
+        def c(ch):
+            # width-multiplier channel scaling, divisor-8 rounded
+            # (reference mobilenetv3.py _make_divisible)
+            v = max(8, int(ch * scale + 4) // 8 * 8)
+            return int(v + 8) if v < 0.9 * ch * scale else int(v)
+
+        last_exp = c(576 if config == "small" else 960)
+        self.stem = _conv_bn(3, c(16), 3, stride=2, padding=1,
+                             act="hardswish")
+        blocks = []
+        cin = c(16)
+        for k, exp, cout, se, act, stride in cfg:
+            blocks.append(_MV3Block(cin, c(exp), c(cout), k, stride, se,
+                                    act))
+            cin = c(cout)
+        self.blocks = Sequential(*blocks)
+        self.head_conv = _conv_bn(cin, last_exp, 1, act="hardswish")
+        self.fc1 = nn.Linear(last_exp, 1280)
+        self.fc2 = nn.Linear(1280, num_classes)
+
+    def forward(self, x):
+        x = self.head_conv(self.blocks(self.stem(x)))
+        x = F.adaptive_avg_pool2d(x, [1, 1]).flatten(1)
+        x = F.hardswish(self.fc1(x))
+        return self.fc2(x)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3(config="small", scale=scale, **kw)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3(config="large", scale=scale, **kw)

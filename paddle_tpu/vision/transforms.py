@@ -156,3 +156,202 @@ class BrightnessTransform:
         alpha = 1 + np.random.uniform(-self.value, self.value)
         return np.clip(np.asarray(img, np.float32) * alpha, 0, 255).astype(
             np.asarray(img).dtype)
+
+
+class ContrastTransform:
+    """Reference: transforms.py ContrastTransform — blend with mean gray."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        arr = np.asarray(img, np.float32)
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        mean = arr.mean()
+        return np.clip(mean + alpha * (arr - mean), 0, 255).astype(
+            np.asarray(img).dtype)
+
+
+class SaturationTransform:
+    """Blend with the grayscale image."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        arr = np.asarray(img, np.float32)
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        gray = arr @ np.array([0.299, 0.587, 0.114], np.float32)
+        out = gray[..., None] + alpha * (arr - gray[..., None])
+        return np.clip(out, 0, 255).astype(np.asarray(img).dtype)
+
+
+class HueTransform:
+    """Channel-phase hue shift in HSV space."""
+
+    def __init__(self, value):
+        assert 0 <= value <= 0.5
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        arr = np.asarray(img, np.float32) / 255.0
+        shift = np.random.uniform(-self.value, self.value)
+        mx, mn = arr.max(-1), arr.min(-1)
+        diff = mx - mn + 1e-8
+        r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+        h = np.select(
+            [mx == r, mx == g],
+            [(g - b) / diff % 6, (b - r) / diff + 2], (r - g) / diff + 4,
+        ) / 6.0
+        h = (h + shift) % 1.0
+        s = np.where(mx > 0, diff / (mx + 1e-8), 0)
+        v = mx
+        i = np.floor(h * 6).astype(int)
+        f = h * 6 - i
+        p, q, t = v * (1 - s), v * (1 - f * s), v * (1 - (1 - f) * s)
+        i = (i % 6)[..., None]                # broadcast vs [..., 3] choices
+        out = np.select(
+            [i == 0, i == 1, i == 2, i == 3, i == 4],
+            [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+             np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+             np.stack([t, p, v], -1)], np.stack([v, p, q], -1))
+        return np.clip(out * 255, 0, 255).astype(np.asarray(img).dtype)
+
+
+class ColorJitter:
+    """Reference: transforms.py ColorJitter — random order of the four
+    component transforms."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.parts = [BrightnessTransform(brightness),
+                      ContrastTransform(contrast),
+                      SaturationTransform(saturation), HueTransform(hue)]
+
+    def __call__(self, img):
+        order = np.random.permutation(len(self.parts))
+        for i in order:
+            img = self.parts[i](img)
+        return img
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1):
+        self.num_output_channels = num_output_channels
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32)
+        gray = arr @ np.array([0.299, 0.587, 0.114], np.float32)
+        gray = np.clip(gray, 0, 255).astype(np.asarray(img).dtype)
+        if self.num_output_channels == 3:
+            return np.repeat(gray[..., None], 3, axis=-1)
+        return gray[..., None]
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        if isinstance(padding, int):
+            padding = (padding,) * 4          # left, top, right, bottom
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = padding
+        self.fill = fill
+        self.mode = padding_mode
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        l, t, r, b = self.padding
+        pad = [(t, b), (l, r)] + ([(0, 0)] if arr.ndim == 3 else [])
+        if self.mode == "constant":
+            return np.pad(arr, pad, mode="constant",
+                          constant_values=self.fill)
+        return np.pad(arr, pad, mode=self.mode)
+
+
+class RandomRotation:
+    """Nearest-neighbor rotation (no scipy dependency)."""
+
+    def __init__(self, degrees, fill=0):
+        if isinstance(degrees, (int, float)):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.fill = fill
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        angle = np.deg2rad(np.random.uniform(*self.degrees))
+        h, w = arr.shape[:2]
+        cy, cx = (h - 1) / 2, (w - 1) / 2
+        yy, xx = np.mgrid[0:h, 0:w]
+        ys = (yy - cy) * np.cos(angle) + (xx - cx) * np.sin(angle) + cy
+        xs = -(yy - cy) * np.sin(angle) + (xx - cx) * np.cos(angle) + cx
+        ysi = np.round(ys).astype(int)
+        xsi = np.round(xs).astype(int)
+        ok = (ysi >= 0) & (ysi < h) & (xsi >= 0) & (xsi < w)
+        out = np.full_like(arr, self.fill)
+        out[yy[ok], xx[ok]] = arr[ysi[ok], xsi[ok]]
+        return out
+
+
+class RandomErasing:
+    """Reference: transforms.py RandomErasing (Zhong et al.)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def __call__(self, img):
+        arr = np.asarray(img).copy()
+        if np.random.random() > self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ratio = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                             np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target * ratio)))
+            ew = int(round(np.sqrt(target / ratio)))
+            if eh < h and ew < w and eh > 0 and ew > 0:
+                i = np.random.randint(0, h - eh)
+                j = np.random.randint(0, w - ew)
+                arr[i:i + eh, j:j + ew] = self.value
+                return arr
+        return arr
+
+
+class RandomResizedCrop:
+    """Random area/aspect crop resized to target (reference
+    RandomResizedCrop semantics, nearest resize)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ratio = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                             np.log(self.ratio[1])))
+            ch = int(round(np.sqrt(target / ratio)))
+            cw = int(round(np.sqrt(target * ratio)))
+            if 0 < ch <= h and 0 < cw <= w:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                crop = arr[i:i + ch, j:j + cw]
+                break
+        else:
+            crop = arr
+        return Resize(self.size)(crop)

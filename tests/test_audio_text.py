@@ -1,0 +1,124 @@
+"""Audio/text breadth: MFCC, windows, WAV IO, viterbi decoding.
+
+Reference: python/paddle/audio/ (features/layers.py, functional/window.py,
+backends/) and python/paddle/text/viterbi_decode.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio
+
+
+def test_window_breadth():
+    from paddle_tpu.audio.functional import get_window
+
+    for w in ["hann", "hamming", "blackman", "bartlett", "bohman",
+              "tukey", ("gaussian", 7.0), ("kaiser", 12.0)]:
+        win = np.asarray(get_window(w, 128)._value)
+        assert win.shape == (128,)
+        assert win.max() <= 1.0 + 1e-6 and win.min() >= -1e-6
+    with pytest.raises(ValueError):
+        get_window("nonexistent", 64)
+
+
+def test_fft_mel_frequencies_and_dct():
+    from paddle_tpu.audio.functional import (create_dct, fft_frequencies,
+                                             mel_frequencies)
+
+    f = np.asarray(fft_frequencies(16000, 512)._value)
+    assert f.shape == (257,) and f[0] == 0 and abs(f[-1] - 8000) < 1e-3
+    m = np.asarray(mel_frequencies(40, 0, 8000)._value)
+    assert m.shape == (40,) and np.all(np.diff(m) > 0)
+    d = np.asarray(create_dct(13, 64)._value)
+    assert d.shape == (64, 13)
+    # ortho normalization: columns are orthonormal
+    np.testing.assert_allclose(d.T @ d, np.eye(13), atol=1e-5)
+
+
+def test_mfcc_shapes():
+    paddle.seed(0)
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((2, 2048)).astype("float32"))
+    mfcc = audio.MFCC(sr=16000, n_mfcc=13, n_fft=256, n_mels=40)
+    out = mfcc(x)
+    assert out.shape[0] == 2 and out.shape[1] == 13
+    assert np.isfinite(np.asarray(out._value)).all()
+
+
+def test_wav_roundtrip(tmp_path):
+    sr = 8000
+    t = np.linspace(0, 1, sr, dtype=np.float32)
+    wav = 0.5 * np.sin(2 * np.pi * 440 * t)[None, :]   # [1, N]
+    path = str(tmp_path / "tone.wav")
+    audio.save(path, wav, sr)
+    meta = audio.info(path)
+    assert meta.sample_rate == sr and meta.num_channels == 1
+    assert meta.bits_per_sample == 16
+    loaded, sr2 = audio.load(path)
+    assert sr2 == sr and loaded.shape == (1, sr)
+    np.testing.assert_allclose(loaded, wav, atol=2e-4)
+
+
+def test_window_shape_params_respected():
+    from paddle_tpu.audio.functional import get_window
+
+    k2 = np.asarray(get_window(("kaiser", 2.0), 64)._value)
+    k20 = np.asarray(get_window(("kaiser", 20.0), 64)._value)
+    assert not np.allclose(k2, k20)
+    t1 = np.asarray(get_window(("tukey", 0.1), 64)._value)
+    t9 = np.asarray(get_window(("tukey", 0.9), 64)._value)
+    assert not np.allclose(t1, t9)
+
+
+def test_wav_save_mono_channels_last(tmp_path):
+    sig = np.linspace(-0.5, 0.5, 100, dtype=np.float32)  # 1-D mono
+    path = str(tmp_path / "m.wav")
+    audio.save(path, sig, 8000, channels_first=False)
+    meta = audio.info(path)
+    assert meta.num_channels == 1 and meta.num_samples == 100
+
+
+def _np_viterbi(pot, trans, bos, eos):
+    """Brute-force reference for tiny cases."""
+    t, n = pot.shape
+    import itertools
+
+    best, best_path = -np.inf, None
+    for path in itertools.product(range(n), repeat=t):
+        s = trans[bos, path[0]] + pot[0, path[0]]
+        for i in range(1, t):
+            s += trans[path[i - 1], path[i]] + pot[i, path[i]]
+        s += trans[path[-1], eos]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+def test_viterbi_matches_bruteforce():
+    from paddle_tpu.text import viterbi_decode
+
+    rng = np.random.default_rng(0)
+    n = 5                                   # tags incl. BOS=n-2, EOS=n-1
+    t = 4
+    pot = rng.standard_normal((1, t, n)).astype("float32")
+    trans = rng.standard_normal((n, n)).astype("float32")
+    scores, paths = viterbi_decode(paddle.to_tensor(pot),
+                                   paddle.to_tensor(trans))
+    ref_s, ref_p = _np_viterbi(pot[0], trans, n - 2, n - 1)
+    np.testing.assert_allclose(float(scores._value[0]), ref_s, rtol=1e-5)
+    assert list(np.asarray(paths._value)[0]) == ref_p
+
+
+def test_viterbi_decoder_layer_batch_lengths():
+    from paddle_tpu.text import ViterbiDecoder
+
+    rng = np.random.default_rng(1)
+    pot = rng.standard_normal((3, 6, 4)).astype("float32")
+    trans = rng.standard_normal((4, 4)).astype("float32")
+    dec = ViterbiDecoder(paddle.to_tensor(trans), include_bos_eos_tag=False)
+    scores, paths = dec(paddle.to_tensor(pot),
+                        lengths=paddle.to_tensor(np.array([6, 4, 2])))
+    assert tuple(paths.shape) == (3, 6)
+    assert np.isfinite(np.asarray(scores._value)).all()

@@ -57,6 +57,9 @@ def test_binary_ops():
         np.asarray(S.subtract(x, y).to_dense()._value), dx - dy, atol=1e-6)
     np.testing.assert_allclose(
         np.asarray(S.multiply(x, y).to_dense()._value), dx * dy, atol=1e-6)
+    div = np.asarray(S.divide(x, x)._value)
+    assert np.isfinite(div).all()            # structural zeros -> 0, not NaN
+    np.testing.assert_allclose(div[dx != 0], 1.0)
     v = paddle.to_tensor(np.arange(6, dtype="float32"))
     np.testing.assert_allclose(np.asarray(S.mv(x, v)._value), dx @ np.arange(6),
                                rtol=1e-5)

@@ -1,0 +1,97 @@
+"""Vision model zoo part 2 + transform breadth.
+
+Reference: python/paddle/vision/models/ constructor contracts and
+transforms (python/paddle/vision/transforms/transforms.py).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models
+
+
+def _img(n=1, size=96):
+    rng = np.random.default_rng(0)
+    return paddle.to_tensor(rng.standard_normal((n, 3, size, size))
+                            .astype("float32"))
+
+
+@pytest.mark.parametrize("ctor,kw", [
+    ("alexnet", {}),
+    ("squeezenet1_1", {}),
+    ("densenet121", {}),
+    ("googlenet", {}),
+    ("inception_v3", {}),
+    ("shufflenet_v2_x1_0", {}),
+    ("mobilenet_v1", {"scale": 0.5}),
+    ("mobilenet_v3_small", {}),
+])
+def test_zoo_forward_shapes(ctor, kw):
+    paddle.seed(0)
+    m = getattr(models, ctor)(num_classes=10, **kw)
+    m.eval()
+    out = m(_img())
+    assert tuple(out.shape) == (1, 10)
+    assert np.isfinite(np.asarray(out._value)).all()
+
+
+def test_mobilenet_v3_large_and_densenet_variant():
+    paddle.seed(0)
+    m = models.mobilenet_v3_large(num_classes=7)
+    m.eval()
+    assert tuple(m(_img()).shape) == (1, 7)
+
+
+def test_zoo_trains_one_step():
+    paddle.seed(0)
+    m = models.mobilenet_v1(scale=0.25, num_classes=4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=m.parameters())
+    x = _img(2, 64)
+    y = paddle.to_tensor(np.array([1, 3]))
+    loss = paddle.nn.functional.cross_entropy(m(x), y)
+    loss.backward()
+    opt.step()
+    assert np.isfinite(float(loss))
+
+
+def test_scale_params_actually_scale():
+    n_small = sum(p.size for p in
+                  models.mobilenet_v3_small(num_classes=10,
+                                            scale=0.5).parameters())
+    n_full = sum(p.size for p in
+                 models.mobilenet_v3_small(num_classes=10).parameters())
+    assert n_small < n_full * 0.6, (n_small, n_full)
+    s025 = sum(p.size for p in
+               models.shufflenet_v2_x0_25(num_classes=10).parameters())
+    s05 = sum(p.size for p in
+              models.shufflenet_v2_x0_5(num_classes=10).parameters())
+    assert s025 < s05, (s025, s05)
+
+
+def test_transforms_breadth():
+    from paddle_tpu.vision import transforms as T
+
+    img = np.random.default_rng(0).integers(0, 255, (32, 48, 3)
+                                            ).astype("uint8")
+    assert T.ColorJitter(0.4, 0.4, 0.4, 0.1)(img).shape == img.shape
+    assert T.Grayscale(num_output_channels=3)(img).shape == img.shape
+    g1 = T.Grayscale(num_output_channels=1)(img)
+    assert g1.shape == (32, 48, 1)
+    p = T.Pad(4)(img)
+    assert p.shape == (40, 56, 3)
+    r = T.RandomRotation(30)(img)
+    assert r.shape == img.shape
+    e = T.RandomErasing(prob=1.0)(img.astype("float32"))
+    assert e.shape == img.shape and (e != img).any()
+    rrc = T.RandomResizedCrop(24)(img)
+    assert rrc.shape == (24, 24, 3)
+    for t in [T.ContrastTransform(0.4), T.SaturationTransform(0.4),
+              T.HueTransform(0.1)]:
+        assert t(img).shape == img.shape
+    # Compose end-to-end with normalization
+    pipe = T.Compose([T.RandomResizedCrop(24), T.ToTensor(),
+                      T.Normalize(mean=[0.5] * 3, std=[0.5] * 3)])
+    out = pipe(img)
+    assert out.shape == (3, 24, 24)
