@@ -98,3 +98,8 @@ segment_sum = make_op_function("geo_segment_sum")
 segment_mean = make_op_function("geo_segment_mean")
 segment_max = make_op_function("geo_segment_max")
 segment_min = make_op_function("geo_segment_min")
+
+from paddle_tpu.geometric.sampling import (  # noqa: F401,E402
+    khop_sampler, reindex_graph, sample_neighbors, send_uv,
+    weighted_sample_neighbors,
+)

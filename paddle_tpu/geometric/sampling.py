@@ -1,0 +1,199 @@
+"""Graph sampling & reindex — paddle.geometric sampling family.
+
+Reference: python/paddle/geometric/sampling/neighbors.py (sample_neighbors
+:68, weighted_sample_neighbors:256), reindex.py:34, incubate
+graph_khop_sampler, message_passing/send_recv.py:413 (send_uv) over the
+phi graph_sample_neighbors / graph_reindex / graph_khop_sampler kernels.
+
+TPU-native split: neighbor sampling produces DYNAMIC-size outputs and
+feeds the input pipeline, so it runs host-side on numpy (same place the
+reference runs it for CPUPlace); `send_uv` is dense gather+op math and
+runs on device, differentiably, through the dispatcher.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.registry import OPS, OpDef, dispatch
+
+
+def _np(x):
+    return np.asarray(x._value) if isinstance(x, Tensor) else np.asarray(x)
+
+
+def _wrap(x):
+    return Tensor._wrap(jnp.asarray(x))
+
+
+def _sample_one(row, colptr, node, k, rng, weight=None):
+    beg, end = int(colptr[node]), int(colptr[node + 1])
+    neigh = row[beg:end]
+    if k < 0 or len(neigh) <= k:
+        return neigh, np.arange(beg, end)
+    if weight is None:
+        pick = rng.choice(len(neigh), size=k, replace=False)
+    else:
+        wv = weight[beg:end].astype(np.float64)
+        if wv.sum() > 0:
+            p = wv / wv.sum()
+            # zero-weight edges are unsampleable: cap k at the nonzero count
+            k = min(k, int((wv > 0).sum()))
+            pick = rng.choice(len(neigh), size=k, replace=False, p=p)
+        else:
+            pick = rng.choice(len(neigh), size=k, replace=False)
+    return neigh[pick], beg + pick
+
+
+def _sample_impl(row, colptr, input_nodes, sample_size, eids, return_eids,
+                 weight=None):
+    rv, cv, nv = _np(row), _np(colptr), _np(input_nodes)
+    ev = _np(eids) if eids is not None else None
+    wv = _np(weight) if weight is not None else None
+    rng = np.random.default_rng()
+    outs, cnts, oeids = [], [], []
+    for node in nv:
+        neigh, idx = _sample_one(rv, cv, int(node), int(sample_size), rng,
+                                 weight=wv)
+        outs.append(neigh)
+        cnts.append(len(neigh))
+        if return_eids:
+            oeids.append(ev[idx] if ev is not None else idx)
+    out = _wrap(np.concatenate(outs) if outs else np.zeros(0, rv.dtype))
+    cnt = _wrap(np.asarray(cnts, np.int32))
+    if return_eids:
+        return out, cnt, _wrap(np.concatenate(oeids) if oeids
+                               else np.zeros(0, np.int64))
+    return out, cnt
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling on a CSC graph. Returns
+    (out_neighbors, out_count[, out_eids])."""
+    return _sample_impl(row, colptr, input_nodes, sample_size, eids,
+                        return_eids)
+
+
+OPS.setdefault("graph_sample_neighbors",
+               OpDef("graph_sample_neighbors", lambda r, c, n: r, diff=False,
+                     dynamic=True, method=False))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-proportional sampling without replacement (reference
+    weighted_sample_neighbors — A-Res reservoir there, np.choice here)."""
+    return _sample_impl(row, colptr, input_nodes, sample_size, eids,
+                        return_eids, weight=edge_weight)
+
+
+OPS.setdefault("weighted_sample_neighbors",
+               OpDef("weighted_sample_neighbors", lambda r, c, w, n: r,
+                     diff=False, dynamic=True, method=False))
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Relabel sampled subgraph nodes to dense local ids. Returns
+    (reindex_src, reindex_dst, out_nodes) — reference reindex.py:34."""
+    xv, nbv, cv = _np(x), _np(neighbors), _np(count)
+    out_nodes = list(xv.tolist())
+    seen = {int(n): i for i, n in enumerate(xv)}
+    src = np.empty(len(nbv), np.int64)
+    for i, n in enumerate(nbv.tolist()):
+        if n not in seen:
+            seen[n] = len(out_nodes)
+            out_nodes.append(n)
+        src[i] = seen[n]
+    dst = np.repeat(np.arange(len(xv)), cv)
+    return (_wrap(src), _wrap(dst.astype(np.int64)),
+            _wrap(np.asarray(out_nodes, xv.dtype)))
+
+
+OPS.setdefault("reindex_graph", OpDef("reindex_graph", lambda x, n, c: x,
+                                      diff=False, dynamic=True,
+                                      method=False))
+
+
+def khop_sampler(row, colptr, input_nodes, sample_sizes, sorted_eids=None,
+                 return_eids=False, name=None):
+    """Multi-hop sampling (incubate graph_khop_sampler): chain
+    sample_neighbors per hop, then one reindex over the union. Returns
+    (edge_src, edge_dst, sample_index, reindex_x[, edge_eids])."""
+    cur = input_nodes
+    all_neigh, all_cnt, all_eids = [], [], []
+    base = [_np(input_nodes)]
+    for k in sample_sizes:
+        res = sample_neighbors(row, colptr, cur, sample_size=k,
+                               eids=sorted_eids, return_eids=return_eids)
+        neigh, cnt = res[0], res[1]
+        all_neigh.append(_np(neigh))
+        all_cnt.append((_np(cur), _np(cnt)))
+        if return_eids:
+            all_eids.append(_np(res[2]))
+        base.append(_np(neigh))
+        cur = neigh
+    # union in first-seen order; edges expressed in local ids
+    seen, order = {}, []
+
+    def local(n):
+        if n not in seen:
+            seen[n] = len(order)
+            order.append(n)
+        return seen[n]
+
+    for n in base[0].tolist():
+        local(int(n))
+    src, dst = [], []
+    for (nodes, cnts), neigh in zip(all_cnt, all_neigh):
+        pos = 0
+        for node, c in zip(nodes.tolist(), cnts.tolist()):
+            d = local(int(node))
+            for m in neigh[pos:pos + c].tolist():
+                src.append(local(int(m)))
+                dst.append(d)
+            pos += c
+    sample_index = np.asarray(order, np.int64)
+    reindex_x = np.asarray([seen[int(n)] for n in base[0]], np.int64)
+    outs = (_wrap(np.asarray(src, np.int64)),
+            _wrap(np.asarray(dst, np.int64)),
+            _wrap(sample_index), _wrap(reindex_x))
+    if return_eids:
+        return outs + (_wrap(np.concatenate(all_eids) if all_eids
+                             else np.zeros(0, np.int64)),)
+    return outs
+
+
+OPS.setdefault("graph_khop_sampler",
+               OpDef("graph_khop_sampler", lambda r, c, n: r, diff=False,
+                     dynamic=True, method=False))
+
+
+def _send_uv(x, y, src_index, dst_index, message_op="add"):
+    xs = jnp.take(x, src_index, axis=0)
+    ys = jnp.take(y, dst_index, axis=0)
+    if message_op == "add":
+        return xs + ys
+    if message_op == "sub":
+        return xs - ys
+    if message_op == "mul":
+        return xs * ys
+    if message_op == "div":
+        return xs / ys
+    raise ValueError(message_op)
+
+
+OPS.setdefault("send_uv", OpDef("send_uv", _send_uv, diff=True,
+                                method=False))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message x[src] op y[dst] — dense gather, device-side,
+    differentiable (reference send_recv.py:413)."""
+    as_t = lambda v: v if isinstance(v, Tensor) else _wrap(v)
+    return dispatch("send_uv", (x, y, as_t(src_index), as_t(dst_index)),
+                    {"message_op": message_op})

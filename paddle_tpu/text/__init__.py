@@ -66,3 +66,6 @@ class SyntheticTextDataset(Dataset):
         return len(self.data)
 
 from paddle_tpu.text.viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401,E402
+from paddle_tpu.text.ops import (  # noqa: F401,E402
+    chunk_eval, crf_decoding, ctc_align, edit_distance, rnnt_loss,
+)

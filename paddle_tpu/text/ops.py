@@ -1,0 +1,294 @@
+"""Sequence ops: CRF decoding, edit distance, CTC alignment, chunk
+evaluation, RNN-T loss.
+
+Reference surface: phi kernels crf_decoding (paddle/fluid/operators/
+crf_decoding_op.h), edit_distance (paddle/phi/kernels/cpu/
+edit_distance_kernel.cc), ctc_align, chunk_eval (paddle/fluid/operators/
+chunk_eval_op.h), warprnnt (paddle/phi/kernels/cpu/warprnnt_kernel.cc).
+
+TPU-native split: crf_decoding rides the viterbi lax.scan; warprnnt is a
+diagonal-wavefront log-space DP in one jit (autodiff gives the gradient —
+no hand-written backward like warp-transducer); edit_distance / ctc_align
+/ chunk_eval are host-side metric/data ops (dynamic output, no gradient).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.registry import OPS, OpDef, dispatch
+from paddle_tpu.text.viterbi import viterbi_decode
+
+
+def _np(x):
+    return np.asarray(x._value) if isinstance(x, Tensor) else np.asarray(x)
+
+
+def _wrap(x):
+    return Tensor._wrap(jnp.asarray(x))
+
+
+# ------------------------------------------------------------- crf_decoding
+
+def crf_decoding(input, transition, label=None, length=None):
+    """Linear-chain CRF argmax decode. `transition` is [N+2, N]: rows 0/1
+    are start/stop weights (the reference linear_chain_crf layout); the
+    rest is the tag-to-tag matrix. Rides the viterbi lax.scan.
+
+    Returns the best path [B, T] (or, when `label` is given, a 0/1 mask of
+    positions where label matches the viterbi path — reference semantics).
+    """
+    pot = _np(input).astype(np.float32)
+    tr = _np(transition).astype(np.float32)
+    start, stop, trans = tr[0], tr[1], tr[2:]
+    b, t, n = pot.shape
+    pot2 = pot.copy()
+    pot2[:, 0] += start[None, :]
+    if length is not None:
+        lens = _np(length).astype(np.int64)
+        for i in range(b):
+            pot2[i, lens[i] - 1] += stop
+    else:
+        lens = np.full(b, t, np.int64)
+        pot2[:, -1] += stop
+    _, path = viterbi_decode(_wrap(pot2), _wrap(trans),
+                             lengths=_wrap(lens),
+                             include_bos_eos_tag=False)
+    if label is None:
+        return path
+    lv = _np(label).reshape(b, -1)
+    return _wrap((lv == _np(path)).astype(np.int64))
+
+
+OPS.setdefault("crf_decoding", OpDef("crf_decoding", lambda x, t: x,
+                                     diff=False, dynamic=True, method=False))
+OPS.setdefault("viterbi_decode", OpDef("viterbi_decode", lambda x, t: x,
+                                       diff=False, dynamic=True,
+                                       method=False))
+
+
+# ------------------------------------------------------------ edit_distance
+
+def _levenshtein(a, b):
+    la, lb = len(a), len(b)
+    prev = np.arange(lb + 1, dtype=np.int64)
+    for i in range(1, la + 1):
+        cur = np.empty(lb + 1, np.int64)
+        cur[0] = i
+        for j in range(1, lb + 1):
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1,
+                         prev[j - 1] + (a[i - 1] != b[j - 1]))
+        prev = cur
+    return int(prev[lb])
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Levenshtein distance per batch row. Returns (distance [B, 1],
+    sequence_num [1]). Host metric op (reference edit_distance_kernel)."""
+    iv, lv = _np(input), _np(label)
+    b = iv.shape[0]
+    il = (_np(input_length).astype(np.int64) if input_length is not None
+          else np.full(b, iv.shape[1], np.int64))
+    ll = (_np(label_length).astype(np.int64) if label_length is not None
+          else np.full(b, lv.shape[1], np.int64))
+    ignored = set(ignored_tokens or ())
+    out = np.zeros((b, 1), np.float32)
+    for i in range(b):
+        a = [x for x in iv[i, :il[i]].tolist() if x not in ignored]
+        c = [x for x in lv[i, :ll[i]].tolist() if x not in ignored]
+        d = float(_levenshtein(a, c))
+        if normalized:
+            d = d / max(len(c), 1)
+        out[i, 0] = d
+    return _wrap(out), _wrap(np.asarray([b], np.int64))
+
+
+OPS.setdefault("edit_distance", OpDef("edit_distance", lambda a, b: a,
+                                      diff=False, dynamic=True,
+                                      method=False))
+
+
+# ---------------------------------------------------------------- ctc_align
+
+def ctc_align(input, input_length=None, blank=0, padding_value=0, name=None):
+    """CTC greedy alignment: merge repeats, drop blanks (reference
+    ctc_align_op). Returns (aligned [B, T] padded, out_lengths [B])."""
+    iv = _np(input)
+    b, t = iv.shape
+    il = (_np(input_length).astype(np.int64) if input_length is not None
+          else np.full(b, t, np.int64))
+    rows, lens = [], []
+    for i in range(b):
+        seq = iv[i, :il[i]]
+        out, prev = [], None
+        for tok in seq.tolist():
+            if tok != blank and tok != prev:
+                out.append(tok)
+            prev = tok
+        rows.append(out)
+        lens.append(len(out))
+    width = max(lens) if lens and max(lens) > 0 else 1
+    padded = np.full((b, width), padding_value, iv.dtype)
+    for i, r in enumerate(rows):
+        padded[i, :len(r)] = r
+    return _wrap(padded), _wrap(np.asarray(lens, np.int64))
+
+
+OPS.setdefault("ctc_align", OpDef("ctc_align", lambda x: x, diff=False,
+                                  dynamic=True, method=False))
+
+
+# ---------------------------------------------------------------- chunk_eval
+
+_TAG_SCHEMES = {
+    "IOB": {"begin": "B", "inside": "I", "end": None, "single": None},
+    "IOE": {"begin": None, "inside": "I", "end": "E", "single": None},
+    "IOBES": {"begin": "B", "inside": "I", "end": "E", "single": "S"},
+}
+
+
+def _extract_chunks(tags, scheme, num_types, excluded):
+    """Decode (type, start, end) chunks from integer tag sequence. Tag id
+    layout matches the reference chunk_eval_op: for IOB,
+    tag = type * 2 + {0: B, 1: I}, `O` = num_types * tag_multiplier; for
+    IOBES type * 4 + {B, I, E, S}; for `plain`, tag IS the type id."""
+    chunks = []
+    if scheme == "plain":
+        start = None
+        for i, tg in enumerate(list(tags) + [-1]):
+            if start is not None and tg != tags[start]:
+                chunks.append((tags[start], start, i - 1))
+                start = None
+            if start is None and tg >= 0 and tg < num_types:
+                start = i
+        return [(c, s, e) for c, s, e in chunks if c not in excluded]
+    n_states = {"IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
+    state_of = {"IOB": ["B", "I"], "IOE": ["I", "E"],
+                "IOBES": ["B", "I", "E", "S"]}[scheme]
+    cur_type, start = None, None
+    for i, tg in enumerate(list(tags) + [n_states * num_types]):
+        if 0 <= tg < n_states * num_types:
+            typ, st = tg // n_states, state_of[tg % n_states]
+        else:
+            typ, st = None, "O"
+        if cur_type is not None and (st in ("B", "S", "O") or typ != cur_type):
+            chunks.append((cur_type, start, i - 1))
+            cur_type = None
+        if st in ("B", "I", "S", "E") and cur_type is None:
+            # E opening a chunk = single-token chunk (IOE: E after O/E)
+            cur_type, start = typ, i
+        if st == "S" or (st == "E" and cur_type is not None):
+            chunks.append((cur_type, start, i))
+            cur_type = None
+    return [(c, s, e) for c, s, e in chunks if c not in excluded]
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """Chunking precision/recall/F1 (NER-style; reference chunk_eval_op.h).
+    Returns (precision, recall, f1, num_infer, num_label, num_correct)."""
+    iv, lv = _np(input), _np(label)
+    if iv.ndim == 1:
+        iv, lv = iv[None], lv[None]
+    b = iv.shape[0]
+    sl = (_np(seq_length).astype(np.int64) if seq_length is not None
+          else np.full(b, iv.shape[1], np.int64))
+    excluded = set(excluded_chunk_types or ())
+    n_inf = n_lab = n_cor = 0
+    for i in range(b):
+        inf = set(_extract_chunks(iv[i, :sl[i]].tolist(), chunk_scheme,
+                                  num_chunk_types, excluded))
+        lab = set(_extract_chunks(lv[i, :sl[i]].tolist(), chunk_scheme,
+                                  num_chunk_types, excluded))
+        n_inf += len(inf)
+        n_lab += len(lab)
+        n_cor += len(inf & lab)
+    p = n_cor / n_inf if n_inf else 0.0
+    r = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    mk = lambda v, dt=np.float32: _wrap(np.asarray([v], dt))
+    return (mk(p), mk(r), mk(f1), mk(n_inf, np.int64), mk(n_lab, np.int64),
+            mk(n_cor, np.int64))
+
+
+OPS.setdefault("chunk_eval", OpDef("chunk_eval", lambda i, l: i, diff=False,
+                                   dynamic=True, method=False))
+
+
+# ----------------------------------------------------------------- warprnnt
+
+def _rnnt_loss_one(logp, labels, t_len, u_len, blank, fe_lambda=0.0):
+    """RNN-T forward-variable DP for one sample, log space.
+
+    logp: [T, U+1, V] log-softmax; labels: [U]. alpha[t, u] =
+    logsumexp(alpha[t-1, u] + blank(t-1, u), alpha[t, u-1] + emit(t, u-1)).
+    Implemented as a lax.scan over t carrying the alpha row over u (the
+    inner u-recurrence is an associative scan in log space, done as a
+    sequential mini-scan — U is small vs T)."""
+    tmax, u1, _ = logp.shape
+    umax = u1 - 1
+    neg = -1e30
+    lab = labels.astype(jnp.int32)
+    emit = jnp.take_along_axis(
+        logp[:, :umax], lab[None, :, None], axis=-1)[..., 0]  # [T, U]
+    if fe_lambda:
+        # FastEmit [Yu et al. 2021], torchaudio-style: scale the gradient of
+        # emit transitions by (1 + lambda) while leaving the forward value
+        # unchanged — (1+l)*e - l*stop_grad(e) == e at forward.
+        emit = (1.0 + fe_lambda) * emit - fe_lambda * jax.lax.stop_gradient(
+            emit)
+    blk = logp[:, :, blank]  # [T, U+1]
+    u_ids = jnp.arange(u1)
+    u_ok = u_ids <= u_len  # valid u positions
+
+    def row_step(alpha_prev_t, t):
+        # horizontal: from alpha[t, u-1] + emit(t, u-1)
+        def u_step(carry, u):
+            from_top = alpha_prev_t[u] + jnp.where(t > 0, blk[t - 1, u], neg)
+            from_top = jnp.where(t > 0, from_top, neg)
+            from_left = carry + jnp.where(u > 0, emit[t, u - 1], neg)
+            from_left = jnp.where(u > 0, from_left, neg)
+            init = jnp.where((t == 0) & (u == 0), 0.0, neg)
+            a = jnp.logaddexp(jnp.logaddexp(from_top, from_left), init)
+            a = jnp.where(u_ok[u], a, neg)
+            return a, a
+
+        _, row = jax.lax.scan(u_step, neg, jnp.arange(u1))
+        return row, row
+
+    _, alphas = jax.lax.scan(row_step, jnp.full((u1,), neg),
+                             jnp.arange(tmax))  # [T, U+1]
+    final = alphas[t_len - 1, u_len] + blk[t_len - 1, u_len]
+    return -final
+
+
+def _warprnnt(logits, labels, input_lengths, label_lengths, blank=0,
+              fasteremit_lambda=0.0):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jax.vmap(_rnnt_loss_one, in_axes=(0, 0, 0, 0, None, None))(
+        logp, labels, input_lengths.astype(jnp.int32),
+        label_lengths.astype(jnp.int32), blank, fasteremit_lambda)
+
+
+OPS.setdefault("warprnnt", OpDef("warprnnt", _warprnnt, diff=True,
+                                 method=False))
+
+
+def rnnt_loss(logits, labels, input_lengths, label_lengths, blank=0,
+              fasteremit_lambda=0.0, reduction="mean", name=None):
+    """RNN-T transducer loss [Graves 2012]; logits [B, T, U+1, V]."""
+    as_t = lambda v: v if isinstance(v, Tensor) else _wrap(v)
+    out = dispatch("warprnnt",
+                   (as_t(logits), as_t(labels), as_t(input_lengths),
+                    as_t(label_lengths)),
+                   {"blank": blank,
+                    "fasteremit_lambda": fasteremit_lambda})
+    if reduction == "mean":
+        return out.mean()
+    if reduction == "sum":
+        return out.sum()
+    return out

@@ -1,0 +1,1052 @@
+"""Detection zoo: box coding, priors/anchors, YOLO, NMS variants, ROI
+pooling, deformable conv, correlation, FPN routing, image IO.
+
+Reference surface: python/paddle/vision/ops.py (yolo_loss:69, yolo_box:277,
+prior_box:438, box_coder:584, deform_conv2d:766, distribute_fpn_proposals
+:1200, read_file:1345, decode_jpeg:1388, psroi_pool:1441, roi_pool:1572,
+generate_proposals:2159, matrix_nms:2376) over the phi detection kernels
+(paddle/phi/kernels/cpu/{yolo_box,prior_box,box_coder,matrix_nms,...}).
+
+TPU-native split: everything with static shapes (box transforms, priors,
+YOLO heads/loss, IoU/decay matrices, ROI pooling, deformable im2col,
+correlation volumes) is dense jnp/lax math that jits onto the VPU/MXU.
+Selection steps whose OUTPUT size is data-dependent (multiclass_nms3,
+generate_proposals, FPN distribute/collect) compute masks and scores on
+device, then compact indices eagerly on host — the standard TPU detection
+recipe (dynamic shapes can't live inside XLA programs).
+"""
+
+from __future__ import annotations
+
+import io as _io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.registry import OPS, OpDef, dispatch
+
+
+def _u(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _np(x):
+    return np.asarray(x._value) if isinstance(x, Tensor) else np.asarray(x)
+
+
+def _wrap(x):
+    return Tensor._wrap(jnp.asarray(x))
+
+
+# --------------------------------------------------------------------------
+# box_coder
+# --------------------------------------------------------------------------
+
+def _center_form(box, normalized):
+    off = 0.0 if normalized else 1.0
+    w = box[..., 2] - box[..., 0] + off
+    h = box[..., 3] - box[..., 1] + off
+    cx = box[..., 0] + w * 0.5
+    cy = box[..., 1] + h * 0.5
+    return cx, cy, w, h
+
+
+def _box_coder(prior_box, target_box, prior_box_var=None,
+               code_type="encode_center_size", box_normalized=True, axis=0):
+    pcx, pcy, pw, ph = _center_form(prior_box, box_normalized)
+    if prior_box_var is None:
+        var = jnp.ones(prior_box.shape[:-1] + (4,), prior_box.dtype)
+    else:
+        var = jnp.broadcast_to(jnp.asarray(prior_box_var, prior_box.dtype),
+                               prior_box.shape[:-1] + (4,))
+    if code_type == "encode_center_size":
+        # target [N,4] x prior [M,4] -> [N,M,4]
+        tcx, tcy, tw, th = _center_form(target_box, box_normalized)
+        tcx, tcy, tw, th = (t[:, None] for t in (tcx, tcy, tw, th))
+        ox = (tcx - pcx[None]) / pw[None] / var[None, :, 0]
+        oy = (tcy - pcy[None]) / ph[None] / var[None, :, 1]
+        ow = jnp.log(jnp.abs(tw / pw[None])) / var[None, :, 2]
+        oh = jnp.log(jnp.abs(th / ph[None])) / var[None, :, 3]
+        return jnp.stack([ox, oy, ow, oh], axis=-1)
+    # decode: target [N,M,4]; prior broadcast along `axis`
+    expand = (slice(None), None) if axis == 1 else (None, slice(None))
+    pcx, pcy, pw, ph = (t[expand] for t in (pcx, pcy, pw, ph))
+    var = var[expand + (slice(None),)]
+    cx = var[..., 0] * target_box[..., 0] * pw + pcx
+    cy = var[..., 1] * target_box[..., 1] * ph + pcy
+    w = jnp.exp(var[..., 2] * target_box[..., 2]) * pw
+    h = jnp.exp(var[..., 3] * target_box[..., 3]) * ph
+    off = 0.0 if box_normalized else 1.0
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5 - off, cy + h * 0.5 - off], axis=-1)
+
+
+OPS.setdefault("box_coder", OpDef("box_coder", _box_coder, diff=True,
+                                  method=False))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    pv = prior_box_var
+    if isinstance(pv, Tensor):
+        pv = pv._value
+    elif pv is not None:
+        pv = tuple(float(v) for v in pv)
+    as_t = lambda v: v if isinstance(v, Tensor) else _wrap(v)
+    return dispatch("box_coder", (as_t(prior_box), as_t(target_box)),
+                    {"prior_box_var": pv,
+                     "code_type": code_type, "box_normalized": box_normalized,
+                     "axis": axis})
+
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes to image bounds. im_info: [N, 3] (h, w, scale) — boxes are
+    clipped to the im_info-scaled image (reference box_clip_op semantics:
+    bounds (h/scale - 1, w/scale - 1))."""
+    b = _u(input)
+    info = _u(im_info)
+    im_h = info[..., 0] / info[..., 2] - 1.0
+    im_w = info[..., 1] / info[..., 2] - 1.0
+    if b.ndim == 3:  # [N, M, 4]
+        im_h, im_w = im_h[:, None], im_w[:, None]
+    x1 = jnp.clip(b[..., 0], 0.0, im_w)
+    y1 = jnp.clip(b[..., 1], 0.0, im_h)
+    x2 = jnp.clip(b[..., 2], 0.0, im_w)
+    y2 = jnp.clip(b[..., 3], 0.0, im_h)
+    return _wrap(jnp.stack([x1, y1, x2, y2], axis=-1))
+
+
+OPS.setdefault("box_clip", OpDef("box_clip", lambda b, i: b, diff=False,
+                                 method=False))
+
+
+# --------------------------------------------------------------------------
+# prior_box / anchor_generator
+# --------------------------------------------------------------------------
+
+def _prior_wh(min_sizes, max_sizes, aspect_ratios, flip,
+              min_max_aspect_ratios_order):
+    """Static python: per-location prior (w, h) list in paddle's order."""
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if any(abs(ar - a) < 1e-6 for a in ars):
+            continue
+        ars.append(ar)
+        if flip:
+            ars.append(1.0 / ar)
+    wh = []
+    for k, ms in enumerate(min_sizes):
+        wh.append((ms, ms))  # ar 1
+        if min_max_aspect_ratios_order and max_sizes:
+            s = (ms * max_sizes[k]) ** 0.5
+            wh.append((s, s))
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            wh.append((ms * ar ** 0.5, ms / ar ** 0.5))
+        if not min_max_aspect_ratios_order and max_sizes:
+            s = (ms * max_sizes[k]) ** 0.5
+            wh.append((s, s))
+    return wh
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes: [H, W, P, 4] normalized xyxy + same-shape variances."""
+    feat = _u(input)
+    img = _u(image)
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    if not isinstance(min_sizes, (list, tuple)):
+        min_sizes = [min_sizes]
+    if max_sizes and not isinstance(max_sizes, (list, tuple)):
+        max_sizes = [max_sizes]
+    if not isinstance(aspect_ratios, (list, tuple)):
+        aspect_ratios = [aspect_ratios]
+    step_w = steps[0] or iw / w
+    step_h = steps[1] or ih / h
+    wh = jnp.asarray(_prior_wh(min_sizes, max_sizes or [], aspect_ratios,
+                               flip, min_max_aspect_ratios_order),
+                     feat.dtype)  # [P, 2]
+    cx = (jnp.arange(w, dtype=feat.dtype) + offset) * step_w
+    cy = (jnp.arange(h, dtype=feat.dtype) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [H, W]
+    bw = wh[:, 0][None, None] * 0.5 / iw
+    bh = wh[:, 1][None, None] * 0.5 / ih
+    cxn = (cxg / iw)[..., None]
+    cyn = (cyg / ih)[..., None]
+    boxes = jnp.stack([cxn - bw, cyn - bh, cxn + bw, cyn + bh], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, feat.dtype), boxes.shape)
+    return _wrap(boxes), _wrap(var)
+
+
+OPS.setdefault("prior_box", OpDef("prior_box", lambda x, img: x, diff=False,
+                                  method=False))
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, variances,
+                     stride, offset=0.5, name=None):
+    """Faster-RCNN anchors: [H, W, A, 4] unnormalized xyxy + variances."""
+    feat = _u(input)
+    h, w = feat.shape[2], feat.shape[3]
+    wh = []
+    for ar in aspect_ratios:
+        for s in anchor_sizes:
+            area = s * s
+            aw = (area / ar) ** 0.5
+            wh.append((aw, aw * ar))
+    wh = jnp.asarray(wh, feat.dtype)  # [A, 2]
+    cx = (jnp.arange(w, dtype=feat.dtype) + offset) * stride[0]
+    cy = (jnp.arange(h, dtype=feat.dtype) + offset) * stride[1]
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    bw = wh[:, 0][None, None] * 0.5
+    bh = wh[:, 1][None, None] * 0.5
+    cxn = cxg[..., None]
+    cyn = cyg[..., None]
+    anchors = jnp.stack([cxn - bw, cyn - bh, cxn + bw, cyn + bh], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, feat.dtype), anchors.shape)
+    return _wrap(anchors), _wrap(var)
+
+
+OPS.setdefault("anchor_generator", OpDef("anchor_generator", lambda x: x,
+                                         diff=False, method=False))
+
+
+# --------------------------------------------------------------------------
+# YOLO
+# --------------------------------------------------------------------------
+
+def _yolo_decode(x, anchors, class_num, downsample_ratio, scale_x_y,
+                 iou_aware, iou_aware_factor):
+    """x [N, C, H, W] -> sigmoid-activated (box_xywh_grid, conf, cls).
+
+    box in grid units: bx = sig(tx)*s - (s-1)/2 + cx ; bw = pw * e^tw
+    (the published YOLOv3 head; reference yolo_box_op.h computes the same).
+    """
+    n, c, h, w = x.shape
+    s = len(anchors) // 2
+    aw = jnp.asarray(anchors[0::2], x.dtype)
+    ah = jnp.asarray(anchors[1::2], x.dtype)
+    if iou_aware:
+        ioup = jax.nn.sigmoid(x[:, :s])  # [N, S, H, W]
+        x = x[:, s:]
+    x = x.reshape(n, s, 5 + class_num, h, w)
+    gx = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    gy = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+    bx = (jax.nn.sigmoid(x[:, :, 0]) * alpha + beta + gx) / w
+    by = (jax.nn.sigmoid(x[:, :, 1]) * alpha + beta + gy) / h
+    bw = jnp.exp(x[:, :, 2]) * aw[None, :, None, None] / (
+        downsample_ratio * w)
+    bh = jnp.exp(x[:, :, 3]) * ah[None, :, None, None] / (
+        downsample_ratio * h)
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    if iou_aware:
+        conf = conf ** (1.0 - iou_aware_factor) * ioup ** iou_aware_factor
+    cls = jax.nn.sigmoid(x[:, :, 5:])  # [N, S, cls, H, W]
+    return bx, by, bw, bh, conf, cls
+
+
+def _yolo_box(x, img_size, anchors, class_num, conf_thresh,
+              downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+              iou_aware=False, iou_aware_factor=0.5):
+    n, _, h, w = x.shape
+    s = len(anchors) // 2
+    bx, by, bw, bh, conf, cls = _yolo_decode(
+        x, anchors, class_num, downsample_ratio, scale_x_y, iou_aware,
+        iou_aware_factor)
+    imh = img_size[:, 0].astype(x.dtype)[:, None, None, None]
+    imw = img_size[:, 1].astype(x.dtype)[:, None, None, None]
+    x1 = (bx - bw * 0.5) * imw
+    y1 = (by - bh * 0.5) * imh
+    x2 = (bx + bw * 0.5) * imw
+    y2 = (by + bh * 0.5) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0.0, imw - 1.0)
+        y1 = jnp.clip(y1, 0.0, imh - 1.0)
+        x2 = jnp.clip(x2, 0.0, imw - 1.0)
+        y2 = jnp.clip(y2, 0.0, imh - 1.0)
+    keep = (conf > conf_thresh).astype(x.dtype)  # [N, S, H, W]
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * keep[..., None]
+    scores = conf[:, :, None] * cls * keep[:, :, None]
+    boxes = boxes.transpose(0, 1, 2, 3, 4).reshape(n, s * h * w, 4)
+    scores = scores.transpose(0, 1, 3, 4, 2).reshape(n, s * h * w, class_num)
+    return boxes, scores
+
+
+OPS.setdefault("yolo_box", OpDef("yolo_box", _yolo_box, diff=False,
+                                 method=False))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    b, s = dispatch("yolo_box", (_u(x), _u(img_size)),
+                    {"anchors": tuple(anchors), "class_num": class_num,
+                     "conf_thresh": conf_thresh,
+                     "downsample_ratio": downsample_ratio,
+                     "clip_bbox": clip_bbox, "scale_x_y": scale_x_y,
+                     "iou_aware": iou_aware,
+                     "iou_aware_factor": iou_aware_factor})
+    return b, s
+
+
+def yolo_box_head(x, anchors, class_num, name=None):
+    """PP-YOLOE head helper: sigmoid-activate a raw YOLO head in place
+    (reference yolo_box_head_op — CUDA-only there, plain VPU math here)."""
+    xv = _u(x)
+    n, c, h, w = xv.shape
+    s = len(anchors) // 2
+    xs = xv.reshape(n, s, 5 + class_num, h, w)
+    act = jnp.concatenate([
+        jax.nn.sigmoid(xs[:, :, :2]), xs[:, :, 2:4],
+        jax.nn.sigmoid(xs[:, :, 4:])], axis=2)
+    return _wrap(act.reshape(n, c, h, w))
+
+
+OPS.setdefault("yolo_box_head", OpDef("yolo_box_head", lambda x: x,
+                                      diff=False, method=False))
+
+
+def yolo_box_post(heads, img_size, anchors_list, class_num, conf_thresh,
+                  downsample_ratios, nms_threshold=0.45, keep_top_k=100,
+                  scale_x_y=1.0):
+    """Multi-scale YOLO post-process: decode every head with yolo_box,
+    concat, then per-class NMS (reference yolo_box_post_op pipeline)."""
+    all_b, all_s = [], []
+    for head, anchors, ds in zip(heads, anchors_list, downsample_ratios):
+        b, s = yolo_box(head, img_size, anchors, class_num, conf_thresh, ds,
+                        scale_x_y=scale_x_y)
+        all_b.append(_u(b))
+        all_s.append(_u(s))
+    boxes = jnp.concatenate(all_b, axis=1)      # [N, M, 4]
+    scores = jnp.concatenate(all_s, axis=1)     # [N, M, cls]
+    return multiclass_nms3(_wrap(boxes),
+                           _wrap(scores.transpose(0, 2, 1)),
+                           score_threshold=conf_thresh, nms_top_k=-1,
+                           keep_top_k=keep_top_k, nms_threshold=nms_threshold)
+
+
+OPS.setdefault("yolo_box_post", OpDef("yolo_box_post", lambda x: x,
+                                      diff=False, method=False))
+
+
+def _bce(pred_logit, label):
+    return (jnp.maximum(pred_logit, 0) - pred_logit * label
+            + jnp.log1p(jnp.exp(-jnp.abs(pred_logit))))
+
+
+def _wh_iou(w1, h1, w2, h2):
+    inter = jnp.minimum(w1, w2) * jnp.minimum(h1, h2)
+    return inter / (w1 * h1 + w2 * h2 - inter + 1e-9)
+
+
+def _yolo_loss(x, gt_box, gt_label, gt_score, anchors, anchor_mask,
+               class_num, ignore_thresh, downsample_ratio,
+               use_label_smooth=True, scale_x_y=1.0):
+    """YOLOv3 loss (published formulation; reference yolo_loss_op.h):
+    xy sigmoid-BCE + wh L2, both weighted (2 - gw*gh); obj BCE with
+    ignore mask (pred-gt IoU > thresh); class BCE w/ label smoothing.
+    Returns per-sample loss [N]."""
+    n, _, h, w = x.shape
+    s = len(anchor_mask)
+    mask_aw = jnp.asarray([anchors[2 * i] for i in anchor_mask], x.dtype)
+    mask_ah = jnp.asarray([anchors[2 * i + 1] for i in anchor_mask], x.dtype)
+    all_aw = jnp.asarray(anchors[0::2], x.dtype)
+    all_ah = jnp.asarray(anchors[1::2], x.dtype)
+    xs = x.reshape(n, s, 5 + class_num, h, w)
+    px, py = xs[:, :, 0], xs[:, :, 1]
+    pw, ph = xs[:, :, 2], xs[:, :, 3]
+    pobj = xs[:, :, 4]
+    pcls = xs[:, :, 5:]  # [N, S, cls, H, W]
+
+    # decoded pred boxes (normalized cxcywh) for the ignore mask
+    gx = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    gy = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+    bx = (jax.nn.sigmoid(px) * alpha + beta + gx) / w
+    by = (jax.nn.sigmoid(py) * alpha + beta + gy) / h
+    bw = jnp.exp(pw) * mask_aw[None, :, None, None] / (downsample_ratio * w)
+    bh = jnp.exp(ph) * mask_ah[None, :, None, None] / (downsample_ratio * h)
+
+    # gt in normalized cxcywh: [N, B, 4]
+    gcx, gcy = gt_box[..., 0], gt_box[..., 1]
+    gw, gh = gt_box[..., 2], gt_box[..., 3]
+    valid = (gw > 1e-6).astype(x.dtype)  # [N, B]
+
+    # ignore mask: max IoU of each pred box vs all gt > thresh
+    def iou_cxcywh(bx1, by1, bw1, bh1, bx2, by2, bw2, bh2):
+        l = jnp.maximum(bx1 - bw1 / 2, bx2 - bw2 / 2)
+        r = jnp.minimum(bx1 + bw1 / 2, bx2 + bw2 / 2)
+        t = jnp.maximum(by1 - bh1 / 2, by2 - bh2 / 2)
+        b = jnp.minimum(by1 + bh1 / 2, by2 + bh2 / 2)
+        inter = jnp.maximum(r - l, 0) * jnp.maximum(b - t, 0)
+        return inter / (bw1 * bh1 + bw2 * bh2 - inter + 1e-9)
+
+    iou_all = iou_cxcywh(
+        bx[..., None], by[..., None], bw[..., None], bh[..., None],
+        gcx[:, None, None, None], gcy[:, None, None, None],
+        gw[:, None, None, None], gh[:, None, None, None])  # [N,S,H,W,B]
+    iou_max = (iou_all * valid[:, None, None, None]).max(axis=-1)
+    ignore = (iou_max > ignore_thresh).astype(x.dtype)
+
+    # gt -> (anchor-in-mask, grid cell) assignment
+    best = jnp.argmax(
+        _wh_iou(gw[..., None] * downsample_ratio * w,
+                gh[..., None] * downsample_ratio * h,
+                all_aw[None, None], all_ah[None, None]), axis=-1)  # [N, B]
+    in_mask = jnp.zeros_like(best, bool)
+    slot = jnp.zeros_like(best)
+    for k, a in enumerate(anchor_mask):
+        hit = best == a
+        in_mask = in_mask | hit
+        slot = jnp.where(hit, k, slot)
+    gi = jnp.clip((gcx * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gcy * h).astype(jnp.int32), 0, h - 1)
+    assign = valid * in_mask.astype(x.dtype)  # [N, B]
+    if gt_score is not None:
+        assign_w = assign * gt_score
+    else:
+        assign_w = assign
+
+    # scatter gt targets onto the grid
+    def scatter(vals):  # vals [N, B] -> [N, S, H, W]
+        out = jnp.zeros((n, s, h, w), x.dtype)
+        bidx = jnp.arange(n)[:, None] * jnp.ones_like(best)
+        return out.at[bidx, slot, gj, gi].add(vals)
+
+    obj_t = jnp.clip(scatter(assign_w), 0.0, 1.0)
+    has_obj = jnp.clip(scatter(assign), 0.0, 1.0)
+    tx = scatter(assign * (gcx * w - jnp.floor(gcx * w)))
+    ty = scatter(assign * (gcy * h - jnp.floor(gcy * h)))
+    sel_aw = mask_aw[slot]
+    sel_ah = mask_ah[slot]
+    tw = scatter(assign * jnp.log(
+        jnp.maximum(gw * downsample_ratio * w / sel_aw, 1e-9)))
+    th = scatter(assign * jnp.log(
+        jnp.maximum(gh * downsample_ratio * h / sel_ah, 1e-9)))
+    box_w = scatter(assign * (2.0 - gw * gh))  # small-box upweight
+
+    loss_xy = box_w * (_bce(px, tx) + _bce(py, ty))
+    loss_wh = box_w * 0.5 * ((pw - tw) ** 2 + (ph - th) ** 2)
+    loss_obj = (has_obj * _bce(pobj, obj_t)
+                + (1 - has_obj) * (1 - ignore) * _bce(pobj, 0.0))
+    delta = 1.0 / class_num if use_label_smooth else 0.0
+    onehot = jax.nn.one_hot(gt_label, class_num, dtype=x.dtype)
+    onehot = onehot * (1 - delta) + delta / class_num
+    cls_t = jnp.zeros((n, s, class_num, h, w), x.dtype)
+    bidx = jnp.arange(n)[:, None] * jnp.ones_like(best)
+    cls_t = cls_t.at[bidx, slot, :, gj, gi].add(
+        assign[..., None] * onehot)
+    loss_cls = has_obj[:, :, None] * _bce(pcls, jnp.clip(cls_t, 0, 1))
+    per_sample = (loss_xy.sum(axis=(1, 2, 3)) + loss_wh.sum(axis=(1, 2, 3))
+                  + loss_obj.sum(axis=(1, 2, 3))
+                  + loss_cls.sum(axis=(1, 2, 3, 4)))
+    return per_sample
+
+
+OPS.setdefault("yolo_loss", OpDef("yolo_loss", _yolo_loss, diff=True,
+                                  method=False))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    return dispatch(
+        "yolo_loss",
+        (x, gt_box, _u(gt_label).astype(jnp.int32),
+         gt_score if gt_score is not None else None),
+        {"anchors": tuple(anchors), "anchor_mask": tuple(anchor_mask),
+         "class_num": class_num, "ignore_thresh": ignore_thresh,
+         "downsample_ratio": downsample_ratio,
+         "use_label_smooth": use_label_smooth, "scale_x_y": scale_x_y})
+
+
+# --------------------------------------------------------------------------
+# NMS variants
+# --------------------------------------------------------------------------
+
+def _iou_matrix(boxes, normalized=True):
+    """Pairwise IoU. Works on jnp arrays (device, for the dense matrix_nms
+    decay) AND numpy arrays (host, for the per-class loops in
+    multiclass_nms3 / generate_proposals — avoids one XLA recompile per
+    distinct candidate count)."""
+    xp = np if isinstance(boxes, np.ndarray) else jnp
+    off = 0.0 if normalized else 1.0
+    area = (xp.maximum(boxes[:, 2] - boxes[:, 0] + off, 0)
+            * xp.maximum(boxes[:, 3] - boxes[:, 1] + off, 0))
+    lt = xp.maximum(boxes[:, None, :2], boxes[None, :, :2])
+    rb = xp.minimum(boxes[:, None, 2:], boxes[None, :, 2:])
+    wh = xp.maximum(rb - lt + off, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / xp.maximum(area[:, None] + area[None, :] - inter, 1e-10)
+
+
+def _matrix_nms_decay(boxes, scores, use_gaussian, sigma, normalized):
+    """SOLOv2 Matrix-NMS: decay_j = min_i [f(iou_ij) / f(max_iou_i)] over
+    higher-scored i. Fully dense — ideal on TPU (one IoU matrix + min)."""
+    order = jnp.argsort(-scores)
+    sb = boxes[order]
+    iou = _iou_matrix(sb, normalized)
+    m = iou.shape[0]
+    upper = jnp.tril(jnp.ones((m, m), bool), -1).T  # i < j pairs at [i, j]
+    iou = jnp.where(upper, iou, 0.0)
+    # row_max[i]: box i's own max overlap with any higher-scored box
+    row_max = iou.max(axis=0)
+    if use_gaussian:
+        f = lambda x: jnp.exp(-sigma * x * x)
+    else:
+        f = lambda x: 1.0 - x
+    decay = jnp.where(upper, f(iou) / f(row_max[:, None]), 1.0).min(axis=0)
+    return order, scores[order] * decay
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Per-image, per-class soft suppression. Device computes the decayed
+    scores densely; host compacts the (dynamic-size) survivor set."""
+    bv = _np(bboxes)   # [N, M, 4]
+    sv = _np(scores)   # [N, C, M]
+    n, c, m = sv.shape
+    outs, idxs, nums = [], [], []
+    for b in range(n):
+        rows = []
+        for cl in range(c):
+            if cl == background_label:
+                continue
+            sc = sv[b, cl]
+            sel = np.nonzero(sc > score_threshold)[0]
+            if sel.size == 0:
+                continue
+            if 0 < nms_top_k < sel.size:
+                sel = sel[np.argsort(-sc[sel])[:nms_top_k]]
+            order, dec = _matrix_nms_decay(
+                jnp.asarray(bv[b, sel]), jnp.asarray(sc[sel]),
+                use_gaussian, gaussian_sigma, normalized)
+            order = np.asarray(order)
+            dec = np.asarray(dec)
+            keep = dec > post_threshold
+            for o, d in zip(sel[order[keep]], dec[keep]):
+                rows.append((cl, d, *bv[b, o], b * m + o))
+        rows.sort(key=lambda r: -r[1])
+        if 0 < keep_top_k < len(rows):
+            rows = rows[:keep_top_k]
+        outs += [r[:6] for r in rows]
+        idxs += [r[6] for r in rows]
+        nums.append(len(rows))
+    out = _wrap(np.asarray(outs, np.float32).reshape(-1, 6))
+    index = _wrap(np.asarray(idxs, np.int32).reshape(-1, 1))
+    rois_num = _wrap(np.asarray(nums, np.int32))
+    if return_index:
+        return (out, index, rois_num) if return_rois_num else (out, index,
+                                                               None)
+    return (out, None, rois_num) if return_rois_num else out
+
+
+OPS.setdefault("matrix_nms", OpDef("matrix_nms", lambda b, s: b, diff=False,
+                                   dynamic=True, method=False))
+
+
+def _hard_nms_indices(boxes, scores, iou_threshold, top_k, normalized=True):
+    """Greedy hard NMS, fully host-side (numpy IoU: the candidate count
+    varies per (image, class), so a device matrix would recompile per
+    shape); returns kept order."""
+    order = np.argsort(-scores)
+    iou = np.asarray(_iou_matrix(np.asarray(boxes)[order], normalized))
+    keep = []
+    alive = np.ones(len(order), bool)
+    for i in range(len(order)):
+        if not alive[i]:
+            continue
+        keep.append(order[i])
+        if 0 < top_k <= len(keep):
+            break
+        alive &= ~(iou[i] > iou_threshold)
+        alive[i] = False
+    return np.asarray(keep, np.int64)
+
+
+def multiclass_nms3(bboxes, scores, score_threshold=0.0, nms_top_k=-1,
+                    keep_top_k=-1, nms_threshold=0.3, normalized=True,
+                    nms_eta=1.0, background_label=-1, return_index=False,
+                    return_rois_num=True, rois_num=None, name=None):
+    """Per-class hard NMS -> cross-class keep_top_k. Output [K, 6]
+    (label, score, x1, y1, x2, y2), survivor index, per-image counts."""
+    bv = _np(bboxes)
+    sv = _np(scores)
+    n, c, m = sv.shape
+    outs, idxs, nums = [], [], []
+    for b in range(n):
+        rows = []
+        for cl in range(c):
+            if cl == background_label:
+                continue
+            sc = sv[b, cl]
+            sel = np.nonzero(sc > score_threshold)[0]
+            if sel.size == 0:
+                continue
+            if 0 < nms_top_k < sel.size:  # pre-NMS candidate cap (reference)
+                sel = sel[np.argsort(-sc[sel])[:nms_top_k]]
+            keep = _hard_nms_indices(bv[b, sel], sc[sel], nms_threshold,
+                                     -1, normalized)
+            for o in sel[keep]:
+                rows.append((cl, sc[o], *bv[b, o], b * m + o))
+        rows.sort(key=lambda r: -r[1])
+        if 0 < keep_top_k < len(rows):
+            rows = rows[:keep_top_k]
+        outs += [r[:6] for r in rows]
+        idxs += [r[6] for r in rows]
+        nums.append(len(rows))
+    out = _wrap(np.asarray(outs, np.float32).reshape(-1, 6))
+    index = _wrap(np.asarray(idxs, np.int32).reshape(-1, 1))
+    nums_t = _wrap(np.asarray(nums, np.int32))
+    if return_index:
+        return out, index, (nums_t if return_rois_num else None)
+    return out, (nums_t if return_rois_num else None)
+
+
+OPS.setdefault("multiclass_nms3", OpDef("multiclass_nms3", lambda b, s: b,
+                                        diff=False, dynamic=True,
+                                        method=False))
+
+
+# --------------------------------------------------------------------------
+# bipartite match / proposals / FPN routing
+# --------------------------------------------------------------------------
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,
+                    name=None):
+    """Greedy bipartite matching: repeatedly take the global max of the
+    [rows=gt? cols=pred] distance matrix (reference bipartite_match_op:
+    rows matched to distinct columns, maximizing matched distance).
+    Returns (match_indices [1, M] col->row, match_dist [1, M])."""
+    d = _np(dist_matrix).astype(np.float64).copy()
+    r, m = d.shape
+    idx = np.full(m, -1, np.int64)
+    dist = np.zeros(m, np.float32)
+    for _ in range(min(r, m)):
+        i, j = np.unravel_index(np.argmax(d), d.shape)
+        if d[i, j] <= 0:
+            break
+        idx[j] = i
+        dist[j] = d[i, j]
+        d[i, :] = -1
+        d[:, j] = -1
+    if match_type == "per_prediction":
+        full = _np(dist_matrix)
+        best = full.argmax(axis=0)
+        bestd = full.max(axis=0)
+        extra = (idx < 0) & (bestd >= dist_threshold)
+        idx = np.where(extra, best, idx)
+        dist = np.where(extra, bestd, dist).astype(np.float32)
+    return _wrap(idx[None]), _wrap(dist[None])
+
+
+OPS.setdefault("bipartite_match", OpDef("bipartite_match", lambda d: d,
+                                        diff=False, dynamic=True,
+                                        method=False))
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True, name=None):
+    """RPN proposals: decode deltas over anchors -> clip -> filter small ->
+    top-pre_nms -> NMS -> top-post_nms. Decode+clip on device, compaction
+    on host. Returns (rois [K,4], roi_scores [K,1], rois_num [N])."""
+    sv = _np(scores)          # [N, A, H, W]
+    dv = _np(bbox_deltas)     # [N, 4A, H, W]
+    iv = _np(img_size)        # [N, 2] (h, w)
+    av = _np(anchors).reshape(-1, 4)
+    vv = _np(variances).reshape(-1, 4)
+    n, a, h, w = sv.shape
+    rois_all, scr_all, nums = [], [], []
+    off = 1.0 if pixel_offset else 0.0
+    for b in range(n):
+        sc = sv[b].transpose(1, 2, 0).reshape(-1)          # [H*W*A]
+        de = dv[b].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        anc = av  # [H*W*A, 4]: anchor_generator's (h, w, a) flattening
+        var = (vv if vv.shape[0] == anc.shape[0]
+               else np.broadcast_to(vv[:1], anc.shape))
+        dec = np.asarray(_box_coder(jnp.asarray(anc), jnp.asarray(de[:, None]),
+                                    prior_box_var=jnp.asarray(var),
+                                    code_type="decode_center_size",
+                                    box_normalized=not pixel_offset,
+                                    axis=1))[:, 0].copy()
+        ih, iw = iv[b, 0], iv[b, 1]
+        dec[:, 0::2] = np.clip(dec[:, 0::2], 0, iw - off)
+        dec[:, 1::2] = np.clip(dec[:, 1::2], 0, ih - off)
+        ws = dec[:, 2] - dec[:, 0] + off
+        hs = dec[:, 3] - dec[:, 1] + off
+        ok = (ws >= min_size) & (hs >= min_size)
+        sel = np.nonzero(ok)[0]
+        sel = sel[np.argsort(-sc[sel])[:int(pre_nms_top_n)]]
+        keep = _hard_nms_indices(dec[sel], sc[sel], nms_thresh,
+                                 int(post_nms_top_n))
+        sel = sel[keep]
+        rois_all.append(dec[sel])
+        scr_all.append(sc[sel, None])
+        nums.append(len(sel))
+    rois = _wrap(np.concatenate(rois_all, 0).astype(np.float32)
+                 if rois_all else np.zeros((0, 4), np.float32))
+    rscores = _wrap(np.concatenate(scr_all, 0).astype(np.float32)
+                    if scr_all else np.zeros((0, 1), np.float32))
+    nums_t = _wrap(np.asarray(nums, np.int32))
+    return (rois, rscores, nums_t) if return_rois_num else (rois, rscores)
+
+
+OPS.setdefault("generate_proposals", OpDef("generate_proposals",
+                                           lambda s, d: s, diff=False,
+                                           dynamic=True, method=False))
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Route each ROI to its FPN level: lvl = floor(refer_level +
+    log2(sqrt(area) / refer_scale)). Returns (per-level roi list,
+    restore_index, per-level rois_num list)."""
+    rv = _np(fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.maximum(
+        (rv[:, 2] - rv[:, 0] + off) * (rv[:, 3] - rv[:, 1] + off), 1e-12))
+    lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-12))
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, nums, order = [], [], []
+    for l in range(min_level, max_level + 1):
+        sel = np.nonzero(lvl == l)[0]
+        outs.append(_wrap(rv[sel]))
+        nums.append(_wrap(np.asarray([len(sel)], np.int32)))
+        order.append(sel)
+    order = np.concatenate(order) if order else np.zeros(0, np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    return outs, _wrap(restore[:, None].astype(np.int32)), \
+        (nums if rois_num is not None else None)
+
+
+OPS.setdefault("distribute_fpn_proposals",
+               OpDef("distribute_fpn_proposals", lambda r: r, diff=False,
+                     dynamic=True, method=False))
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None, name=None):
+    """Merge per-level RPN outputs, keep top post_nms_top_n by score —
+    PER IMAGE when rois_num_per_level gives each level's per-image counts
+    (reference collect_fpn_proposals_op)."""
+    rois = np.concatenate([_np(r) for r in multi_rois], 0)
+    scores = np.concatenate([_np(s).reshape(-1) for s in multi_scores], 0)
+    if rois_num_per_level is None:
+        sel = np.argsort(-scores)[:int(post_nms_top_n)]
+        return _wrap(rois[sel])
+    # image id of every concatenated roi, from per-level [N] counts
+    img_ids = np.concatenate([
+        np.repeat(np.arange(len(_np(c))), _np(c))
+        for c in rois_num_per_level])
+    n_img = max(len(_np(c)) for c in rois_num_per_level)
+    outs, nums = [], []
+    for b in range(n_img):
+        mine = np.nonzero(img_ids == b)[0]
+        sel = mine[np.argsort(-scores[mine])[:int(post_nms_top_n)]]
+        outs.append(rois[sel])
+        nums.append(len(sel))
+    return (_wrap(np.concatenate(outs, 0)),
+            _wrap(np.asarray(nums, np.int32)))
+
+
+OPS.setdefault("collect_fpn_proposals",
+               OpDef("collect_fpn_proposals", lambda r: r, diff=False,
+                     dynamic=True, method=False))
+
+
+# --------------------------------------------------------------------------
+# ROI pooling variants
+# --------------------------------------------------------------------------
+
+def _roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Quantized-bin max pool (Fast-RCNN RoIPool; reference roi_pool_op)."""
+    n, c, h, w = x.shape
+    r = boxes.shape[0]
+    oh, ow = output_size
+    img_idx = jnp.repeat(jnp.arange(boxes_num.shape[0]), boxes_num,
+                         total_repeat_length=r)
+    b = jnp.round(boxes * spatial_scale).astype(jnp.int32)
+    x1, y1 = b[:, 0], b[:, 1]
+    x2, y2 = jnp.maximum(b[:, 2], x1 + 1), jnp.maximum(b[:, 3], y1 + 1)
+    rw = (x2 - x1).astype(x.dtype)
+    rh = (y2 - y1).astype(x.dtype)
+
+    def per_roi(ridx):
+        img = x[img_idx[ridx]]  # [C, H, W]
+        ys = jnp.arange(oh, dtype=x.dtype)
+        xs = jnp.arange(ow, dtype=x.dtype)
+        y_lo = y1[ridx] + jnp.floor(ys * rh[ridx] / oh).astype(jnp.int32)
+        y_hi = y1[ridx] + jnp.ceil((ys + 1) * rh[ridx] / oh).astype(jnp.int32)
+        x_lo = x1[ridx] + jnp.floor(xs * rw[ridx] / ow).astype(jnp.int32)
+        x_hi = x1[ridx] + jnp.ceil((xs + 1) * rw[ridx] / ow).astype(jnp.int32)
+        yy = jnp.arange(h)
+        xx = jnp.arange(w)
+        ymask = ((yy[None, :] >= jnp.clip(y_lo, 0, h)[:, None])
+                 & (yy[None, :] < jnp.clip(y_hi, 0, h)[:, None]))  # [oh, H]
+        xmask = ((xx[None, :] >= jnp.clip(x_lo, 0, w)[:, None])
+                 & (xx[None, :] < jnp.clip(x_hi, 0, w)[:, None]))  # [ow, W]
+        m = ymask[:, None, :, None] & xmask[None, :, None, :]  # [oh,ow,H,W]
+        neg = jnp.finfo(x.dtype).min
+        vals = jnp.where(m[None], img[:, None, None], neg)
+        out = vals.max(axis=(-1, -2))
+        any_bin = m.any(axis=(-1, -2))
+        return jnp.where(any_bin[None], out, 0.0)
+
+    return jax.vmap(per_roi)(jnp.arange(r))
+
+
+OPS.setdefault("roi_pool", OpDef("roi_pool", _roi_pool, diff=True,
+                                 method=False))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return dispatch("roi_pool", (x, boxes, _u(boxes_num)),
+                    {"output_size": tuple(output_size),
+                     "spatial_scale": spatial_scale})
+
+
+def _psroi_pool(x, boxes, boxes_num, output_size, spatial_scale, out_channels):
+    """Position-sensitive RoI average pool (R-FCN; reference psroi_pool_op):
+    bin (i, j) reads channel group  c*oh*ow + i*ow + j."""
+    n, c, h, w = x.shape
+    oh, ow = output_size
+    r = boxes.shape[0]
+    img_idx = jnp.repeat(jnp.arange(boxes_num.shape[0]), boxes_num,
+                         total_repeat_length=r)
+    xs1 = boxes[:, 0] * spatial_scale
+    ys1 = boxes[:, 1] * spatial_scale
+    xs2 = boxes[:, 2] * spatial_scale
+    ys2 = boxes[:, 3] * spatial_scale
+    rw = jnp.maximum(xs2 - xs1, 0.1)
+    rh = jnp.maximum(ys2 - ys1, 0.1)
+
+    def per_roi(ridx):
+        img = x[img_idx[ridx]].reshape(out_channels, oh * ow, h, w)
+        ys = jnp.arange(oh, dtype=x.dtype)
+        xs = jnp.arange(ow, dtype=x.dtype)
+        y_lo = jnp.floor(ys1[ridx] + ys * rh[ridx] / oh).astype(jnp.int32)
+        y_hi = jnp.ceil(ys1[ridx] + (ys + 1) * rh[ridx] / oh).astype(
+            jnp.int32)
+        x_lo = jnp.floor(xs1[ridx] + xs * rw[ridx] / ow).astype(jnp.int32)
+        x_hi = jnp.ceil(xs1[ridx] + (xs + 1) * rw[ridx] / ow).astype(
+            jnp.int32)
+        yy = jnp.arange(h)
+        xx = jnp.arange(w)
+        ymask = ((yy[None, :] >= jnp.clip(y_lo, 0, h)[:, None])
+                 & (yy[None, :] < jnp.clip(y_hi, 0, h)[:, None]))
+        xmask = ((xx[None, :] >= jnp.clip(x_lo, 0, w)[:, None])
+                 & (xx[None, :] < jnp.clip(x_hi, 0, w)[:, None]))
+        m = (ymask[:, None, :, None] & xmask[None, :, None, :])  # [oh,ow,H,W]
+        mf = m.astype(x.dtype)
+        cnt = jnp.maximum(mf.sum(axis=(-1, -2)), 1.0)  # [oh, ow]
+        grid = img.reshape(out_channels, oh, ow, h, w)
+        s = (grid * mf[None]).sum(axis=(-1, -2))
+        return s / cnt[None]
+
+    return jax.vmap(per_roi)(jnp.arange(r))
+
+
+OPS.setdefault("psroi_pool", OpDef("psroi_pool", _psroi_pool, diff=True,
+                                   method=False))
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    c = _u(x).shape[1]
+    assert c % (oh * ow) == 0, "channels must divide output_size^2"
+    return dispatch("psroi_pool", (x, boxes, _u(boxes_num)),
+                    {"output_size": (oh, ow), "spatial_scale": spatial_scale,
+                     "out_channels": c // (oh * ow)})
+
+
+# --------------------------------------------------------------------------
+# deformable conv / correlation
+# --------------------------------------------------------------------------
+
+def _bilinear_at(img, ys, xs):
+    """img [C, H, W]; ys/xs [...] float -> [C, ...]; zero outside."""
+    c, h, w = img.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = ys - y0
+    wx = xs - x0
+    out = 0.0
+    for dy, sy in ((0, 1 - wy), (1, wy)):
+        for dx, sx in ((0, 1 - wx), (1, wx)):
+            yi = (y0 + dy).astype(jnp.int32)
+            xi = (x0 + dx).astype(jnp.int32)
+            ok = ((yi >= 0) & (yi < h) & (xi >= 0) & (xi < w))
+            v = img[:, jnp.clip(yi, 0, h - 1), jnp.clip(xi, 0, w - 1)]
+            out = out + v * (sy * sx * ok)[None]
+    return out
+
+
+def _deform_conv2d(x, offset, weight, mask, stride, padding, dilation,
+                   deformable_groups, groups):
+    """Deformable conv v1/v2 (reference deformable_conv_op): bilinear
+    sampling at offset taps -> im2col -> grouped matmul (MXU)."""
+    n, cin, h, w = x.shape
+    cout, cin_g, kh, kw = weight.shape
+    sh, sw = stride
+    ph, pw = padding
+    dh, dw = dilation
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    oy = jnp.arange(oh) * sh - ph
+    ox = jnp.arange(ow) * sw - pw
+    ky = jnp.arange(kh) * dh
+    kx = jnp.arange(kw) * dw
+    base_y = oy[:, None, None, None] + ky[None, None, :, None]  # [oh,1,kh,1]
+    base_x = ox[None, :, None, None] + kx[None, None, None, :]  # [1,ow,1,kw]
+    base_y = jnp.broadcast_to(base_y, (oh, ow, kh, kw)).astype(x.dtype)
+    base_x = jnp.broadcast_to(base_x, (oh, ow, kh, kw)).astype(x.dtype)
+    off = offset.reshape(n, deformable_groups, kh * kw, 2, oh, ow)
+    off_y = off[:, :, :, 0].transpose(0, 1, 3, 4, 2).reshape(
+        n, deformable_groups, oh, ow, kh, kw)
+    off_x = off[:, :, :, 1].transpose(0, 1, 3, 4, 2).reshape(
+        n, deformable_groups, oh, ow, kh, kw)
+    if mask is not None:
+        mk = mask.reshape(n, deformable_groups, kh * kw, oh, ow).transpose(
+            0, 1, 3, 4, 2).reshape(n, deformable_groups, oh, ow, kh, kw)
+    cg = cin // deformable_groups
+
+    def per_img(b):
+        cols = []
+        for g in range(deformable_groups):
+            ys = base_y + off_y[b, g]
+            xs = base_x + off_x[b, g]
+            v = _bilinear_at(x[b, g * cg:(g + 1) * cg], ys, xs)
+            if mask is not None:
+                v = v * mk[b, g][None]
+            cols.append(v)  # [cg, oh, ow, kh, kw]
+        return jnp.concatenate(cols, axis=0)  # [cin, oh, ow, kh, kw]
+
+    col = jax.vmap(per_img)(jnp.arange(n))  # [N, cin, oh, ow, kh, kw]
+    col = col.transpose(0, 2, 3, 1, 4, 5).reshape(n, oh, ow, groups,
+                                                  cin_g * kh * kw)
+    wm = weight.reshape(groups, cout // groups, cin_g * kh * kw)
+    out = jnp.einsum("nhwgk,gok->ngohw", col, wm)
+    return out.reshape(n, cout, oh, ow)
+
+
+OPS.setdefault("deformable_conv", OpDef("deformable_conv", _deform_conv2d,
+                                        diff=True, method=False))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    to2 = lambda v: (v, v) if isinstance(v, int) else tuple(v)
+    out = dispatch(
+        "deformable_conv",
+        (x, offset, weight, mask),
+        {"stride": to2(stride), "padding": to2(padding),
+         "dilation": to2(dilation), "deformable_groups": deformable_groups,
+         "groups": groups})
+    if bias is not None:
+        out = out + Tensor._wrap(_u(bias).reshape(1, -1, 1, 1))
+    return out
+
+
+def _correlation(x1, x2, pad_size, kernel_size, max_displacement, stride1,
+                 stride2, corr_type_multiply=1):
+    """FlowNet cost volume (reference correlation_op): output [N, D*D, H', W']
+    with D = 2*(max_displacement//stride2) + 1; mean over channels of
+    x1(p) . x2(p + d). Dense shifts — pure VPU math."""
+    n, c, h, w = x1.shape
+    rad = max_displacement // stride2
+    d = 2 * rad + 1
+    p = pad_size
+    x1p = jnp.pad(x1, ((0, 0), (0, 0), (p, p), (p, p)))
+    x2p = jnp.pad(x2, ((0, 0), (0, 0), (p, p), (p, p)))
+    oh = (h + 2 * p - 2 * max_displacement) // stride1
+    ow = (w + 2 * p - 2 * max_displacement) // stride1
+    y0 = max_displacement
+    kr = kernel_size // 2
+    outs = []
+    for dy in range(-rad, rad + 1):
+        for dx in range(-rad, rad + 1):
+            a = jax.lax.dynamic_slice(
+                x1p, (0, 0, y0, y0), (n, c, oh * stride1, ow * stride1))
+            b = jax.lax.dynamic_slice(
+                x2p, (0, 0, y0 + dy * stride2, y0 + dx * stride2),
+                (n, c, oh * stride1, ow * stride1))
+            prod = (a * b).mean(axis=1)  # [N, H', W']
+            if kernel_size > 1:
+                # patch correlation: k x k mean of the product map
+                prod = jax.lax.reduce_window(
+                    prod, 0.0, jax.lax.add, (1, kernel_size, kernel_size),
+                    (1, 1, 1), [(0, 0), (kr, kr), (kr, kr)]) / (
+                    kernel_size * kernel_size)
+            outs.append(prod[:, ::stride1, ::stride1])
+    return jnp.stack(outs, axis=1)  # [N, D*D, oh, ow]
+
+
+OPS.setdefault("correlation", OpDef("correlation", _correlation, diff=True,
+                                    method=False))
+
+
+def correlation(x1, x2, pad_size, kernel_size, max_displacement, stride1,
+                stride2, corr_type_multiply=1):
+    return dispatch("correlation", (x1, x2),
+                    {"pad_size": pad_size, "kernel_size": kernel_size,
+                     "max_displacement": max_displacement,
+                     "stride1": stride1, "stride2": stride2,
+                     "corr_type_multiply": corr_type_multiply})
+
+
+# --------------------------------------------------------------------------
+# image IO (host data-pipeline ops; reference read_file:1345 decode_jpeg:1388)
+# --------------------------------------------------------------------------
+
+def read_file(filename, name=None):
+    with open(filename, "rb") as f:
+        data = f.read()
+    return _wrap(np.frombuffer(data, np.uint8))
+
+
+OPS.setdefault("read_file", OpDef("read_file", lambda f: f, diff=False,
+                                  dynamic=True, method=False))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """JPEG bytes -> CHW uint8 tensor. Host-side (PIL) — image decode
+    belongs in the input pipeline, not the XLA program."""
+    from PIL import Image
+
+    raw = bytes(_np(x).astype(np.uint8).tobytes())
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return _wrap(np.ascontiguousarray(arr))
+
+
+OPS.setdefault("decode_jpeg", OpDef("decode_jpeg", lambda x: x, diff=False,
+                                    dynamic=True, method=False))

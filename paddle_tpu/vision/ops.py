@@ -160,3 +160,14 @@ def box_iou(boxes1, boxes2):
     inter = wh[..., 0] * wh[..., 1]
     return Tensor._wrap(inter / jnp.maximum(a1[:, None] + a2[None, :] - inter,
                                             1e-9))
+
+
+# Detection zoo lives in vision/detection.py; re-export through the
+# reference's paddle.vision.ops namespace.
+from paddle_tpu.vision.detection import (  # noqa: E402,F401
+    anchor_generator, bipartite_match, box_clip, box_coder,
+    collect_fpn_proposals, correlation, decode_jpeg, deform_conv2d,
+    distribute_fpn_proposals, generate_proposals, matrix_nms,
+    multiclass_nms3, prior_box, psroi_pool, read_file, roi_pool,
+    yolo_box, yolo_box_head, yolo_box_post, yolo_loss,
+)

@@ -1,0 +1,449 @@
+"""Detection zoo parity tests vs brute-force numpy references.
+
+Covers paddle_tpu/vision/detection.py (reference surface:
+python/paddle/vision/ops.py detection family over phi kernels).
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+def T(x, dtype=np.float32):
+    return paddle.to_tensor(np.asarray(x, dtype))
+
+
+def A(t):
+    return np.asarray(t._value)
+
+
+rng = np.random.default_rng(7)
+
+
+# ----------------------------------------------------------------- box_coder
+
+def _np_center(box):
+    w = box[..., 2] - box[..., 0]
+    h = box[..., 3] - box[..., 1]
+    return box[..., 0] + w / 2, box[..., 1] + h / 2, w, h
+
+
+def test_box_coder_encode_matches_numpy():
+    pri = rng.random((5, 4)).astype(np.float32) * 10
+    pri[:, 2:] += pri[:, :2] + 1
+    tgt = rng.random((3, 4)).astype(np.float32) * 10
+    tgt[:, 2:] += tgt[:, :2] + 1
+    var = [0.1, 0.1, 0.2, 0.2]
+    out = A(vops.box_coder(T(pri), var, T(tgt)))
+    pcx, pcy, pw, ph = _np_center(pri)
+    tcx, tcy, tw, th = _np_center(tgt)
+    exp = np.stack([
+        (tcx[:, None] - pcx) / pw / var[0],
+        (tcy[:, None] - pcy) / ph / var[1],
+        np.log(tw[:, None] / pw) / var[2],
+        np.log(th[:, None] / ph) / var[3]], axis=-1)
+    np.testing.assert_allclose(out, exp, rtol=1e-5, atol=1e-5)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    pri = np.array([[0., 0., 4., 4.], [2., 2., 10., 12.]], np.float32)
+    tgt = np.array([[1., 1., 5., 6.], [0., 3., 7., 9.]], np.float32)
+    var = [0.1, 0.1, 0.2, 0.2]
+    enc = vops.box_coder(T(pri), var, T(tgt))  # [N, M, 4]
+    dec = A(vops.box_coder(T(pri), var, enc,
+                           code_type="decode_center_size", axis=0))
+    for i in range(2):
+        np.testing.assert_allclose(dec[i, i], tgt[i], rtol=1e-4, atol=1e-4)
+
+
+def test_box_clip():
+    b = np.array([[-5., -5., 50., 50.], [1., 2., 3., 4.]], np.float32)
+    info = np.array([[20., 30., 1.0]], np.float32)
+    out = A(vops.box_clip(T(b[None]), T(info)))
+    assert out.max() <= 29.0 and out.min() >= 0.0
+    np.testing.assert_allclose(out[0, 1], b[1])
+
+
+# ----------------------------------------------------------------- priors
+
+def test_prior_box_count_and_range():
+    feat = T(np.zeros((1, 8, 3, 5)))
+    img = T(np.zeros((1, 3, 30, 50)))
+    boxes, var = vops.prior_box(feat, img, min_sizes=[6.0], max_sizes=[12.0],
+                                aspect_ratios=[2.0], flip=True, clip=True)
+    # priors per loc: min(1) + ar 2 + ar 0.5 + max(1) = 4
+    assert tuple(boxes.shape) == (3, 5, 4, 4)
+    b = A(boxes)
+    assert b.min() >= 0.0 and b.max() <= 1.0
+    # center of cell (0,0): ((0+0.5)*10/50, (0.5)*10/30)
+    cx = (b[0, 0, 0, 0] + b[0, 0, 0, 2]) / 2
+    cy = (b[0, 0, 0, 1] + b[0, 0, 0, 3]) / 2
+    np.testing.assert_allclose([cx, cy], [0.5 * 10 / 50, 0.5 * 10 / 30],
+                               atol=1e-6)
+    assert tuple(var.shape) == (3, 5, 4, 4)
+
+
+def test_anchor_generator_shapes():
+    feat = T(np.zeros((1, 8, 4, 4)))
+    a, v = vops.anchor_generator(feat, [32, 64], [0.5, 1.0, 2.0],
+                                 [0.1, 0.1, 0.2, 0.2], [16., 16.])
+    assert tuple(a.shape) == (4, 4, 6, 4)
+    av = A(a)
+    # aspect 1.0 anchors at cell (0,0) centered at offset*stride
+    ws = av[0, 0, :, 2] - av[0, 0, :, 0]
+    hs = av[0, 0, :, 3] - av[0, 0, :, 1]
+    areas = sorted((ws * hs).round().tolist())
+    assert areas == sorted([32 * 32, 64 * 64] * 3)
+
+
+# ----------------------------------------------------------------- YOLO
+
+def test_yolo_box_matches_numpy():
+    n, s, c, h, w = 1, 2, 3, 2, 2
+    anchors = [10, 13, 16, 30]
+    ds = 16
+    x = rng.standard_normal((n, s * (5 + c), h, w)).astype(np.float32)
+    img = np.array([[ds * h, ds * w]], np.int32)
+    boxes, scores = vops.yolo_box(T(x), T(img, np.int32), anchors, c, 0.0,
+                                  ds, clip_bbox=False)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    xs = x.reshape(s, 5 + c, h, w)
+    exp_boxes = np.zeros((s, h, w, 4))
+    exp_scores = np.zeros((s, h, w, c))
+    for a in range(s):
+        for i in range(h):
+            for j in range(w):
+                bx = (sig(xs[a, 0, i, j]) + j) / w * (ds * w)
+                by = (sig(xs[a, 1, i, j]) + i) / h * (ds * h)
+                bw = anchors[2 * a] * np.exp(xs[a, 2, i, j])
+                bh = anchors[2 * a + 1] * np.exp(xs[a, 3, i, j])
+                conf = sig(xs[a, 4, i, j])
+                exp_boxes[a, i, j] = [bx - bw / 2, by - bh / 2,
+                                      bx + bw / 2, by + bh / 2]
+                exp_scores[a, i, j] = conf * sig(xs[a, 5:, i, j])
+    np.testing.assert_allclose(A(boxes)[0], exp_boxes.reshape(-1, 4),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(A(scores)[0], exp_scores.reshape(-1, c),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_yolo_loss_basic_properties():
+    n, c = 2, 4
+    anchors = [10, 13, 16, 30, 33, 23]
+    x = rng.standard_normal((n, 3 * (5 + c), 4, 4)).astype(np.float32) * 0.1
+    gt_box = np.zeros((n, 2, 4), np.float32)
+    gt_box[:, 0] = [0.4, 0.4, 0.3, 0.3]
+    gt_label = np.zeros((n, 2), np.int64)
+    xt = T(x)
+    xt.stop_gradient = False
+    loss = vops.yolo_loss(xt, T(gt_box), T(gt_label, np.int64), anchors,
+                          [0, 1, 2], c, 0.7, 8)
+    assert tuple(loss.shape) == (n,)
+    lv = A(loss)
+    assert np.isfinite(lv).all() and (lv > 0).all()
+    loss.sum().backward()
+    g = A(xt.grad)
+    assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+# ----------------------------------------------------------------- NMS
+
+def _naive_nms(boxes, scores, thresh):
+    order = np.argsort(-scores)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        xx1 = np.maximum(boxes[i, 0], boxes[order[1:], 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[order[1:], 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[order[1:], 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[order[1:], 3])
+        inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+        a = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        iou = inter / (a[i] + a[order[1:]] - inter + 1e-10)
+        order = order[1:][iou <= thresh]
+    return keep
+
+
+def test_multiclass_nms3_matches_naive():
+    m, c = 12, 3
+    boxes = rng.random((1, m, 4)).astype(np.float32) * 10
+    boxes[..., 2:] += boxes[..., :2] + 1
+    scores = rng.random((1, c, m)).astype(np.float32)
+    out, idx, num = vops.multiclass_nms3(
+        T(boxes), T(scores), score_threshold=0.3, nms_threshold=0.4,
+        background_label=-1, return_index=True)
+    rows = []
+    for cl in range(c):
+        sc = scores[0, cl]
+        sel = np.nonzero(sc > 0.3)[0]
+        for k in _naive_nms(boxes[0, sel], sc[sel], 0.4):
+            rows.append((cl, sc[sel][k], *boxes[0, sel][k]))
+    rows.sort(key=lambda r: -r[1])
+    got = A(out)
+    assert int(A(num)[0]) == len(rows)
+    np.testing.assert_allclose(got, np.asarray(rows, np.float32), rtol=1e-5)
+
+
+def test_matrix_nms_decay_matches_naive():
+    m = 6
+    boxes = rng.random((1, m, 4)).astype(np.float32) * 8
+    boxes[..., 2:] += boxes[..., :2] + 2
+    scores = rng.random((1, 2, m)).astype(np.float32)
+    scores[0, 0] = 0  # background
+    out, idx, num = vops.matrix_nms(
+        T(boxes), T(scores), score_threshold=0.01, post_threshold=0.0,
+        nms_top_k=-1, keep_top_k=-1, use_gaussian=True, gaussian_sigma=2.0,
+        background_label=0, return_index=True)
+    # naive decay for class 1 (over the score_threshold survivors, like the op)
+    sel = np.nonzero(scores[0, 1] > 0.01)[0]
+    sc = scores[0, 1][sel]
+    bsel = boxes[0][sel]
+    m = len(sel)
+    order = np.argsort(-sc)
+    b = bsel[order]
+    a = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    iou = np.zeros((m, m))
+    for i in range(m):
+        for j in range(m):
+            xx1, yy1 = max(b[i, 0], b[j, 0]), max(b[i, 1], b[j, 1])
+            xx2, yy2 = min(b[i, 2], b[j, 2]), min(b[i, 3], b[j, 3])
+            inter = max(xx2 - xx1, 0) * max(yy2 - yy1, 0)
+            iou[i, j] = inter / (a[i] + a[j] - inter + 1e-10)
+    f = lambda x: np.exp(-2.0 * x * x)
+    decay = np.ones(m)
+    for j in range(m):
+        d = 1.0
+        for i in range(j):
+            comp = max(iou[k, i] for k in range(i)) if i else 0.0
+            d = min(d, f(iou[i, j]) / f(comp))
+        decay[j] = d
+    exp_scores = np.sort(sc)[::-1] * decay
+    got = A(out)
+    np.testing.assert_allclose(np.sort(got[:, 1])[::-1],
+                               np.sort(exp_scores)[::-1], rtol=1e-4,
+                               atol=1e-5)
+
+
+# ---------------------------------------------------- matching / proposals
+
+def test_bipartite_match_greedy():
+    d = np.array([[0.6, 0.1, 0.3],
+                  [0.2, 0.8, 0.4]], np.float32)
+    idx, dist = vops.bipartite_match(T(d))
+    np.testing.assert_array_equal(A(idx)[0], [0, 1, -1])
+    np.testing.assert_allclose(A(dist)[0], [0.6, 0.8, 0.0])
+
+
+def test_bipartite_match_per_prediction():
+    d = np.array([[0.6, 0.1, 0.55],
+                  [0.2, 0.8, 0.4]], np.float32)
+    idx, dist = vops.bipartite_match(T(d), match_type="per_prediction",
+                                     dist_threshold=0.5)
+    # col 2 unmatched by greedy, best row 0 with 0.55 >= 0.5
+    np.testing.assert_array_equal(A(idx)[0], [0, 1, 0])
+
+
+def test_generate_proposals_pipeline():
+    feat = T(np.zeros((1, 8, 4, 4)))
+    anch, var = vops.anchor_generator(feat, [16], [1.0], [1., 1., 1., 1.],
+                                      [8., 8.])
+    scores = T(rng.random((1, 1, 4, 4)).astype(np.float32))
+    deltas = T((rng.standard_normal((1, 4, 4, 4)) * 0.1).astype(np.float32))
+    imgsz = T(np.array([[32., 32.]], np.float32))
+    rois, rscores, rn = vops.generate_proposals(
+        scores, deltas, imgsz, anch, var, pre_nms_top_n=10,
+        post_nms_top_n=5, nms_thresh=0.7, min_size=1.0)
+    k = int(A(rn)[0])
+    assert 1 <= k <= 5 and A(rois).shape == (k, 4)
+    r = A(rois)
+    assert r.min() >= 0.0 and r.max() <= 32.0
+
+
+def test_fpn_distribute_and_collect():
+    rois = np.array([[0, 0, 16, 16], [0, 0, 100, 100], [5, 5, 300, 300]],
+                    np.float32)
+    outs, restore, nums = vops.distribute_fpn_proposals(
+        T(rois), 2, 5, 4, 224, rois_num=T(np.array([3], np.int32)))
+    sizes = [int(o.shape[0]) for o in outs]
+    assert sum(sizes) == 3
+    # restore index maps concatenated-by-level order back to input order
+    cat = np.concatenate([A(o) for o in outs if o.shape[0]], 0)
+    rest = A(restore).ravel()
+    np.testing.assert_allclose(cat[rest], rois)
+    col = vops.collect_fpn_proposals(
+        [o for o in outs if o.shape[0]],
+        [T(rng.random((s, 1)).astype(np.float32)) for s in sizes if s],
+        2, 5, 2)
+    assert A(col).shape == (2, 4)
+
+
+# ----------------------------------------------------------------- pooling
+
+def test_roi_pool_matches_naive():
+    x = rng.standard_normal((1, 2, 8, 8)).astype(np.float32)
+    boxes = np.array([[0., 0., 4., 4.], [2., 2., 7., 6.]], np.float32)
+    out = A(vops.roi_pool(T(x), T(boxes), T(np.array([2], np.int32),
+                                            np.int32), 2))
+    assert out.shape == (2, 2, 2, 2)
+    # naive quantized-bin max pool
+    for r, (x1, y1, x2, y2) in enumerate(boxes.round().astype(int)):
+        rw, rh = max(x2 - x1, 1), max(y2 - y1, 1)
+        for i in range(2):
+            for j in range(2):
+                ys = slice(y1 + int(np.floor(i * rh / 2)),
+                           y1 + int(np.ceil((i + 1) * rh / 2)))
+                xs = slice(x1 + int(np.floor(j * rw / 2)),
+                           x1 + int(np.ceil((j + 1) * rw / 2)))
+                exp = x[0, :, ys, xs].max(axis=(1, 2))
+                np.testing.assert_allclose(out[r, :, i, j], exp, rtol=1e-5)
+
+
+def test_psroi_pool_position_sensitive():
+    oh = ow = 2
+    c = 2 * oh * ow
+    x = rng.standard_normal((1, c, 8, 8)).astype(np.float32)
+    boxes = np.array([[0., 0., 8., 8.]], np.float32)
+    out = A(vops.psroi_pool(T(x), T(boxes), T(np.array([1], np.int32),
+                                              np.int32), 2))
+    assert out.shape == (1, 2, 2, 2)
+    # bin (i,j) of out channel k averages input channel k*4 + i*2 + j
+    for k in range(2):
+        for i in range(2):
+            for j in range(2):
+                ch = k * 4 + i * 2 + j
+                exp = x[0, ch, i * 4:(i + 1) * 4, j * 4:(j + 1) * 4].mean()
+                np.testing.assert_allclose(out[0, k, i, j], exp, rtol=1e-4)
+
+
+# ------------------------------------------------- deform conv / correlation
+
+def test_deform_conv2d_zero_offset_equals_conv2d():
+    import paddle_tpu.nn.functional as F
+
+    x = rng.standard_normal((2, 4, 6, 6)).astype(np.float32)
+    w = rng.standard_normal((5, 4, 3, 3)).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 6, 6), np.float32)
+    got = vops.deform_conv2d(T(x), T(off), T(w), padding=1)
+    ref = F.conv2d(T(x), T(w), padding=1)
+    np.testing.assert_allclose(A(got), A(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_deform_conv2d_mask_and_grad():
+    x = T(rng.standard_normal((1, 2, 5, 5)).astype(np.float32))
+    x.stop_gradient = False
+    off = T((rng.standard_normal((1, 2 * 9, 5, 5)) * 0.3).astype(np.float32))
+    mask = T(np.full((1, 9, 5, 5), 0.5, np.float32))
+    w = T(rng.standard_normal((3, 2, 3, 3)).astype(np.float32))
+    out = vops.deform_conv2d(x, off, w, padding=1, mask=mask)
+    out.sum().backward()
+    assert np.isfinite(A(x.grad)).all()
+
+
+def test_correlation_matches_naive():
+    n, c, h, w = 1, 3, 6, 6
+    x1 = rng.standard_normal((n, c, h, w)).astype(np.float32)
+    x2 = rng.standard_normal((n, c, h, w)).astype(np.float32)
+    rad, pad = 1, 1
+    got = A(vops.correlation(T(x1), T(x2), pad_size=pad, kernel_size=1,
+                             max_displacement=rad, stride1=1, stride2=1))
+    x1p = np.pad(x1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    x2p = np.pad(x2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = h + 2 * pad - 2 * rad
+    k = 0
+    for dy in range(-rad, rad + 1):
+        for dx in range(-rad, rad + 1):
+            a = x1p[:, :, rad:rad + oh, rad:rad + oh]
+            b = x2p[:, :, rad + dy:rad + dy + oh, rad + dx:rad + dx + oh]
+            exp = (a * b).mean(axis=1)
+            np.testing.assert_allclose(got[:, k], exp, rtol=1e-4, atol=1e-5)
+            k += 1
+
+
+# ----------------------------------------------------------------- image IO
+
+def test_read_file_decode_jpeg_roundtrip(tmp_path):
+    from PIL import Image
+
+    arr = np.zeros((16, 16, 3), np.uint8)
+    arr[:8] = [255, 0, 0]
+    p = tmp_path / "t.jpg"
+    Image.fromarray(arr).save(p, quality=95)
+    raw = vops.read_file(str(p))
+    assert raw.dtype == paddle.uint8 if hasattr(paddle, "uint8") else True
+    img = vops.decode_jpeg(raw, mode="rgb")
+    got = A(img)
+    assert got.shape == (3, 16, 16) and got.dtype == np.uint8
+    assert got[0, :8].mean() > 200 and got[1, :8].mean() < 60
+    gray = vops.decode_jpeg(raw, mode="gray")
+    assert A(gray).shape == (1, 16, 16)
+
+
+def test_box_coder_decode_gradient_flows():
+    pri = T(np.array([[0., 0., 4., 4.], [2., 2., 10., 12.]], np.float32))
+    deltas = T(rng.standard_normal((2, 2, 4)).astype(np.float32) * 0.1)
+    deltas.stop_gradient = False
+    dec = vops.box_coder(pri, [0.1, 0.1, 0.2, 0.2], deltas,
+                         code_type="decode_center_size", axis=0)
+    dec.sum().backward()
+    g = A(deltas.grad)
+    assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+def test_multiclass_nms3_pre_nms_top_k():
+    # two overlapping boxes + one distant low-score box; nms_top_k=2 keeps
+    # only the 2 highest-scored CANDIDATES before NMS, so the distant
+    # low-score box must never appear
+    boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]]],
+                     np.float32)
+    scores = np.array([[[0.9, 0.8, 0.3]]], np.float32)
+    out, num = vops.multiclass_nms3(T(boxes), T(scores),
+                                    score_threshold=0.1, nms_top_k=2,
+                                    nms_threshold=0.5)
+    got = A(out)
+    assert int(A(num)[0]) == 1  # second candidate suppressed, third capped
+    np.testing.assert_allclose(got[0, 2:], boxes[0, 0])
+
+
+def test_correlation_kernel_size_patch_mean():
+    n, c, h, w = 1, 2, 6, 6
+    x1 = rng.standard_normal((n, c, h, w)).astype(np.float32)
+    x2 = rng.standard_normal((n, c, h, w)).astype(np.float32)
+    got = A(vops.correlation(T(x1), T(x2), pad_size=1, kernel_size=3,
+                             max_displacement=1, stride1=1, stride2=1))
+    k1 = A(vops.correlation(T(x1), T(x2), pad_size=1, kernel_size=1,
+                            max_displacement=1, stride1=1, stride2=1))
+    assert got.shape == k1.shape
+    # kernel_size=3 is the 3x3 box mean of the kernel_size=1 product map
+    pad = np.pad(k1, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    exp = np.zeros_like(k1)
+    for dy in range(3):
+        for dx in range(3):
+            exp += pad[:, :, dy:dy + k1.shape[2], dx:dx + k1.shape[3]]
+    np.testing.assert_allclose(got, exp / 9.0, rtol=1e-4, atol=1e-5)
+
+
+def test_collect_fpn_proposals_per_image():
+    # 2 images; level A has [2, 1] rois per image, level B has [1, 2]
+    rois_a = T(np.array([[0, 0, 1, 1], [0, 0, 2, 2], [0, 0, 3, 3]]))
+    rois_b = T(np.array([[0, 0, 4, 4], [0, 0, 5, 5], [0, 0, 6, 6]]))
+    sc_a = T(np.array([[0.9], [0.8], [0.1]]))
+    sc_b = T(np.array([[0.7], [0.2], [0.3]]))
+    out, nums = vops.collect_fpn_proposals(
+        [rois_a, rois_b], [sc_a, sc_b], 2, 3, post_nms_top_n=2,
+        rois_num_per_level=[T(np.array([2, 1]), np.int32),
+                            T(np.array([1, 2]), np.int32)])
+    np.testing.assert_array_equal(A(nums), [2, 2])
+    got = A(out)
+    # image 0 candidates: scores .9 .8 (level A) .7 (level B) -> top2 = .9 .8
+    np.testing.assert_allclose(got[0], [0, 0, 1, 1])
+    np.testing.assert_allclose(got[1], [0, 0, 2, 2])
+    # image 1 candidates: .1 (A) .2 .3 (B) -> top2 = .3 .2
+    np.testing.assert_allclose(got[2], [0, 0, 6, 6])
+    np.testing.assert_allclose(got[3], [0, 0, 5, 5])

@@ -1,0 +1,232 @@
+"""Graph sampling + sequence op tests.
+
+Covers paddle_tpu/geometric/sampling.py and paddle_tpu/text/ops.py
+(reference: python/paddle/geometric/sampling/neighbors.py, reindex.py,
+phi crf_decoding/edit_distance/ctc_align/chunk_eval/warprnnt kernels).
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as geo
+from paddle_tpu import text
+
+
+def T(x, dtype=np.int64):
+    return paddle.to_tensor(np.asarray(x, dtype))
+
+
+def A(t):
+    return np.asarray(t._value)
+
+
+# CSC test graph: dst<-src edges  0<-[1,2], 1<-[0,2,3], 2<-[3], 3<-[]
+ROW = np.array([1, 2, 0, 2, 3, 3], np.int64)
+COLPTR = np.array([0, 2, 5, 6, 6], np.int64)
+
+
+def test_sample_neighbors_full_and_capped():
+    nb, cnt = geo.sample_neighbors(T(ROW), T(COLPTR), T([0, 1, 3]),
+                                   sample_size=-1)
+    np.testing.assert_array_equal(A(cnt), [2, 3, 0])
+    np.testing.assert_array_equal(np.sort(A(nb)[:2]), [1, 2])
+    nb2, cnt2 = geo.sample_neighbors(T(ROW), T(COLPTR), T([1]),
+                                     sample_size=2)
+    assert A(cnt2)[0] == 2
+    assert set(A(nb2).tolist()) <= {0, 2, 3}
+
+
+def test_weighted_sample_neighbors_respects_weights():
+    # node 1's neighbor 2 has overwhelming weight — should always win
+    w = np.array([1, 1, 0.001, 1000.0, 0.001, 1], np.float32)
+    hits = 0
+    for _ in range(10):
+        nb, cnt = geo.weighted_sample_neighbors(
+            T(ROW), T(COLPTR), paddle.to_tensor(w), T([1]), sample_size=1)
+        hits += int(A(nb)[0] == 2)
+    assert hits >= 8
+
+
+def test_sample_neighbors_return_eids():
+    eids = np.array([10, 11, 12, 13, 14, 15], np.int64)
+    nb, cnt, oe = geo.sample_neighbors(T(ROW), T(COLPTR), T([0]),
+                                       sample_size=-1, eids=T(eids),
+                                       return_eids=True)
+    np.testing.assert_array_equal(np.sort(A(oe)), [10, 11])
+
+
+def test_reindex_graph_reference_example():
+    # the reference reindex.py:34 docstring example
+    src, dst, nodes = geo.reindex_graph(T([0, 1, 2]),
+                                        T([8, 9, 0, 4, 7, 6, 7]),
+                                        T([2, 3, 2], np.int32))
+    np.testing.assert_array_equal(A(src), [3, 4, 0, 5, 6, 7, 6])
+    np.testing.assert_array_equal(A(dst), [0, 0, 1, 1, 1, 2, 2])
+    np.testing.assert_array_equal(A(nodes), [0, 1, 2, 8, 9, 4, 7, 6])
+
+
+def test_khop_sampler_edges_valid():
+    es, ed, si, rx = geo.khop_sampler(T(ROW), T(COLPTR), T([0, 2]), [2, 2])
+    es, ed, si, rx = A(es), A(ed), A(si), A(rx)
+    assert len(es) == len(ed)
+    # every local id maps back to a real node; every edge exists in the graph
+    for s, d in zip(es, ed):
+        gs, gd = si[s], si[d]
+        beg, end = COLPTR[gd], COLPTR[gd + 1]
+        assert gs in ROW[beg:end]
+    np.testing.assert_array_equal(si[rx], [0, 2])
+
+
+def test_send_uv_ops_and_grad():
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(4, 2))
+    y = paddle.to_tensor(np.ones((4, 2), np.float32) * 3)
+    x.stop_gradient = False
+    out = geo.send_uv(x, y, T([0, 2]), T([1, 3]), message_op="mul")
+    np.testing.assert_allclose(A(out), np.asarray([[0, 3], [12, 15]]))
+    out.sum().backward()
+    g = A(x.grad)
+    np.testing.assert_allclose(g[0], [3, 3])
+    np.testing.assert_allclose(g[1], [0, 0])
+    for op, fn in (("add", np.add), ("sub", np.subtract),
+                   ("div", np.divide)):
+        got = A(geo.send_uv(x, y, T([1]), T([2]), message_op=op))
+        np.testing.assert_allclose(got[0], fn(A(x)[1], A(y)[2]), rtol=1e-6)
+
+
+# ------------------------------------------------------------------- text
+
+def test_edit_distance_known_cases():
+    d, n = text.edit_distance(T([[1, 2, 3, 4]]), T([[1, 3, 3, 0]]),
+                              normalized=False,
+                              label_length=T([3]))
+    assert float(A(d)[0, 0]) == 2.0  # substitute 2->3, delete 4
+    assert int(A(n)[0]) == 1
+    d2, _ = text.edit_distance(T([[1, 2, 3]]), T([[1, 2, 3]]),
+                               normalized=True)
+    assert float(A(d2)[0, 0]) == 0.0
+
+
+def test_edit_distance_ignored_tokens():
+    d, _ = text.edit_distance(T([[1, 0, 2]]), T([[1, 2, 0]]),
+                              normalized=False, ignored_tokens=[0])
+    assert float(A(d)[0, 0]) == 0.0
+
+
+def test_ctc_align_merges_and_pads():
+    a, l = text.ctc_align(T([[0, 1, 1, 0, 2, 2, 3],
+                             [5, 5, 0, 0, 0, 0, 0]]))
+    np.testing.assert_array_equal(A(l), [3, 1])
+    np.testing.assert_array_equal(A(a)[0], [1, 2, 3])
+    np.testing.assert_array_equal(A(a)[1], [5, 0, 0])
+
+
+def test_chunk_eval_iob():
+    # IOB, 2 types: tag = type*2 + {0:B, 1:I}; O = 4
+    label = [[0, 1, 4, 2, 3, 4]]   # chunks: type0 [0,1], type1 [3,4]
+    infer = [[0, 1, 4, 2, 4, 4]]   # type0 [0,1] correct, type1 [3,3] wrong
+    p, r, f1, ni, nl, nc = text.chunk_eval(T(infer), T(label), "IOB", 2)
+    assert int(A(ni)[0]) == 2 and int(A(nl)[0]) == 2 and int(A(nc)[0]) == 1
+    np.testing.assert_allclose(A(p)[0], 0.5)
+    np.testing.assert_allclose(A(f1)[0], 0.5)
+
+
+def test_chunk_eval_iobes_single():
+    # IOBES, 1 type: B=0 I=1 E=2 S=3, O=4
+    seq = [[3, 4, 0, 1, 2]]  # S chunk [0,0], BIE chunk [2,4]
+    p, r, f1, ni, nl, nc = text.chunk_eval(T(seq), T(seq), "IOBES", 1)
+    assert int(A(nc)[0]) == 2 and float(A(f1)[0]) == 1.0
+
+
+def test_crf_decoding_matches_viterbi_bruteforce():
+    rng = np.random.default_rng(0)
+    n = 3
+    emit = rng.standard_normal((1, 4, n)).astype(np.float32)
+    trans = rng.standard_normal((n + 2, n)).astype(np.float32)
+    path = A(text.crf_decoding(paddle.to_tensor(emit),
+                               paddle.to_tensor(trans)))
+    # brute force over all 3^4 paths
+    import itertools
+
+    best, best_s = None, -1e30
+    for p in itertools.product(range(n), repeat=4):
+        s = trans[0, p[0]] + emit[0, 0, p[0]]
+        for t in range(1, 4):
+            s += trans[2 + p[t - 1], p[t]] + emit[0, t, p[t]]
+        s += trans[1, p[-1]]
+        if s > best_s:
+            best, best_s = p, s
+    np.testing.assert_array_equal(path[0] if path.ndim == 2 else path,
+                                  best)
+
+
+def test_rnnt_loss_matches_numpy_dp():
+    import jax
+
+    rng = np.random.default_rng(3)
+    B, Tm, U, V = 2, 4, 2, 5
+    logits = paddle.to_tensor(rng.standard_normal((B, Tm, U + 1, V))
+                              .astype(np.float32))
+    logits.stop_gradient = False
+    labels = T([[1, 2], [3, 1]])
+    il, ll = T([4, 3]), T([2, 1])
+    loss = text.rnnt_loss(logits, labels, il, ll, reduction="none")
+
+    def np_rnnt(logp, lab, T_, U_):
+        alpha = np.full((T_, U_ + 1), -1e30)
+        alpha[0, 0] = 0
+        for t in range(T_):
+            for u in range(U_ + 1):
+                if t == 0 and u == 0:
+                    continue
+                cands = []
+                if t > 0:
+                    cands.append(alpha[t - 1, u] + logp[t - 1, u, 0])
+                if u > 0:
+                    cands.append(alpha[t, u - 1] + logp[t, u - 1, lab[u - 1]])
+                alpha[t, u] = np.logaddexp.reduce(cands)
+        return -(alpha[T_ - 1, U_] + logp[T_ - 1, U_, 0])
+
+    lp = np.asarray(jax.nn.log_softmax(logits._value, axis=-1))
+    np.testing.assert_allclose(
+        A(loss), [np_rnnt(lp[0], [1, 2], 4, 2), np_rnnt(lp[1], [3, 1], 3, 1)],
+        rtol=1e-5)
+    loss.sum().backward()
+    assert np.isfinite(A(logits.grad)).all()
+
+
+def test_khop_sampler_threads_eids():
+    eids = np.array([100, 101, 102, 103, 104, 105], np.int64)
+    res = geo.khop_sampler(T(ROW), T(COLPTR), T([0]), [-1],
+                           sorted_eids=T(eids), return_eids=True)
+    out_eids = A(res[4])
+    assert set(out_eids.tolist()) <= set(eids.tolist())
+
+
+def test_chunk_eval_ioe_single_token_chunks():
+    # IOE, 1 type: I=0 E=1, O=2. [E, O, E] = two single-token chunks
+    seq = [[1, 2, 1]]
+    p, r, f1, ni, nl, nc = text.chunk_eval(T(seq), T(seq), "IOE", 1)
+    assert int(A(ni)[0]) == 2 and int(A(nc)[0]) == 2
+    assert float(A(f1)[0]) == 1.0
+
+
+def test_rnnt_fastemit_changes_grad_not_loss():
+    rng2 = np.random.default_rng(11)
+    logits_np = rng2.standard_normal((1, 3, 2, 4)).astype(np.float32)
+    labels, il, ll = T([[1]]), T([3]), T([1])
+    lt1 = paddle.to_tensor(logits_np); lt1.stop_gradient = False
+    l1 = text.rnnt_loss(lt1, labels, il, ll, fasteremit_lambda=0.0)
+    l1.backward()
+    lt2 = paddle.to_tensor(logits_np); lt2.stop_gradient = False
+    l2 = text.rnnt_loss(lt2, labels, il, ll, fasteremit_lambda=0.5)
+    l2.backward()
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    assert not np.allclose(A(lt1.grad), A(lt2.grad))
+
+
+def test_weighted_sample_zero_weight_edges():
+    # node 1 has 3 neighbors but only 1 nonzero weight; k=2 must not crash
+    w = np.array([1, 1, 1.0, 0.0, 0.0, 1], np.float32)
+    nb, cnt = geo.weighted_sample_neighbors(
+        T(ROW), T(COLPTR), paddle.to_tensor(w), T([1]), sample_size=2)
+    assert int(A(cnt)[0]) == 1 and int(A(nb)[0]) == 0
