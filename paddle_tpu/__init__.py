@@ -304,6 +304,7 @@ from paddle_tpu import sparse  # noqa: E402,F401
 from paddle_tpu.tensor import fft, linalg  # noqa: E402,F401
 from paddle_tpu import static  # noqa: E402,F401
 from paddle_tpu import vision  # noqa: E402,F401
+from paddle_tpu import quantization  # noqa: E402,F401
 from paddle_tpu import hapi  # noqa: E402,F401
 from paddle_tpu.hapi import Model, summary  # noqa: E402,F401
 from paddle_tpu.utils.flops import flops  # noqa: E402,F401

@@ -127,16 +127,31 @@ class QAT:
                     sub._sub_layers[child_name] = QuantedLinear(child)
         return model
 
-    def convert(self, model: Layer, inplace=False):
-        """Materialize int8 weights + scales for deployment."""
+    def convert(self, model: Layer, inplace=False, to_int8=False):
+        """Materialize int8 weights + scales for deployment. With
+        `to_int8=True`, swap each QuantedLinear for an Int8Linear that
+        EXECUTES int8 x int8 on the MXU (quantization/int8.py) instead of
+        keeping the QDQ simulation."""
+        from paddle_tpu.quantization.int8 import Int8Linear, weight_quantize
+
+        def materialize(child):
+            qw, s = weight_quantize(child.weight)
+            child._int8_weight = np.asarray(qw._value)
+            child._weight_scale = np.asarray(s._value)
+
+        if isinstance(model, QuantedLinear):  # root layer itself
+            if to_int8:
+                return Int8Linear(model)
+            materialize(model)
+            return model
         for _, sub in model.named_sublayers(include_self=True):
-            if isinstance(sub, QuantedLinear):
-                qmax = 2.0 ** (sub.weight_quanter.quant_bits - 1) - 1
-                s = float(jnp.abs(sub.weight._value).max()) / qmax
-                sub._int8_weight = np.asarray(
-                    jnp.clip(jnp.round(sub.weight._value / s), -qmax, qmax)
-                ).astype(np.int8)
-                sub._weight_scale = s
+            for child_name, child in list(sub._sub_layers.items()):
+                if not isinstance(child, QuantedLinear):
+                    continue
+                if to_int8:
+                    sub._sub_layers[child_name] = Int8Linear(child)
+                else:
+                    materialize(child)
         return model
 
 
@@ -179,3 +194,9 @@ class PTQ:
                     q.eval()
                     sub._sub_layers[child_name] = q
         return model
+
+
+from paddle_tpu.quantization.int8 import (  # noqa: F401,E402
+    Int8Linear, apply_per_channel_scale, dequantize_linear, llm_int8_linear,
+    quantize_linear, weight_dequantize, weight_only_linear, weight_quantize,
+)

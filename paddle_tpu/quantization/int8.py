@@ -1,0 +1,303 @@
+"""Int8 execution path: weight-only + LLM.int8 linears, QDQ ops.
+
+Reference surface: phi kernels weight_quantize / weight_dequantize /
+weight_only_linear (paddle/phi/kernels/gpu/weight_only_linear_kernel.cu),
+llm_int8_linear, quantize_linear / dequantize_linear (QDQ, fake_quantize
+family in paddle/phi/kernels/fake_quantize_*), apply_per_channel_scale.
+
+TPU-native: the MXU multiplies int8 at 2x bf16 throughput (v5e: 394 vs
+197 TOPS), so real int8 execution is lax.dot_general with
+preferred_element_type=int32 over per-channel/per-token scales — no
+custom kernels needed; XLA fuses the (de)quantize elementwise chains.
+Weight-only mode keeps int8 weights in HBM (halving weight bandwidth)
+and dequantizes inside the fused matmul epilogue.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.registry import OPS, OpDef, dispatch
+
+
+def _u(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _wrap(x):
+    return Tensor._wrap(jnp.asarray(x))
+
+
+def _as_t(v):
+    return v if isinstance(v, Tensor) else _wrap(v)
+
+
+# ------------------------------------------------------------ weight quant
+
+def _weight_quantize(w, algo="weight_only_int8", group_size=-1):
+    """Per-output-channel symmetric abs-max int8 (int4 packs the range
+    only; storage stays int8). w: [in, out] -> (qw int8 [in, out],
+    scale fp [out])."""
+    bits = 4 if "int4" in algo else 8
+    qmax = 2.0 ** (bits - 1) - 1
+    if group_size and group_size > 0:
+        k, n = w.shape
+        g = k // group_size
+        wg = w.reshape(g, group_size, n)
+        scale = jnp.abs(wg).max(axis=1) / qmax          # [g, n]
+        q = jnp.clip(jnp.round(wg / jnp.maximum(scale, 1e-9)[:, None, :]),
+                     -qmax, qmax)
+        return q.reshape(k, n).astype(jnp.int8), scale
+    scale = jnp.abs(w).max(axis=0) / qmax               # [out]
+    # zero channels (pruned / zero-init) quantize to 0, not NaN
+    q = jnp.clip(jnp.round(w / jnp.maximum(scale, 1e-9)[None, :]),
+                 -qmax, qmax)
+    return q.astype(jnp.int8), scale
+
+
+def _weight_dequantize(qw, scale, algo="weight_only_int8", group_size=-1):
+    if scale.ndim == 2:  # grouped
+        k, n = qw.shape
+        g = scale.shape[0]
+        return (qw.reshape(g, k // g, n).astype(scale.dtype)
+                * scale[:, None, :]).reshape(k, n)
+    return qw.astype(scale.dtype) * scale[None, :]
+
+
+OPS.setdefault("weight_quantize", OpDef("weight_quantize", _weight_quantize,
+                                        diff=False, method=False))
+OPS.setdefault("weight_dequantize",
+               OpDef("weight_dequantize", _weight_dequantize, diff=False,
+                     method=False))
+
+
+def weight_quantize(x, algo="weight_only_int8", group_size=-1):
+    q, s = dispatch("weight_quantize", (_as_t(x),),
+                    {"algo": algo, "group_size": group_size})
+    return q, s
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", group_size=-1):
+    return dispatch("weight_dequantize", (_as_t(x), _as_t(scale)),
+                    {"algo": algo, "group_size": group_size})
+
+
+def _weight_only_linear(x, qw, weight_scale, bias=None,
+                        weight_dtype="int8", group_size=-1):
+    """fp activation x int8 weight: dequant rides the matmul epilogue
+    (XLA fuses scale-multiply into the dot consumer)."""
+    w = _weight_dequantize(qw, weight_scale.astype(x.dtype),
+                           group_size=group_size)
+    out = x @ w
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+OPS.setdefault("weight_only_linear",
+               OpDef("weight_only_linear", _weight_only_linear, diff=True,
+                     method=False))
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    return dispatch("weight_only_linear",
+                    (_as_t(x), _as_t(weight), _as_t(weight_scale),
+                     _as_t(bias) if bias is not None else None),
+                    {"weight_dtype": weight_dtype, "group_size": group_size})
+
+
+# ------------------------------------------------------------ llm.int8
+
+def _llm_int8_linear(x, qw, weight_scale, bias=None, threshold=6.0):
+    """LLM.int8 [Dettmers 2022]: outlier activation columns run in fp,
+    the rest as int8 x int8 -> int32 on the MXU with per-token dynamic
+    activation scales."""
+    qmax = 127.0
+    absx = jnp.abs(x)
+    outlier = (absx.max(axis=tuple(range(x.ndim - 1))) >= threshold)  # [in]
+    x_reg = jnp.where(outlier[None, :], 0.0, x.reshape(-1, x.shape[-1]))
+    # per-token dynamic abs-max quant of the regular columns
+    xs = jnp.maximum(jnp.abs(x_reg).max(axis=-1, keepdims=True), 1e-8) / qmax
+    xq = jnp.clip(jnp.round(x_reg / xs), -qmax, qmax).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, qw, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)               # [tokens, out] int32
+    reg = acc.astype(x.dtype) * xs * weight_scale[None, :].astype(x.dtype)
+    # outlier columns at full precision against dequantized weight rows
+    w_out = (qw.astype(x.dtype) * weight_scale[None, :]) * \
+        outlier[:, None].astype(x.dtype)
+    x_out = x.reshape(-1, x.shape[-1]) * outlier[None, :].astype(x.dtype)
+    out = (reg + x_out @ w_out).reshape(*x.shape[:-1], qw.shape[1])
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+OPS.setdefault("llm_int8_linear", OpDef("llm_int8_linear", _llm_int8_linear,
+                                        diff=False, method=False))
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0):
+    return dispatch("llm_int8_linear",
+                    (_as_t(x), _as_t(weight), _as_t(weight_scale),
+                     _as_t(bias) if bias is not None else None),
+                    {"threshold": threshold})
+
+
+def _apply_per_channel_scale(x, scales):
+    return x * scales
+
+
+OPS.setdefault("apply_per_channel_scale",
+               OpDef("apply_per_channel_scale", _apply_per_channel_scale,
+                     diff=True, method=False))
+
+
+def apply_per_channel_scale(x, scales):
+    """Pre-scale activations per channel before a weight-only matmul
+    (smooth-quant style; reference apply_per_channel_scale op)."""
+    return dispatch("apply_per_channel_scale", (_as_t(x), _as_t(scales)), {})
+
+
+# ------------------------------------------------------------ QDQ ops
+
+def _quantize_linear(x, scale, zero_point=None, axis=-1, bit_length=8,
+                     round_type=0):
+    qmax = 2.0 ** (bit_length - 1) - 1
+    if scale.ndim == 0 or scale.size == 1:
+        s = scale.reshape(())
+    else:  # per-channel along `axis`
+        shape = [1] * x.ndim
+        shape[axis] = -1
+        s = scale.reshape(shape)
+    q = jnp.clip(jnp.round(x / jnp.maximum(s, 1e-9) * qmax), -qmax, qmax)
+    return q.astype(jnp.int8)
+
+
+def _dequantize_linear(x, scale, zero_point=None, axis=-1, bit_length=8):
+    qmax = 2.0 ** (bit_length - 1) - 1
+    if scale.ndim == 0 or scale.size == 1:
+        s = scale.reshape(())
+    else:
+        shape = [1] * x.ndim
+        shape[axis] = -1
+        s = scale.reshape(shape)
+    return x.astype(scale.dtype) * s / qmax
+
+
+OPS.setdefault("quantize_linear", OpDef("quantize_linear", _quantize_linear,
+                                        diff=False, method=False))
+OPS.setdefault("dequantize_linear",
+               OpDef("dequantize_linear", _dequantize_linear, diff=False,
+                     method=False))
+
+
+def quantize_linear(x, scale, zero_point=None, axis=-1, bit_length=8):
+    return dispatch("quantize_linear", (_as_t(x), _as_t(scale)),
+                    {"axis": axis, "bit_length": bit_length})
+
+
+def dequantize_linear(x, scale, zero_point=None, axis=-1, bit_length=8):
+    return dispatch("dequantize_linear", (_as_t(x), _as_t(scale)),
+                    {"axis": axis, "bit_length": bit_length})
+
+
+# ----------------------------------------------- fake_quantize family
+
+def _fq_abs_max(x, bit_length=8):
+    qmax = 2.0 ** (bit_length - 1) - 1
+    scale = jnp.abs(x).max()
+    q = jnp.clip(jnp.round(x / jnp.maximum(scale, 1e-9) * qmax), -qmax, qmax)
+    return q, scale
+
+
+def _fq_channel_wise_abs_max(x, bit_length=8, quant_axis=0):
+    qmax = 2.0 ** (bit_length - 1) - 1
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    scale = jnp.abs(x).max(axis=axes)
+    shape = [1] * x.ndim
+    shape[quant_axis] = -1
+    q = jnp.clip(jnp.round(x / jnp.maximum(scale.reshape(shape), 1e-9)
+                           * qmax), -qmax, qmax)
+    return q, scale
+
+
+def _fq_dequant_abs_max(x, bit_length=8):
+    q, scale = _fq_abs_max(x, bit_length)
+    qmax = 2.0 ** (bit_length - 1) - 1
+    dq = q * scale / qmax
+    return x + jax.lax.stop_gradient(dq - x), scale  # STE
+
+
+def _fake_dequantize_max_abs(x, scale, max_range):
+    return x.astype(scale.dtype) * scale / max_range
+
+
+def _dequantize_log(x, dict_table):
+    """Log-quantized lookup dequant (reference dequantize_log_op): int8
+    code -> table[|code|] with sign."""
+    idx = jnp.abs(x.astype(jnp.int32))
+    val = jnp.take(dict_table, idx)
+    return jnp.where(x < 0, -val, val)
+
+
+for _n, _f, _d in (
+        ("fake_quantize_abs_max", _fq_abs_max, False),
+        ("fake_channel_wise_quantize_abs_max", _fq_channel_wise_abs_max,
+         False),
+        ("fake_quantize_dequantize_abs_max", _fq_dequant_abs_max, True),
+        ("fake_dequantize_max_abs", _fake_dequantize_max_abs, False),
+        ("dequantize_abs_max", _fake_dequantize_max_abs, False),
+        ("dequantize_log", _dequantize_log, False)):
+    OPS.setdefault(_n, OpDef(_n, _f, diff=_d, method=False))
+
+# moving-average / range variants share the stateful quanter in
+# quantization/__init__.py (FakeQuanterWithAbsMax); op-registry aliases:
+from paddle_tpu.quantization import _fake_quant as _fq_core  # noqa: E402
+
+for _n in ("fake_quantize_moving_average_abs_max",
+           "fake_quantize_dequantize_moving_average_abs_max",
+           "fake_quantize_range_abs_max",
+           "fake_channel_wise_quantize_dequantize_abs_max",
+           "fake_channel_wise_dequantize_max_abs"):
+    OPS.setdefault(_n, OpDef(_n, _fq_core, diff=True, method=False))
+
+
+# ------------------------------------------------------------ int8 layer
+
+from paddle_tpu.nn.layer import Layer  # noqa: E402
+
+
+class Int8Linear(Layer):
+    """Real int8 execution Linear for converted models: int8 weights in
+    HBM, per-token dynamic activation quant, int8 x int8 -> int32 MXU
+    matmul (the deployment target of QAT/PTQ convert(to_int8=True))."""
+
+    def __init__(self, linear):
+        super().__init__()
+        w = _u(linear.weight)
+        qw, scale = _weight_quantize(w)
+        self.register_buffer("qweight", _wrap(qw))
+        self.register_buffer("scale", _wrap(scale))
+        self.bias = linear.bias
+
+    def forward(self, x):
+        xv = _u(x)
+        qmax = 127.0
+        flat = xv.reshape(-1, xv.shape[-1])
+        xs = jnp.maximum(jnp.abs(flat).max(axis=-1, keepdims=True),
+                         1e-8) / qmax
+        xq = jnp.clip(jnp.round(flat / xs), -qmax, qmax).astype(jnp.int8)
+        acc = jax.lax.dot_general(
+            xq, _u(self.qweight), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        out = acc.astype(xv.dtype) * xs * _u(self.scale)[None, :].astype(
+            xv.dtype)
+        out = out.reshape(*xv.shape[:-1], out.shape[-1])
+        if self.bias is not None:
+            out = out + _u(self.bias)
+        return _wrap(out)

@@ -1,0 +1,139 @@
+"""Int8 execution path tests (paddle_tpu/quantization/int8.py).
+
+Reference surface: weight_quantize / weight_only_linear / llm_int8_linear
+/ quantize_linear family (phi gpu kernels; here MXU int8 dot_general).
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu import quantization as Q
+from paddle_tpu.quantization.int8 import Int8Linear
+
+rng = np.random.default_rng(0)
+
+
+def T(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+def A(t):
+    return np.asarray(t._value)
+
+
+def test_weight_quantize_roundtrip():
+    w = rng.standard_normal((64, 32)).astype(np.float32)
+    qw, s = Q.weight_quantize(T(w))
+    assert A(qw).dtype == np.int8
+    assert A(s).shape == (32,)
+    wd = A(Q.weight_dequantize(qw, s))
+    assert abs(wd - w).max() / abs(w).max() < 0.01
+
+
+def test_weight_quantize_int4_range():
+    w = rng.standard_normal((16, 8)).astype(np.float32)
+    qw, s = Q.weight_quantize(T(w), algo="weight_only_int4")
+    assert abs(A(qw)).max() <= 7
+
+
+def test_weight_quantize_grouped():
+    w = rng.standard_normal((64, 8)).astype(np.float32)
+    qw, s = Q.weight_quantize(T(w), group_size=16)
+    assert A(s).shape == (4, 8)
+    wd = A(Q.weight_dequantize(qw, s, group_size=16))
+    assert abs(wd - w).max() / abs(w).max() < 0.01
+
+
+def test_weight_only_linear_close_to_fp():
+    w = rng.standard_normal((64, 32)).astype(np.float32)
+    x = rng.standard_normal((4, 64)).astype(np.float32)
+    b = rng.standard_normal((32,)).astype(np.float32)
+    qw, s = Q.weight_quantize(T(w))
+    got = A(Q.weight_only_linear(T(x), qw, bias=T(b), weight_scale=s))
+    ref = x @ w + b
+    assert abs(got - ref).max() / abs(ref).max() < 0.02
+
+
+def test_llm_int8_linear_outlier_handling():
+    w = rng.standard_normal((64, 32)).astype(np.float32)
+    x = rng.standard_normal((4, 64)).astype(np.float32)
+    x[:, 5] *= 30.0  # outlier channel must run in fp
+    qw, s = Q.weight_quantize(T(w))
+    got = A(Q.llm_int8_linear(T(x), qw, weight_scale=s, threshold=6.0))
+    ref = x @ w
+    assert abs(got - ref).max() / abs(ref).max() < 0.05
+
+
+def test_quantize_dequantize_linear_per_channel():
+    w = rng.standard_normal((16, 8)).astype(np.float32)
+    scale = np.abs(w).max(axis=0)
+    q = Q.quantize_linear(T(w), T(scale), axis=1)
+    assert A(q).dtype == np.int8
+    dq = A(Q.dequantize_linear(q, T(scale), axis=1))
+    assert abs(dq - w).max() / abs(w).max() < 0.01
+
+
+def test_apply_per_channel_scale_grad():
+    x = T(rng.standard_normal((4, 8)).astype(np.float32))
+    x.stop_gradient = False
+    s = T(np.full((8,), 0.5, np.float32))
+    out = Q.apply_per_channel_scale(x, s)
+    out.sum().backward()
+    np.testing.assert_allclose(A(x.grad), np.full((4, 8), 0.5))
+
+
+def test_qat_convert_to_int8_executes():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    data = T(rng.standard_normal((8, 16)))
+    ref = A(model(data))
+    qat = Q.QAT()
+    model = qat.quantize(model)
+    _ = model(data)
+    model = qat.convert(model, to_int8=True)
+    assert isinstance(model._sub_layers["0"], Int8Linear)
+    got = A(model(data))
+    assert abs(got - ref).max() / (abs(ref).max() + 1e-9) < 0.1
+
+
+def test_int8_linear_state_dict_buffers():
+    lin = nn.Linear(8, 4)
+    il = Int8Linear(lin)
+    sd = il.state_dict()
+    assert any("qweight" in k for k in sd)
+    out = il(T(rng.standard_normal((2, 8))))
+    assert tuple(out.shape) == (2, 4)
+
+
+def test_dequantize_log_lookup():
+    from paddle_tpu.ops.registry import OPS
+    import jax.numpy as jnp
+
+    table = jnp.asarray(2.0 ** np.arange(128, dtype=np.float32) / 1e9)
+    codes = jnp.asarray(np.array([-3, 0, 5], np.int8))
+    out = OPS["dequantize_log"].impl(codes, table)
+    np.testing.assert_allclose(np.asarray(out),
+                               [-float(table[3]), float(table[0]),
+                                float(table[5])])
+
+
+def test_weight_quantize_zero_channel_no_nan():
+    w = rng.standard_normal((16, 4)).astype(np.float32)
+    w[:, 2] = 0.0  # pruned channel
+    qw, s = Q.weight_quantize(T(w))
+    assert np.isfinite(A(s)).all() and (A(qw)[:, 2] == 0).all()
+    wd = A(Q.weight_dequantize(qw, s))
+    assert np.isfinite(wd).all()
+
+
+def test_qat_convert_root_quanted_linear():
+    from paddle_tpu.quantization import QuantedLinear
+
+    lin = nn.Linear(8, 4)
+    q = QuantedLinear(lin)
+    out = Q.QAT().convert(q, to_int8=True)
+    assert isinstance(out, Int8Linear)
+    q2 = QuantedLinear(nn.Linear(8, 4))
+    q2 = Q.QAT().convert(q2)
+    assert hasattr(q2, "_int8_weight") and q2._int8_weight.dtype == np.int8
