@@ -1,0 +1,285 @@
+/* C inference API implementation — reference:
+ * paddle/fluid/inference/capi_exp/pd_predictor.cc, pd_tensor.cc.
+ *
+ * The reference's C API wraps AnalysisPredictor; here the predictor IS
+ * the Python paddle_tpu.inference stack (one XLA compile, PJRT buffers),
+ * so the C layer embeds CPython and marshals through it. Every entry
+ * point takes the GIL via PyGILState so callers may be plain C threads.
+ *
+ * Build: g++ -O2 -shared -fPIC -std=c++17 capi.cpp -o libpaddle_tpu_c.so
+ *        $(python3-config --includes --ldflags --embed)
+ */
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "pd_inference_c.h"
+
+namespace {
+
+struct PyRef {  // owned PyObject*
+  PyObject* p = nullptr;
+  explicit PyRef(PyObject* o = nullptr) : p(o) {}
+  ~PyRef() { Py_XDECREF(p); }
+  PyRef(const PyRef&) = delete;
+  PyObject* release() { PyObject* r = p; p = nullptr; return r; }
+};
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+bool ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // release the GIL acquired by Py_Initialize so Gil{} works uniformly
+    PyEval_SaveThread();
+  }
+  return true;
+}
+
+PyObject* inference_module() {
+  PyObject* m = PyImport_ImportModule("paddle_tpu.inference");
+  if (!m) PyErr_Print();
+  return m;
+}
+
+}  // namespace
+
+struct PD_Config {
+  PyObject* obj;  // paddle_tpu.inference.Config
+};
+
+struct PD_Predictor {
+  PyObject* obj;  // paddle_tpu.inference.Predictor
+};
+
+struct PD_Tensor {
+  PyObject* obj;   // _InputHandle / _OutputHandle
+  bool is_input;
+  std::vector<int32_t> shape;  // set via PD_TensorReshape for inputs
+  PyObject* np_cache = nullptr;  // output handles: fetched host array
+};
+
+extern "C" {
+
+PD_Config* PD_ConfigCreate() {
+  ensure_python();
+  Gil g;
+  PyRef mod(inference_module());
+  if (!mod.p) return nullptr;
+  PyObject* cfg = PyObject_CallMethod(mod.p, "Config", nullptr);
+  if (!cfg) { PyErr_Print(); return nullptr; }
+  return new PD_Config{cfg};
+}
+
+void PD_ConfigDestroy(PD_Config* config) {
+  if (!config) return;
+  { Gil g; Py_XDECREF(config->obj); }
+  delete config;
+}
+
+void PD_ConfigSetModel(PD_Config* config, const char* prog_path,
+                       const char* params_path) {
+  Gil g;
+  PyObject_SetAttrString(config->obj, "model_path",
+                         PyRef(PyUnicode_FromString(prog_path)).p);
+  (void)params_path;  // weights live inside the saved program payload
+}
+
+void PD_ConfigEnableLowPrecision(PD_Config* config, const char* dtype) {
+  Gil g;
+  PyRef r(PyObject_CallMethod(config->obj, "enable_low_precision", "s",
+                              dtype));
+  if (!r.p) PyErr_Print();
+}
+
+PD_Predictor* PD_PredictorCreate(PD_Config* config) {
+  Gil g;
+  PyRef mod(inference_module());
+  if (!mod.p) return nullptr;
+  PyObject* pred = PyObject_CallMethod(mod.p, "create_predictor", "O",
+                                       config->obj);
+  if (!pred) { PyErr_Print(); return nullptr; }
+  return new PD_Predictor{pred};
+}
+
+void PD_PredictorDestroy(PD_Predictor* predictor) {
+  if (!predictor) return;
+  { Gil g; Py_XDECREF(predictor->obj); }
+  delete predictor;
+}
+
+static PD_OneDimArrayCstr* names_from(PyObject* pred, const char* method) {
+  Gil g;
+  PyRef list(PyObject_CallMethod(pred, method, nullptr));
+  if (!list.p) { PyErr_Print(); return nullptr; }
+  Py_ssize_t n = PyList_Size(list.p);
+  auto* arr = new PD_OneDimArrayCstr;
+  arr->size = static_cast<size_t>(n);
+  arr->data = new char*[n];
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(list.p, i));
+    arr->data[i] = strdup(s ? s : "");
+  }
+  return arr;
+}
+
+PD_OneDimArrayCstr* PD_PredictorGetInputNames(PD_Predictor* predictor) {
+  return names_from(predictor->obj, "get_input_names");
+}
+
+PD_OneDimArrayCstr* PD_PredictorGetOutputNames(PD_Predictor* predictor) {
+  return names_from(predictor->obj, "get_output_names");
+}
+
+PD_Tensor* PD_PredictorGetInputHandle(PD_Predictor* predictor,
+                                      const char* name) {
+  Gil g;
+  PyObject* h = PyObject_CallMethod(predictor->obj, "get_input_handle", "s",
+                                    name);
+  if (!h) { PyErr_Print(); return nullptr; }
+  return new PD_Tensor{h, true, {}};
+}
+
+PD_Tensor* PD_PredictorGetOutputHandle(PD_Predictor* predictor,
+                                       const char* name) {
+  Gil g;
+  PyObject* h = PyObject_CallMethod(predictor->obj, "get_output_handle", "s",
+                                    name);
+  if (!h) { PyErr_Print(); return nullptr; }
+  return new PD_Tensor{h, false, {}};
+}
+
+int PD_PredictorRun(PD_Predictor* predictor) {
+  Gil g;
+  PyRef r(PyObject_CallMethod(predictor->obj, "run", nullptr));
+  if (!r.p) { PyErr_Print(); return 0; }
+  return 1;
+}
+
+void PD_TensorDestroy(PD_Tensor* tensor) {
+  if (!tensor) return;
+  { Gil g; Py_XDECREF(tensor->obj); Py_XDECREF(tensor->np_cache); }
+  delete tensor;
+}
+
+void PD_TensorReshape(PD_Tensor* tensor, size_t shape_size, int32_t* shape) {
+  tensor->shape.assign(shape, shape + shape_size);
+}
+
+static void copy_from_cpu(PD_Tensor* t, const void* data, const char* npdt,
+                          size_t item) {
+  Gil g;
+  size_t n = 1;
+  for (int32_t d : t->shape) n *= static_cast<size_t>(d);
+  PyRef np(PyImport_ImportModule("numpy"));
+  if (!np.p) { PyErr_Print(); return; }
+  PyRef frombuf(PyObject_GetAttrString(np.p, "frombuffer"));
+  if (!frombuf.p) { PyErr_Print(); return; }
+  PyRef mem(PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(const_cast<void*>(data)),
+      static_cast<Py_ssize_t>(n * item), PyBUF_READ));
+  PyRef flat(PyObject_CallFunction(frombuf.p, "Os", mem.p, npdt));
+  if (!flat.p) { PyErr_Print(); return; }
+  PyRef shape(PyTuple_New(static_cast<Py_ssize_t>(t->shape.size())));
+  for (size_t i = 0; i < t->shape.size(); ++i)
+    PyTuple_SetItem(shape.p, static_cast<Py_ssize_t>(i),
+                    PyLong_FromLong(t->shape[i]));
+  PyRef view(PyObject_CallMethod(flat.p, "reshape", "O", shape.p));
+  if (!view.p) { PyErr_Print(); return; }
+  // the frombuffer view ALIASES the caller's pointer — copy, so the
+  // stored input survives the caller freeing/reusing its buffer
+  PyRef arr(PyObject_CallMethod(view.p, "copy", nullptr));
+  if (!arr.p) { PyErr_Print(); return; }
+  PyRef r(PyObject_CallMethod(t->obj, "copy_from_cpu", "O", arr.p));
+  if (!r.p) PyErr_Print();
+}
+
+void PD_TensorCopyFromCpuFloat(PD_Tensor* tensor, const float* data) {
+  copy_from_cpu(tensor, data, "float32", sizeof(float));
+}
+
+void PD_TensorCopyFromCpuInt64(PD_Tensor* tensor, const int64_t* data) {
+  copy_from_cpu(tensor, data, "int64", sizeof(int64_t));
+}
+
+static PyObject* to_cpu_array(PD_Tensor* t) {  // caller holds GIL
+  // cached: GetShape-then-CopyToCpu is the canonical call sequence and
+  // must fetch from device only once
+  if (t->np_cache) { Py_INCREF(t->np_cache); return t->np_cache; }
+  PyObject* arr = PyObject_CallMethod(t->obj, "copy_to_cpu", nullptr);
+  if (!arr) { PyErr_Print(); return nullptr; }
+  Py_INCREF(arr);
+  t->np_cache = arr;
+  return arr;
+}
+
+static void copy_to_cpu(PD_Tensor* t, void* out, const char* npdt,
+                        size_t item) {
+  Gil g;
+  PyRef arr(to_cpu_array(t));
+  if (!arr.p) return;
+  PyRef cast(PyObject_CallMethod(arr.p, "astype", "s", npdt));
+  if (!cast.p) { PyErr_Print(); return; }
+  PyRef bytes(PyObject_CallMethod(cast.p, "tobytes", nullptr));
+  if (!bytes.p) { PyErr_Print(); return; }
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(bytes.p, &buf, &len) != 0) {
+    PyErr_Print();
+    return;
+  }
+  memcpy(out, buf, static_cast<size_t>(len));
+  (void)item;
+}
+
+void PD_TensorCopyToCpuFloat(PD_Tensor* tensor, float* data) {
+  copy_to_cpu(tensor, data, "float32", sizeof(float));
+}
+
+void PD_TensorCopyToCpuInt64(PD_Tensor* tensor, int64_t* data) {
+  copy_to_cpu(tensor, data, "int64", sizeof(int64_t));
+}
+
+PD_OneDimArrayInt32* PD_TensorGetShape(PD_Tensor* tensor) {
+  Gil g;
+  if (tensor->is_input) {
+    auto* arr = new PD_OneDimArrayInt32;
+    arr->size = tensor->shape.size();
+    arr->data = new int32_t[arr->size];
+    memcpy(arr->data, tensor->shape.data(), arr->size * sizeof(int32_t));
+    return arr;
+  }
+  PyRef np_arr(to_cpu_array(tensor));
+  if (!np_arr.p) return nullptr;
+  PyRef shape(PyObject_GetAttrString(np_arr.p, "shape"));
+  Py_ssize_t n = PyTuple_Size(shape.p);
+  auto* arr = new PD_OneDimArrayInt32;
+  arr->size = static_cast<size_t>(n);
+  arr->data = new int32_t[n];
+  for (Py_ssize_t i = 0; i < n; ++i)
+    arr->data[i] = static_cast<int32_t>(
+        PyLong_AsLong(PyTuple_GetItem(shape.p, i)));
+  return arr;
+}
+
+void PD_OneDimArrayCstrDestroy(PD_OneDimArrayCstr* array) {
+  if (!array) return;
+  for (size_t i = 0; i < array->size; ++i) free(array->data[i]);
+  delete[] array->data;
+  delete array;
+}
+
+void PD_OneDimArrayInt32Destroy(PD_OneDimArrayInt32* array) {
+  if (!array) return;
+  delete[] array->data;
+  delete array;
+}
+
+}  // extern "C"

@@ -1,0 +1,66 @@
+/* C inference API — reference:
+ * paddle/fluid/inference/capi_exp/pd_inference_api.h (paddle_inference_c).
+ *
+ * Same entry-point names and call pattern as the reference's C API, backed
+ * by the embedded CPython runtime driving paddle_tpu.inference (the XLA
+ * AOT predictor). Link against libpaddle_tpu_c.so; a Go/Rust/C caller
+ * needs only this header. */
+#ifndef PD_INFERENCE_C_H
+#define PD_INFERENCE_C_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Config PD_Config;
+typedef struct PD_Predictor PD_Predictor;
+typedef struct PD_Tensor PD_Tensor;
+
+typedef struct PD_OneDimArrayCstr {
+  size_t size;
+  char** data;
+} PD_OneDimArrayCstr;
+
+typedef struct PD_OneDimArrayInt32 {
+  size_t size;
+  int32_t* data;
+} PD_OneDimArrayInt32;
+
+/* config */
+PD_Config* PD_ConfigCreate();
+void PD_ConfigDestroy(PD_Config* config);
+void PD_ConfigSetModel(PD_Config* config, const char* prog_path,
+                       const char* params_path);
+void PD_ConfigEnableLowPrecision(PD_Config* config, const char* dtype);
+
+/* predictor */
+PD_Predictor* PD_PredictorCreate(PD_Config* config);
+void PD_PredictorDestroy(PD_Predictor* predictor);
+PD_OneDimArrayCstr* PD_PredictorGetInputNames(PD_Predictor* predictor);
+PD_OneDimArrayCstr* PD_PredictorGetOutputNames(PD_Predictor* predictor);
+PD_Tensor* PD_PredictorGetInputHandle(PD_Predictor* predictor,
+                                      const char* name);
+PD_Tensor* PD_PredictorGetOutputHandle(PD_Predictor* predictor,
+                                       const char* name);
+int PD_PredictorRun(PD_Predictor* predictor); /* 1 on success, 0 on error */
+
+/* tensor */
+void PD_TensorDestroy(PD_Tensor* tensor);
+void PD_TensorReshape(PD_Tensor* tensor, size_t shape_size, int32_t* shape);
+void PD_TensorCopyFromCpuFloat(PD_Tensor* tensor, const float* data);
+void PD_TensorCopyFromCpuInt64(PD_Tensor* tensor, const int64_t* data);
+void PD_TensorCopyToCpuFloat(PD_Tensor* tensor, float* data);
+void PD_TensorCopyToCpuInt64(PD_Tensor* tensor, int64_t* data);
+PD_OneDimArrayInt32* PD_TensorGetShape(PD_Tensor* tensor);
+
+/* array destructors */
+void PD_OneDimArrayCstrDestroy(PD_OneDimArrayCstr* array);
+void PD_OneDimArrayInt32Destroy(PD_OneDimArrayInt32* array);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PD_INFERENCE_C_H */

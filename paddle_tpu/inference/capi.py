@@ -1,0 +1,40 @@
+"""Builder for the C inference API (libpaddle_tpu_c.so).
+
+Reference: the paddle_inference_c package
+(paddle/fluid/inference/capi_exp/) that C and Go callers link against.
+Here the library embeds CPython and drives paddle_tpu.inference; see
+csrc/capi.cpp + csrc/pd_inference_c.h.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(__file__)), "csrc")
+
+
+def header_path() -> str:
+    return os.path.join(_CSRC, "pd_inference_c.h")
+
+
+def build_capi_library(out_dir: str | None = None) -> str:
+    """Compile libpaddle_tpu_c.so (cached on source mtime); returns path."""
+    out_dir = out_dir or os.path.join(_CSRC, "build")
+    os.makedirs(out_dir, exist_ok=True)
+    src = os.path.join(_CSRC, "capi.cpp")
+    out = os.path.join(out_dir, "libpaddle_tpu_c.so")
+    if (os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)
+            and os.path.getmtime(out) >= os.path.getmtime(header_path())):
+        return out
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION")
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+           src, "-o", out, f"-I{inc}", f"-I{_CSRC}",
+           f"-L{libdir}", f"-lpython{ver}", "-ldl", "-lm",
+           f"-Wl,-rpath,{libdir}"]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return out

@@ -1,0 +1,195 @@
+"""C inference API test: build libpaddle_tpu_c.so and drive a saved model
+through the C entry points via ctypes (exactly the calls a C program
+would make against csrc/pd_inference_c.h).
+
+Reference: paddle/fluid/inference/capi_exp/ (paddle_inference_c).
+"""
+
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.inference.capi import build_capi_library
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    from paddle_tpu import nn
+
+    d = tmp_path_factory.mktemp("capi_model")
+    path = str(d / "mlp")
+    paddle.seed(0)
+    net = nn.Linear(4, 3)
+    net.eval()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        y = net(x).tanh()
+    exe = static.Executor()
+    static.save_inference_model(path, [x], [y], exe, program=main)
+    return path
+
+
+@pytest.fixture(scope="module")
+def lib():
+    so = build_capi_library()
+    L = ctypes.CDLL(so)
+    L.PD_ConfigCreate.restype = ctypes.c_void_p
+    L.PD_PredictorCreate.restype = ctypes.c_void_p
+    L.PD_PredictorCreate.argtypes = [ctypes.c_void_p]
+    L.PD_ConfigSetModel.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_char_p]
+    L.PD_PredictorGetInputNames.restype = ctypes.c_void_p
+    L.PD_PredictorGetInputNames.argtypes = [ctypes.c_void_p]
+    L.PD_PredictorGetOutputNames.restype = ctypes.c_void_p
+    L.PD_PredictorGetOutputNames.argtypes = [ctypes.c_void_p]
+    L.PD_PredictorGetInputHandle.restype = ctypes.c_void_p
+    L.PD_PredictorGetInputHandle.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_char_p]
+    L.PD_PredictorGetOutputHandle.restype = ctypes.c_void_p
+    L.PD_PredictorGetOutputHandle.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_char_p]
+    L.PD_PredictorRun.argtypes = [ctypes.c_void_p]
+    L.PD_PredictorRun.restype = ctypes.c_int
+    L.PD_TensorReshape.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                   ctypes.POINTER(ctypes.c_int32)]
+    L.PD_TensorCopyFromCpuFloat.argtypes = [ctypes.c_void_p,
+                                            ctypes.POINTER(ctypes.c_float)]
+    L.PD_TensorCopyToCpuFloat.argtypes = [ctypes.c_void_p,
+                                          ctypes.POINTER(ctypes.c_float)]
+    L.PD_TensorGetShape.restype = ctypes.c_void_p
+    L.PD_TensorGetShape.argtypes = [ctypes.c_void_p]
+    L.PD_TensorDestroy.argtypes = [ctypes.c_void_p]
+    L.PD_PredictorDestroy.argtypes = [ctypes.c_void_p]
+    L.PD_ConfigDestroy.argtypes = [ctypes.c_void_p]
+    L.PD_OneDimArrayCstrDestroy.argtypes = [ctypes.c_void_p]
+    L.PD_OneDimArrayInt32Destroy.argtypes = [ctypes.c_void_p]
+    return L
+
+
+class _CstrArray(ctypes.Structure):
+    _fields_ = [("size", ctypes.c_size_t),
+                ("data", ctypes.POINTER(ctypes.c_char_p))]
+
+
+class _I32Array(ctypes.Structure):
+    _fields_ = [("size", ctypes.c_size_t),
+                ("data", ctypes.POINTER(ctypes.c_int32))]
+
+
+def test_capi_builds():
+    so = build_capi_library()
+    assert os.path.exists(so)
+
+
+def test_capi_end_to_end(lib, saved_model):
+    cfg = lib.PD_ConfigCreate()
+    assert cfg
+    lib.PD_ConfigSetModel(cfg, saved_model.encode(), b"")
+    pred = lib.PD_PredictorCreate(cfg)
+    assert pred
+
+    names = _CstrArray.from_address(lib.PD_PredictorGetInputNames(pred))
+    assert names.size == 1 and names.data[0] == b"x"
+    out_names = _CstrArray.from_address(lib.PD_PredictorGetOutputNames(pred))
+    assert out_names.size == 1
+
+    x = np.random.default_rng(0).standard_normal((2, 4)).astype(np.float32)
+    x_orig = x.copy()
+    h = lib.PD_PredictorGetInputHandle(pred, b"x")
+    shape = (ctypes.c_int32 * 2)(2, 4)
+    lib.PD_TensorReshape(h, 2, shape)
+    lib.PD_TensorCopyFromCpuFloat(
+        h, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    x[:] = 1e9  # CopyFrom must have COPIED: caller may reuse its buffer
+
+    assert lib.PD_PredictorRun(pred) == 1
+
+    oh = lib.PD_PredictorGetOutputHandle(pred, out_names.data[0])
+    shp = _I32Array.from_address(lib.PD_TensorGetShape(oh))
+    oshape = [shp.data[i] for i in range(shp.size)]
+    assert oshape == [2, 3]
+    out = np.zeros((2, 3), np.float32)
+    lib.PD_TensorCopyToCpuFloat(
+        oh, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+
+    # parity vs the python predictor on the same model
+    from paddle_tpu import inference
+
+    c2 = inference.Config(saved_model)
+    p2 = inference.create_predictor(c2)
+    ih = p2.get_input_handle("x")
+    ih.copy_from_cpu(x_orig)
+    p2.run()
+    ref = p2.get_output_handle("out_0").copy_to_cpu()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    lib.PD_OneDimArrayCstrDestroy(ctypes.addressof(names))
+    lib.PD_OneDimArrayCstrDestroy(ctypes.addressof(out_names))
+    lib.PD_OneDimArrayInt32Destroy(ctypes.addressof(shp))
+    lib.PD_TensorDestroy(h)
+    lib.PD_TensorDestroy(oh)
+    lib.PD_PredictorDestroy(pred)
+    lib.PD_ConfigDestroy(cfg)
+
+
+def test_capi_from_real_c_program(saved_model, tmp_path):
+    """Compile an actual C driver against pd_inference_c.h and run it —
+    the full from-C story (embedding CPython in a non-Python process)."""
+    import subprocess
+    import sys
+    import sysconfig
+
+    from paddle_tpu.inference.capi import build_capi_library, header_path
+
+    so = build_capi_library()
+    c_src = tmp_path / "driver.c"
+    c_src.write_text(r'''
+#include <stdio.h>
+#include "pd_inference_c.h"
+int main(int argc, char** argv) {
+  PD_Config* cfg = PD_ConfigCreate();
+  if (!cfg) return 2;
+  PD_ConfigSetModel(cfg, argv[1], "");
+  PD_Predictor* pred = PD_PredictorCreate(cfg);
+  if (!pred) return 3;
+  float x[8] = {1, 0, 0, 0, 0, 1, 0, 0};
+  int32_t shape[2] = {2, 4};
+  PD_Tensor* in = PD_PredictorGetInputHandle(pred, "x");
+  PD_TensorReshape(in, 2, shape);
+  PD_TensorCopyFromCpuFloat(in, x);
+  if (!PD_PredictorRun(pred)) return 4;
+  PD_Tensor* out = PD_PredictorGetOutputHandle(pred, "out_0");
+  float y[6];
+  PD_TensorCopyToCpuFloat(out, y);
+  for (int i = 0; i < 6; i++) printf("%f ", y[i]);
+  printf("\n");
+  PD_TensorDestroy(in); PD_TensorDestroy(out);
+  PD_PredictorDestroy(pred); PD_ConfigDestroy(cfg);
+  return 0;
+}
+''')
+    exe = tmp_path / "driver"
+    inc = os.path.dirname(header_path())
+    libdir = sysconfig.get_config_var("LIBDIR")
+    subprocess.run(
+        ["gcc", str(c_src), "-o", str(exe), f"-I{inc}", so,
+         f"-Wl,-rpath,{os.path.dirname(so)}", f"-Wl,-rpath,{libdir}"],
+        check=True, capture_output=True, text=True)
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    # the embedded interpreter must target CPU and must NOT register the
+    # axon TPU plugin (its startup registration can block on the relay
+    # when another jax process holds it — hangs the driver)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run([str(exe), saved_model], capture_output=True,
+                       text=True, env=env, timeout=240)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    vals = [float(v) for v in r.stdout.split()]
+    assert len(vals) == 6 and all(abs(v) <= 1.0 for v in vals)
