@@ -2,3 +2,12 @@
 from paddle_tpu.autograd.engine import (  # noqa: F401
     backward, enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled,
 )
+
+
+def __getattr__(name):
+    # lazy: py_layer needs core.tensor, which imports autograd first
+    if name in ("PyLayer", "PyLayerContext", "once_differentiable"):
+        from paddle_tpu.autograd import py_layer
+
+        return getattr(py_layer, name)
+    raise AttributeError(name)

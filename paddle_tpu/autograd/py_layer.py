@@ -1,0 +1,105 @@
+"""PyLayer: user-defined forward/backward in eager mode.
+
+Reference: python/paddle/autograd/py_layer.py:36 (PyLayerContext,
+PyLayer.apply over the CPyLayer plumbing in
+paddle/fluid/eager/pylayer/py_layer_node.h).
+
+TPU-native: apply() runs the user's `forward` eagerly under no_grad (its
+internal ops bypass the tape), then installs ONE GradNode whose vjp calls
+the user's `backward` — exactly how the generic dispatcher records a
+fused op, so hooks / retain_graph / paddle.grad compose unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from paddle_tpu.autograd import engine
+from paddle_tpu.core.tensor import Tensor
+
+
+class PyLayerContext:
+    """Reference py_layer.py PyLayerContext: stash state between
+    forward and backward."""
+
+    def __init__(self):
+        self._saved: List[Tensor] = []
+        self.not_inplace = False
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+    def mark_not_inplace(self, *args):
+        self.not_inplace = True
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, ns):
+        super().__init__(name, bases, ns)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Subclass with @staticmethod forward(ctx, *args) and
+    backward(ctx, *out_grads); call MyLayer.apply(*args)."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+
+        with engine.no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+        out_vals = [o._value for o in outs]
+
+        need_grad = engine.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+        if not need_grad:
+            return out
+
+        def vjp_fn(cots):
+            # cots: cotangent pytree matching the forward output structure
+            cot_list = list(cots) if isinstance(cots, (tuple, list)) else [
+                cots]
+            with engine.no_grad():
+                grads = cls.backward(
+                    ctx, *[Tensor._wrap(c) for c in cot_list])
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            if len(grads) != len(tensor_inputs):
+                raise ValueError(
+                    f"{cls.__name__}.backward returned {len(grads)} grads "
+                    f"for {len(tensor_inputs)} tensor inputs")
+            return tuple(
+                (g._value if isinstance(g, Tensor) else g)
+                for g in grads)
+
+        node = engine.GradNode(
+            cls.__name__, vjp_fn, tensor_inputs,
+            [(v.shape, v.dtype) for v in out_vals], multi_output=multi)
+
+        wrapped = []
+        for i, v in enumerate(out_vals):
+            t = Tensor._wrap(v)
+            t.stop_gradient = False
+            t._grad_node = (node, i)
+            wrapped.append(t)
+        return tuple(wrapped) if multi else wrapped[0]
+
+
+def once_differentiable(fn):
+    """Parity shim for paddle.autograd.py_layer.once_differentiable."""
+    return fn

@@ -13,6 +13,8 @@
 
 #include <cstdint>
 #include <cstring>
+#include <memory>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -34,12 +36,18 @@ struct Gil {
   ~Gil() { PyGILState_Release(st); }
 };
 
+std::once_flag g_py_init_once;
+
 bool ensure_python() {
-  if (!Py_IsInitialized()) {
-    Py_InitializeEx(0);
-    // release the GIL acquired by Py_Initialize so Gil{} works uniformly
-    PyEval_SaveThread();
-  }
+  // once_flag: two C threads racing the first PD_ConfigCreate must not
+  // both run Py_InitializeEx (undefined behavior)
+  std::call_once(g_py_init_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL acquired by Py_Initialize so Gil{} works uniformly
+      PyEval_SaveThread();
+    }
+  });
   return true;
 }
 
@@ -57,13 +65,18 @@ struct PD_Config {
 
 struct PD_Predictor {
   PyObject* obj;  // paddle_tpu.inference.Predictor
+  // bumps on every Run; shared with output handles so a handle outliving
+  // PD_PredictorDestroy reads a live counter, never freed memory
+  std::shared_ptr<uint64_t> run_count = std::make_shared<uint64_t>(0);
 };
 
 struct PD_Tensor {
   PyObject* obj;   // _InputHandle / _OutputHandle
   bool is_input;
   std::vector<int32_t> shape;  // set via PD_TensorReshape for inputs
-  PyObject* np_cache = nullptr;  // output handles: fetched host array
+  std::shared_ptr<uint64_t> run_count;  // issuing predictor's run counter
+  PyObject* np_cache = nullptr;         // fetched host array...
+  uint64_t cache_run = 0;               // ...valid only for this run_count
 };
 
 extern "C" {
@@ -153,13 +166,16 @@ PD_Tensor* PD_PredictorGetOutputHandle(PD_Predictor* predictor,
   PyObject* h = PyObject_CallMethod(predictor->obj, "get_output_handle", "s",
                                     name);
   if (!h) { PyErr_Print(); return nullptr; }
-  return new PD_Tensor{h, false, {}};
+  auto* t = new PD_Tensor{h, false, {}};
+  t->run_count = predictor->run_count;
+  return t;
 }
 
 int PD_PredictorRun(PD_Predictor* predictor) {
   Gil g;
   PyRef r(PyObject_CallMethod(predictor->obj, "run", nullptr));
   if (!r.p) { PyErr_Print(); return 0; }
+  ++*predictor->run_count;  // invalidates all output-handle caches
   return 1;
 }
 
@@ -176,6 +192,8 @@ void PD_TensorReshape(PD_Tensor* tensor, size_t shape_size, int32_t* shape) {
 static void copy_from_cpu(PD_Tensor* t, const void* data, const char* npdt,
                           size_t item) {
   Gil g;
+  Py_XDECREF(t->np_cache);  // new input invalidates any read-back cache
+  t->np_cache = nullptr;
   size_t n = 1;
   for (int32_t d : t->shape) n *= static_cast<size_t>(d);
   PyRef np(PyImport_ImportModule("numpy"));
@@ -210,13 +228,19 @@ void PD_TensorCopyFromCpuInt64(PD_Tensor* tensor, const int64_t* data) {
 }
 
 static PyObject* to_cpu_array(PD_Tensor* t) {  // caller holds GIL
-  // cached: GetShape-then-CopyToCpu is the canonical call sequence and
-  // must fetch from device only once
-  if (t->np_cache) { Py_INCREF(t->np_cache); return t->np_cache; }
+  // cached per run: GetShape-then-CopyToCpu must fetch from device only
+  // once, but a reused handle must NOT serve a previous Run's outputs
+  uint64_t run = t->run_count ? *t->run_count : 0;
+  if (t->np_cache && t->cache_run == run) {
+    Py_INCREF(t->np_cache);
+    return t->np_cache;
+  }
   PyObject* arr = PyObject_CallMethod(t->obj, "copy_to_cpu", nullptr);
   if (!arr) { PyErr_Print(); return nullptr; }
+  Py_XDECREF(t->np_cache);
   Py_INCREF(arr);
   t->np_cache = arr;
+  t->cache_run = run;
   return arr;
 }
 

@@ -11,3 +11,13 @@ points over the same registry ops.
 """
 
 from paddle_tpu.incubate import nn  # noqa: F401
+
+
+def __getattr__(name):
+    # lazy: paddle.incubate.multiprocessing — registering ForkingPickler
+    # reductions has import-order side effects, so only load on demand
+    if name == "multiprocessing":
+        import paddle_tpu.multiprocessing as mp
+
+        return mp
+    raise AttributeError(name)

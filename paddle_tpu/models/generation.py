@@ -185,8 +185,15 @@ class GPTGenerator:
             nxt = _sample(logits[:, -1], key, temperature, top_k, top_p)
             return nxt, caches
 
+        @partial(jax.jit, donate_argnums=(2,))
+        def decode_logits(params, token, caches, pos):
+            logits, caches = _forward_with_cache(
+                params, cfg, token[:, None], caches, pos)
+            return logits[:, -1], caches
+
         self._prefill = prefill
         self._decode = decode
+        self._decode_logits = decode_logits
 
     def _to_mesh(self, v):
         """Replicate host values onto the mesh (params live there)."""
@@ -222,11 +229,87 @@ class GPTGenerator:
                             temperature=temperature, top_k=top_k,
                             top_p=top_p)
 
+    def _decode_logits_call(self, tok, state, pos):
+        return self._decode_logits(self.params, tok, state, pos)
+
+    def _expand_state(self, state, b, k):
+        """Tile the post-prefill state from b rows to b*k beam rows."""
+        return jax.tree_util.tree_map(
+            lambda x: jnp.repeat(x, k, axis=0), state)
+
+    def _gather_state(self, state, idx):
+        """Reorder every state leaf's leading (batch*beam) axis by idx —
+        the beam-reorder step (reference beam_search op's cache gather)."""
+        return jax.tree_util.tree_map(lambda x: jnp.take(x, idx, axis=0),
+                                      state)
+
+    def _beam_search(self, ids, max_new_tokens, num_beams, length_penalty,
+                     eos_token_id):
+        """Beam search over the compiled decode path (reference
+        generation `decode_strategy='beam_search'`,
+        python/paddle/fluid/operators beam_search op semantics): beams
+        fold into the batch axis so every step is one [b*k] decode, and
+        the cache reorder is a leading-axis gather AFTER the step (the
+        row that produced a beam's logits also wrote that row's cache)."""
+        b, t = ids.shape
+        k = num_beams
+        v = self.cfg.vocab_size
+        neg = jnp.float32(-1e9)
+        state = self._make_state(b)
+        last_logits, state = self._prefill_call(ids, state)
+        logp = jax.nn.log_softmax(last_logits.astype(jnp.float32), axis=-1)
+        scores, tok0 = jax.lax.top_k(logp, k)            # [b, k]
+        state = self._expand_state(state, b, k)          # beams ride batch
+        tokens = tok0.reshape(b * k).astype(jnp.int32)
+        seqs = tokens[:, None]
+        finished = (tokens == eos_token_id) if eos_token_id is not None \
+            else jnp.zeros((b * k,), bool)
+        pos = t
+        for _ in range(max_new_tokens - 1):
+            logits, state = self._decode_logits_call(
+                tokens, state, self._to_mesh(jnp.asarray(pos, jnp.int32)))
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            if eos_token_id is not None:
+                # finished beams: only eos continues, at zero added score
+                eos_row = jnp.full((v,), neg).at[eos_token_id].set(0.0)
+                logp = jnp.where(finished[:, None], eos_row[None], logp)
+            total = scores.reshape(b * k, 1) + logp
+            scores, idx = jax.lax.top_k(total.reshape(b, k * v), k)
+            beam = idx // v                               # [b, k]
+            tokval = (idx % v).astype(jnp.int32)
+            gather = (jnp.arange(b)[:, None] * k + beam).reshape(-1)
+            state = self._gather_state(state, gather)
+            seqs = jnp.take(seqs, gather, axis=0)
+            finished = jnp.take(finished, gather, axis=0)
+            tokens = tokval.reshape(-1)
+            if eos_token_id is not None:
+                finished = finished | (tokens == eos_token_id)
+            seqs = jnp.concatenate([seqs, tokens[:, None]], axis=1)
+            pos += 1
+            if eos_token_id is not None and bool(finished.all()):
+                break
+        # pick the best beam per batch row under GNMT length penalty
+        gen_len = seqs.shape[1]
+        if eos_token_id is not None:
+            lengths = jnp.argmax(seqs == eos_token_id, axis=1) + 1
+            lengths = jnp.where((seqs == eos_token_id).any(axis=1),
+                                lengths, gen_len)
+        else:
+            lengths = jnp.full((b * k,), gen_len)
+        norm = scores.reshape(-1) / (lengths.astype(jnp.float32)
+                                     ** length_penalty)
+        best = jnp.argmax(norm.reshape(b, k), axis=1)
+        pick = jnp.arange(b) * k + best
+        return Tensor._wrap(jnp.concatenate(
+            [ids, jnp.take(seqs, pick, axis=0)], axis=1))
+
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
-                 top_k=None, top_p=None, eos_token_id=None, seed=None):
+                 top_k=None, top_p=None, eos_token_id=None, seed=None,
+                 num_beams=1, length_penalty=1.0):
         """Shared prefill + sample + decode loop; subclasses supply the
         cache state and the prefill/decode callables (template method —
-        the eos/padding contract lives in exactly one place)."""
+        the eos/padding contract lives in exactly one place).
+        num_beams > 1 switches to beam search (greedy within beams)."""
         from paddle_tpu.core.random import default_generator
 
         ids = input_ids._value if isinstance(input_ids, Tensor) \
@@ -236,6 +319,9 @@ class GPTGenerator:
         ids = self._to_mesh(ids)
         b, t = ids.shape
         assert t + max_new_tokens <= self.max_len
+        if num_beams > 1:
+            return self._beam_search(ids, max_new_tokens, num_beams,
+                                     length_penalty, eos_token_id)
         state = self._make_state(b)
         last_logits, state = self._prefill_call(ids, state)
         key = self._to_mesh(jax.random.key(seed) if seed is not None
@@ -404,10 +490,18 @@ class PagedGPTGenerator(GPTGenerator):
             nxt = _sample(logits[:, -1], key, temperature, top_k, top_p)
             return nxt, cache.pools
 
+        def decode_logits(params, token, pools, table, pos):
+            cache = _CacheView(pools, table, self.block_size)
+            logits, cache = _forward_paged(params, cfg, token[:, None],
+                                           cache, pos)
+            return logits[:, -1], cache.pools
+
         self._prefill_paged = jax.jit(prefill)
         self._decode_paged = jax.jit(
             decode, donate_argnums=(2,),
             static_argnames=("temperature", "top_k", "top_p"))
+        self._decode_logits_paged = jax.jit(decode_logits,
+                                            donate_argnums=(2,))
 
     def _make_state(self, batch):
         cfg = self.cfg
@@ -431,6 +525,41 @@ class PagedGPTGenerator(GPTGenerator):
                                         pos, key, temperature=temperature,
                                         top_k=top_k, top_p=top_p)
         return tok, (pools, table)
+
+    def _decode_logits_call(self, tok, state, pos):
+        pools, table = state
+        logits, pools = self._decode_logits_paged(self.params, tok, pools,
+                                                  table, pos)
+        return logits, (pools, table)
+
+    # Beam hooks: pool axis 0 is BLOCK index (batch*blocks_per_seq), not
+    # batch — beam row ops must translate to block-row ops. The static
+    # allocator keeps row r owning blocks [r*bps, (r+1)*bps), so a beam
+    # gather of rows is a gather of each row's whole block run; the
+    # block_table stays the identity mapping.
+
+    def _row_to_block_idx(self, row_idx):
+        bps = self.max_len // self.block_size
+        return (row_idx[:, None] * bps
+                + jnp.arange(bps)[None, :]).reshape(-1)
+
+    def _expand_state(self, state, b, k):
+        pools, _ = state
+        rows = jnp.repeat(jnp.arange(b), k)
+        blocks = self._row_to_block_idx(rows)
+        new_pools = [(jnp.take(kp, blocks, axis=0),
+                      jnp.take(vp, blocks, axis=0)) for kp, vp in pools]
+        bps = self.max_len // self.block_size
+        new_table = jnp.arange(b * k * bps, dtype=jnp.int32).reshape(
+            b * k, bps)
+        return new_pools, self._to_mesh(new_table)
+
+    def _gather_state(self, state, idx):
+        pools, table = state
+        blocks = self._row_to_block_idx(idx)
+        new_pools = [(jnp.take(kp, blocks, axis=0),
+                      jnp.take(vp, blocks, axis=0)) for kp, vp in pools]
+        return new_pools, table
 
 
 class _CacheView:

@@ -1,0 +1,66 @@
+"""paddle.multiprocessing — Tensor sharing across processes.
+
+Reference: python/paddle/incubate/multiprocessing/reductions.py — registers
+ForkingPickler reductions so Tensors ride mp.Queue/Pipe via the
+file_system sharing strategy (CUDA IPC handles on GPU).
+
+TPU-native: device buffers are PJRT-owned and not IPC-shareable, so a
+Tensor crosses process boundaries through the file_system strategy: the
+producer writes the host array to a file under /dev/shm (RAM-backed) and
+pickles only the filename; the consumer maps it and DELETES it after
+copying (consumer-owns-cleanup, so a producer exiting right after
+queue.put — the standard worker pattern — can never race the unlink).
+A message that is never consumed leaves a file until /dev/shm is swept,
+the same trade-off the reference's file_system strategy makes.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from multiprocessing import *  # noqa: F401,F403
+from multiprocessing import reduction
+
+import numpy as np
+
+_SHM_DIR = "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+
+
+def _dtype_by_name(name):
+    """np.dtype by NAME, not .str — ml_dtypes (bfloat16, float8_*) encode
+    as opaque '<V2' through .str and would arrive as raw void."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _rebuild_tensor(path, shape, dtype_name):
+    from paddle_tpu.core.tensor import Tensor
+
+    arr = np.fromfile(path, dtype=_dtype_by_name(dtype_name)).reshape(shape)
+    try:
+        os.unlink(path)  # consumer owns cleanup
+    except OSError:
+        pass
+    return Tensor._wrap(arr)
+
+
+def _reduce_tensor(tensor):
+    arr = np.asarray(tensor._value)
+    fd, path = tempfile.mkstemp(prefix="paddle_tpu_shm_", dir=_SHM_DIR)
+    with os.fdopen(fd, "wb") as f:
+        arr.tofile(f)
+    return _rebuild_tensor, (path, arr.shape, arr.dtype.name)
+
+
+def init_reductions():
+    from paddle_tpu.core.tensor import Parameter, Tensor
+
+    reduction.ForkingPickler.register(Tensor, _reduce_tensor)
+    reduction.ForkingPickler.register(Parameter, _reduce_tensor)
+
+
+init_reductions()

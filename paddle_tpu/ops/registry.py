@@ -146,6 +146,12 @@ def _any_symbolic(obj) -> bool:
     return False
 
 
+# api_tracer hook: when set, called as hook(name, args, kwargs) on every
+# dispatch (reference python/paddle/api_tracer/ wraps each generated API;
+# here ONE choke point sees them all)
+TRACE_HOOK = [None]
+
+
 def dispatch(name: str, args, kwargs, _op=None):
     """The generic ad_func (reference eager_gen.py:372 template).
 
@@ -155,6 +161,9 @@ def dispatch(name: str, args, kwargs, _op=None):
     the name-keyed jit cache."""
     from paddle_tpu.core.tensor import Tensor
     from paddle_tpu.amp.state import current_cast_dtype
+
+    if TRACE_HOOK[0] is not None:
+        TRACE_HOOK[0](name, args, kwargs)
 
     # static-graph build mode: ops on symbolic tensors record program nodes
     # (the reference's two-universe split, SURVEY.md §1 L5a/L5b). The flag

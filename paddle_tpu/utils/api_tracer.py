@@ -1,0 +1,86 @@
+"""API call tracer.
+
+Reference: python/paddle/api_tracer/api_tracer.py — hooks every generated
+API and dumps `api(args...)` config lines for op-benchmark replay. Here
+the generic dispatcher is the single choke point (ops/registry.py
+TRACE_HOOK), so one hook sees every op call.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from paddle_tpu.ops import registry
+
+
+def _item_str(v):
+    from paddle_tpu.core.tensor import Tensor
+
+    if isinstance(v, Tensor):
+        return f"Tensor(shape={list(v.shape)},dtype={v.dtype})"
+    if isinstance(v, (list, tuple)):
+        return "[" + ",".join(_item_str(e) for e in v) + "]"
+    if hasattr(v, "shape") and hasattr(v, "dtype"):  # raw array
+        return f"Array(shape={list(v.shape)},dtype={v.dtype})"
+    try:
+        json.dumps(v)
+        return repr(v)
+    except TypeError:
+        return type(v).__name__
+
+
+class APITracer:
+    """Records every dispatched op as an `op(args, kw=...)` line.
+
+    Usage:
+        tracer = APITracer()
+        tracer.start("/tmp/trace.log")   # or start() to record in memory
+        ... run model ...
+        tracer.stop()
+        tracer.calls  # list of recorded lines
+    """
+
+    def __init__(self):
+        self.calls: list[str] = []
+        self._file = None
+        self._hook = None  # the installed bound method (stable identity)
+
+    def start(self, output_path: Optional[str] = None):
+        if self._file:  # re-start: don't leak the previous handle
+            self._file.close()
+            self._file = None
+        if output_path:
+            self._file = open(output_path, "a")
+        self._hook = self._record
+        registry.TRACE_HOOK[0] = self._hook
+        return self
+
+    def stop(self):
+        # only uninstall our own hook — a second tracer may own it now
+        if registry.TRACE_HOOK[0] is self._hook:
+            registry.TRACE_HOOK[0] = None
+        self._hook = None
+        if self._file:
+            self._file.close()
+            self._file = None
+
+    def _record(self, name, args, kwargs):
+        parts = [_item_str(a) for a in args]
+        parts += [f"{k}={_item_str(v)}" for k, v in sorted(kwargs.items())]
+        line = f"{name}({', '.join(parts)})"
+        self.calls.append(line)
+        if self._file:
+            self._file.write(line + "\n")
+            self._file.flush()
+
+
+_GLOBAL = APITracer()
+
+
+def start_api_tracer(output_path: Optional[str] = None) -> APITracer:
+    return _GLOBAL.start(output_path)
+
+
+def stop_api_tracer():
+    _GLOBAL.stop()

@@ -1,0 +1,142 @@
+"""Sub-graph checker: eager vs compiled divergence hunting.
+
+Reference: the reference's sub-graph checking tools
+(tools/check_api_compatible + the SOT sub-graph extraction tests) compare
+dygraph against the to_static/compiled execution of the same layer.
+Here "static" means jit.to_static (one XLA program), so the checker runs
+each sublayer both ways and reports where outputs (and, optionally,
+input-gradients) diverge beyond tolerance — the first tool to reach for
+when a compiled model's loss disagrees with eager.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+import numpy as np
+
+
+@dataclass
+class SubGraphReport:
+    name: str
+    max_abs_err: float
+    max_rel_err: float
+    passed: bool
+    grad_max_abs_err: float | None = None
+
+
+@dataclass
+class CheckResult:
+    reports: List[SubGraphReport] = field(default_factory=list)
+
+    @property
+    def passed(self) -> bool:
+        return all(r.passed for r in self.reports)
+
+    def failures(self) -> List[SubGraphReport]:
+        return [r for r in self.reports if not r.passed]
+
+    def __repr__(self):
+        lines = [f"{'PASS' if r.passed else 'FAIL'} {r.name}: "
+                 f"abs={r.max_abs_err:.3e} rel={r.max_rel_err:.3e}"
+                 + (f" grad_abs={r.grad_max_abs_err:.3e}"
+                    if r.grad_max_abs_err is not None else "")
+                 for r in self.reports]
+        return "\n".join(lines) or "(no sublayers checked)"
+
+
+def _run_pair(layer, inputs, check_grad, atol):
+    """Return (report fields) comparing eager vs to_static for one layer."""
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+
+    def flat(o):
+        if isinstance(o, (list, tuple)):
+            out = []
+            for e in o:
+                out += flat(e)
+            return out
+        return [o] if isinstance(o, Tensor) else []
+
+    eager_out = flat(layer(*inputs))
+    static_fn = paddle.jit.to_static(layer)
+    static_out = flat(static_fn(*inputs))
+    if len(eager_out) != len(static_out):
+        # output-count divergence IS the failure this tool exists to catch
+        return float("inf"), float("inf"), None, False
+    max_abs = max_rel = 0.0
+    for a, b in zip(eager_out, static_out):
+        av, bv = np.asarray(a._value), np.asarray(b._value)
+        d = np.abs(av - bv)
+        max_abs = max(max_abs, float(d.max()) if d.size else 0.0)
+        denom = np.maximum(np.abs(av), 1e-6)
+        max_rel = max(max_rel, float((d / denom).max()) if d.size else 0.0)
+    grad_err = None
+    if check_grad and eager_out:
+        import jax
+
+        from paddle_tpu.jit.functionalize import functionalize
+
+        xs = [x for x in inputs if isinstance(x, Tensor)
+              and not x.stop_gradient]
+        if xs:
+            # eager grads via the tape
+            e = layer(*inputs)
+            e = e[0] if isinstance(e, (list, tuple)) else e
+            e.sum().backward()
+            eager_grads = [np.asarray(x.grad._value) for x in xs]
+            for x in xs:
+                x.clear_grad()
+            # compiled-side grads via jax.grad over the functionalized
+            # layer (the same pure program to_static compiles)
+            fz = functionalize(layer)
+            params = fz.param_values()
+            bufs = fz.buffer_values()
+            vals = [x._value for x in xs]
+
+            def scalar(*xv):
+                full = list(inputs)
+                it = iter(xv)
+                full = [next(it) if (isinstance(a, Tensor)
+                                     and not a.stop_gradient) else
+                        (a._value if isinstance(a, Tensor) else a)
+                        for a in full]
+                out, _ = fz.apply(params, bufs, None, None, *full)
+                first = out[0] if isinstance(out, (list, tuple)) else out
+                return first.sum()
+
+            static_grads = jax.grad(scalar, argnums=tuple(range(len(vals))))(
+                *vals)
+            g_err = 0.0
+            for eg, sg in zip(eager_grads, static_grads):
+                g_err = max(g_err, float(np.abs(eg - np.asarray(sg)).max()))
+            grad_err = g_err
+    passed = max_abs <= atol and (grad_err is None or grad_err <= atol)
+    return max_abs, max_rel, grad_err, passed
+
+
+def check_layer(layer, inputs, atol=1e-5, check_grad=False,
+                recurse=False) -> CheckResult:
+    """Compare eager vs to_static execution of `layer` (and optionally
+    every named sublayer with the intermediate eager activations as
+    inputs is NOT attempted — sublayers are compared on the same
+    top-level inputs only when they are callable with them)."""
+    if not isinstance(inputs, (list, tuple)):
+        inputs = (inputs,)
+    res = CheckResult()
+    max_abs, max_rel, grad_err, passed = _run_pair(layer, inputs,
+                                                   check_grad, atol)
+    res.reports.append(SubGraphReport(
+        name=type(layer).__name__, max_abs_err=max_abs, max_rel_err=max_rel,
+        passed=passed, grad_max_abs_err=grad_err))
+    if recurse:
+        for name, sub in layer.named_sublayers():
+            try:
+                ma, mr, ge, ok = _run_pair(sub, inputs, False, atol)
+            except Exception:
+                continue  # sublayer signature doesn't match the inputs
+            res.reports.append(SubGraphReport(
+                name=name or type(sub).__name__, max_abs_err=ma,
+                max_rel_err=mr, passed=ok, grad_max_abs_err=ge))
+    return res

@@ -1,0 +1,22 @@
+"""Shared test utilities (plain module — conftest.py must stay import-free
+of test code so pytest's rootdir-relative conftest loading can't execute
+it twice under two module names)."""
+
+import os
+
+
+def child_env(repo_on_pythonpath=True):
+    """Env for spawning CPU-only child processes from tests.
+
+    Children must target the CPU backend and must NOT register the axon
+    TPU plugin: inheriting PALLAS_AXON_POOL_IPS makes their sitecustomize
+    register() dial the relay, which hangs when another jax process holds
+    it. Every test that spawns a subprocess should build its env here.
+    """
+    env = dict(os.environ)
+    if repo_on_pythonpath:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env

@@ -180,16 +180,37 @@ int main(int argc, char** argv) {
         ["gcc", str(c_src), "-o", str(exe), f"-I{inc}", so,
          f"-Wl,-rpath,{os.path.dirname(so)}", f"-Wl,-rpath,{libdir}"],
         check=True, capture_output=True, text=True)
-    env = dict(os.environ)
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-    # the embedded interpreter must target CPU and must NOT register the
-    # axon TPU plugin (its startup registration can block on the relay
-    # when another jax process holds it — hangs the driver)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("PALLAS_AXON_POOL_IPS", None)
+    from _helpers import child_env
+
+    env = child_env()
     r = subprocess.run([str(exe), saved_model], capture_output=True,
                        text=True, env=env, timeout=240)
     assert r.returncode == 0, (r.stdout, r.stderr)
     vals = [float(v) for v in r.stdout.split()]
     assert len(vals) == 6 and all(abs(v) <= 1.0 for v in vals)
+
+
+def test_capi_output_cache_invalidated_per_run(lib, saved_model):
+    """A reused output handle must serve THIS run's outputs, not run 1's."""
+    cfg = lib.PD_ConfigCreate()
+    lib.PD_ConfigSetModel(cfg, saved_model.encode(), b"")
+    pred = lib.PD_PredictorCreate(cfg)
+    h = lib.PD_PredictorGetInputHandle(pred, b"x")
+    oh = lib.PD_PredictorGetOutputHandle(pred, b"out_0")
+    shape = (ctypes.c_int32 * 2)(2, 4)
+    outs = []
+    for scale in (0.1, 0.9):
+        x = np.full((2, 4), scale, np.float32)
+        lib.PD_TensorReshape(h, 2, shape)
+        lib.PD_TensorCopyFromCpuFloat(
+            h, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        assert lib.PD_PredictorRun(pred) == 1
+        out = np.zeros((2, 3), np.float32)
+        lib.PD_TensorCopyToCpuFloat(
+            oh, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        outs.append(out)
+    assert not np.allclose(outs[0], outs[1]), "stale output cache"
+    lib.PD_TensorDestroy(h)
+    lib.PD_TensorDestroy(oh)
+    lib.PD_PredictorDestroy(pred)
+    lib.PD_ConfigDestroy(cfg)

@@ -1,0 +1,204 @@
+"""api_tracer, multiprocessing tensor IPC, sub_graph_checker.
+
+Reference: python/paddle/api_tracer/, incubate/multiprocessing/reductions
+.py, and the dygraph-vs-to_static checking tools.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_api_tracer_records_ops(tmp_path):
+    from paddle_tpu.utils.api_tracer import APITracer
+
+    t = APITracer()
+    out = tmp_path / "trace.log"
+    t.start(str(out))
+    try:
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        y = (x * 2 + 1).sum()
+    finally:
+        t.stop()
+    joined = "\n".join(t.calls)
+    assert "Tensor(shape=[2, 3]" in joined
+    assert any(c.startswith("sum(") or c.startswith("reduce_sum(")
+               for c in t.calls), t.calls
+    assert out.read_text().strip()
+    # stopped: no further recording
+    n = len(t.calls)
+    _ = x + 1
+    assert len(t.calls) == n
+
+
+def test_multiprocessing_reduction_roundtrip_inproc():
+    """Pickle path without a real child process: reduce -> rebuild."""
+    import paddle_tpu.multiprocessing as pmp
+
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    fn, args = pmp._reduce_tensor(x)
+    path = args[0]
+    assert os.path.exists(path)
+    y = fn(*args)
+    np.testing.assert_allclose(np.asarray(y._value),
+                               np.asarray(x._value))
+    assert not os.path.exists(path)  # consumer deleted the segment
+
+
+def test_multiprocessing_queue_crossprocess(tmp_path):
+    """Real spawn-child roundtrip through mp.Queue (worker doubles and
+    sums a Tensor; producer-exit must not race the consumer attach)."""
+    script = tmp_path / "w.py"
+    script.write_text("""
+import warnings; warnings.filterwarnings("ignore")
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.multiprocessing as pmp
+
+def worker(q_in, q_out):
+    t = q_in.get()
+    q_out.put((t * 2).sum())
+
+if __name__ == "__main__":
+    ctx = pmp.get_context("spawn")
+    q_in, q_out = ctx.Queue(), ctx.Queue()
+    p = ctx.Process(target=worker, args=(q_in, q_out))
+    p.start()
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    q_in.put(x)
+    r = q_out.get(timeout=300)
+    p.join(timeout=30)
+    assert abs(float(np.asarray(r._value)) - 30.0) < 1e-6
+    print("MP_OK")
+""")
+    from _helpers import child_env
+
+    env = child_env()
+    r = subprocess.run([sys.executable, str(script)], capture_output=True,
+                       text=True, env=env, timeout=420)
+    assert r.returncode == 0 and "MP_OK" in r.stdout, (r.stdout, r.stderr)
+
+
+def test_incubate_multiprocessing_alias():
+    from paddle_tpu import incubate
+
+    assert hasattr(incubate.multiprocessing, "get_context")
+
+
+def test_sub_graph_checker_pass_and_fail():
+    from paddle_tpu.utils.sub_graph_checker import check_layer
+
+    paddle.seed(0)
+    layer = nn.Sequential(nn.Linear(4, 8), nn.GELU(), nn.Linear(8, 2))
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((3, 4)).astype(np.float32))
+    res = check_layer(layer, x, atol=1e-4, check_grad=False, recurse=True)
+    assert res.passed, repr(res)
+    assert len(res.reports) >= 2  # top level + at least one sublayer
+
+    class Diverging(nn.Layer):
+        """Eager and traced paths intentionally disagree."""
+
+        def forward(self, x):
+            from paddle_tpu.static.program import is_symbolic
+
+            import jax
+
+            if isinstance(x._value, jax.core.Tracer):
+                return x * 2.0
+            return x * 3.0
+
+    res2 = check_layer(Diverging(), x, atol=1e-6)
+    assert not res2.passed
+    assert res2.failures()
+
+
+def test_sub_graph_checker_grad():
+    from paddle_tpu.utils.sub_graph_checker import check_layer
+
+    layer = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    x.stop_gradient = False
+    res = check_layer(layer, x, check_grad=True)
+    assert res.passed
+    assert res.reports[0].grad_max_abs_err is not None
+
+
+def test_multiprocessing_bfloat16_dtype_survives():
+    import jax.numpy as jnp
+
+    import paddle_tpu.multiprocessing as pmp
+    from paddle_tpu.core.tensor import Tensor
+
+    x = Tensor._wrap(jnp.ones((2, 2), jnp.bfloat16) * 1.5)
+    fn, args = pmp._reduce_tensor(x)
+    y = fn(*args)
+    assert "bfloat16" in str(np.asarray(y._value).dtype)
+    np.testing.assert_allclose(np.asarray(y._value, np.float32), 1.5)
+
+
+def test_api_tracer_restart_and_foreign_stop(tmp_path):
+    from paddle_tpu.ops import registry
+    from paddle_tpu.utils.api_tracer import APITracer
+
+    t1, t2 = APITracer(), APITracer()
+    t1.start(str(tmp_path / "a.log"))
+    t2.start(str(tmp_path / "b.log"))  # takes over the hook
+    t1.stop()  # must NOT uninstall t2's hook
+    assert registry.TRACE_HOOK[0] is not None
+    _ = paddle.to_tensor(np.ones(2, np.float32)) + 1
+    assert t2.calls
+    t2.stop()
+    assert registry.TRACE_HOOK[0] is None
+
+
+def test_pylayer_custom_backward():
+    from paddle_tpu.autograd import PyLayer
+
+    class DoubleGradTanh(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = x.tanh()
+            ctx.save_for_backward(y)
+            return y
+
+        @staticmethod
+        def backward(ctx, dy):
+            (y,) = ctx.saved_tensor()
+            return dy * (1 - y * y) * 2.0  # deliberately doubled
+
+    x = paddle.to_tensor(np.linspace(-1, 1, 5).astype(np.float32))
+    x.stop_gradient = False
+    y = DoubleGradTanh.apply(x)
+    y.sum().backward()
+    xv = np.asarray(x._value)
+    expected = (1 - np.tanh(xv) ** 2) * 2.0
+    np.testing.assert_allclose(np.asarray(x.grad._value), expected,
+                               rtol=1e-5)
+
+
+def test_pylayer_multi_output_and_nongrad_input():
+    from paddle_tpu.autograd import PyLayer
+
+    class SplitScale(PyLayer):
+        @staticmethod
+        def forward(ctx, x, scale):
+            return x * scale, x + scale
+
+        @staticmethod
+        def backward(ctx, da, db):
+            return da * 3.0 + db, None  # None for the non-grad input
+
+    x = paddle.to_tensor(np.ones(4, np.float32))
+    x.stop_gradient = False
+    s = paddle.to_tensor(np.float32(2.0))  # stop_gradient=True default
+    a, b = SplitScale.apply(x, s)
+    (a.sum() + b.sum()).backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value),
+                               np.full(4, 4.0))

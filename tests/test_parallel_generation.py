@@ -187,3 +187,77 @@ def test_paged_block_size_non_divisible():
     out = g.generate(paddle.to_tensor(rng.integers(0, 64, (1, 6))),
                      max_new_tokens=4, temperature=0.0)
     assert out.shape == [1, 10]
+
+
+# ----------------------------------------------------------- beam search
+
+def test_beam_search_not_worse_than_greedy():
+    """Beam-searched sequence logprob must be >= the greedy sequence's."""
+    paddle.seed(3)
+    model = GPT(GPTConfig(**CFG))
+    model.eval()
+    gen = GPTGenerator(model)
+    ids_np = np.array([[1, 2, 3]], np.int32)
+    ids = paddle.to_tensor(ids_np)
+    greedy = gen.generate(ids, max_new_tokens=6, temperature=0.0).numpy()
+    beam = gen.generate(ids, max_new_tokens=6, num_beams=4).numpy()
+
+    def seq_logprob(full):
+        x = paddle.to_tensor(full[None, :-1].astype(np.int32))
+        logits = np.asarray(model(x)._value)[0].astype(np.float64)
+        lp = 0.0
+        for i in range(ids_np.shape[1] - 1, full.shape[0] - 1):
+            row = logits[i] - logits[i].max()
+            p = row - np.log(np.exp(row).sum())
+            lp += p[full[i + 1]]
+        return lp
+
+    assert seq_logprob(beam[0]) >= seq_logprob(greedy[0]) - 1e-6
+
+
+def test_beam_search_paged_matches_dense():
+    paddle.seed(3)
+    model = GPT(GPTConfig(**CFG))
+    model.eval()
+    ids = paddle.to_tensor(np.array([[1, 2, 3], [7, 8, 9]], np.int32))
+    dense = GPTGenerator(model).generate(ids, max_new_tokens=6,
+                                         num_beams=3).numpy()
+    paged = PagedGPTGenerator(model, block_size=8).generate(
+        ids, max_new_tokens=6, num_beams=3).numpy()
+    np.testing.assert_array_equal(dense, paged)
+
+
+def test_beam_search_eos_contract():
+    paddle.seed(3)
+    model = GPT(GPTConfig(**CFG))
+    model.eval()
+    gen = GPTGenerator(model)
+    ids = paddle.to_tensor(np.array([[1, 2, 3]], np.int32))
+    out = gen.generate(ids, max_new_tokens=8, num_beams=3,
+                       eos_token_id=5).numpy()[0]
+    gen_part = out[3:]
+    hits = np.nonzero(gen_part == 5)[0]
+    if hits.size:  # everything after the first eos must be eos padding
+        assert (gen_part[hits[0]:] == 5).all()
+
+
+def test_beam_search_under_tp_matches_dense():
+    r = np.random.default_rng(1)
+    ids_np = r.integers(0, 64, (2, 8))
+    paddle.seed(3)
+    dense = GPT(GPTConfig(**CFG))
+    dense.eval()
+    ref = GPTGenerator(dense).generate(paddle.to_tensor(ids_np),
+                                       max_new_tokens=6,
+                                       num_beams=2).numpy()
+    mesh = dist.init_mesh({"dp": 4, "tp": 2})
+    try:
+        paddle.seed(3)
+        tp = GPT(GPTConfig(**CFG, tensor_parallel=True))
+        tp.eval()
+        out = GPTGenerator(tp).generate(paddle.to_tensor(ids_np),
+                                        max_new_tokens=6,
+                                        num_beams=2).numpy()
+    finally:
+        dist.set_mesh(None)
+    np.testing.assert_array_equal(out, ref)
