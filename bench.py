@@ -35,10 +35,71 @@ BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "2400"))
 CACHE_DIR = os.environ.get(
     "BENCH_CACHE_DIR", os.path.join(os.path.dirname(__file__), ".jax_cache"))
 PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "45"))
+# In-repo record of every successful TPU rung, updated at run time and
+# committed: when the axon tunnel is wedged at snapshot time, the ladder
+# re-emits these lines marked "stale" so the official BENCH_rXX.json record
+# is never empty (round-1 rc=1 and round-2 parsed:null both lost real
+# mid-round numbers this way).
+RESULT_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_CACHE.json")
 
 
 def remaining() -> float:
     return BUDGET_S - (time.time() - T_START)
+
+
+def _load_result_cache() -> dict:
+    try:
+        with open(RESULT_CACHE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _cache_result(line: dict) -> None:
+    """Persist a successful rung line keyed by metric (TPU results only —
+    a CPU-fallback number must never shadow a real hardware one)."""
+    if line.get("backend") != "tpu":
+        return
+    cache = _load_result_cache()
+    cache[line["metric"]] = {**line, "cached_at": time.time(),
+                             "cached_at_iso": time.strftime(
+                                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    tmp = RESULT_CACHE + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(cache, f, indent=1, sort_keys=True)
+    os.replace(tmp, RESULT_CACHE)
+
+
+def _emit_stale_cache(reason: str) -> bool:
+    """Re-emit every cached rung line marked stale. Returns True if the
+    cache yielded a headline number."""
+    cache = _load_result_cache()
+    headline = None
+    for metric in sorted(cache):
+        line = dict(cache[metric])
+        cached_at = line.pop("cached_at", None)
+        line["stale"] = True
+        line["stale_reason"] = reason
+        if cached_at is not None:
+            line["age_s"] = round(time.time() - cached_at, 1)
+        emit(line)
+        if metric == "gpt_train_tokens_per_sec_per_chip":
+            headline = line
+    if headline is None:
+        # fall back to the largest cached GPT rung (by model size) as the
+        # headline
+        gpt = [m for m in cache if m.startswith("gpt_train_tokens_per_sec_")]
+        if gpt:
+            biggest = max(gpt, key=lambda m: cache[m].get("params_m", 0))
+            headline = dict(cache[biggest])
+            headline.pop("cached_at", None)
+            headline.update(stale=True, stale_reason=reason,
+                            metric="gpt_train_tokens_per_sec_per_chip")
+    if headline is not None:
+        emit(headline)
+        return True
+    return False
 
 
 def emit(obj: dict) -> None:
@@ -235,11 +296,14 @@ def main():
     probe = run_child("probe", PROBE_TIMEOUT_S)
     if probe is None:
         log("tunnel probe failed/hung — TPU backend unavailable")
+        reason = ("axon tunnel probe hung/failed >"
+                  f"{PROBE_TIMEOUT_S:.0f}s at backend init")
+        if _emit_stale_cache(reason):
+            log("re-emitted cached TPU rung results (marked stale)")
+            return
         emit({"metric": "gpt_train_tokens_per_sec_per_chip", "value": 0.0,
               "unit": "tokens/s", "vs_baseline": 0.0,
-              "error": "backend_unavailable",
-              "detail": "axon tunnel probe hung >"
-                        f"{PROBE_TIMEOUT_S:.0f}s at backend init"})
+              "error": "backend_unavailable", "detail": reason})
         # still produce a CPU number (tagged) so the ladder is exercised.
         # NB: the JAX_PLATFORMS env var is re-forced to "axon" at interpreter
         # startup; BENCH_PLATFORM routes through jax.config.update instead.
@@ -255,12 +319,15 @@ def main():
 
     flash = run_child("flash", min(300, max(remaining(), 0)))
     if flash is not None:
-        emit({"metric": "pallas_flash_fwd_bwd_allclose",
-              "value": 1.0 if flash.get("pass") else 0.0, "unit": "bool",
-              "vs_baseline": 1.0 if flash.get("pass") else 0.0,
-              "max_abs_err": flash.get("max_abs_err"),
-              "backend": flash.get("backend"),
-              "interpret": flash.get("interpret")})
+        line = {"metric": "pallas_flash_fwd_bwd_allclose",
+                "value": 1.0 if flash.get("pass") else 0.0, "unit": "bool",
+                "vs_baseline": 1.0 if flash.get("pass") else 0.0,
+                "max_abs_err": flash.get("max_abs_err"),
+                "backend": flash.get("backend"),
+                "interpret": flash.get("interpret")}
+        emit(line)
+        if flash.get("pass"):
+            _cache_result(line)
         log(f"flash check: {flash}")
 
     best = None
@@ -280,6 +347,7 @@ def main():
             break
         line = _result_line(f"gpt_train_tokens_per_sec_{name}", r)
         emit(line)
+        _cache_result(line)
         best = line
         log(f"rung {name}: {r['tokens_per_sec']:.0f} tok/s, "
             f"mfu={r['mfu']:.3f}, compile={r['compile_s']:.0f}s")
@@ -289,15 +357,21 @@ def main():
     if on_tpu and remaining() > 120:
         r = run_child("ernie:12:768:16:512:40000:30", min(900, remaining()))
         if r is not None:
-            emit(_result_line("ernie3_base_pretrain_tokens_per_sec_per_chip",
-                              r))
+            line = _result_line("ernie3_base_pretrain_tokens_per_sec_per_chip",
+                                r)
+            emit(line)
+            _cache_result(line)
             log(f"ernie rung: {r['tokens_per_sec']:.0f} tok/s, "
                 f"mfu={r['mfu']:.3f}")
 
     if best is not None:
         # headline repeated last: drivers that parse the final stdout JSON
         # line get the largest completed config
-        emit({**best, "metric": "gpt_train_tokens_per_sec_per_chip"})
+        headline = {**best, "metric": "gpt_train_tokens_per_sec_per_chip"}
+        emit(headline)
+        _cache_result(headline)
+    elif _emit_stale_cache("tunnel probed OK but no rung completed this run"):
+        log("no fresh rung — re-emitted cached results (marked stale)")
     else:
         emit({"metric": "gpt_train_tokens_per_sec_per_chip", "value": 0.0,
               "unit": "tokens/s", "vs_baseline": 0.0,

@@ -17,7 +17,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from paddle_tpu.core.tensor import Tensor
-from paddle_tpu.ops.registry import OPS, OpDef, dispatch
+from paddle_tpu.ops.registry import OPS, OpDef, dispatch, host_only_impl
 
 
 def _np(x):
@@ -78,7 +78,10 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
 
 
 OPS.setdefault("graph_sample_neighbors",
-               OpDef("graph_sample_neighbors", lambda r, c, n: r, diff=False,
+               OpDef("graph_sample_neighbors",
+                     host_only_impl("graph_sample_neighbors",
+                                    "paddle_tpu.geometric.sample_neighbors"),
+                     diff=False,
                      dynamic=True, method=False))
 
 
@@ -92,7 +95,10 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
 
 
 OPS.setdefault("weighted_sample_neighbors",
-               OpDef("weighted_sample_neighbors", lambda r, c, w, n: r,
+               OpDef("weighted_sample_neighbors",
+                     host_only_impl(
+                         "weighted_sample_neighbors",
+                         "paddle_tpu.geometric.weighted_sample_neighbors"),
                      diff=False, dynamic=True, method=False))
 
 
@@ -114,7 +120,9 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
             _wrap(np.asarray(out_nodes, xv.dtype)))
 
 
-OPS.setdefault("reindex_graph", OpDef("reindex_graph", lambda x, n, c: x,
+OPS.setdefault("reindex_graph", OpDef(
+    "reindex_graph", host_only_impl("reindex_graph",
+                                    "paddle_tpu.geometric.reindex_graph"),
                                       diff=False, dynamic=True,
                                       method=False))
 
@@ -169,7 +177,10 @@ def khop_sampler(row, colptr, input_nodes, sample_sizes, sorted_eids=None,
 
 
 OPS.setdefault("graph_khop_sampler",
-               OpDef("graph_khop_sampler", lambda r, c, n: r, diff=False,
+               OpDef("graph_khop_sampler",
+                     host_only_impl("graph_khop_sampler",
+                                    "paddle_tpu.geometric.khop_sampler"),
+                     diff=False,
                      dynamic=True, method=False))
 
 

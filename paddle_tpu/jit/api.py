@@ -39,16 +39,48 @@ def _sig_of(args) -> Tuple:
     return tuple(out)
 
 
+import jax.errors as _jerr
+
+# Trace-time graph-break signals: python control flow hitting a traced
+# value surfaces as one of these concretization errors. Deliberately NOT a
+# substring match — UnexpectedTracerError (a leaked tracer, i.e. a real
+# user bug) and arbitrary errors mentioning "Tracer" must keep raising.
+_GRAPH_BREAK_TYPES = tuple(
+    t for t in (getattr(_jerr, n, None) for n in (
+        "ConcretizationTypeError", "TracerBoolConversionError",
+        "TracerArrayConversionError", "TracerIntegerConversionError",
+        "NonConcreteBooleanIndexError")) if t is not None)
+
+
+def _is_graph_break(err: Exception) -> bool:
+    """Is this exception a trace-time graph break (python control flow on a
+    traced value), as opposed to a genuine user error?
+
+    The reference SOT interpreter (python/paddle/jit/sot/translate.py:37,
+    pybind/sot/eval_frame.c) detects untraceable bytecode and splits the
+    graph; under jax the same constructs surface as concretization errors
+    when a tracer hits `bool()`/`int()`/`.item()`/numpy conversion."""
+    return isinstance(err, _GRAPH_BREAK_TYPES)
+
+
 class StaticFunction:
     """Compiled wrapper over a Layer (or pure Tensor function).
 
     Per input-signature compiled cache, like the reference's ConcreteProgram
     cache (program_translator.py:398). Buffers (BN stats) round-trip as
     explicit jit outputs and are written back after each call.
+
+    Graph breaks: with full_graph=False (the default, matching the
+    reference to_static SOT mode) a function whose python control flow
+    depends on tensor VALUES cannot trace; the first call detects the
+    concretization error, logs the break, and pins that input signature to
+    eager execution — the minimum-viable analogue of the reference's
+    bytecode-level eager fallback. full_graph=True raises instead (the
+    reference AST mode contract).
     """
 
     def __init__(self, layer_or_fn, input_spec=None, build_strategy=None,
-                 backend=None, full_graph=True):
+                 backend=None, full_graph=False):
         if isinstance(layer_or_fn, Layer):
             self._layer = layer_or_fn
             self._fn = None
@@ -57,6 +89,25 @@ class StaticFunction:
             self._fn = layer_or_fn
         self._func = functionalize(self._layer) if self._layer is not None else None
         self._cache: Dict[Tuple, Any] = {}
+        self._full_graph = full_graph
+        self._eager_sigs: set = set()
+
+    def _graph_break(self, sig, err) -> None:
+        """Record a break for this callsite signature (or re-raise under
+        full_graph=True)."""
+        if self._full_graph:
+            raise err
+        import warnings
+
+        name = getattr(self._fn or self._layer, "__name__",
+                       type(self._fn or self._layer).__name__)
+        warnings.warn(
+            f"paddle_tpu.jit.to_static: graph break in '{name}' — falling "
+            f"back to eager for this input signature. Breaking construct: "
+            f"{type(err).__name__}: {str(err).splitlines()[0][:200]}",
+            RuntimeWarning, stacklevel=4)
+        self._eager_sigs.add(sig)
+        self._cache.pop(sig, None)
 
     def __call__(self, *args, **kwargs):
         if self._fn is not None:
@@ -65,6 +116,8 @@ class StaticFunction:
         kw_items = tuple(sorted(kwargs.items()))
         sig = (_sig_of(args), training, _sig_of([v for _, v in kw_items]),
                tuple(k for k, _ in kw_items))
+        if sig in self._eager_sigs:
+            return self._layer(*args, **kwargs)
         compiled = self._cache.get(sig)
         if compiled is None:
             f = self._func
@@ -78,9 +131,15 @@ class StaticFunction:
         arg_vals = jax.tree_util.tree_map(
             lambda v: v._value if isinstance(v, Tensor) else v, args,
             is_leaf=lambda v: isinstance(v, Tensor))
-        out_values, new_buffers = compiled(
-            self._func.param_values(), self._func.buffer_values(),
-            default_generator.next_key(), arg_vals)
+        try:
+            out_values, new_buffers = compiled(
+                self._func.param_values(), self._func.buffer_values(),
+                default_generator.next_key(), arg_vals)
+        except Exception as e:
+            if not _is_graph_break(e):
+                raise
+            self._graph_break(sig, e)
+            return self._layer(*args, **kwargs)
         if self._layer.training:
             self._func.write_back(buffer_values=new_buffers)
         return jax.tree_util.tree_map(lambda v: Tensor._wrap(v), out_values)
@@ -89,6 +148,8 @@ class StaticFunction:
         kw_items = tuple(sorted(kwargs.items()))
         sig = (_sig_of(args), _sig_of([v for _, v in kw_items]),
                tuple(k for k, _ in kw_items))
+        if sig in self._eager_sigs:
+            return self._fn(*args, **kwargs)
         compiled = self._cache.get(sig)
         if compiled is None:
             fn = self._fn
@@ -109,19 +170,31 @@ class StaticFunction:
         arg_vals = jax.tree_util.tree_map(
             lambda v: v._value if isinstance(v, Tensor) else v, args,
             is_leaf=lambda v: isinstance(v, Tensor))
-        out = compiled(arg_vals)
+        try:
+            out = compiled(arg_vals)
+        except Exception as e:
+            if not _is_graph_break(e):
+                raise
+            self._graph_break(sig, e)
+            return self._fn(*args, **kwargs)
         return jax.tree_util.tree_map(lambda v: Tensor._wrap(v), out)
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True):
-    """paddle.jit.to_static — decorator or direct call."""
+              backend=None, full_graph=False):
+    """paddle.jit.to_static — decorator or direct call.
+
+    full_graph=False (default): graph-break fallback to eager on python
+    data-dependent control flow (reference SOT mode). full_graph=True:
+    trace errors raise (reference AST mode)."""
     if function is None:
         def deco(fn):
-            return StaticFunction(fn, input_spec, build_strategy, backend)
+            return StaticFunction(fn, input_spec, build_strategy, backend,
+                                  full_graph)
 
         return deco
-    return StaticFunction(function, input_spec, build_strategy, backend)
+    return StaticFunction(function, input_spec, build_strategy, backend,
+                          full_graph)
 
 
 class TrainStep:

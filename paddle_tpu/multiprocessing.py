@@ -40,7 +40,17 @@ def _dtype_by_name(name):
 def _rebuild_tensor(path, shape, dtype_name):
     from paddle_tpu.core.tensor import Tensor
 
-    arr = np.fromfile(path, dtype=_dtype_by_name(dtype_name)).reshape(shape)
+    try:
+        arr = np.fromfile(path,
+                          dtype=_dtype_by_name(dtype_name)).reshape(shape)
+    except FileNotFoundError:
+        raise RuntimeError(
+            f"paddle_tpu.multiprocessing: shared-memory segment {path!r} is "
+            "gone — a Tensor message can be deserialized only ONCE (the "
+            "first consumer unlinks the segment). Re-pickling the same "
+            "bytes or fanning one message out to several consumers is not "
+            "supported by the file_system strategy; send one message per "
+            "consumer instead.") from None
     try:
         os.unlink(path)  # consumer owns cleanup
     except OSError:

@@ -64,6 +64,20 @@ class OpDef:
 OPS: Dict[str, OpDef] = {}
 
 
+def host_only_impl(name: str, api_hint: str):
+    """Registry impl for host-side ops (NMS, graph sampling, decode loops)
+    whose outputs are data-dependent-shaped and computed in numpy via the
+    public python API. Generic dispatch (static replay, tracer replay)
+    must never silently pass inputs through, so the registered impl
+    raises, pointing at the real entry point."""
+    def impl(*args, **kwargs):
+        raise NotImplementedError(
+            f"op '{name}' executes host-side with data-dependent output "
+            f"shapes; call the python API ({api_hint}) directly — it is "
+            "not replayable through generic op dispatch")
+    return impl
+
+
 def _load_yaml() -> None:
     path = os.path.join(os.path.dirname(__file__), "ops.yaml")
     with open(path) as f:

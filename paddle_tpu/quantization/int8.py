@@ -37,10 +37,38 @@ def _as_t(v):
 
 # ------------------------------------------------------------ weight quant
 
+def _pack_int4(q):
+    """Pack two signed int4 rows per int8 byte along axis 0 (the in-channel
+    axis), matching the reference weight-only int4 storage density
+    (weight_quantize_kernel.cu packs pairs; we use low-nibble = even row,
+    high-nibble = odd row as our documented layout)."""
+    k = q.shape[0]
+    if k % 2:
+        raise ValueError(f"int4 packing needs an even in-dim, got {k}")
+    lo = q[0::2].astype(jnp.int32) & 0xF
+    hi = q[1::2].astype(jnp.int32) & 0xF
+    return (lo | (hi << 4)).astype(jnp.int8)
+
+
+def _unpack_int4(packed):
+    """Inverse of _pack_int4: int8 [k//2, n] -> signed int4 values
+    [k, n] (still int8 dtype)."""
+    p = packed.astype(jnp.int32) & 0xFF
+    lo = p & 0xF
+    hi = (p >> 4) & 0xF
+    lo = lo - 16 * (lo >= 8)   # sign-extend 4-bit two's complement
+    hi = hi - 16 * (hi >= 8)
+    k2, n = packed.shape
+    out = jnp.stack([lo, hi], axis=1).reshape(2 * k2, n)
+    return out.astype(jnp.int8)
+
+
 def _weight_quantize(w, algo="weight_only_int8", group_size=-1):
-    """Per-output-channel symmetric abs-max int8 (int4 packs the range
-    only; storage stays int8). w: [in, out] -> (qw int8 [in, out],
-    scale fp [out])."""
+    """Per-output-channel symmetric abs-max quant. w: [in, out] ->
+    (qw int8, scale fp [out]). int4 packs two values per byte along the
+    in-dim, so qw is [in//2, out] for int4 (not interchangeable with
+    reference CUDA tile-permuted layouts, but the same density; layout is
+    documented on _pack_int4)."""
     bits = 4 if "int4" in algo else 8
     qmax = 2.0 ** (bits - 1) - 1
     if group_size and group_size > 0:
@@ -49,16 +77,22 @@ def _weight_quantize(w, algo="weight_only_int8", group_size=-1):
         wg = w.reshape(g, group_size, n)
         scale = jnp.abs(wg).max(axis=1) / qmax          # [g, n]
         q = jnp.clip(jnp.round(wg / jnp.maximum(scale, 1e-9)[:, None, :]),
-                     -qmax, qmax)
-        return q.reshape(k, n).astype(jnp.int8), scale
+                     -qmax, qmax).reshape(k, n).astype(jnp.int8)
+        if bits == 4:
+            q = _pack_int4(q)
+        return q, scale
     scale = jnp.abs(w).max(axis=0) / qmax               # [out]
     # zero channels (pruned / zero-init) quantize to 0, not NaN
     q = jnp.clip(jnp.round(w / jnp.maximum(scale, 1e-9)[None, :]),
-                 -qmax, qmax)
-    return q.astype(jnp.int8), scale
+                 -qmax, qmax).astype(jnp.int8)
+    if bits == 4:
+        q = _pack_int4(q)
+    return q, scale
 
 
 def _weight_dequantize(qw, scale, algo="weight_only_int8", group_size=-1):
+    if "int4" in algo:
+        qw = _unpack_int4(qw)
     if scale.ndim == 2:  # grouped
         k, n = qw.shape
         g = scale.shape[0]
@@ -90,6 +124,7 @@ def _weight_only_linear(x, qw, weight_scale, bias=None,
     """fp activation x int8 weight: dequant rides the matmul epilogue
     (XLA fuses scale-multiply into the dot consumer)."""
     w = _weight_dequantize(qw, weight_scale.astype(x.dtype),
+                           algo=f"weight_only_{weight_dtype}",
                            group_size=group_size)
     out = x @ w
     if bias is not None:
@@ -255,16 +290,92 @@ for _n, _f, _d in (
         ("dequantize_log", _dequantize_log, False)):
     OPS.setdefault(_n, OpDef(_n, _f, diff=_d, method=False))
 
-# moving-average / range variants share the stateful quanter in
-# quantization/__init__.py (FakeQuanterWithAbsMax); op-registry aliases:
-from paddle_tpu.quantization import _fake_quant as _fq_core  # noqa: E402
+# Moving-average / range / channel-wise variants get dedicated functional
+# impls matching the reference op semantics (fake_quantize_op.cc): the
+# stateful scale trackers become explicit (state in, state out) so the op
+# is jit-pure; the layer wrappers in quantization/__init__.py own the
+# buffers. (Round-2 advisor finding: these were aliased to the per-tensor
+# QDQ helper, which silently computed the wrong thing.)
 
-for _n in ("fake_quantize_moving_average_abs_max",
-           "fake_quantize_dequantize_moving_average_abs_max",
-           "fake_quantize_range_abs_max",
-           "fake_channel_wise_quantize_dequantize_abs_max",
-           "fake_channel_wise_dequantize_max_abs"):
-    OPS.setdefault(_n, OpDef(_n, _fq_core, diff=True, method=False))
+def _fq_moving_average_abs_max(x, in_scale, in_accum=None, in_state=None,
+                               moving_rate=0.9, bit_length=8, is_test=False):
+    """Quant-only output + EMA scale state. Ref
+    FakeQuantizeMovingAverageAbsMaxOp: accum = r*accum + max|x|,
+    state = r*state + 1, scale = accum/state."""
+    qmax = 2.0 ** (bit_length - 1) - 1
+    if is_test or in_accum is None:
+        scale = in_scale.reshape(())
+        q = jnp.clip(jnp.round(x / jnp.maximum(scale, 1e-9) * qmax),
+                     -qmax, qmax)
+        return q, scale
+    cur = jnp.abs(x).max()
+    accum = moving_rate * in_accum.reshape(()) + cur
+    state = moving_rate * in_state.reshape(()) + 1.0
+    scale = accum / state
+    q = jnp.clip(jnp.round(x / jnp.maximum(scale, 1e-9) * qmax), -qmax, qmax)
+    return q, scale, state, accum
+
+
+def _fq_dq_moving_average_abs_max(x, in_scale, in_accum=None, in_state=None,
+                                  moving_rate=0.9, bit_length=8,
+                                  is_test=False):
+    """QDQ (straight-through) variant of the moving-average quantizer."""
+    res = _fq_moving_average_abs_max(x, in_scale, in_accum, in_state,
+                                     moving_rate, bit_length, is_test)
+    q, scale, rest = res[0], res[1], res[2:]
+    qmax = 2.0 ** (bit_length - 1) - 1
+    dq = q * scale / qmax
+    out = x + jax.lax.stop_gradient(dq - x)
+    return (out, scale) + tuple(rest)
+
+
+def _fq_range_abs_max(x, in_scale, iter_=0, window_size=10000, bit_length=8,
+                      is_test=False):
+    """Windowed-range quantizer (ref FakeQuantizeRangeAbsMaxOp): scale
+    resets to max|x| at each window boundary, else grows monotonically."""
+    qmax = 2.0 ** (bit_length - 1) - 1
+    if is_test:
+        scale = in_scale.reshape(())
+    else:
+        cur = jnp.abs(x).max()
+        at_window_start = (jnp.asarray(iter_) % window_size) == 0
+        scale = jnp.where(at_window_start, cur,
+                          jnp.maximum(in_scale.reshape(()), cur))
+    q = jnp.clip(jnp.round(x / jnp.maximum(scale, 1e-9) * qmax), -qmax, qmax)
+    return q, scale
+
+
+def _fq_dq_channel_wise_abs_max(x, bit_length=8, quant_axis=0):
+    """Per-channel QDQ with straight-through gradient."""
+    q, scale = _fq_channel_wise_abs_max(x, bit_length, quant_axis)
+    qmax = 2.0 ** (bit_length - 1) - 1
+    shape = [1] * x.ndim
+    shape[quant_axis] = -1
+    dq = q * scale.reshape(shape) / qmax
+    return x + jax.lax.stop_gradient(dq - x), scale
+
+
+def _fake_channel_wise_dequantize_max_abs(x, scale, quant_bits=8,
+                                          quant_axis=0):
+    """Per-channel dequantize: x * scale / (2^(bits-1)-1) broadcast along
+    quant_axis (ref FakeChannelWiseDequantizeMaxAbsOp, single-scale form)."""
+    max_range = 2.0 ** (quant_bits - 1) - 1
+    shape = [1] * x.ndim
+    shape[quant_axis] = -1
+    return x.astype(scale.dtype) * scale.reshape(shape) / max_range
+
+
+for _n, _f, _d in (
+        ("fake_quantize_moving_average_abs_max",
+         _fq_moving_average_abs_max, False),
+        ("fake_quantize_dequantize_moving_average_abs_max",
+         _fq_dq_moving_average_abs_max, True),
+        ("fake_quantize_range_abs_max", _fq_range_abs_max, False),
+        ("fake_channel_wise_quantize_dequantize_abs_max",
+         _fq_dq_channel_wise_abs_max, True),
+        ("fake_channel_wise_dequantize_max_abs",
+         _fake_channel_wise_dequantize_max_abs, False)):
+    OPS.setdefault(_n, OpDef(_n, _f, diff=_d, method=False))
 
 
 # ------------------------------------------------------------ int8 layer

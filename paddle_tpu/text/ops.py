@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core.tensor import Tensor
-from paddle_tpu.ops.registry import OPS, OpDef, dispatch
+from paddle_tpu.ops.registry import OPS, OpDef, dispatch, host_only_impl
 from paddle_tpu.text.viterbi import viterbi_decode
 
 
@@ -63,9 +63,13 @@ def crf_decoding(input, transition, label=None, length=None):
     return _wrap((lv == _np(path)).astype(np.int64))
 
 
-OPS.setdefault("crf_decoding", OpDef("crf_decoding", lambda x, t: x,
+OPS.setdefault("crf_decoding", OpDef(
+    "crf_decoding", host_only_impl("crf_decoding",
+                                   "paddle_tpu.text.ops.crf_decoding"),
                                      diff=False, dynamic=True, method=False))
-OPS.setdefault("viterbi_decode", OpDef("viterbi_decode", lambda x, t: x,
+OPS.setdefault("viterbi_decode", OpDef(
+    "viterbi_decode", host_only_impl("viterbi_decode",
+                                     "paddle_tpu.text.viterbi_decode"),
                                        diff=False, dynamic=True,
                                        method=False))
 
@@ -107,7 +111,9 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
     return _wrap(out), _wrap(np.asarray([b], np.int64))
 
 
-OPS.setdefault("edit_distance", OpDef("edit_distance", lambda a, b: a,
+OPS.setdefault("edit_distance", OpDef(
+    "edit_distance", host_only_impl("edit_distance",
+                                    "paddle_tpu.text.ops.edit_distance"),
                                       diff=False, dynamic=True,
                                       method=False))
 
@@ -138,7 +144,9 @@ def ctc_align(input, input_length=None, blank=0, padding_value=0, name=None):
     return _wrap(padded), _wrap(np.asarray(lens, np.int64))
 
 
-OPS.setdefault("ctc_align", OpDef("ctc_align", lambda x: x, diff=False,
+OPS.setdefault("ctc_align", OpDef(
+    "ctc_align", host_only_impl("ctc_align", "paddle_tpu.text.ops.ctc_align"),
+    diff=False,
                                   dynamic=True, method=False))
 
 
@@ -215,7 +223,10 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,
             mk(n_cor, np.int64))
 
 
-OPS.setdefault("chunk_eval", OpDef("chunk_eval", lambda i, l: i, diff=False,
+OPS.setdefault("chunk_eval", OpDef(
+    "chunk_eval", host_only_impl("chunk_eval",
+                                 "paddle_tpu.text.ops.chunk_eval"),
+    diff=False,
                                    dynamic=True, method=False))
 
 

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core.tensor import Tensor
-from paddle_tpu.ops.registry import OPS, OpDef, dispatch
+from paddle_tpu.ops.registry import OPS, OpDef, dispatch, host_only_impl
 
 
 def _u(x):
@@ -119,8 +119,9 @@ def box_clip(input, im_info, name=None):
     return _wrap(jnp.stack([x1, y1, x2, y2], axis=-1))
 
 
-OPS.setdefault("box_clip", OpDef("box_clip", lambda b, i: b, diff=False,
-                                 method=False))
+OPS.setdefault("box_clip", OpDef(
+    "box_clip", host_only_impl("box_clip", "paddle_tpu.vision.ops.box_clip"),
+    diff=False, method=False))
 
 
 # --------------------------------------------------------------------------
@@ -187,8 +188,10 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
     return _wrap(boxes), _wrap(var)
 
 
-OPS.setdefault("prior_box", OpDef("prior_box", lambda x, img: x, diff=False,
-                                  method=False))
+OPS.setdefault("prior_box", OpDef(
+    "prior_box", host_only_impl("prior_box",
+                                "paddle_tpu.vision.ops.prior_box"),
+    diff=False, method=False))
 
 
 def anchor_generator(input, anchor_sizes, aspect_ratios, variances,
@@ -206,8 +209,10 @@ def anchor_generator(input, anchor_sizes, aspect_ratios, variances,
     cx = (jnp.arange(w, dtype=feat.dtype) + offset) * stride[0]
     cy = (jnp.arange(h, dtype=feat.dtype) + offset) * stride[1]
     cxg, cyg = jnp.meshgrid(cx, cy)
-    bw = wh[:, 0][None, None] * 0.5
-    bh = wh[:, 1][None, None] * 0.5
+    # reference anchor_generator_op.h corner convention: cx ± (w-1)/2
+    # (half-pixel inset on every anchor), not cx ± w/2
+    bw = (wh[:, 0][None, None] - 1.0) * 0.5
+    bh = (wh[:, 1][None, None] - 1.0) * 0.5
     cxn = cxg[..., None]
     cyn = cyg[..., None]
     anchors = jnp.stack([cxn - bw, cyn - bh, cxn + bw, cyn + bh], axis=-1)
@@ -215,8 +220,10 @@ def anchor_generator(input, anchor_sizes, aspect_ratios, variances,
     return _wrap(anchors), _wrap(var)
 
 
-OPS.setdefault("anchor_generator", OpDef("anchor_generator", lambda x: x,
-                                         diff=False, method=False))
+OPS.setdefault("anchor_generator", OpDef(
+    "anchor_generator", host_only_impl(
+        "anchor_generator", "paddle_tpu.vision.ops.anchor_generator"),
+    diff=False, method=False))
 
 
 # --------------------------------------------------------------------------
@@ -311,7 +318,9 @@ def yolo_box_head(x, anchors, class_num, name=None):
     return _wrap(act.reshape(n, c, h, w))
 
 
-OPS.setdefault("yolo_box_head", OpDef("yolo_box_head", lambda x: x,
+OPS.setdefault("yolo_box_head", OpDef(
+    "yolo_box_head", host_only_impl("yolo_box_head",
+                                    "paddle_tpu.vision.ops.yolo_box_head"),
                                       diff=False, method=False))
 
 
@@ -334,7 +343,9 @@ def yolo_box_post(heads, img_size, anchors_list, class_num, conf_thresh,
                            keep_top_k=keep_top_k, nms_threshold=nms_threshold)
 
 
-OPS.setdefault("yolo_box_post", OpDef("yolo_box_post", lambda x: x,
+OPS.setdefault("yolo_box_post", OpDef(
+    "yolo_box_post", host_only_impl("yolo_box_post",
+                                    "paddle_tpu.vision.ops.yolo_box_post"),
                                       diff=False, method=False))
 
 
@@ -552,26 +563,34 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
     return (out, None, rois_num) if return_rois_num else out
 
 
-OPS.setdefault("matrix_nms", OpDef("matrix_nms", lambda b, s: b, diff=False,
+OPS.setdefault("matrix_nms", OpDef(
+    "matrix_nms", host_only_impl("matrix_nms",
+                                 "paddle_tpu.vision.ops.matrix_nms"),
+    diff=False,
                                    dynamic=True, method=False))
 
 
-def _hard_nms_indices(boxes, scores, iou_threshold, top_k, normalized=True):
+def _hard_nms_indices(boxes, scores, iou_threshold, top_k, normalized=True,
+                      eta=1.0):
     """Greedy hard NMS, fully host-side (numpy IoU: the candidate count
     varies per (image, class), so a device matrix would recompile per
-    shape); returns kept order."""
+    shape); returns kept order. eta < 1 decays the IoU threshold
+    adaptively after each kept box (reference NMSFast adaptive_threshold)."""
     order = np.argsort(-scores)
     iou = np.asarray(_iou_matrix(np.asarray(boxes)[order], normalized))
     keep = []
     alive = np.ones(len(order), bool)
+    thresh = iou_threshold
     for i in range(len(order)):
         if not alive[i]:
             continue
         keep.append(order[i])
         if 0 < top_k <= len(keep):
             break
-        alive &= ~(iou[i] > iou_threshold)
+        alive &= ~(iou[i] > thresh)
         alive[i] = False
+        if eta < 1.0 and thresh > 0.5:
+            thresh *= eta
     return np.asarray(keep, np.int64)
 
 
@@ -597,7 +616,7 @@ def multiclass_nms3(bboxes, scores, score_threshold=0.0, nms_top_k=-1,
             if 0 < nms_top_k < sel.size:  # pre-NMS candidate cap (reference)
                 sel = sel[np.argsort(-sc[sel])[:nms_top_k]]
             keep = _hard_nms_indices(bv[b, sel], sc[sel], nms_threshold,
-                                     -1, normalized)
+                                     -1, normalized, eta=nms_eta)
             for o in sel[keep]:
                 rows.append((cl, sc[o], *bv[b, o], b * m + o))
         rows.sort(key=lambda r: -r[1])
@@ -614,7 +633,9 @@ def multiclass_nms3(bboxes, scores, score_threshold=0.0, nms_top_k=-1,
     return out, (nums_t if return_rois_num else None)
 
 
-OPS.setdefault("multiclass_nms3", OpDef("multiclass_nms3", lambda b, s: b,
+OPS.setdefault("multiclass_nms3", OpDef(
+    "multiclass_nms3", host_only_impl(
+        "multiclass_nms3", "paddle_tpu.vision.ops.multiclass_nms3"),
                                         diff=False, dynamic=True,
                                         method=False))
 
@@ -651,7 +672,9 @@ def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,
     return _wrap(idx[None]), _wrap(dist[None])
 
 
-OPS.setdefault("bipartite_match", OpDef("bipartite_match", lambda d: d,
+OPS.setdefault("bipartite_match", OpDef(
+    "bipartite_match", host_only_impl(
+        "bipartite_match", "paddle_tpu.vision.ops.bipartite_match"),
                                         diff=False, dynamic=True,
                                         method=False))
 
@@ -704,8 +727,10 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
     return (rois, rscores, nums_t) if return_rois_num else (rois, rscores)
 
 
-OPS.setdefault("generate_proposals", OpDef("generate_proposals",
-                                           lambda s, d: s, diff=False,
+OPS.setdefault("generate_proposals", OpDef(
+    "generate_proposals", host_only_impl(
+        "generate_proposals", "paddle_tpu.vision.ops.generate_proposals"),
+    diff=False,
                                            dynamic=True, method=False))
 
 
@@ -735,7 +760,10 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
 
 
 OPS.setdefault("distribute_fpn_proposals",
-               OpDef("distribute_fpn_proposals", lambda r: r, diff=False,
+               OpDef("distribute_fpn_proposals",
+                     host_only_impl("distribute_fpn_proposals",
+                                    "paddle_tpu.vision.ops."
+                                    "distribute_fpn_proposals"), diff=False,
                      dynamic=True, method=False))
 
 
@@ -765,7 +793,10 @@ def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
 
 
 OPS.setdefault("collect_fpn_proposals",
-               OpDef("collect_fpn_proposals", lambda r: r, diff=False,
+               OpDef("collect_fpn_proposals",
+                     host_only_impl("collect_fpn_proposals",
+                                    "paddle_tpu.vision.ops."
+                                    "collect_fpn_proposals"), diff=False,
                      dynamic=True, method=False))
 
 
@@ -1025,7 +1056,9 @@ def read_file(filename, name=None):
     return _wrap(np.frombuffer(data, np.uint8))
 
 
-OPS.setdefault("read_file", OpDef("read_file", lambda f: f, diff=False,
+OPS.setdefault("read_file", OpDef(
+    "read_file", host_only_impl("read_file", "paddle_tpu.vision.ops.read_file"),
+    diff=False,
                                   dynamic=True, method=False))
 
 
@@ -1048,5 +1081,8 @@ def decode_jpeg(x, mode="unchanged", name=None):
     return _wrap(np.ascontiguousarray(arr))
 
 
-OPS.setdefault("decode_jpeg", OpDef("decode_jpeg", lambda x: x, diff=False,
+OPS.setdefault("decode_jpeg", OpDef(
+    "decode_jpeg", host_only_impl("decode_jpeg",
+                                  "paddle_tpu.vision.ops.decode_jpeg"),
+    diff=False,
                                     dynamic=True, method=False))

@@ -1,0 +1,89 @@
+"""The wedge-proof bench result cache (bench.py BENCH_CACHE.json).
+
+Round-2 verdict: the official BENCH_rXX.json was empty twice because the
+axon tunnel was wedged at snapshot time even though real TPU numbers had
+been measured mid-round. The fix: every successful TPU rung line is
+persisted to BENCH_CACHE.json at run time, and on a failed tunnel probe the
+ladder re-emits the cached lines marked stale. These tests pin that
+contract without spawning children or touching jax.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def bench(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench_under_test"] = mod
+    spec.loader.exec_module(mod)
+    mod.RESULT_CACHE = str(tmp_path / "BENCH_CACHE.json")
+    yield mod
+    del sys.modules["bench_under_test"]
+
+
+def test_cache_roundtrip_and_stale_reemit(bench, capsys):
+    line = {"metric": "gpt_train_tokens_per_sec_mid_6l512", "value": 167000.0,
+            "unit": "tokens/s", "vs_baseline": 0.33, "mfu": 0.184,
+            "backend": "tpu"}
+    bench._cache_result(line)
+    cached = bench._load_result_cache()
+    assert cached[line["metric"]]["value"] == 167000.0
+    assert "cached_at" in cached[line["metric"]]
+
+    assert bench._emit_stale_cache("test wedge") is True
+    out = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    # headline (largest gpt rung) is repeated last even though no
+    # per-chip metric was ever cached
+    assert out[-1]["metric"] == "gpt_train_tokens_per_sec_per_chip"
+    assert out[-1]["stale"] is True
+    assert out[-1]["value"] == 167000.0
+    assert out[-1]["stale_reason"] == "test wedge"
+
+
+def test_cpu_results_never_cached(bench):
+    bench._cache_result({"metric": "gpt_train_tokens_per_sec_tiny",
+                         "value": 1.0, "backend": "cpu"})
+    assert bench._load_result_cache() == {}
+
+
+def test_empty_cache_reports_nothing(bench, capsys):
+    assert bench._emit_stale_cache("wedge") is False
+    assert capsys.readouterr().out == ""
+
+
+def test_headline_falls_back_to_largest_rung_by_params(bench, capsys):
+    """Real rung names sort lexicographically as gpt124m < mid < tiny —
+    the fallback must pick by model size, not name order."""
+    bench._cache_result({"metric": "gpt_train_tokens_per_sec_tiny_2l256",
+                         "value": 900000.0, "params_m": 10.0,
+                         "backend": "tpu"})
+    bench._cache_result({"metric": "gpt_train_tokens_per_sec_mid_6l512",
+                         "value": 300000.0, "params_m": 50.0,
+                         "backend": "tpu"})
+    bench._cache_result({"metric": "gpt_train_tokens_per_sec_gpt124m_12l768",
+                         "value": 100000.0, "params_m": 124.0,
+                         "backend": "tpu"})
+    assert bench._emit_stale_cache("wedge") is True
+    out = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert out[-1]["metric"] == "gpt_train_tokens_per_sec_per_chip"
+    assert out[-1]["params_m"] == 124.0
+
+
+def test_headline_metric_cached_directly_wins(bench, capsys):
+    bench._cache_result({"metric": "gpt_train_tokens_per_sec_per_chip",
+                         "value": 2.0, "backend": "tpu"})
+    bench._cache_result({"metric": "gpt_train_tokens_per_sec_zz_big",
+                         "value": 1.0, "backend": "tpu"})
+    bench._emit_stale_cache("wedge")
+    out = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert out[-1]["metric"] == "gpt_train_tokens_per_sec_per_chip"
+    assert out[-1]["value"] == 2.0

@@ -94,9 +94,11 @@ def test_anchor_generator_shapes():
     assert tuple(a.shape) == (4, 4, 6, 4)
     av = A(a)
     # aspect 1.0 anchors at cell (0,0) centered at offset*stride
+    # reference corner convention is cx ± (w-1)/2 (inclusive pixel span),
+    # so the generated extent is w-1 and the area recovers as (ws+1)(hs+1)
     ws = av[0, 0, :, 2] - av[0, 0, :, 0]
     hs = av[0, 0, :, 3] - av[0, 0, :, 1]
-    areas = sorted((ws * hs).round().tolist())
+    areas = sorted(((ws + 1) * (hs + 1)).round().tolist())
     assert areas == sorted([32 * 32, 64 * 64] * 3)
 
 

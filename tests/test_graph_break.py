@@ -1,0 +1,112 @@
+"""Graph-break fallback for jit.to_static (paddle_tpu/jit/api.py).
+
+Reference: the SOT bytecode interpreter (python/paddle/jit/sot/
+translate.py:37, paddle/fluid/pybind/sot/eval_frame.c) runs arbitrary
+python under to_static by falling back to eager at untraceable points.
+Here the analogue is callsite-level: a concretization error at trace time
+pins that input signature to eager execution (warning emitted), while
+traceable signatures keep the compiled path.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def T(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+def test_data_dependent_if_falls_back_to_eager():
+    @paddle.jit.to_static
+    def f(x):
+        if float(x.mean()) > 0:          # python branch on a tensor VALUE
+            return x * 2.0
+        return x - 1.0
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out_pos = f(T([1.0, 2.0]))
+        out_neg = f(T([-3.0, -4.0]))
+    assert any("graph break" in str(x.message) for x in w)
+    np.testing.assert_allclose(np.asarray(out_pos._value), [2.0, 4.0])
+    np.testing.assert_allclose(np.asarray(out_neg._value), [-4.0, -5.0])
+
+
+def test_fallback_cached_per_signature():
+    calls = {"n": 0}
+
+    @paddle.jit.to_static
+    def f(x):
+        calls["n"] += 1
+        if float(x.sum()) > 0:
+            return x + 1.0
+        return x
+
+    f(T([1.0]))
+    n_after_break = calls["n"]           # trace attempt + eager rerun
+    f(T([2.0]))                          # same signature: eager directly
+    assert calls["n"] == n_after_break + 1
+    assert len(f._eager_sigs) == 1
+
+
+def test_traceable_function_stays_compiled():
+    @paddle.jit.to_static
+    def f(x):
+        return x * 3.0
+
+    out = f(T([1.0, 2.0]))
+    np.testing.assert_allclose(np.asarray(out._value), [3.0, 6.0])
+    assert not f._eager_sigs and len(f._cache) == 1
+
+
+def test_full_graph_true_raises():
+    @paddle.jit.to_static(full_graph=True)
+    def f(x):
+        if float(x.mean()) > 0:
+            return x * 2.0
+        return x
+
+    with pytest.raises(Exception):
+        f(T([1.0]))
+
+
+def test_layer_with_data_dependent_branch_falls_back():
+    from paddle_tpu import nn
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.lin(x)
+            if float(h.mean()) > 1e9:    # value-dependent python branch
+                return h * 0.0
+            return h
+
+    paddle.seed(0)
+    m = M()
+    static = paddle.jit.to_static(m)
+    x = T(np.random.default_rng(0).standard_normal((2, 4)))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = static(x)
+    assert any("graph break" in str(x.message) for x in w)
+    ref = m(x)
+    np.testing.assert_allclose(np.asarray(out._value),
+                               np.asarray(ref._value), rtol=1e-6)
+
+
+def test_real_errors_still_raise():
+    @paddle.jit.to_static
+    def f(x):
+        return x @ x                     # [3] @ [3] -> ok; [2,3]@[2,3] fails
+
+    with pytest.raises(Exception) as ei:
+        f(T(np.ones((2, 3))))
+    assert "Tracer" not in str(ei.value)
+    assert not f._eager_sigs             # not recorded as a graph break

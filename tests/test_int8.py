@@ -31,10 +31,27 @@ def test_weight_quantize_roundtrip():
     assert abs(wd - w).max() / abs(w).max() < 0.01
 
 
-def test_weight_quantize_int4_range():
+def test_weight_quantize_int4_packs_two_per_byte():
+    from paddle_tpu.quantization.int8 import _unpack_int4
+
     w = rng.standard_normal((16, 8)).astype(np.float32)
     qw, s = Q.weight_quantize(T(w), algo="weight_only_int4")
-    assert abs(A(qw)).max() <= 7
+    assert A(qw).shape == (8, 8)          # two int4 per stored byte
+    vals = np.asarray(_unpack_int4(A(qw)))
+    assert vals.shape == (16, 8) and abs(vals).max() <= 7
+    # dequant error bounded by one int4 step
+    wd = A(Q.weight_dequantize(qw, s, algo="weight_only_int4"))
+    assert abs(wd - w).max() <= abs(w).max() / 7 + 1e-6
+
+
+def test_weight_only_linear_int4_matches_dequant():
+    w = rng.standard_normal((16, 8)).astype(np.float32)
+    x = rng.standard_normal((3, 16)).astype(np.float32)
+    qw, s = Q.weight_quantize(T(w), algo="weight_only_int4")
+    out = A(Q.weight_only_linear(T(x), qw, weight_scale=s,
+                                 weight_dtype="int4"))
+    ref = x @ A(Q.weight_dequantize(qw, s, algo="weight_only_int4"))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
 
 
 def test_weight_quantize_grouped():
@@ -137,3 +154,55 @@ def test_qat_convert_root_quanted_linear():
     q2 = QuantedLinear(nn.Linear(8, 4))
     q2 = Q.QAT().convert(q2)
     assert hasattr(q2, "_int8_weight") and q2._int8_weight.dtype == np.int8
+
+
+def test_fake_quant_moving_average_state_update():
+    """Round-2 advisor (medium): these ops were aliased to the per-tensor
+    QDQ helper. Pin the reference semantics: accum=r*accum+max|x|,
+    state=r*state+1, scale=accum/state."""
+    from paddle_tpu.ops.registry import OPS
+    import jax.numpy as jnp
+
+    x = jnp.asarray(rng.standard_normal((4, 4)).astype(np.float32))
+    f = OPS["fake_quantize_moving_average_abs_max"].impl
+    q, scale, state, accum = f(x, jnp.asarray(1.0), jnp.asarray(2.0),
+                               jnp.asarray(3.0), moving_rate=0.5)
+    cur = float(abs(np.asarray(x)).max())
+    np.testing.assert_allclose(float(accum), 0.5 * 2.0 + cur, rtol=1e-6)
+    np.testing.assert_allclose(float(state), 0.5 * 3.0 + 1.0, rtol=1e-6)
+    np.testing.assert_allclose(float(scale), float(accum) / float(state),
+                               rtol=1e-6)
+    assert abs(np.asarray(q)).max() <= 127
+    # is_test: scale passes through unchanged, no state outputs
+    q2, s2 = f(x, jnp.asarray(7.0), is_test=True)
+    assert float(s2) == 7.0
+
+
+def test_fake_quant_range_window_reset():
+    from paddle_tpu.ops.registry import OPS
+    import jax.numpy as jnp
+
+    x = jnp.asarray(rng.standard_normal((4, 4)).astype(np.float32))
+    f = OPS["fake_quantize_range_abs_max"].impl
+    cur = float(abs(np.asarray(x)).max())
+    _, s0 = f(x, jnp.asarray(100.0), iter_=0, window_size=10)
+    np.testing.assert_allclose(float(s0), cur, rtol=1e-6)  # window reset
+    _, s1 = f(x, jnp.asarray(100.0), iter_=3, window_size=10)
+    assert float(s1) == 100.0                              # monotone growth
+
+
+def test_fake_channel_wise_ops_are_per_channel():
+    from paddle_tpu.ops.registry import OPS
+    import jax.numpy as jnp
+
+    x = jnp.asarray(rng.standard_normal((3, 8)).astype(np.float32))
+    out, sc = OPS["fake_channel_wise_quantize_dequantize_abs_max"].impl(
+        x, quant_axis=0)
+    assert sc.shape == (3,)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x),
+                               atol=float(abs(np.asarray(x)).max()) / 127)
+    dq = OPS["fake_channel_wise_dequantize_max_abs"].impl(
+        jnp.ones((3, 8), jnp.int8) * 127, jnp.asarray([1.0, 2.0, 3.0]),
+        quant_axis=0)
+    np.testing.assert_allclose(np.asarray(dq)[:, 0], [1.0, 2.0, 3.0],
+                               rtol=1e-6)
