@@ -25,7 +25,11 @@ class Place:
         self.device_id = int(device_id)
 
     def jax_device(self):
-        return jax.devices(self.backend)[self.device_id]
+        # local_devices, not devices: in a multi-process world the global
+        # list leads with rank 0's devices, which other ranks cannot
+        # address; a Place names a device of THIS process (the reference's
+        # per-trainer device_id semantics)
+        return jax.local_devices(backend=self.backend)[self.device_id]
 
     def __eq__(self, other):
         return (
@@ -93,4 +97,6 @@ def expected_place() -> Place:
 
 
 def device_count(backend: str | None = None) -> int:
-    return len(jax.devices(backend or expected_place().backend))
+    # devices of THIS process (multi-process: the global list spans hosts)
+    return len(jax.local_devices(backend=backend
+                                 or expected_place().backend))

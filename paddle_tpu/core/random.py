@@ -22,9 +22,24 @@ class Generator:
 
     def manual_seed(self, seed: int):
         self._seed = int(seed)
-        self.key = jax.random.key(self._seed)
+        # lazy: materializing a PRNG key runs a computation, which
+        # instantiates the XLA backend — and `import paddle_tpu` must stay
+        # backend-free so a multi-process user can still call
+        # init_parallel_env() (jax.distributed.initialize requires no
+        # backend to exist yet) after importing the framework
+        self._key = None
         self.offset = 0
         return self
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+        return self._key
+
+    @key.setter
+    def key(self, v):
+        self._key = v
 
     def next_key(self):
         self.offset += 1

