@@ -39,11 +39,20 @@ def _send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None):
 
 def _send_ue_recv(x, e, src_index, dst_index, message_op="add",
                   reduce_op="sum", out_size=None):
+    """Node+edge message passing (reference send_ue_recv,
+    phi/kernels/gpu/graph_send_ue_recv_kernel.cu): msg = x[src] OP e,
+    segment-reduced at dst. message_op: add/sub/mul/div."""
     msgs = jnp.take(x, src_index, axis=0)
     if message_op == "add":
         msgs = msgs + e
+    elif message_op == "sub":
+        msgs = msgs - e
     elif message_op == "mul":
         msgs = msgs * e
+    elif message_op == "div":
+        msgs = msgs / e
+    else:
+        raise ValueError(message_op)
     n = out_size if out_size is not None else x.shape[0]
     if reduce_op == "sum":
         return jax.ops.segment_sum(msgs, dst_index, num_segments=n)
@@ -54,6 +63,8 @@ def _send_ue_recv(x, e, src_index, dst_index, message_op="add",
         return s / jnp.maximum(cnt, 1)[:, None]
     if reduce_op == "max":
         return jax.ops.segment_max(msgs, dst_index, num_segments=n)
+    if reduce_op == "min":
+        return jax.ops.segment_min(msgs, dst_index, num_segments=n)
     raise ValueError(reduce_op)
 
 
@@ -91,6 +102,9 @@ for _name, _fn in (("send_u_recv", _send_u_recv),
     # (pass num_segments/out_size explicitly inside jit-traced code)
     OPS.setdefault(f"geo_{_name}", OpDef(f"geo_{_name}", _fn, diff=True,
                                          dynamic=True, method=False))
+    # also registered under the reference kernel name (graph_send_* family)
+    OPS.setdefault(_name, OpDef(_name, _fn, diff=True, dynamic=True,
+                                method=False))
 
 send_u_recv = make_op_function("geo_send_u_recv")
 send_ue_recv = make_op_function("geo_send_ue_recv")

@@ -11,6 +11,7 @@ points over the same registry ops.
 """
 
 from paddle_tpu.incubate import nn  # noqa: F401
+from paddle_tpu.incubate.tdm import tdm_child, tdm_sampler  # noqa: F401
 
 
 def __getattr__(name):

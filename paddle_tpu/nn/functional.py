@@ -8,6 +8,8 @@ mask building).
 
 from __future__ import annotations
 
+import jax.numpy as jnp
+
 from paddle_tpu.ops.registry import C_OPS as _C
 
 # direct re-exports
@@ -113,3 +115,92 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     the autograd tape."""
     return _C.warpctc(log_probs, labels, input_lengths, label_lengths,
                       blank=blank, reduction=reduction)
+
+
+def _margin_cross_entropy_impl(logits, label, margin1=1.0, margin2=0.5,
+                               margin3=0.0, scale=64.0, reduction="mean"):
+    """ArcFace/CosFace margin softmax CE (reference
+    paddle/phi/kernels/gpu/margin_cross_entropy_kernel.cu; python API
+    nn/functional/loss.py margin_cross_entropy). logits are cosines of the
+    normalized feature x class-center angles; the target class logit is
+    remapped cos(t) -> cos(m1*t + m2) - m3 before scaling.
+
+    Model parallel: under GSPMD, class-dim-sharded logits make the
+    log_softmax reduction a mesh collective automatically — the same
+    single program serves both the single-chip and mp-sharded cases
+    (the reference needs a dedicated allreduce dance here)."""
+    import jax
+
+    lab = label.reshape(-1).astype("int32")
+    c = logits.shape[-1]
+    onehot = jax.nn.one_hot(lab, c, dtype=logits.dtype)
+    cos_t = jnp.clip(jnp.sum(logits * onehot, axis=-1), -1.0 + 1e-7,
+                     1.0 - 1e-7)
+    theta = jnp.arccos(cos_t)
+    target = jnp.cos(margin1 * theta + margin2) - margin3
+    adjusted = (logits + onehot * (target - cos_t)[:, None]) * scale
+    logp = jax.nn.log_softmax(adjusted, axis=-1)
+    loss = -jnp.sum(logp * onehot, axis=-1)
+    if reduction == "mean":
+        loss = loss.mean()
+    elif reduction == "sum":
+        loss = loss.sum()
+    else:
+        loss = loss[:, None]            # reference returns [N, 1]
+    return loss, jnp.exp(logp)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    loss, softmax = _C.margin_cross_entropy(
+        logits, label, margin1=margin1, margin2=margin2, margin3=margin3,
+        scale=scale, reduction=reduction)
+    return (loss, softmax) if return_softmax else loss
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """PartialFC class-center sampling (reference
+    nn/functional/common.py:2372 over class_center_sample_kernel.cu):
+    keep every positive class, pad with uniformly-sampled negative
+    classes up to num_samples, return (remapped_label, sampled_classes).
+    Host-side: the output is index bookkeeping that feeds the next
+    step's gather of class-center weights (input-pipeline work, like the
+    reference's CPU path)."""
+    import numpy as _np
+
+    from paddle_tpu.core.random import default_generator
+    from paddle_tpu.core.tensor import Tensor
+
+    lab = _np.asarray(label._value if isinstance(label, Tensor)
+                      else label).reshape(-1).astype(_np.int64)
+    pos = _np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rng = _np.random.default_rng(
+            default_generator._seed * 131071 + default_generator.offset)
+        default_generator.offset += 1
+        neg_pool = _np.setdiff1d(_np.arange(num_classes, dtype=_np.int64),
+                                 pos, assume_unique=True)
+        extra = rng.choice(neg_pool, size=num_samples - len(pos),
+                           replace=False)
+        sampled = _np.sort(_np.concatenate([pos, extra]))
+    remap = _np.full(num_classes, -1, _np.int64)
+    remap[sampled] = _np.arange(len(sampled))
+    return (Tensor._wrap(jnp.asarray(remap[lab])),
+            Tensor._wrap(jnp.asarray(sampled)))
+
+
+from paddle_tpu.ops.registry import OPS as _OPS, OpDef as _OpDef  # noqa: E402
+from paddle_tpu.ops.registry import host_only_impl as _host_only  # noqa: E402
+
+_OPS.setdefault("margin_cross_entropy",
+                _OpDef("margin_cross_entropy", _margin_cross_entropy_impl,
+                       diff=True, method=False))
+_OPS.setdefault("class_center_sample",
+                _OpDef("class_center_sample",
+                       _host_only("class_center_sample",
+                                  "paddle_tpu.nn.functional."
+                                  "class_center_sample"),
+                       diff=False, dynamic=True, method=False))

