@@ -73,33 +73,38 @@ def _cache_result(line: dict) -> None:
 
 def _emit_stale_cache(reason: str) -> bool:
     """Re-emit every cached rung line marked stale. Returns True if the
-    cache yielded a headline number."""
+    cache yielded a headline number; emits NOTHING when it can't (so the
+    caller's CPU-fallback ladder never mixes with stale lines — one run,
+    one consistent line set)."""
     cache = _load_result_cache()
-    headline = None
-    for metric in sorted(cache):
+
+    def staled(metric):
         line = dict(cache[metric])
         cached_at = line.pop("cached_at", None)
         line["stale"] = True
         line["stale_reason"] = reason
         if cached_at is not None:
             line["age_s"] = round(time.time() - cached_at, 1)
-        emit(line)
-        if metric == "gpt_train_tokens_per_sec_per_chip":
-            headline = line
-    if headline is None:
+        return line
+
+    headline = None
+    if "gpt_train_tokens_per_sec_per_chip" in cache:
+        headline = staled("gpt_train_tokens_per_sec_per_chip")
+    else:
         # fall back to the largest cached GPT rung (by model size) as the
         # headline
         gpt = [m for m in cache if m.startswith("gpt_train_tokens_per_sec_")]
         if gpt:
             biggest = max(gpt, key=lambda m: cache[m].get("params_m", 0))
-            headline = dict(cache[biggest])
-            headline.pop("cached_at", None)
-            headline.update(stale=True, stale_reason=reason,
-                            metric="gpt_train_tokens_per_sec_per_chip")
-    if headline is not None:
-        emit(headline)
-        return True
-    return False
+            headline = staled(biggest)
+            headline["metric"] = "gpt_train_tokens_per_sec_per_chip"
+    if headline is None:
+        return False
+    for metric in sorted(cache):
+        if metric != "gpt_train_tokens_per_sec_per_chip":
+            emit(staled(metric))
+    emit(headline)
+    return True
 
 
 def emit(obj: dict) -> None:

@@ -82,6 +82,13 @@ def tdm_sampler(x, neg_samples_num_list, layer_node_num_list, leaf_node_num,
         n_neg = int(neg_samples_num_list[li])
         width = n_neg + (1 if output_positive else 0)
         nodes = layer_flat[starts[li]:starts[li + 1]]
+        if n_neg >= len(nodes):
+            # reference UniformSampler contract: neg_samples_num must be
+            # strictly less than the layer's node count (the positive is
+            # excluded from the pool), else the op errors out
+            raise ValueError(
+                f"tdm_sampler: neg_samples_num_list[{li}]={n_neg} must be < "
+                f"layer_node_num_list[{li}]={len(nodes)}")
         o = np.zeros((len(xv), width), np.int64)
         lab = np.zeros((len(xv), width), np.int64)
         msk = np.ones((len(xv), width), np.int64)
@@ -99,13 +106,12 @@ def tdm_sampler(x, neg_samples_num_list, layer_node_num_list, leaf_node_num,
                 o[bi, 0] = pos
                 lab[bi, 0] = 1
                 col = 1
+            # with-replacement draw excluding only the positive, matching
+            # the reference UniformSampler distribution
             pool = nodes[nodes != pos]
-            take = min(n_neg, len(pool))
-            if take:
-                o[bi, col:col + take] = rng.choice(pool, size=take,
-                                                   replace=False)
-            if take < n_neg:
-                msk[bi, col + take:] = 0
+            if n_neg:
+                o[bi, col:col + n_neg] = rng.choice(pool, size=n_neg,
+                                                    replace=True)
         out_layers.append(o)
         lab_layers.append(lab)
         mask_layers.append(msk)

@@ -104,7 +104,7 @@ class StaticFunction:
         warnings.warn(
             f"paddle_tpu.jit.to_static: graph break in '{name}' — falling "
             f"back to eager for this input signature. Breaking construct: "
-            f"{type(err).__name__}: {str(err).splitlines()[0][:200]}",
+            f"{type(err).__name__}: {(str(err).splitlines() or [''])[0][:200]}",
             RuntimeWarning, stacklevel=4)
         self._eager_sigs.add(sig)
         self._cache.pop(sig, None)

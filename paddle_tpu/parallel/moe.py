@@ -34,7 +34,7 @@ def _switch_moe(x, gate_w, w1, b1, w2, b2, capacity_factor=1.25,
     c = max(int(capacity_factor * s / e), 1)
 
     logits = jnp.matmul(x.astype(jnp.float32), gate_w.astype(jnp.float32))
-    probs = jnp.exp(logits - jnp.log(jnp.sum(jnp.exp(logits), -1, keepdims=True)))
+    probs = _stable_softmax(logits)
     expert_idx = jnp.argmax(probs, axis=-1)                     # [s]
     expert_prob = jnp.max(probs, axis=-1)                       # [s]
     onehot = jnp.eye(e, dtype=jnp.float32)[expert_idx]          # [s, e]
@@ -58,6 +58,15 @@ def _switch_moe(x, gate_w, w1, b1, w2, b2, capacity_factor=1.25,
     frac_probs = jnp.mean(probs, axis=0)
     aux = e * jnp.sum(frac_tokens * frac_probs)
     return y, aux.astype(x.dtype)
+
+
+def _stable_softmax(logits):
+    """Max-subtracted softmax: fp32 gate logits past ~88 overflow a bare
+    exp() to inf and poison routing with NaNs (reference gates normalize the
+    same way)."""
+    import jax
+
+    return jax.nn.softmax(logits, axis=-1)
 
 
 def _act(h, name):
@@ -91,8 +100,7 @@ def _gshard_moe(x, gate_w, w1, b1, w2, b2, capacity_factor=1.25,
         import jax
 
         logits = logits + jax.random.normal(key, logits.shape) * jitter
-    probs = jnp.exp(logits - jnp.log(jnp.sum(jnp.exp(logits), -1,
-                                             keepdims=True)))
+    probs = _stable_softmax(logits)
     idx1 = jnp.argmax(probs, axis=-1)                           # [s]
     p1 = jnp.max(probs, axis=-1)
     oh1 = jnp.eye(e, dtype=jnp.float32)[idx1]                   # [s, e]
@@ -148,10 +156,15 @@ def _naive_moe(x, gate_w, w1, b1, w2, b2, top_k=2, activation="gelu"):
     e = gate_w.shape[1]
     top_k = min(max(int(top_k), 1), e)
     logits = jnp.matmul(x.astype(jnp.float32), gate_w.astype(jnp.float32))
-    probs = jnp.exp(logits - jnp.log(jnp.sum(jnp.exp(logits), -1,
-                                             keepdims=True)))
-    kth = jnp.sort(probs, axis=-1)[:, e - top_k][:, None]
-    w = jnp.where(probs >= kth, probs, 0.0)
+    probs = _stable_softmax(logits)
+    # select exactly top_k experts by index (a >=kth threshold would route
+    # tie-at-kth tokens to more than top_k experts with diluted weights)
+    import jax
+
+    _, top_idx = jax.lax.top_k(probs, top_k)                    # [s, k]
+    sel = jnp.zeros_like(probs).at[
+        jnp.arange(probs.shape[0])[:, None], top_idx].set(1.0)  # [s, e]
+    w = probs * sel
     w = w / jnp.maximum(w.sum(-1, keepdims=True), 1e-9)         # [s, e]
     h = jnp.einsum("sd,edf->esf", x, w1) + b1[:, None, :]
     h = _act(h, activation)

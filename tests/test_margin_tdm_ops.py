@@ -159,9 +159,11 @@ def test_tdm_sampler_padding_layer_zeroes_whole_row():
 
     travel = np.array([[1, 0], [1, 4]], np.int32)   # leaf 0: layer-2 pad
     layers = np.array([1, 2, 3, 4, 5, 6], np.int32)
+    # n_neg must be < layer node count (reference ENFORCE_LE sample_num,
+    # node_nums-1 — tdm_sampler_kernel.cc:119), so layer 0 (2 nodes) gets 1
     out, lab, msk = tdm_sampler(
         paddle.to_tensor(np.array([[0], [1]], np.int32)),
-        [2, 2], [2, 4], 2, travel_list=travel, layer_list=layers,
+        [1, 2], [2, 4], 2, travel_list=travel, layer_list=layers,
         output_list=True, seed=1)
     o1, l1, m1 = A(out[1]), A(lab[1]), A(msk[1])
     assert (o1[0] == 0).all() and (l1[0] == 0).all() and (m1[0] == 0).all()
