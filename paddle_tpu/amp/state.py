@@ -16,6 +16,7 @@ import numpy as np
 WHITE_LIST = {
     "matmul", "bmm", "mv", "addmm", "linear", "conv2d", "conv1d",
     "conv2d_transpose", "einsum", "scaled_dot_product_attention",
+    "flash_attn_unpadded", "flashmask_attention",
 }
 
 # Ops that must run in fp32 (reductions / exp-family, loss ops).

@@ -89,6 +89,40 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen packed flash attention. Reference:
+    python/paddle/nn/functional/flash_attention.py:756. q/k/v are
+    [total_tokens, heads, head_dim]; cu_seqlens_* mark sequence boundaries.
+    Lowers onto segment-id masking in the Pallas kernel (O(total) memory,
+    no dense mask). Returns (out, softmax) like the reference; softmax is
+    never materialized on the flash path, so the second element is None."""
+    out = _C.flash_attn_unpadded(
+        query, key, value, cu_seqlens_q, cu_seqlens_k,
+        max_seqlen_q=int(max_seqlen_q), max_seqlen_k=int(max_seqlen_k),
+        scale=float(scale), dropout=dropout, causal=causal)
+    return out, None
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None, *,
+                        dropout=0.0, causal=False, window_size=None,
+                        return_softmax_lse=False, return_seed_offset=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """FlashMask attention (column-sparse row-range masks). Reference:
+    python/paddle/nn/functional/flash_attention.py:1299."""
+    if return_softmax_lse or return_seed_offset:
+        raise NotImplementedError(
+            "return_softmax_lse/return_seed_offset are not exposed by the "
+            "TPU flash kernel")
+    return _C.flashmask_attention(query, key, value, startend_row_indices,
+                                  dropout=dropout, causal=causal,
+                                  window_size=window_size)
+
+
 def sequence_mask(lengths, maxlen=None, dtype="int64"):
     import jax.numpy as jnp
 

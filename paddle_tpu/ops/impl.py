@@ -1265,22 +1265,35 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     flash_attention (python/paddle/nn/functional/flash_attention.py:358).
 
     Layout [batch, seq, heads, head_dim] (paddle flash-attn convention).
-    Computed at fp32 accumulation. When the shapes tile (d % 128 == 0,
-    seq % 128 == 0) and no mask/dropout is requested, dispatches to the
-    Pallas flash kernel (paddle_tpu/ops/pallas/flash_attention.py).
+    Computed at fp32 accumulation. When the shapes tile (d % 8 == 0,
+    seq % 128 == 0) and no dropout is requested, dispatches to the Pallas
+    flash kernel (paddle_tpu/ops/pallas/flash_attention.py) — including
+    masked attention: broadcastable attn_masks ([b,1,1,sk] padding form,
+    [b,1|h,sq,sk] dense form, bool or additive) are streamed tile-wise into
+    the kernel, so ERNIE-style padded pretraining takes the flash path.
     """
     b, sq, h, d = q.shape
+    sk = k.shape[1]
     scale = scale if scale is not None else (1.0 / math.sqrt(d))
 
     # flags are part of the per-op jit cache key (registry flags_version),
     # so this read is re-evaluated after any set_flags. TPU-only: on other
     # backends the interpret-mode kernel would be slower than the XLA path.
-    if (attn_mask is None and dropout_p == 0.0 and _flash_enabled()):
+    if dropout_p == 0.0 and _flash_enabled():
         from paddle_tpu.ops.pallas.flash_attention import (
             _block_shapes_ok, flash_attention)
 
-        if _block_shapes_ok(q, k, 128, 128, v=v):
-            return flash_attention(q, k, v, causal=is_causal, scale=scale)
+        mask_ok = attn_mask is None
+        if attn_mask is not None:
+            # shape-only classification (no value inspection — this runs
+            # under tracing): any mask broadcastable to [b, 1|h, sq, sk]
+            ms = tuple(attn_mask.shape)
+            mask_ok = (len(ms) == 4 and ms[0] in (1, b)
+                       and ms[1] in (1, h) and ms[2] in (1, sq)
+                       and ms[3] in (1, sk))
+        if mask_ok and _block_shapes_ok(q, k, 128, 128, v=v):
+            return flash_attention(q, k, v, causal=is_causal, scale=scale,
+                                   mask=attn_mask)
     qT = jnp.swapaxes(q, 1, 2)  # b h s d
     kT = jnp.swapaxes(k, 1, 2)
     vT = jnp.swapaxes(v, 1, 2)
@@ -1295,8 +1308,121 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
         else:
             scores = scores + attn_mask.astype(scores.dtype)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if is_causal or attn_mask is not None:
+        # fully-hard-masked rows output exactly 0 (not a uniform average) —
+        # same semantics as the Pallas kernel's masked-row guard, so the
+        # result does not depend on which path dispatch picks
+        row_live = jnp.any(scores > -5e29, axis=-1, keepdims=True)
+        probs = jnp.where(row_live, probs, 0.0)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vT)
     return jnp.swapaxes(out, 1, 2)
+
+
+def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q=None, max_seqlen_k=None, scale=None,
+                        dropout=0.0, causal=False):
+    """Varlen (packed/unpadded) flash attention. Reference:
+    python/paddle/nn/functional/flash_attention.py:756 (flash_attn_unpadded
+    over the varlen CUDA kernel, phi/kernels/gpu/flash_attn_kernel.cu).
+
+    q/k/v: [total_tokens, heads, head_dim] — multiple sequences packed along
+    dim 0; cu_seqlens_*: int32 [b+1] cumulative boundaries. TPU design: the
+    boundaries lower onto per-token segment ids (searchsorted over the
+    traced boundary values — O(total) memory, no dense mask), and the
+    Pallas kernel masks where q_seg != k_seg. With `causal`, global causal
+    ∧ same-segment equals per-sequence causal when q and k share a packing
+    (the standard use). Tokens are padded to the 128-tile and sliced back.
+    """
+    tq, h, d = q.shape
+    tk = k.shape[0]
+    scale = scale if scale is not None else (1.0 / math.sqrt(d))
+    if dropout:
+        raise NotImplementedError(
+            "flash_attn_unpadded: attention dropout is not implemented in "
+            "the TPU flash kernel (reference applies it in-kernel); train "
+            "with dropout=0.0")
+    if causal and tq != tk:
+        raise ValueError(
+            "flash_attn_unpadded(causal=True) requires q and k to share a "
+            f"packing (got {tq} vs {tk} total tokens): global causal over "
+            "mismatched packings is not per-sequence causal")
+
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    pad_q = (-tq) % 128
+    pad_k = (-tk) % 128
+    # padded positions land past cu_seqlens[-1] -> searchsorted gives b+1,
+    # a segment no real token carries, so pads only ever attend pads
+    seg_q = jnp.searchsorted(cu_seqlens_q.astype(jnp.int32),
+                             jnp.arange(tq + pad_q, dtype=jnp.int32),
+                             side="right").astype(jnp.int32)
+    seg_k = jnp.searchsorted(cu_seqlens_k.astype(jnp.int32),
+                             jnp.arange(tk + pad_k, dtype=jnp.int32),
+                             side="right").astype(jnp.int32)
+    pad3 = lambda t, p: jnp.pad(t, ((0, p), (0, 0), (0, 0)))
+    out = flash_attention(
+        pad3(q, pad_q)[None], pad3(k, pad_k)[None], pad3(v, pad_k)[None],
+        causal=causal, scale=scale,
+        segment_ids=(seg_q[None], seg_k[None]))
+    return out[0, :tq]
+
+
+def flashmask_attention(q, k, v, startend_row_indices=None, dropout=0.0,
+                        causal=False, window_size=None):
+    """FlashMask column-sparse attention masks. Reference:
+    python/paddle/nn/functional/flash_attention.py:1299.
+
+    startend_row_indices: int32 [b, 1|h, sk, {1,2,4}] per-key-column row
+    ranges (LTS / LTS,LTE / LTS,UTE / LTS,LTE,UTS,UTE — see reference
+    docstring). TPU lowering: the ranges expand to an additive bias that
+    the Pallas kernel STREAMS tile-by-tile (the score matrix still never
+    materializes; a natively column-sparse Pallas variant is future work,
+    so memory is O(s^2) for the bias where the CUDA kernel is O(s)).
+    window_size composes as in the reference (sliding-window attention)."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    if dropout:
+        raise NotImplementedError(
+            "flashmask_attention: attention dropout is not implemented in "
+            "the TPU flash kernel; train with dropout=0.0")
+
+    from paddle_tpu.ops.pallas.flash_attention import (NEG_INF,
+                                                       flash_attention)
+
+    if startend_row_indices is None and window_size is None:
+        # plain (causal) attention — keep the maskless fast path
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    i = jnp.arange(sq)[None, None, :, None]     # query row
+    j = jnp.arange(sk)[None, None, None, :]     # key column
+    masked = jnp.zeros((1, 1, sq, sk), bool)
+    if startend_row_indices is not None:
+        idx = startend_row_indices.astype(jnp.int32)   # [b, kh, sk, n]
+        n = idx.shape[-1]
+        col = lambda c: idx[..., c][:, :, None, :]     # [b, kh, 1, sk]
+        if causal:
+            lts = col(0)
+            lte = col(1) if n >= 2 else jnp.full_like(lts, sq)
+            masked = (i >= lts) & (i < lte)
+        elif n == 2:
+            lts, ute = col(0), col(1)
+            masked = ((i > j) & (i >= lts)) | ((i < j) & (i < ute))
+        elif n == 4:
+            lts, lte, uts, ute = col(0), col(1), col(2), col(3)
+            masked = (((i > j) & (i >= lts) & (i < lte))
+                      | ((i < j) & (i >= uts) & (i < ute)))
+        else:
+            raise ValueError(
+                f"startend_row_indices last dim {n} invalid for "
+                f"causal={causal}")
+    if window_size is not None:
+        w = ((window_size, window_size) if isinstance(window_size, int)
+             else tuple(window_size))
+        outside = (j < i - w[0]) if causal else ((j < i - w[0])
+                                                | (j > i + w[1]))
+        masked = masked | outside
+    mask = jnp.where(masked, NEG_INF, 0.0).astype(jnp.float32)
+    return flash_attention(q, k, v, causal=causal, scale=scale, mask=mask)
 
 
 def rotary_embedding(q, k, cos, sin, position_ids=None):
