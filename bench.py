@@ -266,6 +266,44 @@ def child_ernie(layers: int, hidden: int, batch: int, seq: int, vocab: int,
                     layers=layers, hidden=hidden, batch=batch, seq=seq)
 
 
+def child_decode(layers: int, hidden: int, batch: int, prompt: int,
+                 gen: int, vocab: int):
+    """Serving rung: paged-KV greedy decode throughput + first-token
+    latency (the Pallas paged-decode kernel path; VERDICT r3 Weak #10)."""
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    import paddle_tpu as paddle
+    from paddle_tpu.models.generation import PagedGPTGenerator
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+
+    backend = jax.default_backend()
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+                    num_heads=max(hidden // 64, 1),
+                    max_seq_len=prompt + gen, dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+    g = PagedGPTGenerator(model)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, vocab, (batch, prompt)))
+    t0 = time.time()
+    out = g.generate(ids, max_new_tokens=gen, temperature=0.0)
+    _ = np.asarray(out._value)  # host readback = fence over the tunnel
+    compile_s = time.time() - t0
+    t0 = time.time()
+    out = g.generate(ids, max_new_tokens=gen, temperature=0.0)
+    _ = np.asarray(out._value)
+    dt = time.time() - t0
+    toks = batch * gen
+    _write_child({"backend": backend, "tokens_per_sec": toks / dt,
+                  "decode_ms_per_token": dt / gen * 1000,
+                  "compile_s": compile_s, "layers": layers,
+                  "hidden": hidden, "batch": batch, "prompt": prompt,
+                  "gen": gen})
+
+
 def _write_child(obj: dict) -> None:
     with open(os.environ["BENCH_CHILD_OUT"], "w") as f:
         json.dump(obj, f)
@@ -369,6 +407,22 @@ def main():
             log(f"ernie rung: {r['tokens_per_sec']:.0f} tok/s, "
                 f"mfu={r['mfu']:.3f}")
 
+    # paged-decode serving rung (secondary line; headline stays training)
+    if on_tpu and remaining() > 120:
+        r = run_child("decode:12:768:8:256:128:32768", min(600, remaining()))
+        if r is not None:
+            line = {"metric": "gpt124m_paged_decode_tokens_per_sec",
+                    "value": round(r["tokens_per_sec"], 1),
+                    "unit": "tokens/s", "vs_baseline": 0.0,
+                    "decode_ms_per_token": round(
+                        r["decode_ms_per_token"], 2),
+                    "backend": r["backend"],
+                    "compile_s": round(r["compile_s"], 1)}
+            emit(line)
+            _cache_result(line)
+            log(f"decode rung: {r['tokens_per_sec']:.0f} tok/s, "
+                f"{r['decode_ms_per_token']:.1f} ms/token")
+
     if best is not None:
         # headline repeated last: drivers that parse the final stdout JSON
         # line get the largest completed config
@@ -401,6 +455,8 @@ if __name__ == "__main__":
             child_rung(*[int(x) for x in mode.split(":")[1:]])
         elif mode.startswith("ernie:"):
             child_ernie(*[int(x) for x in mode.split(":")[1:]])
+        elif mode.startswith("decode:"):
+            child_decode(*[int(x) for x in mode.split(":")[1:]])
         else:
             raise SystemExit(f"unknown child mode {mode}")
     else:
