@@ -205,20 +205,23 @@ def build_pipeline_train_step(cfg: GPTConfig, mesh: Mesh, num_micro: int = 4,
     the shard_map body.
 
     schedule: 'gpipe' (fwd scan + autodiff), 'interleave' (VPP, v chunks per
-    device, ~v-fold bubble cut), or '1f1b' (fused fwd+bwd, O(pp) activation
-    stash) — parallel/pipeline_schedules.py; reference
-    fleet/meta_parallel/pipeline_parallel.py:684,1308.
+    device, ~v-fold bubble cut), '1f1b' (fused fwd+bwd, O(pp) activation
+    stash), or 'zbh1' (zero-bubble H1: B/W backward split, 1/3 less bubble
+    than 1F1B at the same stash) — parallel/pipeline_schedules.py;
+    reference fleet/meta_parallel/pipeline_parallel.py:684,1308 and
+    passes/pipeline_scheduler_pass/pipeline_zero_bubble.py.
     """
     from paddle_tpu.jit.functionalize import functionalize
     from paddle_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
     from paddle_tpu.parallel.pipeline_schedules import (
         interleave_permutation, pipeline_1f1b, pipeline_apply_interleave,
+        pipeline_zbh1,
     )
 
-    if schedule not in ("gpipe", "1f1b", "interleave"):
+    if schedule not in ("gpipe", "1f1b", "interleave", "zbh1"):
         raise ValueError(
             f"unknown pipeline schedule {schedule!r}: "
-            "expected 'gpipe', '1f1b', or 'interleave'")
+            "expected 'gpipe', '1f1b', 'interleave', or 'zbh1'")
     npp = mesh.shape["pp"]
     assert cfg.num_layers % npp == 0
     group = 1
@@ -319,12 +322,13 @@ def build_pipeline_train_step(cfg: GPTConfig, mesh: Mesh, num_micro: int = 4,
                                num_micro=num_micro)
         return head_loss(outer_p, y, labels)
 
-    def grads_1f1b(outer_p, stacked_p, tokens, labels):
-        """Fused-schedule path: pipeline_1f1b returns grads directly; the
-        embedding closes the loop through an explicit vjp on dx, and the
-        tied head/ln_f grads add to the embedding's."""
+    def grads_fused(outer_p, stacked_p, tokens, labels):
+        """Fused-schedule path (1f1b / zbh1): the pipeline returns grads
+        directly; the embedding closes the loop through an explicit vjp on
+        dx, and the tied head/ln_f grads add to the embedding's."""
+        pipe = pipeline_zbh1 if schedule == "zbh1" else pipeline_1f1b
         x, emb_vjp = jax.vjp(lambda op: embed(op, tokens), outer_p)
-        loss, g_stacked, g_head, dx = pipeline_1f1b(
+        loss, g_stacked, g_head, dx = pipe(
             stage_fn, stacked_p, x, labels, head_loss, outer_p, mesh,
             num_micro=num_micro)
         g_emb = emb_vjp(dx)[0]
@@ -333,8 +337,8 @@ def build_pipeline_train_step(cfg: GPTConfig, mesh: Mesh, num_micro: int = 4,
 
     def step(state, tokens, labels):
         outer_p, stacked_p = state
-        if schedule == "1f1b":
-            loss, grads = grads_1f1b(outer_p, stacked_p, tokens, labels)
+        if schedule in ("1f1b", "zbh1"):
+            loss, grads = grads_fused(outer_p, stacked_p, tokens, labels)
         else:
             loss, grads = jax.value_and_grad(fwd, argnums=(0, 1))(
                 outer_p, stacked_p, tokens, labels)

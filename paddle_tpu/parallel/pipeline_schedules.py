@@ -144,6 +144,148 @@ def simulate_1f1b(pp: int, m: int) -> Schedule:
     return Schedule(tables, T, 2 * m * pp, 2 * T * pp, S, 1)
 
 
+def simulate_zbh1(pp: int, m: int) -> Schedule:
+    """Zero-bubble H1 schedule (reference
+    distributed/passes/pipeline_scheduler_pass/pipeline_zero_bubble.py,
+    after Qi et al., "Zero Bubble Pipeline Parallelism").
+
+    Backward splits into B (input-grad dL/dx — the inter-stage critical
+    path) and W (weight-grad dL/dw — device-local, deferrable). One op per
+    device per tick; greedy priorities B > F > W with two memory caps that
+    force the paper's uniform-cost timeline:
+
+      * pipeline-depth cap: F may run ahead of B by < pp - d micro-batches
+        (the 1F1B warmup profile);
+      * stash cap: activations alive F->W stay < 2*(pp-d) - 1 (exactly the
+        1F1B per-device stash), so deferring W never costs extra memory.
+
+    Steady state per device is the f,B,W cycle of the ZB-H1 figure; the
+    bubble drops to 2*(pp-1) ticks/device vs 1F1B's 3*(pp-1) at equal
+    activation memory (uniform op costs; schedule_stats pins both).
+
+    Tables (all [T, pp] int32): op (0 idle / 1 F / 2 B / 3 W), f_mb /
+    f_from_x / f_rd / f_st, b_mb / b_rd_h / b_rd_g / b_st_g, w_rd_h /
+    w_rd_g, and the arrival writes h_wr_valid/h_wr_slot (activations from
+    d-1) + g_wr_valid/g_wr_slot (grads from d+1)."""
+    f_end: dict = {}
+    b_end: dict = {}
+    w_end: dict = {}
+    # slot state per device: free lists + high-water marks
+    harr_free = [[] for _ in range(pp)]
+    harr_max = [0] * pp
+    hst_free = [[] for _ in range(pp)]
+    hst_max = [0] * pp
+    garr_free = [[] for _ in range(pp)]
+    garr_max = [0] * pp
+    gst_free = [[] for _ in range(pp)]
+    gst_max = [0] * pp
+    harr_slot: dict = {}    # (d, i) -> h arrival slot on device d
+    hst_slot: dict = {}     # (d, i) -> stashed stage-input slot
+    garr_slot: dict = {}    # (d, i) -> grad arrival slot
+    gst_slot: dict = {}     # (d, i) -> stashed output-grad slot
+    # payloads in flight: land at start of tick t+1
+    h_incoming: list = [None] * pp
+    g_incoming: list = [None] * pp
+
+    names = ("op", "f_mb", "f_from_x", "f_rd", "f_st", "b_mb", "b_rd_h",
+             "b_rd_g", "b_st_g", "w_rd_h", "w_rd_g", "h_wr_valid",
+             "h_wr_slot", "g_wr_valid", "g_wr_slot")
+    rows = {k: [] for k in names}
+
+    def alloc(free, mx, d):
+        if free[d]:
+            return free[d].pop(), mx
+        s = mx[d]
+        mx[d] += 1
+        return s, mx
+
+    t = 0
+    while len(w_end) < pp * m:
+        assert t < 10 * (3 * m + 3 * pp), "zbh1 schedule did not converge"
+        row = {k: [0] * pp for k in names}
+        # 1) arrivals land
+        new_h = [None] * pp
+        new_g = [None] * pp
+        for d in range(pp):
+            if h_incoming[d] is not None:
+                i = h_incoming[d]
+                s, _ = alloc(harr_free, harr_max, d)
+                harr_slot[(d, i)] = s
+                row["h_wr_valid"][d] = 1
+                row["h_wr_slot"][d] = s
+                h_incoming[d] = None
+            if g_incoming[d] is not None:
+                i = g_incoming[d]
+                s, _ = alloc(garr_free, garr_max, d)
+                garr_slot[(d, i)] = s
+                row["g_wr_valid"][d] = 1
+                row["g_wr_slot"][d] = s
+                g_incoming[d] = None
+        # 2) one op per device, priority B > F > W under the two caps
+        for d in range(pp):
+            fi = sum(1 for (dd, _) in f_end if dd == d)
+            bi = sum(1 for (dd, _) in b_end if dd == d)
+            wi = sum(1 for (dd, _) in w_end if dd == d)
+            # ---- B
+            if bi < m:
+                i = bi
+                grad_ready = (d == pp - 1) or (d, i) in garr_slot
+                if (d, i) in f_end and f_end[(d, i)] < t and grad_ready:
+                    b_end[(d, i)] = t
+                    row["op"][d] = 2
+                    row["b_mb"][d] = i
+                    row["b_rd_h"][d] = hst_slot[(d, i)]
+                    if d < pp - 1:
+                        s = garr_slot.pop((d, i))
+                        row["b_rd_g"][d] = s
+                        garr_free[d].append(s)
+                    s, _ = alloc(gst_free, gst_max, d)
+                    gst_slot[(d, i)] = s
+                    row["b_st_g"][d] = s
+                    if d > 0:
+                        g_incoming[d - 1] = i
+                    continue
+            # ---- F
+            if fi < m:
+                i = fi
+                arrived = (d == 0) or (d, i) in harr_slot
+                if (fi - bi < pp - d and fi - wi < 2 * (pp - d) - 1
+                        and arrived):
+                    f_end[(d, i)] = t
+                    row["op"][d] = 1
+                    row["f_mb"][d] = i
+                    if d == 0:
+                        row["f_from_x"][d] = 1
+                    else:
+                        s = harr_slot.pop((d, i))
+                        row["f_rd"][d] = s
+                        harr_free[d].append(s)
+                    s, _ = alloc(hst_free, hst_max, d)
+                    hst_slot[(d, i)] = s
+                    row["f_st"][d] = s
+                    if d < pp - 1:
+                        h_incoming[d + 1] = i
+                    continue
+            # ---- W
+            if wi < bi:
+                i = wi
+                if b_end[(d, i)] < t:
+                    w_end[(d, i)] = t
+                    row["op"][d] = 3
+                    row["w_rd_h"][d] = hst_slot.pop((d, i))
+                    hst_free[d].append(row["w_rd_h"][d])
+                    row["w_rd_g"][d] = gst_slot.pop((d, i))
+                    gst_free[d].append(row["w_rd_g"][d])
+        for k in names:
+            rows[k].append(row[k])
+        t += 1
+    tables = {k: np.asarray(v, np.int32) for k, v in rows.items()}
+    tables["_sizes"] = np.asarray(
+        [max(harr_max) or 1, max(hst_max) or 1, max(garr_max) or 1,
+         max(gst_max) or 1], np.int32)
+    return Schedule(tables, t, 3 * m * pp, t * pp, max(hst_max), 1)
+
+
 def schedule_stats(pp: int, m: int, schedule: str = "gpipe", v: int = 1):
     """Step-count accounting used by the bubble tests: slots are uniform
     stage-compute units; bubble = idle fraction of the fwd+bwd timeline."""
@@ -166,6 +308,13 @@ def schedule_stats(pp: int, m: int, schedule: str = "gpipe", v: int = 1):
         sim = simulate_1f1b(pp, m)
         return {"total_ticks": sim.total_ticks,
                 "bubble": 1 - m / sim.total_ticks,
+                "stash_micro_batches": sim.stash_size}
+    if schedule == "zbh1":
+        sim = simulate_zbh1(pp, m)
+        # single-op ticks: busy = 3m of T per device
+        return {"total_ticks": sim.total_ticks,
+                "bubble": 1 - 3 * m / sim.total_ticks,
+                "bubble_ticks_per_device": sim.total_ticks - 3 * m,
                 "stash_micro_batches": sim.stash_size}
     raise ValueError(f"unknown schedule {schedule!r}")
 
@@ -406,6 +555,191 @@ def pipeline_1f1b(stage_fn: Callable[[Any, Any], Any], stacked_params,
             tick, init, tab)
         # replicate the cross-device results: loss/ghead live on the last
         # device, dx on the first — psum of masked values replicates them
+        last_mask = jnp.where(is_last, 1.0, 0.0)
+        first_mask = jnp.where(is_first, 1.0, 0.0)
+        loss = lax.psum(loss_acc * last_mask, "pp")
+        ghead = jax.tree_util.tree_map(
+            lambda g: lax.psum(g * last_mask, "pp"), ghead)
+        dx = lax.psum(dx_buf * first_mask, "pp")
+        return loss, gparams, ghead, dx
+
+    mapped = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), stacked_params),
+                  jax.tree_util.tree_map(lambda _: P(), head_params),
+                  P(), P()),
+        out_specs=(P(),
+                   jax.tree_util.tree_map(lambda _: P("pp"), stacked_params),
+                   jax.tree_util.tree_map(lambda _: P(), head_params),
+                   P()),
+        axis_names=frozenset({"pp"}),
+    )
+    return mapped(stacked_params, head_params, x_micro, labels_micro)
+
+
+# ------------------------------------------------------------- zero-bubble H1
+
+def pipeline_zbh1(stage_fn: Callable[[Any, Any], Any], stacked_params,
+                  x_micro, labels_micro,
+                  head_fn: Callable[[Any, Any, Any], Any], head_params,
+                  mesh: Mesh, num_micro: int | None = None):
+    """Fused pipeline step with the ZB-H1 zero-bubble schedule (reference
+    distributed/passes/pipeline_scheduler_pass/pipeline_zero_bubble.py).
+
+    Same contract as pipeline_1f1b: returns (mean_loss, grads_stacked,
+    grads_head, dx_micro) and is NOT differentiable (it IS the backward).
+
+    Backward is split at the vjp level: the B op computes only dL/dx
+    (jax.vjp w.r.t. the stage input — the inter-device critical path; its
+    output-grad cotangent is stashed), and the W op computes dL/dw later
+    from the stashed (input, cotangent) pair, filling what 1F1B leaves as
+    bubble. Each of B and W re-linearizes the stage from the stashed
+    input (one recompute each — the fused-schedule analogue of
+    recompute-everything 1F1B, which pays one; the extra forward is the
+    price of O(1) inter-op state, and the schedule's 1/3 bubble reduction
+    is the win when pp is deep). One op runs per tick via lax.switch with
+    a device-varying index — real branching, so a tick costs its op, not
+    the sum of all three."""
+    npp = mesh.shape["pp"]
+    if num_micro is None:
+        num_micro = x_micro.shape[0]
+    m = num_micro
+    total_stages = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    assert total_stages % npp == 0
+    sim = simulate_zbh1(npp, m)
+    sizes = sim.tables["_sizes"]
+    n_harr, n_hst, n_garr, n_gst = (int(x) for x in sizes)
+    tab = {k: jnp.asarray(val) for k, val in sim.tables.items()
+           if k != "_sizes"}
+    fwd_perm = [(i, (i + 1) % npp) for i in range(npp)]
+    bwd_perm = [(i, (i - 1) % npp) for i in range(npp)]
+
+    def per_device(params_local, head_p, x, labels):
+        d = lax.axis_index("pp")
+        is_first = d == 0
+        is_last = d == npp - 1
+        head_p = jax.tree_util.tree_map(_varying, head_p)  # see 1f1b note
+        mb_shape = x.shape[1:]
+        z = jnp.zeros(mb_shape, x.dtype)
+
+        def dev_fn(pl, h):
+            return chain_stages(stage_fn, pl, h)
+
+        def tick(carry, trow):
+            (h_arr, h_st, g_arr, g_st, gparams, ghead, loss_acc, dx_buf,
+             h_in, g_in) = carry
+            # arrivals land first (payloads permuted last tick)
+            h_arr = jnp.where(
+                trow["h_wr_valid"][d] > 0,
+                lax.dynamic_update_index_in_dim(h_arr, h_in,
+                                                trow["h_wr_slot"][d], 0),
+                h_arr)
+            g_arr = jnp.where(
+                trow["g_wr_valid"][d] > 0,
+                lax.dynamic_update_index_in_dim(g_arr, g_in,
+                                                trow["g_wr_slot"][d], 0),
+                g_arr)
+
+            op = trow["op"][d]
+
+            def f_branch(c):
+                (h_arr, h_st, g_arr, g_st, gp, gh_, la, dxb) = c
+                mb = jnp.clip(trow["f_mb"][d], 0, m - 1)
+                h_x = lax.dynamic_index_in_dim(x, mb, 0, keepdims=False)
+                h_a = lax.dynamic_index_in_dim(h_arr, trow["f_rd"][d], 0,
+                                               keepdims=False)
+                h = jnp.where(trow["f_from_x"][d] > 0, _varying(h_x), h_a)
+                h_st = lax.dynamic_update_index_in_dim(
+                    h_st, h, trow["f_st"][d], 0)
+                y = dev_fn(params_local, h)
+                return (h_arr, h_st, g_arr, g_st, gp, gh_, la, dxb,
+                        y, jnp.zeros_like(y))
+
+            def b_branch(c):
+                (h_arr, h_st, g_arr, g_st, gp, gh_, la, dxb) = c
+                mb = jnp.clip(trow["b_mb"][d], 0, m - 1)
+                h_b = lax.dynamic_index_in_dim(h_st, trow["b_rd_h"][d], 0,
+                                               keepdims=False)
+                y_b, vjp_h = jax.vjp(lambda hh: dev_fn(params_local, hh),
+                                     h_b)
+                lbl = lax.dynamic_index_in_dim(labels, mb, 0,
+                                               keepdims=False)
+
+                def head_branch(op_):
+                    hp, yy, ll = op_
+                    loss_i, (ghp, gyl) = jax.value_and_grad(
+                        lambda hp_, yy_: head_fn(hp_, yy_, ll),
+                        argnums=(0, 1))(hp, yy)
+                    return loss_i / m, jax.tree_util.tree_map(
+                        lambda g: g / m, ghp), gyl / m
+
+                def skip_branch(op_):
+                    hp, yy, _ = op_
+                    return (_varying(jnp.zeros((), jnp.float32)),
+                            jax.tree_util.tree_map(
+                                lambda a: _varying(jnp.zeros_like(a)), hp),
+                            _varying(jnp.zeros_like(yy)))
+
+                loss_i, g_head_i, gy_last = lax.cond(
+                    is_last, head_branch, skip_branch, (head_p, y_b, lbl))
+                g_a = lax.dynamic_index_in_dim(g_arr, trow["b_rd_g"][d], 0,
+                                               keepdims=False)
+                gy = jnp.where(is_last, gy_last, g_a)
+                # stash the cotangent for this micro-batch's W op
+                g_st = lax.dynamic_update_index_in_dim(
+                    g_st, gy, trow["b_st_g"][d], 0)
+                (gh,) = vjp_h(gy)
+                gh_new = jax.tree_util.tree_map(jnp.add, gh_, g_head_i)
+                la = la + loss_i
+                dx_upd = lax.dynamic_update_index_in_dim(dxb, gh, mb, 0)
+                dxb = jnp.where(is_first, dx_upd, dxb)
+                return (h_arr, h_st, g_arr, g_st, gp, gh_new, la, dxb,
+                        jnp.zeros_like(gh), gh)
+
+            def w_branch(c):
+                (h_arr, h_st, g_arr, g_st, gp, gh_, la, dxb) = c
+                h_w = lax.dynamic_index_in_dim(h_st, trow["w_rd_h"][d], 0,
+                                               keepdims=False)
+                gy_w = lax.dynamic_index_in_dim(g_st, trow["w_rd_g"][d], 0,
+                                                keepdims=False)
+                _, vjp_p = jax.vjp(lambda pp_: dev_fn(pp_, h_w),
+                                   params_local)
+                (gp_i,) = vjp_p(gy_w)
+                gp = jax.tree_util.tree_map(jnp.add, gp, gp_i)
+                return (h_arr, h_st, g_arr, g_st, gp, gh_, la, dxb,
+                        _varying(z), _varying(z))
+
+            def idle_branch(c):
+                return c + (_varying(z), _varying(z))
+
+            (h_arr, h_st, g_arr, g_st, gparams, ghead, loss_acc, dx_buf,
+             y_send, gh_send) = lax.switch(
+                jnp.clip(op, 0, 3),
+                [idle_branch, f_branch, b_branch, w_branch],
+                (h_arr, h_st, g_arr, g_st, gparams, ghead, loss_acc,
+                 dx_buf))
+
+            h_in_next = lax.ppermute(y_send, "pp", fwd_perm)
+            g_in_next = lax.ppermute(gh_send, "pp", bwd_perm)
+            return (h_arr, h_st, g_arr, g_st, gparams, ghead, loss_acc,
+                    dx_buf, h_in_next, g_in_next), None
+
+        zeros_like_local = lambda tree: jax.tree_util.tree_map(
+            lambda a: _varying(jnp.zeros_like(a)), tree)
+        init = (
+            _varying(jnp.zeros((n_harr,) + mb_shape, x.dtype)),
+            _varying(jnp.zeros((n_hst,) + mb_shape, x.dtype)),
+            _varying(jnp.zeros((n_garr,) + mb_shape, x.dtype)),
+            _varying(jnp.zeros((n_gst,) + mb_shape, x.dtype)),
+            zeros_like_local(params_local),
+            zeros_like_local(head_p),
+            _varying(jnp.zeros((), jnp.float32)),
+            _varying(jnp.zeros((m,) + mb_shape, x.dtype)),
+            _varying(z),
+            _varying(z),
+        )
+        (_, _, _, _, gparams, ghead, loss_acc, dx_buf, _, _), _ = lax.scan(
+            tick, init, tab)
         last_mask = jnp.where(is_last, 1.0, 0.0)
         first_mask = jnp.where(is_first, 1.0, 0.0)
         loss = lax.psum(loss_acc * last_mask, "pp")

@@ -163,7 +163,7 @@ def test_1f1b_loss_and_grads_match_autodiff(mesh_pp4):
 
 # ----------------------------------------------------------- GPT end-to-end
 
-@pytest.mark.parametrize("schedule", ["1f1b", "interleave"])
+@pytest.mark.parametrize("schedule", ["1f1b", "interleave", "zbh1"])
 def test_gpt_pipeline_schedules_train(mesh_pp4, schedule):
     from paddle_tpu.models.gpt import GPTConfig, build_pipeline_train_step
 

@@ -1,0 +1,171 @@
+"""ZB-H1 zero-bubble pipeline schedule: simulator invariants, bubble
+accounting vs 1F1B, and grads == autodiff equivalence.
+
+Reference: distributed/passes/pipeline_scheduler_pass/
+pipeline_zero_bubble.py (ZBH1), after Qi et al. "Zero Bubble Pipeline
+Parallelism" (B/W backward split)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import parallel as dist
+from paddle_tpu.parallel.pipeline_schedules import (
+    pipeline_zbh1, schedule_stats, simulate_zbh1,
+)
+
+rng = np.random.default_rng(7)
+HID = 8
+
+
+@pytest.fixture
+def mesh_pp4():
+    mesh = dist.init_mesh({"dp": 2, "pp": 4})
+    yield mesh
+    dist.set_mesh(None)
+
+
+def _stage_params(n_stages):
+    return {
+        "w": jnp.asarray(rng.standard_normal((n_stages, HID, HID)) * 0.3,
+                         jnp.float32),
+        "b": jnp.asarray(rng.standard_normal((n_stages, HID)) * 0.1,
+                         jnp.float32),
+    }
+
+
+def _stage_fn(p, h):
+    return jnp.tanh(h @ p["w"] + p["b"])
+
+
+def _chain(stacked, x_micro):
+    def one(h):
+        for i in range(stacked["w"].shape[0]):
+            h = _stage_fn({"w": stacked["w"][i], "b": stacked["b"][i]}, h)
+        return h
+    return jax.vmap(one)(x_micro)
+
+
+# -------------------------------------------------------------- simulator
+
+@pytest.mark.parametrize("pp,m", [(2, 4), (3, 5), (4, 8), (4, 16), (8, 24)])
+def test_zbh1_simulator_invariants(pp, m):
+    """Dependencies respected, every op scheduled once, memory capped."""
+    sim = simulate_zbh1(pp, m)
+    op, f_mb, b_mb = (sim.tables[k] for k in ("op", "f_mb", "b_mb"))
+    f_end, b_end, w_end = {}, {}, {}
+    for t in range(sim.total_ticks):
+        for d in range(pp):
+            o = int(op[t, d])
+            if o == 1:
+                i = int(f_mb[t, d])
+                if d > 0:   # activation must have arrived (one-tick hop)
+                    assert f_end[(d - 1, i)] + 1 <= t, (t, d, i)
+                f_end[(d, i)] = t
+            elif o == 2:
+                i = int(b_mb[t, d])
+                assert f_end[(d, i)] < t
+                if d < pp - 1:
+                    assert b_end[(d + 1, i)] + 1 <= t
+                b_end[(d, i)] = t
+            elif o == 3:
+                # W runs strictly after its B; mbs complete in order
+                n_w = sum(1 for (dd, _) in w_end if dd == d)
+                assert b_end[(d, n_w)] < t
+                w_end[(d, n_w)] = t
+    assert len(f_end) == len(b_end) == len(w_end) == pp * m
+    # H1 memory: per-device activations alive F..W never exceed the 1F1B
+    # stash profile 2*(pp-d)-1
+    for d in range(pp):
+        alive = peak = 0
+        for t in range(sim.total_ticks):
+            if int(op[t, d]) == 1:
+                alive += 1
+            elif int(op[t, d]) == 3:
+                alive -= 1
+            peak = max(peak, alive)
+        assert peak <= 2 * (pp - d) - 1, (d, peak)
+
+
+def test_zbh1_bubble_below_1f1b():
+    """Uniform-op-cost accounting: ZB-H1 idles 2*(pp-1) single-op ticks
+    per device where serialized 1F1B idles 3*(pp-1) — a 1/3 bubble cut at
+    the same activation memory."""
+    for pp, m in [(4, 8), (4, 16), (8, 24)]:
+        zb = schedule_stats(pp, m, "zbh1")
+        assert zb["bubble_ticks_per_device"] == 2 * (pp - 1), (pp, m, zb)
+        # serialized 1F1B stream: 3m busy ticks + 3*(pp-1) idle
+        bubble_1f1b = 3 * (pp - 1) / (3 * m + 3 * (pp - 1))
+        assert zb["bubble"] < bubble_1f1b
+    # and the schedule grows with m only through busy ticks (steady state
+    # stays zero-bubble): T(m+k) - T(m) == 3k
+    t8 = schedule_stats(4, 8, "zbh1")["total_ticks"]
+    t16 = schedule_stats(4, 16, "zbh1")["total_ticks"]
+    assert t16 - t8 == 3 * 8
+
+
+# -------------------------------------------------------------- numerics
+
+def test_zbh1_loss_and_grads_match_autodiff(mesh_pp4):
+    mesh = dist.current_mesh()
+    m, b = 8, 2
+    stacked = _stage_params(4)
+    head_p = {"wh": jnp.asarray(rng.standard_normal((HID, HID)) * 0.3,
+                                jnp.float32)}
+    x = jnp.asarray(rng.standard_normal((m, b, HID)), jnp.float32)
+    labels = jnp.asarray(rng.standard_normal((m, b, HID)), jnp.float32)
+
+    def head_fn(hp, y, lbl):
+        return jnp.mean((y @ hp["wh"] - lbl) ** 2)
+
+    loss, g_stacked, g_head, dx = pipeline_zbh1(
+        _stage_fn, stacked, x, labels, head_fn, head_p, mesh)
+
+    def ref_loss(p, hp, xx):
+        y = _chain(p, xx)
+        return jnp.mean(jax.vmap(lambda yy, ll: head_fn(hp, yy, ll))(
+            y, labels))
+
+    ref, grads = jax.value_and_grad(ref_loss, argnums=(0, 1, 2))(
+        stacked, head_p, x)
+    gr_stacked, gr_head, gr_x = grads
+    np.testing.assert_allclose(float(loss), float(ref), atol=1e-5,
+                               rtol=1e-5)
+    for k in stacked:
+        np.testing.assert_allclose(np.asarray(g_stacked[k]),
+                                   np.asarray(gr_stacked[k]),
+                                   atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(g_head["wh"]),
+                               np.asarray(gr_head["wh"]),
+                               atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(gr_x),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_zbh1_multi_stage_per_device(mesh_pp4):
+    """8 stages on pp=4 (2 chained blocks per device)."""
+    mesh = dist.current_mesh()
+    m, b = 4, 2
+    stacked = _stage_params(8)
+    head_p = {"wh": jnp.asarray(np.eye(HID), jnp.float32)}
+    x = jnp.asarray(rng.standard_normal((m, b, HID)), jnp.float32)
+    labels = jnp.zeros((m, b, HID), jnp.float32)
+
+    def head_fn(hp, y, lbl):
+        return jnp.mean((y @ hp["wh"] - lbl) ** 2)
+
+    loss, g_stacked, _, _ = pipeline_zbh1(
+        _stage_fn, stacked, x, labels, head_fn, head_p, mesh)
+
+    def ref_loss(p):
+        y = _chain(p, x)
+        return jnp.mean((y @ head_p["wh"] - labels) ** 2)
+
+    ref, gr = jax.value_and_grad(ref_loss)(stacked)
+    np.testing.assert_allclose(float(loss), float(ref), atol=1e-5,
+                               rtol=1e-5)
+    for k in stacked:
+        np.testing.assert_allclose(np.asarray(g_stacked[k]),
+                                   np.asarray(gr[k]),
+                                   atol=1e-4, rtol=1e-4)
