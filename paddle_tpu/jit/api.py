@@ -13,6 +13,7 @@ step (paddle_tpu.parallel).
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -73,10 +74,18 @@ class StaticFunction:
     Graph breaks: with full_graph=False (the default, matching the
     reference to_static SOT mode) a function whose python control flow
     depends on tensor VALUES cannot trace; the first call detects the
-    concretization error, logs the break, and pins that input signature to
-    eager execution — the minimum-viable analogue of the reference's
-    bytecode-level eager fallback. full_graph=True raises instead (the
-    reference AST mode contract).
+    concretization error and — for Layers — switches that input signature
+    to STITCHED mode: every direct child layer gets its own StaticFunction
+    (recursively, so a break deep in one child only un-compiles that
+    child's own glue) while the breaking python between child calls
+    re-runs eagerly every call. A transformer whose forward logs
+    `loss.item()` keeps its block stack fully compiled; host-value control
+    flow re-evaluates each call, so branch flips stay correct — the
+    subgraph-stitching analogue of the reference SOT interpreter
+    (python/paddle/jit/sot/translate.py:37, opcode_executor.py:1880),
+    stitched at module rather than bytecode granularity. Plain functions
+    (no children to stitch) pin to eager. full_graph=True raises instead
+    (the reference AST mode contract).
     """
 
     def __init__(self, layer_or_fn, input_spec=None, build_strategy=None,
@@ -91,23 +100,85 @@ class StaticFunction:
         self._cache: Dict[Tuple, Any] = {}
         self._full_graph = full_graph
         self._eager_sigs: set = set()
+        self._stitched = False      # children wrapped in StaticFunctions
+        self._child_statics: list = []
 
     def _graph_break(self, sig, err) -> None:
         """Record a break for this callsite signature (or re-raise under
-        full_graph=True)."""
+        full_graph=True). Layers stitch their children; functions pin to
+        eager."""
         if self._full_graph:
             raise err
         import warnings
 
         name = getattr(self._fn or self._layer, "__name__",
                        type(self._fn or self._layer).__name__)
+        stitch = self._layer is not None and any(
+            True for _ in self._layer.children())
+        action = ("stitching: child layers stay compiled, the breaking "
+                  "python runs eagerly each call" if stitch else
+                  "falling back to eager for this input signature")
         warnings.warn(
-            f"paddle_tpu.jit.to_static: graph break in '{name}' — falling "
-            f"back to eager for this input signature. Breaking construct: "
-            f"{type(err).__name__}: {(str(err).splitlines() or [''])[0][:200]}",
+            f"paddle_tpu.jit.to_static: graph break in '{name}' — {action}."
+            f" Breaking construct: {type(err).__name__}: "
+            f"{(str(err).splitlines() or [''])[0][:200]}",
             RuntimeWarning, stacklevel=4)
         self._eager_sigs.add(sig)
         self._cache.pop(sig, None)
+        if stitch:
+            self._ensure_stitched()
+
+    def _ensure_stitched(self) -> None:
+        """Wrap every direct child layer's forward in its own
+        StaticFunction (idempotent). Containers without a forward of their
+        own (LayerList) are descended through so the real compute modules
+        get wrapped. A child that itself breaks recurses — only the glue
+        around ITS break loses compilation."""
+        if self._stitched:
+            return
+        self._stitched = True
+
+        def wrap(layer):
+            for _, child in layer.named_children():
+                if type(child).forward is Layer.forward:
+                    wrap(child)          # container: descend
+                    continue
+                sf = StaticFunction(child, full_graph=False)
+                self._child_statics.append(sf)
+                # instance attribute shadows the class method;
+                # Layer.__call__ (hooks included) still runs — only the
+                # forward body is compiled
+                child.forward = sf
+
+        wrap(self._layer)
+
+    def _installed(self) -> bool:
+        """Is this StaticFunction mounted as its layer's forward override
+        (stitched-child mode)?"""
+        return (self._layer is not None
+                and self._layer.__dict__.get("forward") is self)
+
+    @contextmanager
+    def _shadow_removed(self):
+        """Temporarily unmount the forward override so tracing/eager runs
+        reach the original forward instead of recursing into this
+        wrapper."""
+        if self._installed():
+            del self._layer.__dict__["forward"]
+            try:
+                yield
+            finally:
+                self._layer.__dict__["forward"] = self
+        else:
+            yield
+
+    def _eager_layer(self, *args, **kwargs):
+        """Run the layer eagerly. Mounted as a forward override,
+        Layer.__call__ (hooks) already ran — invoke the original forward
+        body directly; standalone, run the full layer."""
+        if self._installed():
+            return type(self._layer).forward(self._layer, *args, **kwargs)
+        return self._layer(*args, **kwargs)
 
     def __call__(self, *args, **kwargs):
         if self._fn is not None:
@@ -116,30 +187,37 @@ class StaticFunction:
         kw_items = tuple(sorted(kwargs.items()))
         sig = (_sig_of(args), training, _sig_of([v for _, v in kw_items]),
                tuple(k for k, _ in kw_items))
-        if sig in self._eager_sigs:
-            return self._layer(*args, **kwargs)
+        if self._stitched or sig in self._eager_sigs:
+            return self._eager_layer(*args, **kwargs)
         compiled = self._cache.get(sig)
         if compiled is None:
             f = self._func
+            # tensor-valued kwargs become traced inputs (closing over them
+            # would constant-fold the first call's values into the graph)
+            kw_static = {k: v for k, v in kwargs.items()
+                         if not isinstance(v, Tensor)}
 
-            def run(params, buffers, key, arg_vals):
+            def run(params, buffers, key, arg_vals, kw_vals):
                 return f.apply(params, buffers, key, training, *arg_vals,
-                               **kwargs)
+                               **{**kw_static, **kw_vals})
 
             compiled = jax.jit(run)
             self._cache[sig] = compiled
         arg_vals = jax.tree_util.tree_map(
             lambda v: v._value if isinstance(v, Tensor) else v, args,
             is_leaf=lambda v: isinstance(v, Tensor))
+        kw_vals = {k: v._value for k, v in kwargs.items()
+                   if isinstance(v, Tensor)}
         try:
-            out_values, new_buffers = compiled(
-                self._func.param_values(), self._func.buffer_values(),
-                default_generator.next_key(), arg_vals)
+            with self._shadow_removed():
+                out_values, new_buffers = compiled(
+                    self._func.param_values(), self._func.buffer_values(),
+                    default_generator.next_key(), arg_vals, kw_vals)
         except Exception as e:
             if not _is_graph_break(e):
                 raise
             self._graph_break(sig, e)
-            return self._layer(*args, **kwargs)
+            return self._eager_layer(*args, **kwargs)
         if self._layer.training:
             self._func.write_back(buffer_values=new_buffers)
         return jax.tree_util.tree_map(lambda v: Tensor._wrap(v), out_values)
@@ -153,14 +231,17 @@ class StaticFunction:
         compiled = self._cache.get(sig)
         if compiled is None:
             fn = self._fn
+            kw_static = {k: v for k, v in kwargs.items()
+                         if not isinstance(v, Tensor)}
 
-            def run(arg_vals):
+            def run(arg_vals, kw_vals):
                 from paddle_tpu.autograd.engine import no_grad
 
                 with no_grad():
                     wrapped = jax.tree_util.tree_map(
                         lambda v: Tensor._wrap(v), arg_vals)
-                    out = fn(*wrapped, **kwargs)
+                    kw_w = {k: Tensor._wrap(v) for k, v in kw_vals.items()}
+                    out = fn(*wrapped, **{**kw_static, **kw_w})
                 return jax.tree_util.tree_map(
                     lambda t: t._value if isinstance(t, Tensor) else t, out,
                     is_leaf=lambda t: isinstance(t, Tensor))
@@ -170,8 +251,10 @@ class StaticFunction:
         arg_vals = jax.tree_util.tree_map(
             lambda v: v._value if isinstance(v, Tensor) else v, args,
             is_leaf=lambda v: isinstance(v, Tensor))
+        kw_vals = {k: v._value for k, v in kwargs.items()
+                   if isinstance(v, Tensor)}
         try:
-            out = compiled(arg_vals)
+            out = compiled(arg_vals, kw_vals)
         except Exception as e:
             if not _is_graph_break(e):
                 raise
