@@ -35,9 +35,54 @@ def _sig_of(args) -> Tuple:
             out.append(("s", a))
         elif isinstance(a, (tuple, list)):
             out.append(("l", _sig_of(a)))
+        elif isinstance(a, dict):
+            out.append(("d", tuple(sorted(a)),
+                        _sig_of([a[k] for k in sorted(a)])))
         else:
             out.append(("o", type(a).__name__))
     return tuple(out)
+
+
+class _KwSlot:
+    """Placeholder for a Tensor extracted from a kwargs pytree."""
+
+    __slots__ = ("i",)
+
+    def __init__(self, i):
+        self.i = i
+
+
+def _split_kwargs(kwargs):
+    """Extract every Tensor (at any nesting depth) from kwargs into a flat
+    list, leaving _KwSlot placeholders — so tensor kwargs become traced jit
+    inputs instead of closure-captured constants, including inside
+    lists/dicts."""
+    tensors = []
+
+    def rec(o):
+        if isinstance(o, Tensor):
+            tensors.append(o)
+            return _KwSlot(len(tensors) - 1)
+        if isinstance(o, (list, tuple)):
+            return type(o)(rec(e) for e in o)
+        if isinstance(o, dict):
+            return {k: rec(v) for k, v in o.items()}
+        return o
+
+    return rec(dict(kwargs)), tensors
+
+
+def _fill_kwargs(tpl, vals):
+    def rec(o):
+        if isinstance(o, _KwSlot):
+            return vals[o.i]
+        if isinstance(o, (list, tuple)):
+            return type(o)(rec(e) for e in o)
+        if isinstance(o, dict):
+            return {k: rec(v) for k, v in o.items()}
+        return o
+
+    return rec(tpl)
 
 
 import jax.errors as _jerr
@@ -51,6 +96,11 @@ _GRAPH_BREAK_TYPES = tuple(
         "ConcretizationTypeError", "TracerBoolConversionError",
         "TracerArrayConversionError", "TracerIntegerConversionError",
         "NonConcreteBooleanIndexError")) if t is not None)
+
+
+# >0 while a stitched StaticFunction's eager glue is on the stack: mounted
+# child overrides compile inside it and stay on the eager tape outside it
+_STITCHED_RUN = [0]
 
 
 def _is_graph_break(err: Exception) -> bool:
@@ -83,9 +133,13 @@ class StaticFunction:
     flow re-evaluates each call, so branch flips stay correct — the
     subgraph-stitching analogue of the reference SOT interpreter
     (python/paddle/jit/sot/translate.py:37, opcode_executor.py:1880),
-    stitched at module rather than bytecode granularity. Plain functions
-    (no children to stitch) pin to eager. full_graph=True raises instead
-    (the reference AST mode contract).
+    stitched at module rather than bytecode granularity. Stitching is a
+    whole-StaticFunction switch (one break converts every signature — the
+    glue that broke once is assumed input-independent), and a mounted
+    child defers to the eager tape whenever gradients are being recorded,
+    so training-mode backward through a stitched model keeps working.
+    Plain functions (no children to stitch) pin to eager per signature.
+    full_graph=True raises instead (the reference AST mode contract).
     """
 
     def __init__(self, layer_or_fn, input_spec=None, build_strategy=None,
@@ -116,7 +170,8 @@ class StaticFunction:
         stitch = self._layer is not None and any(
             True for _ in self._layer.children())
         action = ("stitching: child layers stay compiled, the breaking "
-                  "python runs eagerly each call" if stitch else
+                  "python runs eagerly each call (all signatures)"
+                  if stitch else
                   "falling back to eager for this input signature")
         warnings.warn(
             f"paddle_tpu.jit.to_static: graph break in '{name}' — {action}."
@@ -124,9 +179,13 @@ class StaticFunction:
             f"{(str(err).splitlines() or [''])[0][:200]}",
             RuntimeWarning, stacklevel=4)
         self._eager_sigs.add(sig)
-        self._cache.pop(sig, None)
         if stitch:
+            # children carry compilation from here on; whole-graph entries
+            # (all signatures) are dead weight
+            self._cache.clear()
             self._ensure_stitched()
+        else:
+            self._cache.pop(sig, None)
 
     def _ensure_stitched(self) -> None:
         """Wrap every direct child layer's forward in its own
@@ -175,7 +234,18 @@ class StaticFunction:
     def _eager_layer(self, *args, **kwargs):
         """Run the layer eagerly. Mounted as a forward override,
         Layer.__call__ (hooks) already ran — invoke the original forward
-        body directly; standalone, run the full layer."""
+        body directly; standalone, run the full layer. A stitched parent's
+        glue marks the run so mounted children know the user opted into
+        compiled (to_static) semantics."""
+        if self._stitched:
+            _STITCHED_RUN[0] += 1
+            try:
+                if self._installed():
+                    return type(self._layer).forward(self._layer, *args,
+                                                     **kwargs)
+                return self._layer(*args, **kwargs)
+            finally:
+                _STITCHED_RUN[0] -= 1
         if self._installed():
             return type(self._layer).forward(self._layer, *args, **kwargs)
         return self._layer(*args, **kwargs)
@@ -183,6 +253,12 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if self._fn is not None:
             return self._call_fn(*args, **kwargs)
+        if self._installed() and not _STITCHED_RUN[0]:
+            # direct net(x) call outside any to_static invocation: the
+            # user did not opt into compiled semantics here — run on the
+            # eager tape (compiling would execute under no_grad and
+            # silently drop parameter grads in training)
+            return self._eager_layer(*args, **kwargs)
         training = self._layer.training
         kw_items = tuple(sorted(kwargs.items()))
         sig = (_sig_of(args), training, _sig_of([v for _, v in kw_items]),
@@ -190,24 +266,26 @@ class StaticFunction:
         if self._stitched or sig in self._eager_sigs:
             return self._eager_layer(*args, **kwargs)
         compiled = self._cache.get(sig)
+        kw_tpl, kw_tensors = _split_kwargs(kwargs)
         if compiled is None:
             f = self._func
-            # tensor-valued kwargs become traced inputs (closing over them
-            # would constant-fold the first call's values into the graph)
-            kw_static = {k: v for k, v in kwargs.items()
-                         if not isinstance(v, Tensor)}
+            # mounted as a forward override, hooks already ran in the
+            # outer Layer.__call__ — trace only the forward body (tracing
+            # via layer() would apply hooks a second time inside the graph)
+            forward_only = self._installed()
 
             def run(params, buffers, key, arg_vals, kw_vals):
+                kw = _fill_kwargs(kw_tpl,
+                                  [Tensor._wrap(v) for v in kw_vals])
                 return f.apply(params, buffers, key, training, *arg_vals,
-                               **{**kw_static, **kw_vals})
+                               _forward_only=forward_only, **kw)
 
             compiled = jax.jit(run)
             self._cache[sig] = compiled
         arg_vals = jax.tree_util.tree_map(
             lambda v: v._value if isinstance(v, Tensor) else v, args,
             is_leaf=lambda v: isinstance(v, Tensor))
-        kw_vals = {k: v._value for k, v in kwargs.items()
-                   if isinstance(v, Tensor)}
+        kw_vals = [t._value for t in kw_tensors]
         try:
             with self._shadow_removed():
                 out_values, new_buffers = compiled(
@@ -229,10 +307,9 @@ class StaticFunction:
         if sig in self._eager_sigs:
             return self._fn(*args, **kwargs)
         compiled = self._cache.get(sig)
+        kw_tpl, kw_tensors = _split_kwargs(kwargs)
         if compiled is None:
             fn = self._fn
-            kw_static = {k: v for k, v in kwargs.items()
-                         if not isinstance(v, Tensor)}
 
             def run(arg_vals, kw_vals):
                 from paddle_tpu.autograd.engine import no_grad
@@ -240,8 +317,9 @@ class StaticFunction:
                 with no_grad():
                     wrapped = jax.tree_util.tree_map(
                         lambda v: Tensor._wrap(v), arg_vals)
-                    kw_w = {k: Tensor._wrap(v) for k, v in kw_vals.items()}
-                    out = fn(*wrapped, **{**kw_static, **kw_w})
+                    kw = _fill_kwargs(kw_tpl,
+                                      [Tensor._wrap(v) for v in kw_vals])
+                    out = fn(*wrapped, **kw)
                 return jax.tree_util.tree_map(
                     lambda t: t._value if isinstance(t, Tensor) else t, out,
                     is_leaf=lambda t: isinstance(t, Tensor))
@@ -251,8 +329,7 @@ class StaticFunction:
         arg_vals = jax.tree_util.tree_map(
             lambda v: v._value if isinstance(v, Tensor) else v, args,
             is_leaf=lambda v: isinstance(v, Tensor))
-        kw_vals = {k: v._value for k, v in kwargs.items()
-                   if isinstance(v, Tensor)}
+        kw_vals = [t._value for t in kw_tensors]
         try:
             out = compiled(arg_vals, kw_vals)
         except Exception as e:

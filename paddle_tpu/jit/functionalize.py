@@ -85,8 +85,12 @@ class Functionalized:
                 l.training = m
 
     def apply(self, param_values, buffer_values, key, training, *args,
-              **kwargs):
-        """Pure: (params, buffers, key, *args) -> (out_values, new_buffers)."""
+              _forward_only=False, **kwargs):
+        """Pure: (params, buffers, key, *args) -> (out_values, new_buffers).
+
+        _forward_only: invoke the layer's forward body directly, skipping
+        Layer.__call__ hooks — used when this trace runs under an outer
+        Layer.__call__ that already applied them (stitched children)."""
         from paddle_tpu.parallel.api import static_trace
 
         with self._swapped(param_values, buffer_values, key, training), \
@@ -96,7 +100,11 @@ class Functionalized:
                     return Tensor._wrap(v) if hasattr(v, "shape") and hasattr(v, "dtype") else v
 
                 wrapped = jax.tree_util.tree_map(wrap, args)
-                out = self.layer(*wrapped, **kwargs)
+                if _forward_only:
+                    out = type(self.layer).forward(self.layer, *wrapped,
+                                                   **kwargs)
+                else:
+                    out = self.layer(*wrapped, **kwargs)
             out_values = jax.tree_util.tree_map(
                 lambda t: t._value if isinstance(t, Tensor) else t, out,
                 is_leaf=lambda t: isinstance(t, Tensor))

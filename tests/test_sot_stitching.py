@@ -165,6 +165,73 @@ def test_nested_break_stitches_recursively():
                                atol=1e-6)
 
 
+def test_training_backward_through_stitched_model():
+    """Review finding: mounted children must defer to the eager tape when
+    grads are being recorded — stitching must not silently zero grads."""
+    paddle.seed(5)
+    net = LoggingNet()
+    net.train()
+    static = paddle.jit.to_static(net)
+    x = paddle.to_tensor(rng.standard_normal((2, 8)).astype(np.float32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        static(x)                 # break -> stitched
+    out = net(x)                  # plain call, tape active
+    out.sum().backward()
+    g = net.fc1.weight.grad
+    assert g is not None and float(np.abs(np.asarray(g._value)).max()) > 0
+
+
+def test_stitched_child_hooks_run_once():
+    """Review finding: outer Layer.__call__ runs hooks eagerly; the traced
+    forward body must not apply them again."""
+    paddle.seed(6)
+    net = LoggingNet()
+    net.eval()
+    static = paddle.jit.to_static(net)
+    x = paddle.to_tensor(np.ones((2, 8), np.float32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        static(x)                 # break -> stitched
+    counts = []
+    net.fc1.register_forward_pre_hook(
+        lambda layer, args: counts.append(1) or None)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out1 = static(x)          # compiled child path (trace)
+        out2 = static(x)          # cached compiled child path
+    assert len(counts) == 2, f"hook ran {len(counts)} times for 2 calls"
+    np.testing.assert_allclose(np.asarray(out1._value),
+                               np.asarray(out2._value), rtol=1e-6)
+
+
+def test_nested_container_kwargs_not_constant_folded():
+    """Tensors inside list-valued kwargs are traced inputs too."""
+
+    class ListKw(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x, scales=None):
+            h = self.fc(x)
+            for s in scales or []:
+                h = h * s
+            return h
+
+    paddle.seed(7)
+    net = ListKw()
+    net.eval()
+    static = paddle.jit.to_static(net)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    s1 = paddle.to_tensor(np.float32(1.0))
+    s2 = paddle.to_tensor(np.float32(4.0))
+    o1 = static(x, scales=[s1])
+    o2 = static(x, scales=[s2])
+    np.testing.assert_allclose(np.asarray(o2._value),
+                               4.0 * np.asarray(o1._value), rtol=1e-5)
+
+
 def test_tensor_kwargs_not_constant_folded():
     """Tensor kwargs are traced inputs, not baked constants (round-4 fix:
     the old closure captured call-1's kwarg values forever)."""
