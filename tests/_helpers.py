@@ -19,4 +19,8 @@ def child_env(repo_on_pythonpath=True):
         env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)
+    # device-manager tests register fake PJRT plugins; a leaked registry
+    # would make the child's jax plugin discovery dlopen dead stub paths
+    env.pop("PJRT_NAMES_AND_LIBRARY_PATHS", None)
+    env.pop("CUSTOM_DEVICE_ROOT", None)
     return env

@@ -59,6 +59,12 @@ def test_plugin_registration_env_contract(tmp_path, monkeypatch):
 
 
 def test_custom_runtime_root_scan(tmp_path, monkeypatch):
+    # register under monkeypatch so the PJRT_NAMES_AND_LIBRARY_PATHS write
+    # inside load_custom_runtime_libs is rolled back at teardown — leaked,
+    # it makes every later-spawned child process try to dlopen the fake
+    # ELF stubs and die in jax plugin discovery (the round-3 "flaky
+    # cross-process tests" were exactly this)
+    monkeypatch.delenv("PJRT_NAMES_AND_LIBRARY_PATHS", raising=False)
     (tmp_path / "libpjrt_alpha.so").write_bytes(b"\x7fELF")
     (tmp_path / "libpjrt_beta.so").write_bytes(b"\x7fELF")
     (tmp_path / "libother.so").write_bytes(b"\x7fELF")
