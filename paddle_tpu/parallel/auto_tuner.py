@@ -25,6 +25,7 @@ class TrialResult:
     config: Dict[str, int]
     ips: float = 0.0          # items/sec
     step_ms: float = 0.0
+    peak_mem_bytes: int = 0   # XLA-estimated per-device peak (AOT)
     error: Optional[str] = None
 
     @property
@@ -63,16 +64,20 @@ class AutoTuner:
     def run_trial(self, config: Dict[str, int]) -> TrialResult:
         try:
             step_fn, batch = self.build_trial(config)
+            args = batch if isinstance(batch, tuple) else (batch,)
+            mem = getattr(step_fn, "peak_mem_bytes", None)
+            if mem is None:
+                mem = _peak_memory(step_fn, args)
             for _ in range(self.warmup):
-                out = step_fn(batch)
-            jax.block_until_ready(getattr(out, "_value", out))
+                out = step_fn(*args)
+            jax.block_until_ready(_leaves(out))
             t0 = time.perf_counter()
             for _ in range(self.iters):
-                out = step_fn(batch)
-            jax.block_until_ready(getattr(out, "_value", out))
+                out = step_fn(*args)
+            jax.block_until_ready(_leaves(out))
             dt = (time.perf_counter() - t0) / self.iters
             return TrialResult(config, ips=self.items_per_step / dt,
-                               step_ms=dt * 1e3)
+                               step_ms=dt * 1e3, peak_mem_bytes=mem)
         except Exception as e:  # noqa: BLE001
             return TrialResult(config, error=f"{type(e).__name__}: {e}")
 
@@ -90,8 +95,134 @@ class AutoTuner:
         return max(ok, key=lambda r: r.ips)
 
     def summary(self) -> str:
-        lines = [f"{'config':<30}{'step_ms':>10}{'ips':>12}  error"]
+        lines = [f"{'config':<44}{'step_ms':>10}{'ips':>12}"
+                 f"{'peak_MB':>10}  error"]
         for r in sorted(self.results, key=lambda r: -r.ips):
-            lines.append(f"{str(r.config):<30}{r.step_ms:>10.2f}"
-                         f"{r.ips:>12.1f}  {r.error or ''}")
+            lines.append(f"{str(r.config):<44}{r.step_ms:>10.2f}"
+                         f"{r.ips:>12.1f}"
+                         f"{r.peak_mem_bytes / 2**20:>10.1f}"
+                         f"  {r.error or ''}")
         return "\n".join(lines)
+
+    def save_history(self, path: str) -> None:
+        """Append every trial as one JSON line (the reference tuner's
+        history-csv analogue, distributed/auto_tuner/recorder.py)."""
+        import json
+
+        with open(path, "a") as f:
+            for r in self.results:
+                f.write(json.dumps({
+                    "config": r.config, "step_ms": r.step_ms, "ips": r.ips,
+                    "peak_mem_bytes": r.peak_mem_bytes, "error": r.error,
+                }) + "\n")
+
+
+def _leaves(out):
+    return [getattr(v, "_value", v)
+            for v in jax.tree_util.tree_leaves(out)]
+
+
+def _peak_memory(step_fn, args) -> int:
+    """XLA-estimated per-device peak bytes via the AOT path; 0 when the
+    callable is not a jitted function (timing-only trial)."""
+    try:
+        mem = step_fn.lower(*args).compile().memory_analysis()
+        return int(getattr(mem, "temp_size_in_bytes", 0)
+                   + getattr(mem, "argument_size_in_bytes", 0)
+                   + getattr(mem, "output_size_in_bytes", 0))
+    except Exception:  # noqa: BLE001 — AOT introspection is best-effort
+        return 0
+
+
+# ------------------------------------------------- model-level grid search
+
+def prune_parallel_config(cfg: Dict[str, int], *, n_layers: int,
+                          n_heads: int, batch: int,
+                          vocab_divisible: Optional[int] = None) -> Optional[str]:
+    """Reference prune heuristics (auto_tuner/prune.py prune_by_mp/pp/
+    micro-batch) collapsed to divisibility: returns a reason string when
+    the config cannot run, None when viable."""
+    pp = cfg.get("pp", 1)
+    tp = cfg.get("tp", 1)
+    dp = cfg.get("dp", 1)
+    m = cfg.get("num_micro", 1)
+    if n_layers % pp:
+        return f"layers {n_layers} % pp {pp} != 0"
+    if n_heads % tp:
+        return f"heads {n_heads} % tp {tp} != 0"
+    if batch % dp:
+        return f"batch {batch} % dp {dp} != 0"
+    if vocab_divisible and vocab_divisible % tp:
+        return f"vocab {vocab_divisible} % tp {tp} != 0"
+    if pp > 1 and m < pp:
+        return f"num_micro {m} < pp {pp} (bubble-bound)"
+    return None
+
+
+def tune_gpt_parallel(model_cfg, n_devices: Optional[int] = None,
+                      batch: int = 4, num_micros=(1, 2, 4),
+                      schedules=("gpipe",), lr: float = 1e-3,
+                      warmup: int = 1, iters: int = 3,
+                      history_path: Optional[str] = None):
+    """Grid-search (dp, tp, pp) x num_micro x schedule for a GPT config on
+    the available (virtual CPU or real) device set, using the same
+    build_pipeline_train_step machinery the multichip dryrun compiles —
+    cheap trials without trial-process launches (reference
+    distributed/auto_tuner/utils.py:476 launches each trial as a full
+    distributed job; mesh rebuilds are free here).
+
+    Returns (best: TrialResult, tuner: AutoTuner) — tuner.summary() is the
+    ranked table, tuner.save_history() the JSONL record."""
+    from jax.sharding import Mesh
+
+    from paddle_tpu.models.gpt import build_pipeline_train_step
+
+    n = n_devices or len(jax.devices())
+    seq = model_cfg.max_seq_len
+
+    def build(config):
+        axes = {k: config[k] for k in ("dp", "pp", "tp")}
+        devs = np.asarray(jax.devices()[:n]).reshape(*axes.values())
+        mesh = Mesh(devs, tuple(axes))
+        step, state = build_pipeline_train_step(
+            model_cfg, mesh, num_micro=config["num_micro"], lr=lr,
+            schedule=config.get("schedule", "gpipe"))
+        rng = np.random.default_rng(0)
+        toks = jnp_asarray(rng.integers(
+            0, model_cfg.vocab_size,
+            (config["num_micro"], batch, seq)))
+        holder = {"state": state}
+
+        def run(tokens, labels):
+            # states are donated: thread them through the holder so timed
+            # repeat calls don't reuse deleted buffers
+            holder["state"], loss = step(holder["state"], tokens, labels)
+            return loss
+
+        # AOT memory estimate from the real jitted step (run() is a plain
+        # wrapper and cannot be lowered)
+        run.peak_mem_bytes = _peak_memory(step, (state, toks, toks))
+        return run, (toks, toks)
+
+    configs = []
+    for mesh_cfg in candidate_configs(n, axes=("dp", "pp", "tp")):
+        for m in num_micros:
+            for sched in schedules:
+                c = dict(mesh_cfg, num_micro=m, schedule=sched)
+                why = prune_parallel_config(
+                    c, n_layers=model_cfg.num_layers,
+                    n_heads=model_cfg.num_heads, batch=batch)
+                if why is None:
+                    configs.append(c)
+    tuner = AutoTuner(build, warmup=warmup, iters=iters,
+                      items_per_step=batch)
+    best = tuner.tune(configs=configs)
+    if history_path:
+        tuner.save_history(history_path)
+    return best, tuner
+
+
+def jnp_asarray(x):
+    import jax.numpy as jnp
+
+    return jnp.asarray(x)
