@@ -10,6 +10,7 @@ flash-attention path; the incubate namespace provides the reference's entry
 points over the same registry ops.
 """
 
+from paddle_tpu.incubate import asp  # noqa: F401
 from paddle_tpu.incubate import nn  # noqa: F401
 from paddle_tpu.incubate.tdm import tdm_child, tdm_sampler  # noqa: F401
 

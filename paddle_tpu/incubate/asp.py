@@ -1,0 +1,297 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Reference: python/paddle/incubate/asp/ (utils.py mask algorithms:
+get_mask_1d:192, get_mask_2d_greedy:334, get_mask_2d_best:452,
+create_mask:508, check_sparsity:584; asp.py prune_model:319, decorate:233,
+set_excluded_layers:55).
+
+TPU note: the MXU has no 2:4 sparse-math path (that is an NVIDIA Ampere
+sparse-tensor-core feature), so this module provides FORMAT parity — mask
+calculation, pruning, and the sparsity-preserving optimizer wrapper are
+semantically identical to the reference, the masked matmuls execute dense.
+Mask correctness is what the tests pin.
+
+Masks are computed host-side in numpy (pruning is an offline step in the
+reference too); the per-step re-masking after optimizer.step() runs as
+jitted elementwise multiplies on device.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from functools import lru_cache
+from itertools import permutations
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = [
+    "MaskAlgo", "CheckMethod", "calculate_density",
+    "get_mask_1d", "check_mask_1d",
+    "get_mask_2d_greedy", "get_mask_2d_best", "check_mask_2d",
+    "create_mask", "check_sparsity",
+    "set_excluded_layers", "reset_excluded_layers",
+    "prune_model", "decorate",
+]
+
+
+class MaskAlgo(Enum):
+    MASK_1D = "get_mask_1d"
+    MASK_2D_GREEDY = "get_mask_2d_greedy"
+    MASK_2D_BEST = "get_mask_2d_best"
+
+
+class CheckMethod(Enum):
+    CHECK_1D = "check_mask_1d"
+    CHECK_2D = "check_mask_2d"
+
+    @staticmethod
+    def get_checking_method(mask_algo: MaskAlgo) -> "CheckMethod":
+        assert isinstance(mask_algo, MaskAlgo)
+        return (CheckMethod.CHECK_1D if mask_algo == MaskAlgo.MASK_1D
+                else CheckMethod.CHECK_2D)
+
+
+def calculate_density(x) -> float:
+    x = np.asarray(x)
+    return float(np.count_nonzero(x)) / x.size
+
+
+def _pad_cols(mat: np.ndarray, m: int):
+    """Zero-pad the trailing dim to a multiple of m; returns (groups, padded
+    shape) where groups is (-1, m)."""
+    rows, cols = mat.shape
+    pad = (-cols) % m
+    if pad:
+        mat = np.concatenate([mat, np.zeros((rows, pad), mat.dtype)], axis=1)
+    return mat.reshape(-1, m), mat.shape
+
+
+def get_mask_1d(mat, n: int, m: int):
+    """Row-direction n:m mask: zero the n smallest |values| of every m
+    consecutive elements (vectorized — no per-group python loop)."""
+    mat = np.asarray(mat)
+    groups, padded = _pad_cols(mat, m)
+    order = np.argsort(np.abs(groups), axis=1)          # ascending
+    mask = np.ones_like(groups)
+    np.put_along_axis(mask, order[:, :n], 0, axis=1)
+    return mask.reshape(padded)[:, : mat.shape[1]]
+
+
+def check_mask_1d(mat, n: int, m: int) -> bool:
+    """True iff every 1 x m group holds at least n zeros."""
+    mat = np.asarray(mat)
+    if mat.ndim <= 1:
+        mat = mat.reshape(1, -1)
+    groups, _ = _pad_cols(mat, m)
+    return bool((np.count_nonzero(groups, axis=1) <= m - n).all())
+
+
+def _pad_blocks(mat: np.ndarray, m: int):
+    """Zero-pad both dims to multiples of m; returns (blocks [k, m, m],
+    padded shape)."""
+    r, c = mat.shape
+    pr, pc = (-r) % m, (-c) % m
+    if pr or pc:
+        mat = np.pad(mat, ((0, pr), (0, pc)))
+    R, C = mat.shape
+    blocks = (mat.reshape(R // m, m, C // m, m)
+              .transpose(0, 2, 1, 3).reshape(-1, m, m))
+    return blocks, (R, C)
+
+
+def _unpad_blocks(blocks: np.ndarray, padded, m: int, shape):
+    R, C = padded
+    out = (blocks.reshape(R // m, C // m, m, m)
+           .transpose(0, 2, 1, 3).reshape(R, C))
+    return out[: shape[0], : shape[1]]
+
+
+def get_mask_2d_greedy(mat, n: int, m: int):
+    """Per m x m block, keep entries in descending |value| order while no
+    row or column exceeds n kept entries (2D n:m: >= n zeros per row AND
+    per column of each block)."""
+    mat = np.asarray(mat)
+    blocks, padded = _pad_blocks(mat.astype(float), m)
+    masks = np.zeros_like(blocks)
+    for b in range(blocks.shape[0]):
+        order = np.argsort(np.abs(blocks[b]), axis=None)[::-1]
+        kept_r = np.zeros(m, np.int64)
+        kept_c = np.zeros(m, np.int64)
+        for flat in order:
+            r, c = divmod(int(flat), m)
+            if kept_r[r] < n and kept_c[c] < n:
+                masks[b, r, c] = 1.0
+                kept_r[r] += 1
+                kept_c[c] += 1
+    return _unpad_blocks(masks, padded, m, mat.shape)
+
+
+@lru_cache(maxsize=16)
+def _valid_2d_patterns(n: int, m: int) -> np.ndarray:
+    """All m x m 0/1 patterns with exactly n ones per row and at most n per
+    column, as a [P, m, m] array."""
+    row_choices = {p for p in permutations([1] * n + [0] * (m - n))}
+    rows = [np.asarray(p, float) for p in row_choices]
+    out = []
+
+    def build(stack, colsum):
+        if len(stack) == m:
+            out.append(np.stack(stack))
+            return
+        for r in rows:
+            ns = colsum + r
+            if (ns <= n).all():
+                build(stack + [r], ns)
+
+    build([], np.zeros(m))
+    return np.stack(out)
+
+
+def get_mask_2d_best(mat, n: int, m: int):
+    """Exhaustive-pattern 2D n:m mask maximizing the retained L1 norm
+    (reference guarantees best >= greedy)."""
+    mat = np.asarray(mat)
+    blocks, padded = _pad_blocks(np.abs(mat.astype(float)), m)
+    pats = _valid_2d_patterns(n, m)                     # [P, m, m]
+    scores = np.einsum("kij,pij->kp", blocks, pats)
+    best = pats[np.argmax(scores, axis=1)]              # [k, m, m]
+    return _unpad_blocks(best, padded, m, mat.shape)
+
+
+def check_mask_2d(mat, n: int, m: int) -> bool:
+    """True iff every m x m block has >= n zeros in each row and column."""
+    mat = np.asarray(mat)
+    if mat.ndim <= 1:
+        mat = mat.reshape(1, -1)
+    blocks, _ = _pad_blocks(mat.astype(float), m)
+    nz_rows = np.count_nonzero(blocks, axis=2)          # [k, m]
+    nz_cols = np.count_nonzero(blocks, axis=1)
+    return bool((nz_rows <= m - n).all() and (nz_cols <= m - n).all())
+
+
+def _as_2d(t: np.ndarray):
+    """Reference create_mask rank handling: rank<=3 flatten leading dims;
+    rank-4 conv weights transpose to (h*w*out, in) — utils.py:564."""
+    shape = t.shape
+    if t.ndim == 1:
+        return t.reshape(1, -1), None
+    if t.ndim == 2:
+        return t, None
+    if t.ndim == 3:
+        return t.reshape(shape[0] * shape[1], shape[2]), None
+    if t.ndim == 4:
+        tt = t.transpose(0, 1, 3, 2).reshape(
+            shape[0] * shape[1] * shape[3], shape[2])
+        def restore(mask):
+            return (mask.reshape(shape[0], shape[1], shape[3], shape[2])
+                    .transpose(0, 1, 3, 2))
+        return tt, restore
+    raise ValueError(
+        f"ASP supports tensors of rank <= 4, got rank {t.ndim}")
+
+
+def create_mask(tensor, func_name: MaskAlgo = MaskAlgo.MASK_1D,
+                n: int = 2, m: int = 4):
+    if not isinstance(func_name, MaskAlgo):
+        raise AssertionError(
+            f"func_name must be a MaskAlgo, got {type(func_name)}")
+    t = np.asarray(tensor)
+    dtype = t.dtype
+    t2, restore = _as_2d(t.astype(float))
+    mask = globals()[func_name.value](t2, n=n, m=m)
+    if restore is not None:
+        return restore(mask).astype(dtype)
+    return mask.reshape(t.shape).astype(dtype)
+
+
+def check_sparsity(tensor, func_name: CheckMethod = CheckMethod.CHECK_1D,
+                   n: int = 2, m: int = 4) -> bool:
+    if not isinstance(func_name, CheckMethod):
+        raise AssertionError(
+            f"func_name must be a CheckMethod, got {type(func_name)}")
+    t = np.asarray(tensor).astype(float)
+    if t.ndim >= 2:
+        t, _ = _as_2d(t)
+    return globals()[func_name.value](t, n=n, m=m)
+
+
+# ------------------------------------------------------------- model pruning
+
+_EXCLUDED: set = set()
+# id(model) -> list of (param Tensor, device mask) pairs; decorate()d
+# optimizers re-mask every recorded pair after each step
+_MASK_PAIRS: Dict[int, list] = {}
+
+
+def set_excluded_layers(param_names, main_program=None) -> None:
+    """Exclude parameters (by name) from pruning (reference asp.py:55)."""
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None) -> None:
+    _EXCLUDED.clear()
+
+
+def _prunable(name: str, value) -> bool:
+    """Reference supported_layer_list: weights of fc/linear/conv — here any
+    rank>=2 non-excluded parameter whose trailing dim tiles by m=4."""
+    if name in _EXCLUDED or any(name.endswith(f".{e}") for e in _EXCLUDED):
+        return False
+    if "bias" in name.rsplit(".", 1)[-1]:
+        return False
+    return value.ndim >= 2
+
+
+def prune_model(model, n: int = 2, m: int = 4,
+                mask_algo: str = "mask_1d", with_mask: bool = True):
+    """Prune a Layer's prunable parameters to the n:m pattern in place and
+    (with_mask) remember the masks so `decorate`d optimizers keep the
+    pattern through training (reference asp.py:319).
+
+    mask_algo: 'mask_1d' | 'mask_2d_greedy' | 'mask_2d_best'."""
+    import jax.numpy as jnp
+
+    algo = {"mask_1d": MaskAlgo.MASK_1D,
+            "mask_2d_greedy": MaskAlgo.MASK_2D_GREEDY,
+            "mask_2d_best": MaskAlgo.MASK_2D_BEST}[mask_algo]
+    masks: Dict[str, object] = {}
+    pairs = []
+    for name, p in model.named_parameters():
+        val = np.asarray(p._value)
+        if not _prunable(name, val):
+            continue
+        mask = create_mask(val, func_name=algo, n=n, m=m)
+        p._value = jnp.asarray(val * mask)
+        dev_mask = jnp.asarray(mask.astype(val.dtype))
+        masks[name] = dev_mask
+        pairs.append((p, dev_mask))
+    if with_mask:
+        _MASK_PAIRS[id(model)] = pairs
+        model._asp_mask_pairs = pairs   # keep alive with the model
+    return masks
+
+
+class OptimizerWithSparsityGuarantee:
+    """Wraps an optimizer: after every step, re-apply the pruning masks so
+    updated weights stay n:m sparse (reference asp.py:949 — the reference
+    masks via fused momentum ops; masking the post-step weight is the same
+    fixed point)."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def step(self):
+        self._optimizer.step()
+        for pairs in _MASK_PAIRS.values():
+            for p, mask in pairs:
+                p._value = p._value * mask
+
+    def __getattr__(self, name):
+        return getattr(self._optimizer, name)
+
+
+def decorate(optimizer):
+    """Return an optimizer whose step() preserves the pruned n:m pattern
+    (reference asp.py:233)."""
+    return OptimizerWithSparsityGuarantee(optimizer)

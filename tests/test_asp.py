@@ -1,0 +1,98 @@
+"""ASP n:m structured sparsity: mask algorithms against the reference's
+documented examples, pruning, and the sparsity-preserving optimizer.
+
+Reference: python/paddle/incubate/asp/utils.py (docstring examples are
+the oracle), asp.py prune_model/decorate."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate import asp
+
+
+def test_mask_1d_reference_example():
+    mat = np.array([[0, 1, 5, 4], [2, 7, 3, 6]])
+    mask = asp.get_mask_1d(mat, 2, 4)
+    np.testing.assert_array_equal(mask, [[0, 0, 1, 1], [0, 1, 0, 1]])
+    assert asp.check_mask_1d(mask, 2, 4)
+
+
+def test_check_mask_1d_reference_examples():
+    assert asp.check_mask_1d(np.array([[0, 1, 3, 0], [1, 0, 0, 1]]), 2, 4)
+    assert not asp.check_mask_1d(
+        np.array([[0, 1, 5, 4], [1, 0, 0, 1]]), 2, 4)
+    # padding case: (2, 5) padded to (2, 8)
+    assert asp.check_mask_1d(
+        np.array([[0, 1, 0, 4, 6], [1, 0, 0, 1, 7]]), 2, 4)
+
+
+def test_mask_2d_greedy_is_valid_and_best_beats_it():
+    rng = np.random.default_rng(0)
+    mat = rng.standard_normal((8, 8))
+    g = asp.get_mask_2d_greedy(mat, 2, 4)
+    b = asp.get_mask_2d_best(mat, 2, 4)
+    assert asp.check_mask_2d(g, 2, 4)
+    assert asp.check_mask_2d(b, 2, 4)
+    # reference contract: best L1 >= greedy L1
+    assert (np.abs(mat) * b).sum() >= (np.abs(mat) * g).sum() - 1e-9
+
+
+def test_mask_2d_best_reference_example():
+    mat = np.array([[2, 8, 9, 9], [9, 1, 3, 9], [5, 6, 3, 9], [2, 4, 6, 9]])
+    gl1 = (mat * asp.get_mask_2d_greedy(mat, 2, 4)).sum()
+    bl1 = (mat * asp.get_mask_2d_best(mat, 2, 4)).sum()
+    assert gl1 == 56.0 and bl1 == 61.0
+
+
+def test_create_mask_rank4_conv_layout():
+    rng = np.random.default_rng(1)
+    w = rng.standard_normal((3, 3, 8, 16)).astype(np.float32)
+    mask = asp.create_mask(w, asp.MaskAlgo.MASK_1D, 2, 4)
+    assert mask.shape == w.shape
+    assert asp.check_sparsity(mask, asp.CheckMethod.CHECK_1D, 2, 4)
+    assert abs(asp.calculate_density(mask) - 0.5) < 1e-6
+
+
+def test_prune_model_and_decorated_optimizer_preserve_pattern():
+    paddle.seed(0)
+    model = nn.Linear(16, 8)
+    asp.set_excluded_layers([])
+    masks = asp.prune_model(model, n=2, m=4, mask_algo="mask_1d")
+    assert "weight" in next(iter(masks)) or masks  # at least the weight
+    w = np.asarray(model.weight._value)
+    assert asp.check_sparsity(w, asp.CheckMethod.CHECK_1D, 2, 4)
+    assert abs(asp.calculate_density(w) - 0.5) < 1e-6
+
+    opt = asp.decorate(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=model.parameters()))
+    x = paddle.to_tensor(np.random.default_rng(2)
+                         .standard_normal((4, 16)).astype(np.float32))
+    for _ in range(3):
+        loss = ((model(x) - 1.0) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    w2 = np.asarray(model.weight._value)
+    assert asp.check_sparsity(w2, asp.CheckMethod.CHECK_1D, 2, 4)
+    assert not np.allclose(w2, w)      # it actually trained
+
+
+def test_excluded_layers_skip_pruning():
+    paddle.seed(1)
+    model = nn.Linear(8, 8)
+    asp.set_excluded_layers(["weight"])
+    try:
+        masks = asp.prune_model(model, with_mask=False)
+        assert not masks
+        d = asp.calculate_density(np.asarray(model.weight._value))
+        assert d > 0.9
+    finally:
+        asp.reset_excluded_layers()
+
+
+def test_check_sparsity_rejects_dense():
+    dense = np.ones((4, 8))
+    assert not asp.check_sparsity(dense, asp.CheckMethod.CHECK_1D, 2, 4)
+    assert not asp.check_sparsity(dense, asp.CheckMethod.CHECK_2D, 2, 4)
