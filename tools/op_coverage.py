@@ -9,7 +9,7 @@ can audit each exclusion):
   optimizer update ops  -> paddle_tpu.optimizer classes (functional updates)
   collective / c_* ops  -> parallel.collective in-jit XLA collectives
   PS / distributed infra-> parallel/ (store, fleet); PS world scheduled last
-  fake_quantize family  -> paddle_tpu.quantization QAT/PTQ fake-quant
+  collectives           -> in-jit XLA collectives (parallel/collective)
   detection zoo         -> vision.ops (subset); remainder tracked as gaps
   device/memory admin   -> PJRT owns transfers (memcpy_*, npu_identity...)
 
@@ -60,18 +60,6 @@ COLLAPSED = {
     "number_count": "parallel.moe", "prune_gate_by_capacity": "parallel.moe",
     "random_routing": "parallel.moe",
     "sync_calc_stream": "PJRT (stream-free)",
-    # quantization fake ops -> quantization module
-    "fake_channel_wise_dequantize_max_abs": "quantization",
-    "fake_channel_wise_quantize_abs_max": "quantization",
-    "fake_channel_wise_quantize_dequantize_abs_max": "quantization",
-    "fake_dequantize_max_abs": "quantization",
-    "fake_quantize_abs_max": "quantization",
-    "fake_quantize_dequantize_abs_max": "quantization",
-    "fake_quantize_dequantize_moving_average_abs_max": "quantization",
-    "fake_quantize_moving_average_abs_max": "quantization",
-    "fake_quantize_range_abs_max": "quantization",
-    "dequantize_abs_max": "quantization", "dequantize_log": "quantization",
-    "quantize_linear": "quantization", "dequantize_linear": "quantization",
     # device/memory admin -> PJRT
     "memcpy_d2h": "PJRT", "memcpy_h2d": "PJRT", "memcpy": "PJRT",
     "npu_identity": "PJRT", "share_data": "functional arrays",
@@ -91,7 +79,7 @@ COLLAPSED = {
     "repeat_interleave_with_tensor_index": "repeat_interleave",
     "index_select_strided": "index_select",
     "view_dtype": "Tensor.view", "view_shape": "Tensor.view",
-    "view_slice": "Tensor.view", "as_strided": None,  # implemented
+    "view_slice": "Tensor.view",
     "disable_check_model_nan_inf": "FLAGS_check_nan_inf",
     "enable_check_model_nan_inf": "FLAGS_check_nan_inf",
     "check_numerics": "FLAGS_check_nan_inf",
@@ -102,9 +90,8 @@ COLLAPSED = {
     # attention variants -> ops/pallas flash attention + sdp
     "flash_attn": "ops.pallas.flash_attention",
     "flash_attn_qkvpacked": "ops.pallas.flash_attention",
-    "flash_attn_unpadded": "ops.pallas.flash_attention",
-    "flash_attn_varlen_qkvpacked": "ops.pallas.flash_attention",
-    "flashmask_attention": "ops.pallas.flash_attention",
+    "flash_attn_varlen_qkvpacked": "ops.pallas.flash_attention "
+        "(flash_attn_unpadded handles the unpacked form)",
     "memory_efficient_attention": "nn.functional.sdp_attention",
     "variable_length_memory_efficient_attention": "sdp_attention",
     "calc_reduced_attn_scores": "sdp_attention",
@@ -113,11 +100,6 @@ COLLAPSED = {
     "fused_softmax_mask": "XLA fusion", "fused_softmax_mask_upper_triangle":
         "XLA fusion", "fused_batch_norm_act": "XLA fusion",
     "fused_bn_add_activation": "XLA fusion",
-    # int8/weight-only LLM kernels -> quantization roadmap
-    "llm_int8_linear": "quantization (int8 path scheduled)",
-    "weight_dequantize": "quantization", "weight_only_linear":
-        "quantization", "weight_quantize": "quantization",
-    "apply_per_channel_scale": "quantization",
     # PS / distributed-training specials
     "cvm": "PS world", "batch_fc": "PS world",
     "rank_attention": "PS world", "shuffle_batch": "io.DataLoader(shuffle)",
@@ -133,7 +115,6 @@ COLLAPSED = {
     "lstm": "nn.rnn LSTM", "gru": "nn.rnn GRU", "gru_unit": "nn.rnn GRUCell",
     "rnn": "nn.rnn RNN", "beam_search": "models.generation",
     "top_p_sampling": "models.generation.sample",
-    "gather_tree": None,
     "segment_pool": "geometric.segment ops",
 }
 
