@@ -42,6 +42,21 @@ PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "45"))
 # mid-round numbers this way).
 RESULT_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_CACHE.json")
+# Append-only log of every tunnel probe attempt (the VERDICT-r3 fallback
+# evidence when the tunnel is dead a whole round: proof bench ran, when,
+# and what it saw).
+ATTEMPTS_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_ATTEMPTS.jsonl")
+
+
+def _log_attempt(status: str, detail=None) -> None:
+    try:
+        with open(ATTEMPTS_LOG, "a") as f:
+            f.write(json.dumps({
+                "t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                "status": status, "detail": detail}) + "\n")
+    except OSError:
+        pass
 
 
 def remaining() -> float:
@@ -337,6 +352,7 @@ def main():
     log(f"bench ladder start, budget={BUDGET_S:.0f}s cache={CACHE_DIR}")
 
     probe = run_child("probe", PROBE_TIMEOUT_S)
+    _log_attempt("probe_ok" if probe else "probe_hung", probe)
     if probe is None:
         log("tunnel probe failed/hung — TPU backend unavailable")
         reason = ("axon tunnel probe hung/failed >"
