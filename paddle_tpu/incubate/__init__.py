@@ -12,6 +12,7 @@ points over the same registry ops.
 
 from paddle_tpu.incubate import asp  # noqa: F401
 from paddle_tpu.incubate import nn  # noqa: F401
+from paddle_tpu.incubate.pyramid_hash import pyramid_hash  # noqa: F401
 from paddle_tpu.incubate.tdm import tdm_child, tdm_sampler  # noqa: F401
 
 

@@ -1,0 +1,225 @@
+"""pyramid_hash — n-gram hash embeddings for the PS/rec-sys world.
+
+Reference: paddle/phi/kernels/cpu/pyramid_hash_kernel.cc (the last honest
+op gap in rounds 2-3's coverage audit; yaml spec at
+paddle/phi/ops/yaml/ops.yaml:3892).
+
+Semantics (mirrored from the kernel):
+  * input is a batch of variable-length int32 token sequences (LoD);
+  * every sequence contributes its n-grams of lengths 2..pyramid_layer
+    (layer `i` = grams of i+1 consecutive tokens);
+  * each n-gram may be filtered (white list must contain it, black list
+    must not) and — in training — dropped with drop_out_percent;
+  * a surviving n-gram's num_emb-wide embedding is assembled chunk-wise:
+    the gram's ids are cast to float32 and XXH32-hashed with a rolling
+    seed schedule (0, rand_len, j + 2*rand_len, ...); each hash picks a
+    rand_len-wide slice of the flat weight table (hash_embedding_ff,
+    kernel.cc:39) — bit-exact XXH32 here, so positions match the
+    reference for identical weights;
+  * a sequence with no surviving n-grams yields one zero row;
+  * outputs: (out [total_rows, num_emb], out_offsets [b+1],
+    drop_pos, drop_pos_offsets).
+
+Deviations (documented): the reference's white/black lists are raw
+C-struct bloom-filter blobs; here they are python sets of id-tuples (same
+filtering semantics, no binary-format dependency). Dropout uses numpy's
+PCG instead of glibc rand_r — the decision distribution matches, the
+exact stream does not.
+
+The op is host-side by nature (LoD, data-dependent output shape — same
+class as NMS/graph sampling); `w` gradients flow through a PyLayer that
+scatter-adds each row's chunk gradients back to the hashed positions
+(pyramid_hash_grad_kernel.cc).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+_P1 = 2654435761
+_P2 = 2246822519
+_P3 = 3266489917
+_P4 = 668265263
+_P5 = 374761393
+_M = 0xFFFFFFFF
+
+
+def _rotl(x: int, r: int) -> int:
+    return ((x << r) | (x >> (32 - r))) & _M
+
+
+def xxh32(data: bytes, seed: int = 0) -> int:
+    """Bit-exact XXH32 (validated against the published test vectors)."""
+    n = len(data)
+    i = 0
+    if n >= 16:
+        a1 = (seed + _P1 + _P2) & _M
+        a2 = (seed + _P2) & _M
+        a3 = seed & _M
+        a4 = (seed - _P1) & _M
+        while i + 16 <= n:
+            l1, l2, l3, l4 = struct.unpack_from("<IIII", data, i)
+            a1 = (_rotl((a1 + l1 * _P2) & _M, 13) * _P1) & _M
+            a2 = (_rotl((a2 + l2 * _P2) & _M, 13) * _P1) & _M
+            a3 = (_rotl((a3 + l3 * _P2) & _M, 13) * _P1) & _M
+            a4 = (_rotl((a4 + l4 * _P2) & _M, 13) * _P1) & _M
+            i += 16
+        h = (_rotl(a1, 1) + _rotl(a2, 7) + _rotl(a3, 12)
+             + _rotl(a4, 18)) & _M
+    else:
+        h = (seed + _P5) & _M
+    h = (h + n) & _M
+    while i + 4 <= n:
+        (lane,) = struct.unpack_from("<I", data, i)
+        h = (_rotl((h + lane * _P3) & _M, 17) * _P4) & _M
+        i += 4
+    while i < n:
+        h = (_rotl((h + data[i] * _P5) & _M, 11) * _P1) & _M
+        i += 1
+    h ^= h >> 15
+    h = (h * _P2) & _M
+    h ^= h >> 13
+    h = (h * _P3) & _M
+    h ^= h >> 16
+    return h
+
+
+def _gram_positions(gram_f32: np.ndarray, num_emb: int, rand_len: int,
+                    space_len: int) -> List[int]:
+    """The rolling-seed position schedule of hash_embedding_ff: chunk j
+    reads weights[pos_j : pos_j + rand_len] with pos list (h(0), h(rand),
+    h(2*rand), h(rand + 2*rand), ...)."""
+    raw = gram_f32.tobytes()
+    pos1 = xxh32(raw, 0) % space_len
+    pos2 = xxh32(raw, rand_len) % space_len
+    out = []
+    for j in range(0, num_emb, rand_len):
+        pos3 = xxh32(raw, j + 2 * rand_len) % space_len
+        out.append(pos1)
+        pos1, pos2 = pos2, pos3
+    return out
+
+
+def _as_sequences(x, lod=None) -> List[np.ndarray]:
+    if lod is not None:
+        flat = np.asarray(getattr(x, "_value", x)).reshape(-1)
+        off = np.asarray(lod, np.int64).reshape(-1)
+        return [flat[off[i]:off[i + 1]] for i in range(len(off) - 1)]
+    return [np.asarray(getattr(s, "_value", s)).reshape(-1) for s in x]
+
+
+def pyramid_hash(x, w, white_list: Optional[Set[tuple]] = None,
+                 black_list: Optional[Set[tuple]] = None, *,
+                 num_emb: int, space_len: int, pyramid_layer: int = 2,
+                 rand_len: int = 16, drop_out_percent: float = 0.0,
+                 is_training: bool = False, use_filter: bool = True,
+                 seed: int = 0, lod=None):
+    """See module docstring. x: list of int sequences (or flat + lod
+    offsets); w: flat weight Tensor of length >= space_len + rand_len.
+    Returns (out Tensor [total, num_emb], out_offsets np.int64 [b+1],
+    drop_pos np.int32, drop_pos_offsets np.int64)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.autograd.py_layer import PyLayer
+    from paddle_tpu.core.tensor import Tensor
+
+    if num_emb % rand_len:
+        raise ValueError(f"num_emb {num_emb} must be a multiple of "
+                         f"rand_len {rand_len}")
+    seqs = _as_sequences(x, lod)
+    w_t = w if isinstance(w, Tensor) else Tensor._wrap(jnp.asarray(w))
+    w_flat = np.asarray(w_t._value).reshape(-1)
+    if w_flat.size < space_len + rand_len:
+        raise ValueError(
+            f"weight table of {w_flat.size} elements cannot serve "
+            f"space_len {space_len} + rand_len {rand_len}")
+    rng = np.random.default_rng(seed or None)
+
+    kept_positions: List[List[int]] = []   # per kept n-gram
+    out_offsets = [0]
+    drop_flags: List[int] = []
+    drop_offsets = [0]
+    zero_rows: List[int] = []              # row indices that stay zero
+    for s in seqs:
+        ww = len(s)
+        kept_here = 0
+        if ww >= 2:
+            for ilayer in range(1, min(pyramid_layer, ww)):
+                for l in range(ww - ilayer):
+                    gram = tuple(int(v) for v in s[l:l + ilayer + 1])
+                    ok = True
+                    if use_filter:
+                        if white_list is not None and gram not in white_list:
+                            ok = False
+                        if black_list is not None and gram in black_list:
+                            ok = False
+                    if not ok:
+                        drop_flags.append(0)
+                        continue
+                    if is_training and drop_out_percent > 0.0 \
+                            and rng.random() < drop_out_percent:
+                        drop_flags.append(0)
+                        continue
+                    drop_flags.append(1)
+                    gram_f32 = np.asarray(gram, np.float32)
+                    kept_positions.append(_gram_positions(
+                        gram_f32, num_emb, rand_len, space_len))
+                    kept_here += 1
+        drop_offsets.append(len([f for f in drop_flags if f]))
+        if kept_here == 0:
+            zero_rows.append(out_offsets[-1])
+            out_offsets.append(out_offsets[-1] + 1)
+            kept_positions.append(None)    # placeholder zero row
+        else:
+            out_offsets.append(out_offsets[-1] + kept_here)
+
+    total = out_offsets[-1]
+    # gather index matrix [total, num_emb]: chunk c of row r reads
+    # w_flat[pos + 0..rand_len); zero rows read index 0 and mask to 0
+    idx = np.zeros((total, num_emb), np.int64)
+    mask = np.ones((total, 1), np.float32)
+    for r, poss in enumerate(kept_positions):
+        if poss is None:
+            mask[r] = 0.0
+            continue
+        for c, p in enumerate(poss):
+            idx[r, c * rand_len:(c + 1) * rand_len] = np.arange(
+                p, p + rand_len)
+
+    class _PyramidGather(PyLayer):
+        @staticmethod
+        def forward(ctx, w_tensor):
+            ctx.save_for_backward(w_tensor)
+            vals = jnp.take(w_tensor._value.reshape(-1), jnp.asarray(idx))
+            return Tensor._wrap(vals * jnp.asarray(mask))
+
+        @staticmethod
+        def backward(ctx, grad_out):
+            (w_tensor,) = ctx.saved_tensor()
+            flat_g = jnp.zeros((w_flat.size,), grad_out._value.dtype)
+            g = grad_out._value * jnp.asarray(mask)
+            flat_g = flat_g.at[jnp.asarray(idx).reshape(-1)].add(
+                g.reshape(-1))
+            return Tensor._wrap(
+                flat_g.reshape(np.asarray(w_tensor._value).shape))
+
+    out = _PyramidGather.apply(w_t)
+    return (out, np.asarray(out_offsets, np.int64),
+            np.asarray(drop_flags, np.int32),
+            np.asarray(drop_offsets, np.int64))
+
+
+def _register():
+    from paddle_tpu.ops.registry import OPS, OpDef, host_only_impl
+
+    OPS.setdefault("pyramid_hash", OpDef(
+        "pyramid_hash",
+        host_only_impl("pyramid_hash",
+                       "paddle_tpu.incubate.pyramid_hash.pyramid_hash"),
+        diff=False, dynamic=True, method=False))
+
+
+_register()

@@ -1,0 +1,111 @@
+"""pyramid_hash n-gram hash embeddings (the last honest op gap).
+
+Reference: paddle/phi/kernels/cpu/pyramid_hash_kernel.cc — XXH32
+position schedule (hash_embedding_ff:39), white/black filtering,
+per-sequence LoD output with zero rows for empty sequences."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.pyramid_hash import (
+    _gram_positions, pyramid_hash, xxh32,
+)
+
+SPACE, RAND, EMB = 100, 4, 12
+
+
+def _w(seed=0):
+    rng = np.random.default_rng(seed)
+    return paddle.to_tensor(
+        rng.standard_normal(SPACE + RAND).astype(np.float32))
+
+
+def test_xxh32_published_vectors():
+    assert xxh32(b"") == 0x02CC5D05
+    assert xxh32(b"Nobody inspects the spammish repetition") == 0xE2293B2F
+
+
+def test_output_rows_follow_ngram_counts():
+    w = _w()
+    seqs = [np.array([1, 2, 3], np.int32),       # 2 bigrams (layer=2)
+            np.array([7], np.int32),             # too short -> zero row
+            np.array([4, 5], np.int32)]          # 1 bigram
+    out, off, drop, doff = pyramid_hash(
+        seqs, w, num_emb=EMB, space_len=SPACE, rand_len=RAND,
+        pyramid_layer=2, use_filter=False)
+    assert tuple(off) == (0, 2, 3, 4)
+    o = np.asarray(out._value)
+    assert o.shape == (4, EMB)
+    assert np.allclose(o[2], 0.0)                # the empty sequence's row
+    assert not np.allclose(o[0], 0.0)
+
+
+def test_rows_match_hash_position_schedule():
+    """Each kept gram's row equals the weight slices at the XXH32 rolling
+    positions (exact kernel contract)."""
+    w = _w(1)
+    wf = np.asarray(w._value).reshape(-1)
+    seqs = [np.array([11, 22, 33], np.int32)]
+    out, off, _, _ = pyramid_hash(seqs, w, num_emb=EMB, space_len=SPACE,
+                                  rand_len=RAND, pyramid_layer=2,
+                                  use_filter=False)
+    o = np.asarray(out._value)
+    for r, gram in enumerate([(11, 22), (22, 33)]):
+        poss = _gram_positions(np.asarray(gram, np.float32), EMB, RAND,
+                               SPACE)
+        expect = np.concatenate([wf[p:p + RAND] for p in poss])
+        np.testing.assert_allclose(o[r], expect)
+
+
+def test_pyramid_layer_3_adds_trigrams():
+    w = _w()
+    seqs = [np.arange(4, dtype=np.int32)]
+    _, off2, _, _ = pyramid_hash(seqs, w, num_emb=EMB, space_len=SPACE,
+                                 rand_len=RAND, pyramid_layer=2,
+                                 use_filter=False)
+    _, off3, _, _ = pyramid_hash(seqs, w, num_emb=EMB, space_len=SPACE,
+                                 rand_len=RAND, pyramid_layer=3,
+                                 use_filter=False)
+    assert off2[-1] == 3          # 3 bigrams
+    assert off3[-1] == 5          # + 2 trigrams
+
+
+def test_white_black_filtering():
+    w = _w()
+    seqs = [np.array([1, 2, 3], np.int32)]
+    out, off, drop, _ = pyramid_hash(
+        seqs, w, white_list={(1, 2)}, num_emb=EMB, space_len=SPACE,
+        rand_len=RAND, use_filter=True)
+    assert off[-1] == 1 and list(drop) == [1, 0]
+    out, off, drop, _ = pyramid_hash(
+        seqs, w, black_list={(1, 2)}, num_emb=EMB, space_len=SPACE,
+        rand_len=RAND, use_filter=True)
+    assert off[-1] == 1 and list(drop) == [0, 1]
+
+
+def test_training_dropout_drops_some():
+    w = _w()
+    seqs = [np.arange(30, dtype=np.int32)]
+    _, _, drop, _ = pyramid_hash(
+        seqs, w, num_emb=EMB, space_len=SPACE, rand_len=RAND,
+        drop_out_percent=0.5, is_training=True, use_filter=False, seed=3)
+    assert 0 < drop.sum() < len(drop)
+
+
+def test_weight_gradients_scatter_back():
+    w = _w(2)
+    w.stop_gradient = False
+    seqs = [np.array([5, 6, 7], np.int32)]
+    out, _, _, _ = pyramid_hash(seqs, w, num_emb=EMB, space_len=SPACE,
+                                rand_len=RAND, use_filter=False)
+    out.sum().backward()
+    g = np.asarray(w.grad._value)
+    assert g.shape == np.asarray(w._value).shape
+    # gradient count at hashed slots equals occurrences in the index map
+    assert g.sum() > 0 and (g > 0).sum() <= 2 * EMB
+
+
+def test_registered_host_only():
+    from paddle_tpu.ops.registry import OPS
+
+    assert "pyramid_hash" in OPS

@@ -121,8 +121,6 @@ COLLAPSED = {
 # Honest gap list: reference ops with NO equivalent capability here.
 # (Round-2 verdict: the audit list must carry a real "missing" bucket.)
 KNOWN_MISSING = {
-    "pyramid_hash": "sparse feature hash-embedding (PS/rec world) — not "
-                    "implemented",
     "dgc": "deep gradient compression — not planned (GPU bandwidth "
            "workaround; TPU path uses XLA collectives over ICI)",
     "dgc_clip_by_norm": "see dgc",
