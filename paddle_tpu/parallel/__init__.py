@@ -43,6 +43,10 @@ from paddle_tpu.parallel.recompute import (  # noqa: F401,E402
 from paddle_tpu.parallel.ring_attention import RingAttention, ring_attention  # noqa: F401,E402
 from paddle_tpu.parallel.store import TCPStore, create_or_get_global_tcp_store  # noqa: F401,E402
 from paddle_tpu.parallel import checkpoint  # noqa: F401,E402
+from paddle_tpu.parallel.engine import (  # noqa: F401,E402
+    DistModel, Engine, Strategy,
+)
+from paddle_tpu.parallel.engine import to_static as dist_to_static  # noqa: F401,E402
 from paddle_tpu.parallel.checkpoint import load_state_dict, save_state_dict  # noqa: F401,E402
 from paddle_tpu.parallel.auto_tuner import AutoTuner, candidate_configs  # noqa: F401,E402
 from paddle_tpu.parallel.elastic import ElasticManager, Watchdog  # noqa: F401,E402
