@@ -146,12 +146,42 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
         if name not in metas:
             raise KeyError(f"{name} not found in checkpoint {path}")
         entry = metas[name]
-        full = _assemble(entry, path)
         if isinstance(t, Tensor):
             target_sharding = getattr(t._value, "sharding", None)
-            arr = jax.numpy.asarray(full, dtype=t._value.dtype)
-            if target_sharding is not None:
-                arr = jax.device_put(arr, target_sharding)
-            t._value = arr
+            if target_sharding is not None and entry["shape"]:
+                # shard-to-shard: assemble only each device's target slice
+                # from the on-disk shards (host peak = largest deduped
+                # local shard, never the full tensor — trillion-param
+                # scale would OOM the host otherwise; the reference
+                # reshards shard-to-shard the same way)
+                t._value = _load_sharded(entry, path, t._value.dtype,
+                                         target_sharding)
+                continue
+            full = _assemble(entry, path)
+            t._value = jax.device_put(
+                jax.numpy.asarray(full, dtype=t._value.dtype),
+                target_sharding) if target_sharding is not None else \
+                jax.numpy.asarray(full, dtype=t._value.dtype)
         else:
-            state_dict[name] = full
+            state_dict[name] = _assemble(entry, path)
+
+
+def _load_sharded(entry, path, dtype, target_sharding):
+    """Build a sharded jax.Array by reading, per addressable device, only
+    the region that device owns under `target_sharding` — shards on disk
+    and target shards may tile the tensor completely differently (mesh /
+    degree changes); `_assemble`'s region reader computes the overlaps."""
+    shape = tuple(entry["shape"])
+    idx_map = target_sharding.addressable_devices_indices_map(shape)
+    cache: Dict[str, np.ndarray] = {}
+    bufs = []
+    for dev, idx in idx_map.items():
+        want = tuple(slice(*sl.indices(dim))
+                     for sl, dim in zip(idx, shape))
+        key = _index_key(want)
+        if key not in cache:
+            cache[key] = _assemble(entry, path, want_index=want)
+        bufs.append(jax.device_put(
+            jax.numpy.asarray(cache[key], dtype=dtype), dev))
+    return jax.make_array_from_single_device_arrays(
+        shape, target_sharding, bufs)
