@@ -278,7 +278,16 @@ def _p2p_store():
 
 def send(tensor, dst=0, group=None, sync_op=True):
     """Eager p2p over the store (reference distributed.send; the
-    reference's Gloo CPU path plays the same role off-NCCL)."""
+    reference's Gloo CPU path plays the same role off-NCCL).
+
+    PERFORMANCE BOUNDARY: this channel is pickle-over-TCPStore — host
+    sockets at rendezvous speed. It exists for control-plane messages
+    (handshakes, small metadata, tests), matching the role of the
+    reference's Gloo fallback. It is NOT the activation-transfer path:
+    pipeline/tensor-parallel data movement rides in-jit XLA collectives
+    over ICI (`lax.ppermute` in parallel/pipeline*.py — the compiled
+    program never touches this store). Sending multi-MB activations here
+    would serialize through the host NIC; use the compiled path."""
     import pickle
 
     store, rank = _p2p_store()

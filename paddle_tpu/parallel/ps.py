@@ -306,6 +306,115 @@ class SparseEmbedding:
         self._pending.clear()
 
 
+class HbmEmbeddingCache:
+    """Device-resident (HBM) cache of hot embedding rows in front of a PS
+    table — the TPU analogue of the reference's HeterPs GPU cache
+    (paddle/fluid/framework/fleet/heter_ps/: hot rows live in device
+    memory, cold rows on the host PS; see heter_comm.h).
+
+    One [slots, dim] device array holds cached rows; a host-side LRU maps
+    feature id -> slot. A batch lookup splits ids into hits (served by a
+    device gather, no host traffic) and misses (ONE batched PS pull, then
+    one batched device scatter into freed slots). Rows whose gradients
+    were pushed are invalidated (the server applies its own per-row
+    optimizer, so cached copies go stale)."""
+
+    def __init__(self, slots: int, dim: int, dtype=np.float32):
+        import jax.numpy as jnp
+
+        self.slots = int(slots)
+        self.dim = int(dim)
+        self.values = jnp.zeros((self.slots, self.dim),
+                                jnp.dtype(dtype))     # device-resident
+        from collections import OrderedDict
+
+        self._lru: "OrderedDict[int, int]" = OrderedDict()  # id -> slot
+        self._free = list(range(self.slots - 1, -1, -1))
+        self.hits = 0
+        self.misses = 0
+
+    def lookup(self, uniq_ids: np.ndarray, fetch_fn):
+        """Returns a [len(uniq_ids), dim] DEVICE array; fetch_fn(miss_ids)
+        -> host rows for the ids not cached."""
+        import jax.numpy as jnp
+
+        uniq_ids = np.asarray(uniq_ids).reshape(-1)
+        slot_of = np.empty(len(uniq_ids), np.int64)
+        miss_pos: List[int] = []
+        for i, fid in enumerate(uniq_ids):
+            fid = int(fid)
+            if fid in self._lru:
+                self._lru.move_to_end(fid)
+                slot_of[i] = self._lru[fid]
+                self.hits += 1
+            else:
+                miss_pos.append(i)
+                self.misses += 1
+        if miss_pos:
+            miss_ids = uniq_ids[miss_pos]
+            rows = np.asarray(fetch_fn(miss_ids))
+            new_slots = np.empty(len(miss_pos), np.int64)
+            for j, fid in enumerate(miss_ids):
+                if not self._free:
+                    old_id, old_slot = self._lru.popitem(last=False)
+                    self._free.append(old_slot)
+                s = self._free.pop()
+                self._lru[int(fid)] = s
+                new_slots[j] = s
+            slot_of[miss_pos] = new_slots
+            # one batched scatter refreshes all missed slots in HBM
+            self.values = self.values.at[jnp.asarray(new_slots)].set(
+                jnp.asarray(rows))
+        return self.values[jnp.asarray(slot_of)]
+
+    def invalidate(self, ids) -> None:
+        for fid in np.asarray(ids).reshape(-1):
+            s = self._lru.pop(int(fid), None)
+            if s is not None:
+                self._free.append(s)
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+
+class CachedSparseEmbedding(SparseEmbedding):
+    """SparseEmbedding with an HBM hot-row cache: hit rows never touch the
+    host TCP path (reference HeterPs pull_sparse fast path)."""
+
+    def __init__(self, client, num_embeddings_hint: int, dim: int,
+                 cache_slots: int = 4096, **kw):
+        super().__init__(client, num_embeddings_hint, dim, **kw)
+        self.cache = HbmEmbeddingCache(cache_slots, dim)
+
+    def __call__(self, ids):
+        import paddle_tpu as paddle
+        from paddle_tpu.autograd.engine import is_grad_enabled
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.ops.registry import C_OPS
+
+        ids_np = np.asarray(ids._value if isinstance(ids, Tensor) else ids)
+        uniq, inverse = np.unique(ids_np, return_inverse=True)
+        rows = self.cache.lookup(
+            uniq, lambda miss: self.client.pull(self.table_id, miss))
+        w = Tensor._wrap(rows)
+        if is_grad_enabled():
+            w.stop_gradient = False
+            self._pending.append((uniq, w))
+        inv = paddle.to_tensor(inverse.reshape(ids_np.shape).astype("int32"))
+        return C_OPS.gather(w, inv.reshape([-1]), axis=0).reshape(
+            list(ids_np.shape) + [self.dim])
+
+    def push_gradients(self):
+        pushed = [uniq for uniq, w in self._pending if w.grad is not None]
+        super().push_gradients()
+        # the server just applied its optimizer to these rows — cached
+        # copies are stale now
+        for uniq in pushed:
+            self.cache.invalidate(uniq)
+
+
 # ---------------------------------------------------------------- fleet PS
 
 class PsRole:
