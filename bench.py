@@ -344,6 +344,9 @@ RUNGS = [
     ("tiny_2l256", 2, 256, 8, 512, 8192, 50, 420),
     ("mid_6l512", 6, 512, 8, 1024, 32768, 30, 420),
     ("gpt124m_12l768", 12, 768, 8, 1024, 32768, 30, 900),
+    # MFU rung: 2x batch amortizes per-step overhead and fills the MXU
+    # better at 124M scale (activation memory fits v5e with bf16 AMP)
+    ("gpt124m_b16", 12, 768, 16, 1024, 32768, 30, 900),
 ]
 
 
@@ -407,7 +410,10 @@ def main():
         line = _result_line(f"gpt_train_tokens_per_sec_{name}", r)
         emit(line)
         _cache_result(line)
-        best = line
+        # headline = highest-throughput completed rung (the b16 MFU rung
+        # should win over the b8 flagship when both finish)
+        if best is None or line["value"] >= best["value"]:
+            best = line
         log(f"rung {name}: {r['tokens_per_sec']:.0f} tok/s, "
             f"mfu={r['mfu']:.3f}, compile={r['compile_s']:.0f}s")
 
