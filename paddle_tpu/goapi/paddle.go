@@ -1,0 +1,185 @@
+// Package paddle — Go bindings for the paddle_tpu inference C API.
+//
+// Reference: paddle/fluid/inference/goapi/ (config.go, predictor.go,
+// tensor.go) over paddle_inference_c. This package wraps the same
+// PD_* surface exported by paddle_tpu/csrc/capi.cpp (libpaddle_tpu_capi),
+// so the reference's Go inference workflow ports by changing the linked
+// library:
+//
+//	cfg := paddle.NewConfig()
+//	cfg.SetModel("model.json", "model.params")
+//	pred := paddle.NewPredictor(cfg)
+//	in := pred.GetInputHandle(pred.GetInputNames()[0])
+//	in.Reshape([]int32{1, 8})
+//	in.CopyFromCpu(data)
+//	pred.Run()
+//	out := pred.GetOutputHandle(pred.GetOutputNames()[0])
+//	out.CopyToCpu(result)
+//
+// Build: CGO_LDFLAGS="-L<repo>/build -lpaddle_tpu_capi" go build
+// (this image carries no Go toolchain — the package is source-level
+// parity, exercised via the same C symbols tests/test_capi.py drives
+// from compiled C).
+package paddle
+
+/*
+#cgo LDFLAGS: -lpaddle_tpu_capi
+#include <stdint.h>
+#include <stdlib.h>
+
+typedef struct PD_Config PD_Config;
+typedef struct PD_Predictor PD_Predictor;
+typedef struct PD_Tensor PD_Tensor;
+typedef struct PD_OneDimArrayCstr {
+  size_t size;
+  char** data;
+} PD_OneDimArrayCstr;
+typedef struct PD_OneDimArrayInt32 {
+  size_t size;
+  int32_t* data;
+} PD_OneDimArrayInt32;
+
+PD_Config* PD_ConfigCreate();
+void PD_ConfigDestroy(PD_Config*);
+void PD_ConfigSetModel(PD_Config*, const char*, const char*);
+void PD_ConfigEnableLowPrecision(PD_Config*, const char*);
+PD_Predictor* PD_PredictorCreate(PD_Config*);
+void PD_PredictorDestroy(PD_Predictor*);
+PD_OneDimArrayCstr* PD_PredictorGetInputNames(PD_Predictor*);
+PD_OneDimArrayCstr* PD_PredictorGetOutputNames(PD_Predictor*);
+PD_Tensor* PD_PredictorGetInputHandle(PD_Predictor*, const char*);
+PD_Tensor* PD_PredictorGetOutputHandle(PD_Predictor*, const char*);
+int PD_PredictorRun(PD_Predictor*);
+void PD_TensorDestroy(PD_Tensor*);
+void PD_TensorReshape(PD_Tensor*, size_t, int32_t*);
+void PD_TensorCopyFromCpuFloat(PD_Tensor*, const float*);
+void PD_TensorCopyFromCpuInt64(PD_Tensor*, const int64_t*);
+void PD_TensorCopyToCpuFloat(PD_Tensor*, float*);
+void PD_TensorCopyToCpuInt64(PD_Tensor*, int64_t*);
+PD_OneDimArrayInt32* PD_TensorGetShape(PD_Tensor*);
+void PD_OneDimArrayInt32Destroy(PD_OneDimArrayInt32*);
+*/
+import "C"
+
+import (
+	"runtime"
+	"unsafe"
+)
+
+// Config mirrors the reference goapi Config (config.go:43).
+type Config struct {
+	c *C.PD_Config
+}
+
+func NewConfig() *Config {
+	cfg := &Config{c: C.PD_ConfigCreate()}
+	runtime.SetFinalizer(cfg, func(c *Config) { C.PD_ConfigDestroy(c.c) })
+	return cfg
+}
+
+// SetModel points at the serialized program + params produced by
+// paddle_tpu.static.save_inference_model.
+func (cfg *Config) SetModel(model, params string) {
+	cm, cp := C.CString(model), C.CString(params)
+	defer C.free(unsafe.Pointer(cm))
+	defer C.free(unsafe.Pointer(cp))
+	C.PD_ConfigSetModel(cfg.c, cm, cp)
+}
+
+// EnableLowPrecision selects the serving dtype ("bfloat16" / "int8") —
+// the TPU analogue of EnableUseGpu+precision in the reference config.
+func (cfg *Config) EnableLowPrecision(dtype string) {
+	cd := C.CString(dtype)
+	defer C.free(unsafe.Pointer(cd))
+	C.PD_ConfigEnableLowPrecision(cfg.c, cd)
+}
+
+// Predictor mirrors goapi predictor.go.
+type Predictor struct {
+	p *C.PD_Predictor
+}
+
+func NewPredictor(cfg *Config) *Predictor {
+	pred := &Predictor{p: C.PD_PredictorCreate(cfg.c)}
+	runtime.SetFinalizer(pred, func(p *Predictor) {
+		C.PD_PredictorDestroy(p.p)
+	})
+	return pred
+}
+
+func (p *Predictor) Run() bool {
+	return C.PD_PredictorRun(p.p) == 0
+}
+
+func cstrArray(arr *C.PD_OneDimArrayCstr) []string {
+	n := int(arr.size)
+	out := make([]string, n)
+	slice := unsafe.Slice(arr.data, n)
+	for i := 0; i < n; i++ {
+		out[i] = C.GoString(slice[i])
+	}
+	return out
+}
+
+func (p *Predictor) GetInputNames() []string {
+	return cstrArray(C.PD_PredictorGetInputNames(p.p))
+}
+
+func (p *Predictor) GetOutputNames() []string {
+	return cstrArray(C.PD_PredictorGetOutputNames(p.p))
+}
+
+func (p *Predictor) GetInputHandle(name string) *Tensor {
+	cn := C.CString(name)
+	defer C.free(unsafe.Pointer(cn))
+	return newTensor(C.PD_PredictorGetInputHandle(p.p, cn))
+}
+
+func (p *Predictor) GetOutputHandle(name string) *Tensor {
+	cn := C.CString(name)
+	defer C.free(unsafe.Pointer(cn))
+	return newTensor(C.PD_PredictorGetOutputHandle(p.p, cn))
+}
+
+// Tensor mirrors goapi tensor.go.
+type Tensor struct {
+	t *C.PD_Tensor
+}
+
+func newTensor(ct *C.PD_Tensor) *Tensor {
+	t := &Tensor{t: ct}
+	runtime.SetFinalizer(t, func(t *Tensor) { C.PD_TensorDestroy(t.t) })
+	return t
+}
+
+func (t *Tensor) Reshape(shape []int32) {
+	C.PD_TensorReshape(t.t, C.size_t(len(shape)),
+		(*C.int32_t)(unsafe.Pointer(&shape[0])))
+}
+
+func (t *Tensor) Shape() []int32 {
+	arr := C.PD_TensorGetShape(t.t)
+	defer C.PD_OneDimArrayInt32Destroy(arr)
+	return append([]int32(nil),
+		unsafe.Slice((*int32)(unsafe.Pointer(arr.data)),
+			int(arr.size))...)
+}
+
+func (t *Tensor) CopyFromCpuFloat(data []float32) {
+	C.PD_TensorCopyFromCpuFloat(t.t,
+		(*C.float)(unsafe.Pointer(&data[0])))
+}
+
+func (t *Tensor) CopyFromCpuInt64(data []int64) {
+	C.PD_TensorCopyFromCpuInt64(t.t,
+		(*C.int64_t)(unsafe.Pointer(&data[0])))
+}
+
+func (t *Tensor) CopyToCpuFloat(data []float32) {
+	C.PD_TensorCopyToCpuFloat(t.t, (*C.float)(unsafe.Pointer(&data[0])))
+}
+
+func (t *Tensor) CopyToCpuInt64(data []int64) {
+	C.PD_TensorCopyToCpuInt64(t.t,
+		(*C.int64_t)(unsafe.Pointer(&data[0])))
+}
