@@ -1425,6 +1425,65 @@ def flashmask_attention(q, k, v, startend_row_indices=None, dropout=0.0,
     return flash_attention(q, k, v, causal=causal, scale=scale, mask=mask)
 
 
+def sparse_attention(q, k, v, offset, columns, key_padding_mask=None,
+                     attn_mask=None):
+    """CSR-pattern sparse attention. Reference: the legacy sparse_attention
+    op (paddle/phi/kernels/sparse/gpu/sparse_attention via
+    nn.functional.sparse_attention): per-row allowed key columns given as
+    CSR (offset [b, h, M+1], columns [b, h, nnz]); softmax runs over only
+    those entries.
+
+    TPU lowering: the CSR pattern expands to (a) an exact additive mask
+    streamed tile-wise and (b) a tile-granular block mask — the Pallas
+    kernel SKIPS the all-dead tiles' matmuls entirely, so block-structured
+    patterns (local windows, block-diagonal, global tokens) get real
+    compute sparsity, not just masked-dense semantics.
+
+    Layout [b, num_heads, M, d] (the reference op's convention)."""
+    from paddle_tpu.ops.pallas.flash_attention import (NEG_INF,
+                                                      flash_attention)
+
+    b, h, M, d = q.shape
+    offset = offset.astype(jnp.int32)
+    columns = columns.astype(jnp.int32)
+    nnz = columns.shape[-1]
+    # row id of each CSR entry: highest r with offset[r] <= i (vectorized
+    # searchsorted per (b, h) row table)
+    flat_off = offset.reshape(b * h, M + 1)
+    flat_col = columns.reshape(b * h, nnz)
+    pos = jnp.arange(nnz)
+
+    def rows_of(off_row):
+        return jnp.searchsorted(off_row, pos, side="right") - 1
+
+    row_ids = jax.vmap(rows_of)(flat_off)                 # [b*h, nnz]
+    # entries past offset[-1] are padding; park them at row 0 masked off
+    valid = pos[None, :] < flat_off[:, -1:]
+    keep = jnp.zeros((b * h, M, M), bool)
+    bh_idx = jnp.repeat(jnp.arange(b * h), nnz)
+    keep = keep.at[bh_idx,
+                   jnp.where(valid, row_ids, 0).reshape(-1),
+                   jnp.where(valid, flat_col, 0).reshape(-1)].max(
+        valid.reshape(-1))
+    mask = jnp.where(keep.reshape(b, h, M, M), 0.0, NEG_INF
+                     ).astype(jnp.float32)
+
+    block = 128 if M % 128 == 0 else M
+    if M % block == 0:
+        nb = M // block
+        tiles = keep.reshape(b * h, nb, block, nb, block)
+        block_mask = tiles.any(axis=(0, 2, 4)).astype(jnp.int32)
+    else:
+        block_mask = None
+
+    qT = jnp.swapaxes(q, 1, 2)        # -> [b, M, h, d] kernel layout
+    kT = jnp.swapaxes(k, 1, 2)
+    vT = jnp.swapaxes(v, 1, 2)
+    out = flash_attention(qT, kT, vT, causal=False, mask=mask,
+                          block_mask=block_mask)
+    return jnp.swapaxes(out, 1, 2)
+
+
 def rotary_embedding(q, k, cos, sin, position_ids=None):
     """Reference: fused_rotary_position_embedding (incubate/nn/functional).
     q,k: [b, s, h, d]; cos/sin: [s, d] or broadcastable."""

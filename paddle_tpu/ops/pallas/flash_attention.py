@@ -117,11 +117,12 @@ def _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
     return q, k_tile, do, p, ds
 
 
-def _split_refs(refs, n_lead, has_mask, has_kbias, has_seg):
-    """Peel (mask_ref, kbias_ref, qseg_ref, kseg_ref, rest) off a flat
-    pallas ref list after the first `n_lead` fixed inputs."""
+def _split_refs(refs, n_lead, has_mask, has_kbias, has_seg,
+                has_blockmask=False):
+    """Peel (mask_ref, kbias_ref, qseg_ref, kseg_ref, bm_ref, rest) off a
+    flat pallas ref list after the first `n_lead` fixed inputs."""
     i = n_lead
-    mask_ref = kbias_ref = qseg_ref = kseg_ref = None
+    mask_ref = kbias_ref = qseg_ref = kseg_ref = bm_ref = None
     if has_mask:
         mask_ref = refs[i]
         i += 1
@@ -131,16 +132,20 @@ def _split_refs(refs, n_lead, has_mask, has_kbias, has_seg):
     if has_seg:
         qseg_ref, kseg_ref = refs[i], refs[i + 1]
         i += 2
-    return mask_ref, kbias_ref, qseg_ref, kseg_ref, refs[i:]
+    if has_blockmask:
+        bm_ref = refs[i]
+        i += 1
+    return mask_ref, kbias_ref, qseg_ref, kseg_ref, bm_ref, refs[i:]
 
 
 def _flash_fwd_kernel(*refs, block_q: int, block_k: int, causal: bool,
                       scale: float, seq_k: int, seq_q: int, has_mask: bool,
-                      has_kbias: bool, has_seg: bool, with_lse: bool):
+                      has_kbias: bool, has_seg: bool, has_blockmask: bool,
+                      with_lse: bool):
     """One grid step: fold one K/V tile into this Q block's accumulators."""
     q_ref, k_ref, v_ref = refs[:3]
-    mask_ref, kbias_ref, qseg_ref, kseg_ref, rest = _split_refs(
-        refs, 3, has_mask, has_kbias, has_seg)
+    mask_ref, kbias_ref, qseg_ref, kseg_ref, bm_ref, rest = _split_refs(
+        refs, 3, has_mask, has_kbias, has_seg, has_blockmask)
     if with_lse:
         o_ref, lse_ref, m_ref, l_ref, acc_ref = rest
     else:
@@ -160,6 +165,10 @@ def _flash_fwd_kernel(*refs, block_q: int, block_k: int, causal: bool,
     causal_offset = seq_k - seq_q
     q_start = causal_offset + qi * block_q
     live = (ki * block_k <= q_start + block_q - 1) if causal else True
+    if bm_ref is not None:
+        # block-sparse: whole (qi, ki) tiles named dead by the block mask
+        # skip their matmuls entirely (pl.when guards real FLOPs)
+        live = live & (bm_ref[qi, ki] > 0)
 
     @pl.when(live)
     def _tile():
@@ -193,13 +202,14 @@ def _flash_fwd_kernel(*refs, block_q: int, block_k: int, causal: bool,
 
 
 def _extra_inputs_specs(mask, kbias, qseg, kseg, h, block_q, block_k,
-                        order):
-    """Streamed mask/kv-bias/segment inputs + BlockSpecs for a kernel grid.
+                        order, block_mask=None):
+    """Streamed mask/kv-bias/segment/block-mask inputs + BlockSpecs.
 
     order 'qk': grid (bh, qi, ki) — fwd and the dQ kernel.
     order 'kq': grid (bh, ki, qi) — the dK/dV kernel.
     mask: [b, 1|h, sq, sk] additive fp32; kbias: [b, sk] additive fp32;
-    segs: int32 [b, sq] / [b, sk]."""
+    segs: int32 [b, sq] / [b, sk]; block_mask: int32 [nq, nk] tile
+    liveness (0 tiles are skipped — their FLOPs never run)."""
     inputs, specs = [], []
     if mask is not None:
         b, mh, sq, sk = mask.shape
@@ -229,11 +239,17 @@ def _extra_inputs_specs(mask, kbias, qseg, kseg, h, block_q, block_k,
         inputs += [qseg.astype(jnp.int32), kseg.astype(jnp.int32)]
         specs += [pl.BlockSpec((1, block_q), qidx),
                   pl.BlockSpec((1, block_k), kidx)]
+    if block_mask is not None:
+        # the whole [n_qblocks, n_kblocks] table rides in VMEM (tiny);
+        # every grid step indexes it by (qi, ki)
+        nq, nk = block_mask.shape
+        inputs.append(block_mask.astype(jnp.int32))
+        specs.append(pl.BlockSpec((nq, nk), lambda *_: (0, 0)))
     return inputs, specs
 
 
-def _flash_forward(q, k, v, mask, kbias, qseg, kseg, causal: bool,
-                   scale: float, block_q: int, block_k: int,
+def _flash_forward(q, k, v, mask, kbias, qseg, kseg, block_mask,
+                   causal: bool, scale: float, block_q: int, block_k: int,
                    interpret: bool, with_lse: bool = False):
     """q/k/v: [b, s, h, d] -> out [b, s, h, d] (+ lse [b*h, sq, LSE_LANES]
     fp32, value-broadcast across the trailing lane dim)."""
@@ -248,15 +264,17 @@ def _flash_forward(q, k, v, mask, kbias, qseg, kseg, causal: bool,
     common = dict(block_q=block_q, block_k=block_k, causal=causal,
                   scale=scale, seq_k=sk, seq_q=sq,
                   has_mask=mask is not None, has_kbias=kbias is not None,
-                  has_seg=qseg is not None, with_lse=with_lse)
+                  has_seg=qseg is not None,
+                  has_blockmask=block_mask is not None, with_lse=with_lse)
 
     scratch = [
         _scratch((block_q, 1)),
         _scratch((block_q, 1)),
         _scratch((block_q, d)),
     ]
-    extra_in, extra_specs = _extra_inputs_specs(mask, kbias, qseg, kseg, h,
-                                                block_q, block_k, "qk")
+    extra_in, extra_specs = _extra_inputs_specs(
+        mask, kbias, qseg, kseg, h, block_q, block_k, "qk",
+        block_mask=block_mask)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
         pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
@@ -292,12 +310,13 @@ def _scratch(shape):
 
 
 def _flash_bwd_dq_kernel(*refs, block_q, block_k, causal, scale, seq_k,
-                         seq_q, has_mask, has_kbias, has_seg):
+                         seq_q, has_mask, has_kbias, has_seg,
+                         has_blockmask):
     """dQ_i = scale * sum_j dS_ij K_j, dS = P * (dO V^T - delta).
     Grid (bh, qi, ki); accumulate over ki in VMEM scratch."""
     q_ref, k_ref, v_ref, do_ref = refs[:4]
-    mask_ref, kbias_ref, qseg_ref, kseg_ref, rest = _split_refs(
-        refs, 4, has_mask, has_kbias, has_seg)
+    mask_ref, kbias_ref, qseg_ref, kseg_ref, bm_ref, rest = _split_refs(
+        refs, 4, has_mask, has_kbias, has_seg, has_blockmask)
     lse_ref, delta_ref, dq_ref, acc_ref = rest
     d = q_ref.shape[-1]
     qi = pl.program_id(1)
@@ -311,6 +330,8 @@ def _flash_bwd_dq_kernel(*refs, block_q, block_k, causal, scale, seq_k,
     causal_offset = seq_k - seq_q
     q_start = causal_offset + qi * block_q
     live = (ki * block_k <= q_start + block_q - 1) if causal else True
+    if bm_ref is not None:
+        live = live & (bm_ref[qi, ki] > 0)
 
     @pl.when(live)
     def _tile():
@@ -328,12 +349,13 @@ def _flash_bwd_dq_kernel(*refs, block_q, block_k, causal, scale, seq_k,
 
 
 def _flash_bwd_dkv_kernel(*refs, block_q, block_k, causal, scale, seq_k,
-                          seq_q, has_mask, has_kbias, has_seg):
+                          seq_q, has_mask, has_kbias, has_seg,
+                          has_blockmask):
     """dV_j = P^T dO; dK_j = scale * dS^T Q. Grid (bh, ki, qi); accumulate
     over qi in VMEM scratch."""
     q_ref, k_ref, v_ref, do_ref = refs[:4]
-    mask_ref, kbias_ref, qseg_ref, kseg_ref, rest = _split_refs(
-        refs, 4, has_mask, has_kbias, has_seg)
+    mask_ref, kbias_ref, qseg_ref, kseg_ref, bm_ref, rest = _split_refs(
+        refs, 4, has_mask, has_kbias, has_seg, has_blockmask)
     lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
     d = q_ref.shape[-1]
     ki = pl.program_id(1)
@@ -349,6 +371,8 @@ def _flash_bwd_dkv_kernel(*refs, block_q, block_k, causal, scale, seq_k,
     q_start = causal_offset + qi * block_q
     # this q block contributes iff its LAST query can see this k tile
     live = (q_start + block_q - 1 >= ki * block_k) if causal else True
+    if bm_ref is not None:
+        live = live & (bm_ref[qi, ki] > 0)
 
     @pl.when(live)
     def _tile():
@@ -369,8 +393,9 @@ def _flash_bwd_dkv_kernel(*refs, block_q, block_k, causal, scale, seq_k,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, o, do, lse, mask, kbias, qseg, kseg, causal,
-                    scale, block_q, block_k, interpret):
+def _flash_backward(q, k, v, o, do, lse, mask, kbias, qseg, kseg,
+                    block_mask, causal, scale, block_q, block_k,
+                    interpret):
     """Returns (dq, dk, dv) in the [b, s, h, d] layout."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
@@ -385,11 +410,13 @@ def _flash_backward(q, k, v, o, do, lse, mask, kbias, qseg, kseg, causal,
     common = dict(block_q=block_q, block_k=block_k, causal=causal,
                   scale=scale, seq_k=sk, seq_q=sq,
                   has_mask=mask is not None, has_kbias=kbias is not None,
-                  has_seg=qseg is not None)
+                  has_seg=qseg is not None,
+                  has_blockmask=block_mask is not None)
 
     # ---- dQ: grid (bh, qi, ki) -------------------------------------------
-    extra_in, extra_specs = _extra_inputs_specs(mask, kbias, qseg, kseg, h,
-                                                block_q, block_k, "qk")
+    extra_in, extra_specs = _extra_inputs_specs(
+        mask, kbias, qseg, kseg, h, block_q, block_k, "qk",
+        block_mask=block_mask)
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, **common),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
@@ -412,8 +439,9 @@ def _flash_backward(q, k, v, o, do, lse, mask, kbias, qseg, kseg, causal,
     )(qf, kf, vf, dof, *extra_in, lse, delta)
 
     # ---- dK/dV: grid (bh, ki, qi) ----------------------------------------
-    extra_in, extra_specs = _extra_inputs_specs(mask, kbias, qseg, kseg, h,
-                                                block_q, block_k, "kq")
+    extra_in, extra_specs = _extra_inputs_specs(
+        mask, kbias, qseg, kseg, h, block_q, block_k, "kq",
+        block_mask=block_mask)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, **common),
         out_shape=(jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
@@ -477,28 +505,28 @@ def _zero_cot(x):
     return jnp.zeros_like(x)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
-def _flash(q, k, v, mask, kbias, qseg, kseg, causal, scale, block_q,
-           block_k, interpret):
-    return _flash_forward(q, k, v, mask, kbias, qseg, kseg, causal, scale,
-                          block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11, 12))
+def _flash(q, k, v, mask, kbias, qseg, kseg, block_mask, causal, scale,
+           block_q, block_k, interpret):
+    return _flash_forward(q, k, v, mask, kbias, qseg, kseg, block_mask,
+                          causal, scale, block_q, block_k, interpret)
 
 
-def _flash_fwd(q, k, v, mask, kbias, qseg, kseg, causal, scale, block_q,
-               block_k, interpret):
-    out, lse = _flash_forward(q, k, v, mask, kbias, qseg, kseg, causal,
-                              scale, block_q, block_k, interpret,
+def _flash_fwd(q, k, v, mask, kbias, qseg, kseg, block_mask, causal,
+               scale, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, mask, kbias, qseg, kseg, block_mask,
+                              causal, scale, block_q, block_k, interpret,
                               with_lse=True)
-    return out, (q, k, v, mask, kbias, qseg, kseg, out, lse)
+    return out, (q, k, v, mask, kbias, qseg, kseg, block_mask, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, mask, kbias, qseg, kseg, o, lse = res
+    q, k, v, mask, kbias, qseg, kseg, block_mask, o, lse = res
     dq, dk, dv = _flash_backward(q, k, v, o, g, lse, mask, kbias, qseg,
-                                 kseg, causal, scale, block_q, block_k,
-                                 interpret)
+                                 kseg, block_mask, causal, scale, block_q,
+                                 block_k, interpret)
     return (dq, dk, dv, _zero_cot(mask), _zero_cot(kbias),
-            _zero_cot(qseg), _zero_cot(kseg))
+            _zero_cot(qseg), _zero_cot(kseg), _zero_cot(block_mask))
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -613,8 +641,8 @@ def validate_against_reference(shapes=DEFAULT_CHECK_SHAPES, interpret=None,
         def f_f(q, k, v, mask=mask, kbias=kbias, segs=segs, causal=causal,
                 scale=scale):
             qs, ks = (segs, segs) if segs is not None else (None, None)
-            return _flash(q, k, v, mask, kbias, qs, ks, causal, scale,
-                          128, 128, interpret)
+            return _flash(q, k, v, mask, kbias, qs, ks, None, causal,
+                          scale, 128, 128, interpret)
 
         def f_r(q, k, v, mask=mask, kbias=kbias, segs=segs, causal=causal,
                 scale=scale):
@@ -655,7 +683,7 @@ def _log_fallback(q, k, block_q, block_k):
 
 
 def flash_attention(q, k, v, causal: bool = True, scale=None,
-                    mask=None, segment_ids=None,
+                    mask=None, segment_ids=None, block_mask=None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool | None = None):
@@ -667,7 +695,13 @@ def flash_attention(q, k, v, causal: bool = True, scale=None,
     [b, 1|h, sq, sk] — streamed tile-wise into the kernel; key-padding
     forms ([*, *, 1, sk]) are lowered to an O(s) per-key bias.
     segment_ids: int [b, s] or (q_seg [b, sq], kv_seg [b, sk]) — varlen /
-    packed-sequence masking with O(s) memory (attend iff ids equal)."""
+    packed-sequence masking with O(s) memory (attend iff ids equal).
+    block_mask: int/bool [sq//block_q, sk//block_k] tile liveness —
+    dead tiles' FLOPs are skipped entirely (block-sparse attention). The
+    block mask must be IMPLIED by the elementwise masks (a tile marked
+    dead must already be fully masked by mask/segments/causal), otherwise
+    results diverge from the dense computation; callers like
+    sparse_attention derive both from the same pattern."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -681,6 +715,12 @@ def flash_attention(q, k, v, causal: bool = True, scale=None,
     qseg = kseg = None
     if segment_ids is not None:
         qseg, kseg = _canon_segments(segment_ids, b, sq, sk)
+    if block_mask is not None:
+        block_mask = jnp.asarray(block_mask, jnp.int32)
+        if block_mask.shape != (sq // block_q, sk // block_k):
+            raise ValueError(
+                f"block_mask {block_mask.shape} != tile grid "
+                f"({sq // block_q}, {sk // block_k})")
     if causal and sq > sk:
         # bottom-right alignment gives early queries ZERO visible keys —
         # handled by the masked-row guard, but parity with the XLA path is
@@ -690,5 +730,5 @@ def flash_attention(q, k, v, causal: bool = True, scale=None,
     if not _block_shapes_ok(q, k, block_q, block_k, v=v):
         _log_fallback(q, k, block_q, block_k)
         return _reference(q, k, v, causal, scale, mask, kbias, qseg, kseg)
-    return _flash(q, k, v, mask, kbias, qseg, kseg, causal, scale, block_q,
-                  block_k, interpret)
+    return _flash(q, k, v, mask, kbias, qseg, kseg, block_mask, causal,
+                  scale, block_q, block_k, interpret)

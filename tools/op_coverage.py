@@ -96,7 +96,6 @@ COLLAPSED = {
     "variable_length_memory_efficient_attention": "sdp_attention",
     "calc_reduced_attn_scores": "sdp_attention",
     "masked_multihead_attention_": "models.generation masked decode",
-    "sparse_attention": "sdp_attention (dense fallback)",
     "fused_softmax_mask": "XLA fusion", "fused_softmax_mask_upper_triangle":
         "XLA fusion", "fused_batch_norm_act": "XLA fusion",
     "fused_bn_add_activation": "XLA fusion",
