@@ -153,3 +153,87 @@ class LRSchedulerCallback(Callback):
         s = self._sched()
         if self.by_step and s is not None:
             s.step()
+
+
+class ReduceLROnPlateau(Callback):
+    """Shrink the LR when a monitored metric stops improving (reference
+    hapi/callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "max" or (mode == "auto" and ("acc" in monitor
+                                                 or "auc" in monitor)):
+            self.better = lambda c, b: c > b + self.min_delta
+            self.best = -float("inf")
+        else:
+            self.better = lambda c, b: c < b - self.min_delta
+            self.best = float("inf")
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self.better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = self.model._optimizer
+            old = float(opt.get_lr())
+            new = max(old * self.factor, self.min_lr)
+            if new < old:
+                opt.set_lr(new)
+                if self.verbose:
+                    print(f"[ReduceLROnPlateau] epoch {epoch}: "
+                          f"lr {old:.2e} -> {new:.2e}")
+            self.cooldown_counter = self.cooldown
+            self.wait = 0
+
+
+class VisualDL(Callback):
+    """Scalar logging callback (reference hapi/callbacks.py VisualDL).
+    The VisualDL service itself needs egress; this writer emits the same
+    per-step/per-epoch scalars as JSONL under log_dir, which the real
+    VisualDL (or anything else) can ingest offline."""
+
+    def __init__(self, log_dir="vdl_log"):
+        self.log_dir = log_dir
+        self._fh = None
+
+    def _write(self, kind, step, logs):
+        import json
+        import os
+
+        if self._fh is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._fh = open(os.path.join(self.log_dir, "scalars.jsonl"),
+                            "a")
+        rec = {"kind": kind, "step": step}
+        rec.update({k: float(v) for k, v in (logs or {}).items()
+                    if isinstance(v, (int, float))})
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._write("batch", step, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._write("epoch", epoch, logs)
+
+    def on_train_end(self, logs=None):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

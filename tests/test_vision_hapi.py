@@ -117,3 +117,47 @@ def test_summary():
     net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
     info = paddle.summary(net, (1, 8))
     assert info["total_params"] == 8 * 16 + 16 + 16 * 2 + 2
+
+
+def test_reduce_lr_on_plateau_callback():
+    from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+
+    class FakeOpt:
+        def __init__(self):
+            self.lr = 0.1
+
+        def get_lr(self):
+            return self.lr
+
+        def set_lr(self, v):
+            self.lr = v
+
+    class FakeModel:
+        _optimizer = FakeOpt()
+        stop_training = False
+
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                           verbose=0)
+    cb.set_model(FakeModel)
+    cb.on_epoch_end(0, {"loss": 1.0})
+    cb.on_epoch_end(1, {"loss": 1.0})   # wait 1
+    cb.on_epoch_end(2, {"loss": 1.0})   # wait 2 -> reduce
+    assert abs(FakeModel._optimizer.get_lr() - 0.05) < 1e-9
+    cb.on_epoch_end(3, {"loss": 0.5})   # improvement resets
+    cb.on_epoch_end(4, {"loss": 0.5})
+    assert abs(FakeModel._optimizer.get_lr() - 0.05) < 1e-9
+
+
+def test_visualdl_callback_writes_scalars(tmp_path):
+    import json
+
+    from paddle_tpu.hapi.callbacks import VisualDL
+
+    cb = VisualDL(log_dir=str(tmp_path / "vdl"))
+    cb.on_train_batch_end(0, {"loss": 1.5, "step": 0})
+    cb.on_epoch_end(0, {"loss": 1.2, "eval_acc": 0.7})
+    cb.on_train_end()
+    lines = [json.loads(l) for l in
+             (tmp_path / "vdl" / "scalars.jsonl").read_text().splitlines()]
+    assert lines[0]["kind"] == "batch" and lines[0]["loss"] == 1.5
+    assert lines[1]["kind"] == "epoch" and lines[1]["eval_acc"] == 0.7
