@@ -182,11 +182,14 @@ class ReduceLROnPlateau(Callback):
         cur = (logs or {}).get(self.monitor)
         if cur is None:
             return
-        if self.cooldown_counter > 0:
-            self.cooldown_counter -= 1
-            self.wait = 0
         if self.better(cur, self.best):
             self.best = cur
+            self.wait = 0
+            return
+        if self.cooldown_counter > 0:
+            # in cooldown: the LR just changed — don't count this epoch
+            # toward patience (Keras/reference semantics)
+            self.cooldown_counter -= 1
             self.wait = 0
             return
         self.wait += 1

@@ -276,16 +276,26 @@ class OptimizerWithSparsityGuarantee:
     """Wraps an optimizer: after every step, re-apply the pruning masks so
     updated weights stay n:m sparse (reference asp.py:949 — the reference
     masks via fused momentum ops; masking the post-step weight is the same
-    fixed point)."""
+    fixed point). Only masks belonging to THIS optimizer's parameters are
+    applied — pruning model B must not let A's step re-zero B's weights."""
 
     def __init__(self, optimizer):
         self._optimizer = optimizer
+        self._pairs = None
+
+    def _my_pairs(self):
+        if self._pairs is None:
+            own = {id(p) for p in getattr(self._optimizer,
+                                          "_parameter_list", [])}
+            self._pairs = [
+                (p, m) for pairs in _MASK_PAIRS.values()
+                for p, m in pairs if not own or id(p) in own]
+        return self._pairs
 
     def step(self):
         self._optimizer.step()
-        for pairs in _MASK_PAIRS.values():
-            for p, mask in pairs:
-                p._value = p._value * mask
+        for p, mask in self._my_pairs():
+            p._value = p._value * mask
 
     def __getattr__(self, name):
         return getattr(self._optimizer, name)

@@ -141,7 +141,12 @@ def pyramid_hash(x, w, white_list: Optional[Set[tuple]] = None,
     kept_positions: List[List[int]] = []   # per kept n-gram
     out_offsets = [0]
     drop_flags: List[int] = []
+    # NB: mirroring the reference contract exactly (pyramid_hash_kernel.cc
+    # drop_pos_offset): drop_flags holds one entry per CANDIDATE gram,
+    # while drop_offsets accumulate KEPT counts — the offsets partition
+    # the output rows, not the flag array.
     drop_offsets = [0]
+    kept_total = 0
     zero_rows: List[int] = []              # row indices that stay zero
     for s in seqs:
         ww = len(s)
@@ -168,7 +173,8 @@ def pyramid_hash(x, w, white_list: Optional[Set[tuple]] = None,
                     kept_positions.append(_gram_positions(
                         gram_f32, num_emb, rand_len, space_len))
                     kept_here += 1
-        drop_offsets.append(len([f for f in drop_flags if f]))
+        kept_total += kept_here
+        drop_offsets.append(kept_total)
         if kept_here == 0:
             zero_rows.append(out_offsets[-1])
             out_offsets.append(out_offsets[-1] + 1)

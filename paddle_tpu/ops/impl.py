@@ -1437,7 +1437,12 @@ def sparse_attention(q, k, v, offset, columns, key_padding_mask=None,
     streamed tile-wise and (b) a tile-granular block mask — the Pallas
     kernel SKIPS the all-dead tiles' matmuls entirely, so block-structured
     patterns (local windows, block-diagonal, global tokens) get real
-    compute sparsity, not just masked-dense semantics.
+    compute sparsity, not just masked-dense semantics. Memory note: the
+    expanded elementwise mask is O(b*h*M^2) HBM (arbitrary CSR patterns
+    need it — the same bound as the reference's dense-mask route);
+    compute is what the block mask sparsifies. key_padding_mask [b, M] (1 = keep) and additive
+    attn_mask [b, h|1, M, M] compose with the pattern as in the
+    reference.
 
     Layout [b, num_heads, M, d] (the reference op's convention)."""
     from paddle_tpu.ops.pallas.flash_attention import (NEG_INF,
@@ -1465,8 +1470,19 @@ def sparse_attention(q, k, v, offset, columns, key_padding_mask=None,
                    jnp.where(valid, row_ids, 0).reshape(-1),
                    jnp.where(valid, flat_col, 0).reshape(-1)].max(
         valid.reshape(-1))
-    mask = jnp.where(keep.reshape(b, h, M, M), 0.0, NEG_INF
-                     ).astype(jnp.float32)
+    keep = keep.reshape(b, h, M, M)
+    if key_padding_mask is not None:
+        kpm = jnp.asarray(key_padding_mask)
+        if kpm.dtype != jnp.bool_:
+            kpm = kpm > 0
+        keep = keep & kpm[:, None, None, :]            # [b, M] key-side
+    mask = jnp.where(keep, 0.0, NEG_INF).astype(jnp.float32)
+    if attn_mask is not None:
+        am = jnp.asarray(attn_mask)
+        if am.dtype == jnp.bool_:
+            am = jnp.where(am, 0.0, NEG_INF)
+        mask = mask + am.astype(jnp.float32)           # additive compose
+    keep = keep & (mask > NEG_INF * 0.5)               # for the block mask
 
     block = 128 if M % 128 == 0 else M
     if M % block == 0:

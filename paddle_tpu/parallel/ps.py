@@ -335,10 +335,18 @@ class HbmEmbeddingCache:
 
     def lookup(self, uniq_ids: np.ndarray, fetch_fn):
         """Returns a [len(uniq_ids), dim] DEVICE array; fetch_fn(miss_ids)
-        -> host rows for the ids not cached."""
+        -> host rows for the ids not cached. Ids touched by the CURRENT
+        batch are pinned — eviction can never reclaim a slot another id of
+        this very lookup resolved to (a batch larger than the cache
+        bypasses caching instead of corrupting it)."""
         import jax.numpy as jnp
 
         uniq_ids = np.asarray(uniq_ids).reshape(-1)
+        if len(uniq_ids) > self.slots:
+            # cannot pin the whole batch: serve it straight from the PS
+            self.misses += len(uniq_ids)
+            return jnp.asarray(np.asarray(fetch_fn(uniq_ids)))
+        pinned = {int(f) for f in uniq_ids}
         slot_of = np.empty(len(uniq_ids), np.int64)
         miss_pos: List[int] = []
         for i, fid in enumerate(uniq_ids):
@@ -356,8 +364,13 @@ class HbmEmbeddingCache:
             new_slots = np.empty(len(miss_pos), np.int64)
             for j, fid in enumerate(miss_ids):
                 if not self._free:
-                    old_id, old_slot = self._lru.popitem(last=False)
-                    self._free.append(old_slot)
+                    # evict the least-recent UNPINNED id (pinned ones are
+                    # in use by this batch); one must exist because
+                    # len(batch) <= slots
+                    for old_id in self._lru:
+                        if old_id not in pinned:
+                            break
+                    self._free.append(self._lru.pop(old_id))
                 s = self._free.pop()
                 self._lru[int(fid)] = s
                 new_slots[j] = s

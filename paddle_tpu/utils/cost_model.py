@@ -122,6 +122,18 @@ class OpLatencyTable:
                     for a in args)
         return f"{name}{sig}"
 
+    @staticmethod
+    def _fence(out) -> None:
+        """True host-readback fence: the axon tunnel ACKs
+        block_until_ready before execution completes (bench.py documents
+        the failure mode), so timing boundaries read one scalar back."""
+        import jax
+        import numpy as np_
+
+        for leaf in jax.tree_util.tree_leaves(out):
+            if hasattr(leaf, "ravel") and getattr(leaf, "size", 0):
+                np_.asarray(leaf.ravel()[0])
+
     def measure(self, name: str, fn: Callable, *args, iters: int = 5,
                 warmup: int = 2) -> float:
         import jax
@@ -129,14 +141,14 @@ class OpLatencyTable:
         key = self._key(name, args)
         jitted = jax.jit(fn)
         out = jitted(*args)
-        jax.block_until_ready(out)
+        self._fence(out)
         for _ in range(warmup):
             out = jitted(*args)
-        jax.block_until_ready(out)
+        self._fence(out)
         t0 = time.perf_counter()
         for _ in range(iters):
             out = jitted(*args)
-        jax.block_until_ready(out)
+        self._fence(out)
         ms = (time.perf_counter() - t0) / iters * 1e3
         self.table[key] = ms
         return ms

@@ -302,3 +302,20 @@ def test_hbm_cache_invalidated_after_push(ps):
     after = np.asarray(emb(ids)._value)
     assert not np.allclose(after, before)   # sgd moved the server rows
     np.testing.assert_allclose(after, before - 0.5, atol=1e-5)
+
+
+def test_hbm_cache_in_batch_eviction_is_safe(ps):
+    """Review finding: a miss must never evict a slot another id of the
+    SAME batch resolved to; oversized batches bypass the cache."""
+    from paddle_tpu.parallel.ps import CachedSparseEmbedding
+
+    server, client = ps
+    emb = CachedSparseEmbedding(client, 100, 4, cache_slots=2, table_id=94)
+    a_ref = np.asarray(emb(paddle.to_tensor(np.array([1])))._value)
+    # batch [1, 2, 3]: exceeds slots=2 -> direct fetch, values correct
+    out = np.asarray(emb(paddle.to_tensor(np.array([1, 2, 3])))._value)
+    np.testing.assert_allclose(out[0], a_ref[0])
+    # batch [1, 4] within capacity: miss 4 must evict 2/3-era entries,
+    # never id 1's slot (1 is pinned by this batch)
+    out2 = np.asarray(emb(paddle.to_tensor(np.array([1, 4])))._value)
+    np.testing.assert_allclose(out2[0], a_ref[0])

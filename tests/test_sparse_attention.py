@@ -105,3 +105,26 @@ def test_block_mask_actually_skips_tiles(monkeypatch):
     sparse_attention(q, k, v, off, cols)
     bm = np.asarray(got["bm"])
     np.testing.assert_array_equal(bm, [[1, 0], [0, 1]])
+
+
+def test_key_padding_and_attn_mask_compose():
+    """Review finding: the masks were accepted but ignored."""
+    b, h, M, d = 1, 1, 128, 32
+    q, k, v = _qkv(b, h, M, d)
+    keep = np.ones((b, h, M, M), bool)
+    off, cols = _csr_from_dense(keep)
+    kpm = np.ones((b, M), np.int32)
+    kpm[:, 64:] = 0                       # keys 64+ padded out
+    out = sparse_attention(q, k, v, off, cols,
+                           key_padding_mask=jnp.asarray(kpm))
+    keep2 = keep & (kpm[:, None, None, :] > 0)
+    ref = _dense_ref(q, k, v, keep2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+    am = np.zeros((b, h, M, M), np.float32)
+    am[:, :, :, :32] = -1e30              # additive mask kills first 32
+    out = sparse_attention(q, k, v, off, cols,
+                           attn_mask=jnp.asarray(am))
+    ref = _dense_ref(q, k, v, keep & (am > -1e29))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)

@@ -40,7 +40,13 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 PHASES = [
-    # (phase, substrings matched against HLO event names, lowercased)
+    # (phase, substrings matched against HLO event names, lowercased).
+    # ORDER MATTERS: collectives must match before the elementwise/gather
+    # buckets ("all-reduce" contains "reduce", "all-gather" contains
+    # "gather"), attention before matmul.
+    ("collectives", ("all-reduce", "all-gather", "all-to-all",
+                     "reduce-scatter", "collective", "psum",
+                     "permute")),
     ("attention", ("flash", "attention", "softmax", "reduce-window",
                    "cumulative_logsumexp")),
     ("matmul/other", ("dot", "matmul", "einsum", "convolution")),
@@ -51,9 +57,6 @@ PHASES = [
                                "loop_fusion", "input_fusion",
                                "output_fusion", "reduce", "select",
                                "compare", "exponential", "tanh", "rng")),
-    ("collectives", ("all-reduce", "all-gather", "all-to-all",
-                     "reduce-scatter", "collective", "psum",
-                     "permute")),
     ("copy/infeed", ("copy", "infeed", "outfeed", "transpose",
                      "bitcast", "broadcast", "reshape", "convert",
                      "slice", "concatenate", "pad")),
