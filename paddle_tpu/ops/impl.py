@@ -1367,6 +1367,32 @@ def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
     return out[0, :tq]
 
 
+def flash_attn(q, k, v, dropout=0.0, causal=False):
+    """Reference flash_attn op (ops.yaml): the base dense form — same
+    dispatch as scaled_dot_product_attention (Pallas kernel when shapes
+    tile and the gate is open)."""
+    return scaled_dot_product_attention(q, k, v, dropout_p=dropout,
+                                        is_causal=causal)
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False):
+    """Packed [b, s, 3, h, d] form (reference flash_attn_qkvpacked)."""
+    return flash_attn(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                      dropout=dropout, causal=causal)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q=None, max_seqlen_k=None,
+                                scale=None, dropout=0.0, causal=False):
+    """Packed varlen [total, 3, h, d] form (reference
+    flash_attn_varlen_qkvpacked) — lowers onto flash_attn_unpadded's
+    segment-id kernel path."""
+    return flash_attn_unpadded(
+        qkv[:, 0], qkv[:, 1], qkv[:, 2], cu_seqlens_q, cu_seqlens_k,
+        max_seqlen_q=max_seqlen_q, max_seqlen_k=max_seqlen_k, scale=scale,
+        dropout=dropout, causal=causal)
+
+
 def flashmask_attention(q, k, v, startend_row_indices=None, dropout=0.0,
                         causal=False, window_size=None):
     """FlashMask column-sparse attention masks. Reference:

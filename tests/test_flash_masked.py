@@ -236,3 +236,31 @@ class TestUnpaddedAndFlashmask:
                          mask=m.astype(jnp.float32))
         np.testing.assert_allclose(np.asarray(out._value), np.asarray(ref),
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestPackedVariants:
+    def test_qkvpacked_matches_unpacked(self):
+        from paddle_tpu.ops.impl import flash_attn, flash_attn_qkvpacked
+
+        q, k, v = _qkv(b=1, s=128, h=2)
+        qkv = jnp.stack([q, k, v], axis=2)      # [b, s, 3, h, d]
+        np.testing.assert_allclose(
+            np.asarray(flash_attn_qkvpacked(qkv, causal=True)),
+            np.asarray(flash_attn(q, k, v, causal=True)),
+            rtol=1e-5)
+
+    def test_varlen_qkvpacked_matches_unpadded(self):
+        from paddle_tpu.ops.impl import (flash_attn_unpadded,
+                                         flash_attn_varlen_qkvpacked)
+
+        total, h, d = 96, 2, 32
+        cu = jnp.asarray(np.array([0, 40, 96], np.int32))
+        qkv = jnp.asarray(rng.standard_normal((total, 3, h, d)),
+                          jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(flash_attn_varlen_qkvpacked(
+                qkv, cu, cu, 56, 56, causal=True)),
+            np.asarray(flash_attn_unpadded(
+                qkv[:, 0], qkv[:, 1], qkv[:, 2], cu, cu, 56, 56,
+                causal=True)),
+            rtol=1e-5)

@@ -88,10 +88,6 @@ COLLAPSED = {
         "SelectedRows collapse", "merge_selected_rows": "SelectedRows",
     "lookup_table_dequant": "PS world (scheduled last)",
     # attention variants -> ops/pallas flash attention + sdp
-    "flash_attn": "ops.pallas.flash_attention",
-    "flash_attn_qkvpacked": "ops.pallas.flash_attention",
-    "flash_attn_varlen_qkvpacked": "ops.pallas.flash_attention "
-        "(flash_attn_unpadded handles the unpacked form)",
     "memory_efficient_attention": "nn.functional.sdp_attention",
     "variable_length_memory_efficient_attention": "sdp_attention",
     "calc_reduced_attn_scores": "sdp_attention",
