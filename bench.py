@@ -250,7 +250,9 @@ def _time_and_write(step, args, n_params, tokens_per_step, iters, backend,
 def child_ernie(layers: int, hidden: int, batch: int, seq: int, vocab: int,
                 iters: int):
     """ERNIE-3.0-base MLM+SOP pretrain step — the BASELINE.json headline
-    metric ("ERNIE-3.0-base tokens/sec/chip")."""
+    metric ("ERNIE-3.0-base tokens/sec/chip"). Batches carry realistic
+    PADDING (85-100% fill), so the attention path is the Pallas kernel's
+    kv-bias masked lane, exactly like production pretraining."""
     import jax
     import numpy as np
 
@@ -270,12 +272,18 @@ def child_ernie(layers: int, hidden: int, batch: int, seq: int, vocab: int,
     opt = paddle.optimizer.AdamW(parameters=model.parameters(),
                                  learning_rate=1e-4, weight_decay=0.01)
     step = paddle.jit.TrainStep(model, ernie_pretrain_loss_fn, opt,
-                                amp_level="O1", amp_dtype="bfloat16")
+                                n_inputs=3, amp_level="O1",
+                                amp_dtype="bfloat16")
     rng = np.random.default_rng(0)
     base = rng.integers(5, vocab, (batch, seq))
     ids, labels = mask_tokens(base, vocab, rng)
+    lens = rng.integers(int(seq * 0.85), seq + 1, (batch,))
+    att = (np.arange(seq)[None, :] < lens[:, None]).astype(np.int64)
+    labels = np.where(att > 0, labels, -100)   # no loss on pad positions
+    tok_types = np.zeros((batch, seq), np.int64)
     sop = rng.integers(0, 2, (batch,))
-    args = (paddle.to_tensor(ids), paddle.to_tensor(labels),
+    args = (paddle.to_tensor(ids), paddle.to_tensor(tok_types),
+            paddle.to_tensor(att), paddle.to_tensor(labels),
             paddle.to_tensor(sop))
     _time_and_write(step, args, n_params, batch * seq, iters, backend,
                     layers=layers, hidden=hidden, batch=batch, seq=seq)
