@@ -1,13 +1,60 @@
-"""paddle.hub — model loading from local repos (reference:
-python/paddle/hapi/hub.py). Zero-egress environment: only source='local'."""
+"""paddle.hub — hubconf-based model loading.
+
+Reference: python/paddle/hapi/hub.py (list/help/load over a hubconf.py,
+sources local/github/gitee with a download cache).
+
+Zero-egress environment: 'github'/'gitee' sources resolve ONLY against a
+pre-populated cache directory (the reference's download target,
+~/.cache/paddle/hub or $PADDLE_HUB_DIR) — the same repo layout the
+reference's downloader produces. A cache miss raises a clear error
+instead of attempting network IO.
+"""
 from __future__ import annotations
 
 import importlib.util
 import os
-import sys
+
+HUB_DIR_ENV = "PADDLE_HUB_DIR"
 
 
-def _load_hubconf(repo_dir):
+def _hub_cache_dir() -> str:
+    return os.environ.get(
+        HUB_DIR_ENV,
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle", "hub"))
+
+
+def _parse_repo(repo: str):
+    """'owner/name[:branch]' -> (owner, name, branch) (reference
+    hub.py _parse_repo_info; default branch 'main')."""
+    branch = "main"
+    if ":" in repo:
+        repo, branch = repo.split(":", 1)
+    if repo.count("/") != 1:
+        raise ValueError(
+            f"repo must look like owner/name[:branch], got {repo!r}")
+    owner, name = repo.split("/")
+    return owner, name, branch
+
+
+def _resolve_repo_dir(repo_dir: str, source: str) -> str:
+    if source == "local":
+        return repo_dir
+    if source not in ("github", "gitee"):
+        raise ValueError(
+            f"unknown source {source!r}: expected local/github/gitee")
+    owner, name, branch = _parse_repo(repo_dir)
+    # the reference extracts to <hub_dir>/<owner>_<name>_<branch>
+    cached = os.path.join(_hub_cache_dir(), f"{owner}_{name}_{branch}")
+    if os.path.isdir(cached):
+        return cached
+    raise RuntimeError(
+        f"hub cache miss for {source}:{repo_dir} — this environment has "
+        f"no egress; pre-populate {cached} with the repo contents (the "
+        "layout the reference downloader produces) or use source='local'")
+
+
+def _load_hubconf(repo_dir: str, source: str):
+    repo_dir = _resolve_repo_dir(repo_dir, source)
     path = os.path.join(repo_dir, "hubconf.py")
     if not os.path.exists(path):
         raise FileNotFoundError(f"no hubconf.py in {repo_dir}")
@@ -17,18 +64,24 @@ def _load_hubconf(repo_dir):
     return mod
 
 
-def list(repo_dir, source="local"):  # noqa: A001
-    assert source == "local", "only source='local' (no egress)"
-    mod = _load_hubconf(repo_dir)
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """Entry points exported by the repo's hubconf.py (reference
+    hub.list)."""
+    mod = _load_hubconf(repo_dir, source)
     return [n for n in dir(mod)
             if callable(getattr(mod, n)) and not n.startswith("_")]
 
 
-def help(repo_dir, model, source="local"):  # noqa: A001
-    assert source == "local"
-    return getattr(_load_hubconf(repo_dir), model).__doc__
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    """Docstring of a hub entry point (reference hub.help)."""
+    return getattr(_load_hubconf(repo_dir, source), model).__doc__
 
 
-def load(repo_dir, model, source="local", **kwargs):
-    assert source == "local", "only source='local' (no egress)"
-    return getattr(_load_hubconf(repo_dir), model)(**kwargs)
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """Instantiate a hub entry point (reference hub.load)."""
+    mod = _load_hubconf(repo_dir, source)
+    if not hasattr(mod, model):
+        raise ValueError(
+            f"model {model!r} not found; available: "
+            f"{[n for n in dir(mod) if not n.startswith('_')]}")
+    return getattr(mod, model)(**kwargs)

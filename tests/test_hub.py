@@ -1,0 +1,62 @@
+"""paddle.hub: local + cached-github sources (reference hapi/hub.py)."""
+
+import os
+
+import pytest
+
+from paddle_tpu import hub
+
+HUBCONF = '''
+def linear_model(width=4):
+    """A tiny linear model entry point."""
+    import paddle_tpu.nn as nn
+    return nn.Linear(width, width)
+
+def _private():
+    pass
+'''
+
+
+def _mkrepo(d):
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "hubconf.py"), "w") as f:
+        f.write(HUBCONF)
+
+
+def test_local_list_help_load(tmp_path):
+    repo = str(tmp_path / "repo")
+    _mkrepo(repo)
+    assert hub.list(repo) == ["linear_model"]
+    assert "tiny linear" in hub.help(repo, "linear_model")
+    m = hub.load(repo, "linear_model", width=6)
+    assert m.weight.shape == [6, 6]
+
+
+def test_github_source_resolves_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv(hub.HUB_DIR_ENV, str(tmp_path))
+    _mkrepo(str(tmp_path / "owner_models_main"))
+    assert hub.list("owner/models", source="github") == ["linear_model"]
+    m = hub.load("owner/models:dev", source="github", model="linear_model") \
+        if False else hub.load("owner/models", "linear_model",
+                               source="github")
+    assert m.weight.shape == [4, 4]
+
+
+def test_github_cache_miss_raises_clearly(tmp_path, monkeypatch):
+    monkeypatch.setenv(hub.HUB_DIR_ENV, str(tmp_path))
+    with pytest.raises(RuntimeError, match="no egress"):
+        hub.list("nobody/nothing", source="github")
+
+
+def test_bad_inputs():
+    with pytest.raises(ValueError, match="owner/name"):
+        hub._parse_repo("not-a-repo")
+    with pytest.raises(ValueError, match="unknown source"):
+        hub._resolve_repo_dir("a/b", "svn")
+
+
+def test_unknown_model_lists_available(tmp_path):
+    repo = str(tmp_path / "repo")
+    _mkrepo(repo)
+    with pytest.raises(ValueError, match="linear_model"):
+        hub.load(repo, "nope")
