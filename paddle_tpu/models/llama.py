@@ -1,0 +1,216 @@
+"""LLaMA-family decoder (RMSNorm + RoPE + SwiGLU + GQA).
+
+Reference: the reference serves the LLaMA line through its incubate
+fused LLM ops (fused_rms_norm, fused_rotary_position_embedding, swiglu —
+python/paddle/incubate/nn/functional/) and PaddleNLP model defs;
+BASELINE.json lists LLaMA-2-7B pretraining as the stretch config. This
+module is the flagship for those ops: pre-norm RMSNorm blocks, rotary
+position embeddings (NTK-style theta), grouped-query attention (n_kv
+heads < n heads, kv repeated to the query heads ahead of the flash
+kernel), and a SwiGLU MLP with the 2/3-scaled hidden size.
+
+TP follows the GPT pattern: Column/RowParallelLinear pairs over the
+'tp' mesh axis, vocab-parallel embedding, GSPMD inserting collectives.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn.functional as F
+import paddle_tpu.nn.initializer as I
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn import Dropout, Embedding, LayerList, Linear
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.ops.registry import C_OPS
+from paddle_tpu.parallel.api import sharding_constraint
+from paddle_tpu.parallel.mesh import current_mesh
+from paddle_tpu.parallel.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+)
+
+try:
+    from jax.sharding import PartitionSpec as P
+except ImportError:  # pragma: no cover
+    P = None
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None     # GQA; None = MHA
+    ffn_hidden: Optional[int] = None       # None = LLaMA 2/3 * 4h rule
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-6
+    dropout: float = 0.0
+    tensor_parallel: bool = False
+    tie_embeddings: bool = False           # LLaMA keeps a separate head
+
+    def __post_init__(self):
+        if self.num_kv_heads is None:
+            self.num_kv_heads = self.num_heads
+        assert self.num_heads % self.num_kv_heads == 0
+        if self.ffn_hidden is None:
+            # LLaMA rule: 2/3 * 4h rounded to a multiple of 256
+            f = int(2 * 4 * self.hidden_size / 3)
+            self.ffn_hidden = 256 * ((f + 255) // 256)
+
+
+class RMSNorm(Layer):
+    def __init__(self, hidden, eps=1e-6):
+        super().__init__()
+        self.eps = eps
+        self.weight = self.create_parameter(
+            [hidden], default_initializer=I.Constant(1.0))
+
+    def forward(self, x):
+        return C_OPS.rms_norm(x, self.weight, epsilon=self.eps)
+
+
+def _rope_tables(seq, dim, theta):
+    """[seq, dim] cos/sin with interleaved-half convention (matches
+    ops.rotary_embedding's rotate_half)."""
+    inv = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    t = jnp.arange(seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)                       # [seq, dim/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [seq, dim]
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+class LlamaAttention(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        h = cfg.hidden_size
+        self.n_h = cfg.num_heads
+        self.n_kv = cfg.num_kv_heads
+        self.head_dim = h // cfg.num_heads
+        kv_out = self.n_kv * self.head_dim
+        w = I.Normal(0.0, 0.02)
+        wo = I.Normal(0.0, 0.02 / math.sqrt(2 * cfg.num_layers))
+        if cfg.tensor_parallel:
+            self.q_proj = ColumnParallelLinear(h, h, weight_attr=w,
+                                               has_bias=False,
+                                               gather_output=False)
+            # kv heads shard over tp too (n_kv must divide tp evenly in
+            # practice; GSPMD replicates otherwise)
+            self.k_proj = ColumnParallelLinear(h, kv_out, weight_attr=w,
+                                               has_bias=False,
+                                               gather_output=False)
+            self.v_proj = ColumnParallelLinear(h, kv_out, weight_attr=w,
+                                               has_bias=False,
+                                               gather_output=False)
+            self.o_proj = RowParallelLinear(h, h, weight_attr=wo,
+                                            has_bias=False,
+                                            input_is_parallel=True)
+        else:
+            self.q_proj = Linear(h, h, weight_attr=w, bias_attr=False)
+            self.k_proj = Linear(h, kv_out, weight_attr=w, bias_attr=False)
+            self.v_proj = Linear(h, kv_out, weight_attr=w, bias_attr=False)
+            self.o_proj = Linear(h, h, weight_attr=wo, bias_attr=False)
+
+    def forward(self, x):
+        b, s, h = x.shape
+        d = self.head_dim
+        q = self.q_proj(x).reshape([b, s, self.n_h, d])
+        k = self.k_proj(x).reshape([b, s, self.n_kv, d])
+        v = self.v_proj(x).reshape([b, s, self.n_kv, d])
+        cos, sin = _rope_tables(s, d, self.cfg.rope_theta)
+        q, k = C_OPS.rotary_embedding(q, k, Tensor._wrap(cos),
+                                      Tensor._wrap(sin))
+        if self.n_kv != self.n_h:
+            # GQA: repeat kv groups up to the query heads so the flash
+            # kernel sees matched head counts (compute-equivalent; the
+            # repeat is a broadcast XLA folds into the gather)
+            rep = self.n_h // self.n_kv
+            k = C_OPS.repeat_interleave(k, rep, axis=2)
+            v = C_OPS.repeat_interleave(v, rep, axis=2)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        return self.o_proj(out.reshape([b, s, h]))
+
+
+class LlamaMLP(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        h, f = cfg.hidden_size, cfg.ffn_hidden
+        w = I.Normal(0.0, 0.02)
+        wo = I.Normal(0.0, 0.02 / math.sqrt(2 * cfg.num_layers))
+        if cfg.tensor_parallel:
+            self.gate_proj = ColumnParallelLinear(h, f, weight_attr=w,
+                                                  has_bias=False,
+                                                  gather_output=False)
+            self.up_proj = ColumnParallelLinear(h, f, weight_attr=w,
+                                                has_bias=False,
+                                                gather_output=False)
+            self.down_proj = RowParallelLinear(f, h, weight_attr=wo,
+                                               has_bias=False,
+                                               input_is_parallel=True)
+        else:
+            self.gate_proj = Linear(h, f, weight_attr=w, bias_attr=False)
+            self.up_proj = Linear(h, f, weight_attr=w, bias_attr=False)
+            self.down_proj = Linear(f, h, weight_attr=wo, bias_attr=False)
+
+    def forward(self, x):
+        # swiglu(gate, up) = silu(gate) * up — the incubate fused op
+        return self.down_proj(C_OPS.swiglu(self.gate_proj(x),
+                                           self.up_proj(x)))
+
+
+class LlamaBlock(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = RMSNorm(cfg.hidden_size, cfg.rms_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = RMSNorm(cfg.hidden_size,
+                                                cfg.rms_eps)
+        self.mlp = LlamaMLP(cfg)
+
+    def forward(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class Llama(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        if cfg.tensor_parallel:
+            self.embed_tokens = VocabParallelEmbedding(cfg.vocab_size,
+                                                       cfg.hidden_size)
+        else:
+            self.embed_tokens = Embedding(cfg.vocab_size, cfg.hidden_size,
+                                          weight_attr=I.Normal(0.0, 0.02))
+        self.layers = LayerList([LlamaBlock(cfg)
+                                 for _ in range(cfg.num_layers)])
+        self.norm = RMSNorm(cfg.hidden_size, cfg.rms_eps)
+        if not cfg.tie_embeddings:
+            self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size,
+                                  bias_attr=False,
+                                  weight_attr=I.Normal(0.0, 0.02))
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        mesh = current_mesh()
+        if mesh is not None and "dp" in mesh.axis_names:
+            x = sharding_constraint(x, P("dp", None, None))
+        for blk in self.layers:
+            x = blk(x)
+        x = self.norm(x)
+        if self.cfg.tie_embeddings:
+            return C_OPS.matmul(x, self.embed_tokens.weight,
+                                transpose_y=True)
+        return self.lm_head(x)
+
+
+def llama_loss_fn(logits, labels):
+    v = logits.shape[-1]
+    return F.cross_entropy(logits.reshape([-1, v]), labels.reshape([-1]))
