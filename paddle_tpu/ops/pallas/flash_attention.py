@@ -593,7 +593,10 @@ DEFAULT_CHECK_SHAPES = ((1, 256, 4, 64), (2, 512, 8, 64), (1, 256, 4, 128))
 def validate_against_reference(shapes=DEFAULT_CHECK_SHAPES, interpret=None,
                                tol_out=None, tol_grad=None, seed=0):
     """Run the Pallas kernels (fwd + bwd) against the XLA reference path and
-    return {"max_abs_err", "shapes": [[b,s,h,d,err_o,err_g],...], "pass"}.
+    return {"max_abs_err", "shapes": [[b,s,h,d,mode,err_o,err_g],...],
+    "pass"} — each shapes row carries 7 elements, with the attention mode
+    string at index 4 (one of "dense", "densemask", "padbias", "segments",
+    matching the case list built below).
 
     Covers the dense-causal, additive-padding-mask, and segment-id (varlen)
     paths. Single source of truth for the kernel-vs-reference criterion —

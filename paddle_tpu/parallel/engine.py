@@ -162,8 +162,10 @@ class Engine:
                 if self.loss is not None and labels:
                     losses.append(float(self.loss(out, *labels)))
                 for m in self.metrics:
-                    m.update(m.compute(out, *labels) if hasattr(
-                        m, "compute") else (out, *labels))
+                    if hasattr(m, "compute"):
+                        m.update(m.compute(out, *labels))
+                    else:
+                        m.update(out, *labels)
         self.model.train()
         res = {"loss": float(np.mean(losses)) if losses else None}
         for m in self.metrics:

@@ -105,3 +105,29 @@ def test_dist_model_modes():
     assert out.shape == [4, 1]
     le = float(dm.eval()(x, y))
     assert np.isfinite(le)
+
+
+def test_engine_evaluate_no_compute_metric():
+    """Metrics without .compute() (Precision/Recall) get update(preds,
+    labels) unpacked — advisor r4 finding (engine.py evaluate branch)."""
+    from paddle_tpu.metric import Precision
+
+    paddle.seed(1)
+
+    class BinData(Dataset):
+        def __init__(self, n=32):
+            rng = np.random.default_rng(1)
+            self.x = rng.standard_normal((n, 8)).astype(np.float32)
+            self.y = (self.x.sum(-1, keepdims=True) > 0).astype(np.float32)
+
+        def __len__(self):
+            return len(self.x)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+    model = nn.Linear(8, 1)
+    eng = Engine(model, loss=_mse, metrics=[Precision()])
+    ev = eng.evaluate(BinData(), batch_size=16, verbose=0)
+    key = "precision" if "precision" in ev else "Precision"
+    assert 0.0 <= ev[key] <= 1.0
