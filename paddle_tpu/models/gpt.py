@@ -206,8 +206,10 @@ def build_pipeline_train_step(cfg: GPTConfig, mesh: Mesh, num_micro: int = 4,
 
     schedule: 'gpipe' (fwd scan + autodiff), 'interleave' (VPP, v chunks per
     device, ~v-fold bubble cut), '1f1b' (fused fwd+bwd, O(pp) activation
-    stash), or 'zbh1' (zero-bubble H1: B/W backward split, 1/3 less bubble
-    than 1F1B at the same stash) — parallel/pipeline_schedules.py;
+    stash), 'zbh1' (zero-bubble H1: B/W backward split, 1/3 less bubble
+    than 1F1B at the same stash), or 'zbvpp' (zero-bubble virtual pipeline:
+    interleave topology x B/W split, memory-aware W placement) —
+    parallel/pipeline_schedules.py;
     reference fleet/meta_parallel/pipeline_parallel.py:684,1308 and
     passes/pipeline_scheduler_pass/pipeline_zero_bubble.py.
     """
@@ -215,17 +217,17 @@ def build_pipeline_train_step(cfg: GPTConfig, mesh: Mesh, num_micro: int = 4,
     from paddle_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
     from paddle_tpu.parallel.pipeline_schedules import (
         interleave_permutation, pipeline_1f1b, pipeline_apply_interleave,
-        pipeline_zbh1,
+        pipeline_zbh1, pipeline_zbvpp,
     )
 
-    if schedule not in ("gpipe", "1f1b", "interleave", "zbh1"):
+    if schedule not in ("gpipe", "1f1b", "interleave", "zbh1", "zbvpp"):
         raise ValueError(
             f"unknown pipeline schedule {schedule!r}: "
-            "expected 'gpipe', '1f1b', 'interleave', or 'zbh1'")
+            "expected 'gpipe', '1f1b', 'interleave', 'zbh1', or 'zbvpp'")
     npp = mesh.shape["pp"]
     assert cfg.num_layers % npp == 0
     group = 1
-    if schedule == "interleave":
+    if schedule in ("interleave", "zbvpp"):
         # v chunks per device; each virtual stage is a chain of `group`
         # consecutive blocks (group = num_layers / (v*pp))
         v = v or cfg.num_layers // npp
@@ -242,7 +244,7 @@ def build_pipeline_train_step(cfg: GPTConfig, mesh: Mesh, num_micro: int = 4,
     block_names = sorted(
         {k.split(".", 2)[2] for k in all_params if k.startswith("blocks.")})
     n_layers = cfg.num_layers
-    if schedule == "interleave":
+    if schedule in ("interleave", "zbvpp"):
         # [V, group, ...] in DEVICE-MAJOR virtual-stage order so the
         # P('pp')-sharded stack keeps each device's v chunks local (no
         # per-step resharding); virtual stage j = blocks j*group..+group
@@ -269,7 +271,7 @@ def build_pipeline_train_step(cfg: GPTConfig, mesh: Mesh, num_micro: int = 4,
         out, _ = block_func.apply(block_params, {}, None, True, h)
         return out
 
-    if schedule == "interleave":
+    if schedule in ("interleave", "zbvpp"):
         from paddle_tpu.parallel.pipeline import chain_stages
 
         base_stage_fn = stage_fn
@@ -281,7 +283,7 @@ def build_pipeline_train_step(cfg: GPTConfig, mesh: Mesh, num_micro: int = 4,
         """Stage axis sharded on 'pp'; weight matrices additionally
         tensor-parallel on 'tp' (column for qkv/fc1, row for out/fc2).
         Interleave stacks carry an extra (unsharded) group axis."""
-        extra = (None,) if schedule == "interleave" else ()
+        extra = (None,) if schedule in ("interleave", "zbvpp") else ()
         if mesh.shape.get("tp", 1) > 1:
             if any(s in name for s in ("qkv.weight", "fc1.weight")):
                 return P("pp", *extra, None, "tp")
@@ -323,21 +325,26 @@ def build_pipeline_train_step(cfg: GPTConfig, mesh: Mesh, num_micro: int = 4,
         return head_loss(outer_p, y, labels)
 
     def grads_fused(outer_p, stacked_p, tokens, labels):
-        """Fused-schedule path (1f1b / zbh1): the pipeline returns grads
+        """Fused-schedule path (1f1b / zbh1 / zbvpp): the pipeline returns grads
         directly; the embedding closes the loop through an explicit vjp on
         dx, and the tied head/ln_f grads add to the embedding's."""
-        pipe = pipeline_zbh1 if schedule == "zbh1" else pipeline_1f1b
         x, emb_vjp = jax.vjp(lambda op: embed(op, tokens), outer_p)
-        loss, g_stacked, g_head, dx = pipe(
-            stage_fn, stacked_p, x, labels, head_loss, outer_p, mesh,
-            num_micro=num_micro)
+        if schedule == "zbvpp":
+            loss, g_stacked, g_head, dx = pipeline_zbvpp(
+                stage_fn, stacked_p, x, labels, head_loss, outer_p, mesh,
+                v=v, num_micro=num_micro, layout="device")
+        else:
+            pipe = pipeline_zbh1 if schedule == "zbh1" else pipeline_1f1b
+            loss, g_stacked, g_head, dx = pipe(
+                stage_fn, stacked_p, x, labels, head_loss, outer_p, mesh,
+                num_micro=num_micro)
         g_emb = emb_vjp(dx)[0]
         g_outer = jax.tree_util.tree_map(jnp.add, g_head, g_emb)
         return loss, (g_outer, g_stacked)
 
     def step(state, tokens, labels):
         outer_p, stacked_p = state
-        if schedule in ("1f1b", "zbh1"):
+        if schedule in ("1f1b", "zbh1", "zbvpp"):
             loss, grads = grads_fused(outer_p, stacked_p, tokens, labels)
         else:
             loss, grads = jax.value_and_grad(fwd, argnums=(0, 1))(

@@ -35,6 +35,8 @@ from paddle_tpu.parallel.pipeline import pipeline_apply, stack_stage_params  # n
 from paddle_tpu.parallel.pipeline_schedules import (  # noqa: F401
     pipeline_1f1b,
     pipeline_apply_interleave,
+    pipeline_zbh1,
+    pipeline_zbvpp,
     schedule_stats,
 )
 from paddle_tpu.parallel.recompute import (  # noqa: F401,E402

@@ -164,7 +164,8 @@ def tune_gpt_parallel(model_cfg, n_devices: Optional[int] = None,
                       schedules=("gpipe",), lr: float = 1e-3,
                       warmup: int = 1, iters: int = 3,
                       history_path: Optional[str] = None):
-    """Grid-search (dp, tp, pp) x num_micro x schedule for a GPT config on
+    """Grid-search (dp, tp, pp) x num_micro x schedule (any of gpipe /
+    1f1b / interleave / zbh1 / zbvpp) for a GPT config on
     the available (virtual CPU or real) device set, using the same
     build_pipeline_train_step machinery the multichip dryrun compiles —
     cheap trials without trial-process launches (reference

@@ -286,6 +286,173 @@ def simulate_zbh1(pp: int, m: int) -> Schedule:
     return Schedule(tables, t, 3 * m * pp, t * pp, max(hst_max), 1)
 
 
+def simulate_zbvpp(pp: int, v: int, m: int, mem_limit=None) -> Schedule:
+    """Zero-bubble virtual-pipeline (ZB-VPP) schedule: the reference's last
+    pipeline schedule (distributed/passes/pipeline_scheduler_pass/
+    pipeline_zero_bubble.py:150 PipelineZeroBubbleVirtualPipelinePass,
+    VScheduleCreator:343 with memory-aware placement
+    _estimate_program_mem_usagess:269).
+
+    Combines the interleave topology (v chunks per device at virtual
+    stages j = c*pp + d, ring +1 activations / ring -1 grads) with the
+    zero-bubble B/W backward split of ZB-H1. Greedy one-op-per-tick
+    scheduler, priority B > F > W, with the memory-aware rule: F is gated
+    by a per-device stash cap (activations alive F->W), default v*pp
+    micro-chunks — a SOFT cap: when a device would otherwise idle (no B,
+    no W ready) the F runs anyway, which keeps the schedule deadlock-free
+    for every (pp, v, m) while W placement absorbs memory pressure
+    everywhere else (the TPU-native analogue of the reference's
+    insert-W-to-free-memory pass).
+
+    Bubble fraction 1 - 3*v*m/T is <= ZB-H1's at equal m for every tested
+    config (see test_zbvpp.py): the V-topology cuts the fill/drain ramps
+    by ~v while the W ops fill the remaining idle ticks.
+
+    Tables (all [T, pp] int32): op (0 idle/1 F/2 B/3 W); F: f_mb, f_c
+    (local chunk), f_from_x, f_rd, f_st; B: b_mb, b_c, b_is_head,
+    b_is_x, b_rd_h, b_rd_g, b_st_g; W: w_c, w_rd_h, w_rd_g;
+    arrival writes h_wr_valid/h_wr_slot + g_wr_valid/g_wr_slot.
+    tables['_sizes'] = [n_harr, n_hst, n_garr, n_gst]."""
+    V = v * pp
+    if mem_limit is None:
+        mem_limit = lambda d: v * pp
+    elif not callable(mem_limit):
+        _ml = int(mem_limit)
+        mem_limit = lambda d: _ml
+    cap = [mem_limit(d) for d in range(pp)]
+
+    f_end: dict = {}
+    b_end: dict = {}
+    w_end: dict = {}
+    f_next = [0] * V
+    b_next = [0] * V
+    w_next = [0] * V
+    harr_slot: dict = {}    # (j, i) -> arrival slot on device j%pp
+    hst_slot: dict = {}     # (j, i) -> stashed stage-input slot
+    garr_slot: dict = {}
+    gst_slot: dict = {}
+    harr_free = [[] for _ in range(pp)]
+    harr_max = [0] * pp
+    hst_free = [[] for _ in range(pp)]
+    hst_max = [0] * pp
+    garr_free = [[] for _ in range(pp)]
+    garr_max = [0] * pp
+    gst_free = [[] for _ in range(pp)]
+    gst_max = [0] * pp
+    stash_live = [0] * pp
+    h_incoming: list = [None] * pp   # (j, i) landing at start of next tick
+    g_incoming: list = [None] * pp
+
+    names = ("op", "f_mb", "f_c", "f_from_x", "f_rd", "f_st",
+             "b_mb", "b_c", "b_is_head", "b_is_x", "b_rd_h",
+             "b_rd_g", "b_st_g", "w_c", "w_rd_h", "w_rd_g",
+             "h_wr_valid", "h_wr_slot", "g_wr_valid", "g_wr_slot")
+    rows = {k: [] for k in names}
+
+    def alloc(free, mx, d):
+        if free[d]:
+            return free[d].pop()
+        s = mx[d]
+        mx[d] += 1
+        return s
+
+    t = 0
+    while len(w_end) < V * m:
+        assert t < 20 * (3 * V * m + 10 * pp), \
+            f"zbvpp schedule did not converge (pp={pp}, v={v}, m={m})"
+        row = {k: [0] * pp for k in names}
+        # 1) payloads permuted last tick land in arrival buffers
+        for d in range(pp):
+            if h_incoming[d] is not None:
+                j, i = h_incoming[d]
+                s = alloc(harr_free, harr_max, d)
+                harr_slot[(j, i)] = s
+                row["h_wr_valid"][d] = 1
+                row["h_wr_slot"][d] = s
+                h_incoming[d] = None
+            if g_incoming[d] is not None:
+                j, i = g_incoming[d]
+                s = alloc(garr_free, garr_max, d)
+                garr_slot[(j, i)] = s
+                row["g_wr_valid"][d] = 1
+                row["g_wr_slot"][d] = s
+                g_incoming[d] = None
+        # 2) one op per device: B > F (memory-gated, soft) > W
+        for d in range(pp):
+            stages = range(d, V, pp)
+            Bs = [j for j in stages if b_next[j] < m
+                  and f_end.get((j, b_next[j]), t) < t
+                  and (j == V - 1 or (j, b_next[j]) in garr_slot)]
+            if Bs:
+                j = max(Bs)
+                i = b_next[j]
+                b_end[(j, i)] = t
+                b_next[j] += 1
+                row["op"][d] = 2
+                row["b_mb"][d] = i
+                row["b_c"][d] = j // pp
+                row["b_rd_h"][d] = hst_slot[(j, i)]
+                if j == V - 1:
+                    row["b_is_head"][d] = 1
+                else:
+                    s = garr_slot.pop((j, i))
+                    row["b_rd_g"][d] = s
+                    garr_free[d].append(s)
+                if j == 0:
+                    row["b_is_x"][d] = 1
+                s = alloc(gst_free, gst_max, d)
+                gst_slot[(j, i)] = s
+                row["b_st_g"][d] = s
+                if j > 0:
+                    g_incoming[(d - 1) % pp] = (j - 1, i)
+                continue
+            Fs = [j for j in stages if f_next[j] < m
+                  and (j == 0 or (j, f_next[j]) in harr_slot)]
+            Ws = [j for j in stages if w_next[j] < b_next[j]
+                  and b_end[(j, w_next[j])] < t]
+            if Fs and (stash_live[d] < cap[d] or not Ws):
+                j = max(Fs)
+                i = f_next[j]
+                f_end[(j, i)] = t
+                f_next[j] += 1
+                stash_live[d] += 1
+                row["op"][d] = 1
+                row["f_mb"][d] = i
+                row["f_c"][d] = j // pp
+                if j == 0:
+                    row["f_from_x"][d] = 1
+                else:
+                    s = harr_slot.pop((j, i))
+                    row["f_rd"][d] = s
+                    harr_free[d].append(s)
+                s = alloc(hst_free, hst_max, d)
+                hst_slot[(j, i)] = s
+                row["f_st"][d] = s
+                if j < V - 1:
+                    h_incoming[(d + 1) % pp] = (j + 1, i)
+                continue
+            if Ws:
+                j = min(Ws, key=lambda jj: (w_next[jj], jj))
+                i = w_next[j]
+                w_end[(j, i)] = t
+                w_next[j] += 1
+                stash_live[d] -= 1
+                row["op"][d] = 3
+                row["w_c"][d] = j // pp
+                row["w_rd_h"][d] = hst_slot.pop((j, i))
+                hst_free[d].append(row["w_rd_h"][d])
+                row["w_rd_g"][d] = gst_slot.pop((j, i))
+                gst_free[d].append(row["w_rd_g"][d])
+        for k in names:
+            rows[k].append(row[k])
+        t += 1
+    tables = {k: np.asarray(val, np.int32) for k, val in rows.items()}
+    tables["_sizes"] = np.asarray(
+        [max(harr_max) or 1, max(hst_max) or 1, max(garr_max) or 1,
+         max(gst_max) or 1], np.int32)
+    return Schedule(tables, t, 3 * V * m, t * pp, max(hst_max), 1)
+
+
 def schedule_stats(pp: int, m: int, schedule: str = "gpipe", v: int = 1):
     """Step-count accounting used by the bubble tests: slots are uniform
     stage-compute units; bubble = idle fraction of the fwd+bwd timeline."""
@@ -315,6 +482,13 @@ def schedule_stats(pp: int, m: int, schedule: str = "gpipe", v: int = 1):
         return {"total_ticks": sim.total_ticks,
                 "bubble": 1 - 3 * m / sim.total_ticks,
                 "bubble_ticks_per_device": sim.total_ticks - 3 * m,
+                "stash_micro_batches": sim.stash_size}
+    if schedule == "zbvpp":
+        sim = simulate_zbvpp(pp, v, m)
+        # busy = 3 ops per micro-chunk: 3*v*m of T per device
+        return {"total_ticks": sim.total_ticks,
+                "bubble": 1 - 3 * v * m / sim.total_ticks,
+                "bubble_ticks_per_device": sim.total_ticks - 3 * v * m,
                 "stash_micro_batches": sim.stash_size}
     raise ValueError(f"unknown schedule {schedule!r}")
 
@@ -760,3 +934,219 @@ def pipeline_zbh1(stage_fn: Callable[[Any, Any], Any], stacked_params,
         axis_names=frozenset({"pp"}),
     )
     return mapped(stacked_params, head_params, x_micro, labels_micro)
+
+
+# ----------------------------------------------------- zero-bubble VPP (ZBVPP)
+
+def pipeline_zbvpp(stage_fn: Callable[[Any, Any], Any], stacked_params,
+                   x_micro, labels_micro,
+                   head_fn: Callable[[Any, Any, Any], Any], head_params,
+                   mesh: Mesh, v: int = 2, num_micro: int | None = None,
+                   mem_limit=None, layout: str = "layer"):
+    """Fused pipeline step with the zero-bubble virtual-pipeline schedule
+    (reference pipeline_zero_bubble.py:150 ZBVPP — the interleave topology
+    of VPP crossed with the B/W backward split of ZB-H1).
+
+    stacked_params leaves have leading dim V = v*pp: virtual stage j runs
+    on device j % pp as that device's chunk j // pp. layout='layer' means
+    index L = virtual stage L (grads returned in the same order);
+    layout='device' means the caller pre-permuted with
+    interleave_permutation. Stage output shape must equal its input shape
+    (activations ride one ring). head_fn(head_params, y, labels) -> scalar
+    mean loss for ONE micro-batch, evaluated on the last device only.
+
+    Same contract as pipeline_zbh1: returns (mean_loss, grads_stacked,
+    grads_head, dx_micro) and is NOT differentiable (it IS the backward).
+    The B op computes dL/dx (inter-device critical path), the W op fills
+    bubble ticks with the deferred dL/dw from the stashed (input,
+    cotangent) pair — each re-linearizes its chunk from the stash, so the
+    schedule trades one extra chunk forward per op for the ~v-fold
+    shorter ramps AND the W-filled steady state (bubble fraction <=
+    ZB-H1's at equal m; see simulate_zbvpp)."""
+    npp = mesh.shape["pp"]
+    if num_micro is None:
+        num_micro = x_micro.shape[0]
+    m = num_micro
+    leaf = jax.tree_util.tree_leaves(stacked_params)[0]
+    V = leaf.shape[0]
+    assert V == v * npp, f"stage count {V} != v*pp = {v}*{npp}"
+    sim = simulate_zbvpp(npp, v, m, mem_limit=mem_limit)
+    sizes = sim.tables["_sizes"]
+    n_harr, n_hst, n_garr, n_gst = (int(s) for s in sizes)
+    tab = {k: jnp.asarray(val) for k, val in sim.tables.items()
+           if k != "_sizes"}
+    fwd_perm = [(i, (i + 1) % npp) for i in range(npp)]
+    bwd_perm = [(i, (i - 1) % npp) for i in range(npp)]
+
+    if layout == "layer":
+        perm = np.asarray(interleave_permutation(npp, v))
+        re = jax.tree_util.tree_map(lambda a: a[perm], stacked_params)
+    elif layout == "device":
+        re = stacked_params
+    else:
+        raise ValueError(f"unknown layout {layout!r}")
+
+    def per_device(params_local, head_p, x, labels):
+        d = lax.axis_index("pp")
+        is_first = d == 0
+        is_last = d == npp - 1
+        head_p = jax.tree_util.tree_map(_varying, head_p)  # see 1f1b note
+        mb_shape = x.shape[1:]
+        z = jnp.zeros(mb_shape, x.dtype)
+
+        def chunk_params(pl, c):
+            return jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+                pl)
+
+        def acc_chunk(acc_tree, g_tree, c):
+            return jax.tree_util.tree_map(
+                lambda acc, g: lax.dynamic_update_index_in_dim(
+                    acc,
+                    lax.dynamic_index_in_dim(acc, c, 0, keepdims=False) + g,
+                    c, 0),
+                acc_tree, g_tree)
+
+        def tick(carry, trow):
+            (h_arr, h_st, g_arr, g_st, gparams, ghead, loss_acc, dx_buf,
+             h_in, g_in) = carry
+            # arrivals land first (payloads permuted last tick)
+            h_arr = jnp.where(
+                trow["h_wr_valid"][d] > 0,
+                lax.dynamic_update_index_in_dim(h_arr, h_in,
+                                                trow["h_wr_slot"][d], 0),
+                h_arr)
+            g_arr = jnp.where(
+                trow["g_wr_valid"][d] > 0,
+                lax.dynamic_update_index_in_dim(g_arr, g_in,
+                                                trow["g_wr_slot"][d], 0),
+                g_arr)
+
+            op = trow["op"][d]
+
+            def f_branch(c):
+                (h_arr, h_st, g_arr, g_st, gp, gh_, la, dxb) = c
+                mb = jnp.clip(trow["f_mb"][d], 0, m - 1)
+                h_x = lax.dynamic_index_in_dim(x, mb, 0, keepdims=False)
+                h_a = lax.dynamic_index_in_dim(h_arr, trow["f_rd"][d], 0,
+                                               keepdims=False)
+                h = jnp.where(trow["f_from_x"][d] > 0, _varying(h_x), h_a)
+                h_st = lax.dynamic_update_index_in_dim(
+                    h_st, h, trow["f_st"][d], 0)
+                p_c = chunk_params(params_local, trow["f_c"][d])
+                y = stage_fn(p_c, h)
+                return (h_arr, h_st, g_arr, g_st, gp, gh_, la, dxb,
+                        y, jnp.zeros_like(y))
+
+            def b_branch(c):
+                (h_arr, h_st, g_arr, g_st, gp, gh_, la, dxb) = c
+                mb = jnp.clip(trow["b_mb"][d], 0, m - 1)
+                h_b = lax.dynamic_index_in_dim(h_st, trow["b_rd_h"][d], 0,
+                                               keepdims=False)
+                p_c = chunk_params(params_local, trow["b_c"][d])
+                y_b, vjp_h = jax.vjp(lambda hh: stage_fn(p_c, hh), h_b)
+                lbl = lax.dynamic_index_in_dim(labels, mb, 0,
+                                               keepdims=False)
+
+                def head_branch(op_):
+                    hp, yy, ll = op_
+                    loss_i, (ghp, gyl) = jax.value_and_grad(
+                        lambda hp_, yy_: head_fn(hp_, yy_, ll),
+                        argnums=(0, 1))(hp, yy)
+                    return loss_i / m, jax.tree_util.tree_map(
+                        lambda g: g / m, ghp), gyl / m
+
+                def skip_branch(op_):
+                    hp, yy, _ = op_
+                    return (_varying(jnp.zeros((), jnp.float32)),
+                            jax.tree_util.tree_map(
+                                lambda a: _varying(jnp.zeros_like(a)), hp),
+                            _varying(jnp.zeros_like(yy)))
+
+                loss_i, g_head_i, gy_head = lax.cond(
+                    trow["b_is_head"][d] > 0, head_branch, skip_branch,
+                    (head_p, y_b, lbl))
+                g_a = lax.dynamic_index_in_dim(g_arr, trow["b_rd_g"][d], 0,
+                                               keepdims=False)
+                gy = jnp.where(trow["b_is_head"][d] > 0, gy_head, g_a)
+                # stash the cotangent for this micro-chunk's W op
+                g_st = lax.dynamic_update_index_in_dim(
+                    g_st, gy, trow["b_st_g"][d], 0)
+                (gh,) = vjp_h(gy)
+                gh_new = jax.tree_util.tree_map(jnp.add, gh_, g_head_i)
+                la = la + loss_i
+                dx_upd = lax.dynamic_update_index_in_dim(dxb, gh, mb, 0)
+                dxb = jnp.where(trow["b_is_x"][d] > 0, dx_upd, dxb)
+                return (h_arr, h_st, g_arr, g_st, gp, gh_new, la, dxb,
+                        jnp.zeros_like(gh), gh)
+
+            def w_branch(c):
+                (h_arr, h_st, g_arr, g_st, gp, gh_, la, dxb) = c
+                h_w = lax.dynamic_index_in_dim(h_st, trow["w_rd_h"][d], 0,
+                                               keepdims=False)
+                gy_w = lax.dynamic_index_in_dim(g_st, trow["w_rd_g"][d], 0,
+                                                keepdims=False)
+                p_c = chunk_params(params_local, trow["w_c"][d])
+                _, vjp_p = jax.vjp(lambda pc: stage_fn(pc, h_w), p_c)
+                (gp_i,) = vjp_p(gy_w)
+                gp = acc_chunk(gp, gp_i, trow["w_c"][d])
+                return (h_arr, h_st, g_arr, g_st, gp, gh_, la, dxb,
+                        _varying(z), _varying(z))
+
+            def idle_branch(c):
+                return c + (_varying(z), _varying(z))
+
+            (h_arr, h_st, g_arr, g_st, gparams, ghead, loss_acc, dx_buf,
+             y_send, gh_send) = lax.switch(
+                jnp.clip(op, 0, 3),
+                [idle_branch, f_branch, b_branch, w_branch],
+                (h_arr, h_st, g_arr, g_st, gparams, ghead, loss_acc,
+                 dx_buf))
+
+            h_in_next = lax.ppermute(y_send, "pp", fwd_perm)
+            g_in_next = lax.ppermute(gh_send, "pp", bwd_perm)
+            return (h_arr, h_st, g_arr, g_st, gparams, ghead, loss_acc,
+                    dx_buf, h_in_next, g_in_next), None
+
+        zeros_like_local = lambda tree: jax.tree_util.tree_map(
+            lambda a: _varying(jnp.zeros_like(a)), tree)
+        init = (
+            _varying(jnp.zeros((n_harr,) + mb_shape, x.dtype)),
+            _varying(jnp.zeros((n_hst,) + mb_shape, x.dtype)),
+            _varying(jnp.zeros((n_garr,) + mb_shape, x.dtype)),
+            _varying(jnp.zeros((n_gst,) + mb_shape, x.dtype)),
+            zeros_like_local(params_local),
+            zeros_like_local(head_p),
+            _varying(jnp.zeros((), jnp.float32)),
+            _varying(jnp.zeros((m,) + mb_shape, x.dtype)),
+            _varying(z),
+            _varying(z),
+        )
+        (_, _, _, _, gparams, ghead, loss_acc, dx_buf, _, _), _ = lax.scan(
+            tick, init, tab)
+        last_mask = jnp.where(is_last, 1.0, 0.0)
+        first_mask = jnp.where(is_first, 1.0, 0.0)
+        loss = lax.psum(loss_acc * last_mask, "pp")
+        ghead = jax.tree_util.tree_map(
+            lambda g: lax.psum(g * last_mask, "pp"), ghead)
+        dx = lax.psum(dx_buf * first_mask, "pp")
+        return loss, gparams, ghead, dx
+
+    mapped = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), re),
+                  jax.tree_util.tree_map(lambda _: P(), head_params),
+                  P(), P()),
+        out_specs=(P(),
+                   jax.tree_util.tree_map(lambda _: P("pp"), re),
+                   jax.tree_util.tree_map(lambda _: P(), head_params),
+                   P()),
+        axis_names=frozenset({"pp"}),
+    )
+    loss, g_dev, ghead, dx = mapped(re, head_params, x_micro, labels_micro)
+    if layout == "layer":
+        # device-major grads back to layer order: stage perm[p] sits at
+        # position p, so scatter back with the inverse permutation
+        inv = np.argsort(perm)
+        g_dev = jax.tree_util.tree_map(lambda a: a[inv], g_dev)
+    return loss, g_dev, ghead, dx
