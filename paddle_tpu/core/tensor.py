@@ -41,7 +41,7 @@ class Tensor:
 
     def __init__(self, value, stop_gradient: bool = True, name: str = ""):
         if isinstance(value, Tensor):
-            value = value._value
+            value = value._concrete()
         self._value = value
         self.stop_gradient = stop_gradient
         self.grad: Optional[Tensor] = None
@@ -96,8 +96,18 @@ class Tensor:
     def numel(self):
         return self.size
 
+    def _concrete(self):
+        """The concrete jax value — flushes the owning tape segment when
+        this tensor is a lazy segment output (jit/segments.py)."""
+        v = self._value
+        if getattr(v, "_is_lazy", False):
+            from paddle_tpu.jit.segments import materialize
+
+            v = materialize(self)
+        return v
+
     def numpy(self):
-        return np.asarray(self._value)
+        return np.asarray(self._concrete())
 
     def item(self):
         return self.numpy().item()
@@ -113,7 +123,7 @@ class Tensor:
     cast = astype
 
     def detach(self) -> "Tensor":
-        return Tensor(self._value, stop_gradient=True, name=self.name)
+        return Tensor(self._concrete(), stop_gradient=True, name=self.name)
 
     def clone(self) -> "Tensor":
         from paddle_tpu.ops.registry import C_OPS
@@ -133,7 +143,7 @@ class Tensor:
             # process (global indexing would hand rank>0 processes a
             # non-addressable device in multi-process runs)
             dev = jax.local_devices(backend=name)[int(idx) if idx else 0]
-            out = Tensor(jax.device_put(out._value, dev),
+            out = Tensor(jax.device_put(out._concrete(), dev),
                          stop_gradient=out.stop_gradient)
         if dtype is not None:
             out = out.astype(dtype)
@@ -154,6 +164,7 @@ class Tensor:
     # ------------------------------------------------------------ autograd
 
     def backward(self, grad_tensor=None, retain_graph: bool = False):
+        self._concrete()
         engine.backward(self, grad_tensor, retain_graph=retain_graph)
 
     def gradient(self):
@@ -181,16 +192,16 @@ class Tensor:
         return _Handle()
 
     def zero_(self):
-        self._inplace_update(jnp.zeros_like(self._value))
+        self._inplace_update(jnp.zeros_like(self._concrete()))
         return self
 
     def fill_(self, value):
-        self._inplace_update(jnp.full_like(self._value, value))
+        self._inplace_update(jnp.full_like(self._concrete(), value))
         return self
 
     def copy_(self, other, blocking=True):
-        v = other._value if isinstance(other, Tensor) else jnp.asarray(other)
-        self._inplace_update(v.astype(self._value.dtype))
+        v = other._concrete() if isinstance(other, Tensor) else jnp.asarray(other)
+        self._inplace_update(v.astype(self._concrete().dtype))
         return self
 
     def set_value(self, value):
@@ -202,6 +213,13 @@ class Tensor:
                 "in-place update on a non-leaf tensor that requires grad is "
                 "not supported; wrap in paddle_tpu.no_grad() or use detach()"
             )
+        # an open tape segment may hold this tensor as an external input:
+        # flush it first so the deferred replay reads the PRE-mutation
+        # value, matching eager program order (jit/segments.py)
+        from paddle_tpu.ops.registry import SEGMENT_OPEN
+
+        if SEGMENT_OPEN[0] is not None:
+            SEGMENT_OPEN[0].flush()
         self._value = new_value
 
     # ------------------------------------------------------------ indexing
@@ -214,8 +232,8 @@ class Tensor:
 
     def __setitem__(self, idx, value):
         idx = _normalize_index(idx)
-        v = value._value if isinstance(value, Tensor) else value
-        self._inplace_update(self._value.at[idx].set(v))
+        v = value._concrete() if isinstance(value, Tensor) else value
+        self._inplace_update(self._concrete().at[idx].set(v))
 
     # ---------------------------------------------------------- operators
 
@@ -322,12 +340,12 @@ class Tensor:
         grad_s = "" if self.stop_gradient else ", stop_gradient=False"
         return (
             f"Tensor(shape={self.shape}, dtype={dtype_mod.dtype_name(self.dtype)}"
-            f"{grad_s},\n       {np.asarray(self._value)!r})"
+            f"{grad_s},\n       {np.asarray(self._concrete())!r})"
         )
 
     # jax pytree-friendliness: let jnp.asarray(tensor) work
     def __jax_array__(self):
-        return self._value
+        return self._concrete()
 
 
 class Parameter(Tensor):
@@ -364,9 +382,10 @@ def _as_tensor_like(other, ref: Tensor):
 
 def _normalize_index(idx):
     if isinstance(idx, Tensor):
-        return idx._value
+        return idx._concrete()
     if isinstance(idx, tuple):
-        return tuple(i._value if isinstance(i, Tensor) else i for i in idx)
+        return tuple(i._concrete() if isinstance(i, Tensor) else i
+                     for i in idx)
     if isinstance(idx, list):
         return jnp.asarray(idx)
     return idx

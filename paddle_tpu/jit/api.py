@@ -172,7 +172,9 @@ class StaticFunction:
         action = ("stitching: child layers stay compiled, the breaking "
                   "python runs eagerly each call (all signatures)"
                   if stitch else
-                  "falling back to eager for this input signature")
+                  "segment mode for this input signature: the op tape "
+                  "compiles as segments split at the break, eager glue "
+                  "between them")
         warnings.warn(
             f"paddle_tpu.jit.to_static: graph break in '{name}' — {action}."
             f" Breaking construct: {type(err).__name__}: "
@@ -263,8 +265,12 @@ class StaticFunction:
         kw_items = tuple(sorted(kwargs.items()))
         sig = (_sig_of(args), training, _sig_of([v for _, v in kw_items]),
                tuple(k for k, _ in kw_items))
-        if self._stitched or sig in self._eager_sigs:
+        if self._stitched:
             return self._eager_layer(*args, **kwargs)
+        if sig in self._eager_sigs:
+            # childless layer: the whole body re-runs with tape-segment
+            # compilation (compiled regions around the break)
+            return self._run_segmented(self._eager_layer, *args, **kwargs)
         compiled = self._cache.get(sig)
         kw_tpl, kw_tensors = _split_kwargs(kwargs)
         if compiled is None:
@@ -283,9 +289,9 @@ class StaticFunction:
             compiled = jax.jit(run)
             self._cache[sig] = compiled
         arg_vals = jax.tree_util.tree_map(
-            lambda v: v._value if isinstance(v, Tensor) else v, args,
+            lambda v: v._concrete() if isinstance(v, Tensor) else v, args,
             is_leaf=lambda v: isinstance(v, Tensor))
-        kw_vals = [t._value for t in kw_tensors]
+        kw_vals = [t._concrete() for t in kw_tensors]
         try:
             with self._shadow_removed():
                 out_values, new_buffers = compiled(
@@ -295,17 +301,32 @@ class StaticFunction:
             if not _is_graph_break(e):
                 raise
             self._graph_break(sig, e)
-            return self._eager_layer(*args, **kwargs)
+            if self._stitched:
+                return self._eager_layer(*args, **kwargs)
+            # childless layer: segment the break call itself too, like
+            # the plain-function path
+            return self._run_segmented(self._eager_layer, *args, **kwargs)
         if self._layer.training:
             self._func.write_back(buffer_values=new_buffers)
         return jax.tree_util.tree_map(lambda v: Tensor._wrap(v), out_values)
+
+    def _run_segmented(self, fn, *args, **kwargs):
+        """Re-run the broken callable with tape-segment compilation: ops
+        record into segments compiled as single XLA programs (cached),
+        host reads flush, the breaking python runs eagerly in between
+        (jit/segments.py — reference SOT region compilation,
+        opcode_executor.py:1880)."""
+        from paddle_tpu.jit.segments import segment_mode
+
+        with segment_mode():
+            return fn(*args, **kwargs)
 
     def _call_fn(self, *args, **kwargs):
         kw_items = tuple(sorted(kwargs.items()))
         sig = (_sig_of(args), _sig_of([v for _, v in kw_items]),
                tuple(k for k, _ in kw_items))
         if sig in self._eager_sigs:
-            return self._fn(*args, **kwargs)
+            return self._run_segmented(self._fn, *args, **kwargs)
         compiled = self._cache.get(sig)
         kw_tpl, kw_tensors = _split_kwargs(kwargs)
         if compiled is None:
@@ -327,16 +348,16 @@ class StaticFunction:
             compiled = jax.jit(run)
             self._cache[sig] = compiled
         arg_vals = jax.tree_util.tree_map(
-            lambda v: v._value if isinstance(v, Tensor) else v, args,
+            lambda v: v._concrete() if isinstance(v, Tensor) else v, args,
             is_leaf=lambda v: isinstance(v, Tensor))
-        kw_vals = [t._value for t in kw_tensors]
+        kw_vals = [t._concrete() for t in kw_tensors]
         try:
             out = compiled(arg_vals, kw_vals)
         except Exception as e:
             if not _is_graph_break(e):
                 raise
             self._graph_break(sig, e)
-            return self._fn(*args, **kwargs)
+            return self._run_segmented(self._fn, *args, **kwargs)
         return jax.tree_util.tree_map(lambda v: Tensor._wrap(v), out)
 
 

@@ -1,0 +1,268 @@
+"""Tape-segment compilation: sub-function graph stitching for broken
+functions.
+
+Reference: the SOT interpreter compiles the traceable bytecode REGIONS
+around a graph break inside one function
+(python/paddle/jit/sot/opcode_translator/executor/opcode_executor.py:1880,
+translate.py:37) — a 200-line forward with one `.item()` between two
+matmul blocks keeps both blocks compiled.
+
+TPU-native design: instead of re-interpreting CPython bytecode, the eager
+dispatcher records ops into an open SEGMENT while the python between
+breaks runs natively. A host materialization (`.item()`, `bool()`,
+`.numpy()`, `__jax_array__`) flushes the segment: its op tape is compiled
+as ONE jitted XLA program — cached by tape structure + input avals — and
+executed, binding every recorded output. Python then proceeds with
+concrete values and the next op opens the next segment. So a function
+with `.item()` between two matmul blocks executes both blocks from
+compiled segments every call, with the compile cache hit from the second
+call on. The eager glue (the breaking python) re-runs each call, so
+host-value control-flow flips stay correct.
+
+Autograd: one GradNode spans each segment (jax.vjp of the whole replay),
+so training grads are intact; create_graph re-differentiates through the
+stored replay function like any other op (engine._vjp_dispatch).
+
+Ops that cannot stage — dynamic-shape ops, rng ops (their key would bake
+into the cached executable), direct one-shot ops, anything with an
+unhashable attr template — flush the open segment and run eagerly, which
+preserves program order around the segment boundary.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Any, Dict, Tuple
+
+import jax
+
+from paddle_tpu.ops import registry as _registry
+
+# recording state lives in the registry (cheapest hot-path check); this
+# module provides the recorder class and the user-facing context manager
+_MODE = _registry.SEGMENT_MODE
+_OPEN = _registry.SEGMENT_OPEN
+# (tape structure, ext avals) -> jitted replay fn
+_COMPILE_CACHE: Dict[Tuple, Any] = {}
+# (op name, sig_key, input avals) -> output ShapeDtypeStructs — record()
+# runs in the steady state too, so per-op abstract tracing is memoized
+_EVAL_SHAPE_CACHE: Dict[Tuple, Any] = {}
+
+STATS = {"flushes": 0, "compiles": 0, "cache_hits": 0, "ops_recorded": 0,
+         "empty_flushes": 0}
+
+
+def reset_stats() -> None:
+    for k in STATS:
+        STATS[k] = 0
+
+
+def active() -> bool:
+    """Is segment recording requested (inside a segment_mode context)?"""
+    return _MODE[0] > 0
+
+
+class _LazyValue:
+    """Placeholder value of a not-yet-flushed segment output. Quacks
+    enough like a jax.Array (shape/dtype/ndim) for Tensor's metadata
+    properties; any host materialization goes through
+    Tensor.numpy()/__jax_array__ which flush first."""
+
+    __slots__ = ("seg", "idx", "shape", "dtype")
+    _is_lazy = True
+
+    def __init__(self, seg, idx, shape, dtype):
+        self.seg = seg
+        self.idx = idx
+        self.shape = shape
+        self.dtype = dtype
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+
+def is_lazy(value) -> bool:
+    return getattr(value, "_is_lazy", False)
+
+
+class SegmentRecorder:
+    """One open tape segment: records (raw_f, input refs) per op, hands
+    out lazy output Tensors, and on flush compiles + runs the whole tape
+    as one XLA program."""
+
+    def __init__(self):
+        self.recs: list = []          # (raw_f, in_refs, n_out, multi)
+        self.key_parts: list = []     # structural cache key per op
+        self.ext_tensors: list = []   # external input Tensor objects
+        self.ext_ids: dict = {}       # id(tensor) -> position
+        self.out_tensors: list = []   # lazy output Tensors, flat order
+        self.need_grad = False
+        self._flushed = False
+
+    def record(self, name, raw_f, sig_key, tensors, need_grad):
+        """Record one op; returns its output(s) as lazy Tensor(s)."""
+        from paddle_tpu.core.tensor import Tensor
+
+        in_refs = []
+        in_avals = []
+        for t in tensors:
+            v = t._value
+            if is_lazy(v):
+                # produced earlier in THIS segment (older segments always
+                # flush before a new one opens, and flushing binds
+                # concrete values)
+                assert v.seg is self, "lazy value leaked across segments"
+                in_refs.append(("i", v.idx))
+                in_avals.append(jax.ShapeDtypeStruct(v.shape, v.dtype))
+            else:
+                pos = self.ext_ids.get(id(t))
+                if pos is None:
+                    pos = len(self.ext_tensors)
+                    self.ext_ids[id(t)] = pos
+                    self.ext_tensors.append(t)
+                in_refs.append(("e", pos))
+                in_avals.append(jax.ShapeDtypeStruct(v.shape, v.dtype))
+        from paddle_tpu.utils import flags
+
+        aval_key = (name, sig_key,
+                    tuple((a.shape, str(a.dtype)) for a in in_avals),
+                    flags.flags_version())
+        out_aval = _EVAL_SHAPE_CACHE.get(aval_key)
+        if out_aval is None:
+            out_aval = jax.eval_shape(raw_f, *in_avals)
+            _EVAL_SHAPE_CACHE[aval_key] = out_aval
+        multi = isinstance(out_aval, (tuple, list))
+        outs = list(out_aval) if multi else [out_aval]
+        base = len(self.out_tensors)
+        self.recs.append((raw_f, tuple(in_refs), len(outs), multi))
+        self.key_parts.append((name, sig_key, tuple(in_refs)))
+        created = []
+        for k, o in enumerate(outs):
+            t = Tensor._wrap(_LazyValue(self, base + k, o.shape, o.dtype))
+            if need_grad and _is_float_dtype(o.dtype):
+                t.stop_gradient = False
+            self.out_tensors.append(t)
+            created.append(t)
+        self.need_grad = self.need_grad or need_grad
+        STATS["ops_recorded"] += 1
+        return tuple(created) if multi else created[0]
+
+    def _build_replay(self):
+        recs = list(self.recs)
+
+        def replay(*ext_vals):
+            env: list = []
+            for raw_f, in_refs, n_out, multi in recs:
+                ins = [env[i] if kind == "i" else ext_vals[i]
+                       for kind, i in in_refs]
+                out = raw_f(*ins)
+                env.extend(out if multi else (out,))
+            return tuple(env)
+
+        return replay
+
+    def flush(self):
+        """Compile (cached) + execute the tape, bind concrete values to
+        every lazy output, and record ONE GradNode spanning the segment."""
+        from paddle_tpu.autograd import engine
+        from paddle_tpu.ops.registry import TRACE_HOOK
+
+        if _OPEN[0] is self:
+            _OPEN[0] = None
+        if self._flushed:
+            return
+        self._flushed = True
+        if not self.recs:
+            STATS["empty_flushes"] += 1
+            return
+        from paddle_tpu.utils import flags
+
+        vals = [t._value for t in self.ext_tensors]
+        # flags ride the key like the per-op jit cache (registry._jitted_fn
+        # keys on flags_version): op impls read flags at trace time, so a
+        # flag flip must miss the cache, not replay a stale program
+        key = (tuple(self.key_parts),
+               tuple((tuple(v.shape), str(v.dtype)) for v in vals),
+               flags.flags_version())
+        jitted = _COMPILE_CACHE.get(key)
+        cache_hit = jitted is not None
+        if not cache_hit:
+            jitted = jax.jit(self._build_replay())
+            _COMPILE_CACHE[key] = jitted
+            STATS["compiles"] += 1
+        else:
+            STATS["cache_hits"] += 1
+        # grad need was decided per-op at RECORD time (matching eager,
+        # where each op checks is_grad_enabled as it executes); a flush
+        # that happens to run inside a no_grad block — e.g. metric glue —
+        # must still span the recorded training ops with a GradNode
+        need = self.need_grad
+        if need:
+            outs, vjp_fn = jax.vjp(jitted, *vals)
+        else:
+            outs = jitted(*vals)
+        node = None
+        if need:
+            node = engine.GradNode(
+                "jit_segment", vjp_fn, self.ext_tensors,
+                [(o.shape, o.dtype) for o in outs],
+                multi_output=True, raw_f=jitted)
+        for i, (t, o) in enumerate(zip(self.out_tensors, outs)):
+            t._value = o
+            if node is not None and not t.stop_gradient:
+                t._grad_node = (node, i)
+        STATS["flushes"] += 1
+        if TRACE_HOOK[0] is not None:
+            TRACE_HOOK[0]("jit.segment_replay",
+                          tuple(kp[0] for kp in self.key_parts),
+                          {"compiled": True, "cache_hit": cache_hit})
+
+
+def _is_float_dtype(dt):
+    import jax.numpy as jnp
+
+    return (jnp.issubdtype(dt, jnp.floating)
+            or jnp.issubdtype(dt, jnp.complexfloating))
+
+
+def open_recorder() -> SegmentRecorder:
+    """The open recorder, creating one if recording is active."""
+    if _OPEN[0] is None:
+        _OPEN[0] = SegmentRecorder()
+    return _OPEN[0]
+
+
+def flush_open() -> None:
+    """Flush the open segment (no-op when none). Called before any op
+    that cannot stage, and on every host materialization."""
+    if _OPEN[0] is not None:
+        _OPEN[0].flush()
+
+
+def materialize(tensor) -> Any:
+    """Concrete jax value of a (possibly lazy) Tensor, flushing its
+    segment if needed."""
+    v = tensor._value
+    if is_lazy(v):
+        v.seg.flush()
+        v = tensor._value
+        assert not is_lazy(v), "segment flush did not bind a value"
+    return v
+
+
+_registry.SEGMENT_RECORDER_CLS[0] = SegmentRecorder
+
+
+@contextmanager
+def segment_mode():
+    """Record eligible ops into compiled tape segments; host
+    materializations flush. Re-entrant; the open segment is flushed on
+    exit so laziness never leaks out."""
+    _MODE[0] += 1
+    try:
+        yield
+    finally:
+        _MODE[0] -= 1
+        if _MODE[0] == 0:
+            flush_open()

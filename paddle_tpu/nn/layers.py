@@ -293,8 +293,8 @@ class _BatchNormBase(Layer):
             training=self.training, momentum=self._momentum,
             epsilon=self._epsilon, data_format=self._data_format)
         if self.training:
-            self._mean._value = new_mean._value
-            self._variance._value = new_var._value
+            self._mean._value = new_mean._concrete()
+            self._variance._value = new_var._concrete()
         return out
 
 

@@ -55,8 +55,8 @@ def weight_norm(layer: Layer, name: str = "weight", dim: int = 0) -> Layer:
     g = layer.create_parameter(list(_norm_except(w, dim).shape))
     v = layer.create_parameter(list(w.shape))
     with paddle.no_grad():
-        g._value = _norm_except(w, dim)._value
-        v._value = w._value
+        g._value = _norm_except(w, dim)._concrete()
+        v._value = w._concrete()
     setattr(layer, f"{name}_g", g)
     setattr(layer, f"{name}_v", v)
     # the original param must stop being a leaf parameter
@@ -83,7 +83,7 @@ def remove_weight_norm(layer: Layer, name: str = "weight") -> Layer:
                             getattr(layer, f"{pname}_g"), dim)
     w = layer.create_parameter(list(eff.shape))
     with paddle.no_grad():
-        w._value = eff._value
+        w._value = eff._concrete()
     setattr(layer, pname, w)
     for extra in (f"{pname}_v", f"{pname}_g"):
         if extra in layer._parameters:
@@ -117,7 +117,7 @@ def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations=1,
     setattr(layer, f"{name}_v", v)
     orig = layer.create_parameter(list(w.shape))
     with __import__("paddle_tpu").no_grad():
-        orig._value = w._value
+        orig._value = w._concrete()
     setattr(layer, f"{name}_orig", orig)
     if name in layer._parameters:
         del layer._parameters[name]

@@ -165,6 +165,14 @@ def _any_symbolic(obj) -> bool:
 # here ONE choke point sees them all)
 TRACE_HOOK = [None]
 
+# tape-segment recording state, owned here (the cheapest possible check on
+# the dispatch hot path) but driven by paddle_tpu/jit/segments.py, which
+# installs the recorder class on import and flips SEGMENT_MODE in its
+# segment_mode() context manager
+SEGMENT_MODE = [0]
+SEGMENT_OPEN: List[Any] = [None]
+SEGMENT_RECORDER_CLS: List[Any] = [None]
+
 
 def dispatch(name: str, args, kwargs, _op=None):
     """The generic ad_func (reference eager_gen.py:372 template).
@@ -207,6 +215,43 @@ def dispatch(name: str, args, kwargs, _op=None):
         and engine.is_grad_enabled()
         and any(not t.stop_gradient for t in tensors)
     )
+
+    # tape-segment recording (jit/segments.py): inside a segment_mode
+    # context, stageable ops append to the open segment and return lazy
+    # outputs; anything that can't stage (dynamic shapes, rng keys that
+    # would bake into the cached executable, direct ops, unhashable attrs,
+    # nan-checking) flushes the segment first so program order holds.
+    if SEGMENT_MODE[0]:
+        recordable = (
+            _op is None
+            and not op.dynamic
+            and not op.rng
+            and _hashable(args_tpl)
+            and _hashable(kwargs_tpl)
+            and not flags.flag("FLAGS_check_nan_inf")
+        )
+        if recordable:
+            def seg_raw_f(*tvals):
+                if cast_dtype is not None:
+                    tvals = tuple(
+                        v.astype(cast_dtype)
+                        if hasattr(v, "dtype")
+                        and np.issubdtype(v.dtype, np.floating)
+                        else v
+                        for v in tvals
+                    )
+                return op.impl(
+                    *_fill(args_tpl, tvals),
+                    **{k: _fill(v, tvals) for k, v in kwargs_tpl})
+
+            if SEGMENT_OPEN[0] is None:
+                SEGMENT_OPEN[0] = SEGMENT_RECORDER_CLS[0]()
+            sig_key = (args_tpl, kwargs_tpl, cast_dtype)
+            return SEGMENT_OPEN[0].record(
+                name, seg_raw_f, sig_key, tensors, need_grad)
+        if SEGMENT_OPEN[0] is not None:
+            SEGMENT_OPEN[0].flush()
+            vals = [t._value for t in tensors]  # flush rebinds lazy inputs
 
     use_jit = (
         flags.flag("FLAGS_eager_op_jit")

@@ -1,0 +1,302 @@
+"""Sub-function graph stitching: tape-segment compilation around graph
+breaks inside ONE function/layer body (jit/segments.py).
+
+Reference: SOT region compilation — the interpreter compiles traceable
+bytecode regions around a break inside a single function
+(python/paddle/jit/sot/opcode_translator/executor/opcode_executor.py:1880,
+translate.py:37)."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import segments
+from paddle_tpu.ops.registry import TRACE_HOOK
+
+rng = np.random.default_rng(3)
+
+
+@pytest.fixture
+def trace_events():
+    events = []
+    TRACE_HOOK[0] = lambda name, args, kwargs: events.append((name, kwargs))
+    yield events
+    TRACE_HOOK[0] = None
+
+
+def _tensors():
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32),
+                         stop_gradient=False)
+    w1 = paddle.to_tensor(rng.standard_normal((8, 8)).astype(np.float32),
+                          stop_gradient=False)
+    w2 = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32),
+                          stop_gradient=False)
+    return x, w1, w2
+
+
+def _broken_fn():
+    @paddle.jit.to_static
+    def f(x, w1, w2):
+        h = paddle.tanh(paddle.matmul(x, w1))
+        s = h.sum().item()          # graph break between the two blocks
+        h = h * (1.0 if s > 0 else 2.0)
+        return paddle.matmul(h, w2).sum()
+
+    return f
+
+
+def _eager_ref(x, w1, w2):
+    h = paddle.tanh(paddle.matmul(x, w1))
+    s = h.sum().item()
+    h = h * (1.0 if s > 0 else 2.0)
+    return paddle.matmul(h, w2).sum()
+
+
+def test_break_compiles_both_blocks_as_segments(trace_events):
+    """The VERDICT-r4 criterion: a plain function with .item() between two
+    matmul blocks executes BOTH blocks from compiled segments (trace
+    hook shows two segment replays, each containing a matmul), results
+    and training grads matching eager."""
+    f = _broken_fn()
+    x, w1, w2 = _tensors()
+    with warnings.catch_warnings(record=True) as ws:
+        warnings.simplefilter("always")
+        out1 = f(x, w1, w2)          # first call: break detected
+    assert any("segment mode" in str(w.message) for w in ws)
+
+    segments.reset_stats()
+    trace_events.clear()
+    out2 = f(x, w1, w2)              # segmented replay
+    replays = [e for e in trace_events if e[0] == "jit.segment_replay"]
+    assert len(replays) == 2, replays          # one segment per block
+    # both replays ran a compiled program containing the block's matmul
+    op_lists = [e[1] for e in trace_events if e[0] == "jit.segment_replay"]
+    assert all(ev["compiled"] for ev in op_lists)
+    assert segments.STATS["flushes"] == 2
+    assert np.isclose(float(out1), float(out2))
+
+    # grads flow through the segment GradNodes and match pure eager
+    out2.backward()
+    xe = paddle.to_tensor(x.numpy(), stop_gradient=False)
+    w1e = paddle.to_tensor(w1.numpy(), stop_gradient=False)
+    w2e = paddle.to_tensor(w2.numpy(), stop_gradient=False)
+    _eager_ref(xe, w1e, w2e).backward()
+    np.testing.assert_allclose(x.grad.numpy(), xe.grad.numpy(), atol=1e-5)
+    np.testing.assert_allclose(w1.grad.numpy(), w1e.grad.numpy(), atol=1e-5)
+    np.testing.assert_allclose(w2.grad.numpy(), w2e.grad.numpy(), atol=1e-5)
+
+
+def test_segment_compile_cache_hits_across_calls():
+    f = _broken_fn()
+    x, w1, w2 = _tensors()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        f(x, w1, w2)                 # break + first segmented run compiles
+        f(x, w1, w2)
+    segments.reset_stats()
+    f(x, w1, w2)                     # steady state: all cache hits
+    assert segments.STATS["flushes"] == 2
+    assert segments.STATS["compiles"] == 0
+    assert segments.STATS["cache_hits"] == 2
+
+
+def test_host_control_flow_flips_with_values():
+    """The eager glue re-runs each call, so a branch on a host-read value
+    tracks the data (the correctness property whole-graph caching would
+    get wrong)."""
+    @paddle.jit.to_static
+    def g(x):
+        y = x * 2.0
+        if y.sum().item() > 0:       # break + data-dependent branch
+            return (y + 1.0).sum()
+        return (y - 1.0).sum()
+
+    pos = paddle.to_tensor(np.ones((3,), np.float32))
+    neg = paddle.to_tensor(-np.ones((3,), np.float32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out_pos = g(pos)
+    out_neg = g(neg)                 # same signature, other branch
+    np.testing.assert_allclose(float(out_pos), 3 * (2 + 1))
+    np.testing.assert_allclose(float(out_neg), 3 * (-2 - 1))
+
+
+def test_childless_layer_body_segmented(trace_events):
+    """A monolithic layer (no child layers) with a break keeps its op
+    regions compiled via segments rather than pinning wholly to eager."""
+    import paddle_tpu.nn as nn
+
+    class Mono(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.w1 = self.create_parameter([8, 8], "float32")
+            self.w2 = self.create_parameter([8, 4], "float32")
+
+        def forward(self, x):
+            h = paddle.tanh(paddle.matmul(x, self.w1))
+            s = h.sum().item()       # break inside the body
+            h = h * (1.0 if s < 1e9 else 2.0)
+            return paddle.matmul(h, self.w2).sum()
+
+    m = Mono()
+    static = paddle.jit.to_static(m)
+    x = paddle.to_tensor(rng.standard_normal((2, 8)).astype(np.float32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out1 = static(x)
+    segments.reset_stats()
+    trace_events.clear()
+    out2 = static(x)
+    replays = [e for e in trace_events if e[0] == "jit.segment_replay"]
+    assert len(replays) == 2
+    assert np.isclose(float(out1), float(out2))
+    # training backward through the segmented body
+    loss = static(x)
+    loss.backward()
+    assert m.w1.grad is not None and np.isfinite(m.w1.grad.numpy()).all()
+
+
+def test_dynamic_op_flushes_and_stays_correct():
+    """A dynamic-shape op (masked_select) inside the region can't stage:
+    the open segment flushes, the op runs eagerly, and later ops open a
+    new segment — results identical to eager."""
+    @paddle.jit.to_static
+    def h(x):
+        y = x * 3.0
+        _ = y.sum().item()           # break -> segment mode
+        picked = paddle.masked_select(y, y > 0)   # dynamic: flush + eager
+        return (picked * 2.0).sum()
+
+    x = paddle.to_tensor(np.array([-1.0, 2.0, -3.0, 4.0], np.float32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out1 = h(x)
+    out2 = h(x)
+    np.testing.assert_allclose(float(out1), (2.0 + 4.0) * 3 * 2)
+    np.testing.assert_allclose(float(out2), float(out1))
+
+
+def test_rng_op_not_baked_into_segments():
+    """rng ops are never recorded (their key would freeze into the cached
+    executable): dropout inside a broken function still varies across
+    calls."""
+    import paddle_tpu.nn.functional as F
+
+    @paddle.jit.to_static
+    def d(x):
+        y = x * 1.0
+        _ = y.sum().item()
+        return F.dropout(y, p=0.5, training=True)
+
+    paddle.seed(7)
+    x = paddle.to_tensor(np.ones((64,), np.float32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        a = d(x).numpy()
+    b = d(x).numpy()
+    c = d(x).numpy()
+    assert not (np.array_equal(a, b) and np.array_equal(b, c))
+
+
+def test_segment_grads_compose_with_later_eager_ops():
+    """A lazy segment output consumed by later eager ops (after flush)
+    chains GradNodes across the segment boundary."""
+    @paddle.jit.to_static
+    def f(x):
+        y = paddle.tanh(x * 2.0)
+        s = y.sum().item()           # break
+        return y * float(np.sign(s) or 1.0)
+
+    x = paddle.to_tensor(np.array([0.3, -0.2], np.float32),
+                         stop_gradient=False)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        f(x)
+    out = f(x)
+    loss = (out * out).sum()         # eager ops on segment outputs
+    loss.backward()
+    xe = paddle.to_tensor(x.numpy(), stop_gradient=False)
+    ye = paddle.tanh(xe * 2.0)
+    se = ye.sum().item()
+    le = ((ye * float(np.sign(se) or 1.0)) ** 2).sum()
+    le.backward()
+    np.testing.assert_allclose(x.grad.numpy(), xe.grad.numpy(), atol=1e-5)
+
+
+def test_batchnorm_buffers_survive_segments():
+    """BN running stats are written via raw _value aliasing — segments
+    must never leak a lazy value into a buffer (advisor-class bug: second
+    call would crash on the stale _LazyValue)."""
+    import paddle_tpu.nn as nn
+
+    class BNBody(nn.BatchNorm1D):
+        # childless (subclass, not child module) so the break switches the
+        # WHOLE body — including the running-stat update with its raw
+        # `_value` alias write — into segment mode
+        def forward(self, x):
+            h = super().forward(x)
+            _ = h.sum().item()       # break AFTER the BN update
+            return (h * 2.0).sum()
+
+    m = BNBody(4)
+    m.train()
+    assert not any(True for _ in m.children())
+    static = paddle.jit.to_static(m)
+    x = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        static(x)
+    static(x)                        # crashes if a lazy value leaked
+    static(x)
+    mean = m._mean.numpy()
+    assert np.isfinite(mean).all() and not np.allclose(mean, 0.0)
+
+
+def test_inplace_mutation_mid_segment_keeps_program_order():
+    """zero_() on a tensor already recorded as a segment input must flush
+    first so the replay reads the PRE-mutation value."""
+    @paddle.jit.to_static
+    def f(x, buf):
+        y = x + buf                  # records buf as ext input
+        _ = x.sum().item()           # break puts us in segment mode
+        z = y * 2.0
+        buf.zero_()                  # in-place: must flush the segment
+        return (z + buf).sum()
+
+    x = paddle.to_tensor(np.ones((3,), np.float32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        buf = paddle.to_tensor(np.full((3,), 10.0, np.float32))
+        out1 = f(x, buf)
+    buf = paddle.to_tensor(np.full((3,), 10.0, np.float32))
+    out2 = f(x, buf)
+    # eager semantics: z = (x + 10) * 2 = 22 each; buf zeroed after
+    np.testing.assert_allclose(float(out1), 3 * 22.0)
+    np.testing.assert_allclose(float(out2), 3 * 22.0)
+
+
+def test_no_grad_glue_flush_keeps_training_grads():
+    """A host read inside no_grad() (metric logging glue) flushes the
+    segment — the GradNode must still span the recorded training ops."""
+    @paddle.jit.to_static
+    def f(x, w):
+        h = paddle.matmul(x, w)
+        with paddle.no_grad():
+            _ = h.mean().item()      # break + flush under no_grad
+        return (h * h).sum()
+
+    x = paddle.to_tensor(rng.standard_normal((3, 4)).astype(np.float32))
+    w = paddle.to_tensor(rng.standard_normal((4, 2)).astype(np.float32),
+                         stop_gradient=False)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        f(x, w)
+    out = f(x, w)
+    out.backward()
+    assert w.grad is not None
+    we = paddle.to_tensor(w.numpy(), stop_gradient=False)
+    he = paddle.matmul(x, we)
+    (he * he).sum().backward()
+    np.testing.assert_allclose(w.grad.numpy(), we.grad.numpy(), atol=1e-5)
