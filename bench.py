@@ -290,9 +290,12 @@ def child_ernie(layers: int, hidden: int, batch: int, seq: int, vocab: int,
 
 
 def child_decode(layers: int, hidden: int, batch: int, prompt: int,
-                 gen: int, vocab: int):
+                 gen: int, vocab: int, pool_mult: int = 1):
     """Serving rung: paged-KV greedy decode throughput + first-token
-    latency (the Pallas paged-decode kernel path; VERDICT r3 Weak #10)."""
+    latency (the Pallas paged-decode kernel path; VERDICT r3 Weak #10).
+    pool_mult > 1 allocates a pool pool_mult x the sequence budget — the
+    dead-page cost probe: with the clamped-index_map kernel the ms/token
+    should be ~equal to pool_mult=1 (dead pages cost no DMA)."""
     import jax
     import numpy as np
 
@@ -308,7 +311,7 @@ def child_decode(layers: int, hidden: int, batch: int, prompt: int,
                     max_seq_len=prompt + gen, dropout=0.0)
     model = GPT(cfg)
     model.eval()
-    g = PagedGPTGenerator(model)
+    g = PagedGPTGenerator(model, max_len=(prompt + gen) * pool_mult)
     rng = np.random.default_rng(0)
     ids = paddle.to_tensor(rng.integers(0, vocab, (batch, prompt)))
     t0 = time.time()
@@ -324,7 +327,8 @@ def child_decode(layers: int, hidden: int, batch: int, prompt: int,
                   "decode_ms_per_token": dt / gen * 1000,
                   "compile_s": compile_s, "layers": layers,
                   "hidden": hidden, "batch": batch, "prompt": prompt,
-                  "gen": gen})
+                  "gen": gen, "pool_mult": pool_mult,
+                  "pool_len": (prompt + gen) * pool_mult})
 
 
 def _write_child(obj: dict) -> None:
@@ -437,21 +441,34 @@ def main():
             log(f"ernie rung: {r['tokens_per_sec']:.0f} tok/s, "
                 f"mfu={r['mfu']:.3f}")
 
-    # paged-decode serving rung (secondary line; headline stays training)
-    if on_tpu and remaining() > 120:
-        r = run_child("decode:12:768:8:256:128:32768", min(600, remaining()))
-        if r is not None:
-            line = {"metric": "gpt124m_paged_decode_tokens_per_sec",
-                    "value": round(r["tokens_per_sec"], 1),
-                    "unit": "tokens/s", "vs_baseline": 0.0,
-                    "decode_ms_per_token": round(
-                        r["decode_ms_per_token"], 2),
-                    "backend": r["backend"],
-                    "compile_s": round(r["compile_s"], 1)}
-            emit(line)
-            _cache_result(line)
-            log(f"decode rung: {r['tokens_per_sec']:.0f} tok/s, "
-                f"{r['decode_ms_per_token']:.1f} ms/token")
+    # paged-decode serving rung at TWO pool sizes (secondary lines; the
+    # headline stays training). ~equal ms/token across pools verifies the
+    # clamped-index_map kernel: dead pages cost no DMA.
+    decode_ms = {}
+    for pool_mult in (1, 4):
+        if not (on_tpu and remaining() > 120):
+            break
+        r = run_child(f"decode:12:768:8:256:128:32768:{pool_mult}",
+                      min(600, remaining()))
+        if r is None:
+            continue
+        suffix = "" if pool_mult == 1 else f"_pool{pool_mult}x"
+        line = {"metric": f"gpt124m_paged_decode_tokens_per_sec{suffix}",
+                "value": round(r["tokens_per_sec"], 1),
+                "unit": "tokens/s", "vs_baseline": 0.0,
+                "decode_ms_per_token": round(r["decode_ms_per_token"], 2),
+                "pool_len": r["pool_len"], "backend": r["backend"],
+                "compile_s": round(r["compile_s"], 1)}
+        emit(line)
+        _cache_result(line)
+        decode_ms[pool_mult] = r["decode_ms_per_token"]
+        log(f"decode rung (pool x{pool_mult}): "
+            f"{r['tokens_per_sec']:.0f} tok/s, "
+            f"{r['decode_ms_per_token']:.1f} ms/token")
+    if len(decode_ms) == 2:
+        ratio = decode_ms[4] / max(decode_ms[1], 1e-9)
+        log(f"dead-page cost ratio (pool 4x / 1x ms/token): {ratio:.2f} "
+            f"(~1.0 = dead pages free)")
 
     if best is not None:
         # headline repeated last: drivers that parse the final stdout JSON

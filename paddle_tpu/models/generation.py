@@ -409,6 +409,23 @@ def paged_gather(pool, block_table):
     return pages.reshape(b, bps * bs, *pages.shape[3:])
 
 
+_PAGED_FALLBACK_WARNED: set = set()
+
+
+def _warn_paged_fallback(head_dim):
+    """Warn once per head dim when decode declines the paged kernel and
+    pays the full [b, max_len, h, d] gather instead (VERDICT-r4 #10)."""
+    if head_dim in _PAGED_FALLBACK_WARNED:
+        return
+    _PAGED_FALLBACK_WARNED.add(head_dim)
+    import warnings
+
+    warnings.warn(
+        f"paged decode: head dim {head_dim} not 8-aligned — falling back "
+        "to the gathered dense-cache path (full pool gather per step)",
+        stacklevel=3)
+
+
 def block_multihead_attention(q, k_pool, v_pool, block_table, pos,
                               scale=None):
     """Decode-step attention over a paged KV cache (reference
@@ -430,6 +447,7 @@ def block_multihead_attention(q, k_pool, v_pool, block_table, pos,
             out = paged_decode_attention(q[:, 0], k_pool, v_pool,
                                          block_table, pos, scale=scale)
             return out.reshape(b, 1, h * d)
+        _warn_paged_fallback(d)
     k = paged_gather(k_pool, block_table)
     v = paged_gather(v_pool, block_table)
     return masked_cache_attention(q, k, v, pos, scale=scale)

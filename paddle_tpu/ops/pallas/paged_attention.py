@@ -18,9 +18,13 @@ left-to-right with running (m, l, acc) in VMEM scratch.
 
 Layout: pools [num_blocks, block_size, h, d]; q [b, h, d] (t = 1);
 block_table [b, pages_per_seq] int32; pos [b] int32 (keys <= pos visible,
-masked_cache_attention semantics). Pages past a sequence's pos are
-skipped with pl.when (their DMA is still scheduled — the grid is static —
-but no FLOPs run; a dynamic-grid variant is future work)."""
+masked_cache_attention semantics). Pages past a sequence's pos cost no
+DMA: the kv index_map clamps the page index to the sequence's LAST LIVE
+page, and the Pallas pipeline elides the block copy when consecutive grid
+steps map to the same block — so a short sequence in a long max_len pool
+pays only its own pages' bandwidth (the grid still iterates the dead
+steps, but they are scalar no-ops: pl.when skips the FLOPs and the
+revisited block is already resident in VMEM)."""
 
 from __future__ import annotations
 
@@ -100,15 +104,19 @@ def paged_decode_attention(q, k_pool, v_pool, block_table, pos, scale=None,
         interpret = jax.default_backend() != "tpu"
     pos_arr = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (b,))
 
+    def kv_map(b, j, t, p):
+        # clamp dead pages (j beyond pos) to the last live page: the
+        # pipeline sees an unchanged block index and elides the DMA
+        jc = jnp.minimum(j, p[b] // block_size)
+        return (t[b, jc], 0, 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, n_pages),
         in_specs=[
             pl.BlockSpec((1, h, d), lambda b, j, t, p: (b, 0, 0)),
-            pl.BlockSpec((1, block_size, h, d),
-                         lambda b, j, t, p: (t[b, j], 0, 0, 0)),
-            pl.BlockSpec((1, block_size, h, d),
-                         lambda b, j, t, p: (t[b, j], 0, 0, 0)),
+            pl.BlockSpec((1, block_size, h, d), kv_map),
+            pl.BlockSpec((1, block_size, h, d), kv_map),
         ],
         out_specs=pl.BlockSpec((1, h, d), lambda b, j, t, p: (b, 0, 0)),
         scratch_shapes=[

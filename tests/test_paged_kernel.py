@@ -85,3 +85,36 @@ def test_block_mha_routes_to_kernel(monkeypatch):
     out = gen.block_multihead_attention(q[:, None], kp, vp, tbl, 10)
     assert called.get("yes"), "paged kernel not dispatched for t=1"
     assert out.shape == (2, 1, 4 * 64)
+
+
+def test_dead_pages_do_not_change_output():
+    """Pool-size invariance of the clamped-index_map kernel: the same
+    sequence content in a 4x pool (extra dead pages past pos) gives a
+    bit-identical result — the dead grid steps fold nothing in and their
+    clamped DMA revisits the last live page."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.paged_attention import paged_decode_attention
+
+    rng = np.random.default_rng(5)
+    b, h, d, bs = 2, 4, 64, 8
+    pos = jnp.asarray([9, 21], jnp.int32)
+    n_live = 3                            # ceil((21+1)/8)
+    kv = rng.standard_normal((b, n_live * bs, h, d)).astype(np.float32)
+    q = jnp.asarray(rng.standard_normal((b, h, d)), np.float32)
+
+    def run(n_pages):
+        nb = b * n_pages
+        kp = np.zeros((nb, bs, h, d), np.float32)
+        vp = np.zeros((nb, bs, h, d), np.float32)
+        table = np.arange(nb, dtype=np.int32).reshape(b, n_pages)
+        for i in range(b):
+            for j in range(n_live):
+                kp[table[i, j]] = kv[i, j * bs:(j + 1) * bs]
+                vp[table[i, j]] = kv[i, j * bs:(j + 1) * bs] * 0.5
+        return paged_decode_attention(q, jnp.asarray(kp), jnp.asarray(vp),
+                                      jnp.asarray(table), pos,
+                                      interpret=True)
+
+    tight = run(n_live)
+    huge = run(4 * n_live)                # 9 dead pages per sequence
+    np.testing.assert_array_equal(np.asarray(tight), np.asarray(huge))
