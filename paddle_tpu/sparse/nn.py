@@ -5,10 +5,14 @@ BatchNorm, MaxPool3D, functional/conv.py, functional/transformer.py
 sparse attention) over phi/kernels/sparse/ (gpu conv via gather-GEMM).
 
 TPU-native design note: the reference's sparse conv builds a rulebook and
-gathers active sites into dense GEMM tiles (cuSPARSE-free even on GPU). On
-TPU the MXU eats large dense tiles; below ~90% sparsity a dense conv beats
-gather/scatter, so conv/pool here compute through the dense form (XLA
-fuses densify->conv->sparsify) while keeping the SPARSE SEMANTICS:
+gathers active sites into dense GEMM tiles (cuSPARSE-free even on GPU).
+Here the SUBMANIFOLD convs follow the same recipe when sparsity pays: at
+active fraction < GATHER_THRESHOLD a host-resolved rulebook gathers the A
+active sites' neighbor rows and one batched [K,A,Cin]x[K,Cin,Cout] GEMM
+runs on the MXU — FLOPs proportional to active sites, not the grid
+(_subm_gather_gemm). Denser inputs (and the pattern-changing Conv3D/2D)
+compute through the dense form, where the MXU's appetite for large tiles
+beats gather/scatter anyway; either way the SPARSE SEMANTICS hold:
 
   * Conv3D/Conv2D: output pattern = wherever the conv response is nonzero;
   * SubmConv3D/SubmConv2D: submanifold — output pattern is FORCED to the
@@ -52,10 +56,89 @@ def _active_mask(x):
 
 # ------------------------------------------------------------- functional
 
+# active-fraction threshold below which the submanifold conv switches to
+# gather-GEMM (the rulebook path): at high sparsity the A-row GEMMs beat
+# the dense conv's full-grid FLOPs even on the MXU
+GATHER_THRESHOLD = 0.125
+
+
+def _gather_gemm_compute(feats_pad, nbr_idx, wk, bias_val):
+    """Device arithmetic of the rulebook path (jittable, static shapes):
+    feats_pad [A+1, Cin] (row 0 = zeros for missing neighbors),
+    nbr_idx [K, A] int32 (-1 = missing), wk [K, Cin, Cout].
+    Returns [A, Cout]. FLOPs ~ 2*K*A*Cin*Cout — proportional to ACTIVE
+    sites, not the dense grid (the cost-model assert in
+    tests/test_sparse_deep.py pins this)."""
+    g = jnp.take(feats_pad, nbr_idx + 1, axis=0)       # [K, A, Cin]
+    out = jnp.einsum("kac,kco->ao", g, wk,
+                     preferred_element_type=jnp.float32).astype(
+        feats_pad.dtype)
+    if bias_val is not None:
+        out = out + bias_val
+    return out
+
+
+def _subm_gather_gemm(d, w, bias_val, dilation, nd):
+    """Submanifold conv computed ONLY at active sites — the TPU analogue
+    of the reference's rulebook gather-GEMM sparse conv
+    (phi/kernels/sparse/gpu/conv_kernel.cu, conv_grad_kernel.cu; Graham et
+    al. submanifold sparse convnets): host numpy resolves each kernel
+    offset's neighbor row per active site (eager-only, like every
+    dynamic-shape op), then one batched GEMM per call runs on device.
+
+    d: dense [N, *spatial, Cin]; w: [*kernel, Cin, Cout]. Returns the
+    dense [N, *spatial, Cout] with only the input's active sites set."""
+    ksizes = w.shape[:nd]
+    cin, cout = w.shape[-2], w.shape[-1]
+    dims = d.shape[:-1]                       # (N, *spatial)
+    dh = np.asarray(d)
+    mask = np.any(dh != 0, axis=-1)
+    coords = np.argwhere(mask)                # [A, 1+nd]
+    A = len(coords)
+    out_shape = dims + (cout,)
+    if A == 0:
+        return jnp.zeros(out_shape, d.dtype)
+    feats = jnp.asarray(dh[mask])             # [A, Cin]
+    lin = np.ravel_multi_index(tuple(coords.T), dims)
+    order = np.argsort(lin)
+    lin_sorted = lin[order]
+    offsets = np.stack(np.meshgrid(
+        *[np.arange(k) for k in ksizes], indexing="ij"),
+        -1).reshape(-1, nd)                   # [K, nd]
+    # index-space offsets matching the dense path's SAME padding exactly:
+    # tap m*dl - ((k-1)*dl)//2 — for even kernels with dilation this is
+    # NOT (m - (k-1)//2)*dl (method='auto' must never change numerics)
+    pad_left = np.asarray([((k - 1) * dl) // 2
+                           for k, dl in zip(ksizes, dilation)])
+    offsets = offsets * np.asarray(dilation) - pad_left
+    K = len(offsets)
+    nbr = np.full((K, A), -1, np.int64)
+    for ki, off in enumerate(offsets):
+        nc = coords.copy()
+        nc[:, 1:] += off
+        valid = np.all((nc[:, 1:] >= 0)
+                       & (nc[:, 1:] < np.asarray(dims[1:])), axis=1)
+        nlin = np.ravel_multi_index(
+            tuple(np.where(valid[:, None], nc, 0).T), dims)
+        pos = np.searchsorted(lin_sorted, nlin)
+        pos = np.clip(pos, 0, A - 1)
+        found = valid & (lin_sorted[pos] == nlin)
+        nbr[ki] = np.where(found, order[pos], -1)
+    feats_pad = jnp.concatenate(
+        [jnp.zeros((1, cin), feats.dtype), feats])
+    wk = jnp.asarray(w).reshape(K, cin, cout)
+    out = _gather_gemm_compute(feats_pad, jnp.asarray(nbr, jnp.int32), wk,
+                               bias_val)
+    dense_out = jnp.zeros(out_shape, d.dtype)
+    return dense_out.at[tuple(coords.T)].set(out.astype(d.dtype))
+
+
 def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd,
-             subm=False):
+             subm=False, method="auto"):
     """x: sparse [N, *spatial, Cin] (paddle sparse NDHWC/NHWC layout);
-    weight dense [*kernel, Cin, Cout]."""
+    weight dense [*kernel, Cin, Cout]. method: 'auto' picks gather-GEMM
+    for submanifold convs whose active fraction is below
+    GATHER_THRESHOLD, else the dense-form conv; 'gather'/'dense' force."""
     d = _dense(x)
     w = weight._value if isinstance(weight, Tensor) else jnp.asarray(weight)
     if isinstance(stride, int):
@@ -69,6 +152,18 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd,
         if tuple(stride) != (1,) * nd:
             raise ValueError("submanifold conv requires stride=1 "
                              "(output sites must equal input sites)")
+        if groups == 1 and method != "dense":
+            b_val = None
+            if bias is not None:
+                b_val = (bias._value if isinstance(bias, Tensor)
+                         else jnp.asarray(bias))
+            # one scalar readback (not a full device->host transfer) to
+            # pick the method on the auto path
+            if method == "gather" or (
+                    float(jnp.mean(jnp.any(d != 0, axis=-1)))
+                    < GATHER_THRESHOLD):
+                return _sparsify(_subm_gather_gemm(d, w, b_val, dilation,
+                                                   nd))
         padding = [((k - 1) * dl // 2, (k - 1) * dl - (k - 1) * dl // 2)
                    for k, dl in zip(w.shape[:nd], dilation)]
     elif isinstance(padding, int):
@@ -99,9 +194,9 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 
 def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
-                groups=1, data_format="NDHWC", key=None):
+                groups=1, data_format="NDHWC", key=None, method="auto"):
     return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
-                    subm=True)
+                    subm=True, method=method)
 
 
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
@@ -110,9 +205,9 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 
 def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
-                groups=1, data_format="NHWC", key=None):
+                groups=1, data_format="NHWC", key=None, method="auto"):
     return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
-                    subm=True)
+                    subm=True, method=method)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, data_format="NDHWC"):

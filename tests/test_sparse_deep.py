@@ -241,3 +241,68 @@ def test_sparse_batchnorm_active_sites():
     bn.eval()
     out2 = bn(x)
     assert np.isfinite(np.asarray(out2.to_dense()._value)).all()
+
+
+def test_subm_gather_gemm_parity_with_dense():
+    """The rulebook gather-GEMM path (high sparsity) must match the
+    dense-form submanifold conv bit-for-tolerance, including dilation and
+    bias (reference phi/kernels/sparse/gpu/conv_kernel.cu)."""
+    rng = np.random.default_rng(9)
+    for nd, shape, ks in [(2, (2, 12, 12, 3), 3), (3, (1, 8, 8, 8, 2), 3)]:
+        dense = np.zeros(shape, np.float32)
+        flat = dense.reshape(-1, shape[-1])
+        idx = rng.choice(len(flat), size=max(4, len(flat) // 20),
+                         replace=False)
+        flat[idx] = rng.standard_normal((len(idx), shape[-1]))
+        x = S.to_sparse_coo(paddle.to_tensor(dense))
+        w = paddle.to_tensor(rng.standard_normal(
+            (ks,) * nd + (shape[-1], 5)).astype(np.float32) * 0.3)
+        b = paddle.to_tensor(rng.standard_normal(5).astype(np.float32))
+        fn = (S.nn.functional.subm_conv2d if nd == 2
+              else S.nn.functional.subm_conv3d)
+        for dilation in (1, 2):
+            out_g = fn(x, w, b, dilation=dilation, method="gather")
+            out_d = fn(x, w, b, dilation=dilation, method="dense")
+            np.testing.assert_allclose(
+                np.asarray(out_g.to_dense()._value),
+                np.asarray(out_d.to_dense()._value), atol=1e-4,
+                err_msg=f"nd={nd} dilation={dilation}")
+
+
+def test_subm_gather_gemm_flops_drop_with_sparsity():
+    """VERDICT-r5 criterion: cost-model FLOPs of the sparse compute drop
+    with sparsity — the gather path's arithmetic is proportional to
+    active sites, the dense path's to the full grid."""
+    import jax.numpy as jnp
+    from paddle_tpu.sparse.nn import _gather_gemm_compute
+    from paddle_tpu.utils.cost_model import roofline_estimate
+
+    N, H, W, Cin, Cout, ks = 1, 32, 32, 8, 8, 3
+    K = ks * ks
+    grid = N * H * W
+
+    def dense_conv(d, w):
+        import jax
+        dn = jax.lax.conv_dimension_numbers(
+            d.shape, w.shape, ("NHWC", "HWIO", "NHWC"))
+        return jax.lax.conv_general_dilated(
+            d, w, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn)
+
+    d = jnp.ones((N, H, W, Cin), jnp.float32)
+    w = jnp.ones((ks, ks, Cin, Cout), jnp.float32)
+    dense_flops = roofline_estimate(dense_conv, d, w)["flops"]
+
+    flops_at = {}
+    for frac in (0.2, 0.05):
+        A = int(grid * frac)
+        feats_pad = jnp.ones((A + 1, Cin), jnp.float32)
+        nbr = jnp.zeros((K, A), jnp.int32)
+        wk = jnp.ones((K, Cin, Cout), jnp.float32)
+        flops_at[frac] = roofline_estimate(
+            lambda f, n, ww: _gather_gemm_compute(f, n, ww, None),
+            feats_pad, nbr, wk)["flops"]
+    # sparser input -> fewer FLOPs, and far below the dense conv
+    assert flops_at[0.05] < flops_at[0.2] < dense_flops, (
+        flops_at, dense_flops)
+    # the arithmetic scales ~linearly with active sites
+    assert flops_at[0.05] < dense_flops * 0.15, (flops_at, dense_flops)
