@@ -27,6 +27,13 @@
 //        5=SAVE   (payload: path -> u64 nrows written)
 //        6=LOAD   (payload: path -> u64 nrows read)
 //        7=CLEAR
+//        8=SSD_CONFIG (payload: u64 ram_cap_rows | path bytes) — enables
+//          the disk overflow tier (reference ps/table/ssd_sparse_table.h
+//          semantics, rocksdb collapsed to a log-structured file + index):
+//          rows beyond ram_cap_rows demote to disk LRU-last on insert,
+//          a PULL/PUSH of a demoted key promotes it back; weights and
+//          optimizer state round-trip bit-identically, so training is
+//          byte-equal to the RAM-only path at any cap
 //   response: u32 len | bytes
 
 #include <arpa/inet.h>
@@ -35,8 +42,11 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <fcntl.h>
+
 #include <algorithm>
 #include <atomic>
+#include <list>
 #include <chrono>
 #include <cmath>
 #include <cstdint>
@@ -60,6 +70,7 @@ enum Op : uint8_t {
   kSave = 5,
   kLoad = 6,
   kClear = 7,
+  kSsdConfig = 8,
 };
 
 enum Optim : uint8_t { kSGD = 0, kAdagrad = 1, kAdam = 2 };
@@ -102,11 +113,18 @@ struct Row {
   std::vector<float> m;  // adagrad G / adam m
   std::vector<float> v;  // adam v
   int64_t step = 0;
+  std::list<int64_t>::iterator lru_it;  // valid while resident + SSD on
 };
 
 struct Shard {
   std::mutex mu;
   std::unordered_map<int64_t, Row> rows;
+  std::list<int64_t> lru;  // front = most recent (SSD tier only)
+};
+
+struct DiskRec {
+  uint64_t off;
+  uint8_t has_state;
 };
 
 struct Table {
@@ -115,18 +133,125 @@ struct Table {
   float lr = 0.01f;
   float init = 0.01f;
   Shard shards[kNumShards];
+  // --- SSD overflow tier (0 fd = disabled) ---
+  int disk_fd = -1;
+  size_t ram_cap_per_shard = 0;  // 0 = unlimited
+  std::mutex disk_mu;
+  std::unordered_map<int64_t, DiskRec> disk_index;
+  uint64_t disk_end = 0;
+
+  bool ssd() const { return disk_fd >= 0; }
+
+  size_t rec_bytes(bool has_state) const {
+    return 8 + 1 + size_t{4} * dim +
+           (has_state ? size_t{8} * dim + 8 : 0);
+  }
 
   Shard& shard(int64_t key) {
     return shards[static_cast<uint64_t>(key) % kNumShards];
   }
 
+  // demote the LRU-last resident rows until the shard is at cap.
+  // caller holds s.mu; takes disk_mu inside (lock order shard -> disk).
+  void evict_over_cap(Shard& s) {
+    if (!ssd() || ram_cap_per_shard == 0) return;
+    while (s.rows.size() > ram_cap_per_shard && !s.lru.empty()) {
+      int64_t victim = s.lru.back();
+      auto it = s.rows.find(victim);
+      if (it == s.rows.end()) {  // defensive: stale lru entry
+        s.lru.pop_back();
+        continue;
+      }
+      const Row& r = it->second;
+      uint8_t has = r.m.empty() ? 0 : 1;
+      std::vector<char> buf(rec_bytes(has));
+      char* p = buf.data();
+      std::memcpy(p, &victim, 8); p += 8;
+      std::memcpy(p, &has, 1); p += 1;
+      std::memcpy(p, r.w.data(), size_t{4} * dim); p += size_t{4} * dim;
+      if (has) {
+        std::memcpy(p, r.m.data(), size_t{4} * dim); p += size_t{4} * dim;
+        if (r.v.size() == dim) {
+          std::memcpy(p, r.v.data(), size_t{4} * dim);
+        } else {
+          std::memset(p, 0, size_t{4} * dim);
+        }
+        p += size_t{4} * dim;
+        std::memcpy(p, &r.step, 8);
+      }
+      {
+        std::lock_guard<std::mutex> dk(disk_mu);
+        if (::pwrite(disk_fd, buf.data(), buf.size(),
+                     static_cast<off_t>(disk_end)) !=
+            static_cast<ssize_t>(buf.size()))
+          return;  // disk full/failed: keep the row resident
+        disk_index[victim] = DiskRec{disk_end, has};  // newest record wins
+        disk_end += buf.size();
+      }
+      s.lru.pop_back();
+      s.rows.erase(it);
+    }
+  }
+
+  // read a demoted row back; true on success. disk_mu held by caller.
+  bool read_disk(int64_t key, const DiskRec& rec, Row* out) {
+    std::vector<char> buf(rec_bytes(rec.has_state));
+    if (::pread(disk_fd, buf.data(), buf.size(),
+                static_cast<off_t>(rec.off)) !=
+        static_cast<ssize_t>(buf.size()))
+      return false;
+    const char* p = buf.data() + 9;  // skip key + has_state
+    out->w.assign(reinterpret_cast<const float*>(p),
+                  reinterpret_cast<const float*>(p) + dim);
+    p += size_t{4} * dim;
+    if (rec.has_state) {
+      out->m.assign(reinterpret_cast<const float*>(p),
+                    reinterpret_cast<const float*>(p) + dim);
+      p += size_t{4} * dim;
+      out->v.assign(reinterpret_cast<const float*>(p),
+                    reinterpret_cast<const float*>(p) + dim);
+      p += size_t{4} * dim;
+      std::memcpy(&out->step, p, 8);
+    }
+    return true;
+  }
+
+  Row& insert_row(Shard& s, int64_t key, Row&& r) {
+    auto& slot = s.rows.emplace(key, std::move(r)).first->second;
+    if (ssd()) {
+      s.lru.push_front(key);
+      slot.lru_it = s.lru.begin();
+      evict_over_cap(s);
+    }
+    return s.rows.find(key)->second;  // evict may rehash; re-find
+  }
+
   Row& row(Shard& s, int64_t key) {
     auto it = s.rows.find(key);
-    if (it != s.rows.end()) return it->second;
+    if (it != s.rows.end()) {
+      if (ssd()) {  // touch: move to LRU front
+        s.lru.splice(s.lru.begin(), s.lru, it->second.lru_it);
+        it->second.lru_it = s.lru.begin();
+      }
+      return it->second;
+    }
+    if (ssd()) {  // promote from the disk tier if demoted earlier
+      Row r;
+      bool found = false;
+      {
+        std::lock_guard<std::mutex> dk(disk_mu);
+        auto dit = disk_index.find(key);
+        if (dit != disk_index.end() && read_disk(key, dit->second, &r)) {
+          disk_index.erase(dit);  // pull promotes (ssd_sparse_table)
+          found = true;
+        }
+      }
+      if (found) return insert_row(s, key, std::move(r));
+    }
     Row r;
     r.w.resize(dim);
     for (uint32_t i = 0; i < dim; ++i) r.w[i] = init_val(key, i, init);
-    return s.rows.emplace(key, std::move(r)).first->second;
+    return insert_row(s, key, std::move(r));
   }
 
   void update(Row& r, const float* g) {
@@ -166,6 +291,10 @@ struct Table {
     for (auto& s : shards) {
       std::lock_guard<std::mutex> lk(s.mu);
       n += s.rows.size();
+    }
+    {
+      std::lock_guard<std::mutex> dk(disk_mu);
+      n += disk_index.size();
     }
     return n;
   }
@@ -327,6 +456,23 @@ struct PsServer {
                   ++n;
                 }
               }
+              // demoted rows ride along: a save/restore cycle must be
+              // independent of which tier a row happened to live in
+              std::lock_guard<std::mutex> dk(t->disk_mu);
+              for (auto& kv : t->disk_index) {
+                Row r;
+                if (!t->read_disk(kv.first, kv.second, &r)) continue;
+                std::fwrite(&kv.first, 8, 1, f);
+                std::fwrite(r.w.data(), 4, t->dim, f);
+                uint8_t has = r.m.empty() ? 0 : 1;
+                std::fwrite(&has, 1, 1, f);
+                if (has) {
+                  std::fwrite(r.m.data(), 4, t->dim, f);
+                  std::fwrite(r.v.data(), 4, t->dim, f);
+                  std::fwrite(&r.step, 8, 1, f);
+                }
+                ++n;
+              }
               std::fclose(f);
             }
           }
@@ -361,7 +507,12 @@ struct PsServer {
                   }
                   Shard& s = t->shard(key);
                   std::lock_guard<std::mutex> lk(s.mu);
-                  s.rows[key] = std::move(r);
+                  auto old = s.rows.find(key);
+                  if (old != s.rows.end()) {
+                    if (t->ssd()) s.lru.erase(old->second.lru_it);
+                    s.rows.erase(old);
+                  }
+                  t->insert_row(s, key, std::move(r));
                   ++n;
                 }
               } else {
@@ -378,11 +529,63 @@ struct PsServer {
         }
         case kClear: {
           Table* t = table(tid);
-          if (t)
+          if (t) {
             for (auto& s : t->shards) {
               std::lock_guard<std::mutex> lk(s.mu);
               s.rows.clear();
+              s.lru.clear();
             }
+            std::lock_guard<std::mutex> dk(t->disk_mu);
+            t->disk_index.clear();
+            if (t->disk_fd >= 0) {
+              ::ftruncate(t->disk_fd, 0);
+              t->disk_end = 0;
+            }
+          }
+          break;
+        }
+        case kSsdConfig: {
+          Table* t = table(tid);
+          if (!t || t->dim == 0) {
+            err = "SSD_CONFIG: no such table";
+            break;
+          }
+          if (plen < 9) {
+            err = "SSD_CONFIG: short payload";
+            break;
+          }
+          uint64_t cap;
+          std::memcpy(&cap, payload.data(), 8);
+          std::string path(payload.begin() + 8, payload.end());
+          int fd = ::open(path.c_str(), O_RDWR | O_CREAT | O_TRUNC, 0644);
+          if (fd < 0) {
+            err = "SSD_CONFIG: cannot open overflow file";
+            break;
+          }
+          {
+            std::lock_guard<std::mutex> dk(t->disk_mu);
+            if (t->disk_fd >= 0) ::close(t->disk_fd);
+            t->disk_fd = fd;
+            t->disk_end = 0;
+            t->disk_index.clear();
+            t->ram_cap_per_shard =
+                cap == 0 ? 0
+                         : std::max<size_t>(1, static_cast<size_t>(cap) /
+                                                   kNumShards);
+          }
+          // rows inserted BEFORE ssd was enabled carry singular lru_it
+          // iterators — backfill the per-shard LRU lists (and demote any
+          // overflow immediately) so the next touch can't splice an
+          // uninitialized iterator (UB)
+          for (auto& s : t->shards) {
+            std::lock_guard<std::mutex> lk(s.mu);
+            s.lru.clear();
+            for (auto& kv : s.rows) {
+              s.lru.push_front(kv.first);
+              kv.second.lru_it = s.lru.begin();
+            }
+            t->evict_over_cap(s);
+          }
           break;
         }
         default:

@@ -30,8 +30,8 @@ from typing import List, Optional
 
 import numpy as np
 
-_OP_CREATE, _OP_PULL, _OP_PUSH, _OP_STAT, _OP_SAVE, _OP_LOAD, _OP_CLEAR = (
-    1, 2, 3, 4, 5, 6, 7)
+(_OP_CREATE, _OP_PULL, _OP_PUSH, _OP_STAT, _OP_SAVE, _OP_LOAD, _OP_CLEAR,
+ _OP_SSD_CONFIG) = (1, 2, 3, 4, 5, 6, 7, 8)
 _OPTIM = {"sgd": 0, "adagrad": 1, "adam": 2}
 
 _LIB = None
@@ -170,6 +170,16 @@ class PsClient:
 
     def clear(self, table_id: int):
         self._request(_OP_CLEAR, table_id, np.empty(0, np.int64), b"")
+
+    def ssd_config(self, table_id: int, ram_cap_rows: int, path: str):
+        """Enable the disk overflow tier (reference
+        ps/table/ssd_sparse_table.h semantics): rows beyond ram_cap_rows
+        demote LRU-last to a log-structured file at `path`; pulls/pushes
+        of demoted keys promote them back with weights AND optimizer
+        state intact, so training is bit-identical to RAM-only."""
+        payload = struct.pack("<Q", ram_cap_rows) + path.encode()
+        self._request(_OP_SSD_CONFIG, table_id, np.empty(0, np.int64),
+                      payload)
 
     def close(self):
         try:
