@@ -144,3 +144,152 @@ class Watchdog:
         if "error" in result:
             raise result["error"]
         return result.get("value")
+
+
+class ElasticLauncher:
+    """Detection + RECOVERY: the reference ElasticManager kills and
+    re-launches local trainers on membership change
+    (fleet/elastic/manager.py:125, LauncherInterface:57). This controller
+    owns a long-lived TCPStore (the rendezvous point survives re-forms),
+    spawns `nproc` trainers with the PADDLE_TRAINER_* env contract, and on
+    a trainer death (process exit or heartbeat past ttl) it:
+
+      1. kills every remaining local trainer,
+      2. RE-KEYS the store world — elastic/world_size + elastic/generation
+         bumped, stale node/* heartbeat keys dropped,
+      3. relaunches the surviving count with fresh ranks 0..n-1 and
+         PADDLE_ELASTIC_GENERATION in the env,
+
+    until the world would shrink below `min_nproc` or `max_restarts` is
+    exhausted. Trainers read the generation from the env and resume from
+    their own checkpoints (checkpoint/resume is parallel/checkpoint.py's
+    job, orthogonal to re-forming the world)."""
+
+    def __init__(self, script: str, script_args=(), nproc: int = 2,
+                 min_nproc: int = 1, master_addr: str = "127.0.0.1",
+                 master_port: int = 6270, ttl: float = 3.0,
+                 grace: float = 10.0, max_restarts: int = 3,
+                 log_dir: Optional[str] = None, base_env=None):
+        self.base_env = base_env
+        self.script = script
+        self.script_args = list(script_args)
+        self.nproc = nproc
+        self.min_nproc = min_nproc
+        self.master_addr = master_addr
+        self.master_port = master_port
+        self.ttl = ttl
+        self.grace = grace
+        self.max_restarts = max_restarts
+        self.log_dir = log_dir
+        self.store = TCPStore(master_addr, 0, is_master=True)
+        self.generation = 0
+        self.history: List[dict] = []   # re-form audit trail for tests/logs
+
+    # ------------------------------------------------------------ internals
+
+    def _rekey(self, n: int):
+        """Re-key the store world for a new generation."""
+        self.store.set("elastic/world_size", str(n))
+        self.store.set("elastic/generation", str(self.generation))
+        for r in range(64):
+            try:
+                self.store.delete_key(f"node/{r}")
+            except Exception:
+                pass
+        self.store.add("membership_version", 1)
+
+    def _spawn(self, n: int):
+        import subprocess
+        import sys as _sys
+
+        from paddle_tpu.parallel.launch import build_env
+
+        procs = []
+        for rank in range(n):
+            env = build_env(rank, n, self.master_addr, self.master_port,
+                            base_env=self.base_env,
+                            store_port=self.store.port,
+                            generation=self.generation)
+            stdout = None
+            if self.log_dir:
+                import os as _os
+
+                _os.makedirs(self.log_dir, exist_ok=True)
+                stdout = open(
+                    f"{self.log_dir}/worker.g{self.generation}.{rank}.log",
+                    "w")
+            procs.append(subprocess.Popen(
+                [_sys.executable, self.script] + self.script_args, env=env,
+                stdout=stdout,
+                stderr=subprocess.STDOUT if stdout else None))
+        return procs
+
+    def _stop_all(self, procs):
+        import subprocess
+
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 10
+        for p in procs:
+            try:
+                p.wait(timeout=max(deadline - time.time(), 0.1))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    def _stale_ranks(self, n: int, started: float) -> List[int]:
+        """Ranks whose heartbeat key is missing/expired (after the startup
+        grace window) — catches hung-but-alive trainers."""
+        if time.time() - started < self.grace:
+            return []
+        now = time.time()
+        stale = []
+        for r in range(n):
+            raw = self.store.try_get(f"node/{r}")
+            if raw is None:
+                stale.append(r)
+                continue
+            try:
+                if now - float(raw.decode()) > self.ttl:
+                    stale.append(r)
+            except Exception:
+                stale.append(r)
+        return stale
+
+    # ------------------------------------------------------------ main loop
+
+    def _procs_snapshot(self):
+        return list(self._procs)
+
+    def run(self, poll_interval: float = 0.2) -> int:
+        n = self.nproc
+        self._rekey(n)
+        procs = self._procs = self._spawn(n)
+        started = time.time()
+        while True:
+            codes = [p.poll() for p in procs]
+            if all(c == 0 for c in codes):
+                return 0                      # clean finish
+            dead = [i for i, c in enumerate(codes)
+                    if c is not None and c != 0]
+            stale = [r for r in self._stale_ranks(n, started)
+                     if codes[r] is None]     # hung but process alive
+            if dead or stale:
+                survivors = n - len(set(dead) | set(stale))
+                self.history.append({
+                    "generation": self.generation, "dead": dead,
+                    "stale": stale, "next_world": survivors})
+                self._stop_all(procs)
+                if survivors < self.min_nproc:
+                    return 1
+                if self.generation + 1 > self.max_restarts:
+                    return 1
+                self.generation += 1
+                n = survivors
+                self._rekey(n)
+                procs = self._procs = self._spawn(n)
+                started = time.time()
+            time.sleep(poll_interval)
+
+    def stop(self):
+        self.store.close()

@@ -28,7 +28,8 @@ from typing import List
 
 
 def build_env(rank: int, world: int, master_addr: str, master_port: int,
-              base_env=None) -> dict:
+              base_env=None, store_port: int = None,
+              generation: int = None) -> dict:
     env = dict(base_env if base_env is not None else os.environ)
     env.update({
         "PADDLE_TRAINER_ID": str(rank),
@@ -39,9 +40,19 @@ def build_env(rank: int, world: int, master_addr: str, master_port: int,
         "MASTER_ADDR": master_addr,
         "MASTER_PORT": str(master_port),
         # TCPStore port, disjoint from the coordinator (MASTER_PORT) and
-        # the per-rank endpoints (master_port + rank)
-        "PADDLE_STORE_PORT": str(master_port + world),
+        # the per-rank endpoints (master_port + rank). An elastic launcher
+        # passes its own long-lived store so the world can re-form without
+        # moving the rendezvous point.
+        "PADDLE_STORE_PORT": str(store_port if store_port is not None
+                                 else master_port + world),
     })
+    if store_port is not None:
+        # explicit port = a store hosted by the caller (elastic launcher):
+        # trainers must all connect as clients (see
+        # create_or_get_global_tcp_store)
+        env["PADDLE_STORE_EXTERNAL"] = "1"
+    if generation is not None:
+        env["PADDLE_ELASTIC_GENERATION"] = str(generation)
     return env
 
 

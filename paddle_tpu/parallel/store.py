@@ -136,6 +136,9 @@ class TCPStore:
     def delete_key(self, key: str) -> None:
         self._req(_OP_DELETE, key)
 
+    def close(self) -> None:
+        self.__del__()
+
     def __del__(self):
         try:
             if getattr(self, "_py_impl", None) is not None:
@@ -221,6 +224,12 @@ def create_or_get_global_tcp_store() -> TCPStore:
         port = int(os.environ.get(
             "PADDLE_STORE_PORT",
             int(os.environ.get("MASTER_PORT", "6170")) + world))
-        _global_store = TCPStore(host, port, is_master=(rank == 0),
+        # PADDLE_STORE_EXTERNAL=1: the store server is hosted OUTSIDE the
+        # trainer world (the ElasticLauncher keeps a long-lived store so
+        # the rendezvous survives re-forms) — every rank, including 0,
+        # connects as a client instead of trying to bind the port
+        external = os.environ.get("PADDLE_STORE_EXTERNAL") == "1"
+        _global_store = TCPStore(host, port,
+                                 is_master=(rank == 0 and not external),
                                  world_size=world)
     return _global_store

@@ -1,0 +1,95 @@
+"""Elastic recovery: kill one of 3 workers, watch the launcher re-key the
+store world and relaunch the survivors at n=2, training resuming.
+
+Reference: fleet/elastic/manager.py:125 (membership watch ->
+LauncherInterface:57 kill/rerun local trainers)."""
+
+import os
+import signal
+import textwrap
+import threading
+import time
+
+import numpy as np
+
+from _helpers import child_env
+
+from paddle_tpu.parallel.elastic import ElasticLauncher
+from paddle_tpu.parallel.store import TCPStore
+
+WORKER = textwrap.dedent("""
+    import os, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.parallel.store import TCPStore
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    gen = int(os.environ["PADDLE_ELASTIC_GENERATION"])
+    store = TCPStore("127.0.0.1", int(os.environ["PADDLE_STORE_PORT"]),
+                     is_master=False)
+    # announce world view for the test's assertions
+    store.set(f"view/g{gen}/r{rank}", f"{world}")
+    # 'training': bump a progress counter while heartbeating
+    for step in range(2000):
+        store.set(f"node/{rank}", str(time.time()))
+        store.add(f"progress/g{gen}", 1)
+        if gen > 0 and step > 30:
+            break                  # resumed generation finishes cleanly
+        time.sleep(0.02)
+    store.close()
+""")
+
+
+def test_kill_one_of_three_reforms_at_two(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    launcher = ElasticLauncher(str(script), nproc=3, min_nproc=2,
+                               master_port=6370, ttl=4.0, grace=30.0,
+                               max_restarts=2, log_dir=str(tmp_path),
+                               base_env=child_env())
+    client = TCPStore("127.0.0.1", launcher.store.port, is_master=False)
+    rc = {}
+
+    def run():
+        rc["code"] = launcher.run(poll_interval=0.1)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    try:
+        # wait for generation-0 training to make progress
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if client.add("progress/g0", 0) > 10:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("generation 0 never made progress")
+        assert client.get("view/g0/r0").decode() == "3"
+
+        # kill worker rank 1's process (simulated node death)
+        victims = [p for p in launcher._procs_snapshot()
+                   if p.poll() is None]
+        assert len(victims) == 3
+        os.kill(victims[1].pid, signal.SIGKILL)
+
+        # the launcher must re-form the world at n=2 and training resume
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if client.add("progress/g1", 0) > 10:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("world never re-formed / resumed")
+        assert client.get("elastic/world_size").decode() == "2"
+        assert client.get("elastic/generation").decode() == "1"
+        assert client.get("view/g1/r0").decode() == "2"
+        assert client.get("view/g1/r1").decode() == "2"
+        assert launcher.history and launcher.history[0]["next_world"] == 2
+
+        t.join(timeout=120)
+        assert not t.is_alive(), "launcher did not finish"
+        assert rc["code"] == 0     # resumed generation ran to completion
+    finally:
+        client.close()
+        launcher.stop()
