@@ -133,12 +133,16 @@ class StaticFunction:
     flow re-evaluates each call, so branch flips stay correct — the
     subgraph-stitching analogue of the reference SOT interpreter
     (python/paddle/jit/sot/translate.py:37, opcode_executor.py:1880),
-    stitched at module rather than bytecode granularity. Stitching is a
+    stitched at module AND, via jit/segments.py, at sub-function
+    granularity: the stitched glue runs under segment_mode, so the ops
+    between child calls compile as cached tape segments; a mounted child
+    runs eagerly (recording into the same open segment) whenever
+    gradients are being recorded, so training-mode backward through a
+    stitched static(x) call keeps parameter grads, while inference keeps
+    the child's whole-graph compiled cache. Stitching is a
     whole-StaticFunction switch (one break converts every signature — the
-    glue that broke once is assumed input-independent), and a mounted
-    child defers to the eager tape whenever gradients are being recorded,
-    so training-mode backward through a stitched model keeps working.
-    Plain functions (no children to stitch) pin to eager per signature.
+    glue that broke once is assumed input-independent). Plain functions
+    and childless layers re-run under segment mode per signature.
     full_graph=True raises instead (the reference AST mode contract).
     """
 
@@ -238,14 +242,18 @@ class StaticFunction:
         Layer.__call__ (hooks) already ran — invoke the original forward
         body directly; standalone, run the full layer. A stitched parent's
         glue marks the run so mounted children know the user opted into
-        compiled (to_static) semantics."""
+        compiled (to_static) semantics — and runs under segment_mode, so
+        the glue ops between child calls compile as tape segments too."""
         if self._stitched:
+            from paddle_tpu.jit.segments import segment_mode
+
             _STITCHED_RUN[0] += 1
             try:
-                if self._installed():
-                    return type(self._layer).forward(self._layer, *args,
-                                                     **kwargs)
-                return self._layer(*args, **kwargs)
+                with segment_mode():
+                    if self._installed():
+                        return type(self._layer).forward(self._layer,
+                                                         *args, **kwargs)
+                    return self._layer(*args, **kwargs)
             finally:
                 _STITCHED_RUN[0] -= 1
         if self._installed():
@@ -261,6 +269,22 @@ class StaticFunction:
             # eager tape (compiling would execute under no_grad and
             # silently drop parameter grads in training)
             return self._eager_layer(*args, **kwargs)
+        if self._installed() and _STITCHED_RUN[0]:
+            from paddle_tpu.autograd import engine as _engine
+
+            leaves = jax.tree_util.tree_leaves(
+                (args, kwargs), is_leaf=lambda v: isinstance(v, Tensor))
+            if _engine.is_grad_enabled() and (
+                    self._layer.training
+                    or any(isinstance(a, Tensor) and not a.stop_gradient
+                           for a in leaves)):
+                # gradients are being recorded: the compiled child path
+                # executes outside the tape and would silently drop
+                # parameter grads. Run the body eagerly — inside the
+                # stitched glue's segment_mode its ops still record into
+                # the open compiled segment, so training keeps both the
+                # tape AND region compilation.
+                return self._eager_layer(*args, **kwargs)
         training = self._layer.training
         kw_items = tuple(sorted(kwargs.items()))
         sig = (_sig_of(args), training, _sig_of([v for _, v in kw_items]),

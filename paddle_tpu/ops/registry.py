@@ -173,6 +173,23 @@ SEGMENT_MODE = [0]
 SEGMENT_OPEN: List[Any] = [None]
 SEGMENT_RECORDER_CLS: List[Any] = [None]
 
+# in-jit-trace detection for the segment gate: ops dispatched while a jit
+# trace is active (compiled children, functionalize.apply) must stage into
+# THAT trace, never into the eager segment recorder. The trace context
+# catches zero-tensor-input creation ops (ones/full/eye) that the
+# tracer-valued-inputs sniff cannot see.
+try:
+    from jax._src.core import EvalTrace as _EvalTrace
+    from jax._src.core import trace_ctx as _trace_ctx
+except Exception:  # pragma: no cover - jax internals moved
+    _EvalTrace = _trace_ctx = None
+
+
+def _in_jit_trace(vals) -> bool:
+    if _trace_ctx is not None:
+        return not isinstance(_trace_ctx.trace, _EvalTrace)
+    return any(isinstance(v, jax.core.Tracer) for v in vals)
+
 
 def dispatch(name: str, args, kwargs, _op=None):
     """The generic ad_func (reference eager_gen.py:372 template).
@@ -221,7 +238,7 @@ def dispatch(name: str, args, kwargs, _op=None):
     # outputs; anything that can't stage (dynamic shapes, rng keys that
     # would bake into the cached executable, direct ops, unhashable attrs,
     # nan-checking) flushes the segment first so program order holds.
-    if SEGMENT_MODE[0]:
+    if SEGMENT_MODE[0] and not _in_jit_trace(vals):
         recordable = (
             _op is None
             and not op.dynamic

@@ -29,7 +29,7 @@ def test_tune_gpt_parallel_virtual_mesh(tmp_path):
     hist = tmp_path / "hist.jsonl"
     best, tuner = tune_gpt_parallel(
         cfg, n_devices=8, batch=4, num_micros=(2,),
-        schedules=("gpipe",), iters=2, warmup=1,
+        schedules=("gpipe", "zbvpp"), iters=2, warmup=1,
         history_path=str(hist))
     assert best.ok and best.ips > 0
     ok = [r for r in tuner.results if r.ok]

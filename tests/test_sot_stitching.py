@@ -258,3 +258,31 @@ def test_tensor_kwargs_not_constant_folded():
     o2 = static(x, scale=s2)
     np.testing.assert_allclose(np.asarray(o2._value),
                                5.0 * np.asarray(o1._value), rtol=1e-5)
+
+
+def test_training_backward_through_stitched_static_call():
+    """Grads must flow through static(x) itself in training (r5 fix: the
+    compiled-child path bypassed the tape; grad-recording children now run
+    eagerly inside the glue's compiled segments)."""
+    paddle.seed(7)
+    net = LoggingNet()
+    net.train()
+    static = paddle.jit.to_static(net)
+    x = paddle.to_tensor(rng.standard_normal((2, 8)).astype(np.float32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        static(x)                  # break -> stitched
+    out = static(x)                # stitched call, training
+    out.sum().backward()
+    g = net.fc1.weight.grad
+    assert g is not None and float(np.abs(np.asarray(g._value)).max()) > 0
+    # parity vs a pure-eager twin
+    paddle.seed(7)
+    twin = LoggingNet()
+    twin.train()
+    twin.set_state_dict(net.state_dict())
+    out_t = twin(x)
+    out_t.sum().backward()
+    np.testing.assert_allclose(np.asarray(g._value),
+                               np.asarray(twin.fc1.weight.grad._value),
+                               atol=1e-5)
