@@ -272,18 +272,15 @@ class StaticFunction:
         if self._installed() and _STITCHED_RUN[0]:
             from paddle_tpu.autograd import engine as _engine
 
-            leaves = jax.tree_util.tree_leaves(
-                (args, kwargs), is_leaf=lambda v: isinstance(v, Tensor))
-            if _engine.is_grad_enabled() and (
-                    self._layer.training
-                    or any(isinstance(a, Tensor) and not a.stop_gradient
-                           for a in leaves)):
-                # gradients are being recorded: the compiled child path
-                # executes outside the tape and would silently drop
-                # parameter grads. Run the body eagerly — inside the
-                # stitched glue's segment_mode its ops still record into
-                # the open compiled segment, so training keeps both the
-                # tape AND region compilation.
+            if _engine.is_grad_enabled():
+                # gradients could be recorded (eval-mode fine-tuning with
+                # frozen BN included): the compiled child path executes
+                # outside the tape and would silently drop parameter
+                # grads. Run the body eagerly — inside the stitched
+                # glue's segment_mode its ops still record into the open
+                # compiled segment, so grads keep working AND regions
+                # compile. Inference wanting the child's whole-graph
+                # cache should run under paddle.no_grad() (or eval_step).
                 return self._eager_layer(*args, **kwargs)
         training = self._layer.training
         kw_items = tuple(sorted(kwargs.items()))

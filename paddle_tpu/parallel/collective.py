@@ -255,12 +255,49 @@ def send_in(x, axis: str, dst_offset: int = 1):
     return lax.ppermute(x, axis, perm)
 
 
-# Eager multi-process p2p rides the bootstrap TCPStore (the Gloo-class
-# fallback channel: correct, host-side, not ICI-fast). Inside compiled
-# programs p2p is lax.ppermute on a mesh axis (`send_in`; the pipeline
-# module shows the pattern) — that is the TPU-native fast path.
+# Eager multi-process p2p: the DATA rides the PjRt cross-host transfer
+# fabric (jax.experimental.transfer — DCN/ICI device-buffer pulls, the
+# NCCL-p2p analogue; reference process_group_nccl.h:37), with the
+# TCPStore carrying only the rendezvous metadata (address + uuid). When
+# the transfer API is unavailable (or PADDLE_P2P_TRANSPORT=store), the
+# payload falls back to pickle-over-TCPStore — the Gloo-class host
+# channel. Inside compiled programs p2p is lax.ppermute on a mesh axis
+# (`send_in`; the pipeline module shows the pattern).
 
 _P2P_SEQ: dict = {}
+_XFER = {"server": None, "conns": {}, "tried": False}
+
+
+def _transfer_server():
+    """Lazy per-process PjRt TransferServer (None = unavailable). The bind
+    address comes from PADDLE_P2P_BIND (set a routable IP for multi-host;
+    default loopback covers single-host worlds and tests)."""
+    import os
+
+    if os.environ.get("PADDLE_P2P_TRANSPORT") == "store":
+        return None
+    if _XFER["server"] is None and not _XFER["tried"]:
+        _XFER["tried"] = True
+        try:
+            from jax.experimental import transfer as jt
+
+            bind = os.environ.get("PADDLE_P2P_BIND", "127.0.0.1:0")
+            host = bind.rsplit(":", 1)[0]
+            # explicit socket transport addresses: the default local
+            # (same-host shm) bulk transport assumes one process and
+            # aborts on a cross-process pull
+            _XFER["server"] = jt.start_transfer_server(
+                jax.local_devices()[0].client, bind, [f"{host}:0"])
+        except Exception:
+            _XFER["server"] = None
+    return _XFER["server"]
+
+
+def _transfer_conn(addr):
+    conn = _XFER["conns"].get(addr)
+    if conn is None:
+        conn = _XFER["conns"][addr] = _XFER["server"].connect(addr)
+    return conn
 
 
 def _p2p_store():
@@ -277,30 +314,41 @@ def _p2p_store():
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    """Eager p2p over the store (reference distributed.send; the
-    reference's Gloo CPU path plays the same role off-NCCL).
+    """Eager p2p (reference distributed.send / isend).
 
-    PERFORMANCE BOUNDARY: this channel is pickle-over-TCPStore — host
-    sockets at rendezvous speed. It exists for control-plane messages
-    (handshakes, small metadata, tests), matching the role of the
-    reference's Gloo fallback. It is NOT the activation-transfer path:
-    pipeline/tensor-parallel data movement rides in-jit XLA collectives
-    over ICI (`lax.ppermute` in parallel/pipeline*.py — the compiled
-    program never touches this store). Sending multi-MB activations here
-    would serialize through the host NIC; use the compiled path."""
+    Data path: the device buffer is scheduled for a PULL over the PjRt
+    transfer fabric (device-bandwidth DCN/ICI — the NCCL-p2p analogue);
+    only {address, uuid, shape, dtype} metadata crosses the TCPStore.
+    Falls back to pickle-over-store (host sockets) when the transfer API
+    is unavailable or PADDLE_P2P_TRANSPORT=store. For data movement
+    INSIDE a compiled step, use the mesh collectives (`send_in` /
+    lax.ppermute) — the compiled program never touches this channel."""
     import pickle
 
     store, rank = _p2p_store()
     seq = _P2P_SEQ.setdefault(("s", rank, dst), 0)
     _P2P_SEQ[("s", rank, dst)] = seq + 1
+    key = f"p2p/{rank}->{dst}/{seq}"
+    srv = _transfer_server()
+    if srv is not None:
+        val = tensor._value if isinstance(tensor, Tensor) else             jnp.asarray(tensor)
+        uid = ((rank & 0xFFFFF) << 40) | ((dst & 0xFFFFF) << 20) |             (seq & 0xFFFFF)
+        srv.await_pull(uid, [val])
+        store.set(key, pickle.dumps(
+            ("xfer", srv.address(), uid, str(val.dtype),
+             tuple(val.shape))))
+        return
     arr = np.asarray(tensor._value if isinstance(tensor, Tensor)
                      else tensor)
-    store.set(f"p2p/{rank}->{dst}/{seq}",
-              pickle.dumps((arr.dtype.str, arr.shape, arr.tobytes())))
+    store.set(key,
+              pickle.dumps(("host", arr.dtype.str, arr.shape,
+                            arr.tobytes())))
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    """Blocking receive; writes into `tensor` and returns it."""
+    """Blocking receive; writes into `tensor` and returns it. Pulls the
+    device buffer over the transfer fabric when the sender offered one
+    (see send)."""
     import pickle
 
     store, rank = _p2p_store()
@@ -308,11 +356,34 @@ def recv(tensor, src=0, group=None, sync_op=True):
     _P2P_SEQ[("r", src, rank)] = seq + 1
     key = f"p2p/{src}->{rank}/{seq}"
     store.wait([key])
-    dtype, shape, raw = pickle.loads(store.get(key))
+    msg = pickle.loads(store.get(key))
     try:
         store.delete_key(key)  # bounded store; stale keys can't resurrect
     except Exception:
         pass
+    if msg[0] == "xfer":
+        from jax.sharding import SingleDeviceSharding
+
+        _, addr, uid, dtype, shape = msg
+        # an in-flight xfer message must complete with any LIVE server even
+        # if the env flag has since flipped to 'store' (the message is
+        # already popped — failing here would lose it)
+        if _XFER["server"] is None and _transfer_server() is None:
+            raise RuntimeError(
+                "peer sent a device-buffer transfer but the local PjRt "
+                "transfer server is unavailable; set "
+                "PADDLE_P2P_TRANSPORT=store on ALL ranks to force the "
+                "host channel")
+        sds = jax.ShapeDtypeStruct(
+            shape, jnp.dtype(dtype),
+            sharding=SingleDeviceSharding(jax.local_devices()[0]))
+        (val,) = _transfer_conn(addr).pull(uid, [sds])
+        store.set(key + "/ack", b"1")
+        if isinstance(tensor, Tensor):
+            tensor._value = val
+            return tensor
+        return val
+    _, dtype, shape, raw = msg
     arr = np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(shape)
     if isinstance(tensor, Tensor):
         tensor._value = jnp.asarray(arr)
@@ -326,7 +397,7 @@ def batch_isend_irecv(p2p_op_list):
     within one rank's batch."""
     for op in p2p_op_list:
         if op.op in ("isend", "send"):
-            send(op.tensor, op.peer)
+            send(op.tensor, op.peer, sync_op=False)
     for op in p2p_op_list:
         if op.op in ("irecv", "recv"):
             recv(op.tensor, op.peer)

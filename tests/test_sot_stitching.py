@@ -62,7 +62,16 @@ def test_stitched_children_stay_compiled():
 
     assert isinstance(net.fc1.__dict__.get("forward"), StaticFunction)
     assert isinstance(net.fc2.__dict__.get("forward"), StaticFunction)
+    # with grads recordable, child ops compile via the glue's tape
+    # segments; under no_grad the children's whole-graph cache engages
+    from paddle_tpu.jit import segments
+
+    segments.reset_stats()
     out2 = static(x)
+    assert segments.STATS["flushes"] >= 1, "glue segments never compiled"
+    with paddle.no_grad():
+        static(x)
+        static(x)
     assert net.fc1.__dict__["forward"]._cache, "child fc1 never compiled"
     assert net.fc2.__dict__["forward"]._cache, "child fc2 never compiled"
     # eager-reference parity
@@ -145,7 +154,9 @@ def test_nested_break_stitches_recursively():
     net.eval()
     static = paddle.jit.to_static(net)
     x = paddle.to_tensor(rng.standard_normal((2, 4)).astype(np.float32))
-    with warnings.catch_warnings():
+    # no_grad: compiled-child paths engage (grad-recording calls run
+    # eagerly inside the glue's segments and never need to re-break)
+    with warnings.catch_warnings(), paddle.no_grad():
         warnings.simplefilter("ignore")
         static(x)
         out = static(x)
