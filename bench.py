@@ -187,7 +187,7 @@ def child_flash_check():
 
 
 def child_rung(layers: int, hidden: int, batch: int, seq: int,
-               vocab: int, iters: int):
+               vocab: int, iters: int, amp: str = "O1"):
     import jax
     import numpy as np
 
@@ -204,13 +204,14 @@ def child_rung(layers: int, hidden: int, batch: int, seq: int,
     n_params = sum(p.size for p in model.parameters())
     opt = paddle.optimizer.AdamW(parameters=model.parameters(),
                                  learning_rate=3e-4, weight_decay=0.1)
-    step = paddle.jit.TrainStep(model, gpt_loss_fn, opt, amp_level="O1",
+    step = paddle.jit.TrainStep(model, gpt_loss_fn, opt, amp_level=amp,
                                 amp_dtype="bfloat16")
     rng = np.random.default_rng(0)
     toks = paddle.to_tensor(rng.integers(0, vocab, (batch, seq)))
 
     _time_and_write(step, (toks, toks), n_params, batch * seq, iters, backend,
-                    layers=layers, hidden=hidden, batch=batch, seq=seq)
+                    layers=layers, hidden=hidden, batch=batch, seq=seq,
+                    amp=amp)
 
 
 def _time_and_write(step, args, n_params, tokens_per_step, iters, backend,
@@ -359,6 +360,10 @@ RUNGS = [
     # MFU rung: 2x batch amortizes per-step overhead and fills the MXU
     # better at 124M scale (activation memory fits v5e with bf16 AMP)
     ("gpt124m_b16", 12, 768, 16, 1024, 32768, 30, 900),
+    # O2 variant: bf16 weights (fp32 master copies in the optimizer) cut
+    # the per-step weight HBM traffic ~2x vs O1's cast-per-op — the A/B
+    # that decides the flagship AMP recipe on hardware day
+    ("gpt124m_b16_o2", 12, 768, 16, 1024, 32768, 30, 900, "O2"),
 ]
 
 
@@ -405,7 +410,9 @@ def main():
         log(f"flash check: {flash}")
 
     best = None
-    for name, layers, hidden, batch, seq, vocab, iters, deadline in RUNGS:
+    for name, layers, hidden, batch, seq, vocab, iters, deadline, *extra \
+            in RUNGS:
+        amp = extra[0] if extra else "O1"
         if not on_tpu and hidden > 256:
             log(f"skip {name} on {probe.get('backend')} backend")
             continue
@@ -414,8 +421,9 @@ def main():
             break
         deadline = min(deadline, remaining())
         log(f"rung {name}: deadline {deadline:.0f}s")
-        r = run_child(f"rung:{layers}:{hidden}:{batch}:{seq}:{vocab}:{iters}",
-                      deadline)
+        r = run_child(
+            f"rung:{layers}:{hidden}:{batch}:{seq}:{vocab}:{iters}:{amp}",
+            deadline)
         if r is None:
             log(f"rung {name} did not finish — stopping ladder")
             break
@@ -499,7 +507,9 @@ if __name__ == "__main__":
         elif mode == "flash":
             child_flash_check()
         elif mode.startswith("rung:"):
-            child_rung(*[int(x) for x in mode.split(":")[1:]])
+            parts = mode.split(":")[1:]
+            amp = parts.pop() if parts and not parts[-1].isdigit() else "O1"
+            child_rung(*[int(x) for x in parts], amp=amp)
         elif mode.startswith("ernie:"):
             child_ernie(*[int(x) for x in mode.split(":")[1:]])
         elif mode.startswith("decode:"):

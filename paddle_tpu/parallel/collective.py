@@ -331,12 +331,27 @@ def send(tensor, dst=0, group=None, sync_op=True):
     key = f"p2p/{rank}->{dst}/{seq}"
     srv = _transfer_server()
     if srv is not None:
-        val = tensor._value if isinstance(tensor, Tensor) else             jnp.asarray(tensor)
-        uid = ((rank & 0xFFFFF) << 40) | ((dst & 0xFFFFF) << 20) |             (seq & 0xFFFFF)
+        val = (tensor._value if isinstance(tensor, Tensor)
+               else jnp.asarray(tensor))
+        # 10/10/44-bit uid: seq wraps after ~17T messages per channel,
+        # beyond any run; rank/dst disambiguate channels on one server
+        uid = (((rank & 0x3FF) << 54) | ((dst & 0x3FF) << 44)
+               | (seq & 0xFFFFFFFFFFF))
         srv.await_pull(uid, [val])
         store.set(key, pickle.dumps(
             ("xfer", srv.address(), uid, str(val.dtype),
-             tuple(val.shape))))
+             tuple(val.shape), bool(sync_op))))
+        if sync_op:
+            # block until the receiver pulled: the offered buffer lives
+            # in THIS process's transfer server, so a fire-and-forget
+            # sender exiting early would strand the receiver's pull (the
+            # store path had no such lifetime coupling). isend
+            # (sync_op=False) keeps fire-and-forget for batch exchanges.
+            store.wait([key + "/ack"])
+            try:
+                store.delete_key(key + "/ack")
+            except Exception:
+                pass
         return
     arr = np.asarray(tensor._value if isinstance(tensor, Tensor)
                      else tensor)
@@ -356,34 +371,41 @@ def recv(tensor, src=0, group=None, sync_op=True):
     _P2P_SEQ[("r", src, rank)] = seq + 1
     key = f"p2p/{src}->{rank}/{seq}"
     store.wait([key])
-    msg = pickle.loads(store.get(key))
-    try:
-        store.delete_key(key)  # bounded store; stale keys can't resurrect
-    except Exception:
-        pass
+    msg = pickle.loads(store.get(key))   # peek — delete only on success
     if msg[0] == "xfer":
         from jax.sharding import SingleDeviceSharding
 
-        _, addr, uid, dtype, shape = msg
-        # an in-flight xfer message must complete with any LIVE server even
-        # if the env flag has since flipped to 'store' (the message is
-        # already popped — failing here would lose it)
+        # an in-flight xfer message must complete with any LIVE server
+        # even if the env flag has since flipped to 'store'; check BEFORE
+        # popping the key so a mixed-config error leaves the message
+        # retrievable (and the seq re-tryable)
         if _XFER["server"] is None and _transfer_server() is None:
+            _P2P_SEQ[("r", src, rank)] = seq    # un-consume the seq
             raise RuntimeError(
                 "peer sent a device-buffer transfer but the local PjRt "
                 "transfer server is unavailable; set "
                 "PADDLE_P2P_TRANSPORT=store on ALL ranks to force the "
                 "host channel")
+        _, addr, uid, dtype, shape, want_ack = msg
         sds = jax.ShapeDtypeStruct(
             shape, jnp.dtype(dtype),
             sharding=SingleDeviceSharding(jax.local_devices()[0]))
         (val,) = _transfer_conn(addr).pull(uid, [sds])
-        store.set(key + "/ack", b"1")
+        try:
+            store.delete_key(key)  # bounded store: pop after success
+        except Exception:
+            pass
+        if want_ack:
+            store.set(key + "/ack", b"1")   # sender awaits + deletes
         if isinstance(tensor, Tensor):
             tensor._value = val
             return tensor
         return val
     _, dtype, shape, raw = msg
+    try:
+        store.delete_key(key)  # bounded store; stale keys can't resurrect
+    except Exception:
+        pass
     arr = np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(shape)
     if isinstance(tensor, Tensor):
         tensor._value = jnp.asarray(arr)
