@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from paddle_tpu.amp import debugging  # noqa: F401
 from paddle_tpu.amp import state as _state_mod
 from paddle_tpu.amp.state import BLACK_LIST, WHITE_LIST, amp_state
 from paddle_tpu.core import dtype as dtype_mod

@@ -1,0 +1,365 @@
+"""paddle.amp.debugging — tensor checker, operator stats, accuracy compare.
+
+Reference: python/paddle/amp/debugging.py (DebugMode,
+TensorCheckerConfig:173, check_numerics:361, operator stats
+collection:480-592, enable/disable_tensor_checker:653, compare_accuracy:594,
+check_layer_numerics:78) over the check_nan_inf kernel hooks.
+
+TPU-native: the eager dispatcher has ONE choke point (ops/registry.py
+dispatch) — the tensor checker rides its post-execution CHECK_HOOK and the
+operator-stats collector its TRACE_HOOK, so every dispatched op is seen
+without per-kernel instrumentation. Checks force a host readback per op
+(debug modes are not perf modes). Inside jit-compiled programs use
+FLAGS_check_nan_inf (trace-compatible) instead.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import warnings
+from contextlib import contextmanager
+from enum import Enum
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "DebugMode",
+    "TensorCheckerConfig",
+    "check_numerics",
+    "enable_operator_stats_collection",
+    "disable_operator_stats_collection",
+    "collect_operator_stats",
+    "enable_tensor_checker",
+    "disable_tensor_checker",
+    "compare_accuracy",
+    "check_layer_numerics",
+    "set_checked_op_list",
+    "set_skipped_op_list",
+]
+
+
+class DebugMode(Enum):
+    """Reference debugging.py DebugMode — same four modes."""
+
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+
+
+def _tensor_stats(val) -> dict:
+    import jax.numpy as jnp
+
+    v = jnp.asarray(val)
+    if not (jnp.issubdtype(v.dtype, jnp.floating)
+            or jnp.issubdtype(v.dtype, jnp.complexfloating)):
+        return {"dtype": str(v.dtype), "numel": int(v.size), "num_nan": 0,
+                "num_inf": 0, "num_zero": int((v == 0).sum())}
+    vf = v.astype(jnp.float32)
+    absv = jnp.abs(vf)
+    nonzero = jnp.where(absv > 0, absv, jnp.inf)
+    min_abs = float(jnp.min(nonzero)) if v.size else 0.0
+    return {
+        "dtype": str(v.dtype), "numel": int(v.size),
+        "num_nan": int(jnp.isnan(vf).sum()),
+        "num_inf": int(jnp.isinf(vf).sum()),
+        "num_zero": int((vf == 0).sum()),
+        "max": float(jnp.nanmax(vf)) if v.size else 0.0,
+        "min": float(jnp.nanmin(vf)) if v.size else 0.0,
+        "min_abs_nonzero": 0.0 if min_abs == float("inf") else min_abs,
+        "mean": float(jnp.nanmean(vf)) if v.size else 0.0,
+    }
+
+
+_FP16_MAX = 65504.0
+
+
+class TensorCheckerConfig:
+    """Reference TensorCheckerConfig:173 — which ops to check and what to
+    do on a hit. output_dir: when set, every checked op's stats append to
+    `<output_dir>/tensor_check_<pid>.log` (one JSON line per output), the
+    dump format compare_accuracy consumes."""
+
+    def __init__(self, enable: bool = True,
+                 debug_mode: DebugMode = DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir: Optional[str] = None,
+                 checked_op_list: Optional[Sequence[str]] = None,
+                 skipped_op_list: Optional[Sequence[str]] = None):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = set(checked_op_list or [])
+        self.skipped_op_list = set(skipped_op_list or [])
+
+    def _wants(self, name: str) -> bool:
+        if name in self.skipped_op_list:
+            return False
+        if self.checked_op_list:
+            return name in self.checked_op_list
+        return True
+
+
+_CHECKER: list = [None]   # active TensorCheckerConfig
+_DUMP_FH: dict = {}       # output_dir -> open file handle
+
+
+def set_checked_op_list(checked_op_list) -> None:
+    if _CHECKER[0] is not None:
+        _CHECKER[0].checked_op_list = set(checked_op_list or [])
+
+
+def set_skipped_op_list(skipped_op_list) -> None:
+    if _CHECKER[0] is not None:
+        _CHECKER[0].skipped_op_list = set(skipped_op_list or [])
+
+
+def _dump(cfg: TensorCheckerConfig, record: dict) -> None:
+    if cfg.output_dir is None:
+        return
+    import json
+
+    fh = _DUMP_FH.get(cfg.output_dir)
+    if fh is None:
+        os.makedirs(cfg.output_dir, exist_ok=True)
+        path = os.path.join(cfg.output_dir,
+                            f"tensor_check_{os.getpid()}.log")
+        fh = _DUMP_FH[cfg.output_dir] = open(path, "a")
+    fh.write(json.dumps(record) + "\n")
+    fh.flush()
+
+
+def _close_dumps() -> None:
+    for fh in _DUMP_FH.values():
+        try:
+            fh.close()
+        except Exception:
+            pass
+    _DUMP_FH.clear()
+
+
+def _check_one(cfg: TensorCheckerConfig, op_name: str, idx: int,
+               val) -> None:
+    stats = _tensor_stats(val)
+    bad = stats["num_nan"] + stats["num_inf"]
+    record = {"op": op_name, "out": idx, "t": time.time(), **stats}
+    mode = cfg.debug_mode
+    if mode == DebugMode.CHECK_ALL:
+        _dump(cfg, record)
+    if bad:
+        if mode != DebugMode.CHECK_ALL:   # CHECK_ALL already dumped it
+            _dump(cfg, record)
+        msg = (f"[tensor_checker] op '{op_name}' output {idx}: "
+               f"{stats['num_nan']} NaN, {stats['num_inf']} Inf "
+               f"(dtype {stats['dtype']}, numel {stats['numel']})")
+        if mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+            raise FloatingPointError(msg)
+        if mode in (DebugMode.CHECK_NAN_INF,
+                    DebugMode.CHECK_ALL,
+                    DebugMode.CHECK_ALL_FOR_OVERFLOW):
+            warnings.warn(msg)
+    elif mode == DebugMode.CHECK_ALL_FOR_OVERFLOW:
+        overflow = (stats.get("max", 0.0) > _FP16_MAX
+                    or stats.get("min", 0.0) < -_FP16_MAX)
+        underflow = 0.0 < stats.get("min_abs_nonzero", 0.0) < 6.1e-5
+        if overflow or underflow:
+            _dump(cfg, record)
+            warnings.warn(
+                f"[tensor_checker] op '{op_name}' output {idx} exceeds "
+                f"the fp16 range: max={stats.get('max')}, "
+                f"min={stats.get('min')}, "
+                f"min_abs_nonzero={stats.get('min_abs_nonzero')}")
+
+
+def _check_hook(name: str, outs) -> None:
+    cfg = _CHECKER[0]
+    if cfg is None or not cfg.enable or not cfg._wants(name):
+        return
+    for i, o in enumerate(outs):
+        _check_one(cfg, name, i, o)
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig) -> None:
+    """Install the per-op output checker (reference
+    enable_tensor_checker:653). Every eager dispatch's outputs are
+    inspected per the config until disable_tensor_checker()."""
+    from paddle_tpu.ops.registry import CHECK_HOOK
+
+    _CHECKER[0] = checker_config
+    CHECK_HOOK[0] = _check_hook
+
+
+def disable_tensor_checker() -> None:
+    from paddle_tpu.ops.registry import CHECK_HOOK
+
+    _CHECKER[0] = None
+    CHECK_HOOK[0] = None
+    _close_dumps()
+
+
+def check_numerics(tensor, op_type: str = "", var_name: str = "",
+                   debug_mode: DebugMode = DebugMode.CHECK_NAN_INF_AND_ABORT,
+                   stack_height_limit: int = 1,
+                   path: Optional[str] = None) -> dict:
+    """One-shot numerics check of a single tensor (reference
+    check_numerics:361). Returns the stats dict; warns or raises per
+    debug_mode when NaN/Inf present."""
+    val = tensor._value if hasattr(tensor, "_value") else tensor
+    cfg = TensorCheckerConfig(debug_mode=debug_mode, output_dir=path)
+    try:
+        _check_one(cfg, op_type or "check_numerics", 0, val)
+    finally:
+        if path is not None:
+            fh = _DUMP_FH.pop(path, None)
+            if fh is not None:
+                fh.close()
+    return _tensor_stats(val)
+
+
+def check_layer_numerics(func):
+    """Decorator for a Layer.forward: checks every tensor input and output
+    (reference check_layer_numerics:78 — abort on non-finite)."""
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        for i, a in enumerate(args):
+            if hasattr(a, "_value"):
+                check_numerics(a, type(self).__name__, f"input{i}")
+        out = func(self, *args, **kwargs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        for i, o in enumerate(outs):
+            if hasattr(o, "_value"):
+                check_numerics(o, type(self).__name__, f"output{i}")
+        return out
+
+    return wrapper
+
+
+# ------------------------------------------------------- operator stats
+
+_STATS: list = [None]     # {op_name: [fp16, bf16, fp32, other] counts}
+_PREV_TRACE: list = [None]
+
+
+def _dtype_bucket(outs) -> int:
+    for o in outs:
+        d = str(getattr(o, "dtype", ""))
+        if "float16" in d and "bfloat16" not in d:
+            return 0
+        if "bfloat16" in d:
+            return 1
+        if "float32" in d or "float64" in d:
+            return 2
+    return 3
+
+
+def _stats_hook(name: str, args, kwargs) -> None:
+    # TRACE_HOOK fires pre-execution; bucket on the INPUT dtypes (the amp
+    # decision point — matches the reference's op_count per-dtype split)
+    if _STATS[0] is None:
+        return
+    from paddle_tpu.core.tensor import Tensor
+
+    tensors = [a for a in args if isinstance(a, Tensor)]
+    row = _STATS[0].setdefault(name, [0, 0, 0, 0])
+    row[_dtype_bucket([t._value for t in tensors])] += 1
+    if _PREV_TRACE[0] is not None:
+        _PREV_TRACE[0](name, args, kwargs)
+
+
+def enable_operator_stats_collection() -> None:
+    """Count every dispatched op, split by float16/bfloat16/fp32/other
+    input dtype (reference enable_operator_stats_collection:480).
+    Idempotent: a nested enable keeps the existing collector (counts keep
+    accumulating) instead of chaining the hook to itself."""
+    from paddle_tpu.ops.registry import TRACE_HOOK
+
+    if TRACE_HOOK[0] is _stats_hook:
+        return
+    _STATS[0] = {}
+    _PREV_TRACE[0] = TRACE_HOOK[0]
+    TRACE_HOOK[0] = _stats_hook
+
+
+def disable_operator_stats_collection() -> None:
+    """Stop collecting and print the per-op table (reference
+    disable_operator_stats_collection:518). No-op when not collecting
+    (pairs with the idempotent enable under nesting)."""
+    from paddle_tpu.ops.registry import TRACE_HOOK
+
+    if TRACE_HOOK[0] is not _stats_hook:
+        return
+    TRACE_HOOK[0] = _PREV_TRACE[0]
+    _PREV_TRACE[0] = None
+    stats, _STATS[0] = _STATS[0], None
+    if stats is None:
+        return
+    print("<{:-^120}>".format(" op list "))
+    print("{:<40}{:<20}{:<20}{:<20}{:<20}".format(
+        "OP Type", "Calls-FP16", "Calls-BF16", "Calls-FP32", "Calls-Other"))
+    for name in sorted(stats):
+        f16, bf16, f32, other = stats[name]
+        print(f"{name:<40}{f16:<20}{bf16:<20}{f32:<20}{other:<20}")
+    print("<{:-^120}>".format(""))
+
+
+@contextmanager
+def collect_operator_stats():
+    """Context form (reference collect_operator_stats:559)."""
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def operator_stats_snapshot() -> Optional[dict]:
+    """Live view of the collected counts (testing hook; the reference
+    exposes the same via its flag-guarded op-count dict)."""
+    return None if _STATS[0] is None else dict(_STATS[0])
+
+
+# ------------------------------------------------------- accuracy compare
+
+def compare_accuracy(dump_path: str, another_dump_path: str,
+                     output_filename: str, loss_scale: float = 1,
+                     dump_all_tensors: bool = False) -> None:
+    """Merge two tensor-check dump dirs into one CSV keyed by (op, out):
+    the reference writes xlsx via xlsxwriter (not in this image) — the
+    content matches its SHEET: per-op max/min/mean/nan/inf from each run
+    side by side (reference compare_accuracy:594)."""
+    import csv
+    import json
+
+    def load(d):
+        out = {}
+        if not os.path.isdir(d):
+            return out
+        for fn in sorted(os.listdir(d)):
+            if not fn.startswith("tensor_check_"):
+                continue
+            with open(os.path.join(d, fn)) as f:
+                for line in f:
+                    try:
+                        r = json.loads(line)
+                    except ValueError:
+                        continue
+                    out[(r.get("op"), r.get("out"))] = r
+        return out
+
+    a, b = load(dump_path), load(another_dump_path)
+    keys = sorted(set(a) | set(b), key=str)
+    with open(output_filename, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["op", "out",
+                    "a_max", "a_min", "a_mean", "a_nan", "a_inf",
+                    "b_max", "b_min", "b_mean", "b_nan", "b_inf"])
+        for k in keys:
+            ra, rb = a.get(k, {}), b.get(k, {})
+            w.writerow([k[0], k[1],
+                        ra.get("max"), ra.get("min"), ra.get("mean"),
+                        ra.get("num_nan"), ra.get("num_inf"),
+                        rb.get("max"), rb.get("min"), rb.get("mean"),
+                        rb.get("num_nan"), rb.get("num_inf")])

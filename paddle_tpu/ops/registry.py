@@ -165,6 +165,13 @@ def _any_symbolic(obj) -> bool:
 # here ONE choke point sees them all)
 TRACE_HOOK = [None]
 
+# post-execution hook: when set, called as hook(name, outs) with every
+# op's concrete outputs (amp.debugging tensor checker / operator stats —
+# reference python/paddle/amp/debugging.py over the check_nan_inf kernel
+# hooks). Setting it disables tape-segment recording (outputs must be
+# concrete to inspect), mirroring FLAGS_check_nan_inf.
+CHECK_HOOK = [None]
+
 # tape-segment recording state, owned here (the cheapest possible check on
 # the dispatch hot path) but driven by paddle_tpu/jit/segments.py, which
 # installs the recorder class on import and flips SEGMENT_MODE in its
@@ -246,6 +253,7 @@ def dispatch(name: str, args, kwargs, _op=None):
             and _hashable(args_tpl)
             and _hashable(kwargs_tpl)
             and not flags.flag("FLAGS_check_nan_inf")
+            and CHECK_HOOK[0] is None
         )
         if recordable:
             def seg_raw_f(*tvals):
@@ -305,6 +313,8 @@ def dispatch(name: str, args, kwargs, _op=None):
 
     if flags.flag("FLAGS_check_nan_inf"):
         _check_nan_inf(name, outs)
+    if CHECK_HOOK[0] is not None:
+        CHECK_HOOK[0](name, outs)
 
     node = None
     if need_grad:

@@ -342,12 +342,24 @@ def send(tensor, dst=0, group=None, sync_op=True):
             ("xfer", srv.address(), uid, str(val.dtype),
              tuple(val.shape), bool(sync_op))))
         if sync_op:
-            # block until the receiver pulled: the offered buffer lives
-            # in THIS process's transfer server, so a fire-and-forget
-            # sender exiting early would strand the receiver's pull (the
-            # store path had no such lifetime coupling). isend
+            # block (bounded) until the receiver pulled: the offered
+            # buffer lives in THIS process's transfer server, so a
+            # fire-and-forget sender exiting early would strand the
+            # receiver's pull. Bounded so a receiver-side failure surfaces
+            # as a TimeoutError here instead of a permanent hang. isend
             # (sync_op=False) keeps fire-and-forget for batch exchanges.
-            store.wait([key + "/ack"])
+            import os as _os
+            import time as _time
+
+            deadline = _time.time() + float(
+                _os.environ.get("PADDLE_P2P_ACK_TIMEOUT_S", "600"))
+            while not store.check(key + "/ack"):
+                if _time.time() > deadline:
+                    raise TimeoutError(
+                        f"send({rank}->{dst}, seq {seq}): receiver never "
+                        "pulled within PADDLE_P2P_ACK_TIMEOUT_S — peer "
+                        "failed or mis-configured transport?")
+                _time.sleep(0.01)
             try:
                 store.delete_key(key + "/ack")
             except Exception:
@@ -426,5 +438,10 @@ def batch_isend_irecv(p2p_op_list):
     return []
 
 
-isend = send
+def isend(tensor, dst=0, group=None):
+    """Non-blocking send: fire-and-forget offer (no ack rendezvous) — the
+    canonical isend/irecv exchange must not block before the recvs."""
+    return send(tensor, dst, group=group, sync_op=False)
+
+
 irecv = recv

@@ -1,0 +1,100 @@
+"""paddle.amp.debugging surface: tensor checker, operator stats, accuracy
+compare (reference python/paddle/amp/debugging.py)."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.amp import debugging as dbg
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    dbg.disable_tensor_checker()
+
+
+def test_check_numerics_stats_and_abort():
+    t = paddle.to_tensor(np.array([1.0, np.nan, np.inf, 0.0], np.float32))
+    with pytest.raises(FloatingPointError):
+        dbg.check_numerics(t, "op", "x")
+    stats = dbg.check_numerics(t, "op", "x",
+                               debug_mode=dbg.DebugMode.CHECK_NAN_INF)
+    assert stats["num_nan"] == 1 and stats["num_inf"] == 1
+    clean = paddle.to_tensor(np.ones(3, np.float32))
+    s2 = dbg.check_numerics(clean, "op", "y")
+    assert s2["num_nan"] == 0
+
+
+def test_tensor_checker_aborts_on_nan_producing_op():
+    cfg = dbg.TensorCheckerConfig(
+        debug_mode=dbg.DebugMode.CHECK_NAN_INF_AND_ABORT)
+    dbg.enable_tensor_checker(cfg)
+    x = paddle.to_tensor(np.array([-1.0, 4.0], np.float32))
+    with pytest.raises(FloatingPointError) as ei:
+        paddle.sqrt(x)            # sqrt(-1) -> NaN
+    assert "sqrt" in str(ei.value)
+    dbg.disable_tensor_checker()
+    out = paddle.sqrt(x)          # checker off: op proceeds
+    assert np.isnan(out.numpy()[0])
+
+
+def test_tensor_checker_warn_mode_and_op_lists():
+    cfg = dbg.TensorCheckerConfig(debug_mode=dbg.DebugMode.CHECK_NAN_INF,
+                                  skipped_op_list=["sqrt"])
+    dbg.enable_tensor_checker(cfg)
+    x = paddle.to_tensor(np.array([-1.0], np.float32))
+    with warnings.catch_warnings(record=True) as ws:
+        warnings.simplefilter("always")
+        paddle.sqrt(x)            # skipped: silent
+        paddle.log(x)             # log(-1) -> NaN: warns
+    msgs = [str(w.message) for w in ws if "tensor_checker" in str(w.message)]
+    assert len(msgs) == 1 and "log" in msgs[0]
+
+
+def test_tensor_checker_dump_and_compare_accuracy(tmp_path):
+    for sub, scale in (("a", 1.0), ("b", 3.0)):
+        cfg = dbg.TensorCheckerConfig(
+            debug_mode=dbg.DebugMode.CHECK_ALL,
+            output_dir=str(tmp_path / sub))
+        dbg.enable_tensor_checker(cfg)
+        x = paddle.to_tensor(np.full(4, scale, np.float32))
+        (x * 2.0).sum()
+        dbg.disable_tensor_checker()
+    out = tmp_path / "cmp.csv"
+    dbg.compare_accuracy(str(tmp_path / "a"), str(tmp_path / "b"),
+                         str(out))
+    text = out.read_text()
+    assert "op" in text.splitlines()[0]
+    assert len(text.splitlines()) > 1
+
+
+def test_operator_stats_collection(capsys):
+    with dbg.collect_operator_stats():
+        a = paddle.to_tensor(np.ones((2, 2), np.float32))
+        b = a.astype("bfloat16")
+        _ = a @ a
+        _ = b + b
+        snap = dbg.operator_stats_snapshot()
+        assert snap and any("matmul" in k for k in snap)
+    printed = capsys.readouterr().out
+    assert "OP Type" in printed and "matmul" in printed
+    # bf16 add counted in the bf16 bucket
+    add_rows = [k for k in snap if "add" in k]
+    assert any(snap[k][1] >= 1 for k in add_rows), snap
+
+
+def test_check_layer_numerics_decorator():
+    import paddle_tpu.nn as nn
+
+    class L(nn.Layer):
+        @dbg.check_layer_numerics
+        def forward(self, x):
+            return x * 2.0
+
+    out = L()(paddle.to_tensor(np.ones(3, np.float32)))
+    assert np.allclose(out.numpy(), 2.0)
+    with pytest.raises(FloatingPointError):
+        L()(paddle.to_tensor(np.array([np.nan], np.float32)))
