@@ -240,7 +240,7 @@ def check_layer_numerics(func):
 # ------------------------------------------------------- operator stats
 
 _STATS: list = [None]     # {op_name: [fp16, bf16, fp32, other] counts}
-_PREV_TRACE: list = [None]
+_STATS_DEPTH: list = [0]  # nesting depth of enable/disable pairs
 
 
 def _dtype_bucket(outs) -> int:
@@ -256,8 +256,8 @@ def _dtype_bucket(outs) -> int:
 
 
 def _stats_hook(name: str, args, kwargs) -> None:
-    # TRACE_HOOK fires pre-execution; bucket on the INPUT dtypes (the amp
-    # decision point — matches the reference's op_count per-dtype split)
+    # fires pre-execution; bucket on the INPUT dtypes (the amp decision
+    # point — matches the reference's op_count per-dtype split)
     if _STATS[0] is None:
         return
     from paddle_tpu.core.tensor import Tensor
@@ -265,34 +265,34 @@ def _stats_hook(name: str, args, kwargs) -> None:
     tensors = [a for a in args if isinstance(a, Tensor)]
     row = _STATS[0].setdefault(name, [0, 0, 0, 0])
     row[_dtype_bucket([t._value for t in tensors])] += 1
-    if _PREV_TRACE[0] is not None:
-        _PREV_TRACE[0](name, args, kwargs)
 
 
 def enable_operator_stats_collection() -> None:
     """Count every dispatched op, split by float16/bfloat16/fp32/other
-    input dtype (reference enable_operator_stats_collection:480).
-    Idempotent: a nested enable keeps the existing collector (counts keep
-    accumulating) instead of chaining the hook to itself."""
-    from paddle_tpu.ops.registry import TRACE_HOOK
+    input dtype (reference enable_operator_stats_collection:480). Rides
+    the dispatcher's dedicated STATS_HOOK (independent of the api_tracer's
+    TRACE_HOOK lifecycle). Nesting-safe: inner enable/disable pairs keep
+    one accumulating collection; the outermost disable prints it."""
+    from paddle_tpu.ops.registry import STATS_HOOK
 
-    if TRACE_HOOK[0] is _stats_hook:
-        return
-    _STATS[0] = {}
-    _PREV_TRACE[0] = TRACE_HOOK[0]
-    TRACE_HOOK[0] = _stats_hook
+    _STATS_DEPTH[0] += 1
+    if _STATS_DEPTH[0] == 1:
+        _STATS[0] = {}
+        STATS_HOOK[0] = _stats_hook
 
 
 def disable_operator_stats_collection() -> None:
     """Stop collecting and print the per-op table (reference
-    disable_operator_stats_collection:518). No-op when not collecting
-    (pairs with the idempotent enable under nesting)."""
-    from paddle_tpu.ops.registry import TRACE_HOOK
+    disable_operator_stats_collection:518). Inner disables of a nested
+    collection are no-ops; the outermost one prints."""
+    from paddle_tpu.ops.registry import STATS_HOOK
 
-    if TRACE_HOOK[0] is not _stats_hook:
+    if _STATS_DEPTH[0] == 0:
         return
-    TRACE_HOOK[0] = _PREV_TRACE[0]
-    _PREV_TRACE[0] = None
+    _STATS_DEPTH[0] -= 1
+    if _STATS_DEPTH[0] > 0:
+        return
+    STATS_HOOK[0] = None
     stats, _STATS[0] = _STATS[0], None
     if stats is None:
         return
@@ -335,6 +335,7 @@ def compare_accuracy(dump_path: str, another_dump_path: str,
 
     def load(d):
         out = {}
+        occ: dict = {}
         if not os.path.isdir(d):
             return out
         for fn in sorted(os.listdir(d)):
@@ -346,19 +347,22 @@ def compare_accuracy(dump_path: str, another_dump_path: str,
                         r = json.loads(line)
                     except ValueError:
                         continue
-                    out[(r.get("op"), r.get("out"))] = r
+                    base = (r.get("op"), r.get("out"))
+                    n = occ.get(base, 0)   # k-th invocation of this op
+                    occ[base] = n + 1
+                    out[base + (n,)] = r
         return out
 
     a, b = load(dump_path), load(another_dump_path)
     keys = sorted(set(a) | set(b), key=str)
     with open(output_filename, "w", newline="") as f:
         w = csv.writer(f)
-        w.writerow(["op", "out",
+        w.writerow(["op", "out_call",
                     "a_max", "a_min", "a_mean", "a_nan", "a_inf",
                     "b_max", "b_min", "b_mean", "b_nan", "b_inf"])
         for k in keys:
             ra, rb = a.get(k, {}), b.get(k, {})
-            w.writerow([k[0], k[1],
+            w.writerow([k[0], f"{k[1]}#{k[2]}",
                         ra.get("max"), ra.get("min"), ra.get("mean"),
                         ra.get("num_nan"), ra.get("num_inf"),
                         rb.get("max"), rb.get("min"), rb.get("mean"),

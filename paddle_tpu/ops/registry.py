@@ -166,11 +166,17 @@ def _any_symbolic(obj) -> bool:
 TRACE_HOOK = [None]
 
 # post-execution hook: when set, called as hook(name, outs) with every
-# op's concrete outputs (amp.debugging tensor checker / operator stats —
-# reference python/paddle/amp/debugging.py over the check_nan_inf kernel
-# hooks). Setting it disables tape-segment recording (outputs must be
-# concrete to inspect), mirroring FLAGS_check_nan_inf.
+# op's concrete outputs (amp.debugging tensor checker — reference
+# python/paddle/amp/debugging.py over the check_nan_inf kernel hooks).
+# Setting it disables tape-segment recording (outputs must be concrete to
+# inspect), mirroring FLAGS_check_nan_inf. Never invoked inside a jit
+# trace (outputs would be tracers).
 CHECK_HOOK = [None]
+
+# pre-execution stats hook (amp.debugging operator-stats collection):
+# separate from TRACE_HOOK so the api_tracer's install/uninstall
+# lifecycle and the stats collector's cannot corrupt each other
+STATS_HOOK = [None]
 
 # tape-segment recording state, owned here (the cheapest possible check on
 # the dispatch hot path) but driven by paddle_tpu/jit/segments.py, which
@@ -210,6 +216,8 @@ def dispatch(name: str, args, kwargs, _op=None):
 
     if TRACE_HOOK[0] is not None:
         TRACE_HOOK[0](name, args, kwargs)
+    if STATS_HOOK[0] is not None:
+        STATS_HOOK[0](name, args, kwargs)
 
     # static-graph build mode: ops on symbolic tensors record program nodes
     # (the reference's two-universe split, SURVEY.md §1 L5a/L5b). The flag
@@ -313,7 +321,7 @@ def dispatch(name: str, args, kwargs, _op=None):
 
     if flags.flag("FLAGS_check_nan_inf"):
         _check_nan_inf(name, outs)
-    if CHECK_HOOK[0] is not None:
+    if CHECK_HOOK[0] is not None and not _in_jit_trace(outs):
         CHECK_HOOK[0](name, outs)
 
     node = None

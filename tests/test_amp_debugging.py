@@ -98,3 +98,42 @@ def test_check_layer_numerics_decorator():
     assert np.allclose(out.numpy(), 2.0)
     with pytest.raises(FloatingPointError):
         L()(paddle.to_tensor(np.array([np.nan], np.float32)))
+
+
+def test_nested_operator_stats_accumulate():
+    """Inner enable/disable pairs keep ONE accumulating collection; the
+    outermost disable prints (review finding: inner exit must not
+    truncate the outer context's counts)."""
+    with dbg.collect_operator_stats():
+        a = paddle.to_tensor(np.ones(2, np.float32))
+        _ = a + a
+        with dbg.collect_operator_stats():
+            _ = a * a
+        _ = a - a                     # after inner exit: still counted
+        snap = dbg.operator_stats_snapshot()
+    assert snap is not None
+    assert any("subtract" in k for k in snap), snap
+    assert dbg.operator_stats_snapshot() is None   # fully closed
+
+
+def test_tensor_checker_skips_jit_traces():
+    """The checker must not crash ops dispatched inside a jit trace
+    (tracer outputs can't be inspected) — compiled paths stay usable
+    while the checker is on."""
+    import warnings as _w
+
+    import paddle_tpu.nn as nn
+
+    cfg = dbg.TensorCheckerConfig(
+        debug_mode=dbg.DebugMode.CHECK_NAN_INF_AND_ABORT)
+    dbg.enable_tensor_checker(cfg)
+    try:
+        net = nn.Linear(4, 2)
+        net.eval()
+        static = paddle.jit.to_static(net)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        with paddle.no_grad():
+            out = static(x)           # compiled: ops trace under jit
+        assert np.isfinite(out.numpy()).all()
+    finally:
+        dbg.disable_tensor_checker()

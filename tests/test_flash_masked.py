@@ -275,12 +275,13 @@ def test_sdpa_fallback_warns_once_per_shape(monkeypatch):
 
     monkeypatch.setattr(impl, "_flash_enabled", lambda: True)
     monkeypatch.setattr(impl, "_SDPA_FALLBACK_WARNED", set())
+    # head dim 12 defeats both the kernel AND the pad-to-128 rescue
     q = paddle.to_tensor(
         np.random.default_rng(0).standard_normal(
-            (1, 500, 4, 32)).astype(np.float32))
+            (1, 500, 4, 12)).astype(np.float32))
     with warnings.catch_warnings(record=True) as ws:
         warnings.simplefilter("always")
-        F.scaled_dot_product_attention(q, q, q)   # 500 % 128 != 0
+        F.scaled_dot_product_attention(q, q, q)   # d % 8 != 0
         F.scaled_dot_product_attention(q, q, q)   # same shape: no repeat
     msgs = [str(w.message) for w in ws
             if "falls back to the O(s^2)" in str(w.message)]
@@ -307,3 +308,33 @@ def test_paged_decode_fallback_warns(monkeypatch):
         gen.block_multihead_attention(q, pool, pool, table, 3)
     msgs = [str(w.message) for w in ws if "paged decode" in str(w.message)]
     assert len(msgs) == 1, msgs
+
+
+def test_unaligned_seq_pads_to_flash_kernel(monkeypatch):
+    """seq-500 no longer pays the O(s^2) cliff: SDPA pads to the next 128
+    multiple, masks the padded keys, runs the kernel, slices back — exact
+    vs the dense path (VERDICT-r4 Weak #9 closed, not just warned)."""
+    import paddle_tpu.ops.impl as impl_mod
+    import paddle_tpu.ops.pallas.flash_attention as fa
+
+    monkeypatch.setattr(impl_mod, "_flash_enabled", lambda: True)
+    calls = []
+    orig = fa.flash_attention
+
+    def spy(*a, **kw):
+        calls.append(tuple(a[0].shape))
+        kw.setdefault("interpret", True)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(fa, "flash_attention", spy)
+    rng_l = np.random.default_rng(4)
+    q = paddle.to_tensor(rng_l.standard_normal(
+        (2, 500, 4, 32)).astype(np.float32))
+    mask = paddle.to_tensor(np.where(
+        rng_l.random((2, 1, 1, 500)) > 0.2, 0.0, -1e30).astype(np.float32))
+    out = F.scaled_dot_product_attention(q, q, q, attn_mask=mask)
+    assert calls and calls[0][1] == 512, calls     # padded to 512
+    assert out.shape == [2, 500, 4, 32]
+    monkeypatch.setattr(impl_mod, "_flash_enabled", lambda: False)
+    ref = F.scaled_dot_product_attention(q, q, q, attn_mask=mask)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=3e-3)
