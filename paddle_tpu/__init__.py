@@ -320,6 +320,12 @@ def DataParallel(model, *args, **kwargs):
 
     return _DP(model, *args, **kwargs)
 
+# top-level surface completion (numpy-alikes, constants, finfo/iinfo,
+# ParamAttr/create_parameter, paddle.batch, generated in-place variants)
+from paddle_tpu import extras as _extras  # noqa: E402
+
+_extras.install_extras(globals())
+
 from paddle_tpu import strings  # noqa: F401,E402
 from paddle_tpu.core.selected_rows import (  # noqa: F401,E402
     SelectedRows, get_tensor_from_selected_rows, merge_selected_rows,

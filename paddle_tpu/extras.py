@@ -1,0 +1,813 @@
+"""Top-level API surface completion: numpy-alike helpers, constants,
+dtype utilities, and the generated in-place (`op_`) variants.
+
+Reference: python/paddle/__init__.py __all__ — the names here close the
+gap between the yaml-op-generated namespace and the reference's full
+top-level surface (python/paddle/tensor/manipulation.py, math.py,
+creation.py, framework/dtype.py finfo/iinfo, reader/decorator.py batch).
+
+Everything composes over already-dispatched ops (so autograd, AMP and the
+per-op jit cache apply) or is host-side metadata; the in-place variants
+are generated from their functional bases with the same
+detach-compute-update contract the yaml `inplace:` methods use.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtype as _dtype_mod
+from paddle_tpu.core.tensor import Tensor
+
+# ------------------------------------------------------------- constants
+
+pi = float(np.pi)
+e = float(np.e)
+inf = float("inf")
+nan = float("nan")
+newaxis = None
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _wrap(v, like=None):
+    # plain wrap (stop_gradient=True): used for bool/int/metadata results.
+    # Differentiable helpers go through _dop so a GradNode records.
+    return Tensor._wrap(v)
+
+
+def _dop(name, impl, *args, **kwargs):
+    """Dispatch a one-shot differentiable op through the registry (same
+    mechanism recompute segments use): AMP, the tape (jax.vjp GradNode),
+    and hooks all apply — numpy-alike helpers built on this propagate
+    gradients instead of silently dropping them."""
+    from paddle_tpu.ops.registry import OpDef, dispatch
+
+    op = OpDef(name, impl, diff=True, dynamic=True, method=False)
+    return dispatch(name, args, kwargs, _op=op)
+
+
+# ------------------------------------------------------------ dtype utils
+
+class finfo:
+    """paddle.finfo (reference framework/dtype.py)."""
+
+    def __init__(self, dtype):
+        fi = jnp.finfo(_dtype_mod.to_jax_dtype(dtype))
+        self.dtype = str(fi.dtype)
+        self.bits = fi.bits
+        self.eps = float(fi.eps)
+        self.max = float(fi.max)
+        self.min = float(fi.min)
+        self.tiny = float(fi.tiny)
+        self.smallest_normal = float(fi.tiny)
+        self.resolution = float(fi.resolution)
+
+
+class iinfo:
+    def __init__(self, dtype):
+        ii = jnp.iinfo(_dtype_mod.to_jax_dtype(dtype))
+        self.dtype = str(ii.dtype)
+        self.bits = ii.bits
+        self.max = int(ii.max)
+        self.min = int(ii.min)
+
+
+def is_complex(x) -> bool:
+    return bool(jnp.issubdtype(_val(x).dtype, jnp.complexfloating))
+
+
+def is_floating_point(x) -> bool:
+    return bool(jnp.issubdtype(_val(x).dtype, jnp.floating))
+
+
+def is_integer(x) -> bool:
+    return bool(jnp.issubdtype(_val(x).dtype, jnp.integer))
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+# ----------------------------------------------------- stack/split family
+
+def atleast_1d(*xs):
+    out = [_dop("atleast_1d", jnp.atleast_1d, x) for x in xs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_2d(*xs):
+    out = [_dop("atleast_2d", jnp.atleast_2d, x) for x in xs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_3d(*xs):
+    out = [_dop("atleast_3d", jnp.atleast_3d, x) for x in xs]
+    return out[0] if len(out) == 1 else out
+
+
+def hstack(xs):
+    return _dop("hstack", lambda *vs: jnp.hstack(vs), *xs)
+
+
+def vstack(xs):
+    return _dop("vstack", lambda *vs: jnp.vstack(vs), *xs)
+
+
+def dstack(xs):
+    return _dop("dstack", lambda *vs: jnp.dstack(vs), *xs)
+
+
+row_stack = vstack
+
+
+def column_stack(xs):
+    return _dop("column_stack", lambda *vs: jnp.column_stack(vs), *xs)
+
+
+def hsplit(x, num_or_indices):
+    n = num_or_indices if isinstance(num_or_indices, int) else \
+        tuple(num_or_indices)
+    return list(_dop("hsplit", lambda v: tuple(jnp.hsplit(v, n)), x))
+
+
+def vsplit(x, num_or_indices):
+    n = num_or_indices if isinstance(num_or_indices, int) else \
+        tuple(num_or_indices)
+    return list(_dop("vsplit", lambda v: tuple(jnp.vsplit(v, n)), x))
+
+
+def dsplit(x, num_or_indices):
+    n = num_or_indices if isinstance(num_or_indices, int) else \
+        tuple(num_or_indices)
+    return list(_dop("dsplit", lambda v: tuple(jnp.dsplit(v, n)), x))
+
+
+def tensor_split(x, num_or_indices, axis=0):
+    n = num_or_indices if isinstance(num_or_indices, int) else \
+        tuple(num_or_indices)
+    return list(_dop("tensor_split",
+                     lambda v: tuple(jnp.array_split(v, n, axis=axis)), x))
+
+
+def block_diag(inputs):
+    import jax.scipy.linalg as jsl
+
+    return _dop("block_diag", lambda *vs: jsl.block_diag(*vs), *inputs)
+
+
+# ------------------------------------------------------ shape/view family
+
+def moveaxis(x, source, destination):
+    src = tuple(source) if isinstance(source, (list, tuple)) else source
+    dst = (tuple(destination) if isinstance(destination, (list, tuple))
+           else destination)
+    return _dop("moveaxis", lambda v: jnp.moveaxis(v, src, dst), x)
+
+
+def matrix_transpose(x):
+    return _dop("matrix_transpose", lambda v: jnp.swapaxes(v, -1, -2), x)
+
+
+def unflatten(x, axis, shape):
+    ax = axis % _val(x).ndim
+    new_tail = tuple(shape)
+
+    def impl(v):
+        return v.reshape(v.shape[:ax] + new_tail + v.shape[ax + 1:])
+
+    return _dop("unflatten", impl, x)
+
+
+def view(x, shape_or_dtype):
+    """paddle.view — zero-copy reinterpret (functional here)."""
+    if isinstance(shape_or_dtype, (list, tuple)):
+        shp = tuple(shape_or_dtype)
+        return _dop("view", lambda v: v.reshape(shp), x)
+    dt = _dtype_mod.to_jax_dtype(shape_or_dtype)
+    return _wrap(_val(x).view(dt))
+
+
+def view_as(x, other):
+    return view(x, list(other.shape))
+
+
+def rank(x):
+    from paddle_tpu import to_tensor
+
+    return to_tensor(_val(x).ndim, dtype="int32")
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+# ---------------------------------------------------------- math family
+
+def negative(x):
+    from paddle_tpu.ops.registry import C_OPS
+
+    return C_OPS.neg(x)
+
+
+def positive(x):
+    return x if isinstance(x, Tensor) else _wrap(_val(x))
+
+
+def less(x, y):
+    from paddle_tpu.ops.registry import C_OPS
+
+    return C_OPS.less_than(x, y)
+
+
+def mod(x, y):
+    from paddle_tpu.ops.registry import C_OPS
+
+    return C_OPS.remainder(x, y)
+
+
+floor_mod = mod
+
+
+def sgn(x):
+    def impl(v):
+        if jnp.issubdtype(v.dtype, jnp.complexfloating):
+            mag = jnp.abs(v)
+            return jnp.where(mag == 0, 0, v / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(v)
+
+    return _dop("sgn", impl, x)
+
+
+def hypot(x, y):
+    return _dop("hypot", jnp.hypot, x, y)
+
+
+def ldexp(x, y):
+    return _dop("ldexp",
+                lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)), x, y)
+
+
+def frexp(x):
+    m, ex = jnp.frexp(_val(x))
+    return _wrap(m), _wrap(ex)
+
+
+def logaddexp(x, y):
+    return _dop("logaddexp", jnp.logaddexp, x, y)
+
+
+def sinc(x):
+    return _dop("sinc", jnp.sinc, x)
+
+
+def signbit(x):
+    return _wrap(jnp.signbit(_val(x)))
+
+
+def polar(abs, angle):  # noqa: A002
+    a, an = _val(abs), _val(angle)
+    return _wrap((a * jnp.cos(an) + 1j * a * jnp.sin(an)
+                  ).astype(jnp.complex64))
+
+
+def isneginf(x):
+    v = _val(x)
+    return _wrap(jnp.isneginf(v))
+
+
+def isposinf(x):
+    v = _val(x)
+    return _wrap(jnp.isposinf(v))
+
+
+def isreal(x):
+    v = _val(x)
+    if jnp.issubdtype(v.dtype, jnp.complexfloating):
+        return _wrap(jnp.imag(v) == 0)
+    return _wrap(jnp.ones(v.shape, bool))
+
+
+def isin(x, test_x, assume_unique=False, invert=False):
+    return _wrap(jnp.isin(_val(x), _val(test_x), invert=invert))
+
+
+def inner(x, y):
+    return _dop("inner", jnp.inner, x, y)
+
+
+def tensordot(x, y, axes=2):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a
+                     for a in axes)
+    return _dop("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes),
+                x, y)
+
+
+def vecdot(x, y, axis=-1):
+    return _dop("vecdot", lambda a, b: jnp.sum(a * b, axis=axis), x, y)
+
+
+def cdist(x, y, p=2.0):
+    def impl(xv, yv):
+        diff = xv[..., :, None, :] - yv[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, -1))
+        return jnp.sum(jnp.abs(diff) ** p, -1) ** (1.0 / p)
+
+    return _dop("cdist", impl, x, y)
+
+
+def pdist(x, p=2.0):
+    n = _val(x).shape[0]
+    iu, ju = np.triu_indices(n, k=1)
+
+    def impl(v):
+        diff = v[iu] - v[ju]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, -1))
+        return jnp.sum(jnp.abs(diff) ** p, -1) ** (1.0 / p)
+
+    return _dop("pdist", impl, x)
+
+
+def gammainc(x, y):
+    return _dop("gammainc", jax.scipy.special.gammainc, x, y)
+
+
+def gammaincc(x, y):
+    return _dop("gammaincc", jax.scipy.special.gammaincc, x, y)
+
+
+def multigammaln(x, p):
+    return _dop("multigammaln",
+                lambda v: jax.scipy.special.multigammaln(v, p), x)
+
+
+def cumulative_trapezoid(y, x=None, dx=1.0, axis=-1):
+    yv = _val(y)
+    yv = jnp.moveaxis(yv, axis, -1)
+    if x is not None:
+        xv = jnp.moveaxis(_val(x), axis, -1)
+        d = jnp.diff(xv, axis=-1)
+    else:
+        d = dx
+    avg = (yv[..., 1:] + yv[..., :-1]) * 0.5 * d
+    out = jnp.cumsum(avg, axis=-1)
+    return _wrap(jnp.moveaxis(out, -1, axis))
+
+
+def add_n(inputs):
+    def impl(*vs):
+        out = vs[0]
+        for v in vs[1:]:
+            out = out + v
+        return out
+
+    return _dop("add_n", impl, *inputs)
+
+
+def bitwise_invert(x):
+    from paddle_tpu.ops.registry import C_OPS
+
+    return C_OPS.bitwise_not(x)
+
+
+# ----------------------------------------------------- histogram family
+
+def histogram_bin_edges(x, bins=100, min=0, max=0):  # noqa: A002
+    v = np.asarray(_val(x))
+    rng_ = None if (min == 0 and max == 0) else (min, max)
+    return _wrap(jnp.asarray(np.histogram_bin_edges(v, bins, rng_)))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
+    v = np.asarray(_val(x))
+    w = np.asarray(_val(weights)) if weights is not None else None
+    hist, edges = np.histogramdd(v, bins=bins, range=ranges,
+                                 density=density, weights=w)
+    return _wrap(jnp.asarray(hist)), [_wrap(jnp.asarray(e)) for e in edges]
+
+
+# ------------------------------------------------------- combinatorics
+
+def cartesian_prod(xs):
+    grids = jnp.meshgrid(*[_val(x) for x in xs], indexing="ij")
+    return _wrap(jnp.stack([g.reshape(-1) for g in grids], axis=-1))
+
+
+def combinations(x, r=2, with_replacement=False):
+    import itertools
+
+    v = _val(x)
+    n = v.shape[0]
+    it = (itertools.combinations_with_replacement(range(n), r)
+          if with_replacement else itertools.combinations(range(n), r))
+    idx = np.asarray(list(it), dtype=np.int32).reshape(-1, r)
+    return _wrap(v[idx], x)
+
+
+# ------------------------------------------------------- scatter family
+
+def diagflat(x, offset=0):
+    return _dop("diagflat", lambda v: jnp.diagflat(v, k=offset), x)
+
+
+def take(x, index, mode="raise"):
+    def impl(v, i):
+        v = v.reshape(-1)
+        if mode == "wrap":
+            i = i % v.shape[0]
+        elif mode == "clip":
+            i = jnp.clip(i, 0, v.shape[0] - 1)
+        return jnp.take(v, i)
+
+    return _dop("take", impl, x, index)
+
+
+def index_fill(x, index, axis, value):
+    def impl(v, i):
+        idx = [slice(None)] * v.ndim
+        idx[axis] = i
+        return v.at[tuple(idx)].set(value)
+
+    return _dop("index_fill", impl, x, index)
+
+
+def select_scatter(x, values, axis, index):
+    def impl(v, val):
+        idx = [slice(None)] * v.ndim
+        idx[axis] = index
+        return v.at[tuple(idx)].set(val)
+
+    return _dop("select_scatter", impl, x, values)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides):
+    def impl(v, val):
+        idx = [slice(None)] * v.ndim
+        for ax, st, en, sr in zip(axes, starts, ends, strides):
+            idx[ax] = slice(st, en, sr)
+        return v.at[tuple(idx)].set(val)
+
+    return _dop("slice_scatter", impl, x, value)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    def impl(v, yv):
+        n1, n2 = v.shape[axis1], v.shape[axis2]
+        k = min(n1, n2 - offset) if offset >= 0 else min(n1 + offset, n2)
+        i = jnp.arange(k) + (-offset if offset < 0 else 0)
+        j = jnp.arange(k) + (offset if offset > 0 else 0)
+        idx = [slice(None)] * v.ndim
+        idx[axis1], idx[axis2] = i, j
+        return v.at[tuple(idx)].set(yv)
+
+    return _dop("diagonal_scatter", impl, x, y)
+
+
+def masked_scatter(x, mask, value):
+    v, m = _val(x), np.asarray(_val(mask)).astype(bool)
+    m = np.broadcast_to(m, v.shape)
+    src = np.asarray(_val(value)).reshape(-1)[: int(m.sum())]
+    out = np.array(v)
+    out[m] = src
+    return _wrap(jnp.asarray(out), x)
+
+
+def scatter_nd(index, updates, shape):
+    shp = tuple(shape)
+
+    def impl(i, u):
+        out = jnp.zeros(shp, u.dtype)
+        return out.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+
+    return _dop("scatter_nd", impl, index, updates)
+
+
+# --------------------------------------------------------- random extras
+
+def standard_normal(shape, dtype=None):
+    from paddle_tpu import randn
+
+    return randn(shape, dtype=dtype)
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    """Uniform ints in [low, high) shaped/typed like x (reference: dtype
+    defaults to x.dtype, low to 0)."""
+    from paddle_tpu import randint
+
+    out = randint(low, high, shape=tuple(_val(x).shape), dtype="int64")
+    target = dtype or str(_val(x).dtype)
+    return out.astype(target)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None):
+    from paddle_tpu import normal
+
+    return normal(mean, std, shape=shape).exp()
+
+
+# ---------------------------------------------------------- dlpack / io
+
+def to_dlpack(x):
+    return jax.dlpack.to_dlpack(_val(x))
+
+
+def from_dlpack(capsule):
+    return _wrap(jax.dlpack.from_dlpack(capsule))
+
+
+# -------------------------------------------------------- framework bits
+
+_STATIC_MODE = [False]
+
+
+def in_dynamic_mode() -> bool:
+    return not _STATIC_MODE[0]
+
+
+def disable_signal_handler() -> None:
+    """No-op: python owns signal handling here (the reference disables its
+    C++ fault handlers)."""
+
+
+class LazyGuard:
+    """Context that defers parameter initialization (reference
+    LazyGuard/LazyInit). Collapse: parameters here are cheap jax arrays
+    initialized eagerly; the guard is a compatible no-op scope."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class ParamAttr:
+    """paddle.ParamAttr (reference param_attr.py) — carried metadata for
+    layer parameter creation: name / initializer / lr multiplier /
+    regularizer / trainable."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=False,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Top-level parameter factory (reference
+    paddle.create_parameter)."""
+    from paddle_tpu.core.tensor import Parameter
+    from paddle_tpu.nn import initializer as I
+
+    init = default_initializer
+    if init is None and isinstance(attr, ParamAttr) and attr.initializer:
+        init = attr.initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    val = init(tuple(shape), dtype)
+    trainable = not (isinstance(attr, ParamAttr) and not attr.trainable)
+    return Parameter(val, trainable=trainable,
+                     name=(attr.name if isinstance(attr, ParamAttr)
+                           and attr.name else name or ""))
+
+
+def cauchy_(x, loc=0.0, scale=1.0, name=None):
+    """In-place Cauchy fill (reference paddle.Tensor.cauchy_)."""
+    from paddle_tpu.core.random import default_generator
+
+    u = jax.random.uniform(default_generator.next_key(),
+                           tuple(_val(x).shape), jnp.float32,
+                           minval=1e-6, maxval=1 - 1e-6)
+    v = loc + scale * jnp.tan(jnp.pi * (u - 0.5))
+    x._inplace_update(v.astype(_val(x).dtype))
+    return x
+
+
+def geometric_(x, probs=0.5, name=None):
+    """In-place geometric fill (reference paddle.Tensor.geometric_)."""
+    from paddle_tpu.core.random import default_generator
+
+    u = jax.random.uniform(default_generator.next_key(),
+                           tuple(_val(x).shape), jnp.float32,
+                           minval=1e-9, maxval=1.0)
+    v = jnp.ceil(jnp.log(u) / jnp.log1p(-probs))
+    x._inplace_update(v.astype(_val(x).dtype))
+    return x
+
+
+def tolist(x):
+    return x.tolist() if isinstance(x, Tensor) else np.asarray(x).tolist()
+
+
+def check_shape(x, expected_shape):
+    """Assert a tensor's shape (static-graph helper in the reference)."""
+    got = tuple(_val(x).shape)
+    exp = tuple(expected_shape)
+    ok = len(got) == len(exp) and all(
+        e in (-1, None) or g == e for g, e in zip(got, exp))
+    if not ok:
+        raise ValueError(f"shape mismatch: expected {exp}, got {got}")
+    return x
+
+
+def batch(reader, batch_size, drop_last=False):
+    """paddle.batch (reference reader/decorator.py:batch) — wrap a sample
+    reader into a batched reader."""
+
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+# ------------------------------------------------- in-place generation
+
+# reference top-level in-place names whose functional base exists in the
+# namespace: paddle.<op>_(x, ...) computes the base op and writes the
+# result back into x (same detach-compute-update contract as the yaml
+# inplace methods; in-place on a non-leaf recording grads raises in
+# Tensor._inplace_update)
+INPLACE_BASES = [
+    "abs", "acos", "addmm", "asin", "atan", "bernoulli", "bitwise_and",
+    "bitwise_invert", "bitwise_not", "bitwise_or", "bitwise_xor", "cast",
+    "ceil", "clip", "copysign", "cos", "cosh", "cumprod", "cumsum",
+    "digamma", "divide", "equal", "erf", "erfinv", "exp", "expm1",
+    "flatten", "floor", "floor_divide", "floor_mod",
+    "frac", "gammainc", "gammaincc", "gammaln", "gcd", "greater_equal",
+    "greater_than", "hypot", "i0", "lcm", "ldexp", "less", "less_equal",
+    "less_than", "lerp", "lgamma", "log", "log10", "log1p", "log2",
+    "logical_and", "logical_not", "logical_or",
+    "logical_xor", "logit", "masked_fill", "masked_scatter", "mod",
+    "multigammaln", "multiply", "nan_to_num", "neg",
+    "polygamma", "pow", "reciprocal", "remainder", "renorm", "reshape",
+    "round", "rsqrt", "scale", "scatter", "sgn", "sigmoid", "sign",
+    "sin", "sinc", "sinh", "sqrt", "square", "squeeze", "subtract",
+    "t", "tan", "tanh", "transpose", "tril", "triu", "trunc",
+    "unsqueeze", "bitwise_left_shift", "bitwise_right_shift",
+]
+
+# in-place ops whose write target is NOT the first functional arg, or
+# whose semantics are a random FILL of x — explicit definitions:
+
+
+def where_(condition, x, y, name=None):
+    """In-place where: writes the selected values into X (reference
+    paddle.where_ — x, not the bool condition, is the destination)."""
+    from paddle_tpu.ops.registry import C_OPS
+
+    out = C_OPS.where(condition, x.detach(), y)
+    x._inplace_update(out._value)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    """Fill x in place with N(mean, std) samples (reference
+    Tensor.normal_)."""
+    from paddle_tpu.core.random import default_generator
+
+    v = mean + std * jax.random.normal(default_generator.next_key(),
+                                       tuple(_val(x).shape), jnp.float32)
+    x._inplace_update(v.astype(_val(x).dtype))
+    return x
+
+
+def bernoulli_(x, p=0.5, name=None):
+    """Fill x in place with Bernoulli(p) samples (reference
+    Tensor.bernoulli_ — p is the probability, x only supplies
+    shape/dtype)."""
+    from paddle_tpu.core.random import default_generator
+
+    v = jax.random.bernoulli(default_generator.next_key(), p,
+                             tuple(_val(x).shape))
+    x._inplace_update(v.astype(_val(x).dtype))
+    return x
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    """Fill x in place with LogNormal(mean, std) samples."""
+    from paddle_tpu.core.random import default_generator
+
+    v = jnp.exp(mean + std * jax.random.normal(
+        default_generator.next_key(), tuple(_val(x).shape), jnp.float32))
+    x._inplace_update(v.astype(_val(x).dtype))
+    return x
+
+
+def _make_inplace(base_fn, name):
+    def fn(x, *args, **kwargs):
+        out = base_fn(x.detach() if isinstance(x, Tensor) else x,
+                      *args, **kwargs)
+        ov = out._value if isinstance(out, Tensor) else jnp.asarray(out)
+        xv = _val(x)
+        if ov.dtype != xv.dtype and name not in ("cast_",):
+            # paddle's in-place contract: output dtype must match the
+            # destination (a bool comparison result silently flipping a
+            # float tensor's dtype corrupts far from the call site)
+            raise TypeError(
+                f"{name}: result dtype {ov.dtype} != tensor dtype "
+                f"{xv.dtype}; in-place requires matching dtypes (use the "
+                f"functional paddle.{name[:-1]} instead)")
+        x._inplace_update(ov)
+        return x
+
+    fn.__name__ = name
+    fn.__doc__ = f"In-place variant of paddle.{name[:-1]} (writes into x)."
+    return fn
+
+
+def install_extras(namespace: dict) -> None:
+    """Install this module's public API plus the generated in-place
+    variants into the package namespace (idempotent; existing names are
+    never overwritten). Allowlist-based: only functions/classes DEFINED
+    here plus the explicit constants export — imported helpers never leak
+    into the public namespace."""
+    import sys
+    import types
+
+    mod = sys.modules[__name__]
+    consts = ("pi", "e", "inf", "nan", "newaxis", "row_stack",
+              "floor_mod")
+    for n in dir(mod):
+        if n.startswith("_") or n in ("install_extras", "INPLACE_BASES"):
+            continue
+        obj = getattr(mod, n)
+        defined_here = (isinstance(obj, (types.FunctionType, type))
+                        and getattr(obj, "__module__", None) == __name__)
+        if defined_here or n in consts:
+            namespace.setdefault(n, obj)
+    # special names that collide with builtins as module globals
+    namespace.setdefault("bool", _dtype_mod.to_paddle_dtype("bool")
+                         if hasattr(_dtype_mod, "to_paddle_dtype")
+                         else "bool")
+    # place/dtype/compat aliases
+    from paddle_tpu.core.place import CPUPlace, TPUPlace
+
+    namespace.setdefault("CUDAPlace", TPUPlace)       # accelerator place
+    namespace.setdefault("CUDAPinnedPlace", CPUPlace)
+    namespace.setdefault("dtype", type(_dtype_mod.to_jax_dtype("float32")))
+    namespace.setdefault("float8_e4m3fn", jnp.float8_e4m3fn)
+    namespace.setdefault("float8_e5m2", jnp.float8_e5m2)
+    namespace.setdefault("pstring", "pstring")   # PIR-only dtypes: name
+    namespace.setdefault("raw", "raw")           # sentinels for parity
+    namespace.setdefault("get_cuda_rng_state", namespace.get("get_rng_state"))
+    namespace.setdefault("set_cuda_rng_state", namespace.get("set_rng_state"))
+
+    def enable_static():
+        """Reference paddle.enable_static: build ops into a static
+        Program via paddle.static APIs (program_guard); here the flag
+        only flips in_dynamic_mode()'s answer — op capture happens inside
+        static.program_guard either way (one-compiler design)."""
+        _STATIC_MODE[0] = True
+
+    def disable_static():
+        _STATIC_MODE[0] = False
+
+    namespace.setdefault("enable_static", enable_static)
+    namespace.setdefault("disable_static", disable_static)
+
+    for base in INPLACE_BASES:
+        nm = base + "_"
+        if nm in namespace:
+            continue
+        base_fn = namespace.get(base)
+        if base_fn is None:
+            continue
+        fn = _make_inplace(base_fn, nm)
+        namespace[nm] = fn
+        # Tensor method too (x.abs_() etc.)
+        if not hasattr(Tensor, nm):
+            setattr(Tensor, nm, fn)

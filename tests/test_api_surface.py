@@ -1,0 +1,177 @@
+"""Top-level API surface parity: every name in the reference's
+python/paddle/__init__.py __all__ exists on paddle_tpu (the
+switch-from-the-reference contract), plus behavior smokes for the
+extras module (numpy-alikes, in-place variants, framework bits)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+# names from the reference __all__ (frozen copy — the reference tree may
+# not be present where this suite runs); spot set, full parity asserted
+# in-tree by the audit below when the reference exists
+SPOT_NAMES = [
+    "atleast_1d", "hstack", "vstack", "tensor_split", "moveaxis",
+    "tensordot", "cdist", "pdist", "isin", "hypot", "ldexp", "frexp",
+    "logaddexp", "sinc", "signbit", "polar", "sgn", "take", "diagflat",
+    "index_fill", "select_scatter", "slice_scatter", "diagonal_scatter",
+    "masked_scatter", "scatter_nd", "finfo", "iinfo", "ParamAttr",
+    "create_parameter", "LazyGuard", "batch", "add_n", "standard_normal",
+    "randint_like", "from_dlpack", "to_dlpack", "in_dynamic_mode",
+    "enable_static", "disable_static", "pi", "nan", "inf", "newaxis",
+    "abs_", "sin_", "tanh_", "sqrt_", "clip_", "scale_", "transpose_",
+    "reshape_", "cauchy_", "geometric_", "tolist", "view", "view_as",
+    "rank", "broadcast_shape", "float8_e4m3fn", "float8_e5m2",
+]
+
+
+def test_spot_surface_present():
+    missing = [n for n in SPOT_NAMES if not hasattr(paddle, n)]
+    assert not missing, missing
+
+
+def test_full_reference_all_parity():
+    import os
+    import re
+
+    ref = "/root/reference/python/paddle/__init__.py"
+    if not os.path.exists(ref):
+        pytest.skip("reference tree not present")
+    m = re.search(r"__all__ = \[(.*?)\]", open(ref).read(), re.S)
+    names = set(re.findall(r"'([^']+)'", m.group(1)))
+    missing = sorted(n for n in names if not hasattr(paddle, n))
+    assert not missing, f"{len(missing)} missing: {missing[:20]}"
+
+
+def test_stack_split_roundtrip():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    parts = paddle.tensor_split(x, 3)
+    back = paddle.vstack(parts)
+    np.testing.assert_array_equal(back.numpy(), x.numpy())
+    assert paddle.hstack([x, x]).shape == [3, 8]
+    assert paddle.atleast_3d(x).shape == [3, 4, 1]
+
+
+def test_inplace_variants_write_back():
+    x = paddle.to_tensor(np.array([4.0, 9.0], np.float32))
+    y = paddle.sqrt_(x)
+    assert y is x
+    np.testing.assert_allclose(x.numpy(), [2.0, 3.0])
+    # tensor-method form too
+    t = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+    t.abs_()
+    np.testing.assert_allclose(t.numpy(), [1.0, 2.0])
+
+
+def test_inplace_on_grad_nonleaf_raises():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    y = x * 2.0
+    with pytest.raises(RuntimeError):
+        paddle.tanh_(y)
+
+
+def test_scatter_family():
+    x = paddle.to_tensor(np.zeros((3, 3), np.float32))
+    out = paddle.select_scatter(
+        x, paddle.to_tensor(np.ones(3, np.float32)), axis=0, index=1)
+    assert out.numpy()[1].sum() == 3
+    d = paddle.diagonal_scatter(
+        x, paddle.to_tensor(np.full(3, 7.0, np.float32)))
+    np.testing.assert_allclose(np.diag(d.numpy()), 7.0)
+    sn = paddle.scatter_nd(
+        paddle.to_tensor(np.array([[0, 0], [2, 2]], np.int64)),
+        paddle.to_tensor(np.array([5.0, 6.0], np.float32)), [3, 3])
+    assert sn.numpy()[0, 0] == 5 and sn.numpy()[2, 2] == 6
+
+
+def test_distance_and_reduction_helpers():
+    a = paddle.to_tensor(np.array([[0.0, 0.0], [3.0, 4.0]], np.float32))
+    np.testing.assert_allclose(paddle.cdist(a, a).numpy(),
+                               [[0, 5], [5, 0]], atol=1e-5)
+    np.testing.assert_allclose(paddle.pdist(a).numpy(), [5.0], atol=1e-5)
+    s = paddle.add_n([a, a, a])
+    np.testing.assert_allclose(s.numpy(), a.numpy() * 3)
+
+
+def test_add_n_keeps_grads():
+    a = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    b = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    paddle.add_n([a, b]).sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), 1.0)
+    np.testing.assert_allclose(b.grad.numpy(), 1.0)
+
+
+def test_param_attr_and_create_parameter():
+    import paddle_tpu.nn.initializer as I
+
+    p = paddle.create_parameter(
+        [4, 4], "float32",
+        attr=paddle.ParamAttr(name="w", initializer=I.Constant(2.0)))
+    assert isinstance(p, paddle.Parameter)
+    np.testing.assert_allclose(p.numpy(), 2.0)
+    assert not p.stop_gradient
+    frozen = paddle.create_parameter(
+        [2], "float32", attr=paddle.ParamAttr(trainable=False))
+    assert frozen.stop_gradient
+
+
+def test_batch_reader_decorator():
+    def reader():
+        yield from range(7)
+
+    batches = list(paddle.batch(reader, 3)())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+    assert list(paddle.batch(reader, 3, drop_last=True)()) == [
+        [0, 1, 2], [3, 4, 5]]
+
+
+def test_finfo_iinfo_and_static_mode():
+    assert paddle.finfo("float16").max == 65504.0
+    assert paddle.iinfo("int8").max == 127
+    assert paddle.in_dynamic_mode()
+    paddle.enable_static()
+    try:
+        assert not paddle.in_dynamic_mode()
+    finally:
+        paddle.disable_static()
+    assert paddle.in_dynamic_mode()
+
+
+def test_numpy_alikes_propagate_grads():
+    """Review finding: helpers must ride the dispatcher so autograd
+    records (no silent grad drops through tensordot/hstack/splits)."""
+    a = paddle.to_tensor(np.ones((2, 2), np.float32), stop_gradient=False)
+    paddle.tensordot(a, a).backward()
+    assert a.grad is not None and np.abs(a.grad.numpy()).sum() > 0
+    b = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    paddle.hstack([b, b]).sum().backward()
+    np.testing.assert_allclose(b.grad.numpy(), 2.0)
+    c = paddle.to_tensor(np.ones((2, 2), np.float32), stop_gradient=False)
+    p0, p1 = paddle.tensor_split(c, 2)
+    (p0.sum() + p1.sum() * 3).backward()
+    assert set(np.unique(c.grad.numpy())) == {1.0, 3.0}
+
+
+def test_where_and_random_fills_target_x():
+    cond = paddle.to_tensor(np.array([True, False]))
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    z = paddle.to_tensor(np.array([9.0, 9.0], np.float32))
+    assert paddle.where_(cond, x, z) is x
+    np.testing.assert_allclose(x.numpy(), [1.0, 9.0])
+    assert str(cond.dtype) == "bool"          # condition untouched
+    t = paddle.to_tensor(np.zeros(500, np.float32))
+    paddle.bernoulli_(t, 0.8)
+    assert 0.65 < float(t.numpy().mean()) < 0.95
+
+
+def test_comparison_inplace_guards_dtype():
+    f = paddle.to_tensor(np.array([1.5], np.float32))
+    with pytest.raises(TypeError):
+        paddle.equal_(f, f)     # bool result must not flip float dtype
+
+
+def test_randint_like_follows_x_dtype():
+    r = paddle.randint_like(
+        paddle.to_tensor(np.zeros(4, np.float32)), 0, 10)
+    assert "float32" in str(r.dtype)
