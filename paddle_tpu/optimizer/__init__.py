@@ -8,3 +8,6 @@ from paddle_tpu.optimizer.optimizer import (  # noqa: F401
     SGD, Adagrad, Adam, AdamW, ExponentialMovingAverage, Lamb, LookAhead,
     Momentum, Optimizer, RMSProp,
 )
+from paddle_tpu.optimizer.extra import (  # noqa: F401,E402
+    ASGD, Adadelta, Adamax, LBFGS, NAdam, RAdam, Rprop,
+)

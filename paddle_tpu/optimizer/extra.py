@@ -1,0 +1,291 @@
+"""Remaining reference optimizers: Adadelta / Adamax / NAdam / RAdam /
+Rprop / ASGD / LBFGS.
+
+Reference: python/paddle/optimizer/{adadelta,adamax,nadam,radam,rprop,
+asgd,lbfgs}.py — same update rules, expressed as pure
+`_update(p, g, state, lr, wd, step)` over jax arrays so every one of
+them composes with the eager engine AND the fused TrainStep functional
+path (optimizer.py Optimizer base). LBFGS is the exception everywhere
+(closure-driven, history on host), matching the reference's special
+`step(closure)` contract."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.optimizer.optimizer import Optimizer
+
+
+class Adadelta(Optimizer):
+    """Reference optimizer/adadelta.py (Zeiler 2012)."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps = epsilon
+        self._rho = rho
+
+    def _init_state(self, value):
+        return {"avg_sq": jnp.zeros(value.shape, jnp.float32),
+                "avg_dx": jnp.zeros(value.shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd, step):
+        g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+        avg_sq = self._rho * state["avg_sq"] + (1 - self._rho) * g * g
+        dx = (jnp.sqrt(state["avg_dx"] + self._eps)
+              / jnp.sqrt(avg_sq + self._eps)) * g
+        avg_dx = self._rho * state["avg_dx"] + (1 - self._rho) * dx * dx
+        new_p = p.astype(jnp.float32) - lr * dx
+        return new_p.astype(p.dtype), {"avg_sq": avg_sq, "avg_dx": avg_dx}
+
+
+class Adamax(Optimizer):
+    """Reference optimizer/adamax.py (Adam with infinity norm)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, value):
+        return {"m": jnp.zeros(value.shape, jnp.float32),
+                "u": jnp.zeros(value.shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd, step):
+        g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+        t = step          # already 1-based (optimizer.py:81, TrainStep)
+        m = self._b1 * state["m"] + (1 - self._b1) * g
+        u = jnp.maximum(self._b2 * state["u"], jnp.abs(g))
+        new_p = (p.astype(jnp.float32)
+                 - lr / (1 - self._b1 ** t) * m / (u + self._eps))
+        return new_p.astype(p.dtype), {"m": m, "u": u}
+
+
+class NAdam(Optimizer):
+    """Reference optimizer/nadam.py (Adam + Nesterov momentum,
+    Dozat 2016), momentum_decay schedule included."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+        self._md = momentum_decay
+
+    def _init_state(self, value):
+        return {"m": jnp.zeros(value.shape, jnp.float32),
+                "v": jnp.zeros(value.shape, jnp.float32),
+                "mu_prod": jnp.ones((), jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd, step):
+        g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+        t = step          # already 1-based
+        mu_t = self._b1 * (1 - 0.5 * 0.96 ** (t * self._md))
+        mu_next = self._b1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._md))
+        mu_prod = state["mu_prod"] * mu_t
+        m = self._b1 * state["m"] + (1 - self._b1) * g
+        v = self._b2 * state["v"] + (1 - self._b2) * g * g
+        m_hat = (mu_next * m / (1 - mu_prod * mu_next)
+                 + (1 - mu_t) * g / (1 - mu_prod))
+        v_hat = v / (1 - self._b2 ** t)
+        new_p = (p.astype(jnp.float32)
+                 - lr * m_hat / (jnp.sqrt(v_hat) + self._eps))
+        return new_p.astype(p.dtype), {"m": m, "v": v, "mu_prod": mu_prod}
+
+
+class RAdam(Optimizer):
+    """Reference optimizer/radam.py (rectified Adam, Liu et al. 2020):
+    variance rectification gates between adaptive and plain momentum
+    updates — jnp.where keeps it one compiled program."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, value):
+        return {"m": jnp.zeros(value.shape, jnp.float32),
+                "v": jnp.zeros(value.shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd, step):
+        g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+        t = step          # already 1-based
+        b2t = self._b2 ** t
+        m = self._b1 * state["m"] + (1 - self._b1) * g
+        v = self._b2 * state["v"] + (1 - self._b2) * g * g
+        m_hat = m / (1 - self._b1 ** t)
+        rho_inf = 2.0 / (1 - self._b2) - 1.0
+        rho_t = rho_inf - 2.0 * t * b2t / (1 - b2t)
+        r_num = (rho_t - 4.0) * (rho_t - 2.0) * rho_inf
+        r_den = (rho_inf - 4.0) * (rho_inf - 2.0) * rho_t
+        r_t = jnp.sqrt(jnp.maximum(r_num / jnp.maximum(r_den, 1e-30), 0.0))
+        v_hat = jnp.sqrt(v / (1 - b2t)) + self._eps
+        adaptive = lr * r_t * m_hat / v_hat
+        plain = lr * m_hat
+        new_p = p.astype(jnp.float32) - jnp.where(rho_t > 5.0, adaptive,
+                                                  plain)
+        return new_p.astype(p.dtype), {"m": m, "v": v}
+
+
+class Rprop(Optimizer):
+    """Reference optimizer/rprop.py (resilient backprop): per-element
+    step sizes grown/shrunk by gradient sign agreement; gradients are
+    only consulted for their sign."""
+
+    def __init__(self, learning_rate=0.001,
+                 learning_rate_range=(1e-5, 50.0), etas=(0.5, 1.2),
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_minus, self._eta_plus = etas
+
+    def _init_state(self, value):
+        return {"prev_g": jnp.zeros(value.shape, jnp.float32),
+                "step_size": jnp.full(value.shape, self.get_lr(),
+                                      jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd, step):
+        g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+        sign = jnp.sign(g * state["prev_g"])
+        factor = jnp.where(sign > 0, self._eta_plus,
+                           jnp.where(sign < 0, self._eta_minus, 1.0))
+        step_size = jnp.clip(state["step_size"] * factor, self._lr_min,
+                             self._lr_max)
+        # on a sign flip the reference zeroes the gradient for this step
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        new_p = p.astype(jnp.float32) - jnp.sign(g_eff) * step_size
+        return new_p.astype(p.dtype), {"prev_g": g_eff,
+                                       "step_size": step_size}
+
+
+class ASGD(Optimizer):
+    """Reference optimizer/asgd.py (averaged SGD, Polyak-Ruppert): the
+    running parameter average rides the state; `averaged_value(p)` (or
+    the 'ax' state leaf in the functional path) is the deployment
+    weight."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, t0=0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._t0 = t0
+
+    def _init_state(self, value):
+        # explicit copy: the functional TrainStep donates param buffers,
+        # and a state leaf aliasing the param would be donated twice
+        return {"ax": jnp.array(value, dtype=jnp.float32, copy=True)}
+
+    def _update(self, p, g, state, lr, wd, step):
+        g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+        t = step          # already 1-based
+        new_p = p.astype(jnp.float32) - lr * g
+        mu = 1.0 / jnp.maximum(1, t - self._t0)
+        ax = state["ax"] + mu * (new_p - state["ax"])
+        return new_p.astype(p.dtype), {"ax": ax}
+
+    def averaged_value(self, p):
+        """The Polyak average for parameter p (falls back to p when no
+        step has run)."""
+        st = self._accumulators.get(id(p))
+        # copy: TrainStep donates accumulator buffers on the next step
+        # (same convention as Optimizer.state_dict)
+        return jnp.copy(st["ax"]) if st else jnp.copy(p._value)
+
+
+class LBFGS(Optimizer):
+    """Reference optimizer/lbfgs.py — closure-driven limited-memory BFGS
+    with history-based two-loop recursion. Host-side by design (the
+    reference's is too): each step re-evaluates the closure, so it does
+    not ride the fused TrainStep path."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, tolerance_grad=1e-7,
+                 tolerance_change=1e-9, history_size=100, line_search_fn=None,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        if grad_clip is not None:
+            raise NotImplementedError(
+                "LBFGS does not support grad_clip (the closure owns the "
+                "gradient computation)")
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._max_iter = max_iter
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._hist = history_size
+        self._s: list = []
+        self._y: list = []
+        self._prev_flat = None
+        self._prev_grad = None
+
+    def _flat(self):
+        return jnp.concatenate(
+            [jnp.ravel(p._value).astype(jnp.float32)
+             for p in self._parameter_list])
+
+    def _flat_grad(self):
+        wd = self._weight_decay
+        return jnp.concatenate(
+            [jnp.ravel((p.grad._value if p.grad is not None
+                        else jnp.zeros(p._value.shape))
+                       + wd * p._value).astype(jnp.float32)
+             for p in self._parameter_list])
+
+    def _write_back(self, flat):
+        i = 0
+        for p in self._parameter_list:
+            n = int(np.prod(p._value.shape)) if p._value.shape else 1
+            chunk = flat[i:i + n].reshape(p._value.shape)
+            p._inplace_update(chunk.astype(p._value.dtype))
+            i += n
+
+    def _direction(self, grad):
+        q = grad
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / jnp.maximum(jnp.vdot(y, s), 1e-10)
+            a = rho * jnp.vdot(s, q)
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        if self._s:
+            s, y = self._s[-1], self._y[-1]
+            gamma = jnp.vdot(s, y) / jnp.maximum(jnp.vdot(y, y), 1e-10)
+            q = q * gamma
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.vdot(y, q)
+            q = q + s * (a - b)
+        return -q
+
+    def step(self, closure):
+        """closure() -> loss Tensor; must zero grads, recompute the loss
+        and call backward (the reference contract)."""
+        loss = closure()
+        for _ in range(self._max_iter):
+            flat = self._flat()
+            grad = self._flat_grad()
+            if float(jnp.max(jnp.abs(grad))) <= self._tol_grad:
+                break
+            if self._prev_flat is not None:
+                s = flat - self._prev_flat
+                y = grad - self._prev_grad
+                if float(jnp.vdot(s, y)) > 1e-10:
+                    self._s.append(s)
+                    self._y.append(y)
+                    if len(self._s) > self._hist:
+                        self._s.pop(0)
+                        self._y.pop(0)
+            d = self._direction(grad)
+            self._prev_flat, self._prev_grad = flat, grad
+            t = self.get_lr()
+            self._write_back(flat + t * d)
+            new_loss = closure()
+            if abs(float(new_loss) - float(loss)) < self._tol_change:
+                loss = new_loss
+                break
+            loss = new_loss
+        self._step_count += 1
+        return loss

@@ -1,0 +1,90 @@
+"""Remaining reference optimizers (adadelta/adamax/nadam/radam/rprop/
+asgd/lbfgs.py): each must descend a quadratic, keep finite state, and —
+except closure-driven LBFGS — compose with the fused TrainStep path."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+OPTS = ["Adadelta", "Adamax", "NAdam", "RAdam", "Rprop", "ASGD"]
+
+
+def _quadratic_problem(seed=0):
+    rng = np.random.default_rng(seed)
+    target = rng.standard_normal((8, 1)).astype(np.float32)
+    X = rng.standard_normal((64, 8)).astype(np.float32)
+    Y = X @ target
+    return X, Y
+
+
+@pytest.mark.parametrize("name", OPTS)
+def test_eager_descent(name):
+    paddle.seed(0)
+    X, Y = _quadratic_problem()
+    net = nn.Linear(8, 1)
+    lr = {"Adadelta": 1.0, "Rprop": 0.01}.get(name, 0.05)
+    iters = 200 if name == "Adadelta" else 60   # adadelta warms up slowly
+    opt = getattr(paddle.optimizer, name)(
+        learning_rate=lr, parameters=net.parameters())
+    losses = []
+    for _ in range(iters):
+        loss = ((net(paddle.to_tensor(X)) - paddle.to_tensor(Y)) ** 2
+                ).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, (name, losses[0], losses[-1])
+    assert np.isfinite(losses).all()
+
+
+@pytest.mark.parametrize("name", OPTS)
+def test_trainstep_functional_path(name):
+    paddle.seed(1)
+    X, Y = _quadratic_problem(1)
+    net = nn.Linear(8, 1)
+    lr = {"Adadelta": 1.0, "Rprop": 0.01}.get(name, 0.05)
+    opt = getattr(paddle.optimizer, name)(
+        learning_rate=lr, parameters=net.parameters())
+    step = paddle.jit.TrainStep(
+        net, lambda out, y: ((out - y) ** 2).mean(), opt)
+    iters = 200 if name == "Adadelta" else 40
+    losses = [float(step(paddle.to_tensor(X), paddle.to_tensor(Y)))
+              for _ in range(iters)]
+    assert losses[-1] < losses[0] * 0.5, (name, losses[0], losses[-1])
+
+
+def test_asgd_average_tracks():
+    paddle.seed(2)
+    net = nn.Linear(2, 1)
+    opt = paddle.optimizer.ASGD(learning_rate=0.1,
+                                parameters=net.parameters())
+    x = paddle.to_tensor(np.ones((4, 2), np.float32))
+    for _ in range(5):
+        ((net(x) - 1.0) ** 2).mean().backward()
+        opt.step()
+        opt.clear_grad()
+    ax = opt.averaged_value(net.weight)
+    assert np.isfinite(np.asarray(ax)).all()
+
+
+def test_lbfgs_converges_on_quadratic():
+    paddle.seed(3)
+    X, Y = _quadratic_problem(3)
+    net = nn.Linear(8, 1)
+    opt = paddle.optimizer.LBFGS(learning_rate=0.5, max_iter=10,
+                                 parameters=net.parameters())
+
+    def closure():
+        opt.clear_grad()
+        loss = ((net(paddle.to_tensor(X)) - paddle.to_tensor(Y)) ** 2
+                ).mean()
+        loss.backward()
+        return loss
+
+    first = float(closure())
+    for _ in range(5):
+        loss = opt.step(closure)
+    assert float(loss) < first * 0.05, (first, float(loss))
