@@ -40,3 +40,13 @@ from paddle_tpu.nn.loss import (  # noqa: F401,E402
     TripletMarginWithDistanceLoss,
 )
 from paddle_tpu.nn import utils  # noqa: F401,E402
+from paddle_tpu.nn.layers_batch5 import (  # noqa: F401,E402
+    AdaptiveLogSoftmaxWithLoss, BeamSearchDecoder, BiRNN,
+    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+    FeatureAlphaDropout, FractionalMaxPool2D, FractionalMaxPool3D, GRUCell,
+    InstanceNorm1D, InstanceNorm3D, LPPool1D, LPPool2D, LSTMCell,
+    LocalResponseNorm, LogSigmoid, MaxUnPool1D, Maxout, ParameterDict,
+    RNN, RNNCellBase, RNNTLoss, RReLU, SimpleRNNCell, Softmax2D,
+    ThresholdedReLU, Transformer, Unflatten, ZeroPad1D, ZeroPad3D,
+    dynamic_decode,
+)

@@ -1,0 +1,918 @@
+"""nn surface completion (round 5): the remaining reference layer names.
+
+Reference: python/paddle/nn/__init__.py __all__ minus what earlier rounds
+built — activations (LogSigmoid/ThresholdedReLU/RReLU/Maxout/Softmax2D),
+pads (ZeroPad1D/3D), norms (InstanceNorm1D/3D, LocalResponseNorm), pools
+(LPPool1D/2D, FractionalMaxPool2D/3D, MaxUnPool1D), dropout
+(FeatureAlphaDropout), containers (ParameterDict), shapes (Unflatten),
+grad-clip re-exports, RNN cells (RNNCellBase/SimpleRNNCell/LSTMCell/
+GRUCell) with the generic RNN/BiRNN wrappers, the full Transformer, the
+seq2seq decode stack (BeamSearchDecoder + dynamic_decode), and the
+RNNTLoss / AdaptiveLogSoftmaxWithLoss losses.
+
+TPU notes: pooling variants express through reduce_window-backed avg/max
+pools already in functional; fractional pooling builds its pseudo-random
+index sequences host-side per call (eager path) from the framework RNG;
+dynamic_decode is a host loop over compiled steps (same shape discipline
+as models/generation.py)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.ops.registry import C_OPS as _C
+
+# grad clips live with the optimizers; the reference ALSO exports them
+# from paddle.nn
+from paddle_tpu.optimizer import (  # noqa: F401
+    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+)
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# ------------------------------------------------------------ activations
+
+class LogSigmoid(Layer):
+    def forward(self, x):
+        return Tensor._wrap(jax.nn.log_sigmoid(_val(x)))
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold=1.0):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        v = _val(x)
+        return Tensor._wrap(jnp.where(v > self.threshold, v, 0.0))
+
+
+class RReLU(Layer):
+    """Randomized leaky ReLU: slope ~ U[lower, upper] in training, the
+    midpoint in eval (reference nn/layer/activation.py RReLU)."""
+
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        v = _val(x)
+        if self.training:
+            from paddle_tpu.core.random import default_generator
+
+            a = jax.random.uniform(default_generator.next_key(), v.shape,
+                                   jnp.float32, self.lower, self.upper)
+        else:
+            a = (self.lower + self.upper) / 2.0
+        return Tensor._wrap(jnp.where(v >= 0, v, a * v).astype(v.dtype))
+
+
+class Maxout(Layer):
+    """Max over `groups` channel slices (reference Maxout; NCHW)."""
+
+    def __init__(self, groups, axis=1):
+        super().__init__()
+        self.groups, self.axis = groups, axis
+
+    def forward(self, x):
+        v = _val(x)
+        c = v.shape[self.axis]
+        assert c % self.groups == 0
+        new = (v.shape[:self.axis] + (c // self.groups, self.groups)
+               + v.shape[self.axis + 1:])
+        return Tensor._wrap(jnp.max(v.reshape(new), axis=self.axis + 1))
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW inputs."""
+
+    def forward(self, x):
+        return Tensor._wrap(jax.nn.softmax(_val(x), axis=-3))
+
+
+# ------------------------------------------------------------ shape / pad
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape_ = axis, tuple(shape)
+
+    def forward(self, x):
+        from paddle_tpu.extras import unflatten
+
+        return unflatten(x, self.axis, self.shape_)
+
+
+class ZeroPad1D(Layer):
+    """[N, C, L] constant-zero pad on the last dim."""
+
+    def __init__(self, padding, data_format="NCL"):
+        super().__init__()
+        p = padding if isinstance(padding, (list, tuple)) else (padding,) * 2
+        self.pad = tuple(p)
+
+    def forward(self, x):
+        v = _val(x)
+        cfg = [(0, 0)] * (v.ndim - 1) + [self.pad]
+        return Tensor._wrap(jnp.pad(v, cfg))
+
+
+class ZeroPad3D(Layer):
+    """[N, C, D, H, W] constant-zero pad on the last three dims
+    (paddle order: left, right, top, bottom, front, back)."""
+
+    def __init__(self, padding, data_format="NCDHW"):
+        super().__init__()
+        p = padding if isinstance(padding, (list, tuple)) \
+            else (padding,) * 6
+        self.pad = tuple(p)
+
+    def forward(self, x):
+        v = _val(x)
+        l, r, t, b, f, k = self.pad
+        cfg = [(0, 0)] * (v.ndim - 3) + [(f, k), (t, b), (l, r)]
+        return Tensor._wrap(jnp.pad(v, cfg))
+
+
+# ------------------------------------------------------------------ norms
+
+class InstanceNorm1D(Layer):
+    """[N, C, L] instance norm (stats over L)."""
+
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__()
+        self._epsilon = epsilon
+        self.scale = None if weight_attr is False else self.create_parameter(
+            [num_features], default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_features], is_bias=True)
+
+    def forward(self, x):
+        v = _val(x)
+        return Tensor._wrap(_instance_norm_nd(v, (2,), self.scale,
+                                              self.bias, self._epsilon))
+
+
+class InstanceNorm3D(Layer):
+    """[N, C, D, H, W] instance norm (stats over D, H, W)."""
+
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__()
+        self._epsilon = epsilon
+        self.scale = None if weight_attr is False else self.create_parameter(
+            [num_features], default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_features], is_bias=True)
+
+    def forward(self, x):
+        v = _val(x)
+        return Tensor._wrap(_instance_norm_nd(v, (2, 3, 4), self.scale,
+                                              self.bias, self._epsilon))
+
+
+def _instance_norm_nd(v, axes, scale, bias, eps):
+    mu = jnp.mean(v, axis=axes, keepdims=True)
+    var = jnp.var(v, axis=axes, keepdims=True)
+    out = (v - mu) * jax.lax.rsqrt(var + eps)
+    cshape = (1, -1) + (1,) * (v.ndim - 2)
+    if scale is not None:
+        out = out * _val(scale).reshape(cshape)
+    if bias is not None:
+        out = out + _val(bias).reshape(cshape)
+    return out.astype(v.dtype)
+
+
+class LocalResponseNorm(Layer):
+    """AlexNet-style cross-channel response normalization (reference
+    nn/functional/norm.py local_response_norm; NCHW)."""
+
+    def __init__(self, size=5, alpha=1e-4, beta=0.75, k=1.0,
+                 data_format="NCHW"):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+
+    def forward(self, x):
+        v = _val(x)
+        sq = jnp.square(v)
+        half = self.size // 2
+        pad = [(0, 0)] * v.ndim
+        pad[1] = (half, self.size - 1 - half)
+        sq = jnp.pad(sq, pad)
+        acc = sum(sq[:, i:i + v.shape[1]] for i in range(self.size))
+        denom = (self.k + self.alpha * acc / self.size) ** self.beta
+        return Tensor._wrap((v / denom).astype(v.dtype))
+
+
+# ----------------------------------------------------------------- pools
+
+class LPPool1D(Layer):
+    """Power-average pool: (sum |x|^p over window)^(1/p) (reference
+    LPPool1D; NCL)."""
+
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL"):
+        super().__init__()
+        self.p = float(norm_type)
+        self.k = kernel_size
+        self.s = stride or kernel_size
+        self.pad = padding
+
+    def forward(self, x):
+        v = _val(x)
+        vp = jnp.abs(v) ** self.p
+        summed = jax.lax.reduce_window(
+            vp, 0.0, jax.lax.add, (1, 1, self.k), (1, 1, self.s),
+            [(0, 0), (0, 0), (self.pad, self.pad)])
+        return Tensor._wrap((summed ** (1.0 / self.p)).astype(v.dtype))
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW"):
+        super().__init__()
+        self.p = float(norm_type)
+        k = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size,) * 2
+        s = stride if stride is not None else k
+        s = s if isinstance(s, (list, tuple)) else (s,) * 2
+        self.k, self.s = tuple(k), tuple(s)
+        self.pad = padding
+
+    def forward(self, x):
+        v = _val(x)
+        vp = jnp.abs(v) ** self.p
+        summed = jax.lax.reduce_window(
+            vp, 0.0, jax.lax.add, (1, 1) + self.k, (1, 1) + self.s,
+            [(0, 0), (0, 0), (self.pad, self.pad), (self.pad, self.pad)])
+        return Tensor._wrap((summed ** (1.0 / self.p)).astype(v.dtype))
+
+
+def _fractional_bounds(in_size, out_size, u):
+    """Paddle/torch fractional pooling index sequence: alpha = in/out,
+    boundary_i = ceil(alpha * (i + u)) with boundary_out = in."""
+    alpha = in_size / out_size
+    idx = np.arange(out_size + 1, dtype=np.float64)
+    b = np.ceil(alpha * (idx + u)).astype(np.int64) - \
+        int(np.ceil(alpha * u) - 1) - 1
+    b[0] = 0
+    b[-1] = in_size
+    return np.clip(b, 0, in_size)
+
+
+class FractionalMaxPool2D(Layer):
+    """Fractional max pooling (Graham 2014; reference
+    FractionalMaxPool2D): pseudo-random pooling regions whose sizes
+    average to a fractional stride. random_u pins the sequence."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.out = (output_size if isinstance(output_size, (list, tuple))
+                    else (output_size,) * 2)
+        self.kernel_size = (tuple(kernel_size)
+                            if isinstance(kernel_size, (list, tuple))
+                            else ((kernel_size,) * 2 if kernel_size
+                                  else None))
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def _u(self):
+        if self.random_u is not None:
+            return float(self.random_u)
+        from paddle_tpu.core.random import default_generator
+
+        return float(jax.random.uniform(default_generator.next_key(), ()))
+
+    def forward(self, x):
+        v = _val(x)
+        H, W = v.shape[-2:]
+        oh, ow = self.out
+        u = self._u()
+        hb = _fractional_bounds(H, oh, u)
+        wb = _fractional_bounds(W, ow, u)
+        kh, kw = self.kernel_size or (None, None)
+        out_rows = []
+        idx_rows = []
+        for i in range(oh):
+            h0 = hb[i]
+            h1 = (min(h0 + kh, H) if kh else max(hb[i + 1], h0 + 1))
+            row_o = []
+            row_i = []
+            for j in range(ow):
+                w0 = wb[j]
+                w1 = (min(w0 + kw, W) if kw else max(wb[j + 1], w0 + 1))
+                win = v[..., h0:h1, w0:w1]
+                flat = win.reshape(win.shape[:-2] + (-1,))
+                row_o.append(jnp.max(flat, -1))
+                arg = jnp.argmax(flat, -1)
+                wy, wx = arg // (w1 - w0), arg % (w1 - w0)
+                row_i.append((h0 + wy) * W + (w0 + wx))
+            out_rows.append(jnp.stack(row_o, -1))
+            idx_rows.append(jnp.stack(row_i, -1))
+        out = jnp.stack(out_rows, -2)
+        if self.return_mask:
+            return (Tensor._wrap(out),
+                    Tensor._wrap(jnp.stack(idx_rows, -2).astype(
+                        jnp.int32)))
+        return Tensor._wrap(out)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        if return_mask:
+            raise NotImplementedError(
+                "FractionalMaxPool3D return_mask not supported")
+        self.out = (output_size if isinstance(output_size, (list, tuple))
+                    else (output_size,) * 3)
+        self.random_u = random_u
+
+    def forward(self, x):
+        v = _val(x)
+        D, H, W = v.shape[-3:]
+        od, oh, ow = self.out
+        u = (float(self.random_u) if self.random_u is not None else
+             FractionalMaxPool2D._u(self))
+        db = _fractional_bounds(D, od, u)
+        hb = _fractional_bounds(H, oh, u)
+        wb = _fractional_bounds(W, ow, u)
+
+        def pool_axis(t, bounds, n, axis):
+            parts = []
+            for i in range(n):
+                sl = [slice(None)] * t.ndim
+                sl[axis] = slice(bounds[i], max(bounds[i + 1],
+                                                bounds[i] + 1))
+                parts.append(jnp.max(t[tuple(sl)], axis=axis,
+                                     keepdims=True))
+            return jnp.concatenate(parts, axis=axis)
+
+        out = pool_axis(v, db, od, v.ndim - 3)
+        out = pool_axis(out, hb, oh, v.ndim - 2)
+        out = pool_axis(out, wb, ow, v.ndim - 1)
+        return Tensor._wrap(out)
+
+
+class MaxUnPool1D(Layer):
+    """[N, C, L] unpool via the 2D kernel on an expanded height-1 grid."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None):
+        super().__init__()
+        self.k, self.s, self.p = kernel_size, stride, padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        x2 = x.unsqueeze(2)
+        i2 = indices.unsqueeze(2)
+        out_size = None
+        if self.output_size is not None:
+            out_size = list(self.output_size)
+            out_size = out_size[:-1] + [1, out_size[-1]]
+        out = _C.unpool(x2, i2, kernel_size=(1, self.k),
+                        stride=(1, self.s or self.k),
+                        padding=(0, self.p), output_size=out_size)
+        return out.squeeze(2)
+
+
+class FeatureAlphaDropout(Layer):
+    """Alpha dropout zeroing WHOLE channels (reference
+    FeatureAlphaDropout): keeps SELU self-normalizing statistics."""
+
+    _ALPHA = 1.6732632423543772
+    _SCALE = 1.0507009873554805
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        v = _val(x)
+        if not self.training or self.p == 0.0:
+            return Tensor._wrap(v)
+        from paddle_tpu.core.random import default_generator
+
+        alpha_p = -self._ALPHA * self._SCALE
+        mask_shape = v.shape[:2] + (1,) * (v.ndim - 2)
+        keep = jax.random.bernoulli(default_generator.next_key(),
+                                    1.0 - self.p, mask_shape)
+        a = (1.0 / math.sqrt((1 - self.p) *
+                             (1 + self.p * alpha_p ** 2))) \
+            if self.p < 1.0 else 0.0
+        b = -a * alpha_p * self.p
+        out = a * jnp.where(keep, v, alpha_p) + b
+        return Tensor._wrap(out.astype(v.dtype))
+
+
+# ------------------------------------------------------------- containers
+
+class ParameterDict(Layer):
+    """Name-keyed parameter container (reference ParameterDict)."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            for k, p in (parameters.items()
+                         if isinstance(parameters, dict) else parameters):
+                self.add_parameter(k, p)
+
+    def __getitem__(self, key):
+        return self._parameters[key]
+
+    def __setitem__(self, key, parameter):
+        self.add_parameter(key, parameter)
+
+    def __contains__(self, key):
+        return key in self._parameters
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def keys(self):
+        return self._parameters.keys()
+
+    def values(self):
+        return self._parameters.values()
+
+    def items(self):
+        return self._parameters.items()
+
+    def update(self, parameters):
+        for k, p in (parameters.items()
+                     if isinstance(parameters, dict) else parameters):
+            self.add_parameter(k, p)
+
+
+# ------------------------------------------------------------- RNN cells
+
+class RNNCellBase(Layer):
+    """Single-step recurrent cell base (reference nn/layer/rnn.py
+    RNNCellBase): subclasses define state_shape and forward(x, state)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        shapes = shape or self.state_shape
+        if isinstance(shapes[0], (list, tuple)):
+            return tuple(
+                Tensor._wrap(jnp.full((batch,) + tuple(s), init_value,
+                                      jnp.float32)) for s in shapes)
+        return Tensor._wrap(jnp.full((batch,) + tuple(shapes), init_value,
+                                     jnp.float32))
+
+
+class SimpleRNNCell(RNNCellBase):
+    """h' = act(W_ih x + b_ih + W_hh h + b_hh) (reference
+    SimpleRNNCell)."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        self.hidden_size = hidden_size
+        self.activation = activation
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([hidden_size],
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([hidden_size],
+                                             default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu as paddle
+
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = states[0] if isinstance(states, (tuple, list)) else states
+        # dispatched ops keep the autograd tape (grads reach the weights)
+        z = (paddle.matmul(inputs, self.weight_ih, transpose_y=True)
+             + self.bias_ih
+             + paddle.matmul(h, self.weight_hh, transpose_y=True)
+             + self.bias_hh)
+        out = paddle.tanh(z) if self.activation == "tanh" else F.relu(z)
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    """Reference LSTMCell (i, f, g, o gate order)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        self.hidden_size = hidden_size
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], default_initializer=u)
+        self.bias_ih = self.create_parameter([4 * hidden_size],
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([4 * hidden_size],
+                                             default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu as paddle
+
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        z = (paddle.matmul(inputs, self.weight_ih, transpose_y=True)
+             + self.bias_ih
+             + paddle.matmul(h, self.weight_hh, transpose_y=True)
+             + self.bias_hh)
+        i, f, g, o = paddle.split(z, 4, axis=-1)
+        c2 = (F.sigmoid(f) * c + F.sigmoid(i) * paddle.tanh(g))
+        h2 = F.sigmoid(o) * paddle.tanh(c2)
+        return h2, (h2, c2)
+
+
+class GRUCell(RNNCellBase):
+    """Reference GRUCell (r, z, c gate order)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        self.hidden_size = hidden_size
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], default_initializer=u)
+        self.bias_ih = self.create_parameter([3 * hidden_size],
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([3 * hidden_size],
+                                             default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu as paddle
+
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = states[0] if isinstance(states, (tuple, list)) else states
+        gi = (paddle.matmul(inputs, self.weight_ih, transpose_y=True)
+              + self.bias_ih)
+        gh = (paddle.matmul(h, self.weight_hh, transpose_y=True)
+              + self.bias_hh)
+        ir, iz, ic = paddle.split(gi, 3, axis=-1)
+        hr, hz, hc = paddle.split(gh, 3, axis=-1)
+        r = F.sigmoid(ir + hr)
+        z = F.sigmoid(iz + hz)
+        c = paddle.tanh(ic + r * hc)
+        h2 = (1.0 - z) * c + z * h
+        return h2, h2
+
+
+class RNN(Layer):
+    """Run any cell over time (reference nn/layer/rnn.py RNN wrapper):
+    inputs [B, T, ...] (or [T, B, ...] time_major)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        v = inputs if self.time_major else inputs.transpose([1, 0, 2])
+        T = v.shape[0]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = [None] * T
+        lens = None
+        if sequence_length is not None:
+            lens = _val(sequence_length)
+        for t in steps:
+            out, new_states = self.cell(v[t], states, **kwargs)
+            if lens is not None:
+                # pad steps: zero output, state carries through untouched
+                # (reverse passes thus start at each sequence's true end)
+                live = (t < lens)[:, None]
+                out = Tensor._wrap(jnp.where(live, _val(out), 0.0))
+                if states is None:
+                    states = new_states  # first step initialized them
+                def _sel(new, old):
+                    return Tensor._wrap(jnp.where(live, _val(new),
+                                                  _val(old)))
+                if isinstance(new_states, (tuple, list)):
+                    new_states = tuple(_sel(n, o) for n, o in
+                                       zip(new_states, states))
+                else:
+                    new_states = _sel(new_states, states)
+            states = new_states
+            outs[t] = out
+        from paddle_tpu import stack
+
+        y = stack(outs, axis=0 if self.time_major else 1)
+        return y, states
+
+
+class BiRNN(Layer):
+    """Forward + backward cells, outputs concatenated (reference
+    BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        y_fw, s_fw = self.rnn_fw(inputs, st_fw,
+                                 sequence_length=sequence_length, **kwargs)
+        y_bw, s_bw = self.rnn_bw(inputs, st_bw,
+                                 sequence_length=sequence_length, **kwargs)
+        from paddle_tpu import concat
+
+        return concat([y_fw, y_bw], axis=-1), (s_fw, s_bw)
+
+
+# ------------------------------------------------------------ Transformer
+
+class Transformer(Layer):
+    """Full encoder-decoder Transformer (reference nn/layer/transformer.py
+    Transformer) composed from the existing TransformerEncoder/Decoder."""
+
+    def __init__(self, d_model=512, nhead=8, num_encoder_layers=6,
+                 num_decoder_layers=6, dim_feedforward=2048, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 custom_encoder=None, custom_decoder=None):
+        super().__init__()
+        from paddle_tpu.nn.transformer import (
+            TransformerDecoder, TransformerDecoderLayer,
+            TransformerEncoder, TransformerEncoderLayer,
+        )
+
+        kw = dict(dropout=dropout, activation=activation,
+                  attn_dropout=attn_dropout, act_dropout=act_dropout,
+                  normalize_before=normalize_before)
+        if custom_encoder is not None:
+            self.encoder = custom_encoder
+        else:
+            enc_layer = TransformerEncoderLayer(
+                d_model, nhead, dim_feedforward, **kw)
+            self.encoder = TransformerEncoder(enc_layer,
+                                              num_encoder_layers)
+        if custom_decoder is not None:
+            self.decoder = custom_decoder
+        else:
+            dec_layer = TransformerDecoderLayer(
+                d_model, nhead, dim_feedforward, **kw)
+            self.decoder = TransformerDecoder(dec_layer,
+                                              num_decoder_layers)
+        self.d_model = d_model
+        self.nhead = nhead
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None,
+                memory_mask=None):
+        memory = self.encoder(src, src_mask)
+        return self.decoder(tgt, memory, tgt_mask, memory_mask)
+
+    @staticmethod
+    def generate_square_subsequent_mask(length):
+        m = jnp.where(jnp.tril(jnp.ones((length, length), bool)), 0.0,
+                      -jnp.inf)
+        return Tensor._wrap(m.astype(jnp.float32))
+
+
+# ------------------------------------------------------- seq2seq decoding
+
+class BeamSearchDecoder(Layer):
+    """Beam-search decoding over a cell (reference nn/decode.py
+    BeamSearchDecoder): per dynamic_decode step keeps the top-k
+    hypotheses per batch by accumulated log-prob."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        super().__init__()
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # states are host-side dicts of jnp arrays (eager decode loop)
+    def initialize(self, inits):
+        """inits: the cell's initial state for batch B (replicated over
+        beams internally)."""
+        some = inits[0] if isinstance(inits, (tuple, list)) else inits
+        B = some.shape[0]
+        K = self.beam_size
+
+        def rep(s):
+            v = _val(s)
+            return jnp.repeat(v, K, axis=0)   # [B*K, ...]
+
+        cell_states = (tuple(Tensor._wrap(rep(s)) for s in inits)
+                       if isinstance(inits, (tuple, list))
+                       else Tensor._wrap(rep(inits)))
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (K - 1), jnp.float32), (B,))
+        tokens = jnp.full((B * K,), self.start_token, jnp.int64)
+        finished = jnp.zeros((B * K,), bool)
+        return {"cell": cell_states, "log_probs": log_probs,
+                "tokens": tokens, "finished": finished, "batch": B}
+
+    def step(self, time, state):
+        B, K = state["batch"], self.beam_size
+        tok = Tensor._wrap(state["tokens"])
+        inp = self.embedding_fn(tok) if self.embedding_fn else tok
+        out, cell_states = self.cell(inp, state["cell"])
+        logits = self.output_fn(out) if self.output_fn else out
+        logp = jax.nn.log_softmax(_val(logits), axis=-1)    # [B*K, V]
+        V = logp.shape[-1]
+        # finished beams only extend with end_token at no cost
+        fin = state["finished"][:, None]
+        mask = jnp.full((1, V), -jnp.inf).at[0, self.end_token].set(0.0)
+        logp = jnp.where(fin, mask, logp)
+        total = state["log_probs"][:, None] + logp          # [B*K, V]
+        total = total.reshape(B, K * V)
+        top_p, top_i = jax.lax.top_k(total, K)              # [B, K]
+        beam_idx = top_i // V + jnp.arange(B)[:, None] * K  # source beam
+        tokens = (top_i % V).reshape(-1).astype(jnp.int64)
+        gather = beam_idx.reshape(-1)
+
+        def g(s):
+            return Tensor._wrap(_val(s)[gather])
+
+        cell_states = (tuple(g(s) for s in cell_states)
+                       if isinstance(cell_states, (tuple, list))
+                       else g(cell_states))
+        finished = state["finished"][gather] | (tokens == self.end_token)
+        return {"cell": cell_states, "log_probs": top_p.reshape(-1),
+                "tokens": tokens, "finished": finished, "batch": B,
+                "parents": (top_i // V)}     # [B, K] source-beam per slot
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=100, **kwargs):
+    """Run a decoder until every beam finishes or max_step_num (reference
+    nn/decode.py dynamic_decode + gather_tree). Returns
+    (token_ids [B, K, T], log_probs [B, K]).
+
+    Beam slots get reordered by every top-k; the final sequences are
+    reconstructed by backtracking each slot through the per-step parent
+    pointers (the reference's gather_tree), so ids[b, k] is ONE coherent
+    hypothesis matching log_probs[b, k]."""
+    state = decoder.initialize(inits)
+    B, K = state["batch"], decoder.beam_size
+    tokens_per_step = []
+    parents_per_step = []
+    for t in range(max_step_num):
+        state = decoder.step(t, state)
+        tokens_per_step.append(state["tokens"].reshape(B, K))
+        parents_per_step.append(state["parents"])
+        if bool(state["finished"].all()):
+            break
+    T = len(tokens_per_step)
+    # gather_tree backtrack: walk parents from the last step's slot order
+    cur = jnp.tile(jnp.arange(K)[None, :], (B, 1))        # [B, K]
+    cols = [None] * T
+    bidx = jnp.arange(B)[:, None]
+    for t in range(T - 1, -1, -1):
+        cols[t] = tokens_per_step[t][bidx, cur]
+        cur = parents_per_step[t][bidx, cur]
+    ids = jnp.stack(cols, axis=-1)                        # [B, K, T]
+    return (Tensor._wrap(ids),
+            Tensor._wrap(state["log_probs"].reshape(B, K)))
+
+
+# ----------------------------------------------------------------- losses
+
+class RNNTLoss(Layer):
+    """Layer over the transducer DP (reference RNNTLoss ->
+    paddle_tpu/text/ops.py rnnt_loss)."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, logits, labels, input_lengths, label_lengths):
+        from paddle_tpu.text.ops import rnnt_loss
+
+        return rnnt_loss(logits, labels, input_lengths, label_lengths,
+                         blank=self.blank,
+                         fasteremit_lambda=self.fastemit_lambda,
+                         reduction=self.reduction)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Adaptive (clustered) softmax (Grave et al. 2017; reference
+    nn/layer/loss.py AdaptiveLogSoftmaxWithLoss): frequent head words get
+    a full projection, tail clusters get down-projected ones."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        assert cutoffs == sorted(cutoffs) and cutoffs[-1] < n_classes
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.n_clusters = len(self.cutoffs) - 1
+        self.head_size = self.cutoffs[0] + self.n_clusters
+        self.head_weight = self.create_parameter(
+            [in_features, self.head_size],
+            default_initializer=I.XavierNormal())
+        self.head_bias_p = (self.create_parameter(
+            [self.head_size], is_bias=True) if head_bias else None)
+        self.tail_w1 = []
+        self.tail_w2 = []
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features / (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            w1 = self.create_parameter([in_features, hsz],
+                                       default_initializer=I.XavierNormal())
+            w2 = self.create_parameter([hsz, osz],
+                                       default_initializer=I.XavierNormal())
+            self.add_parameter(f"tail_{i}_w1", w1)
+            self.add_parameter(f"tail_{i}_w2", w2)
+            self.tail_w1.append(w1)
+            self.tail_w2.append(w2)
+
+    def _head_logp(self, xv):
+        h = xv @ _val(self.head_weight)
+        if self.head_bias_p is not None:
+            h = h + _val(self.head_bias_p)
+        return jax.nn.log_softmax(h, axis=-1)
+
+    def forward(self, input, label):
+        xv = _val(input)
+        yv = _val(label)
+        head_lp = self._head_logp(xv)                  # [N, head_size]
+        logp = jnp.zeros(yv.shape, jnp.float32)
+        in_head = yv < self.cutoffs[0]
+        safe_head = jnp.clip(yv, 0, self.cutoffs[0] - 1)
+        logp = jnp.where(
+            in_head,
+            jnp.take_along_axis(head_lp, safe_head[:, None], 1)[:, 0],
+            logp)
+        for i in range(self.n_clusters):
+            lo, hi = self.cutoffs[i], self.cutoffs[i + 1]
+            in_c = (yv >= lo) & (yv < hi)
+            tail_lp = jax.nn.log_softmax(
+                (xv @ _val(self.tail_w1[i])) @ _val(self.tail_w2[i]),
+                axis=-1)                               # [N, hi-lo]
+            rel = jnp.clip(yv - lo, 0, hi - lo - 1)
+            cluster_lp = head_lp[:, self.cutoffs[0] + i]
+            word_lp = jnp.take_along_axis(tail_lp, rel[:, None], 1)[:, 0]
+            logp = jnp.where(in_c, cluster_lp + word_lp, logp)
+        loss = -logp.mean()
+        return Tensor._wrap(logp), Tensor._wrap(loss)
+
+    def log_prob(self, input):
+        """Full [N, n_classes] log-probabilities."""
+        xv = _val(input)
+        head_lp = self._head_logp(xv)
+        parts = [head_lp[:, :self.cutoffs[0]]]
+        for i in range(self.n_clusters):
+            tail_lp = jax.nn.log_softmax(
+                (xv @ _val(self.tail_w1[i])) @ _val(self.tail_w2[i]),
+                axis=-1)
+            parts.append(head_lp[:, self.cutoffs[0] + i][:, None]
+                         + tail_lp)
+        return Tensor._wrap(jnp.concatenate(parts, axis=-1))
+
+    def predict(self, input):
+        return Tensor._wrap(jnp.argmax(_val(self.log_prob(input)),
+                                       axis=-1))

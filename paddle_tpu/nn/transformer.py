@@ -105,11 +105,13 @@ class TransformerEncoder(Layer):
 
 class TransformerDecoderLayer(Layer):
     def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
-                 activation="relu", normalize_before=False):
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None):
         super().__init__()
         self.normalize_before = normalize_before
-        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=dropout)
-        self.cross_attn = MultiHeadAttention(d_model, nhead, dropout=dropout)
+        ad = attn_dropout if attn_dropout is not None else dropout
+        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=ad)
+        self.cross_attn = MultiHeadAttention(d_model, nhead, dropout=ad)
         self.linear1 = Linear(d_model, dim_feedforward)
         self.linear2 = Linear(dim_feedforward, d_model)
         self.norm1 = LayerNorm(d_model)

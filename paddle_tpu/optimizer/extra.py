@@ -175,19 +175,34 @@ class ASGD(Optimizer):
                  weight_decay=None, grad_clip=None, t0=0, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
         self._t0 = t0
+        self._n = int(batch_num)
 
     def _init_state(self, value):
         # explicit copy: the functional TrainStep donates param buffers,
         # and a state leaf aliasing the param would be donated twice
-        return {"ax": jnp.array(value, dtype=jnp.float32, copy=True)}
+        st = {"ax": jnp.array(value, dtype=jnp.float32, copy=True)}
+        if self._n > 1:
+            # rolling window of the last batch_num gradients (reference
+            # asgd.py: the applied gradient is their average)
+            st["hist"] = jnp.zeros((self._n,) + tuple(value.shape),
+                                   jnp.float32)
+            st["dsum"] = jnp.zeros(value.shape, jnp.float32)
+        return st
 
     def _update(self, p, g, state, lr, wd, step):
         g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
         t = step          # already 1-based
+        new_state = {}
+        if self._n > 1:
+            slot = (t - 1) % self._n
+            dsum = state["dsum"] - state["hist"][slot] + g
+            new_state["hist"] = state["hist"].at[slot].set(g)
+            new_state["dsum"] = dsum
+            g = dsum / jnp.minimum(t, self._n)
         new_p = p.astype(jnp.float32) - lr * g
         mu = 1.0 / jnp.maximum(1, t - self._t0)
-        ax = state["ax"] + mu * (new_p - state["ax"])
-        return new_p.astype(p.dtype), {"ax": ax}
+        new_state["ax"] = state["ax"] + mu * (new_p - state["ax"])
+        return new_p.astype(p.dtype), new_state
 
     def averaged_value(self, p):
         """The Polyak average for parameter p (falls back to p when no
@@ -212,6 +227,11 @@ class LBFGS(Optimizer):
             raise NotImplementedError(
                 "LBFGS does not support grad_clip (the closure owns the "
                 "gradient computation)")
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError(
+                f"unknown line_search_fn {line_search_fn!r} "
+                "(None or 'strong_wolfe')")
+        self._line_search = line_search_fn
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
         self._max_iter = max_iter
         self._tol_grad = tolerance_grad
@@ -260,6 +280,24 @@ class LBFGS(Optimizer):
             q = q + s * (a - b)
         return -q
 
+    def _wolfe_t(self, closure, flat, d, grad, f0, t):
+        """Backtracking line search with Armijo sufficient decrease +
+        (weak) Wolfe curvature (reference lbfgs.py _strong_wolfe,
+        simplified to backtracking: each trial costs one closure)."""
+        c1, c2 = 1e-4, 0.9
+        gd0 = float(jnp.vdot(grad, d))
+        for _ in range(10):
+            self._write_back(flat + t * d)
+            f_t = float(closure())
+            g_t = self._flat_grad()
+            armijo = f_t <= f0 + c1 * t * gd0
+            wolfe = abs(float(jnp.vdot(g_t, d))) <= c2 * abs(gd0)
+            if armijo and wolfe:
+                break
+            t *= 0.5
+        self._write_back(flat)    # caller applies the final step itself
+        return t
+
     def step(self, closure):
         """closure() -> loss Tensor; must zero grads, recompute the loss
         and call backward (the reference contract)."""
@@ -281,6 +319,8 @@ class LBFGS(Optimizer):
             d = self._direction(grad)
             self._prev_flat, self._prev_grad = flat, grad
             t = self.get_lr()
+            if self._line_search == "strong_wolfe":
+                t = self._wolfe_t(closure, flat, d, grad, float(loss), t)
             self._write_back(flat + t * d)
             new_loss = closure()
             if abs(float(new_loss) - float(loss)) < self._tol_change:
