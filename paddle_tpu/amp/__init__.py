@@ -159,3 +159,15 @@ class GradScaler:
         self._scale = state["scale"]
         self._good_steps = state["good_steps"]
         self._bad_steps = state["bad_steps"]
+
+
+def is_float16_supported(device=None):
+    """Reference amp/__init__.py: fp16 support probe. TPUs prefer
+    bfloat16; XLA still executes fp16 math (CPU too), so this reports
+    True while bf16 remains the recommended dtype."""
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    """bf16 is the TPU-native AMP dtype (MXU operates on it directly)."""
+    return True

@@ -465,3 +465,302 @@ from paddle_tpu.distribution.transform import (  # noqa: F401,E402
     SigmoidTransform, SoftmaxTransform, StackTransform,
     StickBreakingTransform, TanhTransform, Transform,
 )
+
+
+# -------------------------------------------------- round-5 distributions
+# (reference python/paddle/distribution/{binomial,cauchy,chi2,
+#  continuous_bernoulli,exponential_family,lkj_cholesky,
+#  multivariate_normal,student_t}.py)
+
+
+class ExponentialFamily(Distribution):
+    """Base for natural-parameter families (reference
+    exponential_family.py): entropy via the Bregman identity when a
+    subclass provides _natural_parameters / _log_normalizer."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+
+class Binomial(ExponentialFamily):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _t(total_count)
+        self.probs = _t(probs)
+        super().__init__(_bshape(self.total_count, self.probs))
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        full = tuple(shape) + self._batch_shape
+        n = jnp.broadcast_to(self.total_count._value, full)
+        p = jnp.broadcast_to(self.probs._value, full)
+        out = jax.random.binomial(_key(), n.astype(jnp.float32),
+                                  p.astype(jnp.float32), full)
+        return Tensor._wrap(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _t(value)
+        n, p = self.total_count, self.probs
+        comb = (_C.lgamma(n + 1.0) - _C.lgamma(v + 1.0)
+                - _C.lgamma(n - v + 1.0))
+        eps = 1e-7
+        return (comb + v * _C.log(p + eps)
+                + (n - v) * _C.log(1.0 - p + eps))
+
+    def entropy(self):
+        # series entropy over the support (exact for moderate n)
+        n = int(np.max(np.asarray(self.total_count._value)))
+        ks = jnp.arange(n + 1, dtype=jnp.float32)
+        nn = self.total_count._value[..., None]
+        pp = self.probs._value[..., None]
+        logpmf = (jax.scipy.special.gammaln(nn + 1)
+                  - jax.scipy.special.gammaln(ks + 1)
+                  - jax.scipy.special.gammaln(nn - ks + 1)
+                  + ks * jnp.log(pp + 1e-12)
+                  + (nn - ks) * jnp.log(1 - pp + 1e-12))
+        valid = ks <= nn
+        pmf = jnp.where(valid, jnp.exp(logpmf), 0.0)
+        ent = -jnp.sum(pmf * jnp.where(valid, logpmf, 0.0), -1)
+        return Tensor._wrap(ent)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy has no variance")
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        full = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(_key(), full, minval=1e-6,
+                               maxval=1.0 - 1e-6)
+        eps = Tensor._wrap(jnp.tan(jnp.pi * (u - 0.5)))
+        return self.loc + self.scale * eps
+
+    def log_prob(self, value):
+        v = _t(value)
+        z = (v - self.loc) / self.scale
+        return (-math.log(math.pi) - _C.log(self.scale)
+                - _C.log(1.0 + _C.square(z)))
+
+    def cdf(self, value):
+        v = _t(value)
+        z = (v - self.loc) / self.scale
+        return _C.atan(z) / math.pi + 0.5
+
+    def entropy(self):
+        return _C.log(self.scale * 4.0) + math.log(math.pi)
+
+
+class Chi2(Gamma):
+    """Chi-squared = Gamma(df/2, 1/2) (reference chi2.py)."""
+
+    def __init__(self, df, name=None):
+        self.df = _t(df)
+        super().__init__(self.df * 0.5, _t(0.5))
+
+
+class ContinuousBernoulli(ExponentialFamily):
+    """Reference continuous_bernoulli.py (Loaiza-Ganem & Cunningham
+    2019): CB(probs) on [0, 1] with the log-normalizing constant."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _t(probs)
+        self._lims = lims
+        super().__init__(_bshape(self.probs))
+
+    def _outside(self):
+        p = self.probs._value
+        return (p < self._lims[0]) | (p > self._lims[1])
+
+    def _log_norm_const(self):
+        p = jnp.clip(self.probs._value, 1e-6, 1 - 1e-6)
+        safe = jnp.where(self._outside(), p, 0.4)
+        log_c = jnp.log(
+            (2.0 * jnp.arctanh(1 - 2 * safe)) / (1 - 2 * safe))
+        # Taylor expansion around p = 1/2 (the singularity)
+        x = p - 0.5
+        taylor = math.log(2.0) + (4.0 / 3.0 + 104.0 / 45.0 * x * x) * x * x
+        return jnp.where(self._outside(), log_c, taylor)
+
+    @property
+    def mean(self):
+        p = jnp.clip(self.probs._value, 1e-6, 1 - 1e-6)
+        m = p / (2 * p - 1) + 1.0 / (2 * jnp.arctanh(1 - 2 * p))
+        return Tensor._wrap(jnp.where(self._outside(), m, 0.5))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        full = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(_key(), full, minval=1e-6,
+                               maxval=1.0 - 1e-6)
+        p = jnp.clip(self.probs._value, 1e-6, 1 - 1e-6)
+        out = (jnp.log1p(u * (2 * p - 1) / (1 - p)) /
+               (jnp.log(p) - jnp.log1p(-p)))
+        return Tensor._wrap(jnp.where(self._outside(), out, u))
+
+    def log_prob(self, value):
+        v = _t(value)
+        p = _C.clip(self.probs, 1e-6, 1 - 1e-6)
+        return (v * _C.log(p) + (1.0 - v) * _C.log(1.0 - p)
+                + Tensor._wrap(self._log_norm_const()))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc, scale, name=None):
+        self.df = _t(df)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(_bshape(self.df, self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return _C.broadcast_to(self.loc, self._batch_shape or (1,))
+
+    @property
+    def variance(self):
+        d = self.df._value
+        var = jnp.where(d > 2, d / (d - 2), jnp.inf)
+        return Tensor._wrap(
+            jnp.broadcast_to(var * jnp.square(self.scale._value),
+                             self._batch_shape or (1,)))
+
+    def sample(self, shape=()):
+        full = tuple(shape) + self._batch_shape
+        t = jax.random.t(_key(), self.df._value, full)
+        return self.loc + self.scale * Tensor._wrap(t)
+
+    def log_prob(self, value):
+        v = _t(value)
+        d = self.df
+        z = (v - self.loc) / self.scale
+        return (_C.lgamma((d + 1.0) * 0.5) - _C.lgamma(d * 0.5)
+                - 0.5 * _C.log(d * math.pi) - _C.log(self.scale)
+                - (d + 1.0) * 0.5 * _C.log(1.0 + _C.square(z) / d))
+
+    def entropy(self):
+        d = self.df._value
+        ent = ((d + 1) / 2 * (jax.scipy.special.digamma((d + 1) / 2)
+                              - jax.scipy.special.digamma(d / 2))
+               + 0.5 * jnp.log(d)
+               + jax.scipy.special.betaln(d / 2, 0.5)
+               + jnp.log(self.scale._value))
+        return Tensor._wrap(jnp.broadcast_to(ent,
+                                             self._batch_shape or (1,)))
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 name=None):
+        self.loc = _t(loc)
+        if scale_tril is not None:
+            self._tril = _t(scale_tril)._value
+        else:
+            assert covariance_matrix is not None
+            self._tril = jnp.linalg.cholesky(
+                _t(covariance_matrix)._value)
+        super().__init__(tuple(self.loc._value.shape[:-1]))
+        self._d = self.loc._value.shape[-1]
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        cov = self._tril @ jnp.swapaxes(self._tril, -1, -2)
+        return Tensor._wrap(jnp.diagonal(cov, axis1=-2, axis2=-1))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        full = tuple(shape) + self._batch_shape + (self._d,)
+        eps = jax.random.normal(_key(), full)
+        return self.loc + Tensor._wrap(
+            jnp.einsum("...ij,...j->...i", self._tril, eps))
+
+    def log_prob(self, value):
+        v = _t(value)._value
+        diff = v - self.loc._value
+        sol = jax.scipy.linalg.solve_triangular(self._tril, diff[..., None],
+                                                lower=True)[..., 0]
+        maha = jnp.sum(jnp.square(sol), -1)
+        logdet = jnp.sum(jnp.log(jnp.diagonal(self._tril, axis1=-2,
+                                              axis2=-1)), -1)
+        return Tensor._wrap(-0.5 * (self._d * math.log(2 * math.pi)
+                                    + maha) - logdet)
+
+    def entropy(self):
+        logdet = jnp.sum(jnp.log(jnp.diagonal(self._tril, axis1=-2,
+                                              axis2=-1)), -1)
+        return Tensor._wrap(0.5 * self._d * (1 + math.log(2 * math.pi))
+                            + logdet)
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over correlation-matrix Cholesky factors (reference
+    lkj_cholesky.py; onion-method sampling)."""
+
+    def __init__(self, dim, concentration=1.0,
+                 sample_method="onion", name=None):
+        self.dim = int(dim)
+        self.concentration = _t(concentration)
+        super().__init__(tuple(self.concentration._value.shape))
+
+    def sample(self, shape=()):
+        d = self.dim
+        eta = self.concentration._value
+        full = tuple(shape) + self._batch_shape
+        # onion method: build row by row with Beta-distributed radii
+        L = jnp.zeros(full + (d, d)).at[..., 0, 0].set(1.0)
+        for i in range(1, d):
+            beta_a = eta + (d - 1 - i) / 2.0
+            r2 = jax.random.beta(_key(), i / 2.0, beta_a, full)
+            u = jax.random.normal(_key(), full + (i,))
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            w = jnp.sqrt(r2)[..., None] * u
+            L = L.at[..., i, :i].set(w)
+            L = L.at[..., i, i].set(jnp.sqrt(jnp.clip(1.0 - r2, 1e-12)))
+        return Tensor._wrap(L)
+
+    def log_prob(self, value):
+        L = _t(value)._value
+        eta = self.concentration._value
+        d = self.dim
+        order = jnp.arange(2, d + 1, dtype=jnp.float32)
+        exps = 2.0 * (eta - 1.0) + d - order
+        diags = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+        unnorm = jnp.sum(exps * jnp.log(diags), -1)
+        # normalization (reference lkj_cholesky.py log-normalizer)
+        alpha = eta + (d - 2.0) / 2.0
+        logC = 0.0
+        for i in range(1, d):
+            a = alpha - i / 2.0
+            logC = logC + (i * math.log(math.pi) / 2.0
+                           + jax.scipy.special.gammaln(a)
+                           - jax.scipy.special.gammaln(a + i / 2.0))
+        return Tensor._wrap(unnorm - logC)

@@ -298,11 +298,12 @@ class DataLoader:
         for i, idxs in enumerate(batches):
             index_q.put((i, idxs))
         workers = []
-        for _ in range(self.num_workers):
+        for wid in range(self.num_workers):
             index_q.put(None)  # one stop token per worker
             w = ctx.Process(target=_worker_loop,
                             args=(self.dataset, self.collate_fn, index_q,
-                                  out_q), daemon=True)
+                                  out_q, wid, self.num_workers),
+                            daemon=True)
             w.start()
             workers.append(w)
         try:
@@ -347,10 +348,13 @@ class _WorkerError:
         self.tb = tb
 
 
-def _worker_loop(dataset, collate_fn, index_q, out_q):
+def _worker_loop(dataset, collate_fn, index_q, out_q, worker_id=0,
+                 num_workers=1):
     """Reference: io/dataloader/worker.py:281 _worker_loop."""
     import traceback
 
+    _WORKER_INFO[0] = WorkerInfo(worker_id, num_workers,
+                                 dataset=dataset)
     while True:
         item = index_q.get()
         if item is None:
@@ -364,3 +368,124 @@ def _worker_loop(dataset, collate_fn, index_q, out_q):
 from paddle_tpu.io.ps_dataset import (  # noqa: F401,E402
     InMemoryDataset, QueueDataset,
 )
+
+
+class ConcatDataset(Dataset):
+    """Concatenation of map-style datasets (reference io/dataset.py
+    ConcatDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self.cumulative_sizes = []
+        total = 0
+        for d in self.datasets:
+            total += len(d)
+            self.cumulative_sizes.append(total)
+
+    def __len__(self):
+        return self.cumulative_sizes[-1] if self.cumulative_sizes else 0
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        import bisect
+
+        di = bisect.bisect_right(self.cumulative_sizes, idx)
+        prev = self.cumulative_sizes[di - 1] if di else 0
+        return self.datasets[di][idx - prev]
+
+
+class ChainDataset(IterableDataset):
+    """Chained iterable datasets (reference ChainDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+class ComposeDataset(Dataset):
+    """Zip of same-length datasets; each sample is the concatenation of
+    the component samples (reference ComposeDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        assert self.datasets, "ComposeDataset needs at least one dataset"
+        n = len(self.datasets[0])
+        assert all(len(d) == n for d in self.datasets), \
+            "ComposeDataset requires equal lengths"
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            s = d[idx]
+            out.extend(s if isinstance(s, (tuple, list)) else (s,))
+        return tuple(out)
+
+
+class SubsetRandomSampler(Sampler):
+    """Random permutation over a fixed index subset."""
+
+    def __init__(self, indices):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        import numpy as _np
+
+        for i in _np.random.permutation(len(self.indices)):
+            yield self.indices[int(i)]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+class WeightedRandomSampler(Sampler):
+    """Sample indices with given weights (reference
+    WeightedRandomSampler)."""
+
+    def __init__(self, weights, num_samples, replacement=True):
+        import numpy as _np
+
+        self.weights = _np.asarray(
+            [float(w) for w in weights], dtype=_np.float64)
+        if (self.weights < 0).any():
+            raise ValueError("weights must be non-negative")
+        self.num_samples = num_samples
+        self.replacement = replacement
+        if not replacement and num_samples > len(self.weights):
+            raise ValueError("num_samples > population without replacement")
+
+    def __iter__(self):
+        import numpy as _np
+
+        p = self.weights / self.weights.sum()
+        idx = _np.random.choice(len(self.weights), self.num_samples,
+                                replace=self.replacement, p=p)
+        return iter(int(i) for i in idx)
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WorkerInfo:
+    """Worker context inside DataLoader worker processes."""
+
+    def __init__(self, id, num_workers, seed=0, dataset=None):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.seed = seed
+        self.dataset = dataset
+
+
+_WORKER_INFO = [None]
+
+
+def get_worker_info():
+    """Reference io/dataloader/worker.py get_worker_info: None in the main
+    process, a WorkerInfo inside a DataLoader worker."""
+    return _WORKER_INFO[0]

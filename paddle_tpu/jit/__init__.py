@@ -5,3 +5,56 @@ from paddle_tpu.jit.api import (  # noqa: F401
 )
 from paddle_tpu.jit.control_flow import cond, scan, switch_case, while_loop  # noqa: F401
 from paddle_tpu.jit.functionalize import Functionalized, functionalize  # noqa: F401
+
+# ---- SOT-config surface (reference jit/__init__.py exports) ------------
+
+_TO_STATIC_ENABLED = [True]
+_IGNORED_MODULES: list = []
+_VERBOSITY = [0]
+
+
+def enable_to_static(enable: bool) -> None:
+    """Globally toggle to_static compilation (reference
+    enable_to_static): when off, StaticFunction wrappers run eagerly."""
+    _TO_STATIC_ENABLED[0] = bool(enable)
+
+
+def not_to_static(fn=None):
+    """Decorator marking a function to stay eager under to_static
+    (reference jit/api.py not_to_static)."""
+    if fn is None:
+        return not_to_static
+    fn._paddle_not_to_static = True
+    return fn
+
+
+def ignore_module(modules) -> None:
+    """Record modules whose functions SOT should not trace (reference
+    sot ignore_module). Tracing here is jax-native, so the list only
+    gates to_static wrapping."""
+    _IGNORED_MODULES.extend(modules if isinstance(modules, (list, tuple))
+                            else [modules])
+
+
+def set_code_level(level=100, also_to_stdout=False) -> None:
+    """Reference sot set_code_level: dump level for generated code. The
+    tape-segment path has no bytecode to dump; the level gates segment
+    stats logging instead."""
+    _VERBOSITY[0] = level
+
+
+def set_verbosity(level=0, also_to_stdout=False) -> None:
+    _VERBOSITY[0] = level
+
+
+class TranslatedLayer:
+    """Result type of jit.load for saved inference programs (reference
+    translated_layer.py). jit.load here returns the rehydrated callable
+    already; this class is the isinstance-compatible wrapper."""
+
+    def __init__(self, program, params=None):
+        self._program = program
+        self._params = params or {}
+
+    def __call__(self, *args, **kwargs):
+        return self._program(*args, **kwargs)

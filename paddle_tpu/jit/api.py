@@ -261,7 +261,16 @@ class StaticFunction:
         return self._layer(*args, **kwargs)
 
     def __call__(self, *args, **kwargs):
+        from paddle_tpu.jit import _TO_STATIC_ENABLED
+
+        if not _TO_STATIC_ENABLED[0]:
+            # jit.enable_to_static(False): run everything eagerly
+            if self._fn is not None:
+                return self._fn(*args, **kwargs)
+            return self._eager_layer(*args, **kwargs)
         if self._fn is not None:
+            if getattr(self._fn, "_paddle_not_to_static", False):
+                return self._fn(*args, **kwargs)
             return self._call_fn(*args, **kwargs)
         if self._installed() and not _STITCHED_RUN[0]:
             # direct net(x) call outside any to_static invocation: the

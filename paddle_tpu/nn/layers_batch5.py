@@ -616,6 +616,12 @@ class RNN(Layer):
         lens = None
         if sequence_length is not None:
             lens = _val(sequence_length)
+            if states is None:
+                # materialize the true initial states up front: a masked
+                # first step must fall back to THESE, not to the cell's
+                # output on pad garbage
+                states = self.cell.get_initial_states(
+                    Tensor._wrap(_val(v[0])))
         for t in steps:
             out, new_states = self.cell(v[t], states, **kwargs)
             if lens is not None:
@@ -623,8 +629,6 @@ class RNN(Layer):
                 # (reverse passes thus start at each sequence's true end)
                 live = (t < lens)[:, None]
                 out = Tensor._wrap(jnp.where(live, _val(out), 0.0))
-                if states is None:
-                    states = new_states  # first step initialized them
                 def _sel(new, old):
                     return Tensor._wrap(jnp.where(live, _val(new),
                                                   _val(old)))

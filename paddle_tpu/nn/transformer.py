@@ -112,6 +112,8 @@ class TransformerDecoderLayer(Layer):
         ad = attn_dropout if attn_dropout is not None else dropout
         self.self_attn = MultiHeadAttention(d_model, nhead, dropout=ad)
         self.cross_attn = MultiHeadAttention(d_model, nhead, dropout=ad)
+        self.dropout_act = Dropout(
+            act_dropout if act_dropout is not None else dropout)
         self.linear1 = Linear(d_model, dim_feedforward)
         self.linear2 = Linear(dim_feedforward, d_model)
         self.norm1 = LayerNorm(d_model)
@@ -140,7 +142,8 @@ class TransformerDecoderLayer(Layer):
         if self.normalize_before:
             tgt = self.norm3(tgt)
         tgt = residual + self.dropout3(
-            self.linear2(self.activation(self.linear1(tgt))))
+            self.linear2(self.dropout_act(self.activation(
+                self.linear1(tgt)))))
         if not self.normalize_before:
             tgt = self.norm3(tgt)
         return tgt

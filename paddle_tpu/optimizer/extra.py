@@ -286,6 +286,7 @@ class LBFGS(Optimizer):
         simplified to backtracking: each trial costs one closure)."""
         c1, c2 = 1e-4, 0.9
         gd0 = float(jnp.vdot(grad, d))
+        f_t = f0
         for _ in range(10):
             self._write_back(flat + t * d)
             f_t = float(closure())
@@ -295,8 +296,9 @@ class LBFGS(Optimizer):
             if armijo and wolfe:
                 break
             t *= 0.5
-        self._write_back(flat)    # caller applies the final step itself
-        return t
+        # params already sit at the accepted point with grads evaluated
+        # there — the caller reuses both (no redundant closure)
+        return t, f_t
 
     def step(self, closure):
         """closure() -> loss Tensor; must zero grads, recompute the loss
@@ -320,9 +322,12 @@ class LBFGS(Optimizer):
             self._prev_flat, self._prev_grad = flat, grad
             t = self.get_lr()
             if self._line_search == "strong_wolfe":
-                t = self._wolfe_t(closure, flat, d, grad, float(loss), t)
-            self._write_back(flat + t * d)
-            new_loss = closure()
+                # leaves params at the accepted point, grads evaluated
+                t, new_loss = self._wolfe_t(closure, flat, d, grad,
+                                            float(loss), t)
+            else:
+                self._write_back(flat + t * d)
+                new_loss = closure()
             if abs(float(new_loss) - float(loss)) < self._tol_change:
                 loss = new_loss
                 break

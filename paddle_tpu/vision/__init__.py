@@ -4,3 +4,39 @@ from paddle_tpu.vision.models import (  # noqa: F401
     LeNet, MobileNetV2, ResNet, VGG, mobilenet_v2, resnet18, resnet34, resnet50, resnet101, resnet152,
     resnext50_32x4d, vgg16, vgg19, wide_resnet50_2,
 )
+
+
+_IMAGE_BACKEND = ["pil"]
+
+
+def set_image_backend(backend):
+    """Reference vision/image.py set_image_backend: 'pil' or 'cv2'
+    ('cv2' accepted only if importable; 'tensor' loads raw arrays)."""
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unknown image backend {backend!r}")
+    _IMAGE_BACKEND[0] = backend
+
+
+def get_image_backend():
+    return _IMAGE_BACKEND[0]
+
+
+def image_load(path, backend=None):
+    """Load an image file per the configured backend (reference
+    vision/image.py image_load)."""
+    backend = backend or _IMAGE_BACKEND[0]
+    if backend == "tensor":
+        import numpy as _np
+
+        from paddle_tpu import to_tensor
+
+        from PIL import Image
+
+        return to_tensor(_np.asarray(Image.open(path)))
+    if backend == "cv2":
+        import cv2  # noqa: F401 — optional dependency
+
+        return cv2.imread(path)
+    from PIL import Image
+
+    return Image.open(path)
