@@ -238,3 +238,5 @@ _OPS.setdefault("class_center_sample",
                                   "paddle_tpu.nn.functional."
                                   "class_center_sample"),
                        diff=False, dynamic=True, method=False))
+
+from paddle_tpu.nn.functional_batch5 import *  # noqa: F401,F403,E402

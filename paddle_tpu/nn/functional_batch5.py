@@ -1,0 +1,634 @@
+"""nn.functional surface completion (round 5): the remaining reference
+functional names — re-exports of registered ops, 1d/3d pool variants,
+loss functionals over the existing loss math, in-place activations, the
+packed flash-attention entry points, and gather_tree.
+
+Reference: python/paddle/nn/functional/__init__.py __all__. Everything
+either dispatches registered ops (tape/AMP apply) or composes layers
+already tested elsewhere; nothing here is a stub.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.extras import _dop
+from paddle_tpu.ops.registry import C_OPS as _C
+
+__all__ = [
+    "adaptive_avg_pool1d",
+    "adaptive_avg_pool3d",
+    "adaptive_log_softmax_with_loss",
+    "adaptive_max_pool1d",
+    "adaptive_max_pool3d",
+    "affine_grid",
+    "alpha_dropout",
+    "avg_pool1d",
+    "avg_pool3d",
+    "bilinear",
+    "channel_shuffle",
+    "conv1d_transpose",
+    "conv3d",
+    "conv3d_transpose",
+    "cosine_embedding_loss",
+    "dice_loss",
+    "dropout2d",
+    "dropout3d",
+    "feature_alpha_dropout",
+    "flash_attn_qkvpacked",
+    "flash_attn_varlen_qkvpacked",
+    "fold",
+    "fractional_max_pool2d",
+    "fractional_max_pool3d",
+    "gather_tree",
+    "gaussian_nll_loss",
+    "grid_sample",
+    "gumbel_softmax",
+    "hinge_embedding_loss",
+    "hsigmoid_loss",
+    "label_smooth",
+    "local_response_norm",
+    "log_loss",
+    "log_sigmoid",
+    "lp_pool1d",
+    "lp_pool2d",
+    "margin_ranking_loss",
+    "max_pool1d",
+    "max_pool3d",
+    "max_unpool1d",
+    "max_unpool2d",
+    "max_unpool3d",
+    "maxout",
+    "multi_label_soft_margin_loss",
+    "multi_margin_loss",
+    "npair_loss",
+    "pairwise_distance",
+    "pixel_unshuffle",
+    "poisson_nll_loss",
+    "rnnt_loss",
+    "rrelu",
+    "sigmoid_focal_loss",
+    "soft_margin_loss",
+    "sparse_attention",
+    "square_error_cost",
+    "temporal_shift",
+    "thresholded_relu",
+    "triplet_margin_loss",
+    "triplet_margin_with_distance_loss",
+    "zeropad2d",
+    "relu_", "tanh_", "elu_", "leaky_relu_", "hardtanh_",
+    "softmax_", "thresholded_relu_",
+]
+
+
+# ---- direct op re-exports (registered in ops.yaml, absent from F) ------
+
+conv3d = _C.conv3d
+conv1d_transpose = _C.conv1d_transpose
+conv3d_transpose = _C.conv3d_transpose
+grid_sample = _C.grid_sample
+affine_grid = _C.affine_grid
+channel_shuffle = _C.channel_shuffle
+pixel_unshuffle = _C.pixel_unshuffle
+temporal_shift = _C.temporal_shift
+fold = _C.fold
+gumbel_softmax = _C.gumbel_softmax
+label_smooth = _C.label_smooth
+bilinear = _C.bilinear
+log_loss = _C.log_loss
+avg_pool3d = _C.avg_pool3d
+max_pool3d = _C.max_pool3d
+max_unpool2d = _C.unpool
+max_unpool3d = _C.unpool3d
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "hsigmoid_loss custom-tree mode (path_table/path_code) is not "
+            "supported; only the default complete binary tree")
+    out, _pre, _w = _C.hsigmoid_loss(input, label, weight, bias,
+                                     num_classes=num_classes)
+    return out
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,  # noqa: A002
+              fasteremit_lambda=0.001, reduction="mean", name=None):
+    from paddle_tpu.text.ops import rnnt_loss as _rnnt
+
+    return _rnnt(input, label, input_lengths, label_lengths, blank=blank,
+                 fasteremit_lambda=fasteremit_lambda, reduction=reduction)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    from paddle_tpu.sparse.nn import functional as _sf
+
+    return _sf.attention(query, key, value, sparse_csr_offset,
+                         key_padding_mask=key_padding_mask,
+                         attn_mask=attn_mask)
+
+
+# ---- 1d pool variants over the 2d kernels ------------------------------
+
+def _squeeze_call(fn, x, k, s, p, **kw):
+    """Run a 2D pooling op on [N, C, L] data via a height-1 grid."""
+    out = fn(x.unsqueeze(2), kernel_size=(1, k),
+             stride=(1, s if s is not None else k), padding=(0, p), **kw)
+    if isinstance(out, tuple):
+        return tuple(o.squeeze(2) for o in out)
+    return out.squeeze(2)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _squeeze_call(_C.avg_pool2d, x, kernel_size, stride, padding,
+                         exclusive=exclusive, ceil_mode=ceil_mode)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    if return_mask:
+        out, idx = _C.max_pool2d_with_index(
+            x.unsqueeze(2), kernel_size=(1, kernel_size),
+            stride=(1, stride if stride is not None else kernel_size),
+            padding=(0, padding))
+        return out.squeeze(2), idx.squeeze(2)
+    return _squeeze_call(_C.max_pool2d, x, kernel_size, stride, padding,
+                         ceil_mode=ceil_mode)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    from paddle_tpu.nn.layers_batch5 import MaxUnPool1D
+
+    return MaxUnPool1D(kernel_size, stride, padding,
+                       output_size=output_size)(x, indices)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    out = _C.adaptive_avg_pool2d(x.unsqueeze(2),
+                                 output_size=(1, output_size))
+    return out.squeeze(2)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _C.adaptive_max_pool2d(x.unsqueeze(2),
+                                 output_size=(1, output_size))
+    out = out.squeeze(2)
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool1d return_mask unsupported")
+    return out
+
+
+def _adaptive_pool3d_impl(v, os3, reducer):
+    out = v
+    for axis, target in zip((2, 3, 4), os3):
+        size = out.shape[axis]
+        bounds = [(size * i) // target for i in range(target + 1)]
+        parts = [reducer(
+            jax.lax.slice_in_dim(out, bounds[i],
+                                 max(bounds[i + 1], bounds[i] + 1),
+                                 axis=axis),
+            axis=axis, keepdims=True) for i in range(target)]
+        out = jnp.concatenate(parts, axis=axis)
+    return out
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    """[N, C, D, H, W] adaptive mean pool to output_size (int or
+    triple)."""
+    os3 = (output_size if isinstance(output_size, (list, tuple))
+           else (output_size,) * 3)
+    return _dop("adaptive_avg_pool3d",
+                lambda v: _adaptive_pool3d_impl(v, tuple(os3), jnp.mean),
+                x)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool3d return_mask unsupported")
+    os3 = (output_size if isinstance(output_size, (list, tuple))
+           else (output_size,) * 3)
+    return _dop("adaptive_max_pool3d",
+                lambda v: _adaptive_pool3d_impl(v, tuple(os3), jnp.max),
+                x)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    from paddle_tpu.nn.layers_batch5 import LPPool1D
+
+    return LPPool1D(norm_type, kernel_size, stride, padding)(x)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    from paddle_tpu.nn.layers_batch5 import LPPool2D
+
+    return LPPool2D(norm_type, kernel_size, stride, padding)(x)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    from paddle_tpu.nn.layers_batch5 import FractionalMaxPool2D
+
+    return FractionalMaxPool2D(output_size, kernel_size, random_u,
+                               return_mask)(x)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    from paddle_tpu.nn.layers_batch5 import FractionalMaxPool3D
+
+    return FractionalMaxPool3D(output_size, kernel_size, random_u,
+                               return_mask)(x)
+
+
+# ---- activations (+ in-place forms) ------------------------------------
+
+def log_sigmoid(x, name=None):
+    return _dop("log_sigmoid", jax.nn.log_sigmoid, x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    from paddle_tpu.nn.layers_batch5 import Maxout
+
+    return Maxout(groups, axis)(x)
+
+
+def rrelu(x, lower=1. / 8., upper=1. / 3., training=True, name=None):
+    from paddle_tpu.nn.layers_batch5 import RReLU
+
+    layer = RReLU(lower, upper)
+    layer.training = training
+    return layer(x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return _dop("thresholded_relu",
+                lambda v: jnp.where(v > threshold, v, value), x)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    from paddle_tpu.nn.layers_batch5 import LocalResponseNorm
+
+    return LocalResponseNorm(size, alpha, beta, k)(x)
+
+
+def _inplace(fn):
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(x, *args, **kwargs):
+        from paddle_tpu.autograd import engine as _engine
+
+        if _engine.is_grad_enabled() and not x.stop_gradient:
+            # paddle's in-place activations are differentiable views; the
+            # functional design here recomputes-and-rebinds, which cannot
+            # record a grad for the overwrite — fail loudly rather than
+            # silently sever the tape (non-leaf case raises inside
+            # _inplace_update already)
+            raise RuntimeError(
+                f"{fn.__name__}_ on a tensor that requires grad is not "
+                "supported; use the out-of-place form (paddle.nn."
+                f"functional.{fn.__name__}) inside autograd regions")
+        out = fn(x.detach(), *args, **kwargs)
+        x._inplace_update(out._value)
+        return x
+
+    wrapped.__name__ = fn.__name__ + "_"
+    return wrapped
+
+
+relu_ = _inplace(_C.relu)
+tanh_ = _inplace(_C.tanh)
+elu_ = _inplace(_C.elu)
+leaky_relu_ = _inplace(_C.leaky_relu)
+hardtanh_ = _inplace(_C.hardtanh)
+softmax_ = _inplace(_C.softmax)
+thresholded_relu_ = _inplace(thresholded_relu)
+
+
+# ---- dropout variants --------------------------------------------------
+
+def _channel_dropout(x, p, training, rank, channel_axis):
+    if len(x.shape) != rank:
+        raise ValueError(
+            f"expected a rank-{rank} input, got rank {len(x.shape)}")
+    if not training or p == 0.0:
+        return x
+    from paddle_tpu.core.random import default_generator
+
+    mask_shape = [1] * rank
+    mask_shape[0] = x.shape[0]
+    mask_shape[channel_axis] = x.shape[channel_axis]
+    keep = jax.random.bernoulli(default_generator.next_key(), 1.0 - p,
+                                tuple(mask_shape))
+    return _dop("channel_dropout",
+                lambda v: jnp.where(keep, v / (1.0 - p), 0.0
+                                    ).astype(v.dtype), x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    """Channel-wise dropout for NCHW/NHWC (reference dropout2d)."""
+    return _channel_dropout(x, p, training, 4,
+                            1 if data_format == "NCHW" else 3)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    return _channel_dropout(x, p, training, 5,
+                            1 if data_format == "NCDHW" else 4)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    """SELU-preserving dropout (reference alpha_dropout)."""
+    if not training or p == 0.0:
+        return x
+    import math
+
+    from paddle_tpu.core.random import default_generator
+
+    alpha_p = -1.6732632423543772 * 1.0507009873554805
+    keep = jax.random.bernoulli(default_generator.next_key(), 1.0 - p,
+                                tuple(x.shape))
+    a = 1.0 / math.sqrt((1 - p) * (1 + p * alpha_p ** 2))
+    b = -a * alpha_p * p
+    return _dop("alpha_dropout",
+                lambda v: (a * jnp.where(keep, v, alpha_p) + b
+                           ).astype(v.dtype), x)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    from paddle_tpu.nn.layers_batch5 import FeatureAlphaDropout
+
+    layer = FeatureAlphaDropout(p)
+    layer.training = training
+    return layer(x)
+
+
+# ---- losses ------------------------------------------------------------
+
+def square_error_cost(input, label):  # noqa: A002
+    return _C.square(input - label)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
+    """V-Net dice loss (reference dice_loss): input [N, ..., C] probs,
+    label [N, ..., 1] int."""
+    def impl(iv, lv):
+        n_classes = iv.shape[-1]
+        one_hot = jax.nn.one_hot(lv[..., 0], n_classes, dtype=iv.dtype)
+        reduce_dims = tuple(range(1, iv.ndim))
+        inter = jnp.sum(iv * one_hot, axis=reduce_dims)
+        union = jnp.sum(iv, axis=reduce_dims) + jnp.sum(one_hot,
+                                                        axis=reduce_dims)
+        dice = (2.0 * inter + epsilon) / (union + epsilon)
+        return jnp.mean(1.0 - dice)
+
+    return _dop("dice_loss", impl, input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """N-pair loss (reference npair_loss, Sohn 2016)."""
+    def impl(a, p, y):
+        y = y.reshape(-1)
+        sim = a @ p.T                                 # [B, B]
+        same = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = same / jnp.sum(same, -1, keepdims=True)
+        ce = -jnp.sum(tgt * jax.nn.log_softmax(sim, -1), -1).mean()
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, -1))
+                        + jnp.mean(jnp.sum(p * p, -1))) * 0.25
+        return ce + reg
+
+    return _dop("npair_loss", impl, anchor, positive, labels)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction="sum", name=None):
+    """RetinaNet focal loss (reference sigmoid_focal_loss)."""
+    def impl(z, yv, *norm):
+        y = yv.astype(z.dtype)
+        p = jax.nn.sigmoid(z)
+        ce = (jnp.maximum(z, 0) - z * y
+              + jnp.log1p(jnp.exp(-jnp.abs(z))))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if norm:
+            loss = loss / norm[0]
+        if reduction == "sum":
+            return jnp.sum(loss)
+        if reduction == "mean":
+            return jnp.mean(loss)
+        return loss
+
+    args = (logit, label) + ((normalizer,) if normalizer is not None
+                             else ())
+    return _dop("sigmoid_focal_loss", impl, *args)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False,
+                      name=None):
+    def impl(xv, yv):
+        d = xv - yv + epsilon
+        return jnp.sum(jnp.abs(d) ** p, -1,
+                       keepdims=keepdim) ** (1.0 / p)
+
+    return _dop("pairwise_distance", impl, x, y)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    from paddle_tpu.nn import CosineEmbeddingLoss
+
+    return CosineEmbeddingLoss(margin=margin, reduction=reduction)(
+        input1, input2, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",  # noqa: A002
+                         name=None):
+    from paddle_tpu.nn import HingeEmbeddingLoss
+
+    return HingeEmbeddingLoss(margin=margin, reduction=reduction)(
+        input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0,  # noqa: A002
+                        reduction="mean", name=None):
+    from paddle_tpu.nn import MarginRankingLoss
+
+    return MarginRankingLoss(margin=margin, reduction=reduction)(
+        input, other, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,  # noqa: A002
+                                 reduction="mean", name=None):
+    from paddle_tpu.nn import MultiLabelSoftMarginLoss
+
+    return MultiLabelSoftMarginLoss(weight=weight, reduction=reduction)(
+        input, label)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,  # noqa: A002
+                      reduction="mean", name=None):
+    from paddle_tpu.nn import MultiMarginLoss
+
+    return MultiMarginLoss(p=p, margin=margin, weight=weight,
+                           reduction=reduction)(input, label)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,  # noqa: A002
+                     epsilon=1e-8, reduction="mean", name=None):
+    from paddle_tpu.nn import PoissonNLLLoss
+
+    return PoissonNLLLoss(log_input=log_input, full=full, epsilon=epsilon,
+                          reduction=reduction)(input, label)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    from paddle_tpu.nn import SoftMarginLoss
+
+    return SoftMarginLoss(reduction=reduction)(input, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,  # noqa: A002
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    from paddle_tpu.nn import TripletMarginLoss
+
+    return TripletMarginLoss(margin=margin, p=p, epsilon=epsilon,
+                             swap=swap, reduction=reduction)(
+        input, positive, negative)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,  # noqa: A002
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    from paddle_tpu.nn import TripletMarginWithDistanceLoss
+
+    return TripletMarginWithDistanceLoss(
+        distance_function=distance_function, margin=margin, swap=swap,
+        reduction=reduction)(input, positive, negative)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,  # noqa: A002
+                      reduction="mean", name=None):
+    from paddle_tpu.nn import GaussianNLLLoss
+
+    return GaussianNLLLoss(full=full, epsilon=epsilon,
+                           reduction=reduction)(input, label, variance)
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight,  # noqa: A002
+                                   tail_weights, cutoffs, head_bias=None,
+                                   name=None):
+    """Functional adaptive softmax (reference
+    adaptive_log_softmax_with_loss): same math as the layer, explicit
+    weights."""
+    cutoffs = list(cutoffs)
+    n_clusters = len(cutoffs)
+    flat_tails = [w for pair in tail_weights for w in pair]
+    has_bias = head_bias is not None
+
+    def impl(xv, yv, hw, *rest):
+        if has_bias:
+            hb, tails = rest[0], rest[1:]
+        else:
+            hb, tails = None, rest
+        head = xv @ hw
+        if hb is not None:
+            head = head + hb
+        head_lp = jax.nn.log_softmax(head, -1)
+        shortlist = head.shape[-1] - n_clusters
+        logp = jnp.zeros(yv.shape, jnp.float32)
+        in_head = yv < shortlist
+        safe = jnp.clip(yv, 0, shortlist - 1)
+        logp = jnp.where(
+            in_head,
+            jnp.take_along_axis(head_lp, safe[:, None], 1)[:, 0], logp)
+        bounds = [shortlist] + cutoffs
+        for i in range(n_clusters):
+            w1, w2 = tails[2 * i], tails[2 * i + 1]
+            lo, hi = bounds[i], bounds[i + 1]
+            in_c = (yv >= lo) & (yv < hi)
+            tail_lp = jax.nn.log_softmax((xv @ w1) @ w2, -1)
+            rel = jnp.clip(yv - lo, 0, hi - lo - 1)
+            logp = jnp.where(
+                in_c,
+                head_lp[:, shortlist + i]
+                + jnp.take_along_axis(tail_lp, rel[:, None], 1)[:, 0],
+                logp)
+        return logp, -logp.mean()
+
+    args = (input, label, head_weight) + \
+        ((head_bias,) if has_bias else ()) + tuple(flat_tails)
+    return _dop("adaptive_log_softmax_with_loss", impl, *args)
+
+
+# ---- packed flash attention + gather_tree ------------------------------
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, name=None):
+    """Packed [B, S, 3, H, D] flash attention (reference
+    flash_attn_qkvpacked)."""
+    from paddle_tpu.nn.functional import flash_attention
+
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale=None,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, name=None):
+    """Packed varlen form over the cu_seqlens kernel path (reference
+    flash_attn_varlen_qkvpacked)."""
+    from paddle_tpu.nn.functional import flash_attn_unpadded
+
+    q = qkv[:, 0]
+    k = qkv[:, 1]
+    v = qkv[:, 2]
+    return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q, max_seqlen_k, scale=scale,
+                               dropout=dropout, causal=causal)
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrack (reference gather_tree op): ids/parents
+    [T, B, K] -> full sequences re-threaded through parent pointers."""
+    iv = ids._value if isinstance(ids, Tensor) else jnp.asarray(ids)
+    pv = (parents._value if isinstance(parents, Tensor)
+          else jnp.asarray(parents))
+    T, B, K = iv.shape
+    cur = jnp.tile(jnp.arange(K)[None, :], (B, 1))
+    rows = [None] * T
+    bidx = jnp.arange(B)[:, None]
+    for t in range(T - 1, -1, -1):
+        rows[t] = iv[t][bidx, cur]
+        cur = pv[t][bidx, cur]
+    return Tensor._wrap(jnp.stack(rows, 0))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """[N, C, H, W] constant-zero pad, paddle order (l, r, t, b)."""
+    p = padding if isinstance(padding, (list, tuple)) else (padding,) * 4
+    l, r, t, b = p
+
+    def impl(v):
+        cfg = [(0, 0)] * (v.ndim - 2) + [(t, b), (l, r)]
+        return jnp.pad(v, cfg)
+
+    return _dop("zeropad2d", impl, x)

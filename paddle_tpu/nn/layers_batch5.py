@@ -41,11 +41,14 @@ def _val(x):
     return x._value if isinstance(x, Tensor) else jnp.asarray(x)
 
 
+from paddle_tpu.extras import _dop  # noqa: E402 — tape-recording helper
+
+
 # ------------------------------------------------------------ activations
 
 class LogSigmoid(Layer):
     def forward(self, x):
-        return Tensor._wrap(jax.nn.log_sigmoid(_val(x)))
+        return _dop("log_sigmoid", jax.nn.log_sigmoid, x)
 
 
 class ThresholdedReLU(Layer):
@@ -54,8 +57,9 @@ class ThresholdedReLU(Layer):
         self.threshold = threshold
 
     def forward(self, x):
-        v = _val(x)
-        return Tensor._wrap(jnp.where(v > self.threshold, v, 0.0))
+        th = self.threshold
+        return _dop("thresholded_relu",
+                    lambda v: jnp.where(v > th, v, 0.0), x)
 
 
 class RReLU(Layer):
@@ -67,15 +71,17 @@ class RReLU(Layer):
         self.lower, self.upper = lower, upper
 
     def forward(self, x):
-        v = _val(x)
         if self.training:
             from paddle_tpu.core.random import default_generator
 
-            a = jax.random.uniform(default_generator.next_key(), v.shape,
-                                   jnp.float32, self.lower, self.upper)
+            a = jax.random.uniform(default_generator.next_key(),
+                                   tuple(x.shape), jnp.float32,
+                                   self.lower, self.upper)
         else:
             a = (self.lower + self.upper) / 2.0
-        return Tensor._wrap(jnp.where(v >= 0, v, a * v).astype(v.dtype))
+        return _dop("rrelu",
+                    lambda v: jnp.where(v >= 0, v, a * v).astype(v.dtype),
+                    x)
 
 
 class Maxout(Layer):
@@ -86,19 +92,23 @@ class Maxout(Layer):
         self.groups, self.axis = groups, axis
 
     def forward(self, x):
-        v = _val(x)
-        c = v.shape[self.axis]
-        assert c % self.groups == 0
-        new = (v.shape[:self.axis] + (c // self.groups, self.groups)
-               + v.shape[self.axis + 1:])
-        return Tensor._wrap(jnp.max(v.reshape(new), axis=self.axis + 1))
+        groups, axis = self.groups, self.axis
+
+        def impl(v):
+            c = v.shape[axis]
+            assert c % groups == 0
+            new = (v.shape[:axis] + (c // groups, groups)
+                   + v.shape[axis + 1:])
+            return jnp.max(v.reshape(new), axis=axis + 1)
+
+        return _dop("maxout", impl, x)
 
 
 class Softmax2D(Layer):
     """Softmax over the channel dim of NCHW inputs."""
 
     def forward(self, x):
-        return Tensor._wrap(jax.nn.softmax(_val(x), axis=-3))
+        return _dop("softmax2d", lambda v: jax.nn.softmax(v, axis=-3), x)
 
 
 # ------------------------------------------------------------ shape / pad
@@ -123,9 +133,12 @@ class ZeroPad1D(Layer):
         self.pad = tuple(p)
 
     def forward(self, x):
-        v = _val(x)
-        cfg = [(0, 0)] * (v.ndim - 1) + [self.pad]
-        return Tensor._wrap(jnp.pad(v, cfg))
+        pad = self.pad
+
+        def impl(v):
+            return jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [pad])
+
+        return _dop("zeropad1d", impl, x)
 
 
 class ZeroPad3D(Layer):
@@ -139,10 +152,13 @@ class ZeroPad3D(Layer):
         self.pad = tuple(p)
 
     def forward(self, x):
-        v = _val(x)
         l, r, t, b, f, k = self.pad
-        cfg = [(0, 0)] * (v.ndim - 3) + [(f, k), (t, b), (l, r)]
-        return Tensor._wrap(jnp.pad(v, cfg))
+
+        def impl(v):
+            cfg = [(0, 0)] * (v.ndim - 3) + [(f, k), (t, b), (l, r)]
+            return jnp.pad(v, cfg)
+
+        return _dop("zeropad3d", impl, x)
 
 
 # ------------------------------------------------------------------ norms
@@ -160,9 +176,17 @@ class InstanceNorm1D(Layer):
             [num_features], is_bias=True)
 
     def forward(self, x):
-        v = _val(x)
-        return Tensor._wrap(_instance_norm_nd(v, (2,), self.scale,
-                                              self.bias, self._epsilon))
+        eps = self._epsilon
+        args = (x,) + tuple(p for p in (self.scale, self.bias)
+                            if p is not None)
+        has_s, has_b = self.scale is not None, self.bias is not None
+
+        def impl(v, *sb):
+            s = sb[0] if has_s else None
+            b = sb[1] if has_s and has_b else (sb[0] if has_b else None)
+            return _instance_norm_nd(v, (2,), s, b, eps)
+
+        return _dop("instance_norm1d", impl, *args)
 
 
 class InstanceNorm3D(Layer):
@@ -178,9 +202,17 @@ class InstanceNorm3D(Layer):
             [num_features], is_bias=True)
 
     def forward(self, x):
-        v = _val(x)
-        return Tensor._wrap(_instance_norm_nd(v, (2, 3, 4), self.scale,
-                                              self.bias, self._epsilon))
+        eps = self._epsilon
+        args = (x,) + tuple(p for p in (self.scale, self.bias)
+                            if p is not None)
+        has_s, has_b = self.scale is not None, self.bias is not None
+
+        def impl(v, *sb):
+            s = sb[0] if has_s else None
+            b = sb[1] if has_s and has_b else (sb[0] if has_b else None)
+            return _instance_norm_nd(v, (2, 3, 4), s, b, eps)
+
+        return _dop("instance_norm3d", impl, *args)
 
 
 def _instance_norm_nd(v, axes, scale, bias, eps):
@@ -189,9 +221,9 @@ def _instance_norm_nd(v, axes, scale, bias, eps):
     out = (v - mu) * jax.lax.rsqrt(var + eps)
     cshape = (1, -1) + (1,) * (v.ndim - 2)
     if scale is not None:
-        out = out * _val(scale).reshape(cshape)
+        out = out * scale.reshape(cshape)
     if bias is not None:
-        out = out + _val(bias).reshape(cshape)
+        out = out + bias.reshape(cshape)
     return out.astype(v.dtype)
 
 
@@ -205,15 +237,19 @@ class LocalResponseNorm(Layer):
         self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
 
     def forward(self, x):
-        v = _val(x)
-        sq = jnp.square(v)
-        half = self.size // 2
-        pad = [(0, 0)] * v.ndim
-        pad[1] = (half, self.size - 1 - half)
-        sq = jnp.pad(sq, pad)
-        acc = sum(sq[:, i:i + v.shape[1]] for i in range(self.size))
-        denom = (self.k + self.alpha * acc / self.size) ** self.beta
-        return Tensor._wrap((v / denom).astype(v.dtype))
+        size, alpha, beta, k = self.size, self.alpha, self.beta, self.k
+
+        def impl(v):
+            sq = jnp.square(v)
+            half = size // 2
+            pad = [(0, 0)] * v.ndim
+            pad[1] = (half, size - 1 - half)
+            sq = jnp.pad(sq, pad)
+            acc = sum(sq[:, i:i + v.shape[1]] for i in range(size))
+            denom = (k + alpha * acc / size) ** beta
+            return (v / denom).astype(v.dtype)
+
+        return _dop("local_response_norm", impl, x)
 
 
 # ----------------------------------------------------------------- pools
@@ -231,12 +267,16 @@ class LPPool1D(Layer):
         self.pad = padding
 
     def forward(self, x):
-        v = _val(x)
-        vp = jnp.abs(v) ** self.p
-        summed = jax.lax.reduce_window(
-            vp, 0.0, jax.lax.add, (1, 1, self.k), (1, 1, self.s),
-            [(0, 0), (0, 0), (self.pad, self.pad)])
-        return Tensor._wrap((summed ** (1.0 / self.p)).astype(v.dtype))
+        pw, kk, ss, pp = self.p, self.k, self.s, self.pad
+
+        def impl(v):
+            vp = jnp.abs(v) ** pw
+            summed = jax.lax.reduce_window(
+                vp, 0.0, jax.lax.add, (1, 1, kk), (1, 1, ss),
+                [(0, 0), (0, 0), (pp, pp)])
+            return (summed ** (1.0 / pw)).astype(v.dtype)
+
+        return _dop("lp_pool1d", impl, x)
 
 
 class LPPool2D(Layer):
@@ -252,12 +292,16 @@ class LPPool2D(Layer):
         self.pad = padding
 
     def forward(self, x):
-        v = _val(x)
-        vp = jnp.abs(v) ** self.p
-        summed = jax.lax.reduce_window(
-            vp, 0.0, jax.lax.add, (1, 1) + self.k, (1, 1) + self.s,
-            [(0, 0), (0, 0), (self.pad, self.pad), (self.pad, self.pad)])
-        return Tensor._wrap((summed ** (1.0 / self.p)).astype(v.dtype))
+        pw, kk, ss, pp = self.p, self.k, self.s, self.pad
+
+        def impl(v):
+            vp = jnp.abs(v) ** pw
+            summed = jax.lax.reduce_window(
+                vp, 0.0, jax.lax.add, (1, 1) + kk, (1, 1) + ss,
+                [(0, 0), (0, 0), (pp, pp), (pp, pp)])
+            return (summed ** (1.0 / pw)).astype(v.dtype)
+
+        return _dop("lp_pool2d", impl, x)
 
 
 def _fractional_bounds(in_size, out_size, u):
@@ -403,7 +447,7 @@ class FeatureAlphaDropout(Layer):
     def forward(self, x):
         v = _val(x)
         if not self.training or self.p == 0.0:
-            return Tensor._wrap(v)
+            return x if isinstance(x, Tensor) else Tensor._wrap(v)
         from paddle_tpu.core.random import default_generator
 
         alpha_p = -self._ALPHA * self._SCALE
@@ -414,8 +458,9 @@ class FeatureAlphaDropout(Layer):
                              (1 + self.p * alpha_p ** 2))) \
             if self.p < 1.0 else 0.0
         b = -a * alpha_p * self.p
-        out = a * jnp.where(keep, v, alpha_p) + b
-        return Tensor._wrap(out.astype(v.dtype))
+        return _dop("feature_alpha_dropout",
+                    lambda vv: (a * jnp.where(keep, vv, alpha_p) + b
+                                ).astype(vv.dtype), x)
 
 
 # ------------------------------------------------------------- containers
