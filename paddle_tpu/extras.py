@@ -662,7 +662,8 @@ def batch(reader, batch_size, drop_last=False):
 # inplace methods; in-place on a non-leaf recording grads raises in
 # Tensor._inplace_update)
 INPLACE_BASES = [
-    "abs", "acos", "addmm", "asin", "atan", "bernoulli", "bitwise_and",
+    "abs", "acos", "acosh", "addmm", "asin", "asinh", "atan", "atanh",
+    "bitwise_and",
     "bitwise_invert", "bitwise_not", "bitwise_or", "bitwise_xor", "cast",
     "ceil", "clip", "copysign", "cos", "cosh", "cumprod", "cumsum",
     "digamma", "divide", "equal", "erf", "erfinv", "exp", "expm1",
@@ -670,6 +671,7 @@ INPLACE_BASES = [
     "frac", "gammainc", "gammaincc", "gammaln", "gcd", "greater_equal",
     "greater_than", "hypot", "i0", "lcm", "ldexp", "less", "less_equal",
     "less_than", "lerp", "lgamma", "log", "log10", "log1p", "log2",
+    "not_equal", "index_fill",
     "logical_and", "logical_not", "logical_or",
     "logical_xor", "logit", "masked_fill", "masked_scatter", "mod",
     "multigammaln", "multiply", "nan_to_num", "neg",
@@ -762,7 +764,8 @@ def install_extras(namespace: dict) -> None:
     consts = ("pi", "e", "inf", "nan", "newaxis", "row_stack",
               "floor_mod")
     for n in dir(mod):
-        if n.startswith("_") or n in ("install_extras", "INPLACE_BASES"):
+        if n.startswith("_") or n in ("install_extras", "INPLACE_BASES",
+                                      "bind_tensor_methods"):
             continue
         obj = getattr(mod, n)
         defined_here = (isinstance(obj, (types.FunctionType, type))
@@ -811,3 +814,254 @@ def install_extras(namespace: dict) -> None:
         # Tensor method too (x.abs_() etc.)
         if not hasattr(Tensor, nm):
             setattr(Tensor, nm, fn)
+
+
+# ------------------------------------------------ tensor-method parity
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    from paddle_tpu.sparse import pca_lowrank as _pl
+
+    return _pl(x, q=q, center=center, niter=niter)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    """Correlation matrix (reference tensor/linalg.py corrcoef)."""
+    return _dop("corrcoef",
+                lambda v: jnp.corrcoef(v, rowvar=rowvar), x)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference svd_lowrank)."""
+    from paddle_tpu.core.random import default_generator
+
+    n = _val(x).shape[-1]
+    omega = jax.random.normal(default_generator.next_key(), (n, q),
+                              jnp.float32)
+    has_m = M is not None
+
+    def impl(vv, *m):
+        if has_m:
+            vv = vv - m[0]
+        vT = jnp.swapaxes(vv, -1, -2)
+        y = vv @ omega
+        for _ in range(niter):
+            y = vv @ (vT @ y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = jnp.swapaxes(qmat, -1, -2) @ vv
+        u_b, s, vt = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ u_b, s, jnp.swapaxes(vt, -1, -2)
+
+    args = (x,) + ((M,) if has_m else ())
+    return _dop("svd_lowrank", impl, *args)
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse from a Cholesky factor (reference cholesky_inverse)."""
+    def impl(L):
+        eye = jnp.eye(L.shape[-1], dtype=L.dtype)
+        sol = jax.scipy.linalg.cho_solve((L, not upper), eye)
+        return sol
+
+    return _dop("cholesky_inverse", impl, x)
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """Multiply by the orthogonal Q of a householder QR (reference
+    ormqr): materializes Q via householder_product then matmuls."""
+    from paddle_tpu import linalg
+
+    qmat = linalg.householder_product(x, tau)
+
+    def impl(qv, ov):
+        q_ = jnp.swapaxes(qv, -1, -2) if transpose else qv
+        return q_ @ ov if left else ov @ q_
+
+    return _dop("ormqr", impl, qmat, other)
+
+
+def create_tensor(dtype="float32", name=None, persistable=False):
+    """Reference create_tensor: an empty placeholder tensor."""
+    return Tensor._wrap(jnp.zeros((0,), _dtype_mod.to_jax_dtype(dtype)))
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus sampling (reference top_p_sampling): per-row sample from
+    the smallest prefix whose probability mass reaches ps. Returns
+    (scores, ids). seed pins the draw (reference contract)."""
+    from paddle_tpu.core.random import default_generator
+
+    logits = _val(x).astype(jnp.float32)
+    p = jnp.asarray(_val(ps)).reshape(-1, 1)
+    sorted_l = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_l, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.sum(cum < p, axis=-1, keepdims=True)
+    cutoff = jnp.take_along_axis(sorted_l, cutoff_idx, axis=-1)
+    masked = jnp.where(logits < cutoff, -jnp.inf, logits)
+    key = (jax.random.PRNGKey(seed) if seed not in (None, -1)
+           else default_generator.next_key())
+    ids = jax.random.categorical(key, masked, axis=-1)[..., None]
+    scores = jnp.take_along_axis(jax.nn.softmax(logits, -1), ids, -1)
+    return Tensor._wrap(scores), Tensor._wrap(ids.astype(jnp.int64))
+
+
+def index_put_(x, indices, value, accumulate=False, name=None):
+    out = index_put(x.detach(), indices, value, accumulate)
+    if out._value.dtype != _val(x).dtype:
+        raise TypeError("index_put_: dtype mismatch")
+    x._inplace_update(out._value)
+    return x
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def impl(v, val):
+        idx = tuple(_val(i) for i in indices)
+        return v.at[idx].add(val) if accumulate else v.at[idx].set(val)
+
+    return _dop("index_put", impl, x, value)
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign",  # noqa: A002
+                   include_self=True, broadcast=True, name=None):
+    if reduce not in ("assign", "add", "mul", "multiply", "amin", "amax"):
+        raise ValueError(f"unknown reduce mode {reduce!r}")
+    if not include_self and reduce != "assign":
+        raise NotImplementedError(
+            "put_along_axis include_self=False is not supported")
+
+    def impl(v, val):
+        ax = axis % v.ndim
+        i = _val(indices)
+        val_b = jnp.broadcast_to(val, i.shape).astype(v.dtype)
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in i.shape],
+                             indexing="ij")
+        full_idx = [grids[d] for d in range(v.ndim)]
+        full_idx[ax] = i
+        at = v.at[tuple(full_idx)]
+        if reduce == "add":
+            return at.add(val_b)
+        if reduce in ("multiply", "mul"):
+            return at.multiply(val_b)
+        if reduce == "amin":
+            return at.min(val_b)
+        if reduce == "amax":
+            return at.max(val_b)
+        return at.set(val_b)
+
+    return _dop("put_along_axis", impl, x, values)
+
+
+def put_along_axis_(x, indices, values, axis, reduce="assign",  # noqa: A002
+                    name=None):
+    out = put_along_axis(x.detach(), indices, values, axis, reduce)
+    if out._value.dtype != _val(x).dtype:
+        raise TypeError("put_along_axis_: dtype mismatch")
+    x._inplace_update(out._value)
+    return x
+
+
+def resize_(x, shape, fill_zero=False, name=None):
+    """numpy-resize semantics in place (reference Tensor.resize_)."""
+    v = _val(x).reshape(-1)
+    n = int(np.prod(shape)) if shape else 1
+    if n <= v.shape[0]:
+        out = v[:n]
+    else:
+        pad = n - v.shape[0]
+        if fill_zero or v.shape[0] == 0:   # numpy.resize zero-fills empty
+            filler = jnp.zeros((pad,), v.dtype)
+        else:
+            filler = jnp.tile(v, (pad // v.shape[0] + 1,))[:pad]
+        out = jnp.concatenate([v, filler])
+    x._inplace_update(out.reshape(tuple(shape)))
+    return x
+
+
+def set_(x, source=None, shape=None, name=None):
+    """Rebind x's storage to source's (reference Tensor.set_)."""
+    if source is None:
+        x._inplace_update(jnp.zeros((0,), _val(x).dtype))
+        return x
+    v = _val(source)
+    if shape is not None:
+        v = v.reshape(tuple(shape))
+    x._inplace_update(v)
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    from paddle_tpu.core.random import default_generator
+
+    v = _val(x)
+    key = (jax.random.PRNGKey(seed) if seed
+           else default_generator.next_key())
+    out = jax.random.uniform(key, v.shape, jnp.float32, min, max)
+    x._inplace_update(out.astype(v.dtype))
+    return x
+
+
+def reduce_as(x, target, name=None):
+    """Sum x down to target's shape (reference reduce_as)."""
+    def impl(v, t):
+        extra = v.ndim - t.ndim
+        axes = tuple(range(extra)) + tuple(
+            extra + i for i, (a, b) in enumerate(
+                zip(v.shape[extra:], t.shape)) if b == 1 and a != 1)
+        out = jnp.sum(v, axis=axes, keepdims=False)
+        return out.reshape(t.shape)
+
+    return _dop("reduce_as", impl, x, target)
+
+
+_TENSOR_METHOD_SOURCES = ("linalg", "signal", "fft")
+
+
+def bind_tensor_methods(pkg) -> None:
+    """Bind every reference tensor_method_func name that exists as a
+    top-level (or linalg/signal/fft) function but not yet as a Tensor
+    method — x.method(...) == paddle.method(x, ...), the same generated
+    binding the reference applies (python/paddle/tensor/__init__.py)."""
+    ref_names = [
+        "acosh_", "add_n", "asinh_", "atanh_", "atleast_1d", "atleast_2d",
+        "atleast_3d", "bernoulli_", "bitwise_invert", "block_diag",
+        "broadcast_shape", "broadcast_tensors", "cauchy_", "cdist",
+        "cholesky_inverse", "cholesky_solve", "concat", "cond", "corrcoef",
+        "cov", "create_parameter", "create_tensor", "cumulative_trapezoid",
+        "diagflat", "diagonal_scatter", "dsplit", "eig", "eigvals",
+        "eigvalsh", "floor_mod", "frexp", "gammainc", "geometric_",
+        "histogram_bin_edges", "histogramdd", "householder_product",
+        "hsplit", "hypot", "index_fill", "index_fill_", "index_put",
+        "index_put_", "inner", "is_complex", "is_floating_point",
+        "is_integer", "is_tensor", "isin", "isneginf", "isposinf",
+        "isreal", "istft", "ldexp", "less", "log_normal_", "logaddexp",
+        "lstsq", "lu", "lu_unpack", "masked_scatter", "matrix_transpose",
+        "mm", "mod", "moveaxis", "multi_dot", "multigammaln", "multiplex",
+        "negative", "normal_", "not_equal_", "ormqr", "pca_lowrank",
+        "pinv", "polar", "put_along_axis", "put_along_axis_", "qr",
+        "rank", "reduce_as", "resize_", "scatter_nd", "select_scatter",
+        "set_", "sgn", "signbit", "sinc", "slice", "slice_scatter",
+        "solve", "stack", "stft", "svd_lowrank", "take", "tensor_split",
+        "tensordot", "top_p_sampling", "trapezoid", "unflatten", "unfold",
+        "uniform_", "view", "view_as", "vsplit", "where", "where_",
+    ]
+    subs = [getattr(pkg, s, None) for s in _TENSOR_METHOD_SOURCES]
+    for name in ref_names:
+        if hasattr(Tensor, name):
+            continue
+        fn = getattr(pkg, name, None)
+        if fn is None:
+            for sub in subs:
+                if sub is not None and hasattr(sub, name):
+                    fn = getattr(sub, name)
+                    break
+        if fn is None or not callable(fn):
+            continue
+
+        def make(f):
+            def method(self, *args, **kwargs):
+                return f(self, *args, **kwargs)
+
+            method.__name__ = f.__name__ if hasattr(f, "__name__") else name
+            return method
+
+        setattr(Tensor, name, make(fn))

@@ -297,3 +297,44 @@ def test_jit_config_surface():
     with warnings.catch_warnings():
         warnings.simplefilter("error")   # no graph-break warning allowed
         assert sg(paddle.to_tensor(np.ones(3, np.float32))) == 3.0
+
+
+def test_tensor_method_parity_with_reference():
+    """Every name in the reference's tensor_method_func list is a method
+    on Tensor (python/paddle/tensor/__init__.py binding contract)."""
+    import os
+    import re
+
+    from paddle_tpu.core.tensor import Tensor
+
+    ref = "/root/reference/python/paddle/tensor/__init__.py"
+    if not os.path.exists(ref):
+        pytest.skip("reference tree not present")
+    m = re.search(r"tensor_method_func\s*=\s*\[(.*?)\]", open(ref).read(),
+                  re.S)
+    names = set(re.findall(r"'([^']+)'", m.group(1)))
+    missing = sorted(n for n in names if not hasattr(Tensor, n))
+    assert not missing, f"{len(missing)}: {missing[:20]}"
+
+
+def test_bound_tensor_methods_behave():
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (4, 4)).astype(np.float32))
+    q, r = x.qr()
+    np.testing.assert_allclose(q.numpy() @ r.numpy(), x.numpy(),
+                               atol=1e-4)
+    assert x.corrcoef().shape == [4, 4]
+    t = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    t.index_put_((paddle.to_tensor(np.array([1])),
+                  paddle.to_tensor(np.array([2]))),
+                 paddle.to_tensor(np.array([7.0], np.float32)))
+    assert t.numpy()[1, 2] == 7.0
+    t.uniform_(0, 1)
+    assert 0 <= float(t.numpy().min()) and float(t.numpy().max()) <= 1
+    ra = paddle.reduce_as(paddle.to_tensor(np.ones((2, 3), np.float32)),
+                          paddle.to_tensor(np.ones((1, 3), np.float32)))
+    np.testing.assert_allclose(ra.numpy(), 2.0)
+    s, ids = paddle.top_p_sampling(
+        paddle.to_tensor(np.array([[0.0, 10.0, -5.0]], np.float32)),
+        paddle.to_tensor(np.array([0.9], np.float32)))
+    assert int(ids.numpy()[0, 0]) == 1
