@@ -24,3 +24,126 @@ def __getattr__(name):
 
         return mp
     raise AttributeError(name)
+
+
+# ---------------------- round-5: reference incubate __all__ completion --
+# (reference python/paddle/incubate/__init__.py)
+
+from paddle_tpu.geometric import (  # noqa: E402,F401
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+from paddle_tpu.optimizer import LookAhead  # noqa: E402,F401
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Reference incubate.graph_send_recv -> geometric.send_u_recv."""
+    from paddle_tpu.geometric import send_u_recv
+
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    from paddle_tpu.geometric import khop_sampler
+
+    return khop_sampler(row, colptr, input_nodes, sample_sizes,
+                        sorted_eids=sorted_eids, return_eids=return_eids)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    from paddle_tpu.geometric import reindex_graph
+
+    return reindex_graph(x, neighbors, count)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    from paddle_tpu.geometric import sample_neighbors
+
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size, eids=eids,
+                            return_eids=return_eids)
+
+
+def identity_loss(x, reduction="none"):
+    """Reference incubate.identity_loss: marks x as a loss (IPU
+    pipeline hint); numerically reduce-or-identity."""
+    if reduction in ("mean", 1):
+        return x.mean()
+    if reduction in ("sum", 0):
+        return x.sum()
+    return x
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused softmax(x + mask) (reference incubate.softmax_mask_fuse —
+    one XLA fusion here, which is the point of the op)."""
+    import paddle_tpu as paddle
+
+    return paddle.nn.functional.softmax(x + mask, axis=-1)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """softmax with the causal upper-triangle mask fused (reference
+    softmax_mask_fuse_upper_triangle)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+
+    s = x.shape[-1]
+    causal = jnp.where(jnp.tril(jnp.ones((s, s), bool)), 0.0, -1e30)
+    return paddle.nn.functional.softmax(
+        x + Tensor._wrap(causal.astype(jnp.float32)), axis=-1)
+
+
+class ModelAverage:
+    """Reference incubate.ModelAverage: maintains a running average of the
+    parameters for EVALUATION — step() only updates the average (the live
+    training weights are never touched); apply() swaps the averages in
+    (backing up the live values), restore() swaps back."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        import jax.numpy as jnp
+
+        self._params = list(parameters or [])
+        self._jnp = jnp
+        self._avg = [jnp.array(p._value, dtype=jnp.float32, copy=True)
+                     for p in self._params]
+        self._n = 1
+        self._backup = None
+
+    def step(self):
+        self._n += 1
+        mu = 1.0 / self._n
+        self._avg = [a + mu * (p._value.astype(self._jnp.float32) - a)
+                     for a, p in zip(self._avg, self._params)]
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = [self._jnp.array(p._value, copy=True)
+                        for p in self._params]
+        for p, a in zip(self._params, self._avg):
+            p._inplace_update(a.astype(p._value.dtype))
+        if not need_restore:
+            self._backup = None
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            raise RuntimeError("ModelAverage.restore() without a prior "
+                               "apply(need_restore=True)")
+        for p, b in zip(self._params, self._backup):
+            p._inplace_update(b)
+        self._backup = None
+
+    def minimize(self, loss):   # reference-compatible no-op: the inner
+        pass                    # optimizer owns the update here
+
+
+from paddle_tpu import inference  # noqa: E402,F401

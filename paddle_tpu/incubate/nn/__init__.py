@@ -1,2 +1,162 @@
 from paddle_tpu.incubate.nn import functional  # noqa: F401
 from paddle_tpu.nn.layers import RMSNorm as FusedRMSNorm  # noqa: F401
+
+# ------------------ round-5: fused transformer layer surface ------------
+# Reference python/paddle/incubate/nn/__init__.py — FusedLinear,
+# FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer,
+# FusedMultiTransformer, FusedDropoutAdd,
+# FusedBiasDropoutResidualLayerNorm. The reference fuses these as single
+# CUDA kernels; under XLA the SAME composition compiles into fused HLO
+# (that is the one-compiler design), so these classes provide the API
+# contract over the existing layers — the fusion itself is the
+# compiler's.
+
+from paddle_tpu.nn import Linear as FusedLinear  # noqa: E402,F401
+from paddle_tpu.nn.layer import Layer as _Layer  # noqa: E402
+from paddle_tpu.nn.transformer import (  # noqa: E402
+    MultiHeadAttention as _MHA,
+    TransformerEncoderLayer as _EncLayer,
+)
+
+
+class FusedMultiHeadAttention(_MHA):
+    """Reference FusedMultiHeadAttention: attention + bias + dropout +
+    residual + layer_norm in one op. XLA fuses the composition; the
+    pre/post-LN + residual contract matches the reference."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 weight_attr=None, bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        if (kdim is not None and kdim != embed_dim) or \
+                (vdim is not None and vdim != embed_dim):
+            raise NotImplementedError(
+                "FusedMultiHeadAttention requires kdim == vdim == "
+                "embed_dim (cross-dim projections not supported)")
+        if need_weights:
+            raise NotImplementedError(
+                "FusedMultiHeadAttention need_weights=True is not "
+                "supported")
+        super().__init__(embed_dim, num_heads,
+                         dropout=attn_dropout_rate)
+        from paddle_tpu import nn as _nn
+
+        self.normalize_before = normalize_before
+        self.ln = _nn.LayerNorm(embed_dim, epsilon=epsilon)
+        self.out_dropout = _nn.Dropout(dropout_rate)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        residual = query
+        x = self.ln(query) if self.normalize_before else query
+        out = super().forward(x, key, value, attn_mask=attn_mask)
+        out = residual + self.out_dropout(out)
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedFeedForward(_Layer):
+    """Reference FusedFeedForward: linear-act-dropout-linear-dropout +
+    residual + LN."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        from paddle_tpu import nn as _nn
+
+        self.linear1 = _nn.Linear(d_model, dim_feedforward)
+        self.linear2 = _nn.Linear(dim_feedforward, d_model)
+        self.ln = _nn.LayerNorm(d_model, epsilon=epsilon)
+        self.dropout = _nn.Dropout(
+            act_dropout_rate if act_dropout_rate is not None
+            else dropout_rate)
+        self.out_dropout = _nn.Dropout(dropout_rate)
+        self.activation = (_nn.ReLU() if activation == "relu"
+                           else _nn.GELU())
+        self.normalize_before = normalize_before
+
+    def forward(self, src, cache=None):
+        residual = src
+        x = self.ln(src) if self.normalize_before else src
+        x = self.linear2(self.dropout(self.activation(self.linear1(x))))
+        out = residual + self.out_dropout(x)
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(_EncLayer):
+    """Reference FusedTransformerEncoderLayer — same contract as
+    nn.TransformerEncoderLayer; the 'fusion' is XLA's."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__(d_model, nhead, dim_feedforward,
+                         dropout=dropout_rate, activation=activation,
+                         attn_dropout=attn_dropout_rate,
+                         act_dropout=act_dropout_rate,
+                         normalize_before=normalize_before)
+
+
+class FusedMultiTransformer(_Layer):
+    """Reference FusedMultiTransformer: a stack of fused encoder layers
+    driven by one call (the serving-path block stack)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu",
+                 normalize_before=True, num_layers=1, nranks=1,
+                 ring_id=-1, name=None, **kw):
+        super().__init__()
+        from paddle_tpu import nn as _nn
+
+        self.layers = _nn.LayerList([
+            FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward,
+                dropout_rate=dropout_rate, activation=activation,
+                normalize_before=normalize_before)
+            for _ in range(num_layers)])
+
+    def forward(self, src, attn_mask=None, caches=None, **kw):
+        out = src
+        for layer in self.layers:
+            out = layer(out, attn_mask)
+        return out
+
+
+class FusedDropoutAdd(_Layer):
+    """Reference FusedDropoutAdd: y = x + dropout(residual-path)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        from paddle_tpu import nn as _nn
+
+        self.dropout = _nn.Dropout(p, mode=mode)
+
+    def forward(self, x, y):
+        return self.dropout(x) + y
+
+
+class FusedBiasDropoutResidualLayerNorm(_Layer):
+    """Reference FusedBiasDropoutResidualLayerNorm:
+    LN(residual + dropout(x + bias))."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        from paddle_tpu import nn as _nn
+
+        self.bias = self.create_parameter([embed_dim], is_bias=True)
+        self.dropout = _nn.Dropout(dropout_rate)
+        self.ln = _nn.LayerNorm(embed_dim, epsilon=epsilon)
+
+    def forward(self, x, residual):
+        return self.ln(residual + self.dropout(x + self.bias))

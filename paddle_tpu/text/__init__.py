@@ -69,3 +69,126 @@ from paddle_tpu.text.viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401
 from paddle_tpu.text.ops import (  # noqa: F401,E402
     chunk_eval, crf_decoding, ctc_align, edit_distance, rnnt_loss,
 )
+
+
+# ------------------- round-5: reference text dataset classes ------------
+# (reference python/paddle/text/datasets/ — Conll05st, Imdb, Imikolov,
+# Movielens, UCIHousing, WMT14, WMT16). Zero-egress box: each loads from
+# a local data_file when provided, else yields a deterministic synthetic
+# sample set with the real field structure.
+
+import os as _os
+import pickle as _pickle
+import zlib as _zlib
+
+import numpy as _np
+
+from paddle_tpu.io import Dataset as _Dataset
+
+
+class _LocalOrSyntheticText(_Dataset):
+    FIELDS = 2          # items per sample
+    VOCAB = 1000
+    LEN = 16
+
+    def __init__(self, data_file=None, mode="train", n=64, seed=0,
+                 **kwargs):
+        self.mode = mode
+        if data_file and _os.path.exists(data_file):
+            with open(data_file, "rb") as f:
+                self.samples = _pickle.load(f)
+        else:
+            rng = _np.random.default_rng(
+                (seed + _zlib.crc32(mode.encode())) % 2 ** 31)
+            self.samples = [
+                tuple(rng.integers(0, self.VOCAB, self.LEN)
+                      .astype(_np.int64) for _ in range(self.FIELDS))
+                for _ in range(n)]
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+
+class Conll05st(_LocalOrSyntheticText):
+    """SRL dataset (reference text/datasets/conll05.py): word, predicate,
+    ctx windows + mark + labels."""
+
+    FIELDS = 9
+
+
+class Imdb(_LocalOrSyntheticText):
+    """IMDB sentiment (reference imdb.py): (doc tokens, 0/1 label)."""
+
+    def __getitem__(self, idx):
+        doc, _ = self.samples[idx]
+        return doc, _np.int64(int(doc.sum()) % 2)
+
+
+class Imikolov(_LocalOrSyntheticText):
+    """PTB-style n-gram LM dataset (reference imikolov.py)."""
+
+    FIELDS = 1
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, **kw):
+        self.window_size = window_size
+        super().__init__(data_file, mode, **kw)
+
+    def __getitem__(self, idx):
+        (tokens,) = self.samples[idx]
+        return tuple(tokens[: self.window_size])
+
+
+class Movielens(_LocalOrSyntheticText):
+    """MovieLens ratings (reference movielens.py): user/movie features +
+    score."""
+
+    def __getitem__(self, idx):
+        a, b = self.samples[idx]
+        return (a[:1], a[1:2], a[2:3], b[:4],
+                _np.float32(float(a[0] % 5) + 1.0))
+
+
+class UCIHousing(_Dataset):
+    """Boston housing regression (reference uci_housing.py): 13 features
+    + price."""
+
+    def __init__(self, data_file=None, mode="train", **kw):
+        if data_file and _os.path.exists(data_file):
+            arr = _np.load(data_file)
+        else:
+            # ONE generating model for both splits (fixed seed), rows
+            # split train/test — so a regressor fit on train generalizes
+            rng = _np.random.default_rng(1337)
+            x = rng.standard_normal((506, 13)).astype(_np.float32)
+            w = rng.standard_normal((13, 1)).astype(_np.float32)
+            full = _np.concatenate([x, x @ w], axis=1)
+            arr = full[:404] if mode == "train" else full[404:]
+        self.data = arr.astype(_np.float32)
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:13], row[13:]
+
+
+class WMT14(_LocalOrSyntheticText):
+    """WMT14 en-fr (reference wmt14.py): (src ids, trg ids, trg_next
+    ids)."""
+
+    FIELDS = 3
+
+
+class WMT16(_LocalOrSyntheticText):
+    """WMT16 en-de (reference wmt16.py)."""
+
+    FIELDS = 3
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", **kw):
+        super().__init__(data_file, mode, **kw)

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import zlib
 from typing import Callable, Optional
 
 import numpy as np
@@ -187,3 +188,114 @@ class ImageFolder(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+class DatasetFolder(Dataset):
+    """Reference datasets/folder.py DatasetFolder: root/<class>/<file>
+    layout with per-class subdirectories."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _default_loader
+        exts = tuple(extensions or (".jpg", ".jpeg", ".png", ".bmp",
+                                    ".npy"))
+        self.classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(self.classes)}
+        self.samples = []
+        for c in self.classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                path = os.path.join(cdir, fn)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fn.lower().endswith(exts))
+                if ok:
+                    self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no samples found under {root}")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform:
+            img = self.transform(img)
+        return img, label
+
+
+def _default_loader(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    from PIL import Image
+
+    return np.asarray(Image.open(path).convert("RGB"))
+
+
+class Flowers(_SyntheticImageDataset):
+    """Flowers-102 (reference datasets/flowers.py). Zero-egress box:
+    loads from local data_file when given, else deterministic synthetic
+    samples with the real shape/classes."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False,
+                 backend=None):
+        if data_file and os.path.exists(data_file):
+            with open(data_file, "rb") as f:
+                blob = pickle.load(f)
+            self.images, self.labels = blob["images"], blob["labels"]
+            self.num_samples = len(self.images)
+            self.transform = transform
+            self._local = True
+        else:
+            self._local = False
+            super().__init__(64 if mode == "train" else 16,
+                             (3, 96, 96), 102, transform=transform,
+                             seed=zlib.crc32(mode.encode()) % 2 ** 31)
+
+    def __getitem__(self, idx):
+        if not self._local:
+            return super().__getitem__(idx)
+        img, label = self.images[idx], self.labels[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.num_samples
+
+
+class VOC2012(_SyntheticImageDataset):
+    """VOC2012 segmentation (reference datasets/voc2012.py): (image,
+    mask) pairs. Synthetic fallback mirrors the real shapes."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.transform = transform
+        if data_file and os.path.exists(data_file):
+            with open(data_file, "rb") as f:
+                blob = pickle.load(f)
+            self.images, self.masks = blob["images"], blob["masks"]
+            self._local = True
+            self.num_samples = len(self.images)
+        else:
+            self._local = False
+            self.num_samples = 32 if mode == "train" else 8
+            rng = np.random.default_rng(zlib.crc32(mode.encode()) % 2 ** 31)
+            self.images = rng.integers(
+                0, 256, (self.num_samples, 3, 128, 128), dtype=np.uint8)
+            self.masks = rng.integers(
+                0, 21, (self.num_samples, 128, 128), dtype=np.uint8)
+
+    def __len__(self):
+        return self.num_samples
+
+    def __getitem__(self, idx):
+        img, mask = self.images[idx], self.masks[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, mask

@@ -159,6 +159,30 @@ def resnext50_32x4d(pretrained=False, **kwargs):
     return _resnet(50, pretrained, groups=32, width=4, **kwargs)
 
 
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return _resnet(50, pretrained, groups=64, width=4, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return _resnet(101, pretrained, groups=32, width=4, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return _resnet(101, pretrained, groups=64, width=4, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return _resnet(152, pretrained, groups=32, width=4, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return _resnet(152, pretrained, groups=64, width=4, **kwargs)
+
+
+def wide_resnet101_2(pretrained=False, **kwargs):
+    return _resnet(101, pretrained, width=128, **kwargs)
+
+
 class LeNet(Layer):
     """Reference: vision/models/lenet.py."""
 
@@ -215,6 +239,14 @@ def _make_vgg_layers(cfg, batch_norm=False):
             layers.append(nn.ReLU())
             in_c = v
     return Sequential(*layers)
+
+
+def vgg11(pretrained=False, batch_norm=False, **kwargs):
+    return VGG(_make_vgg_layers(_VGG_CFG[11], batch_norm), **kwargs)
+
+
+def vgg13(pretrained=False, batch_norm=False, **kwargs):
+    return VGG(_make_vgg_layers(_VGG_CFG[13], batch_norm), **kwargs)
 
 
 def vgg16(pretrained=False, batch_norm=False, **kwargs):
@@ -307,9 +339,10 @@ def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
 
 from paddle_tpu.vision.models_extra import (  # noqa: E402,F401
     AlexNet, DenseNet, GoogLeNet, InceptionV3, MobileNetV1, MobileNetV3,
-    ShuffleNetV2, SqueezeNet, alexnet, densenet121, densenet161, densenet169,
-    densenet201, googlenet, inception_v3, mobilenet_v1, mobilenet_v3_large,
-    mobilenet_v3_small, shufflenet_v2_x0_25, shufflenet_v2_x0_5,
-    shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0,
-    squeezenet1_0, squeezenet1_1,
+    MobileNetV3Large, MobileNetV3Small, ShuffleNetV2, SqueezeNet, alexnet,
+    densenet121, densenet161, densenet169, densenet201, densenet264,
+    googlenet, inception_v3, mobilenet_v1, mobilenet_v3_large,
+    mobilenet_v3_small, shufflenet_v2_swish, shufflenet_v2_x0_25,
+    shufflenet_v2_x0_33, shufflenet_v2_x0_5, shufflenet_v2_x1_0,
+    shufflenet_v2_x1_5, shufflenet_v2_x2_0, squeezenet1_0, squeezenet1_1,
 )

@@ -153,7 +153,8 @@ class _Transition(Layer):
 
 
 _DENSE_CFG = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
-              169: (6, 12, 32, 32), 201: (6, 12, 48, 32)}
+              169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+              264: (6, 12, 64, 48)}
 
 
 class DenseNet(Layer):
@@ -205,6 +206,10 @@ def densenet169(pretrained=False, **kw):
 
 def densenet201(pretrained=False, **kw):
     return DenseNet(201, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return DenseNet(264, **kw)
 
 
 # ----------------------------------------------------------------- GoogLeNet
@@ -371,6 +376,7 @@ class _ShuffleUnit(Layer):
 
 
 _SHUFFLE_CFG = {
+    0.33: (32, 64, 128, 512),
     0.25: (24, 48, 96, 512),
     0.5: (48, 96, 192, 1024),
     1.0: (116, 232, 464, 1024),
@@ -382,10 +388,12 @@ _SHUFFLE_CFG = {
 class ShuffleNetV2(Layer):
     """Reference: models/shufflenetv2.py."""
 
-    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True,
+                 act="relu"):
         super().__init__()
         c1, c2, c3, cout = _SHUFFLE_CFG[scale]
-        self.stem = Sequential(_conv_bn(3, 24, 3, stride=2, padding=1),
+        self.stem = Sequential(_conv_bn(3, 24, 3, stride=2, padding=1,
+                                        act=act),
                                nn.MaxPool2D(3, stride=2, padding=1))
         stages = []
         cin = 24
@@ -395,7 +403,7 @@ class ShuffleNetV2(Layer):
                 stages.append(_ShuffleUnit(cstage, cstage, 1))
             cin = cstage
         self.stages = Sequential(*stages)
-        self.final = _conv_bn(cin, cout, 1)
+        self.final = _conv_bn(cin, cout, 1, act=act)
         self.fc = nn.Linear(cout, num_classes)
 
     def forward(self, x):
@@ -406,6 +414,16 @@ class ShuffleNetV2(Layer):
 
 def shufflenet_v2_x0_25(pretrained=False, **kw):
     return ShuffleNetV2(scale=0.25, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return ShuffleNetV2(scale=0.33, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    """Swish-activated variant (reference shufflenet_v2_swish; hardswish
+    is the MXU-friendly lowering the repo uses for swish acts)."""
+    return ShuffleNetV2(scale=1.0, act="hardswish", **kw)
 
 
 def shufflenet_v2_x0_5(pretrained=False, **kw):
@@ -564,3 +582,17 @@ def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
     return MobileNetV3(config="large", scale=scale, **kw)
+
+
+class MobileNetV3Small(MobileNetV3):
+    """Reference models/mobilenetv3.py MobileNetV3Small."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(config="small", scale=scale,
+                         num_classes=num_classes, with_pool=with_pool)
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(config="large", scale=scale,
+                         num_classes=num_classes, with_pool=with_pool)

@@ -355,3 +355,270 @@ class RandomResizedCrop:
         else:
             crop = arr
         return Resize(self.size)(crop)
+
+
+# ================== round-5: functional forms + affine/perspective ======
+# Reference: python/paddle/vision/transforms/functional.py — the
+# functional surface the class transforms are defined over. Host-side
+# numpy like everything above.
+
+
+def _arr(img):
+    return np.asarray(img)
+
+
+def to_tensor(pic, data_format="CHW"):
+    return ToTensor(data_format)(pic)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def crop(img, top, left, height, width):
+    return _arr(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
+
+
+def hflip(img):
+    return _arr(img)[:, ::-1]
+
+
+def vflip(img):
+    return _arr(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    return Pad(padding, fill, padding_mode)(img)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    if expand or center is not None:
+        raise NotImplementedError(
+            "rotate: expand/center are not supported (center rotation on "
+            "the original canvas only)")
+    t = RandomRotation((angle, angle), fill=fill)
+    return t(img)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _arr(img).astype(np.float32) * brightness_factor
+    return _clip_like(arr, img)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _arr(img).astype(np.float32)
+    mean = arr.mean()
+    return _clip_like(mean + (arr - mean) * contrast_factor, img)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5]) via HSV round-trip."""
+    assert -0.5 <= hue_factor <= 0.5
+    arr = _arr(img).astype(np.float32)
+    scale = 255.0 if arr.max() > 1.5 else 1.0
+    rgb = arr / scale
+    mx = rgb.max(-1)
+    mn = rgb.min(-1)
+    diff = mx - mn + 1e-12
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    h = np.where(mx == r, ((g - b) / diff) % 6,
+                 np.where(mx == g, (b - r) / diff + 2,
+                          (r - g) / diff + 4)) / 6.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0.0)
+    v = mx
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6).astype(int) % 6
+    f = h * 6 - np.floor(h * 6)
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    choices = np.stack([
+        np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+        np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+        np.stack([t, p, v], -1), np.stack([v, p, q], -1)], 0)
+    out = np.take_along_axis(
+        choices, i[None, ..., None].repeat(3, -1), 0)[0]
+    return _clip_like(out * scale, img)
+
+
+def _clip_like(arr, img):
+    ref = _arr(img)
+    if np.issubdtype(ref.dtype, np.integer):
+        return np.clip(arr, 0, 255).astype(ref.dtype)
+    return arr.astype(np.float32)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    arr = _arr(img) if inplace else _arr(img).copy()
+    arr[i:i + h, j:j + w] = v
+    return arr
+
+
+def _grid_sample_nearest(arr, xs, ys, fill=0):
+    """Nearest-neighbor inverse-map: out[y, x] = arr[ys[y,x], xs[y,x]]
+    where in bounds, `fill` elsewhere. Shared by affine + perspective."""
+    h, w = arr.shape[:2]
+    yy, xx = np.mgrid[0:h, 0:w]
+    xsi = np.round(xs).astype(int)
+    ysi = np.round(ys).astype(int)
+    ok = (ysi >= 0) & (ysi < h) & (xsi >= 0) & (xsi < w)
+    out = np.full_like(arr, fill)
+    out[yy[ok], xx[ok]] = arr[ysi[ok], xsi[ok]]
+    return out
+
+
+def _affine_grid_sample(arr, matrix, fill=0):
+    """Inverse-map a 2x3 affine matrix over HWC numpy (nearest)."""
+    h, w = arr.shape[:2]
+    yy, xx = np.mgrid[0:h, 0:w]
+    cy, cx = (h - 1) / 2, (w - 1) / 2
+    xs = matrix[0, 0] * (xx - cx) + matrix[0, 1] * (yy - cy) + \
+        matrix[0, 2] + cx
+    ys = matrix[1, 0] * (xx - cx) + matrix[1, 1] * (yy - cy) + \
+        matrix[1, 2] + cy
+    return _grid_sample_nearest(arr, xs, ys, fill)
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="nearest", fill=0, center=None):
+    """Affine transform (reference functional.affine): rotation +
+    translation + scale + shear, inverse-mapped."""
+    arr = _arr(img)
+    a = np.deg2rad(angle)
+    sx, sy = (np.deg2rad(s) for s in
+              (shear if isinstance(shear, (list, tuple))
+               else (shear, 0.0)))
+    # forward matrix: R(angle) @ Shear @ diag(scale), then invert
+    m = np.array([
+        [np.cos(a + sy) * scale, -np.sin(a + sx) * scale, translate[0]],
+        [np.sin(a + sy) * scale, np.cos(a + sx) * scale, translate[1]],
+        [0, 0, 1.0]])
+    inv = np.linalg.inv(m)
+    return _affine_grid_sample(arr, inv[:2], fill=fill)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Perspective warp mapping startpoints -> endpoints (reference
+    functional.perspective; inverse-mapped homography)."""
+    arr = _arr(img)
+    A = []
+    b = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        A.append([sx, sy, 1, 0, 0, 0, -ex * sx, -ex * sy])
+        b.append(ex)
+        A.append([0, 0, 0, sx, sy, 1, -ey * sx, -ey * sy])
+        b.append(ey)
+    coeffs = np.linalg.solve(np.asarray(A, np.float64),
+                             np.asarray(b, np.float64))
+    H = np.append(coeffs, 1.0).reshape(3, 3)
+    Hinv = np.linalg.inv(H)
+    h, w = arr.shape[:2]
+    yy, xx = np.mgrid[0:h, 0:w]
+    denom = Hinv[2, 0] * xx + Hinv[2, 1] * yy + Hinv[2, 2]
+    xs = (Hinv[0, 0] * xx + Hinv[0, 1] * yy + Hinv[0, 2]) / denom
+    ys = (Hinv[1, 0] * xx + Hinv[1, 1] * yy + Hinv[1, 2]) / denom
+    return _grid_sample_nearest(arr, xs, ys, fill)
+
+
+class BaseTransform:
+    """Reference transforms.BaseTransform: keys-aware transform base —
+    subclasses implement _apply_image (and optionally _apply_boxes /
+    _apply_mask); __call__ routes each input per `keys`."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def _get_params(self, inputs):
+        return None
+
+    def __call__(self, inputs):
+        single = not isinstance(inputs, (tuple, list))
+        items = (inputs,) if single else tuple(inputs)
+        self.params = self._get_params(items)
+        outs = []
+        for i, item in enumerate(items):
+            # inputs beyond len(keys) pass through untouched (reference
+            # BaseTransform contract — labels must not be dropped)
+            key = self.keys[i] if i < len(self.keys) else None
+            apply = getattr(self, f"_apply_{key}", None) if key else None
+            outs.append(apply(item) if apply else item)
+        return outs[0] if single else tuple(outs)
+
+
+class RandomAffine(BaseTransform):
+    """Random affine (reference RandomAffine)."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, (int, float)):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _arr(img)
+        h, w = arr.shape[:2]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0],
+                                   self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1],
+                                   self.translate[1]) * h
+        sc = (np.random.uniform(*self.scale) if self.scale else 1.0)
+        sh = (np.random.uniform(*self.shear)
+              if self.shear is not None else 0.0)
+        return affine(arr, angle=angle, translate=(tx, ty), scale=sc,
+                      shear=(sh, 0.0), fill=self.fill)
+
+
+class RandomPerspective(BaseTransform):
+    """Random perspective warp (reference RandomPerspective)."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _arr(img)
+        if np.random.random() > self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        dx, dy = int(w * d / 2), int(h * d / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(np.random.randint(0, dx + 1),
+                np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1)),
+               (np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1))]
+        return perspective(arr, start, end, fill=self.fill)
+
+
+def to_grayscale(img, num_output_channels=1):
+    """ITU-R 601-2 luma grayscale (reference functional.to_grayscale)."""
+    arr = _arr(img).astype(np.float32)
+    gray = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+            + 0.114 * arr[..., 2])[..., None]
+    out = np.repeat(gray, num_output_channels, axis=-1)
+    return _clip_like(out, img)
