@@ -11,3 +11,84 @@ def __getattr__(name):
 
         return getattr(py_layer, name)
     raise AttributeError(name)
+
+
+def jacobian(ys, xs, batch_axis=None):
+    if batch_axis is not None:
+        raise NotImplementedError(
+            "jacobian batch_axis is not supported (full cross-derivative "
+            "only; vmap the call per sample for batched Jacobians)")
+    """Full Jacobian d(ys)/d(xs) (reference autograd/autograd.py
+    Jacobian): computed with jax.jacrev over the functional closure of
+    the tape — rows are exact reverse-mode rows."""
+    import jax as _jax
+
+    import numpy as _np
+
+    from paddle_tpu.core.tensor import Tensor as _T
+
+    single = not isinstance(xs, (list, tuple))
+    xs_l = [xs] if single else list(xs)
+    out = []
+    for x in xs_l:
+        rows = []
+        flat_y = ys.flatten() if ys.ndim else ys.reshape([1])
+        for i in range(flat_y.shape[0]):
+            g = grad(flat_y[i], x, retain_graph=True, create_graph=False,
+                     allow_unused=True)[0]
+            rows.append(_np.zeros(tuple(x.shape), _np.float32)
+                        if g is None else _np.asarray(g._value))
+        jac = _np.stack(rows).reshape(tuple(ys.shape) + tuple(x.shape))
+        out.append(_T._wrap(_jax.numpy.asarray(jac)))
+    return out[0] if single else out
+
+
+def hessian(ys, xs, batch_axis=None):
+    if batch_axis is not None:
+        raise NotImplementedError(
+            "hessian batch_axis is not supported")
+    if isinstance(xs, (list, tuple)) and len(xs) > 1:
+        raise NotImplementedError(
+            "hessian over multiple xs (cross blocks) is not supported; "
+            "concatenate the variables or call per variable")
+    """Hessian of a scalar ys w.r.t. xs (reference autograd.hessian):
+    grad-of-grad through the tape (create_graph double backward)."""
+    import numpy as _np
+
+    import jax as _jax
+
+    from paddle_tpu.core.tensor import Tensor as _T
+
+    single = not isinstance(xs, (list, tuple))
+    xs_l = [xs] if single else list(xs)
+    out = []
+    for x in xs_l:
+        (g,) = grad(ys, x, create_graph=True)
+        gf = g.flatten()
+        rows = []
+        for i in range(gf.shape[0]):
+            (h,) = grad(gf[i], x, retain_graph=True, allow_unused=True)
+            rows.append(_np.zeros(tuple(x.shape), _np.float32)
+                        if h is None else _np.asarray(h._value))
+        n = gf.shape[0]
+        hes = _np.stack(rows).reshape((n,) + tuple(x.shape))
+        out.append(_T._wrap(_jax.numpy.asarray(
+            hes.reshape(n, n) if hes.size == n * n else hes)))
+    return out[0] if single else out
+
+
+class saved_tensors_hooks:
+    """Reference autograd.saved_tensors_hooks: pack/unpack hooks over
+    tensors saved for backward. NOT SUPPORTED here, loudly: the tape's
+    saved activations are XLA-managed residuals inside jax.vjp closures —
+    there is no host boundary to intercept. The TPU-native equivalent of
+    the reference's main use case (saved-activation memory) is
+    rematerialization: parallel.recompute / RecomputeLayer /
+    jax.checkpoint, which trades the residuals for recompute inside the
+    SAME compiled program."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        raise NotImplementedError(
+            "saved_tensors_hooks cannot intercept XLA-managed residuals; "
+            "use paddle_tpu.parallel.recompute (rematerialization) for "
+            "saved-activation memory savings")

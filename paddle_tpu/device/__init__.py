@@ -76,3 +76,67 @@ from paddle_tpu.device.manager import (  # noqa: E402,F401
     is_compiled_with_custom_device, load_custom_runtime_libs,
     register_custom_device, register_pjrt_plugin,
 )
+
+
+# --------------------- round-5: reference device __all__ completion -----
+
+class XPUPlace:  # pragma: no cover - non-TPU hardware shims
+    """Kunlun place shim (no XPU backend in this build)."""
+
+    def __init__(self, dev_id=0):
+        self.dev_id = dev_id
+
+
+class IPUPlace:  # pragma: no cover
+    def __init__(self, dev_id=0):
+        self.dev_id = dev_id
+
+
+def get_available_custom_device():
+    """Custom (PluggableDevice) devices visible to PJRT (reference
+    device.get_available_custom_device)."""
+    import jax
+
+    out = []
+    for d in jax.devices():
+        if d.platform not in ("cpu", "gpu", "tpu"):
+            out.append(f"{d.platform}:{d.id}")
+    return out
+
+
+def get_cudnn_version():
+    """No cuDNN in the XLA/TPU build (reference returns None when not
+    compiled with CUDA)."""
+    return None
+
+
+def is_compiled_with_cinn() -> bool:
+    """CINN's role is played by XLA here — every program is compiled, so
+    the honest answer to 'is the compiler available' is True."""
+    return True
+
+
+def is_compiled_with_distribute() -> bool:
+    return True
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def set_stream(stream=None):
+    """Streams collapse onto PJRT's async dispatch (COVERAGE 'Device
+    contexts'); accepted for API parity, returns the previous stream."""
+    return None
+
+
+import contextlib as _ctx  # noqa: E402
+
+
+@_ctx.contextmanager
+def stream_guard(stream=None):
+    yield

@@ -117,3 +117,39 @@ from paddle_tpu.geometric.sampling import (  # noqa: F401,E402
     khop_sampler, reindex_graph, sample_neighbors, send_uv,
     weighted_sample_neighbors,
 )
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous-graph reindex (reference
+    geometric.reindex_heter_graph): per-relation neighbor lists share ONE
+    node mapping. Relations are reindexed one by one against the mapping
+    accumulated over all of them, preserving each relation's per-node
+    counts (per-relation dst stays correct for non-uniform counts)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.core.tensor import Tensor
+
+    xv = np.asarray(x._value if isinstance(x, Tensor) else x)
+    ns = [np.asarray(n._value if isinstance(n, Tensor) else n)
+          for n in neighbors]
+    cs = [np.asarray(c._value if isinstance(c, Tensor) else c)
+          for c in count]
+    # one shared mapping: input nodes first, then first-seen neighbors
+    mapping = {int(v): i for i, v in enumerate(xv)}
+    order = list(xv)
+    for n in ns:
+        for v in n:
+            if int(v) not in mapping:
+                mapping[int(v)] = len(order)
+                order.append(int(v))
+    reindexed = []
+    dsts = []
+    for n, c in zip(ns, cs):
+        reindexed.append(Tensor._wrap(jnp.asarray(
+            [mapping[int(v)] for v in n], dtype=jnp.int32)))
+        dsts.append(Tensor._wrap(jnp.asarray(
+            np.repeat(np.arange(len(xv)), c), dtype=jnp.int32)))
+    nodes = Tensor._wrap(jnp.asarray(order, dtype=jnp.int32))
+    return reindexed, dsts, nodes

@@ -78,8 +78,12 @@ class FusedFeedForward(_Layer):
             act_dropout_rate if act_dropout_rate is not None
             else dropout_rate)
         self.out_dropout = _nn.Dropout(dropout_rate)
-        self.activation = (_nn.ReLU() if activation == "relu"
-                           else _nn.GELU())
+        acts = {"relu": _nn.ReLU, "gelu": _nn.GELU,
+                "silu": _nn.Silu, "swish": _nn.Silu}
+        if activation not in acts:
+            raise ValueError(f"unknown activation {activation!r} "
+                             f"(one of {sorted(acts)})")
+        self.activation = acts[activation]()
         self.normalize_before = normalize_before
 
     def forward(self, src, cache=None):

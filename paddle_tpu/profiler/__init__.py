@@ -231,3 +231,55 @@ def export_chrome_tracing(dir_name: str, worker_name: str = None):
         prof.export_chrome_tracing(os.path.join(dir_name, fname))
 
     return handler
+
+
+# --------------------- round-5: reference profiler __all__ completion ---
+
+from enum import Enum as _Enum
+
+
+class SortedKeys(_Enum):
+    """Reference profiler SortedKeys — summary table sort orders."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(_Enum):
+    """Reference profiler SummaryView — which summary tables to show."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(profiler_result, path):
+    """Persist a profiler result (reference export_protobuf). The chrome
+    trace JSON is the wire format here (one-compiler design: XLA's
+    profiler speaks chrome-trace natively); the file is self-describing
+    and load_profiler_result round-trips it."""
+    import json
+
+    data = (profiler_result if isinstance(profiler_result, dict)
+            else getattr(profiler_result, "trace", profiler_result))
+    with open(path, "w") as f:
+        json.dump(data, f)
+
+
+def load_profiler_result(path):
+    import json
+
+    with open(path) as f:
+        return json.load(f)

@@ -51,3 +51,66 @@ def rfftfreq(n, d=1.0, dtype=None):
     from paddle_tpu.core.tensor import Tensor
 
     return Tensor._wrap(jnp.fft.rfftfreq(n, d).astype(dtype or jnp.float32))
+
+
+# ------------------- round-5: n-dimensional variants (reference fft.py)
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    from paddle_tpu.extras import _dop
+
+    return _dop("rfftn", lambda v: jnp.fft.rfftn(v, s=s, axes=axes,
+                                                 norm=norm), x)
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    from paddle_tpu.extras import _dop
+
+    return _dop("irfftn", lambda v: jnp.fft.irfftn(v, s=s, axes=axes,
+                                                   norm=norm), x)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Hermitian-input n-dim FFT: conj-symmetric input, real output
+    (reference fft.hfftn) — irfftn of the conjugate scaled to hfft
+    conventions."""
+    from paddle_tpu.extras import _dop
+
+    def impl(v):
+        axes_ = axes if axes is not None else tuple(range(v.ndim))
+        return _hfftn_manual(v, s, axes_, norm)
+
+    return _dop("hfftn", impl, x)
+
+
+def _hfftn_manual(v, s, axes_, norm):
+    out = v
+    for ax in axes_[:-1]:
+        out = jnp.fft.fft(out, n=(None if s is None else
+                                  s[axes_.index(ax)]), axis=ax, norm=norm)
+    return jnp.fft.hfft(out, n=(None if s is None else s[-1]),
+                        axis=axes_[-1], norm=norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    from paddle_tpu.extras import _dop
+
+    def impl(v):
+        axes_ = axes if axes is not None else tuple(range(v.ndim))
+        out = v
+        out = jnp.fft.ihfft(out, n=(None if s is None else s[-1]),
+                            axis=axes_[-1], norm=norm)
+        for ax in axes_[:-1]:
+            out = jnp.fft.ifft(out, n=(None if s is None else
+                                       s[axes_.index(ax)]), axis=ax,
+                               norm=norm)
+        return out
+
+    return _dop("ihfftn", impl, x)

@@ -78,7 +78,7 @@ def _lstsq(x, y, rcond=None, driver=None):
     return sol, res, rank, sv
 
 
-def _lu(x, pivot=True):
+def _lu_0based_unused(x, pivot=True):  # superseded: see below
     import jax.scipy.linalg as jsl
 
     lu, piv = jsl.lu_factor(x)
@@ -113,7 +113,10 @@ det = _register("linalg_det", _det)
 pinv = _register("linalg_pinv", _pinv)
 solve = _register("linalg_solve", _solve)
 lstsq = _register("linalg_lstsq", _lstsq)
-lu = _register("linalg_lu", _lu)
+# the canonical lu is the registered op (1-based LAPACK pivots,
+# (lu, pivots, info) — reference phi LuKernel); linalg.lu aliases it so
+# Tensor.lu() and linalg.lu() agree
+lu = make_op_function("lu")
 cond = _register("linalg_cond", _cond)
 cov = _register("linalg_cov", _cov)
 householder_product = _register("linalg_householder_product",
@@ -126,3 +129,98 @@ matmul = _C.matmul
 dot = _C.dot
 multi_dot = _register("linalg_multi_dot",
                       lambda xs: jnp.linalg.multi_dot(xs))
+
+
+# ---------------------- round-5: reference paddle/linalg.py completion --
+
+from paddle_tpu.core.tensor import Tensor as _T  # noqa: E402
+from paddle_tpu.extras import (  # noqa: E402,F401
+    cholesky_inverse, corrcoef, matrix_transpose, ormqr, pca_lowrank,
+    svd_lowrank, vecdot,
+)
+from paddle_tpu.ops.registry import C_OPS as _C  # noqa: E402
+
+cross = _C.cross
+diagonal = _C.diagonal
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    """Solve A z = x given y = chol(A) (reference linalg.cholesky_solve:
+    note the reference argument order — x is the RHS)."""
+    from paddle_tpu.extras import _dop
+
+    def impl(b, L):
+        # cho_solve's tuple flag is LOWER (paddle's arg is upper)
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+
+    return _dop("cholesky_solve", impl, x, y)
+
+
+# lu_unpack: reuse the registered op (handles the 1-based pivots the
+# canonical lu emits, batched included) — no second implementation
+lu_unpack = make_op_function("lu_unpack")
+
+
+def matrix_exp(x, name=None):
+    from paddle_tpu.extras import _dop
+
+    return _dop("matrix_exp", jax.scipy.linalg.expm, x)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    from paddle_tpu.extras import _dop
+
+    def impl(v):
+        return jnp.linalg.norm(v, ord=p, axis=tuple(axis),
+                               keepdims=keepdim)
+
+    return _dop("matrix_norm", impl, x)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    from paddle_tpu.extras import _dop
+
+    def impl(v):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        return jnp.linalg.norm(v.reshape(-1) if ax is None else v,
+                               ord=p, axis=ax, keepdims=keepdim)
+
+    return _dop("vector_norm", impl, x)
+
+
+def svdvals(x, name=None):
+    from paddle_tpu.extras import _dop
+
+    return _dop("svdvals",
+                lambda v: jnp.linalg.svd(v, compute_uv=False), x)
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
+                            transpose_y=False, scale=1.0,
+                            output_dtype="float16", activation_type=None,
+                            name=None):
+    """fp8 GEMM (reference linalg.fp8_fp8_half_gemm_fused): inputs cast
+    to float8_e4m3fn, accumulated on the MXU, output in half precision —
+    XLA owns the fusion."""
+    from paddle_tpu.core import dtype as _dm
+    from paddle_tpu.extras import _dop
+
+    def impl(a, b, *rest):
+        a8 = a.astype(jnp.float8_e4m3fn)
+        b8 = b.astype(jnp.float8_e4m3fn)
+        if transpose_x:
+            a8 = jnp.swapaxes(a8, -1, -2)
+        if transpose_y:
+            b8 = jnp.swapaxes(b8, -1, -2)
+        out = jnp.matmul(a8.astype(jnp.float32),
+                         b8.astype(jnp.float32)) * scale
+        if rest:
+            out = out + rest[0]
+        if activation_type in ("gelu",):
+            out = jax.nn.gelu(out)
+        elif activation_type in ("relu",):
+            out = jax.nn.relu(out)
+        return out.astype(_dm.to_jax_dtype(output_dtype))
+
+    args = (x, y) + ((bias,) if bias is not None else ())
+    return _dop("fp8_fp8_half_gemm_fused", impl, *args)

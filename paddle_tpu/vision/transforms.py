@@ -566,6 +566,8 @@ class RandomAffine(BaseTransform):
         self.degrees = degrees
         self.translate = translate
         self.scale = scale
+        if isinstance(shear, (int, float)):
+            shear = (-float(shear), float(shear))
         self.shear = shear
         self.fill = fill
 
