@@ -144,3 +144,94 @@ class Assign(Initializer):
             f"Assign initializer shape mismatch: {arr.shape} vs {shape}"
         )
         return jnp.asarray(arr, dtype_mod.to_jax_dtype(dtype))
+
+
+# --------------------- round-5: reference initializer completion --------
+
+import math as _math
+
+
+def calculate_gain(nonlinearity, param=None):
+    """Reference initializer.calculate_gain."""
+    gains = {"sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+             "conv3d": 1.0, "conv_transpose1d": 1.0,
+             "conv_transpose2d": 1.0, "conv_transpose3d": 1.0,
+             "tanh": 5.0 / 3.0, "relu": _math.sqrt(2.0),
+             "selu": 3.0 / 4.0}
+    if nonlinearity == "leaky_relu":
+        neg = 0.01 if param is None else param
+        return _math.sqrt(2.0 / (1 + neg ** 2))
+    if nonlinearity not in gains:
+        raise ValueError(f"unknown nonlinearity {nonlinearity!r}")
+    return gains[nonlinearity]
+
+
+class Orthogonal(Initializer):
+    """Orthogonal init via QR of a gaussian (reference
+    initializer/orthogonal.py)."""
+
+    def __init__(self, gain=1.0, name=None):
+        self.gain = gain
+
+    def __call__(self, shape, dtype="float32"):
+        import numpy as _np
+
+        rows = shape[0]
+        cols = int(_np.prod(shape[1:])) if len(shape) > 1 else 1
+        flat = _np.random.default_rng().standard_normal(
+            (max(rows, cols), min(rows, cols)))
+        q, r = _np.linalg.qr(flat)
+        q = q * _np.sign(_np.diag(r))
+        q = q.T if rows < cols else q
+        return jnp.asarray(self.gain * q[:rows, :cols].reshape(shape),
+                           dtype_mod.to_jax_dtype(dtype))
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv init (reference initializer/dirac.py):
+    delta kernels on the channel diagonal."""
+
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def __call__(self, shape, dtype="float32"):
+        import numpy as _np
+
+        out = _np.zeros(shape, _np.float32)
+        cout, cin = shape[0], shape[1]
+        centers = tuple(s // 2 for s in shape[2:])
+        per = cout // self.groups
+        for g in range(self.groups):
+            for i in range(min(per, cin)):
+                out[(g * per + i, i) + centers] = 1.0
+        return jnp.asarray(out, dtype_mod.to_jax_dtype(dtype))
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsample kernel init (reference initializer/Bilinear) for
+    transposed-conv upsampling layers."""
+
+    def __call__(self, shape, dtype="float32"):
+        import numpy as _np
+
+        k = shape[-1]
+        factor = (k + 1) // 2
+        center = factor - 1 if k % 2 == 1 else factor - 0.5
+        og = _np.ogrid[:k, :k]
+        filt = ((1 - _np.abs(og[0] - center) / factor)
+                * (1 - _np.abs(og[1] - center) / factor))
+        out = _np.zeros(shape, _np.float32)
+        for i in range(min(shape[0], shape[1])):
+            out[i, i] = filt
+        return jnp.asarray(out, dtype_mod.to_jax_dtype(dtype))
+
+
+_GLOBAL_INITIALIZER = [None, None]
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Reference set_global_initializer: default initializers for
+    subsequently created parameters (consumed by create_parameter when no
+    explicit initializer is given)."""
+    _GLOBAL_INITIALIZER[0] = weight_init
+    _GLOBAL_INITIALIZER[1] = bias_init

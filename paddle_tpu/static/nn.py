@@ -1,3 +1,345 @@
 """paddle.static.nn — control flow (reference: static/nn/control_flow.py).
 Maps to lax control-flow ops; usable in both universes."""
 from paddle_tpu.jit.control_flow import cond, switch_case, while_loop  # noqa: F401
+
+# --------------------- round-5: the fluid-style static layer functions --
+# Reference python/paddle/static/nn/__init__.py — create-params-on-trace
+# layer functions (fc, conv2d, ...): each call under a program_guard
+# builds its parameters and applies the layer; the Program's live links
+# capture them (the same one-trace contract the reference's static
+# universe has).
+
+from paddle_tpu import nn as _nn  # noqa: E402
+from paddle_tpu.nn import functional as _F  # noqa: E402
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    in_features = 1
+    for d in x.shape[num_flatten_dims:]:
+        in_features *= d
+    layer = _nn.Linear(in_features, size)
+    flat = (x.flatten(num_flatten_dims)
+            if len(x.shape) > num_flatten_dims + 1 else x)
+    out = layer(flat)
+    if activation:
+        out = getattr(_F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,  # noqa: A002
+              param_attr=None, dtype="float32"):
+    layer = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                          sparse=is_sparse)
+    return layer(input)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCHW"):
+    cin = input.shape[1]
+    layer = _nn.Conv2D(cin, num_filters, filter_size, stride=stride,
+                       padding=padding, dilation=dilation, groups=groups)
+    out = layer(input)
+    return getattr(_F, act)(out) if act else out
+
+
+def conv2d_transpose(input, num_filters, filter_size=None,  # noqa: A002
+                     output_size=None, stride=1, padding=0, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None, act=None,
+                     name=None, data_format="NCHW"):
+    cin = input.shape[1]
+    layer = _nn.Conv2DTranspose(cin, num_filters, filter_size,
+                                stride=stride, padding=padding,
+                                dilation=dilation, groups=groups)
+    out = layer(input)
+    return getattr(_F, act)(out) if act else out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCDHW"):
+    cin = input.shape[1]
+    layer = _nn.Conv3D(cin, num_filters, filter_size, stride=stride,
+                       padding=padding, dilation=dilation, groups=groups)
+    out = layer(input)
+    return getattr(_F, act)(out) if act else out
+
+
+def conv3d_transpose(input, num_filters, filter_size=None,  # noqa: A002
+                     output_size=None, stride=1, padding=0, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None, act=None,
+                     name=None, data_format="NCDHW"):
+    cin = input.shape[1]
+    layer = _nn.Conv3DTranspose(cin, num_filters, filter_size,
+                                stride=stride, padding=padding,
+                                dilation=dilation, groups=groups)
+    out = layer(input)
+    return getattr(_F, act)(out) if act else out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,  # noqa: A002
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               is_test=False, name=None, **kw):
+    c = input.shape[1]
+    nd = len(input.shape)
+    cls = {2: _nn.BatchNorm1D, 3: _nn.BatchNorm1D, 4: _nn.BatchNorm2D,
+           5: _nn.BatchNorm3D}[nd]
+    layer = cls(c, momentum=momentum, epsilon=epsilon)
+    if is_test:
+        layer.eval()
+    out = layer(input)
+    return getattr(_F, act)(out) if act else out
+
+
+def layer_norm(input, scale=True, shift=True,  # noqa: A002
+               begin_norm_axis=1, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, name=None):
+    shape = list(input.shape[begin_norm_axis:])
+    layer = _nn.LayerNorm(shape, epsilon=epsilon)
+    out = layer(input)
+    return getattr(_F, act)(out) if act else out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None,  # noqa: A002
+                  bias_attr=None, name=None):
+    c = input.shape[1]
+    nd = len(input.shape)
+    from paddle_tpu.nn import InstanceNorm1D, InstanceNorm2D, InstanceNorm3D
+
+    cls = {3: InstanceNorm1D, 4: InstanceNorm2D, 5: InstanceNorm3D}[nd]
+    return cls(c, epsilon=epsilon)(input)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,  # noqa: A002
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    layer = _nn.GroupNorm(groups, input.shape[1], epsilon=epsilon)
+    out = layer(input)
+    return getattr(_F, act)(out) if act else out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,  # noqa: A002
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """Reference static.nn.data_norm: normalization by accumulated batch
+    statistics (PS-style CTR models) — batch-stat normalization here."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.extras import _dop
+
+    def impl(v):
+        mu = jnp.mean(v, axis=0, keepdims=True)
+        var = jnp.var(v, axis=0, keepdims=True)
+        return (v - mu) / jnp.sqrt(var + epsilon)
+
+    out = _dop("data_norm", impl, input)
+    return getattr(_F, act)(out) if act else out
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    num = {"all": 1, "channel": x.shape[1],
+           "element": int(__import__("numpy").prod(x.shape[1:]))}[mode]
+    layer = _nn.PReLU(num_parameters=num)
+    return layer(x)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    layer = _nn.Bilinear(x.shape[-1], y.shape[-1], size)
+    out = layer(x, y)
+    return getattr(_F, act)(out) if act else out
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  name=None):
+    from paddle_tpu.vision.ops import DeformConv2D
+
+    layer = DeformConv2D(x.shape[1], num_filters, filter_size,
+                         stride=stride, padding=padding,
+                         dilation=dilation, groups=groups,
+                         deformable_groups=deformable_groups)
+    return layer(x, offset, mask)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,  # noqa: A002
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference static.nn.nce):
+    logistic discrimination of the true class against sampled noise."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.random import default_generator
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.extras import _dop
+
+    d = input.shape[-1]
+    w = _nn.Linear(d, num_total_classes)
+    logits = w(input)
+
+    neg = jax.random.randint(default_generator.next_key(),
+                             (num_neg_samples,), 0, num_total_classes)
+
+    def impl(lg, lbl):
+        pos = jnp.take_along_axis(lg, lbl.reshape(-1, 1), axis=-1)[:, 0]
+        neg_l = lg[:, neg]
+        loss = (jax.nn.softplus(-pos)
+                + jax.nn.softplus(neg_l).sum(-1) / num_neg_samples)
+        return loss.mean()
+
+    return _dop("nce", impl, logits, label)
+
+
+def row_conv(input, future_context_size, param_attr=None,  # noqa: A002
+             act=None):
+    """Lookahead row convolution (reference static.nn.row_conv; DeepSpeech
+    2): y[t] = sum_{k=0..K} x[t+k] * w[k]."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.extras import _dop
+    from paddle_tpu import create_parameter
+
+    K = future_context_size + 1
+    w = create_parameter([K, input.shape[-1]], "float32")
+
+    def impl(v, wv):
+        pads = [(0, 0)] * v.ndim
+        pads[-2] = (0, K - 1)
+        vp = jnp.pad(v, pads)
+        T = v.shape[-2]
+        out = sum(vp[..., k:k + T, :] * wv[k] for k in range(K))
+        return out
+
+    out = _dop("row_conv", impl, input, w)
+    return getattr(_F, act)(out) if act else out
+
+
+def sequence_conv(input, num_filters, filter_size=3, stride=1,  # noqa: A002
+                  padding=True, padding_start=None, act=None,
+                  param_attr=None, bias_attr=None, name=None):
+    """Sequence convolution over [B, T, C] (reference
+    static.nn.sequence_conv on LoD sequences; the batched dense analogue
+    here)."""
+    cin = input.shape[-1]
+    conv = _nn.Conv1D(cin, num_filters, filter_size,
+                      padding=(filter_size - 1) // 2 if padding else 0)
+    out = conv(input.transpose([0, 2, 1])).transpose([0, 2, 1])
+    return getattr(_F, act)(out) if act else out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Expand x rows to match y's repeat structure (reference
+    sequence_expand; dense analogue: tile rows to y's length)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.extras import _dop
+
+    def impl(xv, yv):
+        reps = yv.shape[0] // max(xv.shape[0], 1)
+        return jnp.repeat(xv, reps, axis=0)
+
+    return _dop("sequence_expand", impl, x, y)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Reference static.nn.case: first true predicate wins."""
+    for pred, fn in pred_fn_pairs:
+        cond_val = bool(pred.numpy()) if hasattr(pred, "numpy") else \
+            bool(pred)
+        if cond_val:
+            return fn()
+    if default is not None:
+        return default()
+    return pred_fn_pairs[-1][1]()
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    from paddle_tpu.static import py_func as _pf
+
+    return _pf(func, x, out, backward_func, skip_vars_in_backward_input)
+
+
+# names the reference exports from static.nn that already exist above or
+# in control flow
+static_py_func = py_func
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from paddle_tpu.ops.registry import C_OPS as _C
+
+    return _C.spectral_norm(weight, dim=dim, power_iters=power_iters,
+                            eps=eps)
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):  # noqa: A002
+    return _F.softmax(input, axis=-2) if len(input.shape) > 2 \
+        else _F.softmax(input, axis=-1)
+
+
+def sequence_pool(input, pool_type="average", is_test=False,  # noqa: A002
+                  pad_value=0.0):
+    """Pool over the time dim of [B, T, C] (dense analogue of the LoD
+    sequence_pool)."""
+    t = input
+    if pool_type in ("average", "avg"):
+        return t.mean(axis=1)
+    if pool_type == "sum":
+        return t.sum(axis=1)
+    if pool_type == "max":
+        return t.max(axis=1)
+    if pool_type == "sqrt":
+        import math
+
+        return t.sum(axis=1) / math.sqrt(t.shape[1])
+    if pool_type == "first":
+        return t[:, 0]
+    if pool_type == "last":
+        return t[:, -1]
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+def sequence_first_step(input):  # noqa: A002
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):  # noqa: A002
+    return sequence_pool(input, "last")
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,  # noqa: A002
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """PS-backed sparse embedding (reference static.nn.sparse_embedding
+    over the distributed table): the local analogue is an Embedding with
+    sparse gradients; the distributed path is parallel.ps
+    SparseEmbedding."""
+    layer = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                          sparse=True)
+    return layer(input)
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """Reference static.nn.static_pylayer: a PyLayer inside a static
+    program. The eager-traced static universe replays python directly, so
+    the custom backward rides autograd.PyLayer."""
+    if backward_fn is None:
+        return forward_fn(*inputs)
+    from paddle_tpu.autograd import PyLayer
+
+    class _P(PyLayer):
+        @staticmethod
+        def forward(ctx, *args):
+            return forward_fn(*args)
+
+        @staticmethod
+        def backward(ctx, *grads):
+            return backward_fn(*grads)
+
+    return _P.apply(*inputs)

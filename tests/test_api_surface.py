@@ -424,3 +424,46 @@ def test_fft_ndim_variants():
     h = paddle.fft.ihfftn(paddle.to_tensor(
         np.random.default_rng(3).standard_normal(8).astype(np.float32)))
     assert h.shape == [5]
+
+
+@pytest.mark.parametrize("ref_rel,dotted", [
+    ("static/nn/__init__.py", "static.nn"),
+    ("nn/initializer/__init__.py", "nn.initializer"),
+    ("inference/__init__.py", "inference"),
+])
+def test_tail_namespace_parity(ref_rel, dotted):
+    import functools
+    import os
+    import re
+
+    ref = "/root/reference/python/paddle/" + ref_rel
+    if not os.path.exists(ref):
+        pytest.skip("reference tree not present")
+    m = re.search(r"__all__\s*=\s*\[(.*?)\]", open(ref).read(), re.S)
+    names = set(re.findall(r"'([^']+)'", m.group(1)))
+    mod = functools.reduce(getattr, dotted.split("."), paddle)
+    missing = sorted(n for n in names if not hasattr(mod, n))
+    assert not missing, f"{dotted}: {missing}"
+
+
+def test_static_nn_fluid_layers():
+    import paddle_tpu.static as static
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 8], "float32")
+        y = static.nn.fc(x, 4, activation="relu")
+    out, = static.Executor().run(
+        main, feed={"x": np.ones((2, 8), np.float32)}, fetch_list=[y])
+    assert out.shape == (2, 4) and (out >= 0).all()
+
+
+def test_initializer_additions():
+    import paddle_tpu.nn.initializer as I
+
+    g = I.Orthogonal()((4, 4))
+    np.testing.assert_allclose(np.asarray(g) @ np.asarray(g).T,
+                               np.eye(4), atol=1e-4)
+    d = I.Dirac()((3, 3, 3, 3))
+    assert np.asarray(d)[0, 0, 1, 1] == 1.0
+    assert abs(I.calculate_gain("relu") - 2 ** 0.5) < 1e-6
