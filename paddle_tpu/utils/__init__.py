@@ -1,1 +1,89 @@
 from paddle_tpu.utils import flags  # noqa: F401
+
+# --------------------- round-5: reference utils __all__ -----------------
+# (reference python/paddle/utils/__init__.py: deprecated, run_check,
+#  require_version, try_import)
+
+import functools as _functools
+import importlib as _importlib
+import warnings as _warnings
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (reference
+    utils/deprecated.py): warns once per call site."""
+
+    def deco(fn):
+        @_functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API '{fn.__qualname__}' is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f"; use '{update_to}' instead"
+            if reason:
+                msg += f" ({reason})"
+            _warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def try_import(module_name, err_msg=None):
+    """Import a module or raise with an actionable message (reference
+    utils/lazy_import.py)."""
+    try:
+        return _importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"required module '{module_name}' is not "
+            "installed") from e
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed version against [min, max] (reference
+    utils/install_check.py require_version)."""
+    import paddle_tpu
+
+    def parse(v):
+        return tuple(int(x) for x in str(v).split(".")[:3] if x.isdigit())
+
+    cur = parse(getattr(paddle_tpu, "__version__", "0.0.0"))
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {cur} < required minimum {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {cur} > required maximum {max_version}")
+    return True
+
+
+def run_check():
+    """Install check (reference utils/install_check.py run_check): runs a
+    tiny compiled train step on the default backend and reports."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    backend = jax.default_backend()
+    n_dev = len(jax.devices())
+    print(f"Running verify PaddlePaddle(TPU-native) program ... "
+          f"backend={backend}, device count={n_dev}")
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(parameters=net.parameters(),
+                               learning_rate=0.1)
+    step = paddle.jit.TrainStep(
+        net, lambda out, y: ((out - y) ** 2).mean(), opt)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    y = paddle.to_tensor(np.zeros((2, 2), np.float32))
+    l0 = float(step(x, y))
+    l1 = float(step(x, y))
+    assert np.isfinite(l0) and l1 <= l0
+    print("PaddlePaddle(TPU-native) is installed successfully! Let's "
+          "start deep learning with PaddlePaddle(TPU-native) now.")
+    return True

@@ -1,0 +1,38 @@
+"""paddle.version (reference python/paddle/version/__init__.py —
+generated at build time there; static here)."""
+
+full_version = "0.1.0"
+major, minor, patch = full_version.split(".")
+rc = "0"
+commit = "paddle-tpu"
+istaged = True
+with_pip_cuda_libraries = "OFF"
+cuda_archs = []
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print("tpu: True (XLA/PJRT build)")
+    print("cuda: False")
+    print("cudnn: False")
+
+
+def cuda():
+    return False
+
+
+def cudnn():
+    return False
+
+
+def xpu():
+    return False
+
+
+def nccl():
+    return "0"
+
+
+def show_ipu():
+    return None

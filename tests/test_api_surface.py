@@ -467,3 +467,24 @@ def test_initializer_additions():
     d = I.Dirac()((3, 3, 3, 3))
     assert np.asarray(d)[0, 0, 1, 1] == 1.0
     assert abs(I.calculate_gain("relu") - 2 ** 0.5) < 1e-6
+
+
+def test_utils_and_version():
+    import warnings
+
+    assert paddle.utils.require_version("0.0.1")
+    assert paddle.utils.try_import("json").dumps({}) == "{}"
+    with pytest.raises(ImportError):
+        paddle.utils.try_import("definitely_not_a_module_xyz")
+
+    @paddle.utils.deprecated(update_to="paddle.new_api", since="2.0")
+    def old():
+        return 42
+
+    with warnings.catch_warnings(record=True) as ws:
+        warnings.simplefilter("always")
+        assert old() == 42
+    assert any("deprecated" in str(w.message) for w in ws)
+    assert paddle.version.full_version
+    assert not paddle.version.cuda()
+    assert paddle.utils.run_check()
