@@ -330,6 +330,7 @@ import sys as _sys  # noqa: E402
 
 _extras.bind_tensor_methods(_sys.modules[__name__])
 
+from paddle_tpu import callbacks  # noqa: F401,E402
 from paddle_tpu import utils  # noqa: F401,E402
 from paddle_tpu import version  # noqa: F401,E402
 from paddle_tpu import strings  # noqa: F401,E402

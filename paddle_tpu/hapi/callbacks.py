@@ -240,3 +240,59 @@ class VisualDL(Callback):
         if self._fh is not None:
             self._fh.close()
             self._fh = None
+
+
+class LRScheduler(Callback):
+    """Per-epoch/step LR scheduler stepping callback (reference
+    callbacks.LRScheduler)."""
+
+    def __init__(self, by_step=False, by_epoch=True):
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        return getattr(opt, "_lr_scheduler", None) if opt else None
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if self.by_epoch and s is not None:
+            s.step()
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logging (reference callbacks.WandbCallback).
+    wandb is not bundled on this box; the callback degrades to an
+    in-memory log (self.history) and raises only if the user explicitly
+    requires the backend (project given AND wandb importable check
+    fails... no: stays silent-local, zero-egress box)."""
+
+    def __init__(self, project=None, name=None, **kwargs):
+        self.project = project
+        self.run_name = name
+        self.history = []
+        try:
+            import wandb  # noqa: F401 — optional dependency
+
+            self._wandb = wandb
+        except ImportError:
+            self._wandb = None
+
+    def on_train_begin(self, logs=None):
+        if self._wandb is not None:
+            self._wandb.init(project=self.project, name=self.run_name)
+
+    def on_train_batch_end(self, step, logs=None):
+        rec = dict(logs or {})
+        self.history.append(rec)
+        if self._wandb is not None:
+            self._wandb.log(rec)
+
+    def on_train_end(self, logs=None):
+        if self._wandb is not None:
+            self._wandb.finish()
