@@ -39,6 +39,7 @@ def test_strategy_sections():
 
 def test_engine_fit_evaluate_predict_save_load(tmp_path):
     paddle.seed(0)
+    np.random.seed(0)   # loader shuffle rides global numpy RNG
     model = nn.Linear(8, 1)
     opt = paddle.optimizer.Adam(learning_rate=0.05,
                                 parameters=model.parameters())
