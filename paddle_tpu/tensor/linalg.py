@@ -78,13 +78,6 @@ def _lstsq(x, y, rcond=None, driver=None):
     return sol, res, rank, sv
 
 
-def _lu_0based_unused(x, pivot=True):  # superseded: see below
-    import jax.scipy.linalg as jsl
-
-    lu, piv = jsl.lu_factor(x)
-    return lu, piv.astype(jnp.int32)
-
-
 def _cond(x, p=None):
     return jnp.linalg.cond(x, p=p)
 
