@@ -58,3 +58,19 @@ from paddle_tpu.parallel.ps import (  # noqa: F401,E402
     PsClient, PsServer, SparseEmbedding,
 )
 from paddle_tpu.parallel import rpc  # noqa: F401,E402
+from paddle_tpu.parallel.compat import (  # noqa: F401,E402
+    BoxPSDataset, ColWiseParallel, CountFilterEntry, DistAttr, LocalLayer,
+    ParallelMode, PrepareLayerInput, PrepareLayerOutput, ProbabilityEntry,
+    QueueDataset, ReduceType, RowWiseParallel, SequenceParallelBegin,
+    SequenceParallelDisable, SequenceParallelEnable, SequenceParallelEnd,
+    ShardingStage1, ShardingStage2, ShardingStage3, ShowClickEntry,
+    SplitPoint, all_gather_object, alltoall, alltoall_single,
+    broadcast_object_list, destroy_process_group, dtensor_from_fn, gather,
+    get_backend, get_group, get_mesh, gloo_barrier,
+    gloo_init_parallel_env, gloo_release, in_auto_parallel_align_mode,
+    is_available, parallelize, reduce, reduce_scatter,
+    save_group_sharded_model, scatter, scatter_object_list,
+    shard_dataloader, shard_op, shard_optimizer, shard_scaler, spawn,
+    split, stream, to_distributed, unshard_dtensor, wait,
+)
+from paddle_tpu.parallel.compat import InMemoryDataset  # noqa: F401,E402

@@ -1,0 +1,702 @@
+"""paddle.distributed surface completion (round 5).
+
+Reference: python/paddle/distributed/__init__.py exports. Everything here
+is a thin, behaviorally-correct layer over the existing TPU-native
+machinery: mesh-axis collectives (collective.py), GSPMD shardings
+(api.py shard_tensor / sharding_constraint), the mp_layers
+tensor-parallel blocks, the launcher, and the global TCPStore for the
+object collectives — no second implementation of any of it.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+
+# --------------------------------------------------------------- enums etc.
+
+class ParallelMode:
+    """Reference fleet ParallelMode constants."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class ReduceType(Enum):
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+
+
+class ShardingStage1:
+    """Marker configs for paddle.distributed.parallelize sharding
+    (reference auto_parallel/intermediate ShardingStage1/2/3): map to the
+    group_sharded stages already implemented."""
+
+    stage = 1
+
+
+class ShardingStage2:
+    stage = 2
+
+
+class ShardingStage3:
+    stage = 3
+
+
+class DistAttr:
+    """Reference DistAttr: (process_mesh, placements) record."""
+
+    def __init__(self, mesh=None, sharding_specs=None, placements=None):
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs
+        self.placements = placements
+
+
+# ----------------------------------------------------- collective wrappers
+
+def reduce(tensor, dst=0, op=None, group=None, sync_op=True):
+    """Reference distributed.reduce: SPMD collapse — the reduced value is
+    computed on every rank (all_reduce); dst semantics are free because
+    every rank holds the result."""
+    from paddle_tpu.parallel.collective import ReduceOp, all_reduce
+
+    return all_reduce(tensor, op or ReduceOp.SUM, group=group)
+
+
+def reduce_scatter(tensor, tensor_list=None, op=None, group=None,
+                   sync_op=True):
+    """Eager reduce_scatter (reference distributed.reduce_scatter): rank
+    i receives sum over ALL ranks of their tensor_list[i]. Cross-rank
+    movement rides the existing alltoall (each rank posts its slot-r
+    tensor to rank r), then the received pieces sum locally. In-jit code
+    uses reduce_scatter_in (lax.psum_scatter)."""
+    if tensor_list is None:
+        return tensor
+    world = get_world_size_safe()
+    if world <= 1:
+        total = tensor_list[0]._value
+        for t in tensor_list[1:]:
+            total = total + t._value
+        tensor._inplace_update(total)
+        return tensor
+    received: list = []
+    alltoall(received, list(tensor_list), group=group)
+    total = received[0]._value
+    for t in received[1:]:
+        total = total + t._value
+    tensor._inplace_update(total)
+    return tensor
+
+
+def get_world_size_safe():
+    from paddle_tpu.parallel.collective import get_world_size
+
+    try:
+        return get_world_size()
+    except Exception:
+        return 1
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """Reference distributed.scatter: src rank's list scatters one slot
+    per rank (store-backed across processes, local slice otherwise)."""
+    from paddle_tpu.parallel.collective import get_rank, recv, send
+
+    world = get_world_size_safe()
+    rank = get_rank()
+    if world <= 1:
+        if tensor_list:
+            tensor._inplace_update(
+                tensor_list[0]._value if isinstance(tensor_list[0], Tensor)
+                else jnp.asarray(tensor_list[0]))
+        return tensor
+    if rank == src:
+        for r in range(world):
+            if r == src:
+                tensor._inplace_update(tensor_list[r]._value)
+            else:
+                send(tensor_list[r], dst=r)
+        return tensor
+    return recv(tensor, src=src)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Reference distributed.gather — inverse of scatter."""
+    from paddle_tpu.parallel.collective import get_rank, recv, send
+
+    world = get_world_size_safe()
+    rank = get_rank()
+    if world <= 1:
+        if gather_list is not None:
+            gather_list.append(tensor)
+        return gather_list
+    if rank == dst:
+        for r in range(world):
+            if r == dst:
+                gather_list.append(tensor)
+            else:
+                buf = Tensor._wrap(jnp.zeros_like(tensor._value))
+                recv(buf, src=r)
+                gather_list.append(buf)
+        return gather_list
+    send(tensor, dst=dst)
+    return None
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """Reference distributed.alltoall over the eager p2p channel."""
+    from paddle_tpu.parallel.collective import get_rank, isend, recv
+
+    world = get_world_size_safe()
+    rank = get_rank()
+    if world <= 1:
+        out_tensor_list.extend(in_tensor_list)
+        return out_tensor_list
+    for r in range(world):
+        if r == rank:
+            continue
+        isend(in_tensor_list[r], dst=r)
+    for r in range(world):
+        if r == rank:
+            out_tensor_list.append(in_tensor_list[r])
+        else:
+            buf = Tensor._wrap(jnp.zeros_like(in_tensor_list[r]._value))
+            recv(buf, src=r)
+            out_tensor_list.append(buf)
+    return out_tensor_list
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    from paddle_tpu.parallel.collective import get_rank
+
+    world = get_world_size_safe()
+    if world <= 1:
+        out_tensor._inplace_update(in_tensor._value)
+        return out_tensor
+    parts = list(jnp.split(in_tensor._value, world, axis=0))
+    outs: list = []
+    alltoall(outs, [Tensor._wrap(p) for p in parts], group=group)
+    out_tensor._inplace_update(
+        jnp.concatenate([o._value for o in outs], axis=0))
+    return out_tensor
+
+
+def _object_store():
+    from paddle_tpu.parallel.collective import _p2p_store
+
+    return _p2p_store()
+
+
+_OBJ_SEQ = [0]
+
+
+def all_gather_object(object_list, obj, group=None):
+    """Pickle-over-store object all_gather (reference
+    all_gather_object — the reference also pickles)."""
+    import pickle
+
+    world = get_world_size_safe()
+    if world <= 1:
+        object_list.append(obj)
+        return object_list
+    store, rank = _object_store()
+    seq = _OBJ_SEQ[0]
+    _OBJ_SEQ[0] += 1
+    store.set(f"objgather/{seq}/{rank}", pickle.dumps(obj))
+    store.wait([f"objgather/{seq}/{r}" for r in range(world)])
+    for r in range(world):
+        object_list.append(pickle.loads(
+            store.get(f"objgather/{seq}/{r}")))
+    return object_list
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    import pickle
+
+    world = get_world_size_safe()
+    if world <= 1:
+        return object_list
+    store, rank = _object_store()
+    seq = _OBJ_SEQ[0]
+    _OBJ_SEQ[0] += 1
+    if rank == src:
+        store.set(f"objbcast/{seq}", pickle.dumps(list(object_list)))
+    store.wait([f"objbcast/{seq}"])
+    data = pickle.loads(store.get(f"objbcast/{seq}"))
+    object_list[:] = data
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    import pickle
+
+    world = get_world_size_safe()
+    if world <= 1:
+        out_object_list.append(in_object_list[0]
+                               if in_object_list else None)
+        return out_object_list
+    store, rank = _object_store()
+    seq = _OBJ_SEQ[0]
+    _OBJ_SEQ[0] += 1
+    if rank == src:
+        for r in range(world):
+            store.set(f"objscatter/{seq}/{r}",
+                      pickle.dumps(in_object_list[r]))
+    store.wait([f"objscatter/{seq}/{rank}"])
+    out_object_list.append(pickle.loads(
+        store.get(f"objscatter/{seq}/{rank}")))
+    return out_object_list
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Reference distributed.wait: fence the tensor's pending work."""
+    jax.block_until_ready(tensor._value if isinstance(tensor, Tensor)
+                          else tensor)
+    return tensor
+
+
+def destroy_process_group(group=None):
+    """Tear down the bootstrap world state (reference
+    destroy_process_group): init_parallel_env() afterwards re-forms the
+    world."""
+    from paddle_tpu.parallel import env as _env
+
+    _env._initialized = False
+    _env._env_world = None
+    return None
+
+
+def get_backend(group=None) -> str:
+    """The one communication backend here: XLA collectives over
+    ICI/DCN."""
+    return "XCCL"
+
+
+def get_group(id=0):  # noqa: A002
+    from paddle_tpu.parallel.collective import new_group
+
+    return new_group()
+
+
+def is_available() -> bool:
+    return True
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Gloo bootstrap collapse: the TCPStore plays the gloo rendezvous
+    role (reference gloo_init_parallel_env)."""
+    from paddle_tpu.parallel.store import create_or_get_global_tcp_store
+
+    return create_or_get_global_tcp_store()
+
+
+def gloo_barrier():
+    from paddle_tpu.parallel.collective import barrier
+
+    return barrier()
+
+
+def gloo_release():
+    return None
+
+
+def _spawn_entry(func, args, env):
+    """Module-level spawn target (the 'spawn' start method pickles it;
+    func must itself be a module-level callable, same contract as the
+    reference)."""
+    import os
+
+    os.environ.update(env)
+    func(*args)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Reference distributed.spawn: launch func on nprocs local
+    processes with the trainer env contract."""
+    import multiprocessing as mp
+
+    if nprocs in (-1, 0, None):
+        nprocs = max(1, len(jax.devices()))
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {"PADDLE_TRAINER_ID": str(rank),
+               "PADDLE_TRAINERS_NUM": str(nprocs)}
+        p = ctx.Process(target=_spawn_entry, args=(func, args, env),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        bad = [p.exitcode for p in procs if p.exitcode]
+        if bad:
+            raise RuntimeError(f"spawned workers failed: {bad}")
+    return procs
+
+
+# ---------------------------------------------------------- megatron split
+
+def split(x, size, operation="linear", axis=0, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None, num_partitions=None):
+    """Reference distributed.split: build a tensor-parallel linear /
+    embedding over the 'tp' mesh axis (mp_layers own the math)."""
+    from paddle_tpu.parallel.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    )
+
+    if operation == "linear":
+        cls = ColumnParallelLinear if axis == 1 else RowParallelLinear
+        layer = cls(size[0], size[1],
+                    gather_output=gather_out) if axis == 1 else cls(
+            size[0], size[1], input_is_parallel=False)
+        return layer(x)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1])
+        return layer(x)
+    raise ValueError(f"unknown split operation {operation!r}")
+
+
+# ------------------------------------------------- auto-parallel plan API
+
+def get_mesh():
+    from paddle_tpu.parallel.mesh import current_mesh
+
+    return current_mesh()
+
+
+class _PlanBase:
+    """A parallelize() plan entry: applied to a named sublayer."""
+
+    def apply(self, layer, mesh):
+        raise NotImplementedError
+
+
+class ColWiseParallel(_PlanBase):
+    """Shard a Linear's weight column-wise over 'tp' (reference
+    auto_parallel ColWiseParallel)."""
+
+    def __init__(self, gather_output=False):
+        self.gather_output = gather_output
+
+    def apply(self, layer, mesh):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        w = getattr(layer, "weight", None)
+        if w is not None and len(w.shape) == 2:
+            w._inplace_update(jax.device_put(
+                w._value, NamedSharding(mesh, P(None, "tp"))))
+        b = getattr(layer, "bias", None)
+        if b is not None and b is not False and hasattr(b, "_value"):
+            b._inplace_update(jax.device_put(
+                b._value, NamedSharding(mesh, P("tp"))))
+
+
+class RowWiseParallel(_PlanBase):
+    def __init__(self, is_input_parallel=True):
+        self.is_input_parallel = is_input_parallel
+
+    def apply(self, layer, mesh):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        w = getattr(layer, "weight", None)
+        if w is not None and len(w.shape) == 2:
+            w._inplace_update(jax.device_put(
+                w._value, NamedSharding(mesh, P("tp", None))))
+
+
+class PrepareLayerInput(_PlanBase):
+    """Reference PrepareLayerInput: fn(process_mesh) RETURNS the pre-hook
+    to install (reference auto_parallel/intermediate/parallel_base)."""
+
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, mesh):
+        if self.fn is not None:
+            layer.register_forward_pre_hook(self.fn(mesh))
+
+
+class PrepareLayerOutput(_PlanBase):
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, mesh):
+        if self.fn is not None:
+            layer.register_forward_post_hook(self.fn(mesh))
+
+
+class SplitPoint:
+    """Pipeline split markers (reference SplitPoint.BEGINNING/END)."""
+
+    BEGINNING = "beginning"
+    END = "end"
+
+
+class SequenceParallelBegin(_PlanBase):
+    """Sequence-parallel region markers (reference SequenceParallel*):
+    under GSPMD the scatter/gather constraints are applied per layer.
+    No-op on meshes without an 'sp' axis."""
+
+    _AXIS = "sp"
+
+    def apply(self, layer, mesh):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self._AXIS not in mesh.axis_names:
+            return
+        spec = NamedSharding(mesh, P(None, self._AXIS))
+
+        def hook(lyr, args, out):
+            if hasattr(out, "_value") and len(out.shape) >= 2:
+                out._inplace_update(
+                    jax.lax.with_sharding_constraint(out._value, spec))
+            return out
+
+        layer.register_forward_post_hook(hook)
+
+
+class SequenceParallelEnd(_PlanBase):
+    def apply(self, layer, mesh):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = NamedSharding(mesh, P())
+
+        def hook(lyr, args, out):
+            if hasattr(out, "_value"):
+                out._inplace_update(
+                    jax.lax.with_sharding_constraint(out._value, spec))
+            return out
+
+        layer.register_forward_post_hook(hook)
+
+
+class SequenceParallelEnable(SequenceParallelBegin):
+    pass
+
+
+class SequenceParallelDisable(SequenceParallelEnd):
+    pass
+
+
+def parallelize(model, optimizer=None, mesh=None, config=None):
+    """Reference paddle.distributed.parallelize: apply a parallelize_plan
+    mapping sublayer-name patterns to plan entries (ColWiseParallel etc.)
+    over the mesh."""
+    import fnmatch
+
+    from paddle_tpu.parallel.mesh import current_mesh
+
+    mesh = mesh or current_mesh()
+    plan = (config or {}).get("parallelize_plan", {})
+    if mesh is not None:
+        for pattern, entry in plan.items():
+            entries = entry if isinstance(entry, (list, tuple)) else [entry]
+            for name, sub in model.named_sublayers():
+                if fnmatch.fnmatch(name, pattern):
+                    for e in entries:
+                        e.apply(sub, mesh)
+    if optimizer is not None:
+        return model, optimizer
+    return model
+
+
+def to_distributed(model, optimizer=None, dataloader=None, device_num=None,
+                   node_num=1, config=None):
+    """Reference incubate to_distributed: one-call parallelization —
+    collapse onto parallelize + the current mesh."""
+    out = parallelize(model, optimizer=optimizer, config=config or {})
+    if dataloader is not None:
+        return (*out, dataloader) if isinstance(out, tuple) else (
+            out, dataloader)
+    return out
+
+
+def shard_op(op_fn, mesh, in_shardings=None, out_shardings=None):
+    """Reference shard_op: wrap a callable so its outputs carry the given
+    placements (GSPMD constraint). out_shardings: a PartitionSpec (or
+    tuple convertible to one)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def wrapped(*args, **kwargs):
+        out = op_fn(*args, **kwargs)
+        if out_shardings is not None and hasattr(out, "_value"):
+            spec = (out_shardings if isinstance(out_shardings, P)
+                    else P(*out_shardings))
+            out._inplace_update(jax.device_put(
+                out._value, NamedSharding(mesh, spec)))
+        return out
+
+    return wrapped
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Reference shard_optimizer: states follow their parameters'
+    shardings — GSPMD already propagates this (accumulators are built
+    zeros_like the sharded param), so this marks and returns."""
+    optimizer._sharded = True
+    return optimizer
+
+
+def shard_scaler(scaler):
+    """Reference shard_scaler: the GradScaler's found_inf ride psum —
+    already global under one-program SPMD; returns the scaler."""
+    return scaler
+
+
+def shard_dataloader(dataloader, meshes=None, shard_dims=None,
+                     input_keys=None, is_dataset_splitted=False):
+    """Reference shard_dataloader: feed each batch with its dp sharding.
+    The DataLoader here already yields host batches; the TrainStep's
+    batch sharding does the dp split, so the loader passes through."""
+    return dataloader
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    """Reference dtensor_from_fn: build a tensor then place it (plain
+    tensor when no mesh is active or given)."""
+    from paddle_tpu.parallel.api import shard_tensor
+    from paddle_tpu.parallel.mesh import current_mesh
+
+    t = fn(*args, **kwargs)
+    if mesh is None and current_mesh() is None:
+        return t
+    return shard_tensor(t, mesh=mesh, placements=placements)
+
+
+def unshard_dtensor(dist_tensor):
+    """Reference unshard_dtensor: gather to replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.parallel.mesh import current_mesh
+
+    mesh = current_mesh()
+    v = dist_tensor._value
+    if mesh is not None:
+        v = jax.device_put(v, NamedSharding(mesh, P()))
+    return Tensor._wrap(v)
+
+
+def _local_layer_base():
+    from paddle_tpu.nn.layer import Layer
+
+    class LocalLayer(Layer):
+        """Reference LocalLayer: a layer whose forward works on
+        per-shard LOCAL views. Subclass and override forward (the
+        documented usage), or wrap an existing layer. Under GSPMD the
+        per-shard view is what shard_map provides; eager execution runs
+        the addressable shard directly."""
+
+        def __init__(self, layer=None, out_dist_attrs=None):
+            super().__init__()
+            if layer is not None:
+                self.inner = layer
+            self.out_dist_attrs = out_dist_attrs
+
+        def forward(self, *args, **kwargs):
+            inner = getattr(self, "inner", None)
+            if inner is None:
+                raise NotImplementedError(
+                    "LocalLayer subclasses must override forward() (or "
+                    "pass a layer to wrap)")
+            return inner(*args, **kwargs)
+
+    return LocalLayer
+
+
+LocalLayer = _local_layer_base()
+
+
+# ------------------------------------------------------- PS-side datasets
+
+class CountFilterEntry:
+    """Sparse-table entry configs (reference distributed entry.py):
+    admission/eviction policy records consumed by the PS tables."""
+
+    def __init__(self, count_filter=5):
+        self.count_filter = count_filter
+
+
+class ProbabilityEntry:
+    def __init__(self, probability=0.1):
+        self.probability = probability
+
+
+class ShowClickEntry:
+    def __init__(self, show_name="show", click_name="click"):
+        self.show_name = show_name
+        self.click_name = click_name
+
+
+def _ps_datasets():
+    from paddle_tpu.io import InMemoryDataset, QueueDataset
+
+    return InMemoryDataset, QueueDataset
+
+
+InMemoryDataset, QueueDataset = _ps_datasets()
+
+
+class BoxPSDataset(InMemoryDataset):
+    """BoxPS (GPU-PS) dataset shim — same feed contract as
+    InMemoryDataset here (reference fleet/dataset BoxPSDataset)."""
+
+
+# ---------------------------------------------------------------- misc
+
+def in_auto_parallel_align_mode() -> bool:
+    return False
+
+
+class stream:  # noqa: N801 — reference exposes a module-like namespace
+    """paddle.distributed.stream.* collective variants: PJRT's async
+    dispatch IS the stream semantics, so these alias the defaults."""
+
+    @staticmethod
+    def all_reduce(tensor, op=None, group=None, sync_op=True,
+                   use_calc_stream=False):
+        from paddle_tpu.parallel.collective import ReduceOp, all_reduce
+
+        return all_reduce(tensor, op or ReduceOp.SUM, group=group)
+
+    @staticmethod
+    def send(tensor, dst=0, group=None, sync_op=True,
+             use_calc_stream=False):
+        from paddle_tpu.parallel.collective import send as _send
+
+        return _send(tensor, dst=dst, group=group)
+
+    @staticmethod
+    def recv(tensor, src=0, group=None, sync_op=True,
+             use_calc_stream=False):
+        from paddle_tpu.parallel.collective import recv as _recv
+
+        return _recv(tensor, src=src, group=group)
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Reference save_group_sharded_model: persist a group-sharded
+    model's full state."""
+    import os
+
+    import paddle_tpu as paddle
+
+    os.makedirs(output, exist_ok=True)
+    paddle.save(model.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None and hasattr(optimizer, "state_dict"):
+        paddle.save(optimizer.state_dict(),
+                    os.path.join(output, "model.pdopt"))
