@@ -332,6 +332,74 @@ def child_decode(layers: int, hidden: int, batch: int, prompt: int,
                   "pool_len": (prompt + gen) * pool_mult})
 
 
+def child_serving(layers: int, hidden: int, max_batch: int, requests: int,
+                  prompt: int, gen: int, vocab: int):
+    """Continuous-batching serving rung: offered-load sweep through
+    paddle_tpu.serving (engine + FCFS scheduler + paged pool). Each sweep
+    point feeds `requests` prompts at a different arrival cadence
+    (measured in engine steps, so the sweep is hardware-portable) and
+    reports tokens/s and TTFT p50/p99 from serving.metrics. Runs under
+    JAX_PLATFORMS=cpu too (gather attention path) — the ISSUE-1 criterion
+    that the first healthy tunnel minute yields a committed serving
+    number."""
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.serving import GPTRunner, SamplingParams, ServingEngine
+
+    backend = jax.default_backend()
+    paddle.seed(0)
+    max_len = prompt + gen
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+                    num_heads=max(hidden // 64, 1), max_seq_len=max_len,
+                    dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+    block_size = min(16, max_len)
+    runner = GPTRunner(model, block_size=block_size, max_model_len=max_len)
+    pages_per_seq = -(-max_len // block_size)
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(0, vocab, prompt)) for _ in range(requests)]
+
+    def sweep(arrival_every_steps: int) -> dict:
+        eng = ServingEngine(runner,
+                            num_blocks=max_batch * pages_per_seq + 1,
+                            max_batch_size=max_batch, max_model_len=max_len)
+        pending = list(enumerate(prompts))
+        t0 = time.time()
+        steps = 0
+        while pending or eng.has_work():
+            while pending and (arrival_every_steps == 0
+                               or steps % arrival_every_steps == 0):
+                i, p = pending.pop(0)
+                eng.add_request(p, SamplingParams(max_tokens=gen),
+                                request_id=f"r{i}")
+                if arrival_every_steps:
+                    break
+            eng.step()
+            steps += 1
+        wall = time.time() - t0
+        snap = eng.metrics.snapshot()
+        return {"arrival_every_steps": arrival_every_steps,
+                "wall_s": round(wall, 3),
+                "tokens_per_sec": snap["tokens_generated"] / wall,
+                "ttft_s_p50": snap["ttft_s_p50"],
+                "ttft_s_p99": snap["ttft_s_p99"],
+                "batch_occupancy_mean": snap["batch_occupancy_mean"],
+                "preemptions": snap["preemptions"],
+                "decode_steps": snap["decode_steps"]}
+
+    # warmup sweep point compiles prefill buckets + the decode step
+    sweep(0)
+    points = [sweep(k) for k in (0, 1, 4)]   # closed-batch -> light load
+    _write_child({"backend": backend, "layers": layers, "hidden": hidden,
+                  "max_batch": max_batch, "requests": requests,
+                  "prompt": prompt, "gen": gen, "sweep": points})
+
+
 def _write_child(obj: dict) -> None:
     with open(os.environ["BENCH_CHILD_OUT"], "w") as f:
         json.dump(obj, f)
@@ -478,6 +546,30 @@ def main():
         log(f"dead-page cost ratio (pool 4x / 1x ms/token): {ratio:.2f} "
             f"(~1.0 = dead pages free)")
 
+    # continuous-batching serving rung: offered-load sweep through
+    # paddle_tpu.serving (secondary lines; tokens/s + TTFT percentiles)
+    if on_tpu and remaining() > 120:
+        r = run_child("serving:12:768:8:64:128:64:32768",
+                      min(900, remaining()))
+        if r is not None:
+            for pt in r["sweep"]:
+                line = {"metric": "serving_tokens_per_sec_arrival"
+                                  f"{pt['arrival_every_steps']}",
+                        "value": round(pt["tokens_per_sec"], 1),
+                        "unit": "tokens/s", "vs_baseline": 0.0,
+                        "ttft_s_p50": round(pt["ttft_s_p50"], 4),
+                        "ttft_s_p99": round(pt["ttft_s_p99"], 4),
+                        "batch_occupancy_mean":
+                            round(pt["batch_occupancy_mean"], 2),
+                        "preemptions": pt["preemptions"],
+                        "backend": r["backend"]}
+                emit(line)
+                _cache_result(line)
+                log(f"serving sweep arrival={pt['arrival_every_steps']}: "
+                    f"{pt['tokens_per_sec']:.0f} tok/s, "
+                    f"ttft p50={pt['ttft_s_p50']*1000:.0f}ms "
+                    f"p99={pt['ttft_s_p99']*1000:.0f}ms")
+
     if best is not None:
         # headline repeated last: drivers that parse the final stdout JSON
         # line get the largest completed config
@@ -514,6 +606,8 @@ if __name__ == "__main__":
             child_ernie(*[int(x) for x in mode.split(":")[1:]])
         elif mode.startswith("decode:"):
             child_decode(*[int(x) for x in mode.split(":")[1:]])
+        elif mode.startswith("serving:"):
+            child_serving(*[int(x) for x in mode.split(":")[1:]])
         else:
             raise SystemExit(f"unknown child mode {mode}")
     else:

@@ -302,6 +302,9 @@ from paddle_tpu import regularizer  # noqa: E402,F401
 from paddle_tpu import signal  # noqa: E402,F401
 from paddle_tpu import sparse  # noqa: E402,F401
 from paddle_tpu.tensor import fft, linalg  # noqa: E402,F401
+from paddle_tpu.tensor.array import (  # noqa: E402,F401
+    array_length, array_read, array_write, create_array,
+)
 from paddle_tpu import static  # noqa: E402,F401
 from paddle_tpu import vision  # noqa: E402,F401
 from paddle_tpu import quantization  # noqa: E402,F401

@@ -117,6 +117,22 @@ class Predictor:
     def try_shrink_memory(self):
         pass
 
+    def create_serving_engine(self, model, **kw):
+        """Bridge from the single-request Predictor world to the
+        continuous-batching serving engine (paddle_tpu.serving).
+
+        The Predictor serves a fixed-signature static Program one request
+        at a time; token-by-token LLM serving needs a decoder Layer with
+        a paged-KV step function. Pass the decoder (models.Llama /
+        models.GPT — typically the eager twin of the exported program)
+        and get back a ServingEngine; the predictor's low-precision
+        config carries over as the engine's cache/compute dtype."""
+        if self.config._amp_dtype is not None:
+            from paddle_tpu.core.dtype import to_jax_dtype
+
+            kw.setdefault("dtype", to_jax_dtype(self.config._amp_dtype))
+        return create_serving_engine(model, **kw)
+
 
 class _InputHandle:
     def __init__(self, predictor, name):
@@ -141,6 +157,33 @@ class _OutputHandle:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+def create_serving_engine(model, dtype=None, **kw):
+    """Build a continuous-batching ServingEngine for a decoder Layer.
+
+    The serving-path analogue of create_predictor: where the reference
+    pairs fluid/inference with block_multihead_attention and a serving
+    framework above it, this hands the model to paddle_tpu.serving
+    (paged KV pool + FCFS continuous batching + Pallas paged decode).
+    `dtype` casts weights (and thus the KV pool) — the serving twin of
+    Config.enable_low_precision. See paddle_tpu/serving/__init__.py for
+    the engine knobs (num_blocks, block_size, max_batch_size, ...)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.model_runner import runner_for
+
+    runner = runner_for(model,
+                        **{k: kw.pop(k) for k in
+                           ("block_size", "max_model_len", "attn_impl")
+                           if k in kw})
+    if dtype is not None:
+        runner.params = {
+            k: (v.astype(dtype) if jnp.issubdtype(v.dtype, jnp.floating)
+                else v) for k, v in runner.params.items()}
+    kw.setdefault("num_blocks", 128)
+    return ServingEngine(runner, **kw)
 
 
 # --------------------- round-5: reference inference __all__ tail --------

@@ -397,8 +397,15 @@ def paged_write_prefill(pool, block_table, kv, block_size):
 
 
 def paged_write_token(pool, block_table, kv_tok, pos, block_size):
-    """Write one [b, h, d] token at position `pos` (traced scalar)."""
-    blk = jnp.take(block_table, pos // block_size, axis=1)     # [b]
+    """Write one [b, h, d] token at position `pos` (traced scalar, or
+    per-sequence [b] positions — the ragged continuous-batching case the
+    serving engine drives)."""
+    pos = jnp.asarray(pos)
+    if pos.ndim == 0:
+        blk = jnp.take(block_table, pos // block_size, axis=1)     # [b]
+        return pool.at[blk, pos % block_size].set(kv_tok)
+    blk = jnp.take_along_axis(block_table, (pos // block_size)[:, None],
+                              axis=1)[:, 0]                        # [b]
     return pool.at[blk, pos % block_size].set(kv_tok)
 
 

@@ -168,6 +168,10 @@ class Conv2D(Layer):
         k = kernel_size if isinstance(kernel_size, (list, tuple)) else (kernel_size,) * 2
         self._stride, self._padding, self._dilation = stride, padding, dilation
         self._groups = groups
+        if data_format not in ("NCHW", "NHWC"):
+            raise ValueError(
+                f"Conv2D: unsupported data_format {data_format!r}")
+        self._data_format = data_format
         fan_in = in_channels // groups * k[0] * k[1]
         w_init, w_shard = _init_from_attr(
             weight_attr, I.Uniform(-np.sqrt(1 / fan_in), np.sqrt(1 / fan_in)))
@@ -185,7 +189,7 @@ class Conv2D(Layer):
     def forward(self, x):
         return F.conv2d(x, self.weight, self.bias, stride=self._stride,
                         padding=self._padding, dilation=self._dilation,
-                        groups=self._groups)
+                        groups=self._groups, data_format=self._data_format)
 
 
 class Conv1D(Layer):

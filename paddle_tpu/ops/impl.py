@@ -965,7 +965,11 @@ def _pair(v, n=2):
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCHW"):
     """Reference: phi Conv2dKernel (gpudnn). Lowers to XLA conv_general_dilated
-    which maps onto the MXU. Layout NCHW in the API; XLA relayouts internally."""
+    which maps onto the MXU. data_format selects the activation layout
+    (NCHW or NHWC — the latter is what TPUs natively tile); the weight
+    stays OIHW in both, matching the reference's filter storage."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"conv2d: unsupported data_format {data_format!r}")
     stride = _pair(stride)
     dilation = _pair(dilation)
     if isinstance(padding, str):
@@ -976,13 +980,15 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
             pad = [(p[0], p[1]), (p[2], p[3])]
         else:
             pad = [(p[0], p[0]), (p[1], p[1])]
-    dn = lax.conv_dimension_numbers(x.shape, weight.shape, ("NCHW", "OIHW", "NCHW"))
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    (data_format, "OIHW", data_format))
     out = lax.conv_general_dilated(
         x, weight, window_strides=stride, padding=pad,
         rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
     )
     if bias is not None:
-        out = out + bias.reshape(1, -1, 1, 1)
+        shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        out = out + bias.reshape(shape)
     return out
 
 

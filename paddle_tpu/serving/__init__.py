@@ -1,0 +1,60 @@
+"""paddle_tpu.serving — continuous-batching LLM serving engine.
+
+Reference mapping: this subsystem is the TPU-native analogue of the
+reference's LLM serving path. What the reference spreads across
+`paddle/fluid/inference` (the predictor that executes the network),
+`python/paddle/incubate/nn/functional/block_multihead_attention.py` (the
+paged block-table KV kernel) and the serving frameworks above them
+(PaddleNLP llm predictor / fastdeploy: admission queue, dynamic batch,
+cache manager) collapses here into four small modules over the Pallas
+paged-decode kernel (`ops/pallas/paged_attention.py`):
+
+  kv_cache.py      page pool + free-list block allocator + per-sequence
+                   block tables (the reference's cache manager);
+  scheduler.py     FCFS continuous-batching scheduler with prefill/decode
+                   phases and youngest-first preemption under pool
+                   pressure (recompute-on-resume);
+  model_runner.py  jitted paged prefill/decode step functions adapting
+                   models.Llama / models.GPT (the fluid/inference role);
+  engine.py        ServingEngine: per-request sampling params, stop
+                   conditions, token streaming, plus `naive_generate`,
+                   the sequential oracle continuous batching must match
+                   token-for-token;
+  metrics.py       queue depth, TTFT, tokens/s, pool utilization,
+                   preemption counters for bench.py's serving sweep.
+
+Decode attends through the Pallas kernel on TPU and through the
+gather + dense-mask reference path on CPU — the same dual dispatch every
+kernel in ops/pallas uses, so the whole engine runs (and is tested)
+under JAX_PLATFORMS=cpu.
+
+Entry points: `paddle_tpu.inference.create_serving_engine(model)` is the
+bridge from the Predictor world; `tools/serving_smoke.py` is a runnable
+demo; `bench.py --child serving:...` drives the offered-load sweep.
+"""
+
+from paddle_tpu.serving.engine import (  # noqa: F401
+    RequestOutput, ServingEngine, TokenEvent, create_engine, naive_generate,
+    sample_token,
+)
+from paddle_tpu.serving.kv_cache import (  # noqa: F401
+    BlockAllocator, KVCachePool, SCRATCH_PAGE, SequenceKV,
+)
+from paddle_tpu.serving.metrics import (  # noqa: F401
+    Counter, EngineMetrics, Gauge, Histogram,
+)
+from paddle_tpu.serving.model_runner import (  # noqa: F401
+    GPTRunner, LlamaRunner, PagedModelRunner, runner_for,
+)
+from paddle_tpu.serving.scheduler import (  # noqa: F401
+    FCFSScheduler, Request, RequestState, SamplingParams,
+)
+
+__all__ = [
+    "BlockAllocator", "Counter", "EngineMetrics", "FCFSScheduler",
+    "GPTRunner", "Gauge", "Histogram", "KVCachePool", "LlamaRunner",
+    "PagedModelRunner", "Request", "RequestOutput", "RequestState",
+    "SCRATCH_PAGE", "SamplingParams", "SequenceKV", "ServingEngine",
+    "TokenEvent", "create_engine", "naive_generate", "runner_for",
+    "sample_token",
+]

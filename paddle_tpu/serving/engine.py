@@ -1,0 +1,285 @@
+"""ServingEngine: continuous-batching generation over the paged KV pool.
+
+Reference: the serving loop the reference runs above
+block_multihead_attention (PaddleNLP llm predictor / fastdeploy): an
+admission queue feeds a fixed-slot decode batch; prefill computes a new
+request's full context and first token; every subsequent step decodes
+one token for every running request in a single batched call through
+the paged-attention kernel; finished requests free their pages and their
+slot is refilled from the queue — the batch never drains to refill.
+
+The engine is deterministic end-to-end: FCFS admission, sorted-free-list
+pages, greedy (or seeded per-request) sampling, step-indexed sample keys
+that survive preemption. `naive_generate` is the scheduling oracle: the
+same runner, one request at a time, no scheduler — continuous batching
+must reproduce its tokens exactly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.serving.kv_cache import KVCachePool, SCRATCH_PAGE
+from paddle_tpu.serving.metrics import EngineMetrics
+from paddle_tpu.serving.model_runner import PagedModelRunner, runner_for
+from paddle_tpu.serving.scheduler import (
+    FCFSScheduler, Request, SamplingParams,
+)
+
+
+@dataclass
+class TokenEvent:
+    """One streamed token (the engine's per-step output unit)."""
+
+    request_id: str
+    token: int
+    index: int                   # position within the generated sequence
+    finished: bool = False
+    finish_reason: Optional[str] = None
+
+
+@dataclass
+class RequestOutput:
+    request_id: str
+    prompt_tokens: List[int]
+    output_tokens: List[int]
+    finish_reason: str
+    num_preemptions: int = 0
+    ttft_s: Optional[float] = None
+    e2e_s: Optional[float] = None
+
+
+def sample_token(logits_row: np.ndarray, sampling: SamplingParams,
+                 step: int, fallback_seed: int) -> int:
+    """Sample the next token from one [V] logits row, host-side.
+
+    Per-request keys are step-indexed (fold_in by generated-token index),
+    so a preempted request resumes the identical sample stream."""
+    if sampling.temperature == 0.0:
+        return int(np.argmax(logits_row))
+    from paddle_tpu.models.generation import _sample
+
+    seed = sampling.seed if sampling.seed is not None else fallback_seed
+    key = jax.random.fold_in(jax.random.key(seed), step)
+    tok = _sample(jnp.asarray(logits_row)[None], key, sampling.temperature,
+                  sampling.top_k, sampling.top_p)
+    return int(np.asarray(tok)[0])
+
+
+class ServingEngine:
+    """Continuous-batching LLM serving over a paged KV cache.
+
+    engine = ServingEngine(runner, num_blocks=64, block_size=16,
+                           max_batch_size=8, max_model_len=256)
+    rid = engine.add_request([1, 2, 3], SamplingParams(max_tokens=8))
+    for events in iter(engine.step, []): ...   # streaming
+    outputs = engine.run()                     # or drain to completion
+    """
+
+    def __init__(self, runner: PagedModelRunner, *, num_blocks: int,
+                 block_size: Optional[int] = None, max_batch_size: int = 8,
+                 max_model_len: Optional[int] = None,
+                 metrics: Optional[EngineMetrics] = None):
+        self.runner = runner
+        block_size = block_size or runner.block_size
+        if block_size != runner.block_size:
+            raise ValueError(
+                f"engine block_size={block_size} != runner.block_size="
+                f"{runner.block_size} — they share the pool layout")
+        self.max_model_len = max_model_len or runner.max_model_len
+        if self.max_model_len > runner.max_model_len:
+            raise ValueError("max_model_len exceeds the runner's rope/pos "
+                             f"table length {runner.max_model_len}")
+        self.pool = KVCachePool(runner.num_layers, num_blocks, block_size,
+                                runner.n_kv_heads, runner.head_dim,
+                                runner.dtype)
+        self.max_pages_per_seq = self.pool.blocks_for_tokens(
+            self.max_model_len)
+        self.scheduler = FCFSScheduler(self.pool, max_batch_size,
+                                       self.max_pages_per_seq)
+        self.max_batch_size = max_batch_size
+        self.metrics = metrics or EngineMetrics()
+        self._requests: Dict[str, Request] = {}
+        self._outputs: Dict[str, RequestOutput] = {}
+
+    # ----------------------------------------------------------- intake
+
+    def add_request(self, prompt_tokens: Sequence[int],
+                    sampling: Optional[SamplingParams] = None,
+                    request_id: Optional[str] = None) -> str:
+        sampling = sampling or SamplingParams()
+        req = Request(prompt_tokens=list(map(int, prompt_tokens)),
+                      sampling=sampling, request_id=request_id or "")
+        if len(req.prompt_tokens) + sampling.max_tokens > self.max_model_len:
+            raise ValueError(
+                f"prompt({len(req.prompt_tokens)}) + max_tokens"
+                f"({sampling.max_tokens}) exceeds max_model_len="
+                f"{self.max_model_len}")
+        req.arrival_time = self.metrics.clock()
+        self._requests[req.request_id] = req
+        self.scheduler.add(req)
+        self.metrics.requests_added.inc()
+        self.metrics.queue_depth.set(self.scheduler.queue_depth)
+        return req.request_id
+
+    def has_work(self) -> bool:
+        return self.scheduler.has_work()
+
+    # ------------------------------------------------------------- step
+
+    def step(self) -> List[TokenEvent]:
+        """One engine iteration: admit + prefill new requests, reserve
+        decode pages (preempting if needed), run one batched decode step.
+        Returns the tokens produced this step (streaming surface)."""
+        if not self.scheduler.has_work():
+            return []
+        self.metrics.mark_active()
+        events: List[TokenEvent] = []
+
+        # 1. admission + prefill (each admitted request computes its full
+        #    context and first token; TTFT clock stops here)
+        for req in self.scheduler.admit():
+            table = self.pool.pad_table(req.kv.pages, self.max_pages_per_seq)
+            logits, new_pools = self.runner.prefill(
+                req.context_tokens, table, self.pool.pools)
+            self.pool.pools = new_pools
+            req.kv.num_tokens = req.num_context
+            self.metrics.prefill_tokens.inc(req.num_context)
+            tok = sample_token(np.asarray(logits), req.sampling,
+                               len(req.output_tokens), req.arrival_index)
+            events.append(self._append_token(req, tok))
+
+        # 2. decode-page reservation; pool pressure preempts youngest-first
+        victims = self.scheduler.reserve_decode()
+        for v in victims:
+            self.metrics.preemptions.inc()
+
+        # 3. one batched decode step over every running sequence
+        running = self.scheduler.running_in_order()
+        if running:
+            self.metrics.batch_occupancy.observe(len(running))
+            events.extend(self._decode_once(running))
+        self.metrics.decode_steps.inc()
+
+        # bookkeeping gauges
+        a = self.pool.allocator
+        self.metrics.queue_depth.set(self.scheduler.queue_depth)
+        self.metrics.running.set(len(self.scheduler.running))
+        self.metrics.pool_used_pages.set(a.num_usable - a.num_free)
+        self.metrics.pool_utilization.set(self.pool.utilization())
+        return events
+
+    def _decode_once(self, running: Sequence[Request]) -> List[TokenEvent]:
+        B = self.max_batch_size
+        P = self.max_pages_per_seq
+        tokens = np.zeros((B,), np.int32)
+        tables = np.full((B, P), SCRATCH_PAGE, np.int32)
+        pos = np.zeros((B,), np.int32)
+        for req in running:
+            s = req.slot
+            tokens[s] = req.output_tokens[-1]
+            tables[s, :len(req.kv.pages)] = req.kv.pages
+            pos[s] = req.num_context - 1   # position of the fed token
+        logits, new_pools = self.runner.decode(tokens, tables, pos,
+                                               self.pool.pools)
+        self.pool.pools = new_pools
+        logits_np = np.asarray(logits)
+        events = []
+        for req in running:
+            req.kv.num_tokens = req.num_context
+            tok = sample_token(logits_np[req.slot], req.sampling,
+                               len(req.output_tokens), req.arrival_index)
+            events.append(self._append_token(req, tok))
+        return events
+
+    def _append_token(self, req: Request, tok: int) -> TokenEvent:
+        now = self.metrics.clock()
+        if req.first_token_time is None:
+            req.first_token_time = now
+            self.metrics.ttft_s.observe(now - req.arrival_time)
+        req.output_tokens.append(tok)
+        self.metrics.tokens_generated.inc()
+        reason = None
+        if tok in req.sampling.stop_token_ids:
+            reason = "stop"
+        elif len(req.output_tokens) >= req.sampling.max_tokens:
+            reason = "length"
+        if reason is not None:
+            req.finish_time = now
+            self.scheduler.finish(req, reason)
+            self.metrics.requests_finished.inc()
+            self.metrics.e2e_latency_s.observe(now - req.arrival_time)
+            self._outputs[req.request_id] = RequestOutput(
+                request_id=req.request_id,
+                prompt_tokens=list(req.prompt_tokens),
+                output_tokens=list(req.output_tokens),
+                finish_reason=reason,
+                num_preemptions=req.num_preemptions,
+                ttft_s=req.first_token_time - req.arrival_time,
+                e2e_s=req.finish_time - req.arrival_time)
+        return TokenEvent(req.request_id, tok,
+                          len(req.output_tokens) - 1,
+                          finished=reason is not None, finish_reason=reason)
+
+    # -------------------------------------------------------------- run
+
+    def run(self) -> Dict[str, RequestOutput]:
+        """Drain the engine; returns every finished RequestOutput."""
+        while self.scheduler.has_work():
+            self.step()
+        return dict(self._outputs)
+
+    def outputs(self) -> Dict[str, RequestOutput]:
+        return dict(self._outputs)
+
+
+def naive_generate(runner: PagedModelRunner, prompt_tokens: Sequence[int],
+                   sampling: Optional[SamplingParams] = None,
+                   max_model_len: Optional[int] = None,
+                   fallback_seed: int = 0) -> List[int]:
+    """Sequential single-request generation — the scheduling oracle.
+
+    Same runner, same page layout (a private identity-mapped pool), no
+    scheduler, no batching, no preemption. ServingEngine must match this
+    token-for-token for every request."""
+    sampling = sampling or SamplingParams()
+    max_model_len = max_model_len or runner.max_model_len
+    max_pages = -(-max_model_len // runner.block_size)
+    pool = KVCachePool(runner.num_layers, max_pages + 1,
+                       runner.block_size, runner.n_kv_heads,
+                       runner.head_dim, runner.dtype)
+    pages = pool.allocator.alloc(max_pages)
+    table = pool.pad_table(pages, max_pages)
+    tokens = list(map(int, prompt_tokens))
+    logits, pools = runner.prefill(tokens, table, pool.pools)
+    out: List[int] = []
+    tok = sample_token(np.asarray(logits), sampling, 0, fallback_seed)
+    out.append(tok)
+    tables = np.asarray(table, np.int32)[None]
+    while len(out) < sampling.max_tokens and tok not in \
+            sampling.stop_token_ids:
+        pos = np.asarray([len(tokens) + len(out) - 1], np.int32)
+        logits, pools = runner.decode(np.asarray([tok], np.int32), tables,
+                                      pos, pools)
+        tok = sample_token(np.asarray(logits)[0], sampling, len(out),
+                           fallback_seed)
+        out.append(tok)
+    return out
+
+
+def create_engine(model, *, num_blocks: int = 128,
+                  block_size: int = 16, max_batch_size: int = 8,
+                  max_model_len: Optional[int] = None,
+                  attn_impl: str = "auto", **engine_kw) -> ServingEngine:
+    """Build a ServingEngine for a supported decoder Layer (Llama, GPT)."""
+    runner = runner_for(model, block_size=block_size,
+                        max_model_len=max_model_len, attn_impl=attn_impl)
+    return ServingEngine(runner, num_blocks=num_blocks,
+                         block_size=block_size,
+                         max_batch_size=max_batch_size,
+                         max_model_len=max_model_len, **engine_kw)

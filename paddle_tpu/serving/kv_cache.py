@@ -1,0 +1,142 @@
+"""Paged KV-cache pool + block allocator for the serving engine.
+
+Reference: the reference's block_multihead_attention serving path
+(python/paddle/incubate/nn/functional/block_multihead_attention.py) keys
+decode attention by a per-sequence block table into a shared page pool;
+the allocator above it (PaddleNLP llm serving / fastdeploy cache manager)
+hands out fixed-size pages from a free list so sequences of any length
+share one HBM reservation.
+
+Layout matches ops/pallas/paged_attention.py exactly: per layer a
+(k_pool, v_pool) pair of [num_blocks, block_size, n_kv_heads, head_dim]
+arrays, block tables of int32 page ids. Page 0 is RESERVED as scratch:
+dead batch slots and padded prefill positions write there, so the
+allocator never hands it out and no live sequence ever reads it.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+SCRATCH_PAGE = 0
+
+
+class BlockAllocator:
+    """Deterministic free-list page allocator.
+
+    Pages are handed out lowest-id-first (sorted free list) so a given
+    request trace always produces the same block tables — the property the
+    token-for-token equivalence test leans on. Page 0 (scratch) is never
+    allocatable.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("pool needs >= 2 pages (page 0 is scratch)")
+        self.num_blocks = num_blocks
+        self._free = list(range(1, num_blocks))  # ascending
+        self._allocated: set = set()
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_usable(self) -> int:
+        """Total allocatable pages (excludes the scratch page)."""
+        return self.num_blocks - 1
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise MemoryError(
+                f"KV pool exhausted: need {n} pages, {len(self._free)} free")
+        pages, self._free = self._free[:n], self._free[n:]
+        self._allocated.update(pages)
+        return pages
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if p not in self._allocated:
+                raise ValueError(f"double free of page {p}")
+            self._allocated.discard(p)
+        # keep the free list sorted: allocation order stays deterministic
+        self._free = sorted(self._free + list(pages))
+
+    def check_no_leaks(self) -> bool:
+        return not self._allocated and len(self._free) == self.num_usable
+
+
+class KVCachePool:
+    """The device-side page pool: per-layer (k, v) pools + the allocator.
+
+    `pools` are plain jnp arrays threaded through the jitted model steps
+    (functional update: the runner returns new pools, the engine writes
+    them back here). Block tables live host-side as python lists per
+    sequence; `pad_table` builds the fixed-shape device operand.
+    """
+
+    def __init__(self, num_layers: int, num_blocks: int, block_size: int,
+                 n_kv_heads: int, head_dim: int, dtype=jnp.float32):
+        self.num_layers = num_layers
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.n_kv_heads = n_kv_heads
+        self.head_dim = head_dim
+        self.dtype = dtype
+        self.allocator = BlockAllocator(num_blocks)
+        shape = (num_blocks, block_size, n_kv_heads, head_dim)
+        self.pools = [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                      for _ in range(num_layers)]
+
+    def blocks_for_tokens(self, n_tokens: int) -> int:
+        """Pages needed to hold n_tokens KV entries."""
+        return max(1, -(-n_tokens // self.block_size))
+
+    def pad_table(self, pages: List[int], max_pages: int) -> List[int]:
+        """Fixed-width table row; unused entries point at the scratch page
+        (their keys are masked by pos, never read)."""
+        if len(pages) > max_pages:
+            raise ValueError(f"sequence needs {len(pages)} pages > "
+                             f"max_pages_per_seq={max_pages}")
+        return list(pages) + [SCRATCH_PAGE] * (max_pages - len(pages))
+
+    def utilization(self) -> float:
+        a = self.allocator
+        return 1.0 - a.num_free / a.num_usable
+
+    def memory_bytes(self) -> int:
+        itemsize = jnp.zeros((), self.dtype).dtype.itemsize
+        return (2 * self.num_layers * self.num_blocks * self.block_size
+                * self.n_kv_heads * self.head_dim * itemsize)
+
+
+class SequenceKV:
+    """Host-side per-sequence cache state: the owned pages and how many
+    token positions are live. Appending crosses page boundaries lazily —
+    `pages_short()` reports the deficit the scheduler must fund (or
+    preempt to fund) before the next decode step."""
+
+    def __init__(self, pool: KVCachePool):
+        self.pool = pool
+        self.pages: List[int] = []
+        self.num_tokens = 0
+
+    def pages_short(self, upcoming_tokens: int = 1) -> int:
+        need = self.pool.blocks_for_tokens(self.num_tokens + upcoming_tokens)
+        return max(0, need - len(self.pages))
+
+    def grow(self, upcoming_tokens: int = 1) -> None:
+        short = self.pages_short(upcoming_tokens)
+        if short:
+            self.pages.extend(self.pool.allocator.alloc(short))
+
+    def release(self) -> None:
+        if self.pages:
+            self.pool.allocator.free(self.pages)
+        self.pages = []
+        self.num_tokens = 0

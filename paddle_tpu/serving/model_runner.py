@@ -1,0 +1,309 @@
+"""Model runners: pure paged-KV step functions for the serving engine.
+
+Reference: the reference serving stack splits "model" from "engine" the
+same way — fluid/inference executes the network, the serving layer above
+owns batching — with block_multihead_attention as the seam. Here a
+runner adapts a decoder Layer (models.Llama, models.GPT) into two jitted
+step functions over the shared page pool:
+
+  prefill(tokens[1, T], table[1, P], real_len, pools) -> (logits[V], pools)
+  decode(tokens[B, 1], tables[B, P], pos[B], pools)   -> (logits[B, V], pools)
+
+Both steps write K/V through the block table and attend through either
+the Pallas paged-decode kernel (TPU, matched head counts, 8-aligned head
+dim) or the gather + dense-mask reference path — the same dual dispatch
+the kernels in ops/pallas use. Prefill lengths are padded to power-of-2
+buckets so the compile count stays logarithmic; padded positions write
+to the scratch page and their logits are never read. Dead decode slots
+carry all-scratch tables, so they self-neutralize without a mask.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.models.generation import (
+    _block_params, _layer_norm, _mlp, masked_cache_attention, paged_gather,
+)
+from paddle_tpu.models.llama import _rope_tables
+from paddle_tpu.serving.kv_cache import SCRATCH_PAGE
+
+
+def _bucket_len(t: int, minimum: int = 8) -> int:
+    """Power-of-2 prefill bucket (compile once per bucket, not per len)."""
+    b = minimum
+    while b < t:
+        b *= 2
+    return b
+
+
+def paged_attend(q, k_new, v_new, k_pool, v_pool, tables, write_page,
+                 write_off, pos_q, n_rep: int, use_pallas: bool):
+    """Write this step's K/V through the block table, then attend.
+
+    q: [B, T, n_h, d]; k_new/v_new: [B, T, n_kv, d]; tables: [B, P];
+    write_page/write_off: [B, T] int32; pos_q: [B] position of q row 0.
+    Returns ([B, T, n_h*d], k_pool, v_pool)."""
+    k_pool = k_pool.at[write_page, write_off].set(k_new)
+    v_pool = v_pool.at[write_page, write_off].set(v_new)
+    B, T = q.shape[0], q.shape[1]
+    if use_pallas and T == 1 and n_rep == 1:
+        from paddle_tpu.ops.pallas.paged_attention import \
+            paged_decode_attention
+
+        out = paged_decode_attention(q[:, 0], k_pool, v_pool, tables, pos_q)
+        return out.reshape(B, 1, -1), k_pool, v_pool
+    kg = paged_gather(k_pool, tables)
+    vg = paged_gather(v_pool, tables)
+    if n_rep > 1:  # GQA: repeat kv groups up to the query heads
+        kg = jnp.repeat(kg, n_rep, axis=2)
+        vg = jnp.repeat(vg, n_rep, axis=2)
+    out = masked_cache_attention(q, kg, vg, pos_q)
+    return out, k_pool, v_pool
+
+
+class PagedModelRunner:
+    """Shared runner chassis: write-index math, jit caching, dispatch.
+
+    Subclasses set the architecture fields in __init__ and implement
+    `_forward(params, tokens, positions, write_page, write_off, tables,
+    pos_q, pools) -> (logits[B, T, V], pools)`.
+    """
+
+    num_layers: int
+    n_heads: int
+    n_kv_heads: int
+    head_dim: int
+    vocab_size: int
+
+    def __init__(self, params: Dict[str, jnp.ndarray], block_size: int,
+                 max_model_len: int, attn_impl: str = "auto"):
+        self.params = params
+        self.block_size = block_size
+        self.max_model_len = max_model_len
+        if attn_impl not in ("auto", "pallas", "reference"):
+            raise ValueError(f"attn_impl={attn_impl!r}")
+        self.attn_impl = attn_impl
+        self._jit_cache: Dict = {}
+
+    @property
+    def dtype(self):
+        return next(iter(self.params.values())).dtype
+
+    @property
+    def n_rep(self) -> int:
+        return self.n_heads // self.n_kv_heads
+
+    def _resolve_pallas(self) -> bool:
+        if self.attn_impl == "pallas":
+            return True
+        if self.attn_impl == "reference":
+            return False
+        from paddle_tpu.ops.pallas.paged_attention import paged_decode_ok
+
+        return (jax.default_backend() == "tpu" and self.n_rep == 1
+                and paged_decode_ok(self.head_dim))
+
+    # ------------------------------------------------------------- steps
+
+    def _write_indices(self, positions, tables, valid):
+        """positions/valid: [B, T]; tables: [B, P] -> page/off [B, T].
+        Invalid positions are redirected to the scratch page."""
+        page = jnp.take_along_axis(
+            tables, (positions // self.block_size).astype(jnp.int32), axis=1)
+        page = jnp.where(valid, page, SCRATCH_PAGE)
+        return page, positions % self.block_size
+
+    def _prefill_step(self, params, tokens, table, real_len, pools):
+        T = tokens.shape[1]
+        positions = jnp.arange(T, dtype=jnp.int32)[None, :]        # [1, T]
+        valid = positions < real_len
+        positions = jnp.where(valid, positions, 0)
+        page, off = self._write_indices(positions, table, valid)
+        logits, pools = self._forward(params, tokens, positions, page, off,
+                                      table, jnp.zeros((1,), jnp.int32),
+                                      pools)
+        return logits[0, real_len - 1], pools
+
+    def _decode_step(self, params, tokens, tables, pos, pools):
+        positions = pos[:, None].astype(jnp.int32)                 # [B, 1]
+        valid = jnp.ones_like(positions, bool)  # dead slots: scratch tables
+        page, off = self._write_indices(positions, tables, valid)
+        logits, pools = self._forward(params, tokens, positions, page, off,
+                                      tables, pos, pools)
+        return logits[:, 0], pools
+
+    def _jitted(self, kind: str, shape_key):
+        key = (kind, shape_key)
+        if key not in self._jit_cache:
+            fn = {"prefill": self._prefill_step,
+                  "decode": self._decode_step}[kind]
+            donate = (4,) if jax.default_backend() == "tpu" else ()
+            self._jit_cache[key] = jax.jit(fn, donate_argnums=donate)
+        return self._jit_cache[key]
+
+    def prefill(self, tokens: List[int], table_row: List[int], pools):
+        """Run one sequence's (re-)prefill; returns (last_logits[V], pools)."""
+        t = len(tokens)
+        tb = _bucket_len(t)
+        padded = np.zeros((1, tb), np.int32)
+        padded[0, :t] = tokens
+        fn = self._jitted("prefill", tb)
+        return fn(self.params, jnp.asarray(padded),
+                  jnp.asarray(np.asarray(table_row, np.int32)[None]),
+                  jnp.asarray(t, jnp.int32), pools)
+
+    def decode(self, tokens, tables, pos, pools):
+        """Batched decode step; tokens [B], tables [B, P], pos [B]."""
+        fn = self._jitted("decode", tokens.shape[0])
+        return fn(self.params, jnp.asarray(tokens)[:, None],
+                  jnp.asarray(tables), jnp.asarray(pos), pools)
+
+    def _forward(self, params, tokens, positions, write_page, write_off,
+                 tables, pos_q, pools):
+        raise NotImplementedError
+
+
+class LlamaRunner(PagedModelRunner):
+    """Paged-step adapter for models.Llama (RMSNorm + RoPE + GQA + SwiGLU).
+
+    Params come from jit.functionalize, so the runner serves exactly the
+    weights of the Layer it was built from."""
+
+    def __init__(self, model, block_size: int = 16,
+                 max_model_len: int | None = None, attn_impl: str = "auto"):
+        from paddle_tpu.jit.functionalize import functionalize
+
+        cfg = model.cfg
+        params = functionalize(model).param_values()
+        super().__init__(params, block_size,
+                         max_model_len or cfg.max_seq_len, attn_impl)
+        self.cfg = cfg
+        self.num_layers = cfg.num_layers
+        self.n_heads = cfg.num_heads
+        self.n_kv_heads = cfg.num_kv_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        self.vocab_size = cfg.vocab_size
+        cos, sin = _rope_tables(self.max_model_len, self.head_dim,
+                                cfg.rope_theta)
+        self._rope_cos, self._rope_sin = cos, sin      # [L, d] fp32
+
+    def _rope(self, x, cos, sin):
+        # same rotate-half convention as ops.rotary_embedding
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        rot = jnp.concatenate([-x2, x1], axis=-1)
+        return (x * cos[:, :, None, :] + rot * sin[:, :, None, :]
+                ).astype(x.dtype)
+
+    def _rms(self, x, w, eps):
+        xf = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+    def _forward(self, params, tokens, positions, write_page, write_off,
+                 tables, pos_q, pools):
+        cfg = self.cfg
+        B, T = tokens.shape
+        d = self.head_dim
+        use_pallas = self._resolve_pallas()
+        x = jnp.take(params["embed_tokens.weight"], tokens, axis=0)
+        cos = jnp.take(self._rope_cos, positions, axis=0)   # [B, T, d]
+        sin = jnp.take(self._rope_sin, positions, axis=0)
+        new_pools = []
+        for i in range(cfg.num_layers):
+            pre = f"layers.{i}."
+            h = self._rms(x, params[pre + "input_layernorm.weight"],
+                          cfg.rms_eps)
+            q = (h @ params[pre + "self_attn.q_proj.weight"]
+                 ).reshape(B, T, self.n_heads, d)
+            k = (h @ params[pre + "self_attn.k_proj.weight"]
+                 ).reshape(B, T, self.n_kv_heads, d)
+            v = (h @ params[pre + "self_attn.v_proj.weight"]
+                 ).reshape(B, T, self.n_kv_heads, d)
+            q = self._rope(q, cos, sin)
+            k = self._rope(k, cos, sin)
+            out, kp, vp = paged_attend(
+                q, k, v, pools[i][0], pools[i][1], tables, write_page,
+                write_off, pos_q, self.n_rep, use_pallas)
+            x = x + out @ params[pre + "self_attn.o_proj.weight"]
+            h = self._rms(x, params[pre + "post_attention_layernorm.weight"],
+                          cfg.rms_eps)
+            gate = h @ params[pre + "mlp.gate_proj.weight"]
+            up = h @ params[pre + "mlp.up_proj.weight"]
+            x = x + (jax.nn.silu(gate) * up) @ params[pre
+                                                      + "mlp.down_proj.weight"]
+            new_pools.append((kp, vp))
+        x = self._rms(x, params["norm.weight"], cfg.rms_eps)
+        if cfg.tie_embeddings:
+            logits = x @ params["embed_tokens.weight"].T
+        else:
+            logits = x @ params["lm_head.weight"]
+        return logits, new_pools
+
+
+class GPTRunner(PagedModelRunner):
+    """Paged-step adapter for models.GPT — reuses the functional block
+    helpers the dense-cache generator already runs."""
+
+    def __init__(self, model, block_size: int = 16,
+                 max_model_len: int | None = None, attn_impl: str = "auto"):
+        from paddle_tpu.jit.functionalize import functionalize
+
+        cfg = model.cfg
+        params = functionalize(model).param_values()
+        super().__init__(params, block_size,
+                         max_model_len or cfg.max_seq_len, attn_impl)
+        self.cfg = cfg
+        self.num_layers = cfg.num_layers
+        self.n_heads = cfg.num_heads
+        self.n_kv_heads = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        self.vocab_size = cfg.vocab_size
+
+    def _forward(self, params, tokens, positions, write_page, write_off,
+                 tables, pos_q, pools):
+        cfg = self.cfg
+        B, T = tokens.shape
+        d = self.head_dim
+        use_pallas = self._resolve_pallas()
+        x = (jnp.take(params["wte.weight"], tokens, axis=0)
+             + jnp.take(params["wpe.weight"], positions, axis=0))
+        new_pools = []
+        for i in range(cfg.num_layers):
+            p = _block_params(params, i)
+            h = _layer_norm(x, p["ln1.weight"], p["ln1.bias"])
+            qkv = (h @ p["attn.qkv.weight"] + p["attn.qkv.bias"]
+                   ).reshape(B, T, 3, self.n_heads, d)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            out, kp, vp = paged_attend(
+                q, k, v, pools[i][0], pools[i][1], tables, write_page,
+                write_off, pos_q, 1, use_pallas)
+            x = x + (out @ p["attn.out.weight"] + p["attn.out.bias"])
+            h = _layer_norm(x, p["ln2.weight"], p["ln2.bias"])
+            x = x + _mlp(p, h)
+            new_pools.append((kp, vp))
+        x = _layer_norm(x, params["ln_f.weight"], params["ln_f.bias"])
+        if "lm_head.weight" in params:
+            logits = jnp.einsum("bth,hv->btv", x, params["lm_head.weight"])
+        else:
+            logits = jnp.einsum("bth,vh->btv", x, params["wte.weight"])
+        return logits, new_pools
+
+
+def runner_for(model, block_size: int = 16, max_model_len: int | None = None,
+               attn_impl: str = "auto") -> PagedModelRunner:
+    """Pick the runner for a supported decoder Layer."""
+    from paddle_tpu.models.gpt import GPT
+    from paddle_tpu.models.llama import Llama
+
+    if isinstance(model, Llama):
+        return LlamaRunner(model, block_size, max_model_len, attn_impl)
+    if isinstance(model, GPT):
+        return GPTRunner(model, block_size, max_model_len, attn_impl)
+    raise TypeError(
+        f"no serving runner for {type(model).__name__}; supported: Llama, "
+        "GPT (write a PagedModelRunner subclass for custom decoders)")

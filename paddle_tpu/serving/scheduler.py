@@ -1,0 +1,215 @@
+"""Continuous-batching scheduler: FCFS admission, decode reservation,
+LIFO preemption.
+
+Reference: the reference's serving deployments drive
+block_multihead_attention with exactly this loop (PaddleNLP llm
+serving / fastdeploy scheduler): new requests wait in an admission
+queue, prefill joins them to the running batch, every decode step first
+reserves the KV pages the step will write, and when the pool runs dry
+the *youngest* running sequence is preempted — its pages freed, the
+request recycled to the FRONT of the queue for recompute-on-resume.
+
+Determinism contract (the equivalence test leans on every clause):
+  * admission is strict FCFS with head-of-line blocking — requests are
+    admitted in arrival order and a request that does not fit blocks the
+    ones behind it (no out-of-order fill);
+  * pages come from a sorted free list (kv_cache.BlockAllocator), so the
+    same trace of events always yields the same block tables;
+  * preemption victims are chosen youngest-first (last admitted), and a
+    preempted request resumes with its full context (prompt + generated
+    so far) re-prefilled — recompute, not cache migration.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import deque
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Deque, List, Optional, Sequence, Tuple
+
+from paddle_tpu.serving.kv_cache import KVCachePool, SequenceKV
+
+
+@dataclass
+class SamplingParams:
+    """Per-request sampling controls (reference: generation config of the
+    reference's serving API; greedy by default so runs are reproducible)."""
+
+    max_tokens: int = 16
+    temperature: float = 0.0          # 0.0 = greedy
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    seed: Optional[int] = None        # None -> derived from request id
+    stop_token_ids: Tuple[int, ...] = ()
+
+    def __post_init__(self):
+        if self.max_tokens < 1:
+            raise ValueError("max_tokens must be >= 1")
+
+
+class RequestState(Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    FINISHED = "finished"
+
+
+_req_counter = itertools.count()
+
+
+@dataclass(eq=False)          # identity semantics: the scheduler tracks
+class Request:                # requests by object, never by field value
+    """One in-flight generation request."""
+
+    prompt_tokens: List[int]
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    request_id: str = ""
+    arrival_index: int = field(default_factory=lambda: next(_req_counter))
+    state: RequestState = RequestState.WAITING
+    output_tokens: List[int] = field(default_factory=list)
+    finish_reason: Optional[str] = None    # "stop" | "length"
+    kv: Optional[SequenceKV] = None
+    slot: Optional[int] = None
+    admission_index: int = -1              # set fresh at every admission
+    num_preemptions: int = 0
+    arrival_time: float = 0.0
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+
+    def __post_init__(self):
+        if not self.prompt_tokens:
+            raise ValueError("empty prompt")
+        if not self.request_id:
+            self.request_id = f"req-{self.arrival_index}"
+
+    @property
+    def context_tokens(self) -> List[int]:
+        """Prompt plus everything generated — what a (re-)prefill runs."""
+        return self.prompt_tokens + self.output_tokens
+
+    @property
+    def num_context(self) -> int:
+        return len(self.prompt_tokens) + len(self.output_tokens)
+
+    @property
+    def done(self) -> bool:
+        return self.state is RequestState.FINISHED
+
+
+class FCFSScheduler:
+    """Admission queue + running set over one KVCachePool."""
+
+    def __init__(self, pool: KVCachePool, max_batch_size: int,
+                 max_pages_per_seq: int):
+        if max_pages_per_seq > pool.allocator.num_usable:
+            raise ValueError(
+                f"max_pages_per_seq={max_pages_per_seq} exceeds the pool's "
+                f"{pool.allocator.num_usable} usable pages — one sequence "
+                "could never fit; enlarge num_blocks")
+        self.pool = pool
+        self.max_batch_size = max_batch_size
+        self.max_pages_per_seq = max_pages_per_seq
+        self.waiting: Deque[Request] = deque()
+        self.running: List[Request] = []     # kept in admission order
+        self._admission_counter = itertools.count()
+        self._free_slots = list(range(max_batch_size))  # ascending
+
+    # ------------------------------------------------------------- queue
+
+    def add(self, req: Request) -> None:
+        req.state = RequestState.WAITING
+        self.waiting.append(req)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    # --------------------------------------------------------- admission
+
+    def admit(self) -> List[Request]:
+        """Admit queue-head requests while a slot and enough pages exist
+        for their full context PLUS one decode token (so every admitted
+        request is guaranteed its first generated token without an
+        immediate self-preemption). Strict FCFS: stop at the first
+        request that does not fit."""
+        admitted: List[Request] = []
+        while self.waiting and self._free_slots:
+            req = self.waiting[0]
+            need = self.pool.blocks_for_tokens(req.num_context + 1)
+            if need > self.max_pages_per_seq:
+                raise ValueError(
+                    f"request {req.request_id} needs {need} pages > "
+                    f"max_pages_per_seq={self.max_pages_per_seq}")
+            if not self.pool.allocator.can_alloc(need):
+                break
+            self.waiting.popleft()
+            req.kv = SequenceKV(self.pool)
+            req.kv.grow(req.num_context + 1)
+            req.slot = self._free_slots.pop(0)
+            req.admission_index = next(self._admission_counter)
+            req.state = RequestState.RUNNING
+            self.running.append(req)
+            admitted.append(req)
+        return admitted
+
+    # -------------------------------------------------------- preemption
+
+    def reserve_decode(self) -> List[Request]:
+        """Reserve the KV page each running sequence's next token will
+        write, preempting youngest-first when the pool runs dry. Returns
+        the victims (already recycled to the queue front). Called before
+        every decode step."""
+        victims: List[Request] = []
+        for req in list(self.running):      # admission order = oldest first
+            if req not in self.running:     # already preempted this pass
+                continue
+            while True:
+                short = req.kv.pages_short(1)
+                if short == 0 or self.pool.allocator.can_alloc(short):
+                    req.kv.grow(1)
+                    break
+                victim = self.running[-1]   # youngest
+                if victim is req and len(self.running) == 1:
+                    raise MemoryError(
+                        f"request {req.request_id} cannot grow even with "
+                        "the pool to itself — num_blocks too small for "
+                        "max_model_len")
+                self._preempt(victim)
+                victims.append(victim)
+                if victim is req:
+                    break
+        # queue-front recycle in arrival order: oldest victim resumes first
+        for v in sorted(victims, key=lambda r: r.arrival_index, reverse=True):
+            self.waiting.appendleft(v)
+        return victims
+
+    def _preempt(self, req: Request) -> None:
+        req.kv.release()
+        req.kv = None
+        self._release_slot(req)
+        self.running.remove(req)
+        req.state = RequestState.WAITING
+        req.num_preemptions += 1
+
+    # ---------------------------------------------------------- finish
+
+    def finish(self, req: Request, reason: str) -> None:
+        req.kv.release()
+        req.kv = None
+        self._release_slot(req)
+        self.running.remove(req)
+        req.state = RequestState.FINISHED
+        req.finish_reason = reason
+
+    def _release_slot(self, req: Request) -> None:
+        self._free_slots.append(req.slot)
+        self._free_slots.sort()            # lowest slot reused first
+        req.slot = None
+
+    # ------------------------------------------------------------ views
+
+    def running_in_order(self) -> Sequence[Request]:
+        return tuple(self.running)

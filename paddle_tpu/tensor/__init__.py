@@ -1,2 +1,6 @@
-"""Extended tensor namespaces (linalg/fft) — reference: python/paddle/tensor/."""
+"""Extended tensor namespaces (linalg/fft/array) — reference:
+python/paddle/tensor/."""
 from paddle_tpu.tensor import fft, linalg  # noqa: F401
+from paddle_tpu.tensor.array import (  # noqa: F401
+    array_length, array_read, array_write, create_array,
+)

@@ -1,0 +1,96 @@
+"""TensorArray surface: create_array / array_write / array_read /
+array_length.
+
+Reference: python/paddle/tensor/array.py (re-exported through
+python/paddle/tensor/__init__.py). In the reference's dygraph mode a
+TensorArray is literally a python list of Tensors — array_write appends
+or overwrites, array_read indexes, array_length measures — and the
+static-graph LoDTensorArray op pair lowers to the same semantics. This
+build is eager-first (tracing IS execution), so the list IS the
+TensorArray; loops that accumulate per-iteration outputs (the
+static-control-flow use case) write into it host-side and `stack` the
+result afterwards.
+
+Indices may be python ints or integer Tensors (the reference accepts a
+0-D int64 Tensor); lengths are returned as the reference's int64 tensor.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["array_length", "array_read", "array_write", "create_array"]
+
+
+def _as_index(i) -> int:
+    if isinstance(i, Tensor):
+        i = np.asarray(i._value)
+    if isinstance(i, np.ndarray):
+        if i.size != 1:
+            raise ValueError(f"array index must be a scalar, got shape "
+                             f"{i.shape}")
+        i = i.reshape(()).item()
+    if not isinstance(i, (int, np.integer)):
+        raise TypeError(f"array index must be an int or integer Tensor, "
+                        f"got {type(i).__name__}")
+    return int(i)
+
+
+def create_array(dtype: str = "float32",
+                 initialized_list: Optional[List] = None) -> List[Tensor]:
+    """Create a TensorArray, optionally seeded from `initialized_list`
+    (reference create_array: the list members must be Tensors)."""
+    array: List[Tensor] = []
+    if initialized_list is not None:
+        if not isinstance(initialized_list, (list, tuple)):
+            raise TypeError(
+                "initialized_list must be a list/tuple of Tensors, got "
+                f"{type(initialized_list).__name__}")
+        for item in initialized_list:
+            if not isinstance(item, Tensor):
+                raise TypeError(
+                    "initialized_list members must be Tensors, got "
+                    f"{type(item).__name__}")
+            array.append(item)
+    return array
+
+
+def array_write(x: Tensor, i, array: Optional[List[Tensor]] = None
+                ) -> List[Tensor]:
+    """Write x at index i; i == len(array) appends (the loop-accumulate
+    idiom), i < len overwrites, i > len is an error (reference asserts
+    the same in dygraph)."""
+    idx = _as_index(i)
+    if array is None:
+        array = []
+    if idx > len(array):
+        raise IndexError(
+            f"array_write index {idx} past the end of a length-"
+            f"{len(array)} TensorArray (only i <= len(array) is valid)")
+    if idx == len(array):
+        array.append(x)
+    else:
+        array[idx] = x
+    return array
+
+
+def array_read(array: List[Tensor], i) -> Tensor:
+    idx = _as_index(i)
+    if not 0 <= idx < len(array):
+        raise IndexError(f"array_read index {idx} out of range for "
+                         f"length-{len(array)} TensorArray")
+    return array[idx]
+
+
+def array_length(array: List[Tensor]) -> Tensor:
+    """Length as an int64 scalar Tensor (reference returns the 1-D cpu
+    int64 tensor the static op produces)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.dtype import to_jax_dtype
+
+    return Tensor._wrap(jnp.asarray(len(array), to_jax_dtype("int64")))

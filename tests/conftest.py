@@ -12,12 +12,22 @@ import pytest
 TPU_MODE = os.environ.get("PADDLE_TPU_TESTS") == "1"
 
 os.environ.setdefault("JAX_NUM_CPU_DEVICES", "8")
+if not TPU_MODE:
+    # jax < 0.5 has no jax_num_cpu_devices config option; the XLA flag is
+    # the portable spelling and must be set before the CPU client exists
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 
 if not TPU_MODE:
     # must happen before the CPU client is instantiated
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:  # older jax: XLA_FLAGS fallback above applies
+        pass
     try:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
@@ -34,6 +44,10 @@ def pytest_configure(config):
         "markers",
         "tpu: hardware smoke test — runs only with PADDLE_TPU_TESTS=1 "
         "(one-command TPU tier: PADDLE_TPU_TESTS=1 pytest -m tpu tests/)")
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tier-2 test — excluded from the tier-1 "
+        "`-m 'not slow'` run")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,211 @@
+"""Reference call-signature parity (VERDICT r5 musts): fused_rms_norm /
+fused_rotary_position_embedding accept the reference's signatures,
+Conv2D honors data_format="NHWC", and the TensorArray family exists.
+"""
+
+import inspect
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as incubate_F
+import paddle_tpu.nn as nn
+
+rng = np.random.default_rng(0)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+# ------------------------------------------------------- fused_rms_norm
+
+
+def test_fused_rms_norm_reference_signature():
+    # reference: fused_rms_norm(x, norm_weight, norm_bias, epsilon,
+    # begin_norm_axis, bias=None, residual=None, quant_*)
+    names = list(inspect.signature(
+        incubate_F.fused_rms_norm).parameters)
+    assert names[:7] == ["x", "norm_weight", "norm_bias", "epsilon",
+                         "begin_norm_axis", "bias", "residual"]
+    x = _t(rng.standard_normal((2, 3, 8)).astype("float32"))
+    w = _t(np.ones(8, "float32"))
+    b = _t(np.full(8, 0.5, "float32"))
+    out, residual_out = incubate_F.fused_rms_norm(x, w, b, 1e-6, 2)
+    xv = np.asarray(x._value)
+    ref = xv / np.sqrt((xv ** 2).mean(-1, keepdims=True) + 1e-6) + 0.5
+    np.testing.assert_allclose(np.asarray(out._value), ref, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(residual_out._value), xv)
+
+
+def test_fused_rms_norm_residual_add_and_norm_axis():
+    x = _t(rng.standard_normal((2, 3, 4)).astype("float32"))
+    res = _t(rng.standard_normal((2, 3, 4)).astype("float32"))
+    bias = _t(np.full((4,), 0.25, "float32"))
+    w = _t(np.ones(12, "float32"))
+    out, residual_out = incubate_F.fused_rms_norm(
+        x, w, None, 1e-6, 1, bias=bias, residual=res)
+    y = np.asarray(x._value) + 0.25 + np.asarray(res._value)
+    np.testing.assert_allclose(np.asarray(residual_out._value), y,
+                               atol=1e-6)
+    # begin_norm_axis=1: normalized over the trailing [3, 4] block
+    ref = y / np.sqrt((y ** 2).mean((1, 2), keepdims=True) + 1e-6)
+    np.testing.assert_allclose(np.asarray(out._value), ref, atol=1e-5)
+
+
+def test_fused_layer_norm_reference_signature():
+    names = list(inspect.signature(
+        incubate_F.fused_layer_norm).parameters)
+    assert names[:7] == ["x", "norm_weight", "norm_bias", "epsilon",
+                         "begin_norm_axis", "bias", "residual"]
+    x = _t(rng.standard_normal((4, 8)).astype("float32"))
+    out, _ = incubate_F.fused_layer_norm(x, _t(np.ones(8, "float32")),
+                                         _t(np.zeros(8, "float32")),
+                                         1e-5, 1)
+    o = np.asarray(out._value)
+    np.testing.assert_allclose(o.mean(-1), 0, atol=1e-5)
+    np.testing.assert_allclose(o.std(-1), 1, atol=1e-3)
+
+
+# --------------------------------------- fused_rotary_position_embedding
+
+
+def test_fused_rope_reference_signature_and_neox_parity():
+    names = list(inspect.signature(
+        incubate_F.fused_rotary_position_embedding).parameters)
+    assert names[:7] == ["q", "k", "v", "sin", "cos", "position_ids",
+                         "use_neox_rotary_style"]
+    q = _t(rng.standard_normal((2, 5, 4, 8)).astype("float32"))
+    k = _t(rng.standard_normal((2, 5, 4, 8)).astype("float32"))
+    from paddle_tpu.models.llama import _rope_tables
+    from paddle_tpu.ops.registry import C_OPS
+
+    cos, sin = _rope_tables(5, 8, 10000.0)
+    # NOTE sin comes BEFORE cos in the reference signature
+    oq, ok, ov = incubate_F.fused_rotary_position_embedding(
+        q, k, None, _t(np.asarray(sin)), _t(np.asarray(cos)))
+    assert ov is None
+    rq, rk = C_OPS.rotary_embedding(q, k, _t(np.asarray(cos)),
+                                    _t(np.asarray(sin)))
+    np.testing.assert_allclose(np.asarray(oq._value),
+                               np.asarray(rq._value), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ok._value),
+                               np.asarray(rk._value), atol=1e-6)
+    # auto-built tables (sin/cos None) match the explicit ones
+    aq, ak, _ = incubate_F.fused_rotary_position_embedding(q, k)
+    np.testing.assert_allclose(np.asarray(aq._value),
+                               np.asarray(rq._value), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ak._value),
+                               np.asarray(rk._value), atol=1e-5)
+
+
+def test_fused_rope_interleaved_position_ids_time_major():
+    q = _t(rng.standard_normal((2, 6, 2, 4)).astype("float32"))
+    # non-neox (GPT-J interleaved): manual oracle
+    (oq,) = incubate_F.fused_rotary_position_embedding(
+        q, use_neox_rotary_style=False)[:1]
+    d = 4
+    inv = 1.0 / (10000.0 ** (np.arange(0, d, 2) / d))
+    ang = np.outer(np.arange(6), inv)               # [s, d/2]
+    cos = np.repeat(np.cos(ang), 2, -1)[None, :, None, :]
+    sin = np.repeat(np.sin(ang), 2, -1)[None, :, None, :]
+    xv = np.asarray(q._value)
+    rot = np.stack([-xv[..., 1::2], xv[..., 0::2]], -1).reshape(xv.shape)
+    np.testing.assert_allclose(np.asarray(oq._value), xv * cos + rot * sin,
+                               atol=1e-5)
+    # position_ids reorder == gathering the rotated rows
+    pid = np.asarray([[5, 4, 3, 2, 1, 0]] * 2)
+    pq = incubate_F.fused_rotary_position_embedding(
+        q, position_ids=_t(pid))[0]
+    fq = incubate_F.fused_rotary_position_embedding(q)[0]
+    base = np.asarray(q._value)
+    full = np.asarray(fq._value)
+    # row t of pq uses angle pid[t] applied to q row t: check one row
+    d2 = 4
+    inv2 = 1.0 / (10000.0 ** (np.arange(0, d2, 2) / d2))
+    ang5 = np.outer([5.0], inv2)
+    cos5 = np.concatenate([np.cos(ang5), np.cos(ang5)], -1)
+    sin5 = np.concatenate([np.sin(ang5), np.sin(ang5)], -1)
+    x0 = base[:, 0]                                  # [b, h, d]
+    x1, x2 = np.split(x0, 2, -1)
+    rot0 = np.concatenate([-x2, x1], -1)
+    np.testing.assert_allclose(np.asarray(pq._value)[:, 0],
+                               x0 * cos5 + rot0 * sin5, atol=1e-5)
+    # time_major round-trips
+    qt = _t(np.swapaxes(np.asarray(q._value), 0, 1))
+    tm = incubate_F.fused_rotary_position_embedding(qt, time_major=True)[0]
+    np.testing.assert_allclose(
+        np.swapaxes(np.asarray(tm._value), 0, 1), full, atol=1e-6)
+
+
+# ----------------------------------------------------------- Conv2D NHWC
+
+
+@pytest.mark.parametrize("stride,padding,groups", [(1, 0, 1), (2, 1, 1),
+                                                   (1, 1, 3)])
+def test_conv2d_nhwc_matches_nchw(stride, padding, groups):
+    paddle.seed(0)
+    cin, cout = 6, 9 if groups == 3 else 5
+    c_nchw = nn.Conv2D(cin, cout, 3, stride=stride, padding=padding,
+                       groups=groups)
+    c_nhwc = nn.Conv2D(cin, cout, 3, stride=stride, padding=padding,
+                       groups=groups, data_format="NHWC")
+    c_nhwc.weight._value = c_nchw.weight._value
+    c_nhwc.bias._value = c_nchw.bias._value
+    x = rng.standard_normal((2, cin, 8, 8)).astype("float32")
+    y_nchw = np.asarray(c_nchw(_t(x))._value)
+    y_nhwc = np.asarray(c_nhwc(_t(np.transpose(x, (0, 2, 3, 1))))._value)
+    assert y_nhwc.shape == tuple(np.transpose(y_nchw, (0, 2, 3, 1)).shape)
+    np.testing.assert_allclose(np.transpose(y_nhwc, (0, 3, 1, 2)), y_nchw,
+                               atol=1e-5)
+
+
+def test_conv2d_functional_nhwc_and_bad_format():
+    import paddle_tpu.nn.functional as F
+
+    x = rng.standard_normal((1, 4, 4, 3)).astype("float32")
+    w = rng.standard_normal((2, 3, 3, 3)).astype("float32")
+    out = F.conv2d(_t(x), _t(w), data_format="NHWC")
+    assert tuple(out.shape) == (1, 2, 2, 2)
+    with pytest.raises(ValueError):
+        F.conv2d(_t(x), _t(w), data_format="NDHW")
+    with pytest.raises(ValueError):
+        nn.Conv2D(3, 4, 3, data_format="CHWN")
+
+
+# ------------------------------------------------------------ TensorArray
+
+
+def test_tensor_array_family():
+    arr = paddle.create_array("float32")
+    assert arr == []
+    x0 = _t(np.zeros((2, 2), "float32"))
+    x1 = _t(np.ones((2, 2), "float32"))
+    arr = paddle.array_write(x0, _t(0), arr)
+    arr = paddle.array_write(x1, 1, arr)         # int index, append
+    arr = paddle.array_write(x1 * 3, _t(0), arr)  # overwrite
+    assert int(paddle.array_length(arr)._value) == 2
+    np.testing.assert_allclose(
+        np.asarray(paddle.array_read(arr, _t(0))._value), 3.0)
+    np.testing.assert_allclose(
+        np.asarray(paddle.array_read(arr, 1)._value), 1.0)
+    # the loop-accumulate idiom: write at i == len, stack afterwards
+    acc = paddle.create_array()
+    for i in range(4):
+        acc = paddle.array_write(_t(np.full((3,), i, "float32")), i, acc)
+    stacked = np.stack([np.asarray(t._value) for t in acc])
+    assert stacked.shape == (4, 3)
+    with pytest.raises(IndexError):
+        paddle.array_write(x0, 7, acc)
+    with pytest.raises(IndexError):
+        paddle.array_read(acc, 9)
+    # submodule re-export parity (reference python/paddle/tensor/__init__)
+    assert paddle.tensor.create_array is paddle.create_array
+    assert paddle.tensor.array_write is paddle.array_write
+    assert paddle.tensor.array_read is paddle.array_read
+    assert paddle.tensor.array_length is paddle.array_length
+    arr2 = paddle.create_array(initialized_list=[x0, x1])
+    assert int(paddle.array_length(arr2)._value) == 2
+    with pytest.raises(TypeError):
+        paddle.create_array(initialized_list=[1, 2])
