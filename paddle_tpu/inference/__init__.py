@@ -168,7 +168,14 @@ def create_serving_engine(model, dtype=None, **kw):
     (paged KV pool + FCFS continuous batching + Pallas paged decode).
     `dtype` casts weights (and thus the KV pool) — the serving twin of
     Config.enable_low_precision. See paddle_tpu/serving/__init__.py for
-    the engine knobs (num_blocks, block_size, max_batch_size, ...)."""
+    the engine knobs (num_blocks, block_size, max_batch_size, ...).
+
+    Robustness knobs pass straight through to the engine (ISSUE 2):
+    per-request deadlines ride SamplingParams.timeout_s; `max_queue_depth`
+    + `shed_policy` bound the admission queue; `admission_watermark` caps
+    pool pressure; `max_step_retries`/`retry_backoff_s` recover transient
+    runner failures; `nan_policy` guards sampling; `audit=True` runs the
+    invariant auditor after every step."""
     import jax.numpy as jnp
 
     from paddle_tpu.serving import ServingEngine
@@ -184,6 +191,23 @@ def create_serving_engine(model, dtype=None, **kw):
                 else v) for k, v in runner.params.items()}
     kw.setdefault("num_blocks", 128)
     return ServingEngine(runner, **kw)
+
+
+def restore_serving_engine(model, state, attn_impl: str = "auto", **kw):
+    """Rebuild a crashed/killed serving engine from `engine.snapshot()`.
+
+    The crash-recovery twin of create_serving_engine: builds a fresh
+    runner for `model` (the weights the snapshot was serving) and replays
+    all serialized request state through ServingEngine.restore — every
+    in-flight request resumes via recompute-on-resume, token-for-token
+    identical to an uninterrupted run."""
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.model_runner import runner_for
+
+    runner = runner_for(model, block_size=state["config"]["block_size"],
+                        max_model_len=state["config"]["max_model_len"],
+                        attn_impl=attn_impl)
+    return ServingEngine.restore(runner, state, **kw)
 
 
 # --------------------- round-5: reference inference __all__ tail --------

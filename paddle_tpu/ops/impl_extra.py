@@ -1088,22 +1088,34 @@ def gather_tree(ids, parents):
 
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCDHW"):
+    """data_format selects the activation layout (NCDHW or NDHWC — the
+    latter is what TPUs natively tile); the weight stays OIDHW in both,
+    matching the reference's filter storage (same contract as conv2d)."""
+    if data_format not in ("NCDHW", "NDHWC"):
+        raise ValueError(f"conv3d: unsupported data_format {data_format!r}")
     s, d = _pair(stride, 3), _pair(dilation, 3)
     p = _pair(padding, 3)
     pad = [(pi, pi) for pi in p]
     dn = lax.conv_dimension_numbers(x.shape, weight.shape,
-                                    ("NCDHW", "OIDHW", "NCDHW"))
+                                    (data_format, "OIDHW", data_format))
     out = lax.conv_general_dilated(x, weight, window_strides=s, padding=pad,
                                    rhs_dilation=d, dimension_numbers=dn,
                                    feature_group_count=groups)
     if bias is not None:
-        out = out + bias.reshape(1, -1, 1, 1, 1)
+        shape = (1, -1, 1, 1, 1) if data_format == "NCDHW" else (1, 1, 1, 1, -1)
+        out = out + bias.reshape(shape)
     return out
 
 
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1,
                      data_format="NCDHW"):
+    if data_format != "NCDHW":
+        raise ValueError(
+            f"conv3d_transpose: data_format={data_format!r} has no "
+            "TPU-native lowering here — pass NCDHW and transpose the "
+            "activations around the call (one cheap XLA relayout; the MXU "
+            "tiles either layout equally)")
     s, d = _pair(stride, 3), _pair(dilation, 3)
     p = _pair(padding, 3)
     op = _pair(output_padding, 3)
@@ -1494,7 +1506,13 @@ def unpool3d(x, indices, kernel_size=None, stride=None, padding=0,
 
 
 def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
-                     output_padding=0, dilation=1, groups=1):
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCL"):
+    if data_format != "NCL":
+        raise ValueError(
+            f"conv1d_transpose: data_format={data_format!r} has no "
+            "TPU-native lowering here — pass NCL and transpose the "
+            "activations around the call (one cheap XLA relayout)")
     from paddle_tpu.ops.impl import conv2d_transpose
 
     s = stride if isinstance(stride, int) else stride[0]

@@ -21,7 +21,18 @@ paged-decode kernel (`ops/pallas/paged_attention.py`):
                    the sequential oracle continuous batching must match
                    token-for-token;
   metrics.py       queue depth, TTFT, tokens/s, pool utilization,
-                   preemption counters for bench.py's serving sweep.
+                   preemption counters for bench.py's serving sweep —
+                   plus the failure-side instruments (timeouts, aborts,
+                   step retries, NaN events, shed requests);
+  resilience.py    the fault story (ISSUE 2): FaultInjector (simulated
+                   device errors / NaN logits / clock stalls for tests
+                   and drills), the invariant auditor (page + slot +
+                   block-table consistency after every step), and the
+                   failure vocabulary (InjectedDeviceError,
+                   QueueFullError, InvariantViolation). The engine layers
+                   per-request deadlines, abort, bounded-queue
+                   backpressure, step retries with backoff, and
+                   crash-safe snapshot()/restore() on top.
 
 Decode attends through the Pallas kernel on TPU and through the
 gather + dense-mask reference path on CPU — the same dual dispatch every
@@ -46,15 +57,20 @@ from paddle_tpu.serving.metrics import (  # noqa: F401
 from paddle_tpu.serving.model_runner import (  # noqa: F401
     GPTRunner, LlamaRunner, PagedModelRunner, runner_for,
 )
+from paddle_tpu.serving.resilience import (  # noqa: F401
+    FaultInjector, InjectedDeviceError, InvariantViolation, QueueFullError,
+    audit_engine,
+)
 from paddle_tpu.serving.scheduler import (  # noqa: F401
     FCFSScheduler, Request, RequestState, SamplingParams,
 )
 
 __all__ = [
     "BlockAllocator", "Counter", "EngineMetrics", "FCFSScheduler",
-    "GPTRunner", "Gauge", "Histogram", "KVCachePool", "LlamaRunner",
-    "PagedModelRunner", "Request", "RequestOutput", "RequestState",
-    "SCRATCH_PAGE", "SamplingParams", "SequenceKV", "ServingEngine",
-    "TokenEvent", "create_engine", "naive_generate", "runner_for",
-    "sample_token",
+    "FaultInjector", "GPTRunner", "Gauge", "Histogram",
+    "InjectedDeviceError", "InvariantViolation", "KVCachePool",
+    "LlamaRunner", "PagedModelRunner", "QueueFullError", "Request",
+    "RequestOutput", "RequestState", "SCRATCH_PAGE", "SamplingParams",
+    "SequenceKV", "ServingEngine", "TokenEvent", "audit_engine",
+    "create_engine", "naive_generate", "runner_for", "sample_token",
 ]

@@ -13,12 +13,32 @@ pages, greedy (or seeded per-request) sampling, step-indexed sample keys
 that survive preemption. `naive_generate` is the scheduling oracle: the
 same runner, one request at a time, no scheduler — continuous batching
 must reproduce its tokens exactly.
+
+Every failure mode has a defined outcome (ISSUE 2 hardening); no step()
+raises for load- or fault-induced conditions:
+
+  finish_reason   trigger
+  "stop"/"length" normal completion
+  "timeout"       SamplingParams.timeout_s exceeded (queue wait counts)
+  "aborted"       engine.abort(request_id)
+  "shed"          bounded queue overflowed under shed_policy="drop_oldest"
+  "error"         prefill failed past max_step_retries, a decode batch
+                  was quarantined, or NaN/Inf logits under nan_policy
+                  "abort" (or with no finite entry at all)
+
+Transient runner failures retry with bounded exponential backoff;
+`snapshot()`/`restore()` serialize all request state for crash-safe
+relaunch (KV rebuilds through the recompute-on-resume path); the
+opt-in invariant auditor (`audit=True` or PADDLE_TPU_SERVING_AUDIT=1)
+proves page/slot/block-table consistency after every step.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence
+import os
+import time
+from dataclasses import asdict, dataclass
+from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -27,8 +47,10 @@ import numpy as np
 from paddle_tpu.serving.kv_cache import KVCachePool, SCRATCH_PAGE
 from paddle_tpu.serving.metrics import EngineMetrics
 from paddle_tpu.serving.model_runner import PagedModelRunner, runner_for
+from paddle_tpu.serving.resilience import QueueFullError, audit_engine
 from paddle_tpu.serving.scheduler import (
-    FCFSScheduler, Request, SamplingParams,
+    FCFSScheduler, Request, RequestState, SamplingParams,
+    ensure_arrival_counter_above,
 )
 
 
@@ -79,12 +101,32 @@ class ServingEngine:
     rid = engine.add_request([1, 2, 3], SamplingParams(max_tokens=8))
     for events in iter(engine.step, []): ...   # streaming
     outputs = engine.run()                     # or drain to completion
+
+    Robustness knobs (all optional; defaults reproduce the happy path):
+      max_queue_depth      bound on the waiting queue; None = unbounded
+      shed_policy          "reject" (add_request raises QueueFullError) or
+                           "drop_oldest" (oldest waiting request is shed)
+      admission_watermark  pool fraction beyond which admission pauses
+      max_step_retries     transient-failure retries per runner step
+      retry_backoff_s      base of the bounded exponential backoff
+      nan_policy           "abort" kills a request on NaN/Inf logits;
+                           "greedy" argmaxes the finite entries instead
+      audit                run resilience.audit_engine after every step
+                           (None = the PADDLE_TPU_SERVING_AUDIT env var)
     """
 
     def __init__(self, runner: PagedModelRunner, *, num_blocks: int,
                  block_size: Optional[int] = None, max_batch_size: int = 8,
                  max_model_len: Optional[int] = None,
-                 metrics: Optional[EngineMetrics] = None):
+                 metrics: Optional[EngineMetrics] = None,
+                 max_queue_depth: Optional[int] = None,
+                 shed_policy: str = "reject",
+                 admission_watermark: float = 1.0,
+                 max_step_retries: int = 2,
+                 retry_backoff_s: float = 0.02,
+                 nan_policy: str = "abort",
+                 sleep_fn: Optional[Callable[[float], None]] = None,
+                 audit: Optional[bool] = None):
         self.runner = runner
         block_size = block_size or runner.block_size
         if block_size != runner.block_size:
@@ -95,14 +137,34 @@ class ServingEngine:
         if self.max_model_len > runner.max_model_len:
             raise ValueError("max_model_len exceeds the runner's rope/pos "
                              f"table length {runner.max_model_len}")
+        if shed_policy not in ("reject", "drop_oldest"):
+            raise ValueError(f"shed_policy={shed_policy!r}; expected "
+                             "'reject' or 'drop_oldest'")
+        if nan_policy not in ("abort", "greedy"):
+            raise ValueError(f"nan_policy={nan_policy!r}; expected "
+                             "'abort' or 'greedy'")
+        if max_queue_depth is not None and max_queue_depth < 1:
+            raise ValueError("max_queue_depth must be >= 1 (None = unbounded)")
         self.pool = KVCachePool(runner.num_layers, num_blocks, block_size,
                                 runner.n_kv_heads, runner.head_dim,
                                 runner.dtype)
         self.max_pages_per_seq = self.pool.blocks_for_tokens(
             self.max_model_len)
         self.scheduler = FCFSScheduler(self.pool, max_batch_size,
-                                       self.max_pages_per_seq)
+                                       self.max_pages_per_seq,
+                                       admission_watermark)
         self.max_batch_size = max_batch_size
+        self.max_queue_depth = max_queue_depth
+        self.shed_policy = shed_policy
+        self.admission_watermark = admission_watermark
+        self.max_step_retries = max_step_retries
+        self.retry_backoff_s = retry_backoff_s
+        self.nan_policy = nan_policy
+        self._sleep = sleep_fn or time.sleep
+        if audit is None:
+            audit = os.environ.get("PADDLE_TPU_SERVING_AUDIT",
+                                   "") not in ("", "0")
+        self.audit = audit
         self.metrics = metrics or EngineMetrics()
         self._requests: Dict[str, Request] = {}
         self._outputs: Dict[str, RequestOutput] = {}
@@ -120,6 +182,18 @@ class ServingEngine:
                 f"prompt({len(req.prompt_tokens)}) + max_tokens"
                 f"({sampling.max_tokens}) exceeds max_model_len="
                 f"{self.max_model_len}")
+        if (self.max_queue_depth is not None
+                and self.scheduler.queue_depth >= self.max_queue_depth):
+            self.metrics.shed_requests.inc()
+            if self.shed_policy == "reject":
+                raise QueueFullError(
+                    f"admission queue full ({self.scheduler.queue_depth} "
+                    f"waiting >= max_queue_depth={self.max_queue_depth}); "
+                    "shed_policy='reject'")
+            # drop-oldest-waiting: the queue head is shed to admit the new
+            # arrival — freshness beats age under overload
+            self._finish_abnormal(self.scheduler.waiting[0], "shed",
+                                  counted=True)
         req.arrival_time = self.metrics.clock()
         self._requests[req.request_id] = req
         self.scheduler.add(req)
@@ -127,32 +201,98 @@ class ServingEngine:
         self.metrics.queue_depth.set(self.scheduler.queue_depth)
         return req.request_id
 
+    def abort(self, request_id: str, reason: str = "aborted") -> bool:
+        """Cancel an in-flight request: its pages/slot are freed and the
+        output surfaces with finish_reason="aborted". Returns False if the
+        request is unknown or already finished."""
+        req = self._requests.get(request_id)
+        if req is None or req.done:
+            return False
+        self._finish_abnormal(req, reason)
+        self.metrics.queue_depth.set(self.scheduler.queue_depth)
+        return True
+
     def has_work(self) -> bool:
         return self.scheduler.has_work()
+
+    # ------------------------------------------------- failure plumbing
+
+    def _finish_abnormal(self, req: Request, reason: str,
+                         counted: bool = False) -> None:
+        """Terminate a request on a non-token path (timeout / abort / shed
+        / error): release whatever it holds, record the RequestOutput with
+        the partial generation, bump the matching failure counter."""
+        now = self.metrics.clock()
+        if req.state is RequestState.RUNNING:
+            self.scheduler.finish(req, reason)
+        elif req.state is RequestState.WAITING:
+            self.scheduler.remove_waiting(req)
+            req.state = RequestState.FINISHED
+            req.finish_reason = reason
+        else:                                    # pragma: no cover
+            return
+        req.finish_time = now
+        if not counted:        # shed is pre-counted at the add_request gate
+            counter = {"timeout": self.metrics.requests_timed_out,
+                       "shed": self.metrics.shed_requests}.get(
+                           reason, self.metrics.requests_aborted)
+            counter.inc()
+        self._outputs[req.request_id] = RequestOutput(
+            request_id=req.request_id,
+            prompt_tokens=list(req.prompt_tokens),
+            output_tokens=list(req.output_tokens),
+            finish_reason=reason,
+            num_preemptions=req.num_preemptions,
+            ttft_s=(req.first_token_time - req.arrival_time
+                    if req.first_token_time is not None else None),
+            e2e_s=now - req.arrival_time)
+
+    def _expire_deadlines(self) -> None:
+        """Time out every request (queued or running) past its deadline —
+        queue wait counts against timeout_s, exactly like a client-side
+        deadline would."""
+        now = self.metrics.clock()
+        for req in (*self.scheduler.running, *self.scheduler.waiting):
+            t = req.sampling.timeout_s
+            if t is not None and now - req.arrival_time >= t:
+                self._finish_abnormal(req, "timeout")
+
+    def _guarded_sample(self, logits_row: np.ndarray,
+                        req: Request) -> Optional[int]:
+        """Sample with the NaN/Inf guard. Returns None when the request
+        must be aborted (nan_policy="abort", or no finite logit exists)."""
+        row = np.asarray(logits_row)
+        finite = np.isfinite(row)
+        if not finite.all():
+            self.metrics.nan_logit_events.inc()
+            if self.nan_policy == "greedy" and finite.any():
+                return int(np.argmax(np.where(finite, row, -np.inf)))
+            return None
+        return sample_token(row, req.sampling, len(req.output_tokens),
+                            req.arrival_index)
 
     # ------------------------------------------------------------- step
 
     def step(self) -> List[TokenEvent]:
-        """One engine iteration: admit + prefill new requests, reserve
-        decode pages (preempting if needed), run one batched decode step.
-        Returns the tokens produced this step (streaming surface)."""
+        """One engine iteration: expire deadlines, admit + prefill new
+        requests, reserve decode pages (preempting if needed), run one
+        batched decode step. Returns the tokens produced this step
+        (streaming surface). Load- and fault-induced failures never
+        escape: they end requests with an explicit finish_reason."""
         if not self.scheduler.has_work():
             return []
         self.metrics.mark_active()
         events: List[TokenEvent] = []
 
+        # 0. deadlines first: an expired request must not win admission
+        self._expire_deadlines()
+
         # 1. admission + prefill (each admitted request computes its full
         #    context and first token; TTFT clock stops here)
         for req in self.scheduler.admit():
-            table = self.pool.pad_table(req.kv.pages, self.max_pages_per_seq)
-            logits, new_pools = self.runner.prefill(
-                req.context_tokens, table, self.pool.pools)
-            self.pool.pools = new_pools
-            req.kv.num_tokens = req.num_context
-            self.metrics.prefill_tokens.inc(req.num_context)
-            tok = sample_token(np.asarray(logits), req.sampling,
-                               len(req.output_tokens), req.arrival_index)
-            events.append(self._append_token(req, tok))
+            ev = self._prefill_with_recovery(req)
+            if ev is not None:
+                events.append(ev)
 
         # 2. decode-page reservation; pool pressure preempts youngest-first
         victims = self.scheduler.reserve_decode()
@@ -160,10 +300,8 @@ class ServingEngine:
             self.metrics.preemptions.inc()
 
         # 3. one batched decode step over every running sequence
-        running = self.scheduler.running_in_order()
-        if running:
-            self.metrics.batch_occupancy.observe(len(running))
-            events.extend(self._decode_once(running))
+        if self.scheduler.running:
+            events.extend(self._decode_with_recovery())
         self.metrics.decode_steps.inc()
 
         # bookkeeping gauges
@@ -172,28 +310,88 @@ class ServingEngine:
         self.metrics.running.set(len(self.scheduler.running))
         self.metrics.pool_used_pages.set(a.num_usable - a.num_free)
         self.metrics.pool_utilization.set(self.pool.utilization())
+        if self.audit:
+            audit_engine(self)
         return events
 
-    def _decode_once(self, running: Sequence[Request]) -> List[TokenEvent]:
-        B = self.max_batch_size
-        P = self.max_pages_per_seq
-        tokens = np.zeros((B,), np.int32)
-        tables = np.full((B, P), SCRATCH_PAGE, np.int32)
-        pos = np.zeros((B,), np.int32)
-        for req in running:
-            s = req.slot
-            tokens[s] = req.output_tokens[-1]
-            tables[s, :len(req.kv.pages)] = req.kv.pages
-            pos[s] = req.num_context - 1   # position of the fed token
-        logits, new_pools = self.runner.decode(tokens, tables, pos,
-                                               self.pool.pools)
+    def _prefill_with_recovery(self, req: Request) -> Optional[TokenEvent]:
+        """(Re-)prefill one admitted request, retrying transient runner
+        failures with bounded exponential backoff; a request whose prefill
+        keeps failing is quarantined (finish_reason="error")."""
+        table = self.pool.pad_table(req.kv.pages, self.max_pages_per_seq)
+        delay = self.retry_backoff_s
+        for attempt in range(self.max_step_retries + 1):
+            try:
+                logits, new_pools = self.runner.prefill(
+                    req.context_tokens, table, self.pool.pools)
+                break
+            except Exception:
+                if attempt >= self.max_step_retries:
+                    self._finish_abnormal(req, "error")
+                    return None
+                self.metrics.step_retries.inc()
+                self._sleep(delay)
+                delay *= 2
         self.pool.pools = new_pools
+        req.kv.num_tokens = req.num_context
+        self.metrics.prefill_tokens.inc(req.num_context)
+        tok = self._guarded_sample(np.asarray(logits), req)
+        if tok is None:
+            self._finish_abnormal(req, "error")
+            return None
+        return self._append_token(req, tok)
+
+    def _decode_with_recovery(self) -> List[TokenEvent]:
+        """One batched decode step with transient-failure recovery: retry
+        with backoff; once retries are exhausted, quarantine the youngest
+        running request (the step is then rebuilt without it). The loop is
+        bounded: each quarantine shrinks the batch, so at worst the batch
+        drains and the step yields no tokens — never an exception.
+
+        A retried decode is exact, not approximate: a failed attempt either
+        never reached the device (injected/raised before compute) or re-
+        writes the same K/V values through the same block tables, so the
+        token stream is unchanged vs a fault-free run."""
+        attempts = 0
+        delay = self.retry_backoff_s
+        while True:
+            running = self.scheduler.running_in_order()
+            if not running:
+                return []
+            B = self.max_batch_size
+            P = self.max_pages_per_seq
+            tokens = np.zeros((B,), np.int32)
+            tables = np.full((B, P), SCRATCH_PAGE, np.int32)
+            pos = np.zeros((B,), np.int32)
+            for req in running:
+                s = req.slot
+                tokens[s] = req.output_tokens[-1]
+                tables[s, :len(req.kv.pages)] = req.kv.pages
+                pos[s] = req.num_context - 1   # position of the fed token
+            try:
+                logits, new_pools = self.runner.decode(tokens, tables, pos,
+                                                       self.pool.pools)
+                break
+            except Exception:
+                if attempts < self.max_step_retries:
+                    attempts += 1
+                    self.metrics.step_retries.inc()
+                    self._sleep(delay)
+                    delay *= 2
+                    continue
+                self._finish_abnormal(self.scheduler.running[-1], "error")
+                attempts = 0
+                delay = self.retry_backoff_s
+        self.pool.pools = new_pools
+        self.metrics.batch_occupancy.observe(len(running))
         logits_np = np.asarray(logits)
         events = []
         for req in running:
             req.kv.num_tokens = req.num_context
-            tok = sample_token(logits_np[req.slot], req.sampling,
-                               len(req.output_tokens), req.arrival_index)
+            tok = self._guarded_sample(logits_np[req.slot], req)
+            if tok is None:
+                self._finish_abnormal(req, "error")
+                continue
             events.append(self._append_token(req, tok))
         return events
 
@@ -236,6 +434,103 @@ class ServingEngine:
 
     def outputs(self) -> Dict[str, RequestOutput]:
         return dict(self._outputs)
+
+    # ------------------------------------------------ snapshot / restore
+
+    def snapshot(self) -> dict:
+        """Crash-safe serialization of ALL request state: prompts,
+        generated tokens, sampling params, arrival order, plus finished
+        outputs. JSON-serializable; device state is deliberately excluded
+        — restore() rebuilds KV via the recompute-on-resume path, which
+        the step-indexed sample keys make token-exact."""
+        now = self.metrics.clock()
+
+        def req_state(req: Request) -> dict:
+            sp = asdict(req.sampling)
+            sp["stop_token_ids"] = list(sp["stop_token_ids"])
+            return {
+                "request_id": req.request_id,
+                "prompt_tokens": list(req.prompt_tokens),
+                "output_tokens": list(req.output_tokens),
+                "sampling": sp,
+                "arrival_index": req.arrival_index,
+                "num_preemptions": req.num_preemptions,
+                "elapsed_s": now - req.arrival_time,
+                "first_token_elapsed_s": (
+                    req.first_token_time - req.arrival_time
+                    if req.first_token_time is not None else None),
+            }
+
+        # resume priority: running requests first (in admission order —
+        # they are the oldest in flight), then the waiting queue left to
+        # right (its head already encodes preempted-first recycle order)
+        reqs = [req_state(r) for r in (*self.scheduler.running,
+                                       *self.scheduler.waiting)]
+        return {
+            "version": 1,
+            "config": {
+                "num_blocks": self.pool.num_blocks,
+                "block_size": self.pool.block_size,
+                "max_batch_size": self.max_batch_size,
+                "max_model_len": self.max_model_len,
+                "max_queue_depth": self.max_queue_depth,
+                "shed_policy": self.shed_policy,
+                "admission_watermark": self.admission_watermark,
+                "max_step_retries": self.max_step_retries,
+                "retry_backoff_s": self.retry_backoff_s,
+                "nan_policy": self.nan_policy,
+            },
+            "requests": reqs,
+            "finished": [asdict(o) for o in self._outputs.values()],
+        }
+
+    @classmethod
+    def restore(cls, runner: PagedModelRunner, state: dict, *,
+                metrics: Optional[EngineMetrics] = None,
+                sleep_fn: Optional[Callable[[float], None]] = None,
+                audit: Optional[bool] = None) -> "ServingEngine":
+        """Rebuild an engine from snapshot() on a fresh runner. Every
+        in-flight request re-enters the queue with its prompt AND partial
+        generation; admission re-prefills the full context (the normal
+        recompute-on-resume path), so the continued token stream is
+        identical to an uninterrupted run."""
+        if state.get("version") != 1:
+            raise ValueError(f"unknown snapshot version {state.get('version')}")
+        cfg = state["config"]
+        eng = cls(runner, num_blocks=cfg["num_blocks"],
+                  block_size=cfg["block_size"],
+                  max_batch_size=cfg["max_batch_size"],
+                  max_model_len=cfg["max_model_len"],
+                  max_queue_depth=cfg["max_queue_depth"],
+                  shed_policy=cfg["shed_policy"],
+                  admission_watermark=cfg["admission_watermark"],
+                  max_step_retries=cfg["max_step_retries"],
+                  retry_backoff_s=cfg["retry_backoff_s"],
+                  nan_policy=cfg["nan_policy"],
+                  metrics=metrics, sleep_fn=sleep_fn, audit=audit)
+        ensure_arrival_counter_above(max(
+            (r["arrival_index"] for r in state["requests"]), default=-1))
+        now = eng.metrics.clock()
+        for r in state["requests"]:
+            sp = dict(r["sampling"])
+            sp["stop_token_ids"] = tuple(sp.get("stop_token_ids", ()))
+            req = Request(prompt_tokens=list(r["prompt_tokens"]),
+                          sampling=SamplingParams(**sp),
+                          request_id=r["request_id"],
+                          arrival_index=int(r["arrival_index"]))
+            req.output_tokens = list(r["output_tokens"])
+            req.num_preemptions = int(r.get("num_preemptions", 0))
+            req.arrival_time = now - float(r.get("elapsed_s", 0.0))
+            fte = r.get("first_token_elapsed_s")
+            if fte is not None:
+                req.first_token_time = req.arrival_time + float(fte)
+            eng._requests[req.request_id] = req
+            eng.scheduler.add(req)
+            eng.metrics.requests_added.inc()
+        for o in state.get("finished", []):
+            eng._outputs[o["request_id"]] = RequestOutput(**o)
+        eng.metrics.queue_depth.set(eng.scheduler.queue_depth)
+        return eng
 
 
 def naive_generate(runner: PagedModelRunner, prompt_tokens: Sequence[int],

@@ -48,6 +48,11 @@ class BlockAllocator:
         """Total allocatable pages (excludes the scratch page)."""
         return self.num_blocks - 1
 
+    @property
+    def allocated_pages(self) -> frozenset:
+        """Read-only view of the live pages (resilience.audit_engine)."""
+        return frozenset(self._allocated)
+
     def can_alloc(self, n: int) -> bool:
         return n <= len(self._free)
 

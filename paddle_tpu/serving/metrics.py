@@ -91,6 +91,14 @@ class EngineMetrics:
         self.requests_added = Counter("requests_added")
         self.requests_finished = Counter("requests_finished")
         self.preemptions = Counter("preemptions")
+        # failure-side instruments (ISSUE 2): every abnormal outcome is
+        # counted, so an overloaded or faulty deployment is visible in
+        # snapshot() instead of in a stack trace
+        self.requests_timed_out = Counter("requests_timed_out")
+        self.requests_aborted = Counter("requests_aborted")
+        self.step_retries = Counter("step_retries")
+        self.nan_logit_events = Counter("nan_logit_events")
+        self.shed_requests = Counter("shed_requests")
         self.tokens_generated = Counter("tokens_generated")
         self.prefill_tokens = Counter("prefill_tokens")
         self.decode_steps = Counter("decode_steps")
@@ -126,6 +134,11 @@ class EngineMetrics:
             "requests_added": self.requests_added.value,
             "requests_finished": self.requests_finished.value,
             "preemptions": self.preemptions.value,
+            "requests_timed_out": self.requests_timed_out.value,
+            "requests_aborted": self.requests_aborted.value,
+            "step_retries": self.step_retries.value,
+            "nan_logit_events": self.nan_logit_events.value,
+            "shed_requests": self.shed_requests.value,
             "tokens_generated": self.tokens_generated.value,
             "prefill_tokens": self.prefill_tokens.value,
             "decode_steps": self.decode_steps.value,

@@ -1,0 +1,210 @@
+"""Fault tolerance for the serving engine: injected faults + invariants.
+
+Reference: production TPU serving stacks treat failure as a first-class
+input — admission control, request deadlines, and graceful degradation
+rather than crash-or-hang (the Ragged-Paged-Attention serving line and
+the reference's fastdeploy health/recovery loop). This module holds the
+pieces the engine's hardening leans on:
+
+  FaultInjector      a drop-in PagedModelRunner wrapper that raises
+                     simulated device errors, corrupts logits with
+                     NaN/Inf, or stalls the clock on chosen calls —
+                     the test harness for every recovery path;
+  audit_engine       the invariant auditor: page accounting, slot
+                     assignment, and block tables must be mutually
+                     consistent after every step (zero leaks);
+  InjectedDeviceError / QueueFullError / InvariantViolation
+                     the failure vocabulary the engine surfaces.
+
+Everything here is deterministic: fault schedules are keyed by call
+index (never wall time or RNG), so a failing trace replays exactly.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterable, Optional
+
+import numpy as np
+
+from paddle_tpu.serving.kv_cache import SCRATCH_PAGE
+
+
+class InjectedDeviceError(RuntimeError):
+    """A simulated transient device failure (FaultInjector's default)."""
+
+
+class QueueFullError(RuntimeError):
+    """add_request rejected: bounded queue full under shed_policy='reject'."""
+
+
+class InvariantViolation(AssertionError):
+    """Engine state is internally inconsistent (leak / double-own / slot
+    corruption). Raised by audit_engine; always a bug, never load."""
+
+
+class FaultInjector:
+    """Wrap a PagedModelRunner and inject faults on selected calls.
+
+    Drop-in: exposes the runner's attributes (block_size, num_layers,
+    dtype, ...) by delegation, so ``ServingEngine(FaultInjector(runner,
+    ...), ...)`` behaves exactly like the bare runner except on the
+    scheduled calls. Call indices are 1-based and counted PER OP, so
+    ``decode_error_every=5`` fails decode calls 5, 10, 15, ... — the
+    engine's retry makes the very next attempt (a new call) succeed.
+
+    Fault classes (each with ``*_every`` periodic and ``*_calls`` exact
+    schedules, and a target op "prefill" | "decode" | "both"):
+
+      error  raise InjectedDeviceError BEFORE touching the real runner
+             (the KV pool is untouched, so a retry is exact);
+      nan    run the real step, then overwrite the leading
+             ``nan_fraction`` of the vocab with NaN (the KV write has
+             happened; decode re-writes identical values, so both retry
+             and greedy-fallback stay token-deterministic);
+      stall  call ``on_stall`` (default: time.sleep(stall_s)) before the
+             step — with the engine's injectable clock this simulates a
+             stuck device step that pushes requests past their deadline.
+    """
+
+    def __init__(self, runner, *,
+                 error_every: int = 0, error_calls: Iterable[int] = (),
+                 error_target: str = "decode",
+                 nan_every: int = 0, nan_calls: Iterable[int] = (),
+                 nan_target: str = "decode", nan_fraction: float = 1.0,
+                 stall_every: int = 0, stall_calls: Iterable[int] = (),
+                 stall_target: str = "decode", stall_s: float = 0.0,
+                 on_stall: Optional[Callable[[], None]] = None):
+        self._runner = runner
+        for t in (error_target, nan_target, stall_target):
+            if t not in ("prefill", "decode", "both"):
+                raise ValueError(f"fault target {t!r}")
+        if not 0.0 < nan_fraction <= 1.0:
+            raise ValueError("nan_fraction must be in (0, 1]")
+        self._error = (error_every, frozenset(error_calls), error_target)
+        self._nan = (nan_every, frozenset(nan_calls), nan_target)
+        self._stall = (stall_every, frozenset(stall_calls), stall_target)
+        self.nan_fraction = nan_fraction
+        self._on_stall = on_stall or (lambda: time.sleep(stall_s))
+        self.calls = {"prefill": 0, "decode": 0}
+        self.injected = {"error": 0, "nan": 0, "stall": 0}
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_runner"), name)
+
+    @staticmethod
+    def _hits(schedule, op: str, n: int) -> bool:
+        every, calls, target = schedule
+        if target not in (op, "both"):
+            return False
+        return (every > 0 and n % every == 0) or n in calls
+
+    def _corrupt(self, logits):
+        arr = np.array(logits, np.float32, copy=True)
+        k = max(1, int(round(arr.shape[-1] * self.nan_fraction)))
+        arr[..., :k] = np.nan
+        return arr
+
+    def _pre(self, op: str) -> int:
+        self.calls[op] += 1
+        n = self.calls[op]
+        if self._hits(self._stall, op, n):
+            self.injected["stall"] += 1
+            self._on_stall()
+        if self._hits(self._error, op, n):
+            self.injected["error"] += 1
+            raise InjectedDeviceError(f"injected device error: {op} call {n}")
+        return n
+
+    def prefill(self, tokens, table, pools):
+        n = self._pre("prefill")
+        logits, pools = self._runner.prefill(tokens, table, pools)
+        if self._hits(self._nan, "prefill", n):
+            self.injected["nan"] += 1
+            logits = self._corrupt(logits)
+        return logits, pools
+
+    def decode(self, tokens, tables, pos, pools):
+        n = self._pre("decode")
+        logits, pools = self._runner.decode(tokens, tables, pos, pools)
+        if self._hits(self._nan, "decode", n):
+            self.injected["nan"] += 1
+            logits = self._corrupt(logits)
+        return logits, pools
+
+
+def audit_engine(engine) -> None:
+    """Assert page accounting, slot assignment, and block tables are
+    mutually consistent — the opt-in post-step invariant check
+    (ServingEngine(..., audit=True) or PADDLE_TPU_SERVING_AUDIT=1).
+
+    Raises InvariantViolation listing every broken invariant; returns
+    None on a clean state. O(pool + batch) host work, no device calls.
+    """
+    alloc = engine.pool.allocator
+    sched = engine.scheduler
+    problems = []
+
+    # -- allocator self-consistency -------------------------------------
+    free_list = list(alloc._free)
+    fset, aset = set(free_list), set(alloc._allocated)
+    if len(free_list) != len(fset):
+        problems.append("duplicate pages in the free list")
+    if fset & aset:
+        problems.append(f"pages both free and allocated: {sorted(fset & aset)}")
+    if SCRATCH_PAGE in (fset | aset):
+        problems.append("scratch page entered the allocator")
+    expected = set(range(1, alloc.num_blocks))
+    if (fset | aset) != expected:
+        problems.append(
+            f"page accounting broken: lost={sorted(expected - fset - aset)} "
+            f"foreign={sorted((fset | aset) - expected)}")
+
+    # -- ownership: allocated pages == union of running sequences' pages -
+    owned = []
+    for req in sched.running:
+        if req.kv is None:
+            problems.append(f"{req.request_id} RUNNING without kv state")
+            continue
+        if SCRATCH_PAGE in req.kv.pages:
+            problems.append(f"{req.request_id} block table maps the scratch "
+                            "page")
+        need = engine.pool.blocks_for_tokens(max(1, req.kv.num_tokens))
+        if len(req.kv.pages) < need:
+            problems.append(
+                f"{req.request_id} under-provisioned: {len(req.kv.pages)} "
+                f"pages < {need} needed for {req.kv.num_tokens} tokens")
+        if len(req.kv.pages) > engine.max_pages_per_seq:
+            problems.append(f"{req.request_id} holds {len(req.kv.pages)} "
+                            f"pages > max_pages_per_seq")
+        owned.extend(req.kv.pages)
+    oset = set(owned)
+    if len(owned) != len(oset):
+        dupes = sorted({p for p in owned if owned.count(p) > 1})
+        problems.append(f"pages owned by two sequences: {dupes}")
+    if oset != aset:
+        problems.append(
+            f"page leak: allocated-but-unowned={sorted(aset - oset)} "
+            f"owned-but-not-allocated={sorted(oset - aset)}")
+
+    # -- slot accounting -------------------------------------------------
+    slots = [r.slot for r in sched.running]
+    if any(s is None for s in slots):
+        problems.append("RUNNING request without a slot")
+    elif len(set(slots)) != len(slots):
+        problems.append(f"slot assigned twice: {sorted(slots)}")
+    else:
+        sset, free_slots = set(slots), list(sched._free_slots)
+        if (len(free_slots) != len(set(free_slots))
+                or (sset | set(free_slots)) != set(range(sched.max_batch_size))
+                or sset & set(free_slots)):
+            problems.append(f"slot accounting broken: used={sorted(sset)} "
+                            f"free={sorted(free_slots)}")
+
+    # -- waiting requests hold no device resources -----------------------
+    for req in sched.waiting:
+        if req.kv is not None or req.slot is not None:
+            problems.append(f"{req.request_id} WAITING but holds kv/slot")
+
+    if problems:
+        raise InvariantViolation("; ".join(problems))

@@ -42,10 +42,13 @@ class SamplingParams:
     top_p: Optional[float] = None
     seed: Optional[int] = None        # None -> derived from request id
     stop_token_ids: Tuple[int, ...] = ()
+    timeout_s: Optional[float] = None   # deadline from arrival; None = never
 
     def __post_init__(self):
         if self.max_tokens < 1:
             raise ValueError("max_tokens must be >= 1")
+        if self.timeout_s is not None and self.timeout_s <= 0:
+            raise ValueError("timeout_s must be positive (None = no deadline)")
 
 
 class RequestState(Enum):
@@ -55,6 +58,18 @@ class RequestState(Enum):
 
 
 _req_counter = itertools.count()
+
+
+def ensure_arrival_counter_above(n: int) -> None:
+    """Advance the global arrival counter past ``n``.
+
+    Restore-time hook (ServingEngine.restore): restored requests keep
+    their original arrival_index — it seeds seedless sampling and names
+    auto request ids — so requests added AFTER a restore must start
+    beyond every restored index or streams/ids would collide."""
+    global _req_counter
+    current = next(_req_counter)
+    _req_counter = itertools.count(max(current + 1, n + 1))
 
 
 @dataclass(eq=False)          # identity semantics: the scheduler tracks
@@ -100,15 +115,23 @@ class FCFSScheduler:
     """Admission queue + running set over one KVCachePool."""
 
     def __init__(self, pool: KVCachePool, max_batch_size: int,
-                 max_pages_per_seq: int):
+                 max_pages_per_seq: int, admission_watermark: float = 1.0):
         if max_pages_per_seq > pool.allocator.num_usable:
             raise ValueError(
                 f"max_pages_per_seq={max_pages_per_seq} exceeds the pool's "
                 f"{pool.allocator.num_usable} usable pages — one sequence "
                 "could never fit; enlarge num_blocks")
+        if not 0.0 < admission_watermark <= 1.0:
+            raise ValueError("admission_watermark must be in (0, 1]")
         self.pool = pool
         self.max_batch_size = max_batch_size
         self.max_pages_per_seq = max_pages_per_seq
+        self.admission_watermark = admission_watermark
+        # pool high watermark: admission stops once allocation would cross
+        # this many pages, leaving headroom for running sequences to GROW —
+        # overload then degrades throughput instead of thrashing preemptions
+        self._watermark_pages = int(admission_watermark
+                                    * pool.allocator.num_usable)
         self.waiting: Deque[Request] = deque()
         self.running: List[Request] = []     # kept in admission order
         self._admission_counter = itertools.count()
@@ -144,6 +167,13 @@ class FCFSScheduler:
                     f"request {req.request_id} needs {need} pages > "
                     f"max_pages_per_seq={self.max_pages_per_seq}")
             if not self.pool.allocator.can_alloc(need):
+                break
+            used = self.pool.allocator.num_usable - self.pool.allocator.num_free
+            if used + need > self._watermark_pages and (self.running
+                                                        or admitted):
+                # over the high watermark: stop admitting — unless nothing
+                # is running at all (progress guarantee: a request larger
+                # than the watermark must still be servable alone)
                 break
             self.waiting.popleft()
             req.kv = SequenceKV(self.pool)
@@ -195,6 +225,11 @@ class FCFSScheduler:
         req.num_preemptions += 1
 
     # ---------------------------------------------------------- finish
+
+    def remove_waiting(self, req: Request) -> None:
+        """Drop a queued (never-admitted or preempted) request — the
+        deadline/abort/shed path. Holds no pages or slot by invariant."""
+        self.waiting.remove(req)      # identity match (Request is eq=False)
 
     def finish(self, req: Request, reason: str) -> None:
         req.kv.release()

@@ -5,6 +5,74 @@ it twice under two module names)."""
 import os
 
 
+class StubPagedRunner:
+    """A numpy paged-KV 'model' with the PagedModelRunner step interface.
+
+    The KV pool is the single source of history: prefill/decode write the
+    raw token ids through the block table (layer 0, head 0, dim 0) and the
+    next-token logits are a deterministic hash of the FULL gathered
+    history — so any scheduler/allocator/block-table bug (wrong page,
+    stale table, cross-sequence aliasing) changes the generated tokens and
+    breaks oracle equivalence. No jit, no model math: fast enough for
+    hundreds of fuzz trials.
+    """
+
+    num_layers = 1
+    n_heads = 1
+    n_kv_heads = 1
+    head_dim = 1
+
+    def __init__(self, vocab_size=31, block_size=4, max_model_len=64):
+        import jax.numpy as jnp
+
+        self.vocab_size = vocab_size
+        self.block_size = block_size
+        self.max_model_len = max_model_len
+        self.dtype = jnp.float32
+
+    def _logits(self, history):
+        import numpy as np
+
+        h = 7
+        for i, t in enumerate(history):
+            h = (h * 131 + (i + 1) * (int(t) + 1)) % self.vocab_size
+        row = np.zeros((self.vocab_size,), np.float32)
+        row[h] = 1.0
+        return row
+
+    def prefill(self, tokens, table, pools):
+        import jax.numpy as jnp
+        import numpy as np
+
+        (k, v), = pools
+        k = np.array(k)
+        for i, t in enumerate(tokens):
+            page = int(table[i // self.block_size])
+            k[page, i % self.block_size, 0, 0] = float(t)
+        return (jnp.asarray(self._logits(tokens)),
+                [(jnp.asarray(k), v)])
+
+    def decode(self, tokens, tables, pos, pools):
+        import jax.numpy as jnp
+        import numpy as np
+
+        (k, v), = pools
+        k = np.array(k)
+        tokens = np.asarray(tokens)
+        tables = np.asarray(tables)
+        pos = np.asarray(pos)
+        B = tokens.shape[0]
+        out = np.zeros((B, self.vocab_size), np.float32)
+        for b in range(B):
+            p = int(pos[b])
+            page = int(tables[b, p // self.block_size])
+            k[page, p % self.block_size, 0, 0] = float(tokens[b])
+            hist = [k[int(tables[b, i // self.block_size]),
+                      i % self.block_size, 0, 0] for i in range(p + 1)]
+            out[b] = self._logits(hist)
+        return jnp.asarray(out), [(jnp.asarray(k), v)]
+
+
 def child_env(repo_on_pythonpath=True):
     """Env for spawning CPU-only child processes from tests.
 

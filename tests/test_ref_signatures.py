@@ -174,6 +174,40 @@ def test_conv2d_functional_nhwc_and_bad_format():
         nn.Conv2D(3, 4, 3, data_format="CHWN")
 
 
+# ------------------------------------------------ Conv3D / transpose layout
+
+
+def test_conv3d_ndhwc_matches_ncdhw():
+    """ISSUE-2 satellite: the data_format=None swallow in layers_extra's
+    _ConvNd is gone — Conv3D honors NDHWC (XLA dimension_numbers), same
+    contract Conv2D already keeps."""
+    paddle.seed(0)
+    c_cf = nn.Conv3D(3, 5, 3, stride=2, padding=1)
+    c_cl = nn.Conv3D(3, 5, 3, stride=2, padding=1, data_format="NDHWC")
+    c_cl.weight._value = c_cf.weight._value
+    c_cl.bias._value = c_cf.bias._value
+    x = rng.standard_normal((2, 3, 6, 6, 6)).astype("float32")
+    y_cf = np.asarray(c_cf(_t(x))._value)
+    y_cl = np.asarray(c_cl(_t(np.transpose(x, (0, 2, 3, 4, 1))))._value)
+    np.testing.assert_allclose(np.transpose(y_cl, (0, 4, 1, 2, 3)), y_cf,
+                               atol=1e-5)
+
+
+def test_conv_layers_reject_unknown_or_unlowered_formats():
+    # honored-or-loud: bogus names rejected everywhere; channel-last on
+    # the transposed convs fails with the TPU-native alternative named
+    with pytest.raises(ValueError):
+        nn.Conv3D(3, 4, 3, data_format="DHWNC")
+    with pytest.raises(ValueError, match="transpose"):
+        nn.Conv3DTranspose(3, 4, 3, data_format="NDHWC")
+    with pytest.raises(ValueError, match="transpose"):
+        nn.Conv1DTranspose(3, 4, 3, data_format="NLC")
+    # ...and the default stays the working channel-first path
+    x = rng.standard_normal((1, 3, 8)).astype("float32")
+    out = nn.Conv1DTranspose(3, 4, 3)(_t(x))
+    assert tuple(out.shape) == (1, 4, 10)
+
+
 # ------------------------------------------------------------ TensorArray
 
 

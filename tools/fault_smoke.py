@@ -1,0 +1,197 @@
+"""Fault-injection smoke driver: run a mixed workload under each fault
+class and print the recovery metrics (ISSUE-2 tooling satellite).
+
+Usage (CPU-safe, no TPU needed):
+
+    JAX_PLATFORMS=cpu python tools/fault_smoke.py
+    JAX_PLATFORMS=cpu python tools/fault_smoke.py --faults nan,overload \
+        --requests 12 --audit
+
+Fault classes:
+
+    none          baseline (also verifies the oracle token equivalence)
+    device_error  InjectedDeviceError on 1-in-N decode calls; the engine
+                  retries with bounded backoff — tokens must still equal
+                  the fault-free oracle
+    prefill_error every prefill fails; every request must be quarantined
+                  with finish_reason="error" and zero leaks
+    nan           NaN logits on selected decode calls under both
+                  policies (abort / greedy-fallback)
+    stall         a stalled decode step pushes requests past their
+                  timeout_s deadline
+    overload      2x max_queue_depth arrivals under shed_policy
+                  drop_oldest — overload degrades, never thrashes
+
+Exit code 0 iff, for every class: no exception escaped engine.step(),
+every request ended with an explicit finish_reason, and the pool/slot
+audit came back clean.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+FAULTS = ("none", "device_error", "prefill_error", "nan", "stall", "overload")
+
+
+def build_engine(runner, args, **kw):
+    from paddle_tpu.serving import ServingEngine
+
+    kw.setdefault("num_blocks", args.num_blocks)
+    kw.setdefault("max_batch_size", args.max_batch)
+    kw.setdefault("max_model_len", args.max_model_len)
+    kw.setdefault("max_step_retries", 2)
+    kw.setdefault("retry_backoff_s", 0.001)
+    kw.setdefault("audit", True)
+    return ServingEngine(runner, **kw)
+
+
+def run_class(fault: str, runner, args) -> dict:
+    import numpy as np
+
+    from paddle_tpu.serving import FaultInjector, SamplingParams
+
+    timeout_s = None
+    engine_kw = {}
+    if fault == "device_error":
+        target = FaultInjector(runner, error_every=args.error_every,
+                               error_target="decode")
+    elif fault == "prefill_error":
+        target = FaultInjector(runner, error_every=1, error_target="prefill")
+    elif fault == "nan":
+        target = FaultInjector(runner, nan_every=7, nan_target="decode",
+                               nan_fraction=0.5)
+        engine_kw["nan_policy"] = "greedy"
+    elif fault == "stall":
+        # the runner is pre-warmed (the classes share its jit cache), so
+        # a healthy decode step is milliseconds; a 1.5s stall blows the
+        # 1s deadline for every then-running request
+        target = FaultInjector(runner, stall_every=4, stall_target="decode",
+                               stall_s=1.5)
+        timeout_s = 1.0
+    else:
+        target = runner
+    if fault == "overload":
+        engine_kw.update(max_queue_depth=max(2, args.requests // 4),
+                         shed_policy="drop_oldest")
+    eng = build_engine(target, args, **engine_kw)
+
+    rng = np.random.default_rng(0)
+    vocab = runner.vocab_size
+    n = args.requests * (2 if fault == "overload" else 1)
+    work = []
+    for i in range(n):
+        prompt = list(rng.integers(1, vocab, int(rng.integers(4, 20))))
+        sp = SamplingParams(max_tokens=int(rng.integers(3, args.max_tokens)),
+                            timeout_s=timeout_s)
+        work.append((eng.add_request(prompt, sp), prompt, sp))
+
+    crashed = None
+    try:
+        eng.run()
+    except Exception as e:          # must never happen — that's the point
+        crashed = f"{type(e).__name__}: {e}"
+
+    outs = eng.outputs()
+    reasons = {}
+    for o in outs.values():
+        reasons[o.finish_reason] = reasons.get(o.finish_reason, 0) + 1
+    m = eng.metrics.snapshot()
+    leaks_ok = eng.pool.allocator.check_no_leaks()
+    slots_ok = sorted(eng.scheduler._free_slots) == list(range(args.max_batch))
+
+    oracle_ok = True
+    if fault in ("none", "device_error"):
+        # retries are exact: tokens must equal the fault-free oracle
+        from paddle_tpu.serving import naive_generate
+
+        for rid, prompt, sp in work:
+            ref = naive_generate(runner, prompt, sp,
+                                 max_model_len=args.max_model_len)
+            if outs[rid].output_tokens != ref:
+                oracle_ok = False
+                break
+
+    ok = (crashed is None and leaks_ok and slots_ok and oracle_ok
+          and len(outs) == n
+          and all(o.finish_reason for o in outs.values()))
+    return {
+        "fault": fault, "ok": ok, "requests": n,
+        "finish_reasons": reasons,
+        "no_unhandled_exception": crashed is None,
+        "crash": crashed,
+        "pages_leaked": not leaks_ok, "slots_leaked": not slots_ok,
+        "oracle_token_equal": oracle_ok,
+        "step_retries": m["step_retries"],
+        "requests_timed_out": m["requests_timed_out"],
+        "requests_aborted": m["requests_aborted"],
+        "nan_logit_events": m["nan_logit_events"],
+        "shed_requests": m["shed_requests"],
+        "preemptions": m["preemptions"],
+        "injected": dict(getattr(target, "injected", {})) or None,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--faults", default=",".join(FAULTS),
+                    help=f"comma list from {FAULTS}")
+    ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument("--num-blocks", type=int, default=12)
+    ap.add_argument("--block-size", type=int, default=8)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--max-model-len", type=int, default=64)
+    ap.add_argument("--max-tokens", type=int, default=9)
+    ap.add_argument("--error-every", type=int, default=5)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=32)
+    args = ap.parse_args()
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import Llama, LlamaConfig
+    from paddle_tpu.serving import LlamaRunner
+
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=97, hidden_size=args.hidden,
+                      num_layers=args.layers,
+                      num_heads=max(2, args.hidden // 16), num_kv_heads=None,
+                      max_seq_len=args.max_model_len, dropout=0.0)
+    model = Llama(cfg)
+    model.eval()
+    # one shared runner: the fault classes reuse its jit cache, so only
+    # the first class pays compile time (engines/pools stay per-class)
+    runner = LlamaRunner(model, block_size=args.block_size,
+                         max_model_len=args.max_model_len)
+    # warm the prefill buckets + decode step so deadline-sensitive classes
+    # (stall) measure steps, not compiles
+    import numpy as np
+
+    from paddle_tpu.serving import SamplingParams
+
+    warm = build_engine(runner, args)
+    wrng = np.random.default_rng(0)
+    for _ in range(4):
+        warm.add_request(list(wrng.integers(1, 97, int(wrng.integers(4, 20)))),
+                         SamplingParams(max_tokens=2))
+    warm.run()
+
+    all_ok = True
+    for fault in args.faults.split(","):
+        fault = fault.strip()
+        if fault not in FAULTS:
+            raise SystemExit(f"unknown fault class {fault!r}; "
+                             f"choose from {FAULTS}")
+        rec = run_class(fault, runner, args)
+        all_ok &= rec["ok"]
+        print(json.dumps(rec))
+    print(f"\nfault smoke: {'ALL RECOVERED' if all_ok else 'FAILURES'}")
+    return 0 if all_ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
