@@ -357,7 +357,7 @@ def child_decode(layers: int, hidden: int, batch: int, prompt: int,
 
 
 def child_serving(layers: int, hidden: int, max_batch: int, requests: int,
-                  prompt: int, gen: int, vocab: int):
+                  prompt: int, gen: int, vocab: int, shared_prefix: int = 0):
     """Continuous-batching serving rung: offered-load sweep through
     paddle_tpu.serving (engine + FCFS scheduler + paged pool). Each sweep
     point feeds `requests` prompts at a different arrival cadence
@@ -365,7 +365,13 @@ def child_serving(layers: int, hidden: int, max_batch: int, requests: int,
     reports tokens/s and TTFT p50/p99 from serving.metrics. Runs under
     JAX_PLATFORMS=cpu too (gather attention path) — the ISSUE-1 criterion
     that the first healthy tunnel minute yields a committed serving
-    number."""
+    number.
+
+    `shared_prefix` > 0 switches on the ISSUE-3 workload mode: every
+    request shares a common header of that many tokens, the engine runs
+    with the prefix cache + chunked prefill enabled, and each sweep point
+    additionally reports the prefix-hit rate and prefill-token savings —
+    the before/after number the TPU rung commits."""
     import jax
     import numpy as np
 
@@ -386,12 +392,24 @@ def child_serving(layers: int, hidden: int, max_batch: int, requests: int,
     runner = GPTRunner(model, block_size=block_size, max_model_len=max_len)
     pages_per_seq = -(-max_len // block_size)
     rng = np.random.default_rng(0)
-    prompts = [list(rng.integers(0, vocab, prompt)) for _ in range(requests)]
+    if shared_prefix:
+        shared_prefix = min(shared_prefix, prompt - 1)
+        header = list(rng.integers(0, vocab, shared_prefix))
+        prompts = [header + list(rng.integers(0, vocab,
+                                              prompt - shared_prefix))
+                   for _ in range(requests)]
+        engine_kw = {"enable_prefix_cache": True,
+                     "max_prefill_tokens_per_step": 4 * block_size}
+    else:
+        prompts = [list(rng.integers(0, vocab, prompt))
+                   for _ in range(requests)]
+        engine_kw = {}
 
     def sweep(arrival_every_steps: int) -> dict:
         eng = ServingEngine(runner,
                             num_blocks=max_batch * pages_per_seq + 1,
-                            max_batch_size=max_batch, max_model_len=max_len)
+                            max_batch_size=max_batch, max_model_len=max_len,
+                            **engine_kw)
         pending = list(enumerate(prompts))
         t0 = time.time()
         steps = 0
@@ -407,21 +425,30 @@ def child_serving(layers: int, hidden: int, max_batch: int, requests: int,
             steps += 1
         wall = time.time() - t0
         snap = eng.metrics.snapshot()
-        return {"arrival_every_steps": arrival_every_steps,
-                "wall_s": round(wall, 3),
-                "tokens_per_sec": snap["tokens_generated"] / wall,
-                "ttft_s_p50": snap["ttft_s_p50"],
-                "ttft_s_p99": snap["ttft_s_p99"],
-                "batch_occupancy_mean": snap["batch_occupancy_mean"],
-                "preemptions": snap["preemptions"],
-                "decode_steps": snap["decode_steps"]}
+        context = snap["prefill_tokens"] + snap["prefix_hit_tokens"]
+        point = {"arrival_every_steps": arrival_every_steps,
+                 "wall_s": round(wall, 3),
+                 "tokens_per_sec": snap["tokens_generated"] / wall,
+                 "ttft_s_p50": snap["ttft_s_p50"],
+                 "ttft_s_p99": snap["ttft_s_p99"],
+                 "batch_occupancy_mean": snap["batch_occupancy_mean"],
+                 "preemptions": snap["preemptions"],
+                 "decode_steps": snap["decode_steps"],
+                 "prefill_tokens_computed": snap["prefill_tokens"],
+                 "prefix_hit_tokens": snap["prefix_hit_tokens"],
+                 "prefix_hit_rate": (snap["prefix_hit_tokens"] / context
+                                     if context else 0.0),
+                 "prefill_chunks": snap["prefill_chunks"],
+                 "cow_copies": snap["cow_copies"]}
+        return point
 
     # warmup sweep point compiles prefill buckets + the decode step
     sweep(0)
     points = [sweep(k) for k in (0, 1, 4)]   # closed-batch -> light load
     _write_child({"backend": backend, "layers": layers, "hidden": hidden,
                   "max_batch": max_batch, "requests": requests,
-                  "prompt": prompt, "gen": gen, "sweep": points})
+                  "prompt": prompt, "gen": gen,
+                  "shared_prefix": shared_prefix, "sweep": points})
 
 
 def _write_child(obj: dict) -> None:
@@ -601,6 +628,35 @@ def main():
                     f"{pt['tokens_per_sec']:.0f} tok/s, "
                     f"ttft p50={pt['ttft_s_p50']*1000:.0f}ms "
                     f"p99={pt['ttft_s_p99']*1000:.0f}ms")
+
+    # shared-prefix serving rung (ISSUE 3): same sweep with a 96-token
+    # common header, prefix cache + chunked prefill on — the committed
+    # before/after number is the prefill-token savings at equal tokens/s
+    if on_tpu and remaining() > 120:
+        r = run_child("serving:12:768:8:64:128:64:32768:96",
+                      min(900, remaining()))
+        if r is not None:
+            for pt in r["sweep"]:
+                line = {"metric": "serving_prefix_tokens_per_sec_arrival"
+                                  f"{pt['arrival_every_steps']}",
+                        "value": round(pt["tokens_per_sec"], 1),
+                        "unit": "tokens/s", "vs_baseline": 0.0,
+                        "ttft_s_p50": round(pt["ttft_s_p50"], 4),
+                        "ttft_s_p99": round(pt["ttft_s_p99"], 4),
+                        "prefix_hit_rate": round(pt["prefix_hit_rate"], 4),
+                        "prefill_tokens_computed":
+                            pt["prefill_tokens_computed"],
+                        "prefix_hit_tokens": pt["prefix_hit_tokens"],
+                        "prefill_chunks": pt["prefill_chunks"],
+                        "cow_copies": pt["cow_copies"],
+                        "backend": r["backend"]}
+                emit(line)
+                _cache_result(line)
+                log(f"prefix sweep arrival={pt['arrival_every_steps']}: "
+                    f"{pt['tokens_per_sec']:.0f} tok/s, "
+                    f"hit rate={pt['prefix_hit_rate']*100:.0f}%, "
+                    f"prefill computed={pt['prefill_tokens_computed']:.0f} "
+                    f"(saved {pt['prefix_hit_tokens']:.0f})")
 
     if best is not None:
         # headline repeated last: drivers that parse the final stdout JSON

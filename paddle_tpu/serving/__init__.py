@@ -9,11 +9,17 @@ paged block-table KV kernel) and the serving frameworks above them
 cache manager) collapses here into four small modules over the Pallas
 paged-decode kernel (`ops/pallas/paged_attention.py`):
 
-  kv_cache.py      page pool + free-list block allocator + per-sequence
-                   block tables (the reference's cache manager);
+  kv_cache.py      page pool + refcounted free-list block allocator +
+                   per-sequence block tables (the reference's cache
+                   manager), plus the PrefixCache (ISSUE 3): a
+                   hash-indexed cache of full immutable KV pages shared
+                   across requests with copy-on-write forking and
+                   LRU eviction of cached-free pages;
   scheduler.py     FCFS continuous-batching scheduler with prefill/decode
-                   phases and youngest-first preemption under pool
-                   pressure (recompute-on-resume);
+                   phases, chunked prefill under a per-step token budget
+                   (max_prefill_tokens_per_step), and youngest-first
+                   preemption under pool pressure (recompute-on-resume —
+                   mostly prefix-cache hits when the cache is on);
   model_runner.py  jitted paged prefill/decode step functions adapting
                    models.Llama / models.GPT (the fluid/inference role);
   engine.py        ServingEngine: per-request sampling params, stop
@@ -49,7 +55,8 @@ from paddle_tpu.serving.engine import (  # noqa: F401
     sample_token,
 )
 from paddle_tpu.serving.kv_cache import (  # noqa: F401
-    BlockAllocator, KVCachePool, SCRATCH_PAGE, SequenceKV,
+    BlockAllocator, KVCachePool, PrefixCache, SCRATCH_PAGE, SequenceKV,
+    page_content_hash,
 )
 from paddle_tpu.serving.metrics import (  # noqa: F401
     Counter, EngineMetrics, Gauge, Histogram,
@@ -69,8 +76,9 @@ __all__ = [
     "BlockAllocator", "Counter", "EngineMetrics", "FCFSScheduler",
     "FaultInjector", "GPTRunner", "Gauge", "Histogram",
     "InjectedDeviceError", "InvariantViolation", "KVCachePool",
-    "LlamaRunner", "PagedModelRunner", "QueueFullError", "Request",
-    "RequestOutput", "RequestState", "SCRATCH_PAGE", "SamplingParams",
-    "SequenceKV", "ServingEngine", "TokenEvent", "audit_engine",
-    "create_engine", "naive_generate", "runner_for", "sample_token",
+    "LlamaRunner", "PagedModelRunner", "PrefixCache", "QueueFullError",
+    "Request", "RequestOutput", "RequestState", "SCRATCH_PAGE",
+    "SamplingParams", "SequenceKV", "ServingEngine", "TokenEvent",
+    "audit_engine", "create_engine", "naive_generate", "page_content_hash",
+    "runner_for", "sample_token",
 ]

@@ -3,10 +3,21 @@
 Reference: the serving loop the reference runs above
 block_multihead_attention (PaddleNLP llm predictor / fastdeploy): an
 admission queue feeds a fixed-slot decode batch; prefill computes a new
-request's full context and first token; every subsequent step decodes
-one token for every running request in a single batched call through
-the paged-attention kernel; finished requests free their pages and their
-slot is refilled from the queue — the batch never drains to refill.
+request's context in CHUNKS bounded by a per-step token budget
+(`max_prefill_tokens_per_step`), interleaved with decode so a long
+prompt never stalls running requests for more than one budget per step;
+every step decodes one token for every decode-phase request in a single
+batched call through the paged-attention kernel; finished requests free
+their pages and their slot is refilled from the queue — the batch never
+drains to refill.
+
+With `enable_prefix_cache=True` (ISSUE 3) identical context prefixes
+stop being recomputed: full KV pages are refcounted and hash-indexed,
+admission maps the longest cached page-aligned prefix straight into the
+block table (prefix_hit_tokens metric), and any write that would touch a
+shared page forks it first (copy-on-write, cow_copies metric) — so
+shared few-shot headers, preemption recompute-on-resume, and
+crash-restore become mostly cache hits while staying token-exact.
 
 The engine is deterministic end-to-end: FCFS admission, sorted-free-list
 pages, greedy (or seeded per-request) sampling, step-indexed sample keys
@@ -113,6 +124,14 @@ class ServingEngine:
                            "greedy" argmaxes the finite entries instead
       audit                run resilience.audit_engine after every step
                            (None = the PADDLE_TPU_SERVING_AUDIT env var)
+      max_prefill_tokens_per_step
+                           per-step prefill token budget: long prompts
+                           are computed in chunks of at most this many
+                           tokens, interleaved with decode (None = whole
+                           context in one chunk, the pre-ISSUE-3 shape)
+      enable_prefix_cache  refcounted shared-prefix KV page cache with
+                           copy-on-write (off by default: sharing changes
+                           page-assignment traces, never tokens)
     """
 
     def __init__(self, runner: PagedModelRunner, *, num_blocks: int,
@@ -125,6 +144,8 @@ class ServingEngine:
                  max_step_retries: int = 2,
                  retry_backoff_s: float = 0.02,
                  nan_policy: str = "abort",
+                 max_prefill_tokens_per_step: Optional[int] = None,
+                 enable_prefix_cache: bool = False,
                  sleep_fn: Optional[Callable[[float], None]] = None,
                  audit: Optional[bool] = None):
         self.runner = runner
@@ -148,11 +169,16 @@ class ServingEngine:
         self.pool = KVCachePool(runner.num_layers, num_blocks, block_size,
                                 runner.n_kv_heads, runner.head_dim,
                                 runner.dtype)
+        self.enable_prefix_cache = bool(enable_prefix_cache)
+        if self.enable_prefix_cache:
+            self.pool.enable_prefix_cache()
+        self.max_prefill_tokens_per_step = max_prefill_tokens_per_step
         self.max_pages_per_seq = self.pool.blocks_for_tokens(
             self.max_model_len)
         self.scheduler = FCFSScheduler(self.pool, max_batch_size,
                                        self.max_pages_per_seq,
-                                       admission_watermark)
+                                       admission_watermark,
+                                       max_prefill_tokens_per_step)
         self.max_batch_size = max_batch_size
         self.max_queue_depth = max_queue_depth
         self.shed_policy = shed_policy
@@ -274,11 +300,13 @@ class ServingEngine:
     # ------------------------------------------------------------- step
 
     def step(self) -> List[TokenEvent]:
-        """One engine iteration: expire deadlines, admit + prefill new
-        requests, reserve decode pages (preempting if needed), run one
-        batched decode step. Returns the tokens produced this step
-        (streaming surface). Load- and fault-induced failures never
-        escape: they end requests with an explicit finish_reason."""
+        """One engine iteration: expire deadlines, admit new requests
+        (mapping cached prefixes), run this step's prefill chunks under
+        the token budget, reserve decode pages (preempting if needed),
+        run one batched decode step over the decode-phase requests.
+        Returns the tokens produced this step (streaming surface). Load-
+        and fault-induced failures never escape: they end requests with
+        an explicit finish_reason."""
         if not self.scheduler.has_work():
             return []
         self.metrics.mark_active()
@@ -287,19 +315,26 @@ class ServingEngine:
         # 0. deadlines first: an expired request must not win admission
         self._expire_deadlines()
 
-        # 1. admission + prefill (each admitted request computes its full
-        #    context and first token; TTFT clock stops here)
+        # 1. admission: slot + pages (the longest cached prefix maps in
+        #    for free — those tokens never reach the prefill chunks)
         for req in self.scheduler.admit():
-            ev = self._prefill_with_recovery(req)
+            if req.kv.num_tokens:
+                self.metrics.prefix_hit_tokens.inc(req.kv.num_tokens)
+
+        # 2. prefill chunks, oldest-first, bounded per step by
+        #    max_prefill_tokens_per_step; the chunk completing a context
+        #    samples that request's next token (TTFT clock stops there)
+        for req, start, end in self.scheduler.prefill_plan():
+            ev = self._prefill_chunk_with_recovery(req, start, end)
             if ev is not None:
                 events.append(ev)
 
-        # 2. decode-page reservation; pool pressure preempts youngest-first
+        # 3. decode-page reservation; pool pressure preempts youngest-first
         victims = self.scheduler.reserve_decode()
         for v in victims:
             self.metrics.preemptions.inc()
 
-        # 3. one batched decode step over every running sequence
+        # 4. one batched decode step over every decode-phase sequence
         if self.scheduler.running:
             events.extend(self._decode_with_recovery())
         self.metrics.decode_steps.inc()
@@ -310,20 +345,30 @@ class ServingEngine:
         self.metrics.running.set(len(self.scheduler.running))
         self.metrics.pool_used_pages.set(a.num_usable - a.num_free)
         self.metrics.pool_utilization.set(self.pool.utilization())
+        if self.pool.prefix_cache is not None:
+            self.metrics.prefix_cached_pages.set(len(self.pool.prefix_cache))
         if self.audit:
             audit_engine(self)
         return events
 
-    def _prefill_with_recovery(self, req: Request) -> Optional[TokenEvent]:
-        """(Re-)prefill one admitted request, retrying transient runner
-        failures with bounded exponential backoff; a request whose prefill
-        keeps failing is quarantined (finish_reason="error")."""
+    def _prefill_chunk_with_recovery(self, req: Request, start: int,
+                                     end: int) -> Optional[TokenEvent]:
+        """Compute context positions [start, end) of one request's
+        (re-)prefill, retrying transient runner failures with bounded
+        exponential backoff; a request whose chunk keeps failing is
+        quarantined (finish_reason="error"). The chunk that completes the
+        context (end == num_context) samples the request's next token and
+        flips it into the decode phase."""
+        cow = req.kv.ensure_writable(start, end)
+        if cow:
+            self.metrics.cow_copies.inc(cow)
         table = self.pool.pad_table(req.kv.pages, self.max_pages_per_seq)
+        chunk = req.context_tokens[start:end]
         delay = self.retry_backoff_s
         for attempt in range(self.max_step_retries + 1):
             try:
-                logits, new_pools = self.runner.prefill(
-                    req.context_tokens, table, self.pool.pools)
+                logits, new_pools = self.runner.prefill_chunk(
+                    chunk, start, table, self.pool.pools)
                 break
             except Exception:
                 if attempt >= self.max_step_retries:
@@ -333,12 +378,18 @@ class ServingEngine:
                 self._sleep(delay)
                 delay *= 2
         self.pool.pools = new_pools
-        req.kv.num_tokens = req.num_context
-        self.metrics.prefill_tokens.inc(req.num_context)
+        req.kv.num_tokens = end
+        self.metrics.prefill_tokens.inc(end - start)
+        self.metrics.prefill_chunks.inc()
+        if self.pool.prefix_cache is not None:
+            self.pool.prefix_cache.register_seq(req.kv, req.context_tokens)
+        if end < req.num_context:
+            return None              # intermediate chunk: logits unread
         tok = self._guarded_sample(np.asarray(logits), req)
         if tok is None:
             self._finish_abnormal(req, "error")
             return None
+        req.phase = "decode"
         return self._append_token(req, tok)
 
     def _decode_with_recovery(self) -> List[TokenEvent]:
@@ -351,19 +402,30 @@ class ServingEngine:
         A retried decode is exact, not approximate: a failed attempt either
         never reached the device (injected/raised before compute) or re-
         writes the same K/V values through the same block tables, so the
-        token stream is unchanged vs a fault-free run."""
+        token stream is unchanged vs a fault-free run.
+
+        Only decode-phase requests join the batch — a request mid-way
+        through its chunked prefill has no token to feed yet; its slot
+        carries an all-scratch table and self-neutralizes."""
         attempts = 0
         delay = self.retry_backoff_s
         while True:
-            running = self.scheduler.running_in_order()
-            if not running:
+            batch = [r for r in self.scheduler.running_in_order()
+                     if r.phase == "decode"]
+            if not batch:
                 return []
             B = self.max_batch_size
             P = self.max_pages_per_seq
             tokens = np.zeros((B,), np.int32)
             tables = np.full((B, P), SCRATCH_PAGE, np.int32)
             pos = np.zeros((B,), np.int32)
-            for req in running:
+            for req in batch:
+                # the fed token's KV write must never land on a shared
+                # page (idempotent: a forked page is private on retry)
+                cow = req.kv.ensure_writable(req.num_context - 1,
+                                             req.num_context)
+                if cow:
+                    self.metrics.cow_copies.inc(cow)
                 s = req.slot
                 tokens[s] = req.output_tokens[-1]
                 tables[s, :len(req.kv.pages)] = req.kv.pages
@@ -379,15 +441,18 @@ class ServingEngine:
                     self._sleep(delay)
                     delay *= 2
                     continue
-                self._finish_abnormal(self.scheduler.running[-1], "error")
+                self._finish_abnormal(batch[-1], "error")
                 attempts = 0
                 delay = self.retry_backoff_s
         self.pool.pools = new_pools
-        self.metrics.batch_occupancy.observe(len(running))
+        self.metrics.batch_occupancy.observe(len(batch))
         logits_np = np.asarray(logits)
         events = []
-        for req in running:
+        for req in batch:
             req.kv.num_tokens = req.num_context
+            if self.pool.prefix_cache is not None:
+                self.pool.prefix_cache.register_seq(req.kv,
+                                                    req.context_tokens)
             tok = self._guarded_sample(logits_np[req.slot], req)
             if tok is None:
                 self._finish_abnormal(req, "error")
@@ -437,12 +502,31 @@ class ServingEngine:
 
     # ------------------------------------------------ snapshot / restore
 
+    def release_prefix_cache(self) -> int:
+        """Drop the prefix cache's index and its page references: cached
+        -free pages return to the free list; pages still mapped by running
+        sequences stay live (they just lose the cache pin). Returns the
+        number of pages released. The teardown/leak-audit hook — after a
+        drain plus this call, check_no_leaks() must hold again."""
+        if self.pool.prefix_cache is None:
+            return 0
+        return self.pool.prefix_cache.clear()
+
     def snapshot(self) -> dict:
         """Crash-safe serialization of ALL request state: prompts,
         generated tokens, sampling params, arrival order, plus finished
         outputs. JSON-serializable; device state is deliberately excluded
         — restore() rebuilds KV via the recompute-on-resume path, which
-        the step-indexed sample keys make token-exact."""
+        the step-indexed sample keys make token-exact.
+
+        The prefix cache's hash index is deliberately DROPPED (not
+        serialized): it points at device pages whose KV does not survive
+        the crash, so a restored engine starts with an empty cache and
+        rebuilds it as the recompute-on-resume prefills register their
+        pages — after which the still-queued siblings hit it again. A
+        snapshot taken mid-chunked-prefill serializes the same way: the
+        resumed request simply re-prefills from its (possibly cached)
+        prefix."""
         now = self.metrics.clock()
 
         def req_state(req: Request) -> dict:
@@ -479,6 +563,9 @@ class ServingEngine:
                 "max_step_retries": self.max_step_retries,
                 "retry_backoff_s": self.retry_backoff_s,
                 "nan_policy": self.nan_policy,
+                "max_prefill_tokens_per_step":
+                    self.max_prefill_tokens_per_step,
+                "enable_prefix_cache": self.enable_prefix_cache,
             },
             "requests": reqs,
             "finished": [asdict(o) for o in self._outputs.values()],
@@ -507,6 +594,9 @@ class ServingEngine:
                   max_step_retries=cfg["max_step_retries"],
                   retry_backoff_s=cfg["retry_backoff_s"],
                   nan_policy=cfg["nan_policy"],
+                  max_prefill_tokens_per_step=cfg.get(
+                      "max_prefill_tokens_per_step"),
+                  enable_prefix_cache=cfg.get("enable_prefix_cache", False),
                   metrics=metrics, sleep_fn=sleep_fn, audit=audit)
         ensure_arrival_counter_above(max(
             (r["arrival_index"] for r in state["requests"]), default=-1))

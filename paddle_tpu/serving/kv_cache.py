@@ -12,24 +12,53 @@ Layout matches ops/pallas/paged_attention.py exactly: per layer a
 arrays, block tables of int32 page ids. Page 0 is RESERVED as scratch:
 dead batch slots and padded prefill positions write there, so the
 allocator never hands it out and no live sequence ever reads it.
+
+ISSUE 3 adds page sharing (vLLM/SGLang-style prefix caching): pages are
+refcounted, and a PrefixCache keeps FULL, immutable pages indexed by a
+hash chain over their token content. A new request maps the longest
+cached page-aligned prefix of its context straight into its block table
+(incref, no recompute); any write that would land on a shared page is
+copy-on-write forked first, so a shared page is never mutated in place.
+Cached pages the cache alone still references (refcount 1) are evictable
+in LRU order when the free list runs dry.
 """
 
 from __future__ import annotations
 
-from typing import List
+from bisect import insort
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 
 SCRATCH_PAGE = 0
 
+# seed of the per-page content hash chain (any fixed int; the chain makes
+# page i's key depend on every token in pages 0..i, so equal hash ==
+# equal token prefix — the property prefix matching leans on)
+_CHAIN_SEED = 0x5EED
+
+
+def page_content_hash(prev_hash: int, page_tokens: Sequence[int]) -> int:
+    """Hash key of one FULL page given its tokens and the previous page's
+    chain hash. Tuple-of-int hashing is deterministic in CPython (ints
+    hash to themselves), so equal prefixes always collide on purpose."""
+    return hash((prev_hash,) + tuple(int(t) for t in page_tokens))
+
 
 class BlockAllocator:
-    """Deterministic free-list page allocator.
+    """Deterministic refcounted free-list page allocator.
 
     Pages are handed out lowest-id-first (sorted free list) so a given
     request trace always produces the same block tables — the property the
     token-for-token equivalence test leans on. Page 0 (scratch) is never
     allocatable.
+
+    Refcounts (ISSUE 3): `alloc` hands a page out at refcount 1;
+    prefix-shared pages are `incref`ed per additional user (including the
+    PrefixCache itself, which holds one reference per registered page) and
+    `decref`ed on release — a page returns to the free list only when its
+    count hits zero. `free(pages)` is decref-each, so exclusive pages
+    behave exactly as before the cache existed.
     """
 
     def __init__(self, num_blocks: int):
@@ -37,7 +66,8 @@ class BlockAllocator:
             raise ValueError("pool needs >= 2 pages (page 0 is scratch)")
         self.num_blocks = num_blocks
         self._free = list(range(1, num_blocks))  # ascending
-        self._allocated: set = set()
+        self._ref: Dict[int, int] = {}           # page -> refcount (>= 1)
+        self.evictor: Optional["PrefixCache"] = None
 
     @property
     def num_free(self) -> int:
@@ -49,31 +79,197 @@ class BlockAllocator:
         return self.num_blocks - 1
 
     @property
+    def num_evictable(self) -> int:
+        """Cached pages only the prefix cache still references — they can
+        be reclaimed on demand, so admission treats them as free."""
+        return self.evictor.evictable_count() if self.evictor else 0
+
+    @property
     def allocated_pages(self) -> frozenset:
         """Read-only view of the live pages (resilience.audit_engine)."""
-        return frozenset(self._allocated)
+        return frozenset(self._ref)
+
+    def refcount(self, page: int) -> int:
+        return self._ref.get(page, 0)
 
     def can_alloc(self, n: int) -> bool:
-        return n <= len(self._free)
+        return n <= len(self._free) + self.num_evictable
 
     def alloc(self, n: int) -> List[int]:
+        if n > len(self._free) and self.evictor is not None:
+            self.evictor.evict(n - len(self._free))
         if n > len(self._free):
             raise MemoryError(
                 f"KV pool exhausted: need {n} pages, {len(self._free)} free")
         pages, self._free = self._free[:n], self._free[n:]
-        self._allocated.update(pages)
+        for p in pages:
+            self._ref[p] = 1
         return pages
+
+    def incref(self, page: int) -> int:
+        if page not in self._ref:
+            raise ValueError(f"incref of unallocated page {page}")
+        self._ref[page] += 1
+        return self._ref[page]
+
+    def decref(self, page: int) -> int:
+        """Drop one reference; a page whose count reaches zero returns to
+        the (sorted) free list. Raises on over-release — the double-free
+        guard the leak tests lean on."""
+        if page not in self._ref:
+            raise ValueError(f"double free of page {page}")
+        self._ref[page] -= 1
+        rc = self._ref[page]
+        if rc == 0:
+            del self._ref[page]
+            insort(self._free, page)   # keep sorted: allocation stays
+        return rc                      # deterministic
 
     def free(self, pages: List[int]) -> None:
         for p in pages:
-            if p not in self._allocated:
-                raise ValueError(f"double free of page {p}")
-            self._allocated.discard(p)
-        # keep the free list sorted: allocation order stays deterministic
-        self._free = sorted(self._free + list(pages))
+            self.decref(p)
 
     def check_no_leaks(self) -> bool:
-        return not self._allocated and len(self._free) == self.num_usable
+        return not self._ref and len(self._free) == self.num_usable
+
+
+class PrefixCache:
+    """Hash-indexed cache of FULL, immutable KV pages (ISSUE 3 tentpole).
+
+    Keys are content-chain hashes: page i of a sequence is keyed by
+    hash(chain(pages 0..i-1), tokens of page i), so a hit on page i
+    certifies the entire token prefix matches — exactly the vLLM /
+    SGLang automatic-prefix-caching contract, restricted to page
+    granularity.
+
+    The cache holds ONE allocator reference per registered page, so a
+    registered page survives its owning sequence (preemption, finish,
+    crash-restore recompute) at refcount 1 — "cached free". Those pages
+    are evictable in LRU order (a deterministic logical tick, never wall
+    time) when the allocator runs dry; acquiring a page for a new match
+    increfs it back above 1, which pins it.
+
+    Immutability is enforced by copy-on-write at the write path
+    (SequenceKV.ensure_writable): any page with refcount > 1 — shared
+    with another sequence or with this cache — is forked before a write,
+    so cached content is never mutated in place.
+    """
+
+    def __init__(self, pool: "KVCachePool"):
+        self.pool = pool
+        self.block_size = pool.block_size
+        self._index: Dict[int, int] = {}        # chain hash -> page id
+        self._page_hash: Dict[int, int] = {}    # page id -> chain hash
+        self._page_tick: Dict[int, int] = {}    # page id -> last-use tick
+        self._tick = 0
+        self.hit_pages = 0
+        self.miss_pages = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def pages(self) -> frozenset:
+        return frozenset(self._page_hash)
+
+    def _touch(self, page: int) -> None:
+        self._tick += 1
+        self._page_tick[page] = self._tick
+
+    # ---------------------------------------------------------- matching
+
+    def match(self, tokens: Sequence[int]) -> List[Tuple[int, int]]:
+        """Longest cached page-aligned prefix of `tokens`, as a list of
+        (chain_hash, page) pairs. Capped STRICTLY below len(tokens): at
+        least one token is always left to compute, so admission always
+        produces the logits it must sample from."""
+        limit = (len(tokens) - 1) // self.block_size
+        out: List[Tuple[int, int]] = []
+        prev = _CHAIN_SEED
+        for i in range(limit):
+            h = page_content_hash(
+                prev, tokens[i * self.block_size:(i + 1) * self.block_size])
+            page = self._index.get(h)
+            if page is None:
+                self.miss_pages += 1
+                break
+            out.append((h, page))
+            prev = h
+        self.hit_pages += len(out)
+        return out
+
+    def acquire(self, matched: List[Tuple[int, int]]) -> None:
+        """Pin a match() result for a sequence: one incref per page (and
+        an LRU touch). Must run before any further allocation so eviction
+        cannot reclaim the matched pages out from under the admit."""
+        for _, page in matched:
+            self.pool.allocator.incref(page)
+            self._touch(page)
+
+    def unacquire(self, matched: List[Tuple[int, int]]) -> None:
+        """Roll acquire() back (admission decided not to take the seat)."""
+        for _, page in matched:
+            self.pool.allocator.decref(page)
+
+    # ------------------------------------------------------ registration
+
+    def register_seq(self, kv: "SequenceKV", tokens: Sequence[int]) -> int:
+        """Register every newly-FULL page of `kv` (tokens = the owning
+        request's context). Pages whose content hash is already cached are
+        skipped (first writer wins; the duplicate page stays private to
+        its sequence). Returns the number of pages newly registered."""
+        full = kv.num_tokens // self.block_size
+        added = 0
+        while kv.registered_pages < full:
+            i = kv.registered_pages
+            prev = kv.hash_chain[i - 1] if i else _CHAIN_SEED
+            h = page_content_hash(
+                prev, tokens[i * self.block_size:(i + 1) * self.block_size])
+            page = kv.pages[i]
+            if h not in self._index:
+                self._index[h] = page
+                self._page_hash[page] = h
+                self.pool.allocator.incref(page)   # the cache's own ref
+                self._touch(page)
+            kv.hash_chain.append(h)
+            kv.registered_pages += 1
+            added += 1
+        return added
+
+    # ---------------------------------------------------------- eviction
+
+    def evictable_count(self) -> int:
+        alloc = self.pool.allocator
+        return sum(1 for p in self._page_hash if alloc.refcount(p) == 1)
+
+    def evict(self, n: int) -> int:
+        """Reclaim up to n cached-free pages (refcount 1 = only the cache
+        holds them), least-recently-used first — the tick order is a
+        logical counter, so eviction is deterministic."""
+        alloc = self.pool.allocator
+        victims = sorted((p for p in self._page_hash
+                          if alloc.refcount(p) == 1),
+                         key=lambda p: self._page_tick[p])[:n]
+        for page in victims:
+            self._unregister(page)
+            alloc.decref(page)         # rc 1 -> 0: back to the free list
+            self.evictions += 1
+        return len(victims)
+
+    def _unregister(self, page: int) -> None:
+        h = self._page_hash.pop(page)
+        del self._index[h]
+        del self._page_tick[page]
+
+    def clear(self) -> int:
+        """Drop the whole index (the cache's references with it). Pages
+        still mapped by running sequences stay live; cached-free pages
+        return to the free list. Used by snapshot/teardown paths."""
+        pages = list(self._page_hash)
+        for page in pages:
+            self._unregister(page)
+            self.pool.allocator.decref(page)
+        return len(pages)
 
 
 class KVCachePool:
@@ -94,9 +290,17 @@ class KVCachePool:
         self.head_dim = head_dim
         self.dtype = dtype
         self.allocator = BlockAllocator(num_blocks)
+        self.prefix_cache: Optional[PrefixCache] = None
         shape = (num_blocks, block_size, n_kv_heads, head_dim)
         self.pools = [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
                       for _ in range(num_layers)]
+
+    def enable_prefix_cache(self) -> PrefixCache:
+        """Turn on shared-prefix page caching (idempotent)."""
+        if self.prefix_cache is None:
+            self.prefix_cache = PrefixCache(self)
+            self.allocator.evictor = self.prefix_cache
+        return self.prefix_cache
 
     def blocks_for_tokens(self, n_tokens: int) -> int:
         """Pages needed to hold n_tokens KV entries."""
@@ -109,6 +313,12 @@ class KVCachePool:
             raise ValueError(f"sequence needs {len(pages)} pages > "
                              f"max_pages_per_seq={max_pages}")
         return list(pages) + [SCRATCH_PAGE] * (max_pages - len(pages))
+
+    def copy_page(self, src: int, dst: int) -> None:
+        """Device-side page copy across every layer's (k, v) pool — the
+        data move behind a copy-on-write fork."""
+        self.pools = [(k.at[dst].set(k[src]), v.at[dst].set(v[src]))
+                      for k, v in self.pools]
 
     def utilization(self) -> float:
         a = self.allocator
@@ -124,12 +334,29 @@ class SequenceKV:
     """Host-side per-sequence cache state: the owned pages and how many
     token positions are live. Appending crosses page boundaries lazily —
     `pages_short()` reports the deficit the scheduler must fund (or
-    preempt to fund) before the next decode step."""
+    preempt to fund) before the next decode step.
+
+    With the prefix cache on, the leading pages may be SHARED (mapped
+    from the cache at admission); `registered_pages`/`hash_chain` track
+    how far this sequence's full pages have been pushed into the cache,
+    and `ensure_writable` copy-on-write forks any shared page before the
+    runner would write through it."""
 
     def __init__(self, pool: KVCachePool):
         self.pool = pool
         self.pages: List[int] = []
         self.num_tokens = 0
+        self.registered_pages = 0          # leading pages already cached
+        self.hash_chain: List[int] = []    # chain hash per registered page
+
+    def adopt_prefix(self, matched: List[Tuple[int, int]],
+                     block_size: int) -> None:
+        """Map an ALREADY-ACQUIRED PrefixCache match as this sequence's
+        leading pages: their KV is live, so prefill starts after them."""
+        self.pages = [page for _, page in matched]
+        self.hash_chain = [h for h, _ in matched]
+        self.registered_pages = len(matched)
+        self.num_tokens = len(matched) * block_size
 
     def pages_short(self, upcoming_tokens: int = 1) -> int:
         need = self.pool.blocks_for_tokens(self.num_tokens + upcoming_tokens)
@@ -140,8 +367,36 @@ class SequenceKV:
         if short:
             self.pages.extend(self.pool.allocator.alloc(short))
 
+    def ensure_writable(self, start_tok: int, end_tok: int) -> int:
+        """Copy-on-write guard for a write covering token positions
+        [start_tok, end_tok): any touched page with refcount > 1 (shared
+        with another sequence or pinned by the prefix cache) is forked —
+        fresh page, KV contents copied, block-table entry swapped, old
+        reference dropped. Returns the number of pages forked."""
+        if end_tok <= start_tok:
+            return 0
+        alloc = self.pool.allocator
+        bs = self.pool.block_size
+        forked = 0
+        for idx in range(start_tok // bs, (end_tok - 1) // bs + 1):
+            page = self.pages[idx]
+            if alloc.refcount(page) > 1:
+                new = alloc.alloc(1)[0]
+                self.pool.copy_page(page, new)
+                alloc.decref(page)
+                self.pages[idx] = new
+                # the fork is private and its content will diverge: it is
+                # no longer covered by this sequence's registered chain
+                if idx < self.registered_pages:
+                    self.registered_pages = idx
+                    del self.hash_chain[idx:]
+                forked += 1
+        return forked
+
     def release(self) -> None:
         if self.pages:
-            self.pool.allocator.free(self.pages)
+            self.pool.allocator.free(self.pages)   # decref each
         self.pages = []
         self.num_tokens = 0
+        self.registered_pages = 0
+        self.hash_chain = []

@@ -100,10 +100,18 @@ class EngineMetrics:
         self.nan_logit_events = Counter("nan_logit_events")
         self.shed_requests = Counter("shed_requests")
         self.tokens_generated = Counter("tokens_generated")
+        # prefill_tokens counts tokens actually COMPUTED by prefill
+        # chunks; prefix-cache hits skip the compute and land in
+        # prefix_hit_tokens instead, so (computed + hit) = total context
+        # and the hit counter IS the prefill-token savings (ISSUE 3)
         self.prefill_tokens = Counter("prefill_tokens")
+        self.prefill_chunks = Counter("prefill_chunks")
+        self.prefix_hit_tokens = Counter("prefix_hit_tokens")
+        self.cow_copies = Counter("cow_copies")
         self.decode_steps = Counter("decode_steps")
         self.queue_depth = Gauge("queue_depth")
         self.running = Gauge("running")
+        self.prefix_cached_pages = Gauge("prefix_cached_pages")
         self.pool_used_pages = Gauge("pool_used_pages")
         self.pool_utilization = Gauge("pool_utilization")
         self.batch_occupancy = Histogram("batch_occupancy")
@@ -141,6 +149,10 @@ class EngineMetrics:
             "shed_requests": self.shed_requests.value,
             "tokens_generated": self.tokens_generated.value,
             "prefill_tokens": self.prefill_tokens.value,
+            "prefill_chunks": self.prefill_chunks.value,
+            "prefix_hit_tokens": self.prefix_hit_tokens.value,
+            "cow_copies": self.cow_copies.value,
+            "prefix_cached_pages": self.prefix_cached_pages.value,
             "decode_steps": self.decode_steps.value,
             "queue_depth": self.queue_depth.value,
             "queue_depth_peak": self.queue_depth.peak,

@@ -7,6 +7,7 @@ runner adapts a decoder Layer (models.Llama, models.GPT) into two jitted
 step functions over the shared page pool:
 
   prefill(tokens[1, T], table[1, P], real_len, pools) -> (logits[V], pools)
+  prefill_chunk(tokens, start_pos, table, pools)      -> (logits[V], pools)
   decode(tokens[B, 1], tables[B, P], pos[B], pools)   -> (logits[B, V], pools)
 
 Both steps write K/V through the block table and attend through either
@@ -16,15 +17,29 @@ the kernels in ops/pallas use. Prefill lengths are padded to power-of-2
 buckets so the compile count stays logarithmic; padded positions write
 to the scratch page and their logits are never read. Dead decode slots
 carry all-scratch tables, so they self-neutralize without a mask.
+
+`prefill_chunk` (ISSUE 3) is the incremental spelling: it computes
+context positions [start_pos, start_pos + len(tokens)), attending over
+everything already written through the same block table (earlier chunks,
+prefix-cache pages) — `prefill` is just the start_pos=0 full-context
+case, so both share one jit cache keyed by the chunk-length bucket, and
+`start_pos` rides in as a traced scalar (no recompile per offset). The
+jit cache logs every compile and can be capped via the
+PADDLE_TPU_MAX_JIT_CACHE env var (LRU eviction; 0/unset = unbounded).
 """
 
 from __future__ import annotations
 
+import logging
+import os
+from collections import OrderedDict
 from typing import Dict, List
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from paddle_tpu.models.generation import (
     _block_params, _layer_norm, _mlp, masked_cache_attention, paged_gather,
@@ -88,7 +103,7 @@ class PagedModelRunner:
         if attn_impl not in ("auto", "pallas", "reference"):
             raise ValueError(f"attn_impl={attn_impl!r}")
         self.attn_impl = attn_impl
-        self._jit_cache: Dict = {}
+        self._jit_cache: "OrderedDict" = OrderedDict()
 
     @property
     def dtype(self):
@@ -118,15 +133,16 @@ class PagedModelRunner:
         page = jnp.where(valid, page, SCRATCH_PAGE)
         return page, positions % self.block_size
 
-    def _prefill_step(self, params, tokens, table, real_len, pools):
+    def _prefill_step(self, params, tokens, table, real_len, start_pos,
+                      pools):
         T = tokens.shape[1]
-        positions = jnp.arange(T, dtype=jnp.int32)[None, :]        # [1, T]
-        valid = positions < real_len
-        positions = jnp.where(valid, positions, 0)
+        offs = jnp.arange(T, dtype=jnp.int32)[None, :]             # [1, T]
+        valid = offs < real_len
+        positions = jnp.where(valid, start_pos + offs, 0)
         page, off = self._write_indices(positions, table, valid)
         logits, pools = self._forward(params, tokens, positions, page, off,
-                                      table, jnp.zeros((1,), jnp.int32),
-                                      pools)
+                                      table,
+                                      jnp.reshape(start_pos, (1,)), pools)
         return logits[0, real_len - 1], pools
 
     def _decode_step(self, params, tokens, tables, pos, pools):
@@ -138,16 +154,47 @@ class PagedModelRunner:
         return logits[:, 0], pools
 
     def _jitted(self, kind: str, shape_key):
+        """Shape-keyed jit cache. Every miss (= a compile) is logged, and
+        PADDLE_TPU_MAX_JIT_CACHE bounds the entry count with LRU eviction
+        so a pathological shape stream cannot grow the compile cache
+        without bound (chunked prefill already buckets its lengths, so a
+        healthy serve needs only O(log max_model_len) prefill entries plus
+        one decode entry per batch width)."""
         key = (kind, shape_key)
-        if key not in self._jit_cache:
-            fn = {"prefill": self._prefill_step,
-                  "decode": self._decode_step}[kind]
-            donate = (4,) if jax.default_backend() == "tpu" else ()
-            self._jit_cache[key] = jax.jit(fn, donate_argnums=donate)
-        return self._jit_cache[key]
+        cached = self._jit_cache.get(key)
+        if cached is not None:
+            self._jit_cache.move_to_end(key)
+            return cached
+        fn = {"prefill": self._prefill_step,
+              "decode": self._decode_step}[kind]
+        pools_arg = {"prefill": 5, "decode": 4}[kind]
+        donate = (pools_arg,) if jax.default_backend() == "tpu" else ()
+        jitted = jax.jit(fn, donate_argnums=donate)
+        self._jit_cache[key] = jitted
+        logger.info("serving jit compile %s key=%s (cache entries: %d)",
+                    kind, shape_key, len(self._jit_cache))
+        cap = int(os.environ.get("PADDLE_TPU_MAX_JIT_CACHE", "0") or "0")
+        if cap > 0:
+            while len(self._jit_cache) > cap:
+                evicted, _ = self._jit_cache.popitem(last=False)
+                logger.warning(
+                    "serving jit cache over PADDLE_TPU_MAX_JIT_CACHE=%d; "
+                    "evicting %s", cap, evicted)
+        return jitted
 
     def prefill(self, tokens: List[int], table_row: List[int], pools):
         """Run one sequence's (re-)prefill; returns (last_logits[V], pools)."""
+        return self.prefill_chunk(tokens, 0, table_row, pools)
+
+    def prefill_chunk(self, tokens: List[int], start_pos: int,
+                      table_row: List[int], pools):
+        """Compute context positions [start_pos, start_pos + len(tokens))
+        for one sequence, attending over everything the block table
+        already holds (earlier chunks, shared prefix pages). Returns the
+        logits of the chunk's LAST position plus the updated pools —
+        callers only sample from the chunk that completes the context.
+        Chunk lengths share the power-of-2 prefill buckets, so chunking
+        never compiles per odd length."""
         t = len(tokens)
         tb = _bucket_len(t)
         padded = np.zeros((1, tb), np.int32)
@@ -155,7 +202,8 @@ class PagedModelRunner:
         fn = self._jitted("prefill", tb)
         return fn(self.params, jnp.asarray(padded),
                   jnp.asarray(np.asarray(table_row, np.int32)[None]),
-                  jnp.asarray(t, jnp.int32), pools)
+                  jnp.asarray(t, jnp.int32),
+                  jnp.asarray(start_pos, jnp.int32), pools)
 
     def decode(self, tokens, tables, pos, pools):
         """Batched decode step; tokens [B], tables [B, P], pos [B]."""

@@ -124,6 +124,17 @@ class FaultInjector:
             logits = self._corrupt(logits)
         return logits, pools
 
+    def prefill_chunk(self, tokens, start_pos, table, pools):
+        # chunks share the "prefill" op counter: a chunked engine sees
+        # the same per-prefill-call fault schedule as a monolithic one
+        n = self._pre("prefill")
+        logits, pools = self._runner.prefill_chunk(tokens, start_pos, table,
+                                                   pools)
+        if self._hits(self._nan, "prefill", n):
+            self.injected["nan"] += 1
+            logits = self._corrupt(logits)
+        return logits, pools
+
     def decode(self, tokens, tables, pos, pools):
         n = self._pre("decode")
         logits, pools = self._runner.decode(tokens, tables, pos, pools)
@@ -138,16 +149,24 @@ def audit_engine(engine) -> None:
     mutually consistent — the opt-in post-step invariant check
     (ServingEngine(..., audit=True) or PADDLE_TPU_SERVING_AUDIT=1).
 
+    With the prefix cache enabled, page sharing is refcount-audited: a
+    page's refcount must equal the number of sequences mapping it plus
+    one if the cache's index holds it, the index must be a bijection,
+    and a page may appear at most once within ONE sequence's table
+    (cross-sequence sharing is the feature; intra-sequence aliasing is
+    always a bug).
+
     Raises InvariantViolation listing every broken invariant; returns
     None on a clean state. O(pool + batch) host work, no device calls.
     """
     alloc = engine.pool.allocator
     sched = engine.scheduler
+    cache = engine.pool.prefix_cache
     problems = []
 
     # -- allocator self-consistency -------------------------------------
     free_list = list(alloc._free)
-    fset, aset = set(free_list), set(alloc._allocated)
+    fset, aset = set(free_list), set(alloc._ref)
     if len(free_list) != len(fset):
         problems.append("duplicate pages in the free list")
     if fset & aset:
@@ -159,9 +178,12 @@ def audit_engine(engine) -> None:
         problems.append(
             f"page accounting broken: lost={sorted(expected - fset - aset)} "
             f"foreign={sorted((fset | aset) - expected)}")
+    if any(rc < 1 for rc in alloc._ref.values()):
+        problems.append("allocated page with refcount < 1")
 
-    # -- ownership: allocated pages == union of running sequences' pages -
-    owned = []
+    # -- ownership: allocated pages == running sequences' pages (counted
+    #    with sharing multiplicity) + the prefix cache's registrations ---
+    owner_counts: dict = {}
     for req in sched.running:
         if req.kv is None:
             problems.append(f"{req.request_id} RUNNING without kv state")
@@ -169,6 +191,11 @@ def audit_engine(engine) -> None:
         if SCRATCH_PAGE in req.kv.pages:
             problems.append(f"{req.request_id} block table maps the scratch "
                             "page")
+        if len(set(req.kv.pages)) != len(req.kv.pages):
+            problems.append(f"{req.request_id} maps the same page twice")
+        if req.kv.num_tokens > req.num_context:
+            problems.append(f"{req.request_id} kv covers {req.kv.num_tokens}"
+                            f" tokens > context {req.num_context}")
         need = engine.pool.blocks_for_tokens(max(1, req.kv.num_tokens))
         if len(req.kv.pages) < need:
             problems.append(
@@ -177,15 +204,39 @@ def audit_engine(engine) -> None:
         if len(req.kv.pages) > engine.max_pages_per_seq:
             problems.append(f"{req.request_id} holds {len(req.kv.pages)} "
                             f"pages > max_pages_per_seq")
-        owned.extend(req.kv.pages)
-    oset = set(owned)
-    if len(owned) != len(oset):
-        dupes = sorted({p for p in owned if owned.count(p) > 1})
+        for p in req.kv.pages:
+            owner_counts[p] = owner_counts.get(p, 0) + 1
+    cached = set(cache.pages()) if cache is not None else set()
+    oset = set(owner_counts)
+    if cache is None and len(owner_counts) != sum(owner_counts.values()):
+        dupes = sorted(p for p, c in owner_counts.items() if c > 1)
         problems.append(f"pages owned by two sequences: {dupes}")
-    if oset != aset:
+    if oset | cached != aset:
         problems.append(
-            f"page leak: allocated-but-unowned={sorted(aset - oset)} "
-            f"owned-but-not-allocated={sorted(oset - aset)}")
+            f"page leak: allocated-but-unowned={sorted(aset - oset - cached)}"
+            f" owned-but-not-allocated={sorted((oset | cached) - aset)}")
+    for p in aset:
+        expected_rc = owner_counts.get(p, 0) + (1 if p in cached else 0)
+        if alloc._ref.get(p) != expected_rc:
+            problems.append(
+                f"page {p} refcount {alloc._ref.get(p)} != "
+                f"{owner_counts.get(p, 0)} owners + "
+                f"{int(p in cached)} cache refs")
+
+    # -- prefix-cache index consistency ----------------------------------
+    if cache is not None:
+        if SCRATCH_PAGE in cached:
+            problems.append("scratch page registered in the prefix cache")
+        if cached & fset:
+            problems.append(
+                f"cached pages on the free list: {sorted(cached & fset)}")
+        index_pages = list(cache._index.values())
+        if len(index_pages) != len(set(index_pages)):
+            problems.append("prefix-cache index maps two hashes to one page")
+        if {cache._index[h] for h in cache._index} != cached or any(
+                cache._index.get(cache._page_hash.get(p)) != p
+                for p in cached):
+            problems.append("prefix-cache hash index and page index disagree")
 
     # -- slot accounting -------------------------------------------------
     slots = [r.slot for r in sched.running]

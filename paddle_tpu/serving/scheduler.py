@@ -17,7 +17,17 @@ Determinism contract (the equivalence test leans on every clause):
     same trace of events always yields the same block tables;
   * preemption victims are chosen youngest-first (last admitted), and a
     preempted request resumes with its full context (prompt + generated
-    so far) re-prefilled — recompute, not cache migration.
+    so far) re-prefilled — recompute, not cache migration (with the
+    prefix cache on, the recompute is mostly cache hits: the victim's
+    full pages survive at refcount 1 and re-match at re-admission).
+
+ISSUE 3 adds chunked prefill: `max_prefill_tokens_per_step` bounds the
+prefill tokens computed per engine step, and `prefill_plan()` slices the
+running requests' outstanding context into chunks under that budget
+(oldest-first), so a long-prompt arrival never stalls running decodes
+for more than one chunk budget per step. Admission maps the longest
+cached page-aligned prefix from the pool's PrefixCache before
+allocating the remainder.
 """
 
 from __future__ import annotations
@@ -85,6 +95,9 @@ class Request:                # requests by object, never by field value
     finish_reason: Optional[str] = None    # "stop" | "length"
     kv: Optional[SequenceKV] = None
     slot: Optional[int] = None
+    # "prefill" until the chunk that completes the context samples its
+    # token, then "decode"; reset at every (re-)admission
+    phase: str = "prefill"
     admission_index: int = -1              # set fresh at every admission
     num_preemptions: int = 0
     arrival_time: float = 0.0
@@ -115,7 +128,8 @@ class FCFSScheduler:
     """Admission queue + running set over one KVCachePool."""
 
     def __init__(self, pool: KVCachePool, max_batch_size: int,
-                 max_pages_per_seq: int, admission_watermark: float = 1.0):
+                 max_pages_per_seq: int, admission_watermark: float = 1.0,
+                 max_prefill_tokens_per_step: Optional[int] = None):
         if max_pages_per_seq > pool.allocator.num_usable:
             raise ValueError(
                 f"max_pages_per_seq={max_pages_per_seq} exceeds the pool's "
@@ -123,6 +137,11 @@ class FCFSScheduler:
                 "could never fit; enlarge num_blocks")
         if not 0.0 < admission_watermark <= 1.0:
             raise ValueError("admission_watermark must be in (0, 1]")
+        if (max_prefill_tokens_per_step is not None
+                and max_prefill_tokens_per_step < 1):
+            raise ValueError("max_prefill_tokens_per_step must be >= 1 "
+                             "(None = whole context in one chunk)")
+        self.max_prefill_tokens_per_step = max_prefill_tokens_per_step
         self.pool = pool
         self.max_batch_size = max_batch_size
         self.max_pages_per_seq = max_pages_per_seq
@@ -157,8 +176,16 @@ class FCFSScheduler:
         for their full context PLUS one decode token (so every admitted
         request is guaranteed its first generated token without an
         immediate self-preemption). Strict FCFS: stop at the first
-        request that does not fit."""
+        request that does not fit.
+
+        With the pool's PrefixCache enabled, the longest cached
+        page-aligned prefix of the request's context is mapped (shared,
+        increfed) into its block table before the remainder is allocated
+        — those tokens are already live KV, so prefill starts after them
+        and the pool only has to fund the unmatched tail."""
         admitted: List[Request] = []
+        alloc = self.pool.allocator
+        cache = self.pool.prefix_cache
         while self.waiting and self._free_slots:
             req = self.waiting[0]
             need = self.pool.blocks_for_tokens(req.num_context + 1)
@@ -166,24 +193,67 @@ class FCFSScheduler:
                 raise ValueError(
                     f"request {req.request_id} needs {need} pages > "
                     f"max_pages_per_seq={self.max_pages_per_seq}")
-            if not self.pool.allocator.can_alloc(need):
-                break
-            used = self.pool.allocator.num_usable - self.pool.allocator.num_free
-            if used + need > self._watermark_pages and (self.running
-                                                        or admitted):
+            matched = cache.match(req.context_tokens) if cache else []
+            if matched:
+                # pin the match BEFORE any allocation: an incref lifts
+                # the pages above refcount 1, so eviction (which alloc
+                # may trigger) cannot reclaim them mid-admission
+                cache.acquire(matched)
+            need_new = need - len(matched)
+            # live = pages some sequence actually maps; cached-free pages
+            # are reclaimable, so they count as headroom, not pressure
+            used_live = (alloc.num_usable - alloc.num_free
+                         - alloc.num_evictable)
+            over_watermark = (used_live + need_new > self._watermark_pages
+                              and (self.running or admitted))
+            if not alloc.can_alloc(need_new) or over_watermark:
+                if matched:
+                    cache.unacquire(matched)
                 # over the high watermark: stop admitting — unless nothing
                 # is running at all (progress guarantee: a request larger
                 # than the watermark must still be servable alone)
                 break
             self.waiting.popleft()
             req.kv = SequenceKV(self.pool)
-            req.kv.grow(req.num_context + 1)
+            if matched:
+                req.kv.adopt_prefix(matched, self.pool.block_size)
+            req.kv.grow(req.num_context + 1 - req.kv.num_tokens)
             req.slot = self._free_slots.pop(0)
             req.admission_index = next(self._admission_counter)
             req.state = RequestState.RUNNING
+            req.phase = "prefill"
             self.running.append(req)
             admitted.append(req)
         return admitted
+
+    # ---------------------------------------------------- chunked prefill
+
+    def prefill_plan(self) -> List[Tuple[Request, int, int]]:
+        """Slice the running requests' outstanding context into prefill
+        chunks for THIS step, oldest-first, spending at most
+        `max_prefill_tokens_per_step` tokens total (None = unbounded, one
+        chunk per request). Returns (request, start, end) token ranges;
+        `end == request.num_context` marks the completing chunk whose
+        logits the engine samples from."""
+        budget = self.max_prefill_tokens_per_step
+        plan: List[Tuple[Request, int, int]] = []
+        for req in self.running:               # admission order = oldest
+            if req.phase != "prefill" or req.kv is None:
+                continue
+            remaining = req.num_context - req.kv.num_tokens
+            if remaining <= 0:                 # pragma: no cover — a
+                continue                       # prefill-phase req always
+            take = remaining                   # has outstanding tokens
+            if budget is not None:
+                take = min(take, budget)
+                if take <= 0:
+                    break
+            plan.append((req, req.kv.num_tokens, req.kv.num_tokens + take))
+            if budget is not None:
+                budget -= take
+                if budget <= 0:
+                    break
+        return plan
 
     # -------------------------------------------------------- preemption
 

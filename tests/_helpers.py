@@ -41,15 +41,26 @@ class StubPagedRunner:
         return row
 
     def prefill(self, tokens, table, pools):
+        return self.prefill_chunk(tokens, 0, table, pools)
+
+    def prefill_chunk(self, tokens, start_pos, table, pools):
+        """Write the chunk's tokens at positions [start_pos, ...) and hash
+        the FULL history as gathered from the pool — so a wrong shared
+        -prefix page, a stale chunk boundary, or a COW miss changes the
+        logits and breaks oracle equivalence."""
         import jax.numpy as jnp
         import numpy as np
 
         (k, v), = pools
         k = np.array(k)
         for i, t in enumerate(tokens):
-            page = int(table[i // self.block_size])
-            k[page, i % self.block_size, 0, 0] = float(t)
-        return (jnp.asarray(self._logits(tokens)),
+            p = start_pos + i
+            page = int(table[p // self.block_size])
+            k[page, p % self.block_size, 0, 0] = float(t)
+        end = start_pos + len(tokens)
+        hist = [k[int(table[i // self.block_size]),
+                  i % self.block_size, 0, 0] for i in range(end)]
+        return (jnp.asarray(self._logits(hist)),
                 [(jnp.asarray(k), v)])
 
     def decode(self, tokens, tables, pos, pools):

@@ -387,7 +387,7 @@ def test_auditor_catches_leaked_and_double_owned_pages():
     with pytest.raises(InvariantViolation):
         audit_engine(eng)
     eng.pool.allocator._free.remove(page)      # un-corrupt
-    eng.pool.allocator._allocated.add(page)
+    eng.pool.allocator._ref[page] = 1
     audit_engine(eng)
 
 

@@ -25,6 +25,13 @@ Fault classes:
 Exit code 0 iff, for every class: no exception escaped engine.step(),
 every request ended with an explicit finish_reason, and the pool/slot
 audit came back clean.
+
+ISSUE 3: the workload now runs with the shared-prefix page cache and
+chunked prefill enabled by default (--no-prefix-cache / --chunk 0 to
+disable) — half the requests share a common header — and the refcounted
+invariants are audited after EVERY step via PADDLE_TPU_SERVING_AUDIT.
+The leak check releases the cache first: a drained engine plus a cleared
+cache must return every page to the free list.
 """
 
 from __future__ import annotations
@@ -48,6 +55,8 @@ def build_engine(runner, args, **kw):
     kw.setdefault("max_step_retries", 2)
     kw.setdefault("retry_backoff_s", 0.001)
     kw.setdefault("audit", True)
+    kw.setdefault("enable_prefix_cache", args.prefix_cache)
+    kw.setdefault("max_prefill_tokens_per_step", args.chunk or None)
     return ServingEngine(runner, **kw)
 
 
@@ -84,9 +93,15 @@ def run_class(fault: str, runner, args) -> dict:
     rng = np.random.default_rng(0)
     vocab = runner.vocab_size
     n = args.requests * (2 if fault == "overload" else 1)
+    # half the workload shares a common header: with the prefix cache on,
+    # every fault class also exercises shared-page refcounts + COW paths
+    header = list(rng.integers(1, vocab, 9))
     work = []
     for i in range(n):
         prompt = list(rng.integers(1, vocab, int(rng.integers(4, 20))))
+        if i % 2:
+            prompt[:min(len(header), len(prompt) - 1)] = \
+                header[:len(prompt) - 1]
         sp = SamplingParams(max_tokens=int(rng.integers(3, args.max_tokens)),
                             timeout_s=timeout_s)
         work.append((eng.add_request(prompt, sp), prompt, sp))
@@ -102,6 +117,7 @@ def run_class(fault: str, runner, args) -> dict:
     for o in outs.values():
         reasons[o.finish_reason] = reasons.get(o.finish_reason, 0) + 1
     m = eng.metrics.snapshot()
+    eng.release_prefix_cache()      # cached-free pages back to the pool
     leaks_ok = eng.pool.allocator.check_no_leaks()
     slots_ok = sorted(eng.scheduler._free_slots) == list(range(args.max_batch))
 
@@ -133,6 +149,9 @@ def run_class(fault: str, runner, args) -> dict:
         "nan_logit_events": m["nan_logit_events"],
         "shed_requests": m["shed_requests"],
         "preemptions": m["preemptions"],
+        "prefix_hit_tokens": m["prefix_hit_tokens"],
+        "prefill_chunks": m["prefill_chunks"],
+        "cow_copies": m["cow_copies"],
         "injected": dict(getattr(target, "injected", {})) or None,
     }
 
@@ -150,7 +169,16 @@ def main() -> int:
     ap.add_argument("--error-every", type=int, default=5)
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--hidden", type=int, default=32)
+    ap.add_argument("--prefix-cache", dest="prefix_cache",
+                    action="store_true", default=True,
+                    help="shared-prefix KV page cache (default: on)")
+    ap.add_argument("--no-prefix-cache", dest="prefix_cache",
+                    action="store_false")
+    ap.add_argument("--chunk", type=int, default=16,
+                    help="max prefill tokens per step (0 = monolithic)")
     args = ap.parse_args()
+    # refcounted invariants audited after every step, engine-independent
+    os.environ["PADDLE_TPU_SERVING_AUDIT"] = "1"
 
     import paddle_tpu as paddle
     from paddle_tpu.models.llama import Llama, LlamaConfig
