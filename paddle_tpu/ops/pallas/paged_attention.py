@@ -137,3 +137,23 @@ def paged_decode_attention(q, k_pool, v_pool, block_table, pos, scale=None,
 def paged_decode_ok(h_dim: int) -> bool:
     """Kernel tiling gate: Mosaic needs the lane dim 8-aligned."""
     return h_dim % 8 == 0
+
+
+def best_paged_impl(head_dim: int, n_heads: int, n_kv_heads: int,
+                    q_len: int):
+    """Which paged Pallas kernel can serve this attention shape.
+
+    The dispatch gate for the serving runner (single source of truth, so
+    model_runner and the tests can't drift): the specialized single-token
+    MHA decode kernel above wins its exact shape; every other shape the
+    ragged kernel covers — GQA (n_rep > 1), chunked prefill (q_len > 1),
+    and mixed ragged batches. Returns "paged_decode" | "ragged" | None
+    (None = no kernel tiles; callers fall back to the gather path)."""
+    from paddle_tpu.ops.pallas.ragged_paged_attention import \
+        ragged_attention_ok
+
+    if q_len == 1 and n_heads == n_kv_heads and paged_decode_ok(head_dim):
+        return "paged_decode"
+    if ragged_attention_ok(head_dim, n_heads, n_kv_heads):
+        return "ragged"
+    return None

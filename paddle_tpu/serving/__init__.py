@@ -62,7 +62,7 @@ from paddle_tpu.serving.metrics import (  # noqa: F401
     Counter, EngineMetrics, Gauge, Histogram,
 )
 from paddle_tpu.serving.model_runner import (  # noqa: F401
-    GPTRunner, LlamaRunner, PagedModelRunner, runner_for,
+    GPTRunner, LlamaRunner, PagedModelRunner, bucket_len, runner_for,
 )
 from paddle_tpu.serving.resilience import (  # noqa: F401
     FaultInjector, InjectedDeviceError, InvariantViolation, QueueFullError,
@@ -79,6 +79,6 @@ __all__ = [
     "LlamaRunner", "PagedModelRunner", "PrefixCache", "QueueFullError",
     "Request", "RequestOutput", "RequestState", "SCRATCH_PAGE",
     "SamplingParams", "SequenceKV", "ServingEngine", "TokenEvent",
-    "audit_engine", "create_engine", "naive_generate", "page_content_hash",
-    "runner_for", "sample_token",
+    "audit_engine", "bucket_len", "create_engine", "naive_generate",
+    "page_content_hash", "runner_for", "sample_token",
 ]

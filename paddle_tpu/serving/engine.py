@@ -132,6 +132,13 @@ class ServingEngine:
       enable_prefix_cache  refcounted shared-prefix KV page cache with
                            copy-on-write (off by default: sharing changes
                            page-assignment traces, never tokens)
+      ragged_batch         collapse each step's prefill chunks AND its
+                           batched decode into ONE mixed ragged runner
+                           call (runner.ragged_step over the ragged
+                           paged-attention kernel) whenever a step has
+                           both; off by default — fusing changes the
+                           call trace (fault schedules, jit keys), never
+                           tokens (ISSUE 4)
     """
 
     def __init__(self, runner: PagedModelRunner, *, num_blocks: int,
@@ -146,6 +153,7 @@ class ServingEngine:
                  nan_policy: str = "abort",
                  max_prefill_tokens_per_step: Optional[int] = None,
                  enable_prefix_cache: bool = False,
+                 ragged_batch: bool = False,
                  sleep_fn: Optional[Callable[[float], None]] = None,
                  audit: Optional[bool] = None):
         self.runner = runner
@@ -173,6 +181,7 @@ class ServingEngine:
         if self.enable_prefix_cache:
             self.pool.enable_prefix_cache()
         self.max_prefill_tokens_per_step = max_prefill_tokens_per_step
+        self.ragged_batch = bool(ragged_batch)
         self.max_pages_per_seq = self.pool.blocks_for_tokens(
             self.max_model_len)
         self.scheduler = FCFSScheduler(self.pool, max_batch_size,
@@ -321,25 +330,40 @@ class ServingEngine:
             if req.kv.num_tokens:
                 self.metrics.prefix_hit_tokens.inc(req.kv.num_tokens)
 
-        # 2. prefill chunks, oldest-first, bounded per step by
-        #    max_prefill_tokens_per_step; the chunk completing a context
-        #    samples that request's next token (TTFT clock stops there)
-        for req, start, end in self.scheduler.prefill_plan():
-            ev = self._prefill_chunk_with_recovery(req, start, end)
-            if ev is not None:
-                events.append(ev)
-
-        # 3. decode-page reservation; pool pressure preempts youngest-first
-        victims = self.scheduler.reserve_decode()
-        for v in victims:
-            self.metrics.preemptions.inc()
-
-        # 4. one batched decode step over every decode-phase sequence
-        if self.scheduler.running:
-            events.extend(self._decode_with_recovery())
+        # 2-4. compute this step's spans. ragged_batch mode collapses the
+        # chunk-then-decode sequencing: when the step has BOTH prefill
+        # chunks and decode-phase requests, pages are reserved first and
+        # one mixed ragged runner call computes every span at once (the
+        # only timing difference vs sequential: a request completing its
+        # prefill inside the fused call decodes its first token NEXT
+        # step, since sampling needs this call's logits — token values
+        # are unchanged). Otherwise: chunks oldest-first under the token
+        # budget, then page reservation, then one batched decode.
+        fused = (self.ragged_batch and self.scheduler.prefill_plan()
+                 and self.scheduler.decode_ready())
+        if fused:
+            for v in self.scheduler.reserve_decode():
+                self.metrics.preemptions.inc()
+            events.extend(self._ragged_step_with_recovery())
+        else:
+            for req, start, end in self.scheduler.prefill_plan():
+                ev = self._prefill_chunk_with_recovery(req, start, end)
+                if ev is not None:
+                    events.append(ev)
+            # decode-page reservation; pool pressure preempts youngest-first
+            for v in self.scheduler.reserve_decode():
+                self.metrics.preemptions.inc()
+            # one batched decode step over every decode-phase sequence
+            if self.scheduler.running:
+                events.extend(self._decode_with_recovery())
         self.metrics.decode_steps.inc()
 
         # bookkeeping gauges
+        read = getattr(self.runner, "attn_kv_bytes_read", None)
+        if read is not None:
+            self.metrics.attn_kv_bytes_read.set(read)
+            self.metrics.attn_kv_bytes_gather.set(
+                self.runner.attn_kv_bytes_gather)
         a = self.pool.allocator
         self.metrics.queue_depth.set(self.scheduler.queue_depth)
         self.metrics.running.set(len(self.scheduler.running))
@@ -392,6 +416,89 @@ class ServingEngine:
         req.phase = "decode"
         return self._append_token(req, tok)
 
+    def _ragged_step_with_recovery(self) -> List[TokenEvent]:
+        """ONE mixed ragged runner call for this step: every planned
+        prefill chunk and every decode-phase request rides its batch
+        slot as a (start, q_len) span into runner.ragged_step, which the
+        ragged paged-attention kernel serves in a single launch (ISSUE
+        4). Transient failures retry the whole call with backoff (exact:
+        a failed attempt either never reached the device or re-writes
+        identical K/V through the same block tables — COW forks happen
+        before the call and are idempotent on retry); once retries are
+        exhausted the YOUNGEST spanning request is quarantined and the
+        batch is rebuilt, so the loop is bounded exactly like the
+        sequential decode path."""
+        from paddle_tpu.serving.model_runner import bucket_len
+
+        attempts = 0
+        delay = self.retry_backoff_s
+        while True:
+            # rebuild from live scheduler state each attempt: page
+            # reservation may have preempted, quarantine may have removed
+            spans = [(req, start, end, False)
+                     for req, start, end in self.scheduler.prefill_plan()]
+            spans += [(req, req.num_context - 1, req.num_context, True)
+                      for req in self.scheduler.decode_ready()]
+            if not spans:
+                return []
+            B = self.max_batch_size
+            P = self.max_pages_per_seq
+            T = bucket_len(max(end - start for _, start, end, _ in spans))
+            tokens = np.zeros((B, T), np.int32)
+            starts = np.zeros((B,), np.int32)
+            qlens = np.zeros((B,), np.int32)
+            tables = np.full((B, P), SCRATCH_PAGE, np.int32)
+            for req, start, end, is_dec in spans:
+                # no write may land on a shared page (idempotent: a
+                # forked page is already private when the call retries)
+                cow = req.kv.ensure_writable(start, end)
+                if cow:
+                    self.metrics.cow_copies.inc(cow)
+                s = req.slot
+                span_toks = (req.output_tokens[-1:] if is_dec
+                             else req.context_tokens[start:end])
+                tokens[s, :end - start] = span_toks
+                starts[s] = start
+                qlens[s] = end - start
+                tables[s, :len(req.kv.pages)] = req.kv.pages
+            try:
+                logits, new_pools = self.runner.ragged_step(
+                    tokens, tables, starts, qlens, self.pool.pools)
+                break
+            except Exception:
+                if attempts < self.max_step_retries:
+                    attempts += 1
+                    self.metrics.step_retries.inc()
+                    self._sleep(delay)
+                    delay *= 2
+                    continue
+                victim = max((r for r, *_ in spans),
+                             key=lambda r: r.admission_index)
+                self._finish_abnormal(victim, "error")
+                attempts = 0
+                delay = self.retry_backoff_s
+        self.pool.pools = new_pools
+        self.metrics.batch_occupancy.observe(len(spans))
+        logits_np = np.asarray(logits)
+        events = []
+        for req, start, end, is_dec in spans:
+            req.kv.num_tokens = req.num_context if is_dec else end
+            if not is_dec:
+                self.metrics.prefill_tokens.inc(end - start)
+                self.metrics.prefill_chunks.inc()
+            if self.pool.prefix_cache is not None:
+                self.pool.prefix_cache.register_seq(req.kv,
+                                                    req.context_tokens)
+            if is_dec or end == req.num_context:
+                tok = self._guarded_sample(logits_np[req.slot], req)
+                if tok is None:
+                    self._finish_abnormal(req, "error")
+                    continue
+                if not is_dec:
+                    req.phase = "decode"
+                events.append(self._append_token(req, tok))
+        return events
+
     def _decode_with_recovery(self) -> List[TokenEvent]:
         """One batched decode step with transient-failure recovery: retry
         with backoff; once retries are exhausted, quarantine the youngest
@@ -410,8 +517,7 @@ class ServingEngine:
         attempts = 0
         delay = self.retry_backoff_s
         while True:
-            batch = [r for r in self.scheduler.running_in_order()
-                     if r.phase == "decode"]
+            batch = self.scheduler.decode_ready()
             if not batch:
                 return []
             B = self.max_batch_size
@@ -566,6 +672,7 @@ class ServingEngine:
                 "max_prefill_tokens_per_step":
                     self.max_prefill_tokens_per_step,
                 "enable_prefix_cache": self.enable_prefix_cache,
+                "ragged_batch": self.ragged_batch,
             },
             "requests": reqs,
             "finished": [asdict(o) for o in self._outputs.values()],
@@ -597,6 +704,7 @@ class ServingEngine:
                   max_prefill_tokens_per_step=cfg.get(
                       "max_prefill_tokens_per_step"),
                   enable_prefix_cache=cfg.get("enable_prefix_cache", False),
+                  ragged_batch=cfg.get("ragged_batch", False),
                   metrics=metrics, sleep_fn=sleep_fn, audit=audit)
         ensure_arrival_counter_above(max(
             (r["arrival_index"] for r in state["requests"]), default=-1))

@@ -112,6 +112,13 @@ class EngineMetrics:
         self.queue_depth = Gauge("queue_depth")
         self.running = Gauge("running")
         self.prefix_cached_pages = Gauge("prefix_cached_pages")
+        # instrumented-pool counters (ISSUE 4), mirrored from the
+        # runner's host-side accounting each step: KV-pool bytes the
+        # chosen attention path actually touched vs what the gather
+        # reference path would have read for the same calls — the
+        # CPU-countable form of the ragged kernel's bandwidth win
+        self.attn_kv_bytes_read = Gauge("attn_kv_bytes_read")
+        self.attn_kv_bytes_gather = Gauge("attn_kv_bytes_gather")
         self.pool_used_pages = Gauge("pool_used_pages")
         self.pool_utilization = Gauge("pool_utilization")
         self.batch_occupancy = Histogram("batch_occupancy")
@@ -153,6 +160,8 @@ class EngineMetrics:
             "prefix_hit_tokens": self.prefix_hit_tokens.value,
             "cow_copies": self.cow_copies.value,
             "prefix_cached_pages": self.prefix_cached_pages.value,
+            "attn_kv_bytes_read": self.attn_kv_bytes_read.value,
+            "attn_kv_bytes_gather": self.attn_kv_bytes_gather.value,
             "decode_steps": self.decode_steps.value,
             "queue_depth": self.queue_depth.value,
             "queue_depth_peak": self.queue_depth.peak,

@@ -9,14 +9,25 @@ step functions over the shared page pool:
   prefill(tokens[1, T], table[1, P], real_len, pools) -> (logits[V], pools)
   prefill_chunk(tokens, start_pos, table, pools)      -> (logits[V], pools)
   decode(tokens[B, 1], tables[B, P], pos[B], pools)   -> (logits[B, V], pools)
+  ragged_step(tokens[B, T], tables, start[B], q_lens[B], pools)
+                                                      -> (logits[B, V], pools)
 
-Both steps write K/V through the block table and attend through either
-the Pallas paged-decode kernel (TPU, matched head counts, 8-aligned head
-dim) or the gather + dense-mask reference path — the same dual dispatch
-the kernels in ops/pallas use. Prefill lengths are padded to power-of-2
-buckets so the compile count stays logarithmic; padded positions write
-to the scratch page and their logits are never read. Dead decode slots
-carry all-scratch tables, so they self-neutralize without a mask.
+Every step writes K/V through the block table and attends through one of
+three statically-dispatched paths (`_attn_impl_for`, logged once per
+bucket): the ragged paged-attention Pallas kernel (ISSUE 4 — chunked
+prefill, GQA, and mixed chunk+decode batches straight off the page pool,
+O(live pages) HBM), the specialized single-token paged-decode kernel
+(its exact T==1/MHA shape), or the gather + dense-mask reference path
+(the CPU oracle; O(table width) HBM per call). `ragged_step` is the
+fused call the engine's ragged-batch mode feeds: each batch slot carries
+its own query span (decode=1 token, chunk=many, dead slot=0). The
+instrumented-pool counters (`attn_kv_bytes_read` / `attn_kv_bytes_gather`)
+account the pool bytes each dispatch actually touches vs what the gather
+path would have cost — host-side, so the bandwidth win is CPU-countable.
+Prefill lengths are padded to shared power-of-2 buckets (`bucket_len`)
+so the compile count stays logarithmic; padded positions write to the
+scratch page and their logits are never read. Dead decode slots carry
+all-scratch tables, so they self-neutralize without a mask.
 
 `prefill_chunk` (ISSUE 3) is the incremental spelling: it computes
 context positions [start_pos, start_pos + len(tokens)), attending over
@@ -48,30 +59,47 @@ from paddle_tpu.models.llama import _rope_tables
 from paddle_tpu.serving.kv_cache import SCRATCH_PAGE
 
 
-def _bucket_len(t: int, minimum: int = 8) -> int:
-    """Power-of-2 prefill bucket (compile once per bucket, not per len)."""
+def bucket_len(t: int, minimum: int = 8) -> int:
+    """Power-of-2 length bucket — the ONE bucket rule every step path
+    shares (prefill, chunked prefill, the fused ragged step): compile
+    once per bucket, not per length, and never duplicate jit-cache
+    entries across paths by rounding differently per call site (the
+    PADDLE_TPU_MAX_JIT_CACHE budget counts every entry)."""
     b = minimum
     while b < t:
         b *= 2
     return b
 
 
+_bucket_len = bucket_len          # pre-rename spelling (internal callers)
+
+
 def paged_attend(q, k_new, v_new, k_pool, v_pool, tables, write_page,
-                 write_off, pos_q, n_rep: int, use_pallas: bool):
+                 write_off, pos_q, q_len, n_rep: int, impl: str):
     """Write this step's K/V through the block table, then attend.
 
     q: [B, T, n_h, d]; k_new/v_new: [B, T, n_kv, d]; tables: [B, P];
-    write_page/write_off: [B, T] int32; pos_q: [B] position of q row 0.
-    Returns ([B, T, n_h*d], k_pool, v_pool)."""
+    write_page/write_off: [B, T] int32; pos_q: [B] context position of q
+    row 0; q_len: [B] live rows per span (rows past it are padding).
+    impl is the statically-resolved attention path ("reference" |
+    "paged_decode" | "ragged" — PagedModelRunner._attn_impl_for), baked
+    per jit entry. Returns ([B, T, n_h*d], k_pool, v_pool)."""
     k_pool = k_pool.at[write_page, write_off].set(k_new)
     v_pool = v_pool.at[write_page, write_off].set(v_new)
     B, T = q.shape[0], q.shape[1]
-    if use_pallas and T == 1 and n_rep == 1:
+    if impl == "paged_decode":
         from paddle_tpu.ops.pallas.paged_attention import \
             paged_decode_attention
 
         out = paged_decode_attention(q[:, 0], k_pool, v_pool, tables, pos_q)
         return out.reshape(B, 1, -1), k_pool, v_pool
+    if impl == "ragged":
+        from paddle_tpu.ops.pallas.ragged_paged_attention import \
+            ragged_paged_attention
+
+        out = ragged_paged_attention(q, k_pool, v_pool, tables, pos_q,
+                                     q_len)
+        return out.reshape(B, T, -1), k_pool, v_pool
     kg = paged_gather(k_pool, tables)
     vg = paged_gather(v_pool, tables)
     if n_rep > 1:  # GQA: repeat kv groups up to the query heads
@@ -95,15 +123,24 @@ class PagedModelRunner:
     head_dim: int
     vocab_size: int
 
+    ATTN_IMPLS = ("auto", "pallas", "ragged", "reference")
+
     def __init__(self, params: Dict[str, jnp.ndarray], block_size: int,
                  max_model_len: int, attn_impl: str = "auto"):
         self.params = params
         self.block_size = block_size
         self.max_model_len = max_model_len
-        if attn_impl not in ("auto", "pallas", "reference"):
-            raise ValueError(f"attn_impl={attn_impl!r}")
+        if attn_impl not in self.ATTN_IMPLS:
+            raise ValueError(f"attn_impl={attn_impl!r}; expected one of "
+                             f"{self.ATTN_IMPLS}")
         self.attn_impl = attn_impl
         self._jit_cache: "OrderedDict" = OrderedDict()
+        self._impl_logged: set = set()
+        # instrumented-pool counters: HBM bytes of KV pool the chosen
+        # attention path touches (host-side analytics, CPU-countable) vs
+        # what the gather path would have read for the same calls
+        self.attn_kv_bytes_read = 0.0
+        self.attn_kv_bytes_gather = 0.0
 
     @property
     def dtype(self):
@@ -113,15 +150,69 @@ class PagedModelRunner:
     def n_rep(self) -> int:
         return self.n_heads // self.n_kv_heads
 
-    def _resolve_pallas(self) -> bool:
-        if self.attn_impl == "pallas":
-            return True
-        if self.attn_impl == "reference":
-            return False
-        from paddle_tpu.ops.pallas.paged_attention import paged_decode_ok
+    # --------------------------------------------------------- dispatch
 
-        return (jax.default_backend() == "tpu" and self.n_rep == 1
-                and paged_decode_ok(self.head_dim))
+    def _attn_impl_for(self, q_len_bucket: int) -> str:
+        """Resolve the attention path for one (padded) query-span length.
+
+        Static per jit entry — called at trace time, where the span
+        bucket and head layout are known. "auto" prefers the specialized
+        single-token paged-decode kernel for its exact shape, then the
+        ragged kernel (GQA, q_len > 1, mixed spans), then the gather
+        reference; "pallas"/"ragged" force kernels (interpret mode off
+        TPU); "reference" forces the gather oracle. The chosen impl is
+        logged once per bucket so a serve's dispatch is auditable."""
+        from paddle_tpu.ops.pallas.paged_attention import best_paged_impl
+
+        if self.attn_impl == "reference":
+            impl = "reference"
+        else:
+            best = best_paged_impl(self.head_dim, self.n_heads,
+                                   self.n_kv_heads, q_len_bucket)
+            if self.attn_impl == "ragged":
+                from paddle_tpu.ops.pallas.ragged_paged_attention import \
+                    ragged_attention_ok
+
+                impl = ("ragged" if ragged_attention_ok(
+                    self.head_dim, self.n_heads, self.n_kv_heads)
+                    else "reference")
+            elif self.attn_impl == "pallas":
+                impl = best or "reference"
+            else:          # auto: kernels on TPU, gather oracle on CPU
+                impl = (best or "reference"
+                        if jax.default_backend() == "tpu" else "reference")
+        key = (q_len_bucket, impl)
+        if key not in self._impl_logged:
+            self._impl_logged.add(key)
+            logger.info(
+                "serving attention impl: %s (q_len bucket %d, heads %d/%d, "
+                "head_dim %d, attn_impl=%s)", impl, q_len_bucket,
+                self.n_heads, self.n_kv_heads, self.head_dim, self.attn_impl)
+        return impl
+
+    def _account_attn(self, impl: str, starts, q_lens, table_width: int):
+        """Bump the instrumented-pool counters for one step call: the
+        kernels read only each span's live pages (clamped index_map);
+        the gather path reads every table entry of every slot. Counted
+        host-side from the same operands the device call gets, so the
+        bandwidth claim is verifiable without TPU access."""
+        from paddle_tpu.ops.pallas.ragged_paged_attention import \
+            attention_page_reads
+
+        per_page = (2 * self.num_layers * self.block_size * self.n_kv_heads
+                    * self.head_dim * np.dtype(self.dtype).itemsize)
+        gather_pages = len(np.asarray(starts).reshape(-1)) * table_width
+        if impl in ("paged_decode", "ragged"):
+            pages = int(attention_page_reads(starts, q_lens,
+                                             self.block_size).sum())
+        else:
+            pages = gather_pages
+        self.attn_kv_bytes_read += pages * per_page
+        self.attn_kv_bytes_gather += gather_pages * per_page
+
+    def reset_attn_counters(self) -> None:
+        self.attn_kv_bytes_read = 0.0
+        self.attn_kv_bytes_gather = 0.0
 
     # ------------------------------------------------------------- steps
 
@@ -142,16 +233,37 @@ class PagedModelRunner:
         page, off = self._write_indices(positions, table, valid)
         logits, pools = self._forward(params, tokens, positions, page, off,
                                       table,
-                                      jnp.reshape(start_pos, (1,)), pools)
+                                      jnp.reshape(start_pos, (1,)),
+                                      jnp.reshape(real_len, (1,)), pools)
         return logits[0, real_len - 1], pools
 
     def _decode_step(self, params, tokens, tables, pos, pools):
         positions = pos[:, None].astype(jnp.int32)                 # [B, 1]
         valid = jnp.ones_like(positions, bool)  # dead slots: scratch tables
         page, off = self._write_indices(positions, tables, valid)
+        B = tokens.shape[0]
         logits, pools = self._forward(params, tokens, positions, page, off,
-                                      tables, pos, pools)
+                                      tables, pos,
+                                      jnp.ones((B,), jnp.int32), pools)
         return logits[:, 0], pools
+
+    def _ragged_step(self, params, tokens, tables, start_pos, q_lens,
+                     pools):
+        """One mixed ragged batch: every slot carries its own query span
+        — decode steps (q_len=1), prefill chunks (q_len=chunk at an
+        offset), dead slots (q_len=0) — computed in ONE forward pass.
+        Returns each slot's logits at its span's LAST live row (dead
+        slots return garbage that callers never read)."""
+        B, T = tokens.shape
+        offs = jnp.arange(T, dtype=jnp.int32)[None, :]             # [1, T]
+        valid = offs < q_lens[:, None]
+        positions = jnp.where(valid, start_pos[:, None] + offs, 0)
+        page, off = self._write_indices(positions, tables, valid)
+        logits, pools = self._forward(params, tokens, positions, page, off,
+                                      tables, start_pos, q_lens, pools)
+        last = jnp.maximum(q_lens - 1, 0).astype(jnp.int32)
+        out = jnp.take_along_axis(logits, last[:, None, None], axis=1)
+        return out[:, 0], pools
 
     def _jitted(self, kind: str, shape_key):
         """Shape-keyed jit cache. Every miss (= a compile) is logged, and
@@ -166,8 +278,9 @@ class PagedModelRunner:
             self._jit_cache.move_to_end(key)
             return cached
         fn = {"prefill": self._prefill_step,
-              "decode": self._decode_step}[kind]
-        pools_arg = {"prefill": 5, "decode": 4}[kind]
+              "decode": self._decode_step,
+              "ragged": self._ragged_step}[kind]
+        pools_arg = {"prefill": 5, "decode": 4, "ragged": 5}[kind]
         donate = (pools_arg,) if jax.default_backend() == "tpu" else ()
         jitted = jax.jit(fn, donate_argnums=donate)
         self._jit_cache[key] = jitted
@@ -196,9 +309,12 @@ class PagedModelRunner:
         Chunk lengths share the power-of-2 prefill buckets, so chunking
         never compiles per odd length."""
         t = len(tokens)
-        tb = _bucket_len(t)
+        tb = bucket_len(t)
         padded = np.zeros((1, tb), np.int32)
         padded[0, :t] = tokens
+        self._account_attn(self._attn_impl_for(tb),
+                           np.asarray([start_pos]), np.asarray([t]),
+                           len(table_row))
         fn = self._jitted("prefill", tb)
         return fn(self.params, jnp.asarray(padded),
                   jnp.asarray(np.asarray(table_row, np.int32)[None]),
@@ -207,12 +323,30 @@ class PagedModelRunner:
 
     def decode(self, tokens, tables, pos, pools):
         """Batched decode step; tokens [B], tables [B, P], pos [B]."""
+        pos_np = np.asarray(pos)
+        self._account_attn(self._attn_impl_for(1), pos_np,
+                           np.ones_like(pos_np),
+                           np.asarray(tables).shape[1])
         fn = self._jitted("decode", tokens.shape[0])
         return fn(self.params, jnp.asarray(tokens)[:, None],
                   jnp.asarray(tables), jnp.asarray(pos), pools)
 
+    def ragged_step(self, tokens, tables, start_pos, q_lens, pools):
+        """One mixed ragged batch (the fused chunk+decode step): tokens
+        [B, T] int (T pre-padded to a shared power-of-2 bucket by the
+        engine via `bucket_len`), tables [B, P], start_pos/q_lens [B].
+        Returns (logits [B, V] at each span's last live row, pools)."""
+        tokens = np.asarray(tokens, np.int32)
+        B, T = tokens.shape
+        self._account_attn(self._attn_impl_for(T), np.asarray(start_pos),
+                           np.asarray(q_lens), np.asarray(tables).shape[1])
+        fn = self._jitted("ragged", (B, T))
+        return fn(self.params, jnp.asarray(tokens), jnp.asarray(tables),
+                  jnp.asarray(np.asarray(start_pos, np.int32)),
+                  jnp.asarray(np.asarray(q_lens, np.int32)), pools)
+
     def _forward(self, params, tokens, positions, write_page, write_off,
-                 tables, pos_q, pools):
+                 tables, pos_q, q_lens, pools):
         raise NotImplementedError
 
 
@@ -253,11 +387,11 @@ class LlamaRunner(PagedModelRunner):
         return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
 
     def _forward(self, params, tokens, positions, write_page, write_off,
-                 tables, pos_q, pools):
+                 tables, pos_q, q_lens, pools):
         cfg = self.cfg
         B, T = tokens.shape
         d = self.head_dim
-        use_pallas = self._resolve_pallas()
+        impl = self._attn_impl_for(T)
         x = jnp.take(params["embed_tokens.weight"], tokens, axis=0)
         cos = jnp.take(self._rope_cos, positions, axis=0)   # [B, T, d]
         sin = jnp.take(self._rope_sin, positions, axis=0)
@@ -276,7 +410,7 @@ class LlamaRunner(PagedModelRunner):
             k = self._rope(k, cos, sin)
             out, kp, vp = paged_attend(
                 q, k, v, pools[i][0], pools[i][1], tables, write_page,
-                write_off, pos_q, self.n_rep, use_pallas)
+                write_off, pos_q, q_lens, self.n_rep, impl)
             x = x + out @ params[pre + "self_attn.o_proj.weight"]
             h = self._rms(x, params[pre + "post_attention_layernorm.weight"],
                           cfg.rms_eps)
@@ -313,11 +447,11 @@ class GPTRunner(PagedModelRunner):
         self.vocab_size = cfg.vocab_size
 
     def _forward(self, params, tokens, positions, write_page, write_off,
-                 tables, pos_q, pools):
+                 tables, pos_q, q_lens, pools):
         cfg = self.cfg
         B, T = tokens.shape
         d = self.head_dim
-        use_pallas = self._resolve_pallas()
+        impl = self._attn_impl_for(T)
         x = (jnp.take(params["wte.weight"], tokens, axis=0)
              + jnp.take(params["wpe.weight"], positions, axis=0))
         new_pools = []
@@ -329,7 +463,7 @@ class GPTRunner(PagedModelRunner):
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
             out, kp, vp = paged_attend(
                 q, k, v, pools[i][0], pools[i][1], tables, write_page,
-                write_off, pos_q, 1, use_pallas)
+                write_off, pos_q, q_lens, 1, impl)
             x = x + (out @ p["attn.out.weight"] + p["attn.out.bias"])
             h = _layer_norm(x, p["ln2.weight"], p["ln2.bias"])
             x = x + _mlp(p, h)

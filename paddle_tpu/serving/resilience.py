@@ -143,6 +143,19 @@ class FaultInjector:
             logits = self._corrupt(logits)
         return logits, pools
 
+    def ragged_step(self, tokens, tables, start_pos, q_lens, pools):
+        # the fused chunk+decode call (engine ragged_batch mode, ISSUE 4)
+        # IS the step's decode call site — it shares the "decode" op
+        # counter, so a decode fault schedule keeps firing when the
+        # engine collapses its sequencing into one ragged launch
+        n = self._pre("decode")
+        logits, pools = self._runner.ragged_step(tokens, tables, start_pos,
+                                                 q_lens, pools)
+        if self._hits(self._nan, "decode", n):
+            self.injected["nan"] += 1
+            logits = self._corrupt(logits)
+        return logits, pools
+
 
 def audit_engine(engine) -> None:
     """Assert page accounting, slot assignment, and block tables are
@@ -196,6 +209,16 @@ def audit_engine(engine) -> None:
         if req.kv.num_tokens > req.num_context:
             problems.append(f"{req.request_id} kv covers {req.kv.num_tokens}"
                             f" tokens > context {req.num_context}")
+        if req.phase not in ("prefill", "decode"):
+            problems.append(f"{req.request_id} unknown phase {req.phase!r}")
+        elif (req.phase == "decode"
+                and req.kv.num_tokens < req.num_context - 1):
+            # a decode-phase request may lag its context by exactly the
+            # token sampled this step (fused ragged steps flip the phase
+            # before the first decode), never by more
+            problems.append(
+                f"{req.request_id} decode-phase but kv covers only "
+                f"{req.kv.num_tokens} of {req.num_context} context tokens")
         need = engine.pool.blocks_for_tokens(max(1, req.kv.num_tokens))
         if len(req.kv.pages) < need:
             problems.append(

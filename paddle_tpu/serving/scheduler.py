@@ -255,6 +255,13 @@ class FCFSScheduler:
                     break
         return plan
 
+    def decode_ready(self) -> List[Request]:
+        """Decode-phase running requests in admission order — the spans
+        the batched decode step feeds, and the decode half of a fused
+        ragged step (engine ragged_batch mode: this step's prefill
+        chunks and these decodes ride ONE runner.ragged_step call)."""
+        return [r for r in self.running if r.phase == "decode"]
+
     # -------------------------------------------------------- preemption
 
     def reserve_decode(self) -> List[Request]:

@@ -32,6 +32,15 @@ disable) — half the requests share a common header — and the refcounted
 invariants are audited after EVERY step via PADDLE_TPU_SERVING_AUDIT.
 The leak check releases the cache first: a drained engine plus a cleared
 cache must return every page to the free list.
+
+ISSUE 4: the engine additionally runs with fused ragged batching on by
+default (--no-ragged-batch to disable): each step's prefill chunks and
+decodes ride ONE runner.ragged_step call, which FaultInjector wraps on
+the decode op counter — so every fault class also exercises the fused
+call site's retry/quarantine. --attn-impl picks the attention path
+(default "auto": kernels on TPU, gather oracle on CPU; "ragged" forces
+the ragged paged-attention kernel in interpret mode for a CPU-only
+kernel-path drill). Records report the attention-bytes counters.
 """
 
 from __future__ import annotations
@@ -57,6 +66,7 @@ def build_engine(runner, args, **kw):
     kw.setdefault("audit", True)
     kw.setdefault("enable_prefix_cache", args.prefix_cache)
     kw.setdefault("max_prefill_tokens_per_step", args.chunk or None)
+    kw.setdefault("ragged_batch", args.ragged_batch)
     return ServingEngine(runner, **kw)
 
 
@@ -152,6 +162,8 @@ def run_class(fault: str, runner, args) -> dict:
         "prefix_hit_tokens": m["prefix_hit_tokens"],
         "prefill_chunks": m["prefill_chunks"],
         "cow_copies": m["cow_copies"],
+        "attn_kv_bytes_read": m["attn_kv_bytes_read"],
+        "attn_kv_bytes_gather": m["attn_kv_bytes_gather"],
         "injected": dict(getattr(target, "injected", {})) or None,
     }
 
@@ -176,6 +188,16 @@ def main() -> int:
                     action="store_false")
     ap.add_argument("--chunk", type=int, default=16,
                     help="max prefill tokens per step (0 = monolithic)")
+    ap.add_argument("--ragged-batch", dest="ragged_batch",
+                    action="store_true", default=True,
+                    help="fused chunk+decode ragged steps (default: on)")
+    ap.add_argument("--no-ragged-batch", dest="ragged_batch",
+                    action="store_false")
+    ap.add_argument("--attn-impl", default="auto",
+                    choices=("auto", "pallas", "ragged", "reference"),
+                    help="attention path (auto: kernels on TPU, gather "
+                         "oracle on CPU; ragged: force the ragged "
+                         "paged-attention kernel, interpret mode off-TPU)")
     args = ap.parse_args()
     # refcounted invariants audited after every step, engine-independent
     os.environ["PADDLE_TPU_SERVING_AUDIT"] = "1"
@@ -194,7 +216,8 @@ def main() -> int:
     # one shared runner: the fault classes reuse its jit cache, so only
     # the first class pays compile time (engines/pools stay per-class)
     runner = LlamaRunner(model, block_size=args.block_size,
-                         max_model_len=args.max_model_len)
+                         max_model_len=args.max_model_len,
+                         attn_impl=args.attn_impl)
     # warm the prefill buckets + decode step so deadline-sensitive classes
     # (stall) measure steps, not compiles
     import numpy as np
