@@ -26,6 +26,17 @@ paged-decode kernel (`ops/pallas/paged_attention.py`):
                    conditions, token streaming, plus `naive_generate`,
                    the sequential oracle continuous batching must match
                    token-for-token;
+  speculate.py     NgramProposer (ISSUE 5): model-free prompt-lookup
+                   draft proposals mined from the request's own context;
+                   the engine verifies all k+1 span positions in ONE
+                   fused ragged launch and accepts the longest draft
+                   prefix the target model reproduces — several tokens
+                   per engine step on repetition-heavy workloads,
+                   token-exact vs naive_generate by construction;
+  detokenize.py    StreamDetokenizer (ISSUE 5): incremental streaming
+                   detokenization over TokenEvents, buffering raw bytes
+                   to byte-complete UTF-8 boundaries
+                   (engine.stream_text(request_id));
   metrics.py       queue depth, TTFT, tokens/s, pool utilization,
                    preemption counters for bench.py's serving sweep —
                    plus the failure-side instruments (timeouts, aborts,
@@ -50,9 +61,12 @@ bridge from the Predictor world; `tools/serving_smoke.py` is a runnable
 demo; `bench.py --child serving:...` drives the offered-load sweep.
 """
 
+from paddle_tpu.serving.detokenize import (  # noqa: F401
+    StreamDetokenizer, complete_utf8_prefix,
+)
 from paddle_tpu.serving.engine import (  # noqa: F401
-    RequestOutput, ServingEngine, TokenEvent, create_engine, naive_generate,
-    sample_token,
+    RequestOutput, ServingEngine, TokenEvent, create_engine, greedy_grid,
+    naive_generate, sample_token,
 )
 from paddle_tpu.serving.kv_cache import (  # noqa: F401
     BlockAllocator, KVCachePool, PrefixCache, SCRATCH_PAGE, SequenceKV,
@@ -71,14 +85,16 @@ from paddle_tpu.serving.resilience import (  # noqa: F401
 from paddle_tpu.serving.scheduler import (  # noqa: F401
     FCFSScheduler, Request, RequestState, SamplingParams,
 )
+from paddle_tpu.serving.speculate import NgramProposer  # noqa: F401
 
 __all__ = [
     "BlockAllocator", "Counter", "EngineMetrics", "FCFSScheduler",
     "FaultInjector", "GPTRunner", "Gauge", "Histogram",
     "InjectedDeviceError", "InvariantViolation", "KVCachePool",
-    "LlamaRunner", "PagedModelRunner", "PrefixCache", "QueueFullError",
-    "Request", "RequestOutput", "RequestState", "SCRATCH_PAGE",
-    "SamplingParams", "SequenceKV", "ServingEngine", "TokenEvent",
-    "audit_engine", "bucket_len", "create_engine", "naive_generate",
-    "page_content_hash", "runner_for", "sample_token",
+    "LlamaRunner", "NgramProposer", "PagedModelRunner", "PrefixCache",
+    "QueueFullError", "Request", "RequestOutput", "RequestState",
+    "SCRATCH_PAGE", "SamplingParams", "SequenceKV", "ServingEngine",
+    "StreamDetokenizer", "TokenEvent", "audit_engine", "bucket_len",
+    "complete_utf8_prefix", "create_engine", "greedy_grid",
+    "naive_generate", "page_content_hash", "runner_for", "sample_token",
 ]

@@ -1,0 +1,115 @@
+"""Incremental streaming detokenization over TokenEvents (ISSUE 5).
+
+The engine's streaming surface is token ids (`TokenEvent` per step). A
+text client cannot naively `decode()` each token as it arrives: byte-
+level tokenizers (BPE over UTF-8) routinely split one multi-byte
+character across SEVERAL tokens, so a per-token decode emits mojibake
+(replacement characters) at every split point. The fix every serving
+stack ships (the reference's PaddleNLP streamers, HF's
+`TextIteratorStreamer`) is an incremental detokenizer that buffers raw
+bytes until a byte-complete boundary — no dangling UTF-8 lead/
+continuation bytes — and only then releases text.
+
+`StreamDetokenizer` is that shim, minimal on purpose: it needs only a
+token→bytes mapping from the tokenizer (``id_to_bytes(tok) -> bytes``
+preferred; falls back to ``decode([tok])``), keeps one pending-bytes
+buffer, and is driven either token-by-token (``push``) or straight off
+the engine's event stream (``push_event``). ``ServingEngine.stream_text``
+wraps one per request.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+def complete_utf8_prefix(buf: bytes) -> int:
+    """Length of the longest prefix of ``buf`` that does not end in the
+    middle of a multi-byte UTF-8 character. Malformed tails (stray
+    continuation bytes, over-long runs) are treated as complete — the
+    decode step will substitute replacement characters for them, which
+    is the correct surface for genuinely broken token bytes."""
+    i = len(buf)
+    j = i
+    while j > 0 and i - j < 3 and (buf[j - 1] & 0xC0) == 0x80:
+        j -= 1                       # skip trailing continuation bytes
+    if j == 0:
+        return i                     # all continuations: malformed, emit
+    lead = buf[j - 1]
+    if lead < 0x80:
+        return i                     # ASCII tail: complete
+    if lead >= 0xF0:
+        need = 4
+    elif lead >= 0xE0:
+        need = 3
+    elif lead >= 0xC0:
+        need = 2
+    else:
+        return i                     # stray continuation byte: emit
+    return i if i - (j - 1) >= need else j - 1
+
+
+def token_bytes(tokenizer, tok: int) -> bytes:
+    """Raw bytes of one token id. Prefers ``id_to_bytes`` (byte-level
+    tokenizers can represent partial UTF-8 sequences there); falls back
+    to ``decode([tok])`` (str or bytes)."""
+    if hasattr(tokenizer, "id_to_bytes"):
+        return bytes(tokenizer.id_to_bytes(int(tok)))
+    out = tokenizer.decode([int(tok)])
+    return out if isinstance(out, bytes) else str(out).encode("utf-8")
+
+
+class StreamDetokenizer:
+    """Per-request incremental detokenizer.
+
+    d = StreamDetokenizer(tokenizer)
+    d.push(tok)        # -> newly completed text ('' while buffering)
+    d.push_event(ev)   # same, driven by a TokenEvent (flushes on finish)
+    d.finish()         # flush the remainder (errors -> U+FFFD)
+    d.text             # everything emitted so far
+    d.consumed         # tokens pushed so far (engine resume cursor)
+    """
+
+    def __init__(self, tokenizer):
+        self.tokenizer = tokenizer
+        self._pending = b""
+        self._parts: List[str] = []
+        self.consumed = 0
+        self.finished = False
+
+    @property
+    def text(self) -> str:
+        return "".join(self._parts)
+
+    def push(self, tok: int) -> str:
+        """Feed one token; returns the text newly released by it (the
+        maximal byte-complete prefix of the pending buffer)."""
+        if self.finished:
+            raise ValueError("push() after finish()")
+        self.consumed += 1
+        self._pending += token_bytes(self.tokenizer, tok)
+        cut = complete_utf8_prefix(self._pending)
+        if not cut:
+            return ""
+        out = self._pending[:cut].decode("utf-8", errors="replace")
+        self._pending = self._pending[cut:]
+        self._parts.append(out)
+        return out
+
+    def push_event(self, event) -> str:
+        """Feed one engine TokenEvent; a finished event also flushes."""
+        out = self.push(event.token)
+        if getattr(event, "finished", False):
+            out += self.finish()
+        return out
+
+    def finish(self) -> str:
+        """End of stream: release whatever is buffered, replacing any
+        incomplete trailing sequence (the stream ended mid-character)."""
+        self.finished = True
+        if not self._pending:
+            return ""
+        out = self._pending.decode("utf-8", errors="replace")
+        self._pending = b""
+        self._parts.append(out)
+        return out

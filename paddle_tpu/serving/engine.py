@@ -19,11 +19,22 @@ shared page forks it first (copy-on-write, cow_copies metric) — so
 shared few-shot headers, preemption recompute-on-resume, and
 crash-restore become mostly cache hits while staying token-exact.
 
+With `num_speculative_tokens > 0` (ISSUE 5) decode stops paying one
+engine step per token: a model-free n-gram prompt-lookup proposer drafts
+up to k continuation tokens from the request's own context, one fused
+`runner.ragged_step(full_logits=True)` launch scores all k+1 span
+positions against the paged pools, and the longest draft prefix the
+target model reproduces (argmax equality under greedy; the seeded step-
+indexed sample under temperature > 0) is accepted at once — rejected-
+tail KV rolls back through the refcount machinery (`SequenceKV.truncate`
++ page decref) so a speculated page never leaks or corrupts the prefix
+cache.
+
 The engine is deterministic end-to-end: FCFS admission, sorted-free-list
 pages, greedy (or seeded per-request) sampling, step-indexed sample keys
 that survive preemption. `naive_generate` is the scheduling oracle: the
 same runner, one request at a time, no scheduler — continuous batching
-must reproduce its tokens exactly.
+(speculation included) must reproduce its tokens exactly.
 
 Every failure mode has a defined outcome (ISSUE 2 hardening); no step()
 raises for load- or fault-induced conditions:
@@ -55,6 +66,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu.serving.detokenize import StreamDetokenizer
 from paddle_tpu.serving.kv_cache import KVCachePool, SCRATCH_PAGE
 from paddle_tpu.serving.metrics import EngineMetrics
 from paddle_tpu.serving.model_runner import PagedModelRunner, runner_for
@@ -63,6 +75,7 @@ from paddle_tpu.serving.scheduler import (
     FCFSScheduler, Request, RequestState, SamplingParams,
     ensure_arrival_counter_above,
 )
+from paddle_tpu.serving.speculate import NgramProposer
 
 
 @dataclass
@@ -104,6 +117,19 @@ def sample_token(logits_row: np.ndarray, sampling: SamplingParams,
     return int(np.asarray(tok)[0])
 
 
+def greedy_grid(logits):
+    """Vectorized device-side greedy pass (ISSUE 5 satellite): ONE argmax
+    and ONE finiteness reduction over a [..., V] logits array, computed
+    where the logits live, then two tiny host transfers (ints/bools, no
+    vocab axis). The full array only crosses to host afterwards when a
+    row actually needs it — temperature > 0 sampling, or a NaN rescue
+    under nan_policy="greedy". Tie-breaking matches np.argmax (first max
+    wins), which the batched-sampling pin test asserts against the
+    host path `sample_token` / `naive_generate` use."""
+    return (np.asarray(jnp.argmax(logits, axis=-1)),
+            np.asarray(jnp.all(jnp.isfinite(logits), axis=-1)))
+
+
 class ServingEngine:
     """Continuous-batching LLM serving over a paged KV cache.
 
@@ -139,6 +165,25 @@ class ServingEngine:
                            both; off by default — fusing changes the
                            call trace (fault schedules, jit keys), never
                            tokens (ISSUE 4)
+      num_speculative_tokens
+                           speculative decoding (ISSUE 5): up to this
+                           many n-gram prompt-lookup draft tokens ride
+                           each decode request's span into one fused
+                           verify launch (runner.ragged_step scoring all
+                           k+1 positions); the longest draft prefix the
+                           target model agrees with is accepted in one
+                           engine step, rejected-tail KV is rolled back
+                           through the refcount machinery. 0 = off.
+                           Token streams stay EXACTLY naive_generate's:
+                           greedy acceptance is argmax equality, and
+                           temperature > 0 compares the draft against
+                           the request's seeded step-indexed sample.
+      spec_max_ngram /     suffix n-gram lengths the draft proposer
+      spec_min_ngram       matches (longest first, most recent wins)
+      tokenizer            optional tokenizer (id_to_bytes(tok) or
+                           decode([tok])) enabling stream_text():
+                           incremental detokenization that buffers
+                           until a byte-complete UTF-8 boundary
     """
 
     def __init__(self, runner: PagedModelRunner, *, num_blocks: int,
@@ -154,6 +199,10 @@ class ServingEngine:
                  max_prefill_tokens_per_step: Optional[int] = None,
                  enable_prefix_cache: bool = False,
                  ragged_batch: bool = False,
+                 num_speculative_tokens: int = 0,
+                 spec_max_ngram: int = 3,
+                 spec_min_ngram: int = 1,
+                 tokenizer=None,
                  sleep_fn: Optional[Callable[[float], None]] = None,
                  audit: Optional[bool] = None):
         self.runner = runner
@@ -182,6 +231,19 @@ class ServingEngine:
             self.pool.enable_prefix_cache()
         self.max_prefill_tokens_per_step = max_prefill_tokens_per_step
         self.ragged_batch = bool(ragged_batch)
+        if num_speculative_tokens < 0:
+            raise ValueError("num_speculative_tokens must be >= 0 (0 = "
+                             "speculation off)")
+        self.num_speculative_tokens = int(num_speculative_tokens)
+        self.spec_max_ngram = int(spec_max_ngram)
+        self.spec_min_ngram = int(spec_min_ngram)
+        # the proposer validates the n-gram range; built lazily-but-eager
+        # here so a bad knob combination fails at construction time
+        self.proposer = (NgramProposer(self.spec_max_ngram,
+                                       self.spec_min_ngram)
+                         if self.num_speculative_tokens else None)
+        self.tokenizer = tokenizer
+        self._detoks: Dict[str, StreamDetokenizer] = {}
         self.max_pages_per_seq = self.pool.blocks_for_tokens(
             self.max_model_len)
         self.scheduler = FCFSScheduler(self.pool, max_batch_size,
@@ -292,19 +354,38 @@ class ServingEngine:
             if t is not None and now - req.arrival_time >= t:
                 self._finish_abnormal(req, "timeout")
 
-    def _guarded_sample(self, logits_row: np.ndarray,
-                        req: Request) -> Optional[int]:
-        """Sample with the NaN/Inf guard. Returns None when the request
-        must be aborted (nan_policy="abort", or no finite logit exists)."""
-        row = np.asarray(logits_row)
-        finite = np.isfinite(row)
-        if not finite.all():
+    def _resolve_token(self, req: Request, step: int, greedy_tok, finite,
+                       row_fn: Callable[[], np.ndarray]) -> Optional[int]:
+        """NaN/Inf-guarded token for ONE logits row, fed from a
+        `greedy_grid` pass over the whole batch (ISSUE 5 satellite: the
+        greedy/finite-guard path is vectorized device-side; `row_fn`
+        lazily fetches the actual [V] row only for temperature > 0
+        sampling or a NaN rescue). Returns None when the request must be
+        aborted (nan_policy="abort", or no finite logit exists). The
+        seeded temperature path is untouched — per-request step-indexed
+        streams stay bit-identical."""
+        if not finite:
             self.metrics.nan_logit_events.inc()
-            if self.nan_policy == "greedy" and finite.any():
-                return int(np.argmax(np.where(finite, row, -np.inf)))
+            if self.nan_policy == "greedy":
+                row = np.asarray(row_fn())
+                ok = np.isfinite(row)
+                if ok.any():
+                    return int(np.argmax(np.where(ok, row, -np.inf)))
             return None
-        return sample_token(row, req.sampling, len(req.output_tokens),
+        if req.sampling.temperature == 0.0:
+            return int(greedy_tok)
+        return sample_token(np.asarray(row_fn()), req.sampling, step,
                             req.arrival_index)
+
+    def _guarded_sample(self, logits_row, req: Request,
+                        step: Optional[int] = None) -> Optional[int]:
+        """Single-row spelling of the guarded sampler (the completing-
+        chunk call site): same greedy_grid pass, scalar-shaped."""
+        am, fin = greedy_grid(logits_row)
+        if step is None:
+            step = len(req.output_tokens)
+        return self._resolve_token(req, step, am, fin,
+                                   lambda: np.asarray(logits_row))
 
     # ------------------------------------------------------------- step
 
@@ -339,9 +420,30 @@ class ServingEngine:
         # step, since sampling needs this call's logits — token values
         # are unchanged). Otherwise: chunks oldest-first under the token
         # budget, then page reservation, then one batched decode.
-        fused = (self.ragged_batch and self.scheduler.prefill_plan()
-                 and self.scheduler.decode_ready())
-        if fused:
+        #
+        # num_speculative_tokens > 0 (ISSUE 5) reroutes the decode half
+        # through verify spans: each decode request feeds its last token
+        # PLUS an n-gram draft (q_len = 1+k) into one full-logits ragged
+        # launch, accepting the longest draft prefix the target model
+        # reproduces — several tokens per engine step when drafts hit.
+        # Chunks fuse into the same launch under ragged_batch, otherwise
+        # they keep the sequential chunk-then-decode sequencing.
+        plan = self.scheduler.prefill_plan()
+        fused = bool(self.ragged_batch and plan
+                     and self.scheduler.decode_ready())
+        if self.num_speculative_tokens > 0 and self.scheduler.decode_ready():
+            chunk_tokens = sum(end - start for _, start, end in plan)
+            if not fused:
+                for req, start, end in plan:
+                    ev = self._prefill_chunk_with_recovery(req, start, end)
+                    if ev is not None:
+                        events.append(ev)
+            for v in self.scheduler.reserve_decode():
+                self.metrics.preemptions.inc()
+            proposals = self._plan_speculation(chunk_tokens)
+            events.extend(self._ragged_step_with_recovery(
+                proposals, include_chunks=fused))
+        elif fused:
             for v in self.scheduler.reserve_decode():
                 self.metrics.preemptions.inc()
             events.extend(self._ragged_step_with_recovery())
@@ -409,36 +511,76 @@ class ServingEngine:
             self.pool.prefix_cache.register_seq(req.kv, req.context_tokens)
         if end < req.num_context:
             return None              # intermediate chunk: logits unread
-        tok = self._guarded_sample(np.asarray(logits), req)
+        tok = self._guarded_sample(logits, req)
         if tok is None:
             self._finish_abnormal(req, "error")
             return None
         req.phase = "decode"
         return self._append_token(req, tok)
 
-    def _ragged_step_with_recovery(self) -> List[TokenEvent]:
+    def _plan_speculation(self, chunk_tokens: int) -> Dict[Request,
+                                                           List[int]]:
+        """n-gram draft proposals for this step's decode batch (ISSUE 5),
+        capped in admission order by (a) the request's own remaining-
+        token headroom (at most max_tokens - generated - 1 drafts: the
+        bonus/corrected token always fits) and model-length headroom,
+        (b) the scheduler's leftover per-step token budget — verify
+        spans count against max_prefill_tokens_per_step exactly like
+        prefill chunks — and (c) best-effort page reservation: under
+        pool pressure a proposal shrinks instead of preempting anyone."""
+        budget = self.scheduler.speculation_budget(chunk_tokens)
+        proposals: Dict[Request, List[int]] = {}
+        for req in self.scheduler.decode_ready():      # admission order
+            k = self.num_speculative_tokens
+            k = min(k, req.sampling.max_tokens - len(req.output_tokens) - 1)
+            k = min(k, self.max_model_len - req.num_context)
+            if budget is not None:
+                k = min(k, budget)
+            if k <= 0:
+                continue
+            prop = self.proposer.propose(req.context_tokens, k)
+            if not prop:
+                continue
+            if budget is not None:
+                budget -= len(prop)
+            proposals[req] = prop
+        self.scheduler.reserve_speculation(proposals)
+        return proposals
+
+    def _ragged_step_with_recovery(
+            self, proposals: Optional[Dict[Request, List[int]]] = None,
+            include_chunks: bool = True) -> List[TokenEvent]:
         """ONE mixed ragged runner call for this step: every planned
         prefill chunk and every decode-phase request rides its batch
         slot as a (start, q_len) span into runner.ragged_step, which the
         ragged paged-attention kernel serves in a single launch (ISSUE
-        4). Transient failures retry the whole call with backoff (exact:
-        a failed attempt either never reached the device or re-writes
-        identical K/V through the same block tables — COW forks happen
-        before the call and are idempotent on retry); once retries are
-        exhausted the YOUNGEST spanning request is quarantined and the
-        batch is rebuilt, so the loop is bounded exactly like the
-        sequential decode path."""
+        4). With `proposals` (speculative decoding, ISSUE 5) each decode
+        span stretches to q_len = 1 + k — the fed last token plus its
+        n-gram draft — and the call asks the runner for FULL per-position
+        logits so `_accept_verify` can score every draft position off
+        the single launch. Transient failures retry the whole call with
+        backoff (exact: a failed attempt either never reached the device
+        or re-writes identical K/V through the same block tables — COW
+        forks happen before the call and are idempotent on retry); once
+        retries are exhausted the YOUNGEST spanning request is
+        quarantined and the batch is rebuilt, so the loop is bounded
+        exactly like the sequential decode path."""
         from paddle_tpu.serving.model_runner import bucket_len
 
+        full = proposals is not None
         attempts = 0
         delay = self.retry_backoff_s
         while True:
             # rebuild from live scheduler state each attempt: page
             # reservation may have preempted, quarantine may have removed
-            spans = [(req, start, end, False)
-                     for req, start, end in self.scheduler.prefill_plan()]
-            spans += [(req, req.num_context - 1, req.num_context, True)
-                      for req in self.scheduler.decode_ready()]
+            spans = []
+            if include_chunks:
+                spans += [(req, start, end, None) for req, start, end
+                          in self.scheduler.prefill_plan()]
+            for req in self.scheduler.decode_ready():
+                prop = proposals.get(req, []) if full else []
+                spans.append((req, req.num_context - 1,
+                              req.num_context + len(prop), prop))
             if not spans:
                 return []
             B = self.max_batch_size
@@ -448,22 +590,27 @@ class ServingEngine:
             starts = np.zeros((B,), np.int32)
             qlens = np.zeros((B,), np.int32)
             tables = np.full((B, P), SCRATCH_PAGE, np.int32)
-            for req, start, end, is_dec in spans:
+            for req, start, end, prop in spans:
                 # no write may land on a shared page (idempotent: a
                 # forked page is already private when the call retries)
                 cow = req.kv.ensure_writable(start, end)
                 if cow:
                     self.metrics.cow_copies.inc(cow)
                 s = req.slot
-                span_toks = (req.output_tokens[-1:] if is_dec
-                             else req.context_tokens[start:end])
+                span_toks = (req.context_tokens[start:end] if prop is None
+                             else req.output_tokens[-1:] + list(prop))
                 tokens[s, :end - start] = span_toks
                 starts[s] = start
                 qlens[s] = end - start
                 tables[s, :len(req.kv.pages)] = req.kv.pages
             try:
-                logits, new_pools = self.runner.ragged_step(
-                    tokens, tables, starts, qlens, self.pool.pools)
+                if full:
+                    logits, new_pools = self.runner.ragged_step(
+                        tokens, tables, starts, qlens, self.pool.pools,
+                        full_logits=True)
+                else:
+                    logits, new_pools = self.runner.ragged_step(
+                        tokens, tables, starts, qlens, self.pool.pools)
                 break
             except Exception:
                 if attempts < self.max_step_retries:
@@ -479,25 +626,114 @@ class ServingEngine:
                 delay = self.retry_backoff_s
         self.pool.pools = new_pools
         self.metrics.batch_occupancy.observe(len(spans))
-        logits_np = np.asarray(logits)
-        events = []
-        for req, start, end, is_dec in spans:
-            req.kv.num_tokens = req.num_context if is_dec else end
-            if not is_dec:
+        # vectorized greedy/finite pass over the whole call's logits
+        # ([B, V] or [B, T, V]); rows transfer lazily only when needed
+        am, fin = greedy_grid(logits)
+        host: Dict[str, np.ndarray] = {}
+
+        def _rows() -> np.ndarray:
+            if "l" not in host:
+                host["l"] = np.asarray(logits)
+            return host["l"]
+
+        events: List[TokenEvent] = []
+        for req, start, end, prop in spans:
+            if prop is None:                    # prefill chunk span
+                req.kv.num_tokens = end
                 self.metrics.prefill_tokens.inc(end - start)
                 self.metrics.prefill_chunks.inc()
-            if self.pool.prefix_cache is not None:
-                self.pool.prefix_cache.register_seq(req.kv,
-                                                    req.context_tokens)
-            if is_dec or end == req.num_context:
-                tok = self._guarded_sample(logits_np[req.slot], req)
+                if self.pool.prefix_cache is not None:
+                    self.pool.prefix_cache.register_seq(req.kv,
+                                                        req.context_tokens)
+                if end == req.num_context:      # completing chunk
+                    s, r = req.slot, end - start - 1
+                    if full:
+                        tok = self._resolve_token(
+                            req, len(req.output_tokens), am[s, r],
+                            fin[s, r], lambda s=s, r=r: _rows()[s, r])
+                    else:
+                        tok = self._resolve_token(
+                            req, len(req.output_tokens), am[s], fin[s],
+                            lambda s=s: _rows()[s])
+                    if tok is None:
+                        self._finish_abnormal(req, "error")
+                        continue
+                    req.phase = "decode"
+                    events.append(self._append_token(req, tok))
+            elif not full:                      # plain fused decode
+                req.kv.num_tokens = req.num_context
+                if self.pool.prefix_cache is not None:
+                    self.pool.prefix_cache.register_seq(req.kv,
+                                                        req.context_tokens)
+                s = req.slot
+                tok = self._resolve_token(req, len(req.output_tokens),
+                                          am[s], fin[s],
+                                          lambda s=s: _rows()[s])
                 if tok is None:
                     self._finish_abnormal(req, "error")
                     continue
-                if not is_dec:
-                    req.phase = "decode"
                 events.append(self._append_token(req, tok))
+            else:                               # verify span (ISSUE 5)
+                s = req.slot
+                self._accept_verify(
+                    req, prop, am[s], fin[s],
+                    lambda i, s=s: _rows()[s, i], events)
         return events
+
+    def _accept_verify(self, req: Request, prop: List[int], row_am,
+                       row_fin, row_fn, events: List[TokenEvent]) -> None:
+        """Token-exact accept loop for one verify span (ISSUE 5
+        tentpole). Span position i scored the logits for the token AFTER
+        context + prop[:i]; the target token there is resolved with the
+        request's own step-indexed sampler — argmax under greedy, the
+        seeded per-step sample stream under temperature > 0, exactly the
+        keys naive_generate uses — so acceptance means "the draft token
+        IS the token the target model would have emitted". The longest
+        matching draft prefix is accepted, then the first divergent
+        position contributes its corrected token (or the bonus token
+        after a fully-accepted draft). The rejected tail's KV state is
+        rolled back before any append can finish the request: coverage
+        truncates to the accepted prefix and pages grown only for the
+        rejected span are decref'd — a speculated page never survives
+        its rejection (the auditor's over-provision check pins it)."""
+        k = len(prop)
+        o = len(req.output_tokens)
+        C = req.num_context
+        toks: List[int] = []
+        accepted = 0
+        aborted = False
+        for i in range(k + 1):
+            tok = self._resolve_token(req, o + i, row_am[i], row_fin[i],
+                                      lambda i=i: row_fn(i))
+            if tok is None:
+                aborted = True
+                break
+            toks.append(tok)
+            matched = i < k and int(prop[i]) == tok
+            if matched:
+                accepted += 1
+            done = (tok in req.sampling.stop_token_ids
+                    or o + len(toks) >= req.sampling.max_tokens)
+            if done or not matched:
+                break
+        self.metrics.spec_proposed_tokens.inc(k)
+        self.metrics.spec_accepted_tokens.inc(accepted)
+        # positions C..C+accepted-1 hold accepted-draft KV; the rejected
+        # tail [C+accepted, C+k) is dead weight — roll it back through
+        # the refcount machinery, then register/append
+        req.kv.num_tokens = C + accepted
+        dropped = req.kv.truncate(C + accepted)
+        if dropped:
+            self.metrics.spec_rollback_pages.inc(dropped)
+        if self.pool.prefix_cache is not None:
+            self.pool.prefix_cache.register_seq(
+                req.kv, req.context_tokens + toks[:accepted])
+        for t in toks:
+            events.append(self._append_token(req, t))
+            if req.done:
+                break
+        if aborted and not req.done:
+            self._finish_abnormal(req, "error")
 
     def _decode_with_recovery(self) -> List[TokenEvent]:
         """One batched decode step with transient-failure recovery: retry
@@ -552,14 +788,25 @@ class ServingEngine:
                 delay = self.retry_backoff_s
         self.pool.pools = new_pools
         self.metrics.batch_occupancy.observe(len(batch))
-        logits_np = np.asarray(logits)
+        # one vectorized greedy/finite pass for the whole batch; the
+        # [B, V] array only reaches the host for temp>0 / NaN-rescue rows
+        am, fin = greedy_grid(logits)
+        host: Dict[str, np.ndarray] = {}
+
+        def _rows() -> np.ndarray:
+            if "l" not in host:
+                host["l"] = np.asarray(logits)
+            return host["l"]
+
         events = []
         for req in batch:
             req.kv.num_tokens = req.num_context
             if self.pool.prefix_cache is not None:
                 self.pool.prefix_cache.register_seq(req.kv,
                                                     req.context_tokens)
-            tok = self._guarded_sample(logits_np[req.slot], req)
+            tok = self._resolve_token(req, len(req.output_tokens),
+                                      am[req.slot], fin[req.slot],
+                                      lambda s=req.slot: _rows()[s])
             if tok is None:
                 self._finish_abnormal(req, "error")
                 continue
@@ -594,6 +841,35 @@ class ServingEngine:
         return TokenEvent(req.request_id, tok,
                           len(req.output_tokens) - 1,
                           finished=reason is not None, finish_reason=reason)
+
+    # -------------------------------------------------------- streaming
+
+    def stream_text(self, request_id: str) -> str:
+        """Incremental detokenized text of a request's generation so far
+        (ISSUE 5 satellite): every output token up to the last byte-
+        complete UTF-8 boundary — a multi-byte character split across
+        tokens stays buffered until its continuation bytes arrive — and
+        the fully-flushed text (dangling bytes replaced) once the
+        request finished. Requires the engine's `tokenizer` knob
+        (id_to_bytes(tok) -> bytes preferred; decode([tok]) fallback).
+        Safe to call at any time, including between steps and after a
+        restore: the per-request detokenizer replays from the request's
+        token history, so no TokenEvent may be missed or double-fed."""
+        if self.tokenizer is None:
+            raise ValueError("stream_text() needs ServingEngine("
+                             "tokenizer=...) — an object exposing "
+                             "id_to_bytes(tok) or decode([tok])")
+        req = self._requests.get(request_id)
+        if req is None:
+            raise KeyError(f"unknown request {request_id!r}")
+        d = self._detoks.get(request_id)
+        if d is None:
+            d = self._detoks[request_id] = StreamDetokenizer(self.tokenizer)
+        while not d.finished and d.consumed < len(req.output_tokens):
+            d.push(req.output_tokens[d.consumed])
+        if req.done and not d.finished:
+            d.finish()
+        return d.text
 
     # -------------------------------------------------------------- run
 
@@ -673,6 +949,9 @@ class ServingEngine:
                     self.max_prefill_tokens_per_step,
                 "enable_prefix_cache": self.enable_prefix_cache,
                 "ragged_batch": self.ragged_batch,
+                "num_speculative_tokens": self.num_speculative_tokens,
+                "spec_max_ngram": self.spec_max_ngram,
+                "spec_min_ngram": self.spec_min_ngram,
             },
             "requests": reqs,
             "finished": [asdict(o) for o in self._outputs.values()],
@@ -681,6 +960,7 @@ class ServingEngine:
     @classmethod
     def restore(cls, runner: PagedModelRunner, state: dict, *,
                 metrics: Optional[EngineMetrics] = None,
+                tokenizer=None,
                 sleep_fn: Optional[Callable[[float], None]] = None,
                 audit: Optional[bool] = None) -> "ServingEngine":
         """Rebuild an engine from snapshot() on a fresh runner. Every
@@ -705,6 +985,10 @@ class ServingEngine:
                       "max_prefill_tokens_per_step"),
                   enable_prefix_cache=cfg.get("enable_prefix_cache", False),
                   ragged_batch=cfg.get("ragged_batch", False),
+                  num_speculative_tokens=cfg.get("num_speculative_tokens", 0),
+                  spec_max_ngram=cfg.get("spec_max_ngram", 3),
+                  spec_min_ngram=cfg.get("spec_min_ngram", 1),
+                  tokenizer=tokenizer,
                   metrics=metrics, sleep_fn=sleep_fn, audit=audit)
         ensure_arrival_counter_above(max(
             (r["arrival_index"] for r in state["requests"]), default=-1))

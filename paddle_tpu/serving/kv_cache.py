@@ -367,6 +367,30 @@ class SequenceKV:
         if short:
             self.pages.extend(self.pool.allocator.alloc(short))
 
+    def truncate(self, num_tokens: int) -> int:
+        """Roll back speculative tail state (ISSUE 5): keep only the
+        pages needed to cover ``num_tokens`` live positions and decref
+        the rest. The verify step grows the sequence for its whole
+        `k+1`-token span up front; after acceptance, the pages that only
+        ever held rejected speculative K/V are returned here — a
+        speculated page must never outlive its rejection (the auditor's
+        over-provision check pins it). Dropped pages are always private
+        (freshly grown for the span, never registered or shared), so the
+        decref sends them straight back to the free list. Returns the
+        number of pages dropped."""
+        keep = self.pool.blocks_for_tokens(max(num_tokens, 1))
+        if keep < self.registered_pages:
+            raise ValueError(
+                f"truncate({num_tokens}) would drop registered page "
+                f"{keep} < {self.registered_pages} — cached pages cannot "
+                "be speculative")
+        dropped = self.pages[keep:]
+        if dropped:
+            del self.pages[keep:]
+            self.pool.allocator.free(dropped)   # decref each
+        self.num_tokens = num_tokens
+        return len(dropped)
+
     def ensure_writable(self, start_tok: int, end_tok: int) -> int:
         """Copy-on-write guard for a write covering token positions
         [start_tok, end_tok): any touched page with refcount > 1 (shared

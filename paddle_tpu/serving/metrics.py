@@ -108,6 +108,14 @@ class EngineMetrics:
         self.prefill_chunks = Counter("prefill_chunks")
         self.prefix_hit_tokens = Counter("prefix_hit_tokens")
         self.cow_copies = Counter("cow_copies")
+        # speculative decoding (ISSUE 5): draft tokens the n-gram
+        # proposer put into verify spans vs how many the target model
+        # accepted; spec_rollback_pages counts pages the rejected tails
+        # returned (must be matched by truncate — the leak audit's
+        # over-provision check is the hard guarantee, this the gauge)
+        self.spec_proposed_tokens = Counter("spec_proposed_tokens")
+        self.spec_accepted_tokens = Counter("spec_accepted_tokens")
+        self.spec_rollback_pages = Counter("spec_rollback_pages")
         self.decode_steps = Counter("decode_steps")
         self.queue_depth = Gauge("queue_depth")
         self.running = Gauge("running")
@@ -144,6 +152,18 @@ class EngineMetrics:
         dt = self.busy_seconds
         return self.tokens_generated.value / dt if dt > 0 else 0.0
 
+    def spec_acceptance_rate(self) -> float:
+        """Accepted / proposed draft tokens (0.0 when nothing proposed)."""
+        p = self.spec_proposed_tokens.value
+        return self.spec_accepted_tokens.value / p if p > 0 else 0.0
+
+    def steps_per_token(self) -> float:
+        """Engine steps per generated token — the number speculation
+        drives BELOW 1/batch-occupancy: each accepted draft token is a
+        token that never paid its own engine step."""
+        t = self.tokens_generated.value
+        return self.decode_steps.value / t if t > 0 else 0.0
+
     def snapshot(self) -> Dict[str, float]:
         return {
             "requests_added": self.requests_added.value,
@@ -162,6 +182,11 @@ class EngineMetrics:
             "prefix_cached_pages": self.prefix_cached_pages.value,
             "attn_kv_bytes_read": self.attn_kv_bytes_read.value,
             "attn_kv_bytes_gather": self.attn_kv_bytes_gather.value,
+            "spec_proposed_tokens": self.spec_proposed_tokens.value,
+            "spec_accepted_tokens": self.spec_accepted_tokens.value,
+            "spec_rollback_pages": self.spec_rollback_pages.value,
+            "spec_acceptance_rate": self.spec_acceptance_rate(),
+            "steps_per_token": self.steps_per_token(),
             "decode_steps": self.decode_steps.value,
             "queue_depth": self.queue_depth.value,
             "queue_depth_peak": self.queue_depth.peak,

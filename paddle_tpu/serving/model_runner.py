@@ -11,6 +11,7 @@ step functions over the shared page pool:
   decode(tokens[B, 1], tables[B, P], pos[B], pools)   -> (logits[B, V], pools)
   ragged_step(tokens[B, T], tables, start[B], q_lens[B], pools)
                                                       -> (logits[B, V], pools)
+  ragged_step(..., full_logits=True)              -> (logits[B, T, V], pools)
 
 Every step writes K/V through the block table and attends through one of
 three statically-dispatched paths (`_attn_impl_for`, logged once per
@@ -247,20 +248,28 @@ class PagedModelRunner:
                                       jnp.ones((B,), jnp.int32), pools)
         return logits[:, 0], pools
 
-    def _ragged_step(self, params, tokens, tables, start_pos, q_lens,
+    def _ragged_core(self, params, tokens, tables, start_pos, q_lens,
                      pools):
         """One mixed ragged batch: every slot carries its own query span
         — decode steps (q_len=1), prefill chunks (q_len=chunk at an
-        offset), dead slots (q_len=0) — computed in ONE forward pass.
-        Returns each slot's logits at its span's LAST live row (dead
-        slots return garbage that callers never read)."""
+        offset), verify spans (q_len=k+1, ISSUE 5), dead slots (q_len=0)
+        — computed in ONE forward pass. Returns the full per-position
+        logits [B, T, V] (rows past a span's q_len are garbage that
+        callers never read)."""
         B, T = tokens.shape
         offs = jnp.arange(T, dtype=jnp.int32)[None, :]             # [1, T]
         valid = offs < q_lens[:, None]
         positions = jnp.where(valid, start_pos[:, None] + offs, 0)
         page, off = self._write_indices(positions, tables, valid)
-        logits, pools = self._forward(params, tokens, positions, page, off,
-                                      tables, start_pos, q_lens, pools)
+        return self._forward(params, tokens, positions, page, off,
+                             tables, start_pos, q_lens, pools)
+
+    def _ragged_step(self, params, tokens, tables, start_pos, q_lens,
+                     pools):
+        """Ragged batch returning each slot's logits at its span's LAST
+        live row only — the fused chunk+decode step's shape."""
+        logits, pools = self._ragged_core(params, tokens, tables, start_pos,
+                                          q_lens, pools)
         last = jnp.maximum(q_lens - 1, 0).astype(jnp.int32)
         out = jnp.take_along_axis(logits, last[:, None, None], axis=1)
         return out[:, 0], pools
@@ -279,8 +288,10 @@ class PagedModelRunner:
             return cached
         fn = {"prefill": self._prefill_step,
               "decode": self._decode_step,
-              "ragged": self._ragged_step}[kind]
-        pools_arg = {"prefill": 5, "decode": 4, "ragged": 5}[kind]
+              "ragged": self._ragged_step,
+              "ragged_full": self._ragged_core}[kind]
+        pools_arg = {"prefill": 5, "decode": 4, "ragged": 5,
+                     "ragged_full": 5}[kind]
         donate = (pools_arg,) if jax.default_backend() == "tpu" else ()
         jitted = jax.jit(fn, donate_argnums=donate)
         self._jit_cache[key] = jitted
@@ -331,16 +342,22 @@ class PagedModelRunner:
         return fn(self.params, jnp.asarray(tokens)[:, None],
                   jnp.asarray(tables), jnp.asarray(pos), pools)
 
-    def ragged_step(self, tokens, tables, start_pos, q_lens, pools):
+    def ragged_step(self, tokens, tables, start_pos, q_lens, pools,
+                    full_logits: bool = False):
         """One mixed ragged batch (the fused chunk+decode step): tokens
         [B, T] int (T pre-padded to a shared power-of-2 bucket by the
-        engine via `bucket_len`), tables [B, P], start_pos/q_lens [B].
-        Returns (logits [B, V] at each span's last live row, pools)."""
+        engine via `bucket_len` — verify spans and prefill chunks share
+        the SAME bucket rule, so a k+1-token verify span reuses the
+        small-chunk jit entry instead of minting its own), tables
+        [B, P], start_pos/q_lens [B]. Returns (logits, pools): logits is
+        [B, V] at each span's last live row, or the full per-position
+        [B, T, V] when `full_logits=True` — the speculative verify step
+        (ISSUE 5) scores all k+1 span positions from one launch."""
         tokens = np.asarray(tokens, np.int32)
         B, T = tokens.shape
         self._account_attn(self._attn_impl_for(T), np.asarray(start_pos),
                            np.asarray(q_lens), np.asarray(tables).shape[1])
-        fn = self._jitted("ragged", (B, T))
+        fn = self._jitted("ragged_full" if full_logits else "ragged", (B, T))
         return fn(self.params, jnp.asarray(tokens), jnp.asarray(tables),
                   jnp.asarray(np.asarray(start_pos, np.int32)),
                   jnp.asarray(np.asarray(q_lens, np.int32)), pools)

@@ -143,14 +143,22 @@ class FaultInjector:
             logits = self._corrupt(logits)
         return logits, pools
 
-    def ragged_step(self, tokens, tables, start_pos, q_lens, pools):
+    def ragged_step(self, tokens, tables, start_pos, q_lens, pools,
+                    full_logits: bool = False):
         # the fused chunk+decode call (engine ragged_batch mode, ISSUE 4)
         # IS the step's decode call site — it shares the "decode" op
         # counter, so a decode fault schedule keeps firing when the
-        # engine collapses its sequencing into one ragged launch
+        # engine collapses its sequencing into one ragged launch. The
+        # speculative verify call (ISSUE 5, full_logits=True) rides the
+        # same wrapper: error/nan/stall schedules cover verification too
+        # (_corrupt NaNs the leading vocab fraction of EVERY span row)
         n = self._pre("decode")
-        logits, pools = self._runner.ragged_step(tokens, tables, start_pos,
-                                                 q_lens, pools)
+        if full_logits:
+            logits, pools = self._runner.ragged_step(
+                tokens, tables, start_pos, q_lens, pools, full_logits=True)
+        else:
+            logits, pools = self._runner.ragged_step(tokens, tables,
+                                                     start_pos, q_lens, pools)
         if self._hits(self._nan, "decode", n):
             self.injected["nan"] += 1
             logits = self._corrupt(logits)
@@ -227,6 +235,16 @@ def audit_engine(engine) -> None:
         if len(req.kv.pages) > engine.max_pages_per_seq:
             problems.append(f"{req.request_id} holds {len(req.kv.pages)} "
                             f"pages > max_pages_per_seq")
+        # no speculative page survives rejection (ISSUE 5): between
+        # steps a sequence may hold at most the pages its full context
+        # plus one upcoming token needs — a verify span's rejected-tail
+        # pages must have been truncated back before the step ended
+        cap = engine.pool.blocks_for_tokens(req.num_context + 1)
+        if len(req.kv.pages) > cap:
+            problems.append(
+                f"{req.request_id} holds {len(req.kv.pages)} pages > "
+                f"{cap} needed for context+1 — speculative pages "
+                "survived rejection")
         for p in req.kv.pages:
             owner_counts[p] = owner_counts.get(p, 0) + 1
     cached = set(cache.pages()) if cache is not None else set()

@@ -36,7 +36,7 @@ import itertools
 from collections import deque
 from dataclasses import dataclass, field
 from enum import Enum
-from typing import Deque, List, Optional, Sequence, Tuple
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 from paddle_tpu.serving.kv_cache import KVCachePool, SequenceKV
 
@@ -261,6 +261,47 @@ class FCFSScheduler:
         ragged step (engine ragged_batch mode: this step's prefill
         chunks and these decodes ride ONE runner.ragged_step call)."""
         return [r for r in self.running if r.phase == "decode"]
+
+    # ------------------------------------------------------- speculation
+
+    def speculation_budget(self, chunk_tokens: int) -> Optional[int]:
+        """Per-step token budget left for speculative (verify-span)
+        tokens after this step's prefill chunks (ISSUE 5): verify spans
+        count against `max_prefill_tokens_per_step` exactly like chunk
+        tokens do, so the fused launch's live-row count stays bounded by
+        the same knob that bounds chunked prefill. Only the EXTRA
+        speculative tokens are budgeted — the mandatory one-token decode
+        feed per request always runs, budget or not (a decode step was
+        never budget-gated). None = unbounded."""
+        if self.max_prefill_tokens_per_step is None:
+            return None
+        return max(0, self.max_prefill_tokens_per_step - chunk_tokens)
+
+    def reserve_speculation(self, proposals: Dict[Request, List[int]]) -> int:
+        """Best-effort page reservation for this step's verify spans,
+        admission order: each decode request's proposal is trimmed (in
+        place) until the pages its whole `1+k`-token span will write can
+        be funded WITHOUT preempting — speculation never evicts a running
+        sequence's pages; under pool pressure it degrades to a plain
+        decode (k=0) instead. Runs after reserve_decode(), which already
+        funded the mandatory decode token the hard way. Returns the
+        total number of reserved speculative tokens."""
+        total = 0
+        for req in self.running:
+            prop = proposals.get(req)
+            if req.phase != "decode" or not prop:
+                continue
+            k = len(prop)
+            while k:
+                short = req.kv.pages_short(1 + k)
+                if short == 0 or self.pool.allocator.can_alloc(short):
+                    break
+                k -= 1
+            del prop[k:]
+            if k:
+                req.kv.grow(1 + k)
+                total += k
+        return total
 
     # -------------------------------------------------------- preemption
 

@@ -1,0 +1,59 @@
+"""Model-free draft proposal for speculative decoding (ISSUE 5).
+
+Reference: the serving-side speculation line in PAPERS.md — SpecInfer's
+draft-and-verify loop and vLLM's n-gram "prompt lookup" speculator. A
+second draft model is the classic proposer, but for a serving stack the
+zero-cost variant is to mine the request's OWN token stream: if the
+current suffix n-gram occurred earlier in the context (prompt or
+generated output), propose the tokens that followed it. On
+repetition-heavy workloads — extraction, code, templated answers, any
+model that quotes its prompt — the proposals hit often enough that one
+fused verify launch (engine `_verify`/`runner.ragged_step`, scoring all
+k+1 positions at once) replaces several per-token decode launches.
+
+The proposer is deterministic: longest suffix n-gram first, most recent
+prior occurrence wins, zero RNG — the engine's token-exactness vs
+`naive_generate` never depends on WHAT is proposed, only that the verify
+step accepts exactly the tokens the target model would have produced.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+
+class NgramProposer:
+    """Prompt-lookup draft proposer: match the context's trailing n-gram
+    against its own history and propose the continuation.
+
+    proposer = NgramProposer(max_ngram=3, min_ngram=1)
+    draft = proposer.propose(context_tokens, max_k)   # [] when no match
+
+    Matching tries the LONGEST suffix n-gram first (more context = higher
+    -precision proposals) and, per length, the MOST RECENT earlier
+    occurrence (recency beats frequency for self-repetitive streams).
+    Proposals are pure reads of the context — no model call, no state —
+    so a preempted/restored request re-proposes identically.
+    """
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram({min_ngram}) <= max_ngram({max_ngram})")
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+
+    def propose(self, context: Sequence[int], max_k: int) -> List[int]:
+        """Up to ``max_k`` draft tokens continuing ``context``, or []."""
+        if max_k <= 0:
+            return []
+        ctx = list(map(int, context))
+        n_hi = min(self.max_ngram, len(ctx) - 1)
+        for n in range(n_hi, self.min_ngram - 1, -1):
+            suffix = ctx[-n:]
+            # most recent earlier occurrence: scan right-to-left, ending
+            # strictly before the suffix itself
+            for j in range(len(ctx) - n - 1, -1, -1):
+                if ctx[j:j + n] == suffix:
+                    return ctx[j + n:j + n + max_k]
+        return []

@@ -83,6 +83,62 @@ class StubPagedRunner:
             out[b] = self._logits(hist)
         return jnp.asarray(out), [(jnp.asarray(k), v)]
 
+    def ragged_step(self, tokens, tables, start_pos, q_lens, pools,
+                    full_logits=False):
+        """Mixed ragged batch (fused chunk+decode and the ISSUE-5 verify
+        step): each slot writes its span's tokens through its own block
+        table and row i scores the pool-gathered history THROUGH span
+        position i — so a stale table, a wrong speculative write, or a
+        missed rollback changes the logits and breaks oracle equality."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        (k, v), = pools
+        k = np.array(k)
+        tokens = np.asarray(tokens)
+        tables = np.asarray(tables)
+        start_pos = np.asarray(start_pos)
+        q_lens = np.asarray(q_lens)
+        B, T = tokens.shape
+        full = np.zeros((B, T, self.vocab_size), np.float32)
+        for b in range(B):
+            for i in range(int(q_lens[b])):
+                p = int(start_pos[b]) + i
+                page = int(tables[b, p // self.block_size])
+                k[page, p % self.block_size, 0, 0] = float(tokens[b, i])
+                hist = [k[int(tables[b, j // self.block_size]),
+                          j % self.block_size, 0, 0] for j in range(p + 1)]
+                full[b, i] = self._logits(hist)
+        if full_logits:
+            return jnp.asarray(full), [(jnp.asarray(k), v)]
+        last = np.stack([full[b, max(int(q_lens[b]) - 1, 0)]
+                         for b in range(B)])
+        return jnp.asarray(last), [(jnp.asarray(k), v)]
+
+
+class PeriodicStubRunner(StubPagedRunner):
+    """Stub whose greedy continuation is PERIODIC: the next token repeats
+    the token `period` positions back in the pool-gathered history (so
+    block-table/rollback bugs still break it). Decoding a periodic
+    prompt yields a periodic output — the n-gram prompt-lookup proposer
+    hits almost every step, which makes this the repetition-heavy
+    workload for the ISSUE-5 steps-per-token acceptance pin."""
+
+    def __init__(self, period=4, **kw):
+        super().__init__(**kw)
+        self.period = period
+
+    def _logits(self, history):
+        import numpy as np
+
+        if len(history) >= self.period:
+            nxt = int(history[-self.period]) % self.vocab_size
+        else:
+            nxt = (7 * (len(history) + 1)) % self.vocab_size
+        row = np.zeros((self.vocab_size,), np.float32)
+        row[nxt] = 1.0
+        return row
+
 
 def child_env(repo_on_pythonpath=True):
     """Env for spawning CPU-only child processes from tests.

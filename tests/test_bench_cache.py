@@ -119,10 +119,40 @@ def test_bench_no_tpu_emits_parseable_status_line(tmp_path):
     assert last["error"] == "backend_unavailable"
     assert any(r.get("status") == "child_failed" for r in parsed), \
         "probe child crash must surface as a structured record"
+    # ISSUE-5 satellite: the backend-init failure inside the child is
+    # CLASSIFIED — an extra structured tunnel_down record names it, and
+    # the child replaces its raw jax traceback with one JSON status line
+    assert any(r.get("status") == "tunnel_down"
+               and r.get("error_kind") == "backend_init"
+               for r in parsed), \
+        "backend-init failure must emit a classified tunnel_down record"
+    err_text = err_f.read_text()
+    assert "backend_init_failed" in err_text
+    assert "Traceback (most recent call last)" not in err_text, \
+        "backend-init failure must not dump a raw traceback"
     # the attempt log recorded the probe outcome
     with open(tmp_path / "attempts.jsonl") as f:
         attempts = [json.loads(ln) for ln in f if ln.strip()]
     assert attempts and attempts[-1]["status"] == "probe_hung"
+
+
+def test_backend_init_failure_classifier(bench):
+    """The marker set must catch the raw jax messages the BENCH logs
+    actually showed (BENCH_r05 tail: `Unable to initialize backend
+    'axon'`) plus the unknown-platform spelling, and must NOT absorb
+    ordinary child crashes."""
+    assert bench._backend_init_failure(
+        {"error": "Unable to initialize backend 'axon': DEADLINE_EXCEEDED"})
+    assert bench._backend_init_failure(
+        {"error": "Unknown backend: 'bogus_backend' requested, but no "
+                  "platforms that are instances of bogus_backend are "
+                  "present."})
+    assert bench._backend_init_failure(
+        RuntimeError("Unable to initialize backend 'tpu'"))
+    assert not bench._backend_init_failure({"error": "ValueError: shapes "
+                                                     "(8,) and (4,)"})
+    assert not bench._backend_init_failure({})
+    assert not bench._backend_init_failure(None)
 
 
 def test_headline_metric_cached_directly_wins(bench, capsys):

@@ -41,6 +41,15 @@ call site's retry/quarantine. --attn-impl picks the attention path
 (default "auto": kernels on TPU, gather oracle on CPU; "ragged" forces
 the ragged paged-attention kernel in interpret mode for a CPU-only
 kernel-path drill). Records report the attention-bytes counters.
+
+ISSUE 5: `--speculate [K]` (K defaults to 4) drills every fault class
+with speculative decoding ON: decode rides n-gram verify spans through
+the full-logits ragged call — the same decode-op fault schedules now
+hit the verify launch — and half the prompts become repetition-heavy
+periodic patterns so proposals actually fire. Recovery must stay
+token-exact (none/device_error classes still compare against the
+naive oracle) and the rejected-tail rollback must leave zero leaked
+pages. Records add the proposed/accepted counters and acceptance rate.
 """
 
 from __future__ import annotations
@@ -67,6 +76,7 @@ def build_engine(runner, args, **kw):
     kw.setdefault("enable_prefix_cache", args.prefix_cache)
     kw.setdefault("max_prefill_tokens_per_step", args.chunk or None)
     kw.setdefault("ragged_batch", args.ragged_batch)
+    kw.setdefault("num_speculative_tokens", args.speculate)
     return ServingEngine(runner, **kw)
 
 
@@ -108,7 +118,15 @@ def run_class(fault: str, runner, args) -> dict:
     header = list(rng.integers(1, vocab, 9))
     work = []
     for i in range(n):
-        prompt = list(rng.integers(1, vocab, int(rng.integers(4, 20))))
+        plen = int(rng.integers(4, 20))
+        if args.speculate and i % 2 == 0:
+            # repetition-heavy half (ISSUE 5): a short periodic pattern
+            # the n-gram proposer can mine, so the verify path carries
+            # real accepted drafts under every fault class
+            pattern = list(rng.integers(1, vocab, int(rng.integers(2, 4))))
+            prompt = (pattern * (plen // len(pattern) + 1))[:plen]
+        else:
+            prompt = list(rng.integers(1, vocab, plen))
         if i % 2:
             prompt[:min(len(header), len(prompt) - 1)] = \
                 header[:len(prompt) - 1]
@@ -164,6 +182,10 @@ def run_class(fault: str, runner, args) -> dict:
         "cow_copies": m["cow_copies"],
         "attn_kv_bytes_read": m["attn_kv_bytes_read"],
         "attn_kv_bytes_gather": m["attn_kv_bytes_gather"],
+        "spec_proposed_tokens": m["spec_proposed_tokens"],
+        "spec_accepted_tokens": m["spec_accepted_tokens"],
+        "spec_acceptance_rate": m["spec_acceptance_rate"],
+        "steps_per_token": m["steps_per_token"],
         "injected": dict(getattr(target, "injected", {})) or None,
     }
 
@@ -193,6 +215,12 @@ def main() -> int:
                     help="fused chunk+decode ragged steps (default: on)")
     ap.add_argument("--no-ragged-batch", dest="ragged_batch",
                     action="store_false")
+    ap.add_argument("--speculate", type=int, nargs="?", const=4, default=0,
+                    metavar="K",
+                    help="speculative decoding with up to K n-gram draft "
+                         "tokens per verify span (bare flag: K=4; "
+                         "default: off) — half the prompts become "
+                         "periodic so proposals fire")
     ap.add_argument("--attn-impl", default="auto",
                     choices=("auto", "pallas", "ragged", "reference"),
                     help="attention path (auto: kernels on TPU, gather "
