@@ -459,6 +459,10 @@ def child_serving(layers: int, hidden: int, max_batch: int, requests: int,
         snap = eng.metrics.snapshot()
         context = snap["prefill_tokens"] + snap["prefix_hit_tokens"]
         point = {"arrival_every_steps": arrival_every_steps,
+                 # recorded so serving rungs stay comparable across
+                 # rounds once the horizon knob starts moving (ISSUE 6)
+                 "decode_horizon": eng.decode_horizon,
+                 "host_syncs_per_token": snap["host_syncs_per_token"],
                  "wall_s": round(wall, 3),
                  "tokens_per_sec": snap["tokens_generated"] / wall,
                  "ttft_s_p50": snap["ttft_s_p50"],
@@ -532,6 +536,8 @@ def child_serving_long(layers: int, hidden: int, max_batch: int,
         read = snap["attn_kv_bytes_read"]
         gather = snap["attn_kv_bytes_gather"]
         return {"wall_s": round(wall, 3),
+                "decode_horizon": eng.decode_horizon,
+                "host_syncs_per_token": snap["host_syncs_per_token"],
                 "tokens_per_sec": snap["tokens_generated"] / wall,
                 "ttft_s_p50": snap["ttft_s_p50"],
                 "ttft_s_p99": snap["ttft_s_p99"],
@@ -601,6 +607,8 @@ def child_serving_spec(layers: int, hidden: int, max_batch: int,
         wall = time.time() - t0
         snap = eng.metrics.snapshot()
         return {"speculative_tokens": spec,
+                "decode_horizon": eng.decode_horizon,
+                "host_syncs_per_token": snap["host_syncs_per_token"],
                 "wall_s": round(wall, 3),
                 "tokens_per_sec": snap["tokens_generated"] / wall,
                 "decode_steps": snap["decode_steps"],
@@ -624,6 +632,80 @@ def child_serving_spec(layers: int, hidden: int, max_batch: int,
                   "tokens_per_sec_x": (spec["tokens_per_sec"]
                                        / base["tokens_per_sec"]
                                        if base["tokens_per_sec"] else 0.0)})
+
+
+def child_serving_multistep(layers: int, hidden: int, max_batch: int,
+                            requests: int, prompt: int, gen: int,
+                            vocab: int):
+    """Multi-step decode rung (ISSUE 6): the same pure-greedy
+    closed-batch workload at decode_horizon s in {1, 4, 8}. s=1 is
+    today's per-step loop (one blocking device->host drain per decode
+    step); s>1 runs s decode steps device-resident per drain
+    (runner.decode_multi lax.scan). Commits, per arm, tokens/s plus the
+    structural number the knob exists to move: host_syncs_per_token
+    (blocking drains / generated tokens — the acceptance criterion is a
+    >= 4x drop at s=8 vs s=1, countable on CPU proxy too, where the
+    wall-clock win is muted because a CPU 'device' has no real transfer
+    latency to hide)."""
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.serving import GPTRunner, SamplingParams, ServingEngine
+
+    backend = jax.default_backend()
+    paddle.seed(0)
+    max_len = prompt + gen
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+                    num_heads=max(hidden // 64, 1), max_seq_len=max_len,
+                    dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+    block_size = min(16, max_len)
+    runner = GPTRunner(model, block_size=block_size, max_model_len=max_len)
+    pages_per_seq = -(-max_len // block_size)
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(0, vocab, prompt)) for _ in range(requests)]
+
+    def run_once(s: int) -> dict:
+        eng = ServingEngine(runner,
+                            num_blocks=max_batch * pages_per_seq + 1,
+                            max_batch_size=max_batch, max_model_len=max_len,
+                            decode_horizon=s)
+        t0 = time.time()
+        for i, p in enumerate(prompts):
+            eng.add_request(p, SamplingParams(max_tokens=gen),
+                            request_id=f"r{i}")
+        eng.run()
+        wall = time.time() - t0
+        snap = eng.metrics.snapshot()
+        return {"decode_horizon": s,
+                "wall_s": round(wall, 3),
+                "tokens_per_sec": snap["tokens_generated"] / wall,
+                "tokens_generated": snap["tokens_generated"],
+                "host_syncs": snap["host_syncs"],
+                "host_syncs_per_token": snap["host_syncs_per_token"],
+                "decode_horizon_steps": snap["decode_horizon_steps"],
+                "horizon_overshoot_tokens":
+                    snap["horizon_overshoot_tokens"],
+                "decode_steps": snap["decode_steps"]}
+
+    for s in (1, 4, 8):     # warmup: compiles prefill + every scan length
+        run_once(s)
+    arms = [run_once(s) for s in (1, 4, 8)]
+    base = arms[0]["host_syncs_per_token"]
+    top = arms[-1]["host_syncs_per_token"]
+    _write_child({"backend": backend, "layers": layers, "hidden": hidden,
+                  "max_batch": max_batch, "requests": requests,
+                  "prompt": prompt, "gen": gen, "workload": "multistep",
+                  "arms": arms,
+                  "host_syncs_reduction_x": (base / top if top else 0.0),
+                  "tokens_per_sec_x": (arms[-1]["tokens_per_sec"]
+                                       / arms[0]["tokens_per_sec"]
+                                       if arms[0]["tokens_per_sec"]
+                                       else 0.0)})
 
 
 def _write_child(obj: dict) -> None:
@@ -889,6 +971,34 @@ def main():
                 f" ({r['step_reduction_x']:.2f}x fewer), acceptance "
                 f"{sp['spec_acceptance_rate']*100:.0f}%")
 
+    # multi-step decode rung (ISSUE 6): pure-greedy workload at
+    # decode_horizon 1/4/8; commits tokens/s per arm and the
+    # host-syncs-per-token trajectory (the >= 4x reduction criterion
+    # is countable on CPU proxy; the wall-clock multiplier is the
+    # number to watch on a real tunnel, where each sync is an RPC)
+    if on_tpu and remaining() > 120:
+        r = run_child("serving:6:512:4:8:64:64:32768:multistep",
+                      min(900, remaining()))
+        if r is not None:
+            for arm in r["arms"]:
+                line = {"metric": "serving_multistep_tokens_per_sec_s"
+                                  f"{arm['decode_horizon']}",
+                        "value": round(arm["tokens_per_sec"], 1),
+                        "unit": "tokens/s", "vs_baseline": 0.0,
+                        "decode_horizon": arm["decode_horizon"],
+                        "host_syncs_per_token":
+                            round(arm["host_syncs_per_token"], 4),
+                        "horizon_overshoot_tokens":
+                            arm["horizon_overshoot_tokens"],
+                        "backend": r["backend"]}
+                emit(line)
+                _cache_result(line)
+            log(f"multistep rung: syncs/token "
+                f"{r['arms'][0]['host_syncs_per_token']:.3f} -> "
+                f"{r['arms'][-1]['host_syncs_per_token']:.3f} "
+                f"({r['host_syncs_reduction_x']:.1f}x fewer), tokens/s "
+                f"{r['tokens_per_sec_x']:.2f}x at s=8")
+
     if best is not None:
         # headline repeated last: drivers that parse the final stdout JSON
         # line get the largest completed config
@@ -930,6 +1040,8 @@ def _child_main(mode: str) -> None:
             child_serving_long(*[int(x) for x in parts[:-1]])
         elif parts and parts[-1] == "speculative":
             child_serving_spec(*[int(x) for x in parts[:-1]])
+        elif parts and parts[-1] == "multistep":
+            child_serving_multistep(*[int(x) for x in parts[:-1]])
         else:
             child_serving(*[int(x) for x in parts])
     else:

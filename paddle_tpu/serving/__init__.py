@@ -25,7 +25,11 @@ paged-decode kernel (`ops/pallas/paged_attention.py`):
   engine.py        ServingEngine: per-request sampling params, stop
                    conditions, token streaming, plus `naive_generate`,
                    the sequential oracle continuous batching must match
-                   token-for-token;
+                   token-for-token; `decode_horizon=s` (ISSUE 6) keeps
+                   the greedy sampling loop device-resident for s steps
+                   per host sync (runner.decode_multi), draining one
+                   packed token buffer per horizon instead of one
+                   transfer per token;
   speculate.py     NgramProposer (ISSUE 5): model-free prompt-lookup
                    draft proposals mined from the request's own context;
                    the engine verifies all k+1 span positions in ONE

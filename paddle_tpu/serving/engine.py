@@ -30,11 +30,25 @@ tail KV rolls back through the refcount machinery (`SequenceKV.truncate`
 + page decref) so a speculated page never leaks or corrupts the prefix
 cache.
 
+With `decode_horizon=s > 1` (ISSUE 6) the engine stops paying a host
+round-trip per token: a pure-greedy decode batch runs s consecutive
+decode steps in ONE `runner.decode_multi` launch — a device-resident
+lax.scan that feeds each step's argmax token back as the next input —
+against block tables whose pages the scheduler pre-committed for the
+whole horizon, and the host drains a single packed [B, s] token buffer
+per horizon (`host_syncs` drops toward tokens/s) instead of blocking on
+every step's logits. The drained buffer replays token-by-token through
+the same stop/length/NaN bookkeeping, discarding overshoot past a stop
+and reclaiming its pages, so the token streams are the s=1 streams
+verbatim; batches the horizon can't serve (temperature > 0, verify
+spans, prefill chunks in flight) fall back to the per-step path.
+
 The engine is deterministic end-to-end: FCFS admission, sorted-free-list
 pages, greedy (or seeded per-request) sampling, step-indexed sample keys
 that survive preemption. `naive_generate` is the scheduling oracle: the
 same runner, one request at a time, no scheduler — continuous batching
-(speculation included) must reproduce its tokens exactly.
+(speculation and multi-step horizons included) must reproduce its tokens
+exactly.
 
 Every failure mode has a defined outcome (ISSUE 2 hardening); no step()
 raises for load- or fault-induced conditions:
@@ -117,17 +131,31 @@ def sample_token(logits_row: np.ndarray, sampling: SamplingParams,
     return int(np.asarray(tok)[0])
 
 
+def _to_host(x) -> np.ndarray:
+    """THE device->host sync boundary: every blocking drain the engine
+    performs funnels through here (greedy_grid's packed pull, the lazy
+    full-logits row fetch, the multi-step horizon drain), so a test can
+    monkeypatch this one symbol and count exactly how many times a step
+    blocked on the device (the ISSUE 6 one-sync-per-step pin)."""
+    return np.asarray(x)
+
+
 def greedy_grid(logits):
     """Vectorized device-side greedy pass (ISSUE 5 satellite): ONE argmax
     and ONE finiteness reduction over a [..., V] logits array, computed
-    where the logits live, then two tiny host transfers (ints/bools, no
-    vocab axis). The full array only crosses to host afterwards when a
-    row actually needs it — temperature > 0 sampling, or a NaN rescue
-    under nan_policy="greedy". Tie-breaking matches np.argmax (first max
-    wins), which the batched-sampling pin test asserts against the
-    host path `sample_token` / `naive_generate` use."""
-    return (np.asarray(jnp.argmax(logits, axis=-1)),
-            np.asarray(jnp.all(jnp.isfinite(logits), axis=-1)))
+    where the logits live, then ONE tiny host transfer — the argmax ids
+    and finite flags ride a single packed int32 array (ISSUE 6
+    satellite: this used to be two separate np.asarray pulls, i.e. two
+    blocking syncs per decode step). The full array only crosses to
+    host afterwards when a row actually needs it — temperature > 0
+    sampling, or a NaN rescue under nan_policy="greedy". Tie-breaking
+    matches np.argmax (first max wins), which the batched-sampling pin
+    test asserts against the host path `sample_token` /
+    `naive_generate` use."""
+    packed = _to_host(jnp.stack(
+        [jnp.argmax(logits, axis=-1).astype(jnp.int32),
+         jnp.all(jnp.isfinite(logits), axis=-1).astype(jnp.int32)]))
+    return packed[0], packed[1].astype(bool)
 
 
 class ServingEngine:
@@ -178,6 +206,30 @@ class ServingEngine:
                            greedy acceptance is argmax equality, and
                            temperature > 0 compares the draft against
                            the request's seeded step-indexed sample.
+      decode_horizon       multi-step decode (ISSUE 6): sync with the
+                           host every `s` steps instead of every step.
+                           A pure-greedy decode batch (no prefill
+                           chunks in flight, speculation off, every
+                           request temperature == 0) runs up to `s`
+                           consecutive decode steps in ONE
+                           runner.decode_multi launch — the sampling
+                           loop stays device-resident, each argmax
+                           token fed back on device — and the host
+                           drains a single [B, s] buffer per horizon
+                           (host_syncs metric) instead of one transfer
+                           per token. The scheduler pre-commits every
+                           page the horizon will write
+                           (plan_decode_horizon: trims s, never
+                           preempts). Token streams are EXACTLY the
+                           s=1 streams: the drained buffer replays
+                           token-by-token through the same stop/
+                           length/NaN handling, and overshoot tokens
+                           past a stop are discarded with their pages
+                           reclaimed (horizon_overshoot_tokens).
+                           Default 1 = today's per-step loop, bit-
+                           exact. Batches that can't ride a horizon
+                           (temperature > 0, verify spans, chunks in
+                           flight) fall back to the per-step path.
       spec_max_ngram /     suffix n-gram lengths the draft proposer
       spec_min_ngram       matches (longest first, most recent wins)
       tokenizer            optional tokenizer (id_to_bytes(tok) or
@@ -199,6 +251,7 @@ class ServingEngine:
                  max_prefill_tokens_per_step: Optional[int] = None,
                  enable_prefix_cache: bool = False,
                  ragged_batch: bool = False,
+                 decode_horizon: int = 1,
                  num_speculative_tokens: int = 0,
                  spec_max_ngram: int = 3,
                  spec_min_ngram: int = 1,
@@ -231,6 +284,10 @@ class ServingEngine:
             self.pool.enable_prefix_cache()
         self.max_prefill_tokens_per_step = max_prefill_tokens_per_step
         self.ragged_batch = bool(ragged_batch)
+        if decode_horizon < 1:
+            raise ValueError("decode_horizon must be >= 1 (1 = sync with "
+                             "the host every step)")
+        self.decode_horizon = int(decode_horizon)
         if num_speculative_tokens < 0:
             raise ValueError("num_speculative_tokens must be >= 0 (0 = "
                              "speculation off)")
@@ -382,6 +439,7 @@ class ServingEngine:
         """Single-row spelling of the guarded sampler (the completing-
         chunk call site): same greedy_grid pass, scalar-shaped."""
         am, fin = greedy_grid(logits_row)
+        self.metrics.host_syncs.inc()
         if step is None:
             step = len(req.output_tokens)
         return self._resolve_token(req, step, am, fin,
@@ -448,16 +506,23 @@ class ServingEngine:
                 self.metrics.preemptions.inc()
             events.extend(self._ragged_step_with_recovery())
         else:
-            for req, start, end in self.scheduler.prefill_plan():
+            for req, start, end in plan:
                 ev = self._prefill_chunk_with_recovery(req, start, end)
                 if ev is not None:
                     events.append(ev)
             # decode-page reservation; pool pressure preempts youngest-first
             for v in self.scheduler.reserve_decode():
                 self.metrics.preemptions.inc()
-            # one batched decode step over every decode-phase sequence
+            # one batched decode step over every decode-phase sequence —
+            # or, when the batch qualifies (ISSUE 6: decode_horizon > 1,
+            # pure greedy, no chunks in flight), one device-resident
+            # multi-step horizon that drains s tokens per host sync
             if self.scheduler.running:
-                events.extend(self._decode_with_recovery())
+                s = self._plan_horizon(chunks_in_flight=bool(plan))
+                if s > 1:
+                    events.extend(self._decode_multi_with_recovery(s))
+                else:
+                    events.extend(self._decode_with_recovery())
         self.metrics.decode_steps.inc()
 
         # bookkeeping gauges
@@ -629,11 +694,13 @@ class ServingEngine:
         # vectorized greedy/finite pass over the whole call's logits
         # ([B, V] or [B, T, V]); rows transfer lazily only when needed
         am, fin = greedy_grid(logits)
+        self.metrics.host_syncs.inc()
         host: Dict[str, np.ndarray] = {}
 
         def _rows() -> np.ndarray:
             if "l" not in host:
-                host["l"] = np.asarray(logits)
+                host["l"] = _to_host(logits)
+                self.metrics.host_syncs.inc()
             return host["l"]
 
         events: List[TokenEvent] = []
@@ -735,6 +802,141 @@ class ServingEngine:
         if aborted and not req.done:
             self._finish_abnormal(req, "error")
 
+    # ------------------------------------------- multi-step decode (s>1)
+
+    def _plan_horizon(self, chunks_in_flight: bool) -> int:
+        """Effective multi-step horizon for THIS step's decode batch
+        (ISSUE 6) — the fallback matrix in one place. Returns 1 (the
+        per-step path) whenever the batch can't ride a device-resident
+        horizon: decode_horizon off, prefill chunks in flight this step
+        (their completing logits need per-step sampling), speculation on
+        (verify spans already batch several tokens per sync and need
+        full logits), any request sampling at temperature > 0 (needs
+        its [V] rows on host), or a request deferred here by a mid-
+        horizon NaN (the per-step path refetches real logits to rescue
+        from). Otherwise caps s at the batch's token headroom (never
+        scan past every request's max_tokens, never write a K/V
+        position past max_model_len — overshoot past a STOP token is
+        fine and rolled back, the cap is about provable waste) and lets
+        the scheduler pre-commit the horizon's pages, trimming further
+        under pool pressure."""
+        s = self.decode_horizon
+        batch = self.scheduler.decode_ready()
+        if (s <= 1 or not batch or chunks_in_flight
+                or self.num_speculative_tokens):
+            return 1
+        deferred = False
+        for r in batch:
+            if r.defer_horizon:
+                r.defer_horizon = False
+                deferred = True
+        if deferred or any(r.sampling.temperature != 0.0 for r in batch):
+            return 1
+        s = min(s, max(r.sampling.max_tokens - len(r.output_tokens)
+                       for r in batch))
+        s = min(s, min(self.max_model_len - r.num_context + 1
+                       for r in batch))
+        if s <= 1:
+            return 1
+        return self.scheduler.plan_decode_horizon(s)
+
+    def _decode_multi_with_recovery(self, s: int) -> List[TokenEvent]:
+        """One device-resident multi-step decode horizon (ISSUE 6
+        tentpole) with the per-step path's transient-failure recovery.
+        The batch's next `s` decode steps run in ONE
+        runner.decode_multi launch — a lax.scan that feeds each step's
+        on-device argmax token back as the next input — and the host
+        drains ONE packed [2, B, s] buffer (host_syncs += 1, not += s).
+        The buffer is then replayed token-by-token through exactly the
+        per-step bookkeeping: _append_token's stop/length handling,
+        prefix-cache registration at each coverage point, the NaN
+        policy — so token streams, finish reasons, and metrics match
+        the s=1 loop verbatim. A request that stops mid-horizon
+        discards its overshoot tail (horizon_overshoot_tokens); its
+        pre-committed pages go back via the normal finish release,
+        mirroring speculative rollback. Retries are exact for the same
+        reason decode retries are: a failed attempt either never
+        reached the device or re-writes identical K/V (the greedy
+        feedback chain is deterministic) through the same block tables;
+        exhausted retries quarantine the youngest spanning request and
+        rebuild, exactly like the per-step loop."""
+        attempts = 0
+        delay = self.retry_backoff_s
+        while True:
+            batch = self.scheduler.decode_ready()
+            if not batch:
+                return []
+            B = self.max_batch_size
+            P = self.max_pages_per_seq
+            tokens = np.zeros((B,), np.int32)
+            tables = np.full((B, P), SCRATCH_PAGE, np.int32)
+            pos = np.zeros((B,), np.int32)
+            for req in batch:
+                # every page the horizon will write must be private
+                # BEFORE launch (idempotent: forks survive a retry)
+                cow = req.kv.ensure_writable(req.num_context - 1,
+                                             req.num_context - 1 + s)
+                if cow:
+                    self.metrics.cow_copies.inc(cow)
+                sl = req.slot
+                tokens[sl] = req.output_tokens[-1]
+                tables[sl, :len(req.kv.pages)] = req.kv.pages
+                pos[sl] = req.num_context - 1
+            try:
+                packed, new_pools = self.runner.decode_multi(
+                    tokens, tables, pos, self.pool.pools, s)
+                break
+            except Exception:
+                if attempts < self.max_step_retries:
+                    attempts += 1
+                    self.metrics.step_retries.inc()
+                    self._sleep(delay)
+                    delay *= 2
+                    continue
+                self._finish_abnormal(batch[-1], "error")
+                attempts = 0
+                delay = self.retry_backoff_s
+        self.pool.pools = new_pools
+        self.metrics.batch_occupancy.observe(len(batch))
+        self.metrics.decode_horizon_steps.inc(s)
+        drained = _to_host(packed)      # the horizon's ONE host sync
+        self.metrics.host_syncs.inc()
+        toks, fins = drained[0], drained[1]
+        events: List[TokenEvent] = []
+        for req in batch:
+            sl = req.slot
+            C = req.num_context
+            accepted = 0
+            for j in range(s):
+                if not fins[sl, j]:
+                    self._horizon_nan(req, C, accepted)
+                    break
+                req.kv.num_tokens = C + j
+                if self.pool.prefix_cache is not None:
+                    self.pool.prefix_cache.register_seq(
+                        req.kv, req.context_tokens)
+                events.append(self._append_token(req, int(toks[sl, j])))
+                accepted += 1
+                if req.done:
+                    self.metrics.horizon_overshoot_tokens.inc(s - accepted)
+                    break
+        return events
+
+    def _horizon_nan(self, req: Request, C: int, accepted: int) -> None:
+        """Non-finite logits surfaced mid-horizon: the device loop kept
+        no [V] row to rescue from, so under nan_policy="abort" the
+        request ends exactly like an unrescuable per-step row; under
+        "greedy" the horizon tail is rolled back (coverage truncated,
+        over-committed pages decref'd on the spot) and the request is
+        deferred to the per-step path next step, which refetches the
+        real logits and applies the normal finite-entry rescue."""
+        self.metrics.nan_logit_events.inc()
+        if self.nan_policy == "abort":
+            self._finish_abnormal(req, "error")
+            return
+        req.kv.truncate(max(C + accepted - 1, 1))
+        req.defer_horizon = True
+
     def _decode_with_recovery(self) -> List[TokenEvent]:
         """One batched decode step with transient-failure recovery: retry
         with backoff; once retries are exhausted, quarantine the youngest
@@ -791,11 +993,13 @@ class ServingEngine:
         # one vectorized greedy/finite pass for the whole batch; the
         # [B, V] array only reaches the host for temp>0 / NaN-rescue rows
         am, fin = greedy_grid(logits)
+        self.metrics.host_syncs.inc()
         host: Dict[str, np.ndarray] = {}
 
         def _rows() -> np.ndarray:
             if "l" not in host:
-                host["l"] = np.asarray(logits)
+                host["l"] = _to_host(logits)
+                self.metrics.host_syncs.inc()
             return host["l"]
 
         events = []
@@ -949,6 +1153,7 @@ class ServingEngine:
                     self.max_prefill_tokens_per_step,
                 "enable_prefix_cache": self.enable_prefix_cache,
                 "ragged_batch": self.ragged_batch,
+                "decode_horizon": self.decode_horizon,
                 "num_speculative_tokens": self.num_speculative_tokens,
                 "spec_max_ngram": self.spec_max_ngram,
                 "spec_min_ngram": self.spec_min_ngram,
@@ -985,6 +1190,7 @@ class ServingEngine:
                       "max_prefill_tokens_per_step"),
                   enable_prefix_cache=cfg.get("enable_prefix_cache", False),
                   ragged_batch=cfg.get("ragged_batch", False),
+                  decode_horizon=cfg.get("decode_horizon", 1),
                   num_speculative_tokens=cfg.get("num_speculative_tokens", 0),
                   spec_max_ngram=cfg.get("spec_max_ngram", 3),
                   spec_min_ngram=cfg.get("spec_min_ngram", 1),

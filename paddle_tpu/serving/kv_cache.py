@@ -368,16 +368,20 @@ class SequenceKV:
             self.pages.extend(self.pool.allocator.alloc(short))
 
     def truncate(self, num_tokens: int) -> int:
-        """Roll back speculative tail state (ISSUE 5): keep only the
-        pages needed to cover ``num_tokens`` live positions and decref
-        the rest. The verify step grows the sequence for its whole
-        `k+1`-token span up front; after acceptance, the pages that only
-        ever held rejected speculative K/V are returned here — a
-        speculated page must never outlive its rejection (the auditor's
-        over-provision check pins it). Dropped pages are always private
-        (freshly grown for the span, never registered or shared), so the
-        decref sends them straight back to the free list. Returns the
-        number of pages dropped."""
+        """Roll back over-committed tail state (ISSUE 5 + 6): keep only
+        the pages needed to cover ``num_tokens`` live positions and
+        decref the rest. Two callers grow a sequence past its accepted
+        context up front and return the unused tail here: the
+        speculative verify step (pages grown for a rejected `k+1`-token
+        span — a speculated page must never outlive its rejection) and
+        the multi-step decode horizon (pages pre-committed for `s`
+        future tokens, rolled back when non-finite logits cut the
+        horizon short; a request that merely STOPS mid-horizon instead
+        releases everything through the normal finish path). The
+        auditor's over-provision check pins both. Dropped pages are
+        always private (freshly grown for the span, never registered or
+        shared), so the decref sends them straight back to the free
+        list. Returns the number of pages dropped."""
         keep = self.pool.blocks_for_tokens(max(num_tokens, 1))
         if keep < self.registered_pages:
             raise ValueError(

@@ -116,6 +116,17 @@ class EngineMetrics:
         self.spec_proposed_tokens = Counter("spec_proposed_tokens")
         self.spec_accepted_tokens = Counter("spec_accepted_tokens")
         self.spec_rollback_pages = Counter("spec_rollback_pages")
+        # multi-step decode (ISSUE 6): host_syncs counts every blocking
+        # device->host drain the engine performs (one per step on the
+        # s=1 path, one per HORIZON on the multi-step path — the number
+        # the decode_horizon knob exists to shrink);
+        # decode_horizon_steps counts device decode steps executed
+        # inside decode_multi horizons; horizon_overshoot_tokens counts
+        # drained tokens discarded because their request stopped earlier
+        # in the horizon (their pages are reclaimed on the spot)
+        self.host_syncs = Counter("host_syncs")
+        self.decode_horizon_steps = Counter("decode_horizon_steps")
+        self.horizon_overshoot_tokens = Counter("horizon_overshoot_tokens")
         self.decode_steps = Counter("decode_steps")
         self.queue_depth = Gauge("queue_depth")
         self.running = Gauge("running")
@@ -157,6 +168,12 @@ class EngineMetrics:
         p = self.spec_proposed_tokens.value
         return self.spec_accepted_tokens.value / p if p > 0 else 0.0
 
+    def host_syncs_per_token(self) -> float:
+        """Blocking device->host drains per generated token (ISSUE 6) —
+        1.0 on the per-step loop, ~1/s with decode_horizon=s."""
+        t = self.tokens_generated.value
+        return self.host_syncs.value / t if t > 0 else 0.0
+
     def steps_per_token(self) -> float:
         """Engine steps per generated token — the number speculation
         drives BELOW 1/batch-occupancy: each accepted draft token is a
@@ -187,6 +204,10 @@ class EngineMetrics:
             "spec_rollback_pages": self.spec_rollback_pages.value,
             "spec_acceptance_rate": self.spec_acceptance_rate(),
             "steps_per_token": self.steps_per_token(),
+            "host_syncs": self.host_syncs.value,
+            "host_syncs_per_token": self.host_syncs_per_token(),
+            "decode_horizon_steps": self.decode_horizon_steps.value,
+            "horizon_overshoot_tokens": self.horizon_overshoot_tokens.value,
             "decode_steps": self.decode_steps.value,
             "queue_depth": self.queue_depth.value,
             "queue_depth_peak": self.queue_depth.peak,

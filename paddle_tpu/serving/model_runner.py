@@ -9,9 +9,19 @@ step functions over the shared page pool:
   prefill(tokens[1, T], table[1, P], real_len, pools) -> (logits[V], pools)
   prefill_chunk(tokens, start_pos, table, pools)      -> (logits[V], pools)
   decode(tokens[B, 1], tables[B, P], pos[B], pools)   -> (logits[B, V], pools)
+  decode_multi(tokens[B], tables, pos[B], pools, s)   -> (packed[2, B, s], pools)
   ragged_step(tokens[B, T], tables, start[B], q_lens[B], pools)
                                                       -> (logits[B, V], pools)
   ragged_step(..., full_logits=True)              -> (logits[B, T, V], pools)
+
+`decode_multi` (ISSUE 6 tentpole) is the device-resident sampling loop:
+one jitted `lax.scan` over `s` consecutive decode steps that feeds each
+step's on-device argmax token straight back as the next input — no host
+round-trip between tokens. It returns ONE packed int32 array (row 0 the
+[B, s] greedy token buffer, row 1 the per-step all-finite flags), so the
+engine drains a horizon with a single device->host transfer instead of
+one per token. Block tables are fixed for the whole horizon: the
+scheduler pre-commits every page the s steps will write before launch.
 
 Every step writes K/V through the block table and attends through one of
 three statically-dispatched paths (`_attn_impl_for`, logged once per
@@ -248,6 +258,35 @@ class PagedModelRunner:
                                       jnp.ones((B,), jnp.int32), pools)
         return logits[:, 0], pools
 
+    def _decode_multi_step(self, params, tokens, tables, pos, pools,
+                           num_steps: int):
+        """Device-resident multi-step greedy decode (ISSUE 6 tentpole):
+        `lax.scan` over `num_steps` consecutive decode steps, each step's
+        argmax token fed back as the next step's input ON DEVICE. K/V is
+        written through the fixed block tables at per-step positions
+        pos, pos+1, ..., pos+num_steps-1 (the scheduler committed those
+        pages up front). Accumulates the [B, s] greedy token buffer and
+        a per-step all-finite flag, packed into ONE int32 array so the
+        host pays a single transfer per horizon. num_steps is static
+        (baked per jit entry); the greedy feedback is jnp.argmax, whose
+        first-max tie-break matches the host path (`greedy_grid` /
+        np.argmax — the batched-sampling pin), so a horizon is bit-exact
+        vs num_steps sequential decode()+argmax round-trips."""
+
+        def body(carry, _):
+            toks, p, pools = carry
+            logits, pools = self._decode_step(params, toks[:, None], tables,
+                                              p, pools)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            fin = jnp.all(jnp.isfinite(logits), axis=-1)
+            return (nxt, p + 1, pools), (nxt, fin)
+
+        init = (tokens.astype(jnp.int32), pos.astype(jnp.int32), pools)
+        (_, _, pools), (toks, fins) = jax.lax.scan(body, init, None,
+                                                   length=num_steps)
+        packed = jnp.stack([toks.T, fins.T.astype(jnp.int32)])  # [2, B, s]
+        return packed, pools
+
     def _ragged_core(self, params, tokens, tables, start_pos, q_lens,
                      pools):
         """One mixed ragged batch: every slot carries its own query span
@@ -288,12 +327,15 @@ class PagedModelRunner:
             return cached
         fn = {"prefill": self._prefill_step,
               "decode": self._decode_step,
+              "decode_multi": self._decode_multi_step,
               "ragged": self._ragged_step,
               "ragged_full": self._ragged_core}[kind]
-        pools_arg = {"prefill": 5, "decode": 4, "ragged": 5,
-                     "ragged_full": 5}[kind]
+        pools_arg = {"prefill": 5, "decode": 4, "decode_multi": 4,
+                     "ragged": 5, "ragged_full": 5}[kind]
         donate = (pools_arg,) if jax.default_backend() == "tpu" else ()
-        jitted = jax.jit(fn, donate_argnums=donate)
+        # decode_multi's horizon length is a lax.scan bound — static
+        static = (5,) if kind == "decode_multi" else ()
+        jitted = jax.jit(fn, donate_argnums=donate, static_argnums=static)
         self._jit_cache[key] = jitted
         logger.info("serving jit compile %s key=%s (cache entries: %d)",
                     kind, shape_key, len(self._jit_cache))
@@ -327,20 +369,43 @@ class PagedModelRunner:
                            np.asarray([start_pos]), np.asarray([t]),
                            len(table_row))
         fn = self._jitted("prefill", tb)
-        return fn(self.params, jnp.asarray(padded),
-                  jnp.asarray(np.asarray(table_row, np.int32)[None]),
-                  jnp.asarray(t, jnp.int32),
-                  jnp.asarray(start_pos, jnp.int32), pools)
+        # host operands go to the jitted fn as-is — jit commits them in
+        # one hop; a jnp.asarray(np.asarray(...)) round-trip here used to
+        # stage an extra host copy per call (ISSUE 6 satellite)
+        return fn(self.params, padded,
+                  np.asarray(table_row, np.int32)[None],
+                  np.int32(t), np.int32(start_pos), pools)
 
     def decode(self, tokens, tables, pos, pools):
         """Batched decode step; tokens [B], tables [B, P], pos [B]."""
-        pos_np = np.asarray(pos)
+        pos_np = np.asarray(pos, np.int32)
         self._account_attn(self._attn_impl_for(1), pos_np,
                            np.ones_like(pos_np),
                            np.asarray(tables).shape[1])
-        fn = self._jitted("decode", tokens.shape[0])
-        return fn(self.params, jnp.asarray(tokens)[:, None],
-                  jnp.asarray(tables), jnp.asarray(pos), pools)
+        fn = self._jitted("decode", np.asarray(tokens).shape[0])
+        return fn(self.params, np.asarray(tokens, np.int32)[:, None],
+                  tables, pos_np, pools)
+
+    def decode_multi(self, tokens, tables, pos, pools, num_steps: int):
+        """Device-resident multi-step decode (ISSUE 6): run `num_steps`
+        consecutive greedy decode steps in ONE jitted lax.scan launch,
+        feeding each step's on-device argmax back as the next input.
+        tokens [B] (the fed last tokens), tables [B, P] (must already
+        map every page positions pos .. pos+num_steps-1 will write),
+        pos [B]. Returns (packed[2, B, num_steps] int32, pools): row 0
+        the greedy token buffer, row 1 the per-step finiteness flags —
+        one host transfer drains the whole horizon."""
+        if num_steps < 1:
+            raise ValueError("decode_multi needs num_steps >= 1")
+        pos_np = np.asarray(pos, np.int32)
+        impl = self._attn_impl_for(1)
+        width = np.asarray(tables).shape[1]
+        for t in range(num_steps):      # inner step t attends at pos + t
+            self._account_attn(impl, pos_np + t, np.ones_like(pos_np),
+                               width)
+        fn = self._jitted("decode_multi", (pos_np.shape[0], num_steps))
+        return fn(self.params, np.asarray(tokens, np.int32), tables,
+                  pos_np, pools, num_steps)
 
     def ragged_step(self, tokens, tables, start_pos, q_lens, pools,
                     full_logits: bool = False):
@@ -355,12 +420,12 @@ class PagedModelRunner:
         (ISSUE 5) scores all k+1 span positions from one launch."""
         tokens = np.asarray(tokens, np.int32)
         B, T = tokens.shape
-        self._account_attn(self._attn_impl_for(T), np.asarray(start_pos),
-                           np.asarray(q_lens), np.asarray(tables).shape[1])
+        start_pos = np.asarray(start_pos, np.int32)
+        q_lens = np.asarray(q_lens, np.int32)
+        self._account_attn(self._attn_impl_for(T), start_pos, q_lens,
+                           np.asarray(tables).shape[1])
         fn = self._jitted("ragged_full" if full_logits else "ragged", (B, T))
-        return fn(self.params, jnp.asarray(tokens), jnp.asarray(tables),
-                  jnp.asarray(np.asarray(start_pos, np.int32)),
-                  jnp.asarray(np.asarray(q_lens, np.int32)), pools)
+        return fn(self.params, tokens, tables, start_pos, q_lens, pools)
 
     def _forward(self, params, tokens, positions, write_page, write_off,
                  tables, pos_q, q_lens, pools):

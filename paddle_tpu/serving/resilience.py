@@ -143,6 +143,25 @@ class FaultInjector:
             logits = self._corrupt(logits)
         return logits, pools
 
+    def decode_multi(self, tokens, tables, pos, pools, num_steps):
+        # the multi-step horizon (ISSUE 6) IS the step's decode call
+        # site — it shares the "decode" op counter like ragged_step, so
+        # a decode fault schedule keeps firing when the engine batches s
+        # steps per launch. NaN injection can't reach the logits inside
+        # the device-resident scan, so it drops the packed finiteness
+        # flags instead (every step of the call): the engine sees the
+        # horizon "go NaN" at step one, exactly like a full-vocab
+        # corruption of the first step's logits on the per-step path.
+        n = self._pre("decode")
+        packed, pools = self._runner.decode_multi(tokens, tables, pos,
+                                                  pools, num_steps)
+        if self._hits(self._nan, "decode", n):
+            self.injected["nan"] += 1
+            arr = np.array(packed, np.int32, copy=True)
+            arr[1] = 0
+            packed = arr
+        return packed, pools
+
     def ragged_step(self, tokens, tables, start_pos, q_lens, pools,
                     full_logits: bool = False):
         # the fused chunk+decode call (engine ragged_batch mode, ISSUE 4)
@@ -235,16 +254,19 @@ def audit_engine(engine) -> None:
         if len(req.kv.pages) > engine.max_pages_per_seq:
             problems.append(f"{req.request_id} holds {len(req.kv.pages)} "
                             f"pages > max_pages_per_seq")
-        # no speculative page survives rejection (ISSUE 5): between
-        # steps a sequence may hold at most the pages its full context
-        # plus one upcoming token needs — a verify span's rejected-tail
-        # pages must have been truncated back before the step ended
+        # no over-committed page survives its step (ISSUE 5 + 6):
+        # between steps a sequence may hold at most the pages its full
+        # context plus one upcoming token needs — a verify span's
+        # rejected tail AND a decode horizon's pre-committed pages must
+        # both have been reclaimed (truncate / finish-release) by the
+        # time the step ends, whether the tokens were rejected, the
+        # request stopped mid-horizon, or a NaN cut the horizon short
         cap = engine.pool.blocks_for_tokens(req.num_context + 1)
         if len(req.kv.pages) > cap:
             problems.append(
                 f"{req.request_id} holds {len(req.kv.pages)} pages > "
-                f"{cap} needed for context+1 — speculative pages "
-                "survived rejection")
+                f"{cap} needed for context+1 — speculative/horizon "
+                "pages survived rejection")
         for p in req.kv.pages:
             owner_counts[p] = owner_counts.get(p, 0) + 1
     cached = set(cache.pages()) if cache is not None else set()

@@ -28,6 +28,15 @@ running requests' outstanding context into chunks under that budget
 for more than one chunk budget per step. Admission maps the longest
 cached page-aligned prefix from the pool's PrefixCache before
 allocating the remainder.
+
+ISSUE 6 adds multi-step decode planning: `plan_decode_horizon(s)`
+pre-commits the KV pages the next `s` decode tokens of EVERY
+decode-phase request will write, so the engine can run `s` device steps
+back-to-back (`runner.decode_multi`) without touching the host. The
+horizon degrades, never thrashes: when the free list or the admission
+watermark can't fund the extra pages, `s` is trimmed down (to 1 in the
+worst case) instead of preempting anyone — preemption stays the
+exclusive business of `reserve_decode()`, which must have run first.
 """
 
 from __future__ import annotations
@@ -98,6 +107,10 @@ class Request:                # requests by object, never by field value
     # "prefill" until the chunk that completes the context samples its
     # token, then "decode"; reset at every (re-)admission
     phase: str = "prefill"
+    # set when a multi-step horizon hit non-finite logits it could not
+    # rescue without the row (nan_policy="greedy"): the next engine step
+    # takes the per-step path once, which refetches real logits
+    defer_horizon: bool = False
     admission_index: int = -1              # set fresh at every admission
     num_preemptions: int = 0
     arrival_time: float = 0.0
@@ -302,6 +315,40 @@ class FCFSScheduler:
                 req.kv.grow(1 + k)
                 total += k
         return total
+
+    # ------------------------------------------------- multi-step decode
+
+    def plan_decode_horizon(self, s: int) -> int:
+        """Pre-commit pages for up to `s` future decode tokens per
+        decode-ready request (ISSUE 6): the multi-step device loop
+        writes K/V for its whole horizon against block tables that are
+        FIXED at launch, so every page must exist before the call.
+        Trims `s` down — NEVER preempting — whenever the free list or
+        the admission watermark cannot fund the extra pages: a tight
+        pool degrades the horizon back toward per-step decode instead
+        of evicting anyone. Assumes reserve_decode() already funded
+        step one (s=1 needs no new pages by that invariant). Grows
+        every decode-ready sequence to the returned effective horizon
+        and returns it (0 with no decode-ready requests)."""
+        batch = self.decode_ready()
+        if not batch:
+            return 0
+        s = max(1, int(s))
+        alloc = self.pool.allocator
+        while s > 1:
+            short = sum(r.kv.pages_short(s) for r in batch)
+            if short == 0:
+                break
+            used_live = (alloc.num_usable - alloc.num_free
+                         - alloc.num_evictable)
+            if (alloc.can_alloc(short)
+                    and used_live + short <= self._watermark_pages):
+                break
+            s -= 1
+        if s > 1:
+            for r in batch:
+                r.kv.grow(s)
+        return s
 
     # -------------------------------------------------------- preemption
 

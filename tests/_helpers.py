@@ -83,6 +83,40 @@ class StubPagedRunner:
             out[b] = self._logits(hist)
         return jnp.asarray(out), [(jnp.asarray(k), v)]
 
+    def decode_multi(self, tokens, tables, pos, pools, num_steps):
+        """Device-resident horizon (ISSUE 6): num_steps consecutive
+        decode steps, each argmax token fed back as the next input,
+        history gathered from the pool every step — so a missing
+        pre-committed horizon page, a stale table, or a wrong feedback
+        token changes the buffer and breaks oracle equality. Returns
+        the packed [2, B, s] (tokens, finite-flags) buffer the real
+        runner's scan emits."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        (k, v), = pools
+        k = np.array(k)
+        tokens = np.asarray(tokens).copy()
+        tables = np.asarray(tables)
+        pos = np.asarray(pos).copy()
+        B = tokens.shape[0]
+        toks = np.zeros((B, num_steps), np.int32)
+        fins = np.zeros((B, num_steps), np.int32)
+        for t in range(num_steps):
+            for b in range(B):
+                p = int(pos[b])
+                page = int(tables[b, p // self.block_size])
+                k[page, p % self.block_size, 0, 0] = float(tokens[b])
+                hist = [k[int(tables[b, i // self.block_size]),
+                          i % self.block_size, 0, 0] for i in range(p + 1)]
+                row = self._logits(hist)
+                toks[b, t] = int(np.argmax(row))
+                fins[b, t] = int(np.all(np.isfinite(row)))
+            tokens = toks[:, t].copy()
+            pos += 1
+        return (jnp.asarray(np.stack([toks, fins])),
+                [(jnp.asarray(k), v)])
+
     def ragged_step(self, tokens, tables, start_pos, q_lens, pools,
                     full_logits=False):
         """Mixed ragged batch (fused chunk+decode and the ISSUE-5 verify

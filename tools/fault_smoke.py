@@ -42,6 +42,16 @@ call site's retry/quarantine. --attn-impl picks the attention path
 the ragged paged-attention kernel in interpret mode for a CPU-only
 kernel-path drill). Records report the attention-bytes counters.
 
+ISSUE 6: `--decode-horizon N` drills all six fault classes with the
+device-resident multi-step decode loop on: pure-greedy decode batches
+run up to N device steps per host sync (`runner.decode_multi`, wrapped
+by FaultInjector on the decode op counter — injected errors hit the
+horizon launch, injected NaN drops the packed finiteness flags), and
+recovery must stay token-exact with zero leaked pre-committed horizon
+pages. Records add host_syncs / host_syncs_per_token /
+decode_horizon_steps / horizon_overshoot_tokens. Mutually exclusive
+with --speculate (speculative batches fall back to per-step decode).
+
 ISSUE 5: `--speculate [K]` (K defaults to 4) drills every fault class
 with speculative decoding ON: decode rides n-gram verify spans through
 the full-logits ragged call — the same decode-op fault schedules now
@@ -77,6 +87,7 @@ def build_engine(runner, args, **kw):
     kw.setdefault("max_prefill_tokens_per_step", args.chunk or None)
     kw.setdefault("ragged_batch", args.ragged_batch)
     kw.setdefault("num_speculative_tokens", args.speculate)
+    kw.setdefault("decode_horizon", args.decode_horizon)
     return ServingEngine(runner, **kw)
 
 
@@ -186,6 +197,10 @@ def run_class(fault: str, runner, args) -> dict:
         "spec_accepted_tokens": m["spec_accepted_tokens"],
         "spec_acceptance_rate": m["spec_acceptance_rate"],
         "steps_per_token": m["steps_per_token"],
+        "host_syncs": m["host_syncs"],
+        "host_syncs_per_token": m["host_syncs_per_token"],
+        "decode_horizon_steps": m["decode_horizon_steps"],
+        "horizon_overshoot_tokens": m["horizon_overshoot_tokens"],
         "injected": dict(getattr(target, "injected", {})) or None,
     }
 
@@ -221,6 +236,10 @@ def main() -> int:
                          "tokens per verify span (bare flag: K=4; "
                          "default: off) — half the prompts become "
                          "periodic so proposals fire")
+    ap.add_argument("--decode-horizon", type=int, default=1, metavar="N",
+                    help="multi-step decode: sync with the host every N "
+                         "steps on pure-greedy decode batches "
+                         "(runner.decode_multi; default 1 = per-step)")
     ap.add_argument("--attn-impl", default="auto",
                     choices=("auto", "pallas", "ragged", "reference"),
                     help="attention path (auto: kernels on TPU, gather "
