@@ -708,6 +708,118 @@ def child_serving_multistep(layers: int, hidden: int, max_batch: int,
                                        else 0.0)})
 
 
+def child_serving_tp(layers: int, hidden: int, max_batch: int,
+                     requests: int, prompt: int, gen: int, vocab: int):
+    """Tensor-parallel serving rung (ISSUE 7): the same closed-batch
+    GQA-Llama workload swept over mesh shapes (data=1, tp in {1, 2, 4},
+    capped by the backend's device count and the kv-head divisibility
+    rule). Per arm: tokens/s, the PER-SHARD instrumented attention
+    bytes (must be single-device/tp — the bandwidth acceptance number),
+    per-shard pool bytes, and the host-array call-prep microbench
+    extended to the mesh path (PR 6 satellite follow-on): staging all
+    of a decode call's host operands in ONE replicated device_put vs
+    the naive one-device_put-per-array spelling, us/call. On the CPU
+    proxy the wall-clock multiplier is muted (one process emulates all
+    shards); the structural numbers (bytes/tp, prep cost) carry."""
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import Llama, LlamaConfig
+    from paddle_tpu.parallel.mesh import serving_mesh
+    from paddle_tpu.serving import (
+        LlamaRunner, SamplingParams, ServingEngine,
+    )
+
+    backend = jax.default_backend()
+    paddle.seed(0)
+    max_len = prompt + gen
+    heads = max(hidden // 64, 4)
+    n_kv = 4 if heads % 4 == 0 else heads
+    cfg = LlamaConfig(vocab_size=vocab, hidden_size=hidden,
+                      num_layers=layers, num_heads=heads, num_kv_heads=n_kv,
+                      max_seq_len=max_len, dropout=0.0)
+    model = Llama(cfg)
+    model.eval()
+    block_size = min(16, max_len)
+    pages_per_seq = -(-max_len // block_size)
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, vocab, prompt)) for _ in range(requests)]
+    n_dev = len(jax.devices())
+    tps = [t for t in (1, 2, 4) if t <= n_dev and n_kv % t == 0]
+
+    def prep_microbench(runner) -> dict:
+        toks = np.zeros((max_batch,), np.int32)
+        tabs = np.zeros((max_batch, pages_per_seq), np.int32)
+        pos = np.zeros((max_batch,), np.int32)
+        iters = 200
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            runner._stage(toks, tabs, pos)
+        staged = (time.perf_counter() - t0) / iters * 1e6
+        per_array = None
+        if runner.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            sh = NamedSharding(runner.mesh, PartitionSpec())
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                for a in (toks, tabs, pos):
+                    jax.device_put(a, sh)
+            per_array = (time.perf_counter() - t0) / iters * 1e6
+        return {"staged_us_per_call": round(staged, 2),
+                "per_array_us_per_call": (round(per_array, 2)
+                                          if per_array is not None
+                                          else None)}
+
+    def run_arm(tp: int) -> dict:
+        runner = LlamaRunner(model, block_size=block_size,
+                             max_model_len=max_len)
+        if tp > 1:
+            runner.shard(serving_mesh(data=1, model=tp))
+
+        def once() -> dict:
+            runner.reset_attn_counters()
+            eng = ServingEngine(runner,
+                                num_blocks=max_batch * pages_per_seq + 1,
+                                max_batch_size=max_batch,
+                                max_model_len=max_len)
+            t0 = time.time()
+            for i, p in enumerate(prompts):
+                eng.add_request(p, SamplingParams(max_tokens=gen),
+                                request_id=f"r{i}")
+            eng.run()
+            wall = time.time() - t0
+            snap = eng.metrics.snapshot()
+            return {"tp": tp, "wall_s": round(wall, 3),
+                    "tokens_per_sec": snap["tokens_generated"] / wall,
+                    "tokens_generated": snap["tokens_generated"],
+                    "attn_kv_bytes_read_per_shard":
+                        snap["attn_kv_bytes_read"],
+                    "per_shard_pool_bytes":
+                        eng.pool.per_shard_memory_bytes(),
+                    "pool_bytes_total": eng.pool.memory_bytes()}
+
+        once()                                 # warmup: compiles this mesh
+        arm = once()
+        arm["call_prep"] = prep_microbench(runner)
+        return arm
+
+    arms = [run_arm(t) for t in tps]
+    base = arms[0]
+    _write_child({"backend": backend, "layers": layers, "hidden": hidden,
+                  "heads": heads, "n_kv_heads": n_kv,
+                  "max_batch": max_batch, "requests": requests,
+                  "prompt": prompt, "gen": gen, "workload": "tp",
+                  "devices": n_dev, "arms": arms,
+                  "attn_bytes_per_shard_ratio": [
+                      (base["attn_kv_bytes_read_per_shard"]
+                       / a["attn_kv_bytes_read_per_shard"])
+                      if a["attn_kv_bytes_read_per_shard"] else 0.0
+                      for a in arms]})
+
+
 def _write_child(obj: dict) -> None:
     with open(os.environ["BENCH_CHILD_OUT"], "w") as f:
         json.dump(obj, f)
@@ -999,6 +1111,33 @@ def main():
                 f"({r['host_syncs_reduction_x']:.1f}x fewer), tokens/s "
                 f"{r['tokens_per_sec_x']:.2f}x at s=8")
 
+    # tensor-parallel serving rung (ISSUE 7): mesh-shape sweep — the
+    # carried-over "committed on-TPU sharded number" lands here the
+    # first healthy tunnel window. On a single-chip tunnel only the
+    # tp=1 arm runs (the child caps tp at the device count); the
+    # structural per-shard-bytes ratio is committed either way.
+    if on_tpu and remaining() > 120:
+        r = run_child("serving:4:512:4:8:48:32:32768:tp",
+                      min(900, remaining()))
+        if r is not None:
+            for arm in r["arms"]:
+                line = {"metric": f"serving_tp_tokens_per_sec_tp{arm['tp']}",
+                        "value": round(arm["tokens_per_sec"], 1),
+                        "unit": "tokens/s", "vs_baseline": 0.0,
+                        "tp": arm["tp"],
+                        "attn_kv_bytes_read_per_shard":
+                            arm["attn_kv_bytes_read_per_shard"],
+                        "per_shard_pool_bytes": arm["per_shard_pool_bytes"],
+                        "call_prep_staged_us":
+                            arm["call_prep"]["staged_us_per_call"],
+                        "backend": r["backend"]}
+                emit(line)
+                _cache_result(line)
+            log(f"tp rung: arms tp={[a['tp'] for a in r['arms']]}, "
+                f"tokens/s {[round(a['tokens_per_sec']) for a in r['arms']]},"
+                f" per-shard bytes ratio "
+                f"{[round(x, 2) for x in r['attn_bytes_per_shard_ratio']]}")
+
     if best is not None:
         # headline repeated last: drivers that parse the final stdout JSON
         # line get the largest completed config
@@ -1042,6 +1181,8 @@ def _child_main(mode: str) -> None:
             child_serving_spec(*[int(x) for x in parts[:-1]])
         elif parts and parts[-1] == "multistep":
             child_serving_multistep(*[int(x) for x in parts[:-1]])
+        elif parts and parts[-1] == "tp":
+            child_serving_tp(*[int(x) for x in parts[:-1]])
         else:
             child_serving(*[int(x) for x in parts])
     else:

@@ -175,12 +175,17 @@ def create_serving_engine(model, dtype=None, **kw):
     + `shed_policy` bound the admission queue; `admission_watermark` caps
     pool pressure; `max_step_retries`/`retry_backoff_s` recover transient
     runner failures; `nan_policy` guards sampling; `audit=True` runs the
-    invariant auditor after every step."""
+    invariant auditor after every step.
+
+    `mesh=` (a `(data, model)` jax mesh — parallel.mesh.serving_mesh)
+    serves tensor-parallel (ISSUE 7): weights and the paged K/V pools
+    shard over the model axis, token streams unchanged."""
     import jax.numpy as jnp
 
     from paddle_tpu.serving import ServingEngine
     from paddle_tpu.serving.model_runner import runner_for
 
+    mesh = kw.pop("mesh", None)
     runner = runner_for(model,
                         **{k: kw.pop(k) for k in
                            ("block_size", "max_model_len", "attn_impl")
@@ -189,24 +194,33 @@ def create_serving_engine(model, dtype=None, **kw):
         runner.params = {
             k: (v.astype(dtype) if jnp.issubdtype(v.dtype, jnp.floating)
                 else v) for k, v in runner.params.items()}
+    if mesh is not None:
+        # cast first, shard second: the device_put then ships the final
+        # serving dtype, not fp32 weights that get re-cast on device
+        runner.shard(mesh)
     kw.setdefault("num_blocks", 128)
     return ServingEngine(runner, **kw)
 
 
-def restore_serving_engine(model, state, attn_impl: str = "auto", **kw):
+def restore_serving_engine(model, state, attn_impl: str = "auto",
+                           mesh=None, **kw):
     """Rebuild a crashed/killed serving engine from `engine.snapshot()`.
 
     The crash-recovery twin of create_serving_engine: builds a fresh
     runner for `model` (the weights the snapshot was serving) and replays
     all serialized request state through ServingEngine.restore — every
     in-flight request resumes via recompute-on-resume, token-for-token
-    identical to an uninterrupted run."""
+    identical to an uninterrupted run. Pass `mesh=` to restore onto a
+    tensor-parallel runner; recompute-on-resume is sharding-agnostic, so
+    the mesh may differ from the snapshot's (config["mesh_axes"])."""
     from paddle_tpu.serving import ServingEngine
     from paddle_tpu.serving.model_runner import runner_for
 
     runner = runner_for(model, block_size=state["config"]["block_size"],
                         max_model_len=state["config"]["max_model_len"],
                         attn_impl=attn_impl)
+    if mesh is not None:
+        runner.shard(mesh)
     return ServingEngine.restore(runner, state, **kw)
 
 

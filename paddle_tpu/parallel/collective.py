@@ -163,8 +163,33 @@ def broadcast(tensor: Tensor, src=0, group=None, sync_op=True):
     return tensor
 
 
+_BARRIER_SEQ = [0]
+
+
 def barrier(group=None):
+    """Fence local device work; in a multi-process world, additionally
+    rendezvous every rank through the global TCPStore (an arrival
+    counter per barrier sequence). Store requests are request/response
+    on one ordered connection per rank, so a rank's pre-barrier
+    `store.set` is server-applied before its arrival mark — every
+    rank's pre-barrier writes are visible to every rank after barrier()
+    returns (pinned by test_cross_process_barrier_orders_effects; the
+    old local-fence-only spelling only held by timing luck)."""
     jax.block_until_ready(jnp.zeros(()))
+    from paddle_tpu.parallel import env as _env
+
+    if not _env.is_initialized() or _env.get_world_size() <= 1:
+        return
+    import time as _time
+
+    store, _rank = _p2p_store()
+    world = _env.get_world_size()
+    seq = _BARRIER_SEQ[0]
+    _BARRIER_SEQ[0] += 1
+    key = f"barrier/{seq}"
+    if store.add(key, 1) < world:
+        while store.add(key, 0) < world:
+            _time.sleep(0.001)
 
 
 def get_rank(group=None) -> int:
@@ -250,7 +275,9 @@ def send_in(x, axis: str, dst_offset: int = 1):
     """In-jit: send this rank's block `dst_offset` ranks forward along the
     axis ring; returns what this rank RECEIVES (collective_permute
     semantics — every rank participates)."""
-    n = lax.axis_size(axis)
+    from paddle_tpu.parallel.pipeline import axis_size
+
+    n = axis_size(axis)
     perm = [(i, (i + dst_offset) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
 

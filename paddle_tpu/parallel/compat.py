@@ -447,6 +447,79 @@ class SplitPoint:
     END = "end"
 
 
+# ------------------------------------------------- serving spec layout
+
+
+def _spec_layout():
+    from dataclasses import dataclass
+
+    from jax.sharding import PartitionSpec as PS
+
+    @dataclass(frozen=True)
+    class SpecLayout:
+        """Canonical PartitionSpecs for a tensor-parallel decoder over a
+        `(data, model)` serving mesh (ISSUE 7).
+
+        The spec shapes are exactly the ColWiseParallel / RowWiseParallel
+        placements above, named per decoder weight role so the serving
+        model runner can build a full param->spec table from one object:
+
+          embeddings        vocab-sharded over `model`, replicated over
+                            `data` (the SNIPPETS SpecLayout convention);
+          column_parallel   [in, out] with OUT sharded — QKV projections,
+                            MLP up/gate: each shard computes its own head
+                            / hidden slice, no communication;
+          row_parallel      [in, out] with IN sharded — attention
+                            out-proj, MLP down-proj: partial products
+                            allreduce on the row output (GSPMD inserts
+                            the psum), the one collective per sublayer;
+          kv_pool           the paged K/V pools sharded on the kv-head
+                            axis ([blocks, block_size, n_kv, d]): GQA
+                            splits naturally, every shard walks its own
+                            kv-head slice of the SAME page ids;
+          replicated        norms, biases, block tables, token/pos
+                            operands — identical on every shard.
+
+        `data` is the replica axis: serving state (weights, pools) is
+        replicated over it; it exists so the same mesh can later carry
+        data-parallel engine replicas (ROADMAP router tier) without a
+        re-shard.
+        """
+
+        data_axis: str = "data"
+        model_axis: str = "model"
+
+        def replicated(self) -> PS:
+            return PS()
+
+        def embeddings(self) -> PS:
+            return PS(self.model_axis, None)
+
+        def column_parallel(self) -> PS:
+            # ColWiseParallel's placement: P(None, tp)
+            return PS(None, self.model_axis)
+
+        def row_parallel(self) -> PS:
+            # RowWiseParallel's placement: P(tp, None)
+            return PS(self.model_axis, None)
+
+        def bias_column(self) -> PS:
+            return PS(self.model_axis)
+
+        def heads(self) -> PS:
+            """[B, T, heads, d] activations: heads ride the model axis."""
+            return PS(None, None, self.model_axis, None)
+
+        def kv_pool(self) -> PS:
+            """[blocks, block, n_kv, d]: kv-heads ride the model axis."""
+            return PS(None, None, self.model_axis, None)
+
+    return SpecLayout
+
+
+SpecLayout = _spec_layout()
+
+
 class SequenceParallelBegin(_PlanBase):
     """Sequence-parallel region markers (reference SequenceParallel*):
     under GSPMD the scatter/gather constraints are applied per layer.

@@ -73,6 +73,30 @@ def mesh_scope(mesh: Mesh):
         _current_mesh = prev
 
 
+def serving_mesh(data: int = 1, model: int = 1,
+                 devices: Optional[Sequence] = None,
+                 data_axis: str = "data",
+                 model_axis: str = "model") -> Mesh:
+    """Build the serving `(data, model)` mesh (ISSUE 7) WITHOUT
+    installing it globally: the serving engine owns its mesh explicitly
+    (runner.shard(mesh)), so a training mesh in the same process is
+    never clobbered. Uses the first data*model devices when `devices`
+    is not given — on the 8-way CPU test mesh that makes tp=2/4
+    sub-meshes cheap to build."""
+    if data < 1 or model < 1:
+        raise ValueError(f"mesh axes must be >= 1, got data={data} "
+                         f"model={model}")
+    if devices is None:
+        devices = jax.devices()
+    n = data * model
+    if n > len(devices):
+        raise ValueError(f"serving mesh ({data_axis}={data}, "
+                         f"{model_axis}={model}) needs {n} devices, "
+                         f"have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(data, model)
+    return Mesh(arr, (data_axis, model_axis))
+
+
 class ProcessMesh:
     """paddle.distributed.ProcessMesh-compatible facade over jax Mesh."""
 

@@ -493,7 +493,9 @@ def schedule_stats(pp: int, m: int, schedule: str = "gpipe", v: int = 1):
     raise ValueError(f"unknown schedule {schedule!r}")
 
 
-from paddle_tpu.parallel.pipeline import chain_stages, varying as _varying  # noqa: E402
+from paddle_tpu.parallel.pipeline import (  # noqa: E402
+    chain_stages, compat_shard_map, varying as _varying,
+)
 
 
 # ----------------------------------------------------------- interleave apply
@@ -587,7 +589,7 @@ def pipeline_apply_interleave(stage_fn: Callable[[Any, Any], Any],
         (_, outbuf, _), _ = lax.scan(tick, init, tab)
         return outbuf
 
-    mapped = shard_map(
+    mapped = compat_shard_map(
         per_device, mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), re), P()),
         out_specs=P("pp"),
@@ -737,7 +739,7 @@ def pipeline_1f1b(stage_fn: Callable[[Any, Any], Any], stacked_params,
         dx = lax.psum(dx_buf * first_mask, "pp")
         return loss, gparams, ghead, dx
 
-    mapped = shard_map(
+    mapped = compat_shard_map(
         per_device, mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), stacked_params),
                   jax.tree_util.tree_map(lambda _: P(), head_params),
@@ -922,7 +924,7 @@ def pipeline_zbh1(stage_fn: Callable[[Any, Any], Any], stacked_params,
         dx = lax.psum(dx_buf * first_mask, "pp")
         return loss, gparams, ghead, dx
 
-    mapped = shard_map(
+    mapped = compat_shard_map(
         per_device, mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), stacked_params),
                   jax.tree_util.tree_map(lambda _: P(), head_params),
@@ -1132,7 +1134,7 @@ def pipeline_zbvpp(stage_fn: Callable[[Any, Any], Any], stacked_params,
         dx = lax.psum(dx_buf * first_mask, "pp")
         return loss, gparams, ghead, dx
 
-    mapped = shard_map(
+    mapped = compat_shard_map(
         per_device, mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), re),
                   jax.tree_util.tree_map(lambda _: P(), head_params),

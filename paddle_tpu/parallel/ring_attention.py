@@ -23,15 +23,14 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from paddle_tpu.parallel.pipeline import compat_shard_map
 
 
 def _ring_attention_local(q, k, v, axis: str, causal: bool, scale):
     """Per-device body. q/k/v: [b, s_local, h, d] local shards."""
-    n = lax.axis_size(axis)
+    from paddle_tpu.parallel.pipeline import axis_size
+
+    n = axis_size(axis)
     idx = lax.axis_index(axis)
     b, sq, h, d = q.shape
     scale = scale or (1.0 / math.sqrt(d))
@@ -88,7 +87,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
     body = partial(_ring_attention_local, axis=axis, causal=causal,
                    scale=scale)
     spec = P(None, axis, None, None)
-    mapped = shard_map(
+    mapped = compat_shard_map(
         body, mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -60,6 +60,15 @@ gather + dense-mask reference path on CPU — the same dual dispatch every
 kernel in ops/pallas uses, so the whole engine runs (and is tested)
 under JAX_PLATFORMS=cpu.
 
+Tensor-parallel serving (ISSUE 7): `runner.shard(mesh)` over a
+`(data, model)` mesh (parallel.mesh.serving_mesh) shards the weights
+Megatron-style and the paged K/V pools along the kv-head axis — each
+model shard walks its own kv-head slice of the SAME page ids (Pallas
+kernels per-shard via shard_map, reference path via GSPMD), while the
+allocator, scheduler, block tables, and PrefixCache stay host-side and
+replicated. Token streams are identical to the single-device engine;
+per-shard pool and attention bytes drop to 1/tp.
+
 Entry points: `paddle_tpu.inference.create_serving_engine(model)` is the
 bridge from the Predictor world; `tools/serving_smoke.py` is a runnable
 demo; `bench.py --child serving:...` drives the offered-load sweep.
@@ -90,6 +99,10 @@ from paddle_tpu.serving.scheduler import (  # noqa: F401
     FCFSScheduler, Request, RequestState, SamplingParams,
 )
 from paddle_tpu.serving.speculate import NgramProposer  # noqa: F401
+# the serving (data, model) mesh builder + spec layout (ISSUE 7) live in
+# parallel/ — re-exported here because they are the TP serving surface
+from paddle_tpu.parallel.mesh import serving_mesh  # noqa: F401
+from paddle_tpu.parallel.compat import SpecLayout  # noqa: F401
 
 __all__ = [
     "BlockAllocator", "Counter", "EngineMetrics", "FCFSScheduler",
@@ -98,7 +111,8 @@ __all__ = [
     "LlamaRunner", "NgramProposer", "PagedModelRunner", "PrefixCache",
     "QueueFullError", "Request", "RequestOutput", "RequestState",
     "SCRATCH_PAGE", "SamplingParams", "SequenceKV", "ServingEngine",
-    "StreamDetokenizer", "TokenEvent", "audit_engine", "bucket_len",
-    "complete_utf8_prefix", "create_engine", "greedy_grid",
+    "SpecLayout", "StreamDetokenizer", "TokenEvent", "audit_engine",
+    "bucket_len", "complete_utf8_prefix", "create_engine", "greedy_grid",
     "naive_generate", "page_content_hash", "runner_for", "sample_token",
+    "serving_mesh",
 ]

@@ -71,6 +71,7 @@ proves page/slot/block-table consistency after every step.
 
 from __future__ import annotations
 
+import logging
 import os
 import time
 from dataclasses import asdict, dataclass
@@ -90,6 +91,8 @@ from paddle_tpu.serving.scheduler import (
     ensure_arrival_counter_above,
 )
 from paddle_tpu.serving.speculate import NgramProposer
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -236,6 +239,14 @@ class ServingEngine:
                            decode([tok])) enabling stream_text():
                            incremental detokenization that buffers
                            until a byte-complete UTF-8 boundary
+
+    Tensor parallelism (ISSUE 7) is a RUNNER property, not an engine
+    knob: pass a sharded runner (`runner.shard(mesh)`, or
+    `create_engine(model, mesh=...)`) and the engine builds its K/V
+    pools kv-head-sharded over the runner's mesh. Everything host-side
+    — scheduler, block tables, refcounts, prefix cache, retries,
+    snapshots — is mesh-blind, and token streams are identical to the
+    single-device engine.
     """
 
     def __init__(self, runner: PagedModelRunner, *, num_blocks: int,
@@ -276,9 +287,16 @@ class ServingEngine:
                              "'abort' or 'greedy'")
         if max_queue_depth is not None and max_queue_depth < 1:
             raise ValueError("max_queue_depth must be >= 1 (None = unbounded)")
+        # a sharded runner (runner.shard(mesh), ISSUE 7) brings its mesh
+        # along: the K/V pools are then born split on the kv-head axis
+        # over the model axis — everything host-side (allocator, block
+        # tables, scheduler, PrefixCache) stays replicated and mesh-blind
+        self.mesh = getattr(runner, "mesh", None)
         self.pool = KVCachePool(runner.num_layers, num_blocks, block_size,
                                 runner.n_kv_heads, runner.head_dim,
-                                runner.dtype)
+                                runner.dtype, mesh=self.mesh,
+                                model_axis=getattr(runner, "model_axis",
+                                                   "model"))
         self.enable_prefix_cache = bool(enable_prefix_cache)
         if self.enable_prefix_cache:
             self.pool.enable_prefix_cache()
@@ -1157,6 +1175,13 @@ class ServingEngine:
                 "num_speculative_tokens": self.num_speculative_tokens,
                 "spec_max_ngram": self.spec_max_ngram,
                 "spec_min_ngram": self.spec_min_ngram,
+                # mesh shape rides along for the record (ISSUE 7); the
+                # restored engine follows the NEW runner's mesh — the
+                # recompute-on-resume path is sharding-agnostic, so a
+                # tp=2 snapshot restores token-exactly on tp=1/2/4
+                "mesh_axes": (
+                    {str(a): int(s) for a, s in self.mesh.shape.items()}
+                    if self.mesh is not None else None),
             },
             "requests": reqs,
             "finished": [asdict(o) for o in self._outputs.values()],
@@ -1218,6 +1243,14 @@ class ServingEngine:
         for o in state.get("finished", []):
             eng._outputs[o["request_id"]] = RequestOutput(**o)
         eng.metrics.queue_depth.set(eng.scheduler.queue_depth)
+        snap_mesh = cfg.get("mesh_axes")
+        run_mesh = ({str(a): int(s) for a, s in eng.mesh.shape.items()}
+                    if eng.mesh is not None else None)
+        if snap_mesh != run_mesh:
+            # legal (recompute-on-resume is sharding-agnostic and token-
+            # exact) but worth a breadcrumb: capacity/throughput differ
+            logger.info("restore: snapshot mesh %s -> runner mesh %s",
+                        snap_mesh, run_mesh)
         return eng
 
 
@@ -1258,10 +1291,19 @@ def naive_generate(runner: PagedModelRunner, prompt_tokens: Sequence[int],
 def create_engine(model, *, num_blocks: int = 128,
                   block_size: int = 16, max_batch_size: int = 8,
                   max_model_len: Optional[int] = None,
-                  attn_impl: str = "auto", **engine_kw) -> ServingEngine:
-    """Build a ServingEngine for a supported decoder Layer (Llama, GPT)."""
+                  attn_impl: str = "auto", mesh=None,
+                  data_axis: str = "data", model_axis: str = "model",
+                  **engine_kw) -> ServingEngine:
+    """Build a ServingEngine for a supported decoder Layer (Llama, GPT).
+
+    Pass a `(data, model)` jax mesh (parallel.mesh.serving_mesh) to serve
+    tensor-parallel (ISSUE 7): weights and the paged K/V pools shard over
+    the model axis; token streams stay identical to the single-device
+    engine. n_kv_heads must divide by the model-axis degree."""
     runner = runner_for(model, block_size=block_size,
                         max_model_len=max_model_len, attn_impl=attn_impl)
+    if mesh is not None:
+        runner.shard(mesh, data_axis=data_axis, model_axis=model_axis)
     return ServingEngine(runner, num_blocks=num_blocks,
                          block_size=block_size,
                          max_batch_size=max_batch_size,

@@ -279,21 +279,50 @@ class KVCachePool:
     (functional update: the runner returns new pools, the engine writes
     them back here). Block tables live host-side as python lists per
     sequence; `pad_table` builds the fixed-shape device operand.
+
+    With a `mesh` (ISSUE 7) the pools are BORN sharded along the kv-head
+    axis over the mesh's model axis: each model shard holds every page's
+    slice of n_kv_heads/tp heads, so per-shard pool HBM is the single-
+    device pool / tp — the capacity win TP serving exists for. The
+    allocator, block tables, and PrefixCache are deliberately mesh-blind:
+    one page id names the same page on every shard, so all refcount /
+    COW / eviction logic is identical to the single-device engine.
     """
 
     def __init__(self, num_layers: int, num_blocks: int, block_size: int,
-                 n_kv_heads: int, head_dim: int, dtype=jnp.float32):
+                 n_kv_heads: int, head_dim: int, dtype=jnp.float32,
+                 mesh=None, model_axis: str = "model"):
         self.num_layers = num_layers
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.n_kv_heads = n_kv_heads
         self.head_dim = head_dim
         self.dtype = dtype
+        self.mesh = mesh
+        self.model_axis = model_axis
+        self.tp_size = 1
         self.allocator = BlockAllocator(num_blocks)
         self.prefix_cache: Optional[PrefixCache] = None
         shape = (num_blocks, block_size, n_kv_heads, head_dim)
-        self.pools = [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
-                      for _ in range(num_layers)]
+        if mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self.tp_size = int(mesh.shape[model_axis])
+            if n_kv_heads % self.tp_size:
+                raise ValueError(
+                    f"n_kv_heads={n_kv_heads} is not divisible by the "
+                    f"model-axis degree {self.tp_size}: the paged pools "
+                    "shard in whole kv-heads (GQA rule)")
+            sharding = NamedSharding(
+                mesh, PartitionSpec(None, None, model_axis, None))
+            self.pools = [
+                (jax.device_put(jnp.zeros(shape, dtype), sharding),
+                 jax.device_put(jnp.zeros(shape, dtype), sharding))
+                for _ in range(num_layers)]
+        else:
+            self.pools = [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                          for _ in range(num_layers)]
 
     def enable_prefix_cache(self) -> PrefixCache:
         """Turn on shared-prefix page caching (idempotent)."""
@@ -325,9 +354,17 @@ class KVCachePool:
         return 1.0 - a.num_free / a.num_usable
 
     def memory_bytes(self) -> int:
+        """Total logical pool bytes across the whole mesh (the single-
+        device number — sharding never changes it)."""
         itemsize = jnp.zeros((), self.dtype).dtype.itemsize
         return (2 * self.num_layers * self.num_blocks * self.block_size
                 * self.n_kv_heads * self.head_dim * itemsize)
+
+    def per_shard_memory_bytes(self) -> int:
+        """Pool bytes ONE model shard holds: total / tp (each shard
+        stores its n_kv/tp kv-head slice of every page) — the ISSUE 7
+        capacity acceptance number."""
+        return self.memory_bytes() // self.tp_size
 
 
 class SequenceKV:

@@ -48,6 +48,22 @@ case, so both share one jit cache keyed by the chunk-length bucket, and
 `start_pos` rides in as a traced scalar (no recompile per offset). The
 jit cache logs every compile and can be capped via the
 PADDLE_TPU_MAX_JIT_CACHE env var (LRU eviction; 0/unset = unbounded).
+
+`shard(mesh)` (ISSUE 7 tentpole) turns any runner tensor-parallel over
+a `(data, model)` jax mesh: weights get the Megatron column/row
+PartitionSpecs (`parallel.compat.SpecLayout` — column-wise QKV/up/gate,
+row-wise out-proj/down-proj with the allreduce on the row output,
+embeddings vocab-sharded), and every jitted step is re-minted with
+explicit in/out shardings: params per their specs, the paged K/V pools
+split along the KV-HEAD axis (GQA shards naturally — each model shard
+walks its own kv-head slice of the SAME page ids through the same
+replicated block tables), and host operands replicated. On TPU the
+Pallas kernels run per-shard via `shard_map`; on the CPU test mesh the
+sharding-annotated gather reference path partitions under GSPMD. The
+block tables, allocator, scheduler, and PrefixCache never notice: one
+page id means the same page on every shard, so all host-side COW/
+refcount/eviction logic is untouched. Sharded runners count the
+instrumented-pool bytes PER SHARD (bytes/tp — the acceptance number).
 """
 
 from __future__ import annotations
@@ -60,6 +76,7 @@ from typing import Dict, List
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 logger = logging.getLogger(__name__)
 
@@ -85,8 +102,33 @@ def bucket_len(t: int, minimum: int = 8) -> int:
 _bucket_len = bucket_len          # pre-rename spelling (internal callers)
 
 
+def _shard_mapped_kernel(kernel, shard_ctx, q_spec):
+    """Wrap a paged-attention Pallas kernel so it runs PER MODEL SHARD
+    (ISSUE 7): q and the K/V pools split on their (kv-)head axis, the
+    block tables and positions ride replicated — every shard walks the
+    SAME page ids over its own kv-head slice, so the kernel body is
+    unchanged (GQA's n_rep is shard-invariant because n_heads and
+    n_kv_heads divide by tp together). Pallas calls are opaque to GSPMD,
+    hence shard_map instead of a sharding annotation."""
+    from paddle_tpu.parallel.pipeline import compat_shard_map
+
+    mesh, model_axis = shard_ctx
+    pool_spec = P(None, None, model_axis, None)
+
+    def run(q, k_pool, v_pool, tables, pos_q, *rest):
+        return compat_shard_map(
+            kernel, mesh=mesh,
+            in_specs=(q_spec, pool_spec, pool_spec) + (P(),) * (2 + len(rest)),
+            out_specs=q_spec,
+            axis_names=frozenset({model_axis}),
+        )(q, k_pool, v_pool, tables, pos_q, *rest)
+
+    return run
+
+
 def paged_attend(q, k_new, v_new, k_pool, v_pool, tables, write_page,
-                 write_off, pos_q, q_len, n_rep: int, impl: str):
+                 write_off, pos_q, q_len, n_rep: int, impl: str,
+                 shard_ctx=None):
     """Write this step's K/V through the block table, then attend.
 
     q: [B, T, n_h, d]; k_new/v_new: [B, T, n_kv, d]; tables: [B, P];
@@ -94,7 +136,11 @@ def paged_attend(q, k_new, v_new, k_pool, v_pool, tables, write_page,
     row 0; q_len: [B] live rows per span (rows past it are padding).
     impl is the statically-resolved attention path ("reference" |
     "paged_decode" | "ragged" — PagedModelRunner._attn_impl_for), baked
-    per jit entry. Returns ([B, T, n_h*d], k_pool, v_pool)."""
+    per jit entry. shard_ctx = (mesh, model_axis) on a sharded runner
+    (ISSUE 7): the kernels then run per-shard via shard_map on each
+    shard's kv-head slice; the gather reference path needs no wrapper —
+    GSPMD partitions it from the pool sharding alone. Returns
+    ([B, T, n_h*d], k_pool, v_pool)."""
     k_pool = k_pool.at[write_page, write_off].set(k_new)
     v_pool = v_pool.at[write_page, write_off].set(v_new)
     B, T = q.shape[0], q.shape[1]
@@ -102,14 +148,21 @@ def paged_attend(q, k_new, v_new, k_pool, v_pool, tables, write_page,
         from paddle_tpu.ops.pallas.paged_attention import \
             paged_decode_attention
 
-        out = paged_decode_attention(q[:, 0], k_pool, v_pool, tables, pos_q)
+        fn = paged_decode_attention
+        if shard_ctx is not None:
+            fn = _shard_mapped_kernel(fn, shard_ctx,
+                                      P(None, shard_ctx[1], None))
+        out = fn(q[:, 0], k_pool, v_pool, tables, pos_q)
         return out.reshape(B, 1, -1), k_pool, v_pool
     if impl == "ragged":
         from paddle_tpu.ops.pallas.ragged_paged_attention import \
             ragged_paged_attention
 
-        out = ragged_paged_attention(q, k_pool, v_pool, tables, pos_q,
-                                     q_len)
+        fn = ragged_paged_attention
+        if shard_ctx is not None:
+            fn = _shard_mapped_kernel(fn, shard_ctx,
+                                      P(None, None, shard_ctx[1], None))
+        out = fn(q, k_pool, v_pool, tables, pos_q, q_len)
         return out.reshape(B, T, -1), k_pool, v_pool
     kg = paged_gather(k_pool, tables)
     vg = paged_gather(v_pool, tables)
@@ -147,9 +200,19 @@ class PagedModelRunner:
         self.attn_impl = attn_impl
         self._jit_cache: "OrderedDict" = OrderedDict()
         self._impl_logged: set = set()
+        # tensor-parallel state (ISSUE 7): set by shard(); mesh=None is
+        # the single-device runner all earlier PRs built
+        self.mesh = None
+        self.data_axis = "data"
+        self.model_axis = "model"
+        self.tp_size = 1
+        self._layout = None                  # parallel.compat.SpecLayout
+        self._param_shardings = None         # name -> NamedSharding
         # instrumented-pool counters: HBM bytes of KV pool the chosen
         # attention path touches (host-side analytics, CPU-countable) vs
-        # what the gather path would have read for the same calls
+        # what the gather path would have read for the same calls.
+        # Sharded runners count PER-SHARD bytes (each shard walks only
+        # its own kv-head slice, so sharded = single-device / tp)
         self.attn_kv_bytes_read = 0.0
         self.attn_kv_bytes_gather = 0.0
 
@@ -160,6 +223,130 @@ class PagedModelRunner:
     @property
     def n_rep(self) -> int:
         return self.n_heads // self.n_kv_heads
+
+    # --------------------------------------------------- sharding (ISSUE 7)
+
+    @property
+    def is_sharded(self) -> bool:
+        return self.mesh is not None
+
+    def _param_specs(self, layout) -> Dict[str, P]:
+        """name -> PartitionSpec table for this architecture (subclass
+        hook; unlisted params ride replicated)."""
+        raise NotImplementedError
+
+    @staticmethod
+    def _spec_fits(shape, spec, mesh) -> bool:
+        """A spec fits iff every sharded dim divides evenly across its
+        mesh axes — the clean-split precondition the fallback leans on."""
+        for dim, axes in zip(shape, tuple(spec)):
+            if axes is None:
+                continue
+            names = axes if isinstance(axes, tuple) else (axes,)
+            parts = int(np.prod([mesh.shape[a] for a in names]))
+            if dim % parts:
+                return False
+        return True
+
+    def shard(self, mesh, *, data_axis: str = "data",
+              model_axis: str = "model") -> "PagedModelRunner":
+        """Shard this runner's weights over `mesh`'s model axis and
+        re-mint every jitted step with explicit in/out shardings (the
+        ISSUE 7 tentpole). Embeddings go vocab-sharded (replicated over
+        `data`), QKV/up/gate column-wise, out-proj/down-proj row-wise
+        with the allreduce on the row output — the SpecLayout /
+        ColWiseParallel / RowWiseParallel placements — and the paged K/V
+        pools the engine builds afterwards split along the kv-head axis.
+        GQA must split in whole kv-heads: n_kv_heads (and n_heads) not
+        divisible by the model-axis degree is a LOUD error, never a
+        silent replication. Params whose other dims don't divide (e.g. a
+        prime vocab) fall back to replication for that one param, logged.
+        Idempotent per mesh; returns self for chaining."""
+        for axis in (data_axis, model_axis):
+            if axis not in mesh.axis_names:
+                raise ValueError(
+                    f"mesh axes {mesh.axis_names} lack {axis!r} — build "
+                    "the serving mesh with parallel.mesh.serving_mesh("
+                    "data, model)")
+        tp = int(mesh.shape[model_axis])
+        if self.n_kv_heads % tp:
+            raise ValueError(
+                f"n_kv_heads={self.n_kv_heads} is not divisible by the "
+                f"tensor-parallel degree {tp} ({model_axis!r} axis): GQA "
+                "shards along kv-heads, so every shard needs a whole "
+                "kv-head slice of the paged pools — choose tp dividing "
+                "n_kv_heads or reshape the mesh")
+        if self.n_heads % tp:
+            raise ValueError(
+                f"n_heads={self.n_heads} is not divisible by the tensor-"
+                f"parallel degree {tp} ({model_axis!r} axis)")
+        from paddle_tpu.parallel.compat import SpecLayout
+
+        layout = SpecLayout(data_axis=data_axis, model_axis=model_axis)
+        specs = self._param_specs(layout)
+        shardings: Dict[str, NamedSharding] = {}
+        for name, v in self.params.items():
+            spec = specs.get(name, P())
+            if spec != P() and not self._spec_fits(v.shape, spec, mesh):
+                logger.warning(
+                    "shard: %s %s does not divide over %s — this param "
+                    "stays replicated", name, tuple(v.shape), spec)
+                spec = P()
+            shardings[name] = NamedSharding(mesh, spec)
+        self.params = {name: jax.device_put(v, shardings[name])
+                       for name, v in self.params.items()}
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.model_axis = model_axis
+        self.tp_size = tp
+        self._layout = layout
+        self._param_shardings = shardings
+        self._jit_cache.clear()        # shardings are baked per jit entry
+        logger.info(
+            "serving runner sharded: mesh=%s tp=%d (%d/%d heads, %d/%d "
+            "kv-heads per shard)",
+            dict(mesh.shape), tp, self.n_heads // tp, self.n_heads,
+            self.n_kv_heads // tp, self.n_kv_heads)
+        return self
+
+    @property
+    def _shard_ctx(self):
+        """(mesh, model_axis) for the shard_map kernel wrappers, None on
+        single-device runners."""
+        return (self.mesh, self.model_axis) if self.mesh is not None else None
+
+    def _constrain_heads(self, *xs):
+        """Pin [B, T, heads, d] activations to the head sharding at
+        trace time — makes GSPMD's Megatron partition deterministic
+        instead of solver-chosen. No-op unsharded."""
+        if self._layout is None:
+            return xs if len(xs) > 1 else xs[0]
+        sh = NamedSharding(self.mesh, self._layout.heads())
+        out = tuple(jax.lax.with_sharding_constraint(x, sh) for x in xs)
+        return out if len(out) > 1 else out[0]
+
+    def _stage(self, *host_arrays):
+        """Stage host operands for a sharded call (ISSUE 7 satellite):
+        ONE jax.device_put of the whole tuple with a replicated
+        NamedSharding, so each step ships its block tables / token / pos
+        arrays to the mesh in a single staging call instead of one
+        implicit per-array transfer per shard path. Unsharded runners
+        pass host arrays straight to jit (the ISSUE 6 one-hop rule)."""
+        if self.mesh is None:
+            return host_arrays
+        return jax.device_put(host_arrays, NamedSharding(self.mesh, P()))
+
+    def _step_shardings(self, kind: str, pools_arg: int):
+        """Explicit (in_shardings, out_shardings) for one jitted step:
+        params per their specs, host operands replicated, K/V pools
+        split on the kv-head axis in AND out — the pools never leave the
+        mesh sharded layout, so no step pays a gather/reshard."""
+        mesh = self.mesh
+        rep = NamedSharding(mesh, P())
+        kv = NamedSharding(mesh, self._layout.kv_pool())
+        pools = [(kv, kv) for _ in range(self.num_layers)]
+        ins = ([self._param_shardings] + [rep] * (pools_arg - 1) + [pools])
+        return tuple(ins), (rep, pools)
 
     # --------------------------------------------------------- dispatch
 
@@ -206,11 +393,15 @@ class PagedModelRunner:
         kernels read only each span's live pages (clamped index_map);
         the gather path reads every table entry of every slot. Counted
         host-side from the same operands the device call gets, so the
-        bandwidth claim is verifiable without TPU access."""
+        bandwidth claim is verifiable without TPU access. On a sharded
+        runner the count is PER SHARD — each shard reads only its
+        n_kv/tp kv-head slice of every page, so sharded bytes equal the
+        single-device bytes / tp (the ISSUE 7 acceptance number)."""
         from paddle_tpu.ops.pallas.ragged_paged_attention import \
             attention_page_reads
 
-        per_page = (2 * self.num_layers * self.block_size * self.n_kv_heads
+        per_page = (2 * self.num_layers * self.block_size
+                    * (self.n_kv_heads // self.tp_size)
                     * self.head_dim * np.dtype(self.dtype).itemsize)
         gather_pages = len(np.asarray(starts).reshape(-1)) * table_width
         if impl in ("paged_decode", "ragged"):
@@ -335,7 +526,17 @@ class PagedModelRunner:
         donate = (pools_arg,) if jax.default_backend() == "tpu" else ()
         # decode_multi's horizon length is a lax.scan bound — static
         static = (5,) if kind == "decode_multi" else ()
-        jitted = jax.jit(fn, donate_argnums=donate, static_argnums=static)
+        if self.mesh is not None:
+            # sharded runner (ISSUE 7): every step is pjit'd with
+            # explicit in/out shardings — params per spec, pools split
+            # on the kv-head axis both ways, host operands replicated
+            ins, outs = self._step_shardings(kind, pools_arg)
+            jitted = jax.jit(fn, donate_argnums=donate,
+                             static_argnums=static, in_shardings=ins,
+                             out_shardings=outs)
+        else:
+            jitted = jax.jit(fn, donate_argnums=donate,
+                             static_argnums=static)
         self._jit_cache[key] = jitted
         logger.info("serving jit compile %s key=%s (cache entries: %d)",
                     kind, shape_key, len(self._jit_cache))
@@ -371,9 +572,11 @@ class PagedModelRunner:
         fn = self._jitted("prefill", tb)
         # host operands go to the jitted fn as-is — jit commits them in
         # one hop; a jnp.asarray(np.asarray(...)) round-trip here used to
-        # stage an extra host copy per call (ISSUE 6 satellite)
-        return fn(self.params, padded,
-                  np.asarray(table_row, np.int32)[None],
+        # stage an extra host copy per call (ISSUE 6 satellite). Sharded
+        # runners stage them in ONE replicated device_put (ISSUE 7)
+        toks, table = self._stage(padded,
+                                  np.asarray(table_row, np.int32)[None])
+        return fn(self.params, toks, table,
                   np.int32(t), np.int32(start_pos), pools)
 
     def decode(self, tokens, tables, pos, pools):
@@ -383,8 +586,10 @@ class PagedModelRunner:
                            np.ones_like(pos_np),
                            np.asarray(tables).shape[1])
         fn = self._jitted("decode", np.asarray(tokens).shape[0])
-        return fn(self.params, np.asarray(tokens, np.int32)[:, None],
-                  tables, pos_np, pools)
+        toks, tabs, pos_a = self._stage(
+            np.asarray(tokens, np.int32)[:, None],
+            np.asarray(tables, np.int32), pos_np)
+        return fn(self.params, toks, tabs, pos_a, pools)
 
     def decode_multi(self, tokens, tables, pos, pools, num_steps: int):
         """Device-resident multi-step decode (ISSUE 6): run `num_steps`
@@ -404,8 +609,10 @@ class PagedModelRunner:
             self._account_attn(impl, pos_np + t, np.ones_like(pos_np),
                                width)
         fn = self._jitted("decode_multi", (pos_np.shape[0], num_steps))
-        return fn(self.params, np.asarray(tokens, np.int32), tables,
-                  pos_np, pools, num_steps)
+        toks, tabs, pos_a = self._stage(np.asarray(tokens, np.int32),
+                                        np.asarray(tables, np.int32),
+                                        pos_np)
+        return fn(self.params, toks, tabs, pos_a, pools, num_steps)
 
     def ragged_step(self, tokens, tables, start_pos, q_lens, pools,
                     full_logits: bool = False):
@@ -425,7 +632,9 @@ class PagedModelRunner:
         self._account_attn(self._attn_impl_for(T), start_pos, q_lens,
                            np.asarray(tables).shape[1])
         fn = self._jitted("ragged_full" if full_logits else "ragged", (B, T))
-        return fn(self.params, tokens, tables, start_pos, q_lens, pools)
+        toks, tabs, starts, lens = self._stage(
+            tokens, np.asarray(tables, np.int32), start_pos, q_lens)
+        return fn(self.params, toks, tabs, starts, lens, pools)
 
     def _forward(self, params, tokens, positions, write_page, write_off,
                  tables, pos_q, q_lens, pools):
@@ -455,6 +664,26 @@ class LlamaRunner(PagedModelRunner):
         cos, sin = _rope_tables(self.max_model_len, self.head_dim,
                                 cfg.rope_theta)
         self._rope_cos, self._rope_sin = cos, sin      # [L, d] fp32
+
+    def _param_specs(self, layout):
+        """Megatron placements for the Llama block (ISSUE 7): column-
+        wise Q/K/V and gate/up (each shard computes its own head /
+        hidden slice), row-wise o_proj/down_proj (allreduce on the row
+        output), vocab-sharded embeddings; norms replicated (default)."""
+        col, row = layout.column_parallel(), layout.row_parallel()
+        specs = {"embed_tokens.weight": layout.embeddings()}
+        for i in range(self.num_layers):
+            pre = f"layers.{i}."
+            specs[pre + "self_attn.q_proj.weight"] = col
+            specs[pre + "self_attn.k_proj.weight"] = col
+            specs[pre + "self_attn.v_proj.weight"] = col
+            specs[pre + "self_attn.o_proj.weight"] = row
+            specs[pre + "mlp.gate_proj.weight"] = col
+            specs[pre + "mlp.up_proj.weight"] = col
+            specs[pre + "mlp.down_proj.weight"] = row
+        if "lm_head.weight" in self.params:        # [H, V]: column-wise
+            specs["lm_head.weight"] = col
+        return specs
 
     def _rope(self, x, cos, sin):
         # same rotate-half convention as ops.rotary_embedding
@@ -490,9 +719,11 @@ class LlamaRunner(PagedModelRunner):
                  ).reshape(B, T, self.n_kv_heads, d)
             q = self._rope(q, cos, sin)
             k = self._rope(k, cos, sin)
+            q, k, v = self._constrain_heads(q, k, v)
             out, kp, vp = paged_attend(
                 q, k, v, pools[i][0], pools[i][1], tables, write_page,
-                write_off, pos_q, q_lens, self.n_rep, impl)
+                write_off, pos_q, q_lens, self.n_rep, impl,
+                shard_ctx=self._shard_ctx)
             x = x + out @ params[pre + "self_attn.o_proj.weight"]
             h = self._rms(x, params[pre + "post_attention_layernorm.weight"],
                           cfg.rms_eps)
@@ -528,6 +759,26 @@ class GPTRunner(PagedModelRunner):
         self.head_dim = cfg.hidden_size // cfg.num_heads
         self.vocab_size = cfg.vocab_size
 
+    def _param_specs(self, layout):
+        """GPT placements (ISSUE 7). The fused attn.qkv weight keeps its
+        (3, n_heads, d) column layout — a flat column shard would split
+        across the q/k/v boundary — so it stays replicated and the
+        sharded K/V POOLS carry the attention split instead (the head-
+        sharded pool makes the whole attention block compute per-shard;
+        out-proj then reduces row-wise). MLP and the vocab matrices
+        shard the standard Megatron way."""
+        col, row = layout.column_parallel(), layout.row_parallel()
+        specs = {"wte.weight": layout.embeddings()}
+        for i in range(self.num_layers):
+            pre = f"blocks.{i}."
+            specs[pre + "attn.out.weight"] = row
+            specs[pre + "mlp.fc1.weight"] = col
+            specs[pre + "mlp.fc1.bias"] = layout.bias_column()
+            specs[pre + "mlp.fc2.weight"] = row
+        if "lm_head.weight" in self.params:        # [H, V]: column-wise
+            specs["lm_head.weight"] = col
+        return specs
+
     def _forward(self, params, tokens, positions, write_page, write_off,
                  tables, pos_q, q_lens, pools):
         cfg = self.cfg
@@ -543,9 +794,11 @@ class GPTRunner(PagedModelRunner):
             qkv = (h @ p["attn.qkv.weight"] + p["attn.qkv.bias"]
                    ).reshape(B, T, 3, self.n_heads, d)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            q, k, v = self._constrain_heads(q, k, v)
             out, kp, vp = paged_attend(
                 q, k, v, pools[i][0], pools[i][1], tables, write_page,
-                write_off, pos_q, q_lens, 1, impl)
+                write_off, pos_q, q_lens, 1, impl,
+                shard_ctx=self._shard_ctx)
             x = x + (out @ p["attn.out.weight"] + p["attn.out.bias"])
             h = _layer_norm(x, p["ln2.weight"], p["ln2.bias"])
             x = x + _mlp(p, h)

@@ -49,7 +49,11 @@ class FaultInjector:
     Drop-in: exposes the runner's attributes (block_size, num_layers,
     dtype, ...) by delegation, so ``ServingEngine(FaultInjector(runner,
     ...), ...)`` behaves exactly like the bare runner except on the
-    scheduled calls. Call indices are 1-based and counted PER OP, so
+    scheduled calls. Sharded runners (runner.shard(mesh), ISSUE 7) are
+    wrapped the same way — `mesh`/`model_axis`/`tp_size` delegate
+    through, so the engine still builds kv-head-sharded pools, injected
+    errors hit the sharded launch before any device work (retry exact),
+    and NaN corruption happens on the replicated host-side logits. Call indices are 1-based and counted PER OP, so
     ``decode_error_every=5`` fails decode calls 5, 10, 15, ... — the
     engine's retry makes the very next attempt (a new call) succeed.
 
@@ -300,6 +304,30 @@ def audit_engine(engine) -> None:
                 cache._index.get(cache._page_hash.get(p)) != p
                 for p in cached):
             problems.append("prefix-cache hash index and page index disagree")
+
+    # -- sharded pools (ISSUE 7): per-shard shapes must agree with the
+    #    replicated block tables — every model shard holds EVERY page's
+    #    kv-head slice (pages replicated across shards, only kv-heads
+    #    split), or a page id in a block table would dangle on some shard
+    pool = engine.pool
+    if getattr(pool, "mesh", None) is not None:
+        expect = (pool.num_blocks, pool.block_size,
+                  pool.n_kv_heads // pool.tp_size, pool.head_dim)
+        for li, (k, v) in enumerate(pool.pools):
+            for nm, arr in (("k", k), ("v", v)):
+                shards = getattr(arr, "addressable_shards", None)
+                if not shards:
+                    problems.append(
+                        f"layer {li} {nm}-pool is not a sharded device "
+                        "array on a mesh-backed pool")
+                    continue
+                shapes = {tuple(s.data.shape) for s in shards}
+                if shapes != {expect}:
+                    problems.append(
+                        f"layer {li} {nm}-pool per-shard shapes "
+                        f"{sorted(shapes)} != {expect} — block tables are "
+                        "replicated, so every shard must hold all "
+                        f"{pool.num_blocks} pages at n_kv/tp heads")
 
     # -- slot accounting -------------------------------------------------
     slots = [r.slot for r in sched.running]

@@ -174,13 +174,20 @@ class PeriodicStubRunner(StubPagedRunner):
         return row
 
 
-def child_env(repo_on_pythonpath=True):
+def child_env(repo_on_pythonpath=True, num_cpu_devices=None):
     """Env for spawning CPU-only child processes from tests.
 
     Children must target the CPU backend and must NOT register the axon
     TPU plugin: inheriting PALLAS_AXON_POOL_IPS makes their sitecustomize
     register() dial the relay, which hangs when another jax process holds
     it. Every test that spawns a subprocess should build its env here.
+
+    num_cpu_devices: pin the child's virtual CPU device count. jax < 0.5
+    ignores JAX_NUM_CPU_DEVICES, and the parent's conftest XLA_FLAGS
+    (--xla_force_host_platform_device_count=8) would otherwise leak into
+    the child — multi-process tests then see 8 devices per rank instead
+    of 1, breaking every world-mesh shape. Setting BOTH spellings here
+    keeps child device counts right across the jax version skew.
     """
     env = dict(os.environ)
     if repo_on_pythonpath:
@@ -192,4 +199,11 @@ def child_env(repo_on_pythonpath=True):
     # would make the child's jax plugin discovery dlopen dead stub paths
     env.pop("PJRT_NAMES_AND_LIBRARY_PATHS", None)
     env.pop("CUSTOM_DEVICE_ROOT", None)
+    if num_cpu_devices is not None:
+        env["JAX_NUM_CPU_DEVICES"] = str(num_cpu_devices)
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append("--xla_force_host_platform_device_count="
+                     f"{num_cpu_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
     return env

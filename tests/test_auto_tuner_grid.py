@@ -23,6 +23,7 @@ def test_prune_heuristics():
                                  n_layers=4, n_heads=4, batch=4) is None
 
 
+@pytest.mark.slow        # ~60s: a real grid search over parallel configs
 def test_tune_gpt_parallel_virtual_mesh(tmp_path):
     cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
                     num_heads=4, max_seq_len=16, dropout=0.0)

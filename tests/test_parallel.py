@@ -99,16 +99,13 @@ def test_in_jit_collectives(mesh2x2x2):
     from paddle_tpu.parallel import collective as C
 
     mesh = dist.current_mesh()
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    from paddle_tpu.parallel.pipeline import compat_shard_map
 
     x = jnp.arange(8.0).reshape(8, 1)
 
-    f = shard_map(lambda a: C.psum(a, "dp"), mesh=mesh,
-                  in_specs=P("dp"), out_specs=P(),
-                  axis_names=frozenset({"dp"}))
+    f = compat_shard_map(lambda a: C.psum(a, "dp"), mesh=mesh,
+                         in_specs=P("dp"), out_specs=P(),
+                         axis_names=frozenset({"dp"}))
     out = f(x)
     # psum over dp sums the two (4,1) shards; output replicated
     assert out.shape == (4, 1)

@@ -1,0 +1,323 @@
+"""Tensor-parallel sharded serving over the (data, model) mesh (ISSUE 7).
+
+Acceptance contract: sharding is a CAPACITY/THROUGHPUT change, never a
+sampling change — a tp=2 or tp=4 engine on the 8-way virtual CPU mesh
+must reproduce the single-device engine (and therefore the naive
+oracle) token-for-token across greedy, seeded temperature, prefix
+cache, chunked prefill, and decode-horizon workloads, while each model
+shard holds exactly 1/tp of the KV pool bytes (asserted through both
+`per_shard_memory_bytes` and the instrumented attention-bytes
+counters). GQA shards in whole kv-heads: a tp that does not divide
+n_kv_heads is a loud construction error. The invariant auditor (with
+its new per-shard pool-shape check) is armed on every engine test.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.models.llama import Llama, LlamaConfig
+from paddle_tpu.parallel.mesh import serving_mesh
+from paddle_tpu.serving import (
+    FaultInjector, GPTRunner, InvariantViolation, LlamaRunner,
+    SamplingParams, ServingEngine, SpecLayout, audit_engine, naive_generate,
+)
+
+
+@pytest.fixture(autouse=True)
+def _audit_every_engine(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_SERVING_AUDIT", "1")
+
+
+@pytest.fixture(scope="module")
+def llama_model():
+    """GQA decoder whose kv-heads divide every swept tp: 8 q-heads over
+    4 kv-heads (n_rep=2), so tp in {1, 2, 4} splits cleanly."""
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=97, hidden_size=64, num_layers=2,
+                      num_heads=8, num_kv_heads=4, max_seq_len=64,
+                      dropout=0.0)
+    model = Llama(cfg)
+    model.eval()
+    return model
+
+
+@pytest.fixture(scope="module")
+def gpt_model():
+    """MHA decoder with a tp-divisible vocab (96), so the embedding and
+    lm_head matrices actually shard instead of falling back."""
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=96, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=64, dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+    return model
+
+
+def _llama_runner(model, tp, **kw):
+    r = LlamaRunner(model, block_size=8, max_model_len=64, **kw)
+    if tp > 1:
+        r.shard(serving_mesh(data=1, model=tp))
+    return r
+
+
+# ------------------------------------------------------------- loud errors
+
+
+def test_kv_heads_not_divisible_is_loud():
+    """The GQA rule: tp must divide n_kv_heads — construction fails
+    naming the rule, never silently replicating the pools."""
+    paddle.seed(1)
+    cfg = LlamaConfig(vocab_size=31, hidden_size=32, num_layers=1,
+                      num_heads=6, num_kv_heads=3, max_seq_len=32,
+                      dropout=0.0)
+    model = Llama(cfg)
+    model.eval()
+    runner = LlamaRunner(model, block_size=8, max_model_len=32)
+    with pytest.raises(ValueError, match="n_kv_heads=3.*kv-head"):
+        runner.shard(serving_mesh(data=1, model=2))
+    # and q-heads must divide too (kv divides at tp=3, q=6/3 ok; tp= 4 no)
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        runner.shard(serving_mesh(data=1, model=4))
+
+
+def test_mesh_axis_and_device_validation():
+    import jax
+
+    with pytest.raises(ValueError, match="needs"):
+        serving_mesh(data=2, model=len(jax.devices()))
+    paddle.seed(1)
+    cfg = LlamaConfig(vocab_size=31, hidden_size=32, num_layers=1,
+                      num_heads=2, num_kv_heads=2, max_seq_len=32,
+                      dropout=0.0)
+    model = Llama(cfg)
+    model.eval()
+    runner = LlamaRunner(model, block_size=8, max_model_len=32)
+    mesh = serving_mesh(data=1, model=2, data_axis="dp", model_axis="tp")
+    with pytest.raises(ValueError, match="lack"):
+        runner.shard(mesh)                     # default axis names absent
+    runner.shard(mesh, data_axis="dp", model_axis="tp")
+    assert runner.tp_size == 2 and runner.model_axis == "tp"
+
+
+def test_spec_layout_matches_colwise_rowwise():
+    """SpecLayout is the serving face of the ColWise/RowWise hooks: the
+    spec SHAPES must stay in lockstep with compat.parallelize's."""
+    from jax.sharding import PartitionSpec as P
+
+    lay = SpecLayout(data_axis="data", model_axis="tp")
+    assert lay.column_parallel() == P(None, "tp")     # ColWiseParallel
+    assert lay.row_parallel() == P("tp", None)        # RowWiseParallel
+    assert lay.bias_column() == P("tp")
+    assert lay.embeddings() == P("tp", None)
+    assert lay.kv_pool() == P(None, None, "tp", None)
+    assert lay.replicated() == P()
+
+
+# ------------------------------------------------- token-exact tp sweep
+
+
+def _workload(rng, n=5):
+    """Greedy + seeded temperature + shared prefixes + a long prompt
+    (chunked under the budget) — every sharded code path in one batch."""
+    work = []
+    header = [7, 8, 9, 10]
+    for i in range(n):
+        plen = int(rng.integers(4, 14)) if i != 2 else 20   # chunks
+        p = list(map(int, rng.integers(1, 96, plen)))
+        if i % 2:
+            p[:4] = header                                  # prefix hits
+        sp = SamplingParams(max_tokens=int(rng.integers(4, 8)),
+                            temperature=(0.8 if i == 4 else 0.0), seed=11)
+        work.append((f"r{i}", p, sp))
+    return work
+
+
+def _run_engine(runner, work, **kw):
+    kw.setdefault("num_blocks", 40)
+    kw.setdefault("max_batch_size", 3)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("enable_prefix_cache", True)
+    kw.setdefault("max_prefill_tokens_per_step", 6)
+    kw.setdefault("decode_horizon", 4)
+    eng = ServingEngine(runner, **kw)
+    assert eng.audit, "TP tests must run under the invariant auditor"
+    for rid, p, sp in work:
+        eng.add_request(p, sp, request_id=rid)
+    outs = eng.run()
+    eng.release_prefix_cache()
+    assert eng.pool.allocator.check_no_leaks()
+    return eng, {rid: outs[rid].output_tokens for rid, _, _ in work}
+
+
+def test_llama_token_exact_tp_sweep(llama_model):
+    """THE acceptance pins in one sweep: tp in {1, 2, 4} engines on the
+    CPU mesh are token-for-token the single-device engine (and the
+    naive oracle) with greedy + seeded temperature + prefix cache +
+    chunked prefill + decode_horizon > 1 all on — and per-shard KV
+    bytes are EXACTLY the single-device bytes / tp, via the pool
+    accounting, the real per-shard device shapes, and the instrumented
+    attention-bytes counters over the identical call sequence."""
+    rng = np.random.default_rng(7)
+    work = _workload(rng)
+    base = _llama_runner(llama_model, 1)
+    eng1, ref = _run_engine(base, work)
+    base_bytes = base.attn_kv_bytes_read     # before naive pollutes it
+    assert base_bytes > 0
+    for rid, p, sp in work:
+        assert ref[rid] == naive_generate(base, p, sp, max_model_len=64), \
+            f"single-device engine diverged from the oracle on {rid}"
+    for tp in (1, 2, 4):
+        runner = _llama_runner(llama_model, tp)
+        if tp > 1:
+            assert runner.is_sharded and runner.tp_size == tp
+        eng, got = _run_engine(runner, work)
+        assert got == ref, f"tp={tp} diverged from the single-device engine"
+        if tp == 1:
+            continue
+        pool = eng.pool
+        assert pool.per_shard_memory_bytes() \
+            == eng1.pool.memory_bytes() // tp
+        k0 = pool.pools[0][0]
+        shapes = {s.data.shape for s in k0.addressable_shards}
+        assert shapes == {(pool.num_blocks, pool.block_size,
+                           pool.n_kv_heads // tp, pool.head_dim)}
+        # identical call sequence, per-shard accounting: exactly 1/tp
+        assert runner.attn_kv_bytes_read == pytest.approx(base_bytes / tp)
+        assert eng.metrics.snapshot()["attn_kv_bytes_read"] \
+            == pytest.approx(base_bytes / tp)
+
+
+def test_gpt_token_exact_and_vocab_sharded(gpt_model):
+    """GPT at tp=2 (data=2 x model=2 sub-mesh — the data axis carries
+    replicas, serving state is replicated over it): token-exact, with
+    the vocab matrices ACTUALLY sharded (vocab 96 divides)."""
+    from jax.sharding import PartitionSpec as P
+
+    rng = np.random.default_rng(3)
+    work = _workload(rng, n=4)
+    base = GPTRunner(gpt_model, block_size=8, max_model_len=64)
+    _, ref = _run_engine(base, work)
+    tp = GPTRunner(gpt_model, block_size=8, max_model_len=64).shard(
+        serving_mesh(data=2, model=2))
+    assert tp.params["wte.weight"].sharding.spec == P("model", None)
+    _, got = _run_engine(tp, work)
+    assert got == ref
+
+
+# ----------------------------------------------- capacity + invariants
+
+
+def test_auditor_catches_unsharded_pool(llama_model):
+    """The new per-shard audit: an unsharded array smuggled into a
+    mesh-backed pool is an InvariantViolation naming the shard shapes."""
+    import jax.numpy as jnp
+
+    runner = _llama_runner(llama_model, 2)
+    eng = ServingEngine(runner, num_blocks=20, max_batch_size=2,
+                        max_model_len=64)
+    k, v = eng.pool.pools[0]
+    eng.pool.pools[0] = (jnp.zeros(k.shape, k.dtype), v)
+    with pytest.raises(InvariantViolation, match="per-shard"):
+        audit_engine(eng)
+
+
+# --------------------------------------------- snapshot / restore / faults
+
+
+def test_snapshot_roundtrips_mesh_and_restores_token_exact(llama_model):
+    """Kill-and-restore mid-run on the mesh: config records the mesh
+    axes, the restored tp=2 engine finishes token-exact vs naive."""
+    runner = _llama_runner(llama_model, 2)
+    eng = ServingEngine(runner, num_blocks=40, max_batch_size=2,
+                        max_model_len=64)
+    rng = np.random.default_rng(9)
+    work = []
+    for i in range(3):
+        p = list(map(int, rng.integers(1, 96, int(rng.integers(4, 10)))))
+        sp = SamplingParams(max_tokens=6)
+        work.append((eng.add_request(p, sp, request_id=f"r{i}"), p, sp))
+    for _ in range(3):
+        eng.step()
+    state = json.loads(json.dumps(eng.snapshot()))
+    assert state["config"]["mesh_axes"] == {"data": 1, "model": 2}
+    eng2 = ServingEngine.restore(runner, state)
+    while eng2.has_work():
+        eng2.step()
+    outs = eng2.outputs()
+    base = _llama_runner(llama_model, 1)
+    for rid, p, sp in work:
+        assert outs[rid].output_tokens == naive_generate(
+            base, p, sp, max_model_len=64), rid
+
+
+def test_fault_injected_sharded_decode_retries_exactly(llama_model):
+    """Injected device errors on the sharded decode launch retry with
+    backoff and stay token-exact — recovery is mesh-blind (the failed
+    attempt never half-commits any shard's pool slice)."""
+    runner = _llama_runner(llama_model, 2)
+    inj = FaultInjector(runner, error_every=3, error_target="both")
+    eng = ServingEngine(inj, num_blocks=40, max_batch_size=2,
+                        max_model_len=64, retry_backoff_s=0.0,
+                        sleep_fn=lambda _t: None)
+    rng = np.random.default_rng(2)
+    work = []
+    for i in range(3):
+        p = list(map(int, rng.integers(1, 96, 6)))
+        sp = SamplingParams(max_tokens=6)
+        work.append((eng.add_request(p, sp, request_id=f"r{i}"), p, sp))
+    outs = eng.run()
+    assert eng.metrics.snapshot()["step_retries"] > 0
+    base = _llama_runner(llama_model, 1)
+    for rid, p, sp in work:
+        assert outs[rid].finish_reason == "length"
+        assert outs[rid].output_tokens == naive_generate(
+            base, p, sp, max_model_len=64), rid
+    assert eng.pool.allocator.check_no_leaks()
+
+
+# ------------------------------------------------- kernel + staging paths
+
+
+def test_sharded_ragged_kernel_path_token_exact(llama_model):
+    """attn_impl='ragged' at tp=2: the Pallas ragged kernel runs PER
+    SHARD via shard_map (interpret mode on CPU) on each shard's kv-head
+    slice — tokens equal the single-device reference path."""
+    base = _llama_runner(llama_model, 1, attn_impl="reference")
+    tpk = _llama_runner(llama_model, 2, attn_impl="ragged")
+    work = [(f"r{i}", [3 + i, 5, 8, 13, 21], SamplingParams(max_tokens=4))
+            for i in range(2)]
+    _, ref = _run_engine(base, work, ragged_batch=True, decode_horizon=1)
+    _, got = _run_engine(tpk, work, ragged_batch=True, decode_horizon=1)
+    assert got == ref
+
+
+def test_host_array_staging_is_one_device_put(llama_model, monkeypatch):
+    """ISSUE 7 satellite: a sharded call stages ALL its host operands
+    (tokens / tables / pos) in ONE replicated jax.device_put, and the
+    staged arrays are committed to the mesh."""
+    import jax
+
+    runner = _llama_runner(llama_model, 2)
+    calls = {"n": 0}
+    real = jax.device_put
+
+    def counting(x, *a, **kw):
+        calls["n"] += 1
+        return real(x, *a, **kw)
+
+    monkeypatch.setattr(jax, "device_put", counting)
+    staged = runner._stage(np.zeros((2,), np.int32),
+                           np.zeros((2, 4), np.int32),
+                           np.zeros((2,), np.int32))
+    assert calls["n"] == 1, "staging must batch all host arrays"
+    for arr in staged:
+        assert arr.sharding.mesh.shape == {"data": 1, "model": 2}
+        assert arr.sharding.is_fully_replicated
+    # unsharded runners pass host arrays through untouched (one-hop jit)
+    base = _llama_runner(llama_model, 1)
+    a = np.zeros((2,), np.int32)
+    assert base._stage(a)[0] is a

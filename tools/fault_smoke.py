@@ -52,6 +52,15 @@ pages. Records add host_syncs / host_syncs_per_token /
 decode_horizon_steps / horizon_overshoot_tokens. Mutually exclusive
 with --speculate (speculative batches fall back to per-step decode).
 
+ISSUE 7: `--tp N` drills all fault classes on a TENSOR-PARALLEL engine:
+the runner's weights and the paged K/V pools shard over a (data=1,
+model=N) mesh (8-way virtual CPU mesh off-TPU; n_kv_heads must divide
+N), the auditor additionally checks per-shard pool shapes against the
+replicated block tables after every step, and the none/device_error
+classes still require token equality with the naive oracle — injected
+sharded-launch errors must retry exactly like single-device ones.
+Records add tp / attn bytes, which are counted PER SHARD when tp > 1.
+
 ISSUE 5: `--speculate [K]` (K defaults to 4) drills every fault class
 with speculative decoding ON: decode rides n-gram verify spans through
 the full-logits ragged call — the same decode-op fault schedules now
@@ -177,6 +186,7 @@ def run_class(fault: str, runner, args) -> dict:
           and all(o.finish_reason for o in outs.values()))
     return {
         "fault": fault, "ok": ok, "requests": n,
+        "tp": getattr(runner, "tp_size", 1),
         "finish_reasons": reasons,
         "no_unhandled_exception": crashed is None,
         "crash": crashed,
@@ -240,6 +250,10 @@ def main() -> int:
                     help="multi-step decode: sync with the host every N "
                          "steps on pure-greedy decode batches "
                          "(runner.decode_multi; default 1 = per-step)")
+    ap.add_argument("--tp", type=int, default=1, metavar="N",
+                    help="tensor-parallel degree: shard weights + KV "
+                         "pools over a (data=1, model=N) mesh (ISSUE 7; "
+                         "default 1 = single-device)")
     ap.add_argument("--attn-impl", default="auto",
                     choices=("auto", "pallas", "ragged", "reference"),
                     help="attention path (auto: kernels on TPU, gather "
@@ -265,6 +279,10 @@ def main() -> int:
     runner = LlamaRunner(model, block_size=args.block_size,
                          max_model_len=args.max_model_len,
                          attn_impl=args.attn_impl)
+    if args.tp > 1:
+        from paddle_tpu.parallel.mesh import serving_mesh
+
+        runner.shard(serving_mesh(data=1, model=args.tp))
     # warm the prefill buckets + decode step so deadline-sensitive classes
     # (stall) measure steps, not compiles
     import numpy as np
