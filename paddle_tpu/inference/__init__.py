@@ -202,6 +202,62 @@ def create_serving_engine(model, dtype=None, **kw):
     return ServingEngine(runner, **kw)
 
 
+def create_serving_router(model, *, replicas: int = 2, dtype=None,
+                          mesh=None, meshes=None, attn_impl: str = "auto",
+                          block_size: int = 16,
+                          max_model_len: Optional[int] = None,
+                          data_axis: str = "data",
+                          model_axis: str = "model", **kw):
+    """Build a multi-engine ServingRouter for a decoder Layer (ISSUE 8).
+
+    The fleet-tier analogue of create_serving_engine: N full serving
+    engines (thread-per-engine, each with its own paged KV pool and
+    prefix cache) behind one submit/stream/abort surface, with prefix-
+    affinity routing, tier-level admission control, and a crash-
+    restarting Supervisor (see paddle_tpu/serving/router.py).
+
+    Meshes: pass `meshes=[m0, m1, ...]` (one per replica) to pin each
+    replica's engine to its own mesh, or a single `(data, model)` serving
+    mesh whose data-axis degree equals `replicas` — it is then split into
+    per-replica `(model,)` sub-meshes via parallel.mesh.replica_submeshes,
+    finally mapping the data axis onto engine replicas. A single mesh
+    with data=1 shards every replica identically."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.serving import ServingRouter
+    from paddle_tpu.serving.model_runner import runner_for
+
+    if meshes is None and mesh is not None:
+        data = dict(mesh.shape).get(data_axis, 1)
+        if data == replicas and replicas > 1:
+            from paddle_tpu.parallel.mesh import replica_submeshes
+
+            meshes = replica_submeshes(mesh, data_axis=data_axis,
+                                       model_axis=model_axis)
+        else:
+            meshes = [mesh] * replicas
+    if meshes is not None and len(meshes) < replicas:
+        raise ValueError(f"{len(meshes)} meshes for {replicas} replicas")
+
+    def factory(idx: int):
+        runner = runner_for(model, block_size=block_size,
+                            max_model_len=max_model_len,
+                            attn_impl=attn_impl)
+        if dtype is not None:
+            runner.params = {
+                k: (v.astype(dtype)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                for k, v in runner.params.items()}
+        if meshes is not None and meshes[idx] is not None:
+            # cast first, shard second (same order as the single-engine
+            # bridge): the device_put ships the final serving dtype
+            runner.shard(meshes[idx], model_axis=model_axis)
+        return runner
+
+    kw.setdefault("num_blocks", 128)
+    return ServingRouter(factory, replicas=replicas, **kw)
+
+
 def restore_serving_engine(model, state, attn_impl: str = "auto",
                            mesh=None, **kw):
     """Rebuild a crashed/killed serving engine from `engine.snapshot()`.

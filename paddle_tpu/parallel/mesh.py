@@ -97,6 +97,28 @@ def serving_mesh(data: int = 1, model: int = 1,
     return Mesh(arr, (data_axis, model_axis))
 
 
+def replica_submeshes(mesh: Mesh, data_axis: str = "data",
+                      model_axis: str = "model") -> List[Mesh]:
+    """Split a serving `(data, model)` mesh into data-many
+    `(data=1, model)` sub-meshes — one per engine replica (ISSUE 8).
+    This is what finally puts the data axis to work: PR 7's tensor-
+    parallel engine shards weights and K/V pools over the model axis
+    but left data idle; the router tier maps replica i onto sub-mesh i,
+    so a (data=2, model=4) mesh carries two independent tp=4 engines.
+    Each sub-mesh keeps every other axis of the parent and a size-1
+    data axis (runner.shard and the SpecLayout placements name both
+    axes), so a replica's runner shards exactly like a standalone
+    (data=1, model=tp) engine."""
+    names = list(mesh.axis_names)
+    if data_axis not in names:
+        raise ValueError(f"mesh axes {tuple(names)} have no "
+                         f"{data_axis!r} axis to split replicas over")
+    axis = names.index(data_axis)
+    devs = np.moveaxis(np.asarray(mesh.devices), axis, 0)
+    rest = (data_axis,) + tuple(n for n in names if n != data_axis)
+    return [Mesh(devs[i][None, ...], rest) for i in range(devs.shape[0])]
+
+
 class ProcessMesh:
     """paddle.distributed.ProcessMesh-compatible facade over jax Mesh."""
 

@@ -69,13 +69,26 @@ allocator, scheduler, block tables, and PrefixCache stay host-side and
 replicated. Token streams are identical to the single-device engine;
 per-shard pool and attention bytes drop to 1/tp.
 
-Entry points: `paddle_tpu.inference.create_serving_engine(model)` is the
-bridge from the Predictor world; `tools/serving_smoke.py` is a runnable
-demo; `bench.py --child serving:...` drives the offered-load sweep.
+The serving TIER (ISSUE 8): `router.py` (ServingRouter — N engine
+replicas, thread-per-engine, prefix-affinity routing keyed by the
+PrefixCache content-hash chain with least-loaded fallback, tier
+admission control over the per-engine bounded queues, at-most-once
+delivery via per-request cursors + epoch fencing) and `supervisor.py`
+(Supervisor — step-progress heartbeats, crash/hang detection,
+token-exact restore from the crash-safe snapshot plus registry
+backfill, drain/redistribute of the dead replica's queue). Replicas
+may each carry their own `(data=1, model=tp)` sub-mesh
+(`replica_submeshes`), finally mapping the serving mesh's data axis.
+
+Entry points: `paddle_tpu.inference.create_serving_engine(model)` /
+`create_serving_router(model, replicas=N)` are the bridges from the
+Predictor world; `tools/serving_smoke.py` is a runnable demo;
+`tools/fault_smoke.py --router N` drills the tier fault classes;
+`bench.py --child serving:...` drives the offered-load sweeps.
 """
 
 from paddle_tpu.serving.detokenize import (  # noqa: F401
-    StreamDetokenizer, complete_utf8_prefix,
+    StreamDetokenizer, TokenizerAdapter, complete_utf8_prefix,
 )
 from paddle_tpu.serving.engine import (  # noqa: F401
     RequestOutput, ServingEngine, TokenEvent, create_engine, greedy_grid,
@@ -86,33 +99,42 @@ from paddle_tpu.serving.kv_cache import (  # noqa: F401
     page_content_hash,
 )
 from paddle_tpu.serving.metrics import (  # noqa: F401
-    Counter, EngineMetrics, Gauge, Histogram,
+    Counter, EngineMetrics, Gauge, Histogram, aggregate_snapshots,
 )
 from paddle_tpu.serving.model_runner import (  # noqa: F401
     GPTRunner, LlamaRunner, PagedModelRunner, bucket_len, runner_for,
 )
 from paddle_tpu.serving.resilience import (  # noqa: F401
     FaultInjector, InjectedDeviceError, InvariantViolation, QueueFullError,
-    audit_engine,
+    ReplicaCrashError, audit_engine, audit_router,
+)
+from paddle_tpu.serving.router import (  # noqa: F401
+    EngineReplica, RouterMetrics, RouterOutput, ServingRouter,
 )
 from paddle_tpu.serving.scheduler import (  # noqa: F401
     FCFSScheduler, Request, RequestState, SamplingParams,
 )
 from paddle_tpu.serving.speculate import NgramProposer  # noqa: F401
-# the serving (data, model) mesh builder + spec layout (ISSUE 7) live in
-# parallel/ — re-exported here because they are the TP serving surface
-from paddle_tpu.parallel.mesh import serving_mesh  # noqa: F401
+from paddle_tpu.serving.supervisor import Supervisor  # noqa: F401
+# the serving (data, model) mesh builder + spec layout (ISSUE 7) and the
+# per-replica sub-mesh splitter (ISSUE 8) live in parallel/ —
+# re-exported here because they are the TP/router serving surface
+from paddle_tpu.parallel.mesh import (  # noqa: F401
+    replica_submeshes, serving_mesh,
+)
 from paddle_tpu.parallel.compat import SpecLayout  # noqa: F401
 
 __all__ = [
-    "BlockAllocator", "Counter", "EngineMetrics", "FCFSScheduler",
-    "FaultInjector", "GPTRunner", "Gauge", "Histogram",
+    "BlockAllocator", "Counter", "EngineMetrics", "EngineReplica",
+    "FCFSScheduler", "FaultInjector", "GPTRunner", "Gauge", "Histogram",
     "InjectedDeviceError", "InvariantViolation", "KVCachePool",
     "LlamaRunner", "NgramProposer", "PagedModelRunner", "PrefixCache",
-    "QueueFullError", "Request", "RequestOutput", "RequestState",
-    "SCRATCH_PAGE", "SamplingParams", "SequenceKV", "ServingEngine",
-    "SpecLayout", "StreamDetokenizer", "TokenEvent", "audit_engine",
-    "bucket_len", "complete_utf8_prefix", "create_engine", "greedy_grid",
-    "naive_generate", "page_content_hash", "runner_for", "sample_token",
-    "serving_mesh",
+    "QueueFullError", "ReplicaCrashError", "Request", "RequestOutput",
+    "RequestState", "RouterMetrics", "RouterOutput", "SCRATCH_PAGE",
+    "SamplingParams", "SequenceKV", "ServingEngine", "ServingRouter",
+    "SpecLayout", "StreamDetokenizer", "Supervisor", "TokenEvent",
+    "TokenizerAdapter", "audit_engine", "audit_router",
+    "aggregate_snapshots", "bucket_len", "complete_utf8_prefix",
+    "create_engine", "greedy_grid", "naive_generate", "page_content_hash",
+    "replica_submeshes", "runner_for", "sample_token", "serving_mesh",
 ]

@@ -49,6 +49,85 @@ def complete_utf8_prefix(buf: bytes) -> int:
     return i if i - (j - 1) >= need else j - 1
 
 
+def _byte_decoder() -> dict:
+    """Inverse of the GPT-2 byte-level BPE `bytes_to_unicode` table: the
+    256 raw byte values are mapped to printable unicode code points (the
+    printable ASCII/latin range keeps itself; the rest shift up past
+    0x100), and byte-level tokenizers spell their vocabulary in THAT
+    alphabet — so a token string maps back to raw bytes one character at
+    a time. Computed once, lazily."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(0xA1, 0xAD)) + list(range(0xAE, 0x100)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(0x100 + n)
+            n += 1
+    return {chr(c): b for b, c in zip(bs, cs)}
+
+
+class TokenizerAdapter:
+    """Thin shim making any HF-style tokenizer streamable (ISSUE 8
+    satellite, the carried-over ROADMAP tokenizer item): the engine's
+    `StreamDetokenizer` wants a token -> RAW BYTES mapping
+    (``id_to_bytes``), but HuggingFace tokenizers only expose
+    ``decode``/``convert_ids_to_tokens``. This adapter derives the bytes
+    without any new dependency:
+
+      * byte-level BPE vocabularies (GPT-2/Llama-BPE style,
+        ``convert_ids_to_tokens`` returns strings over the
+        bytes_to_unicode alphabet) are inverted exactly — a token
+        holding HALF of a multi-byte UTF-8 character yields its true
+        partial bytes, which is the whole point of incremental
+        detokenization;
+      * SentencePiece-style pieces (leading U+2581 word marker) map the
+        marker to a space and encode the rest;
+      * anything else falls back to ``decode([tok])``.
+
+    `StreamDetokenizer` wraps tokenizers in this adapter automatically,
+    so ``ServingEngine(tokenizer=hf_tokenizer)`` just works."""
+
+    _SP_MARKER = "▁"
+
+    def __init__(self, tokenizer):
+        if tokenizer is None:
+            raise ValueError("TokenizerAdapter needs a tokenizer object")
+        self.tokenizer = tokenizer
+        self._decoder = _byte_decoder()
+
+    @classmethod
+    def wrap(cls, tokenizer):
+        """Adapt `tokenizer` if (and only if) it needs adapting: objects
+        already exposing id_to_bytes pass through untouched, HF-style
+        objects with convert_ids_to_tokens get wrapped, and bare
+        decode-only objects keep the token_bytes decode fallback."""
+        if tokenizer is None or hasattr(tokenizer, "id_to_bytes"):
+            return tokenizer
+        if hasattr(tokenizer, "convert_ids_to_tokens"):
+            return cls(tokenizer)
+        return tokenizer
+
+    def id_to_bytes(self, tok: int) -> bytes:
+        piece = self.tokenizer.convert_ids_to_tokens(int(tok))
+        if isinstance(piece, (list, tuple)):
+            piece = piece[0] if piece else ""
+        if piece is None:
+            piece = ""
+        if isinstance(piece, bytes):
+            return piece
+        piece = str(piece)
+        if piece and all(c in self._decoder for c in piece):
+            return bytes(self._decoder[c] for c in piece)
+        if piece.startswith(self._SP_MARKER):
+            piece = " " + piece[len(self._SP_MARKER):]
+        return piece.encode("utf-8")
+
+    def decode(self, ids):
+        return self.tokenizer.decode(ids)
+
+
 def token_bytes(tokenizer, tok: int) -> bytes:
     """Raw bytes of one token id. Prefers ``id_to_bytes`` (byte-level
     tokenizers can represent partial UTF-8 sequences there); falls back
@@ -71,7 +150,9 @@ class StreamDetokenizer:
     """
 
     def __init__(self, tokenizer):
-        self.tokenizer = tokenizer
+        # HF-style objects (convert_ids_to_tokens, no id_to_bytes) are
+        # adapted transparently — see TokenizerAdapter (ISSUE 8)
+        self.tokenizer = TokenizerAdapter.wrap(tokenizer)
         self._pending = b""
         self._parts: List[str] = []
         self.consumed = 0

@@ -1104,6 +1104,88 @@ class ServingEngine:
     def outputs(self) -> Dict[str, RequestOutput]:
         return dict(self._outputs)
 
+    # --------------------------------------------- migration (router tier)
+
+    def inject_request(self, prompt_tokens: Sequence[int],
+                       sampling: Optional[SamplingParams] = None, *,
+                       request_id: Optional[str] = None,
+                       output_tokens: Sequence[int] = (),
+                       arrival_index: Optional[int] = None,
+                       num_preemptions: int = 0,
+                       elapsed_s: float = 0.0,
+                       first_token_elapsed_s: Optional[float] = None) -> str:
+        """Admit a request WITH prior generation state — the restore /
+        migration primitive (ISSUE 8). The request re-enters the queue
+        carrying its prompt AND partial `output_tokens`; admission
+        re-prefills the full context (the normal recompute-on-resume
+        path) and the step-indexed sample keys make the continued stream
+        token-exact, on THIS engine or any sibling replica. Preserving
+        `arrival_index` keeps seedless sampling streams and auto ids
+        stable across the move (the counter is advanced past it so new
+        arrivals never collide). Deliberately bypasses the bounded-queue
+        shed gate: recovered requests must never be shed by their own
+        restore."""
+        sampling = sampling or SamplingParams()
+        if arrival_index is not None:
+            ensure_arrival_counter_above(int(arrival_index))
+            req = Request(prompt_tokens=list(map(int, prompt_tokens)),
+                          sampling=sampling, request_id=request_id or "",
+                          arrival_index=int(arrival_index))
+        else:
+            req = Request(prompt_tokens=list(map(int, prompt_tokens)),
+                          sampling=sampling, request_id=request_id or "")
+        if len(req.prompt_tokens) + sampling.max_tokens > self.max_model_len:
+            raise ValueError(
+                f"prompt({len(req.prompt_tokens)}) + max_tokens"
+                f"({sampling.max_tokens}) exceeds max_model_len="
+                f"{self.max_model_len}")
+        if req.request_id in self._requests:
+            raise ValueError(f"request {req.request_id!r} already present")
+        req.output_tokens = list(map(int, output_tokens))
+        req.num_preemptions = int(num_preemptions)
+        now = self.metrics.clock()
+        req.arrival_time = now - float(elapsed_s)
+        if first_token_elapsed_s is not None:
+            req.first_token_time = req.arrival_time + \
+                float(first_token_elapsed_s)
+        self._requests[req.request_id] = req
+        self.scheduler.add(req)
+        self.metrics.requests_added.inc()
+        self.metrics.queue_depth.set(self.scheduler.queue_depth)
+        return req.request_id
+
+    def extract_request(self, request_id: str) -> dict:
+        """Remove a WAITING request and return its serialized state (the
+        snapshot per-request shape, with a live SamplingParams object) —
+        the drain/redistribute half of migration (ISSUE 8): the router
+        tier extracts queued requests from a restored replica and
+        `inject_request`s them into siblings. RUNNING requests hold
+        device pages and cannot move; FINISHED ones have nothing to."""
+        req = self._requests.get(request_id)
+        if req is None:
+            raise KeyError(f"unknown request {request_id!r}")
+        if req.state is not RequestState.WAITING:
+            raise ValueError(
+                f"request {request_id!r} is {req.state.value}; only "
+                "WAITING requests can be extracted")
+        self.scheduler.remove_waiting(req)
+        del self._requests[request_id]
+        self._detoks.pop(request_id, None)
+        self.metrics.queue_depth.set(self.scheduler.queue_depth)
+        now = self.metrics.clock()
+        return {
+            "request_id": req.request_id,
+            "prompt_tokens": list(req.prompt_tokens),
+            "output_tokens": list(req.output_tokens),
+            "sampling": req.sampling,
+            "arrival_index": req.arrival_index,
+            "num_preemptions": req.num_preemptions,
+            "elapsed_s": now - req.arrival_time,
+            "first_token_elapsed_s": (
+                req.first_token_time - req.arrival_time
+                if req.first_token_time is not None else None),
+        }
+
     # ------------------------------------------------ snapshot / restore
 
     def release_prefix_cache(self) -> int:
@@ -1221,25 +1303,17 @@ class ServingEngine:
                   spec_min_ngram=cfg.get("spec_min_ngram", 1),
                   tokenizer=tokenizer,
                   metrics=metrics, sleep_fn=sleep_fn, audit=audit)
-        ensure_arrival_counter_above(max(
-            (r["arrival_index"] for r in state["requests"]), default=-1))
-        now = eng.metrics.clock()
         for r in state["requests"]:
             sp = dict(r["sampling"])
             sp["stop_token_ids"] = tuple(sp.get("stop_token_ids", ()))
-            req = Request(prompt_tokens=list(r["prompt_tokens"]),
-                          sampling=SamplingParams(**sp),
-                          request_id=r["request_id"],
-                          arrival_index=int(r["arrival_index"]))
-            req.output_tokens = list(r["output_tokens"])
-            req.num_preemptions = int(r.get("num_preemptions", 0))
-            req.arrival_time = now - float(r.get("elapsed_s", 0.0))
-            fte = r.get("first_token_elapsed_s")
-            if fte is not None:
-                req.first_token_time = req.arrival_time + float(fte)
-            eng._requests[req.request_id] = req
-            eng.scheduler.add(req)
-            eng.metrics.requests_added.inc()
+            eng.inject_request(
+                r["prompt_tokens"], SamplingParams(**sp),
+                request_id=r["request_id"],
+                output_tokens=r["output_tokens"],
+                arrival_index=int(r["arrival_index"]),
+                num_preemptions=int(r.get("num_preemptions", 0)),
+                elapsed_s=float(r.get("elapsed_s", 0.0)),
+                first_token_elapsed_s=r.get("first_token_elapsed_s"))
         for o in state.get("finished", []):
             eng._outputs[o["request_id"]] = RequestOutput(**o)
         eng.metrics.queue_depth.set(eng.scheduler.queue_depth)

@@ -78,6 +78,55 @@ class Histogram:
         return s[rank]
 
 
+# EngineMetrics.snapshot() keys that are cumulative event counts — the
+# keys a multi-engine tier can meaningfully SUM across replicas (ISSUE 8
+# metrics aggregation). Gauges/peaks take max, ratios are recomputed from
+# the summed counters, and exact percentiles are dropped: scalar
+# snapshots cannot be merged into a percentile, so tier-level latency
+# lives in the router's own histograms instead.
+SUMMABLE_KEYS = (
+    "requests_added", "requests_finished", "preemptions",
+    "requests_timed_out", "requests_aborted", "step_retries",
+    "nan_logit_events", "shed_requests", "tokens_generated",
+    "prefill_tokens", "prefill_chunks", "prefix_hit_tokens", "cow_copies",
+    "prefix_cached_pages", "attn_kv_bytes_read", "attn_kv_bytes_gather",
+    "spec_proposed_tokens", "spec_accepted_tokens", "spec_rollback_pages",
+    "host_syncs", "decode_horizon_steps", "horizon_overshoot_tokens",
+    "decode_steps", "queue_depth", "running", "pool_used_pages",
+)
+
+MAX_KEYS = ("queue_depth_peak", "pool_utilization_peak", "busy_seconds")
+
+
+def aggregate_snapshots(snaps) -> Dict[str, float]:
+    """Merge several EngineMetrics snapshots into one tier-level view:
+    counters sum, peaks take the max (replicas run concurrently, so
+    busy_seconds is the max too — the tier was busy as long as its
+    busiest replica), and derived ratios are recomputed from the summed
+    counters. Percentile keys are intentionally absent (see
+    SUMMABLE_KEYS)."""
+    snaps = list(snaps)
+    out: Dict[str, float] = {k: 0.0 for k in SUMMABLE_KEYS}
+    for k in MAX_KEYS:
+        out[k] = 0.0
+    for s in snaps:
+        for k in SUMMABLE_KEYS:
+            out[k] += float(s.get(k, 0.0))
+        for k in MAX_KEYS:
+            out[k] = max(out[k], float(s.get(k, 0.0)))
+    toks = out["tokens_generated"]
+    prop = out["spec_proposed_tokens"]
+    out["spec_acceptance_rate"] = (out["spec_accepted_tokens"] / prop
+                                   if prop > 0 else 0.0)
+    out["steps_per_token"] = out["decode_steps"] / toks if toks > 0 else 0.0
+    out["host_syncs_per_token"] = out["host_syncs"] / toks if toks > 0 \
+        else 0.0
+    out["tokens_per_sec"] = (toks / out["busy_seconds"]
+                             if out["busy_seconds"] > 0 else 0.0)
+    out["replicas"] = float(len(snaps))
+    return out
+
+
 class EngineMetrics:
     """The engine's instrument panel, snapshot()-able for bench.py.
 

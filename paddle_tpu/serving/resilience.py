@@ -43,6 +43,19 @@ class InvariantViolation(AssertionError):
     corruption). Raised by audit_engine; always a bug, never load."""
 
 
+class ReplicaCrashError(BaseException):
+    """A simulated WHOLE-REPLICA failure (ISSUE 8 fault class).
+
+    Derives from BaseException ON PURPOSE: the engine's transient-fault
+    recovery catches `Exception`, so this error cannot be absorbed by
+    step retries or quarantine — it escapes engine.step() and kills the
+    replica's worker thread, which is exactly the contract a real
+    replica death has (OOM kill, device loss, segfaulted process). The
+    router tier's Supervisor, not the engine, owns this failure mode:
+    it must fence the dead replica, restore from the last crash-safe
+    snapshot, and resubmit anything the snapshot missed."""
+
+
 class FaultInjector:
     """Wrap a PagedModelRunner and inject faults on selected calls.
 
@@ -78,9 +91,11 @@ class FaultInjector:
                  nan_target: str = "decode", nan_fraction: float = 1.0,
                  stall_every: int = 0, stall_calls: Iterable[int] = (),
                  stall_target: str = "decode", stall_s: float = 0.0,
-                 on_stall: Optional[Callable[[], None]] = None):
+                 on_stall: Optional[Callable[[], None]] = None,
+                 crash_every: int = 0, crash_calls: Iterable[int] = (),
+                 crash_target: str = "decode"):
         self._runner = runner
-        for t in (error_target, nan_target, stall_target):
+        for t in (error_target, nan_target, stall_target, crash_target):
             if t not in ("prefill", "decode", "both"):
                 raise ValueError(f"fault target {t!r}")
         if not 0.0 < nan_fraction <= 1.0:
@@ -88,10 +103,14 @@ class FaultInjector:
         self._error = (error_every, frozenset(error_calls), error_target)
         self._nan = (nan_every, frozenset(nan_calls), nan_target)
         self._stall = (stall_every, frozenset(stall_calls), stall_target)
+        # crash (ISSUE 8): raise ReplicaCrashError — a BaseException the
+        # engine's retry loop can NOT catch, so the scheduled call kills
+        # the whole replica (the supervisor drill's fault class)
+        self._crash = (crash_every, frozenset(crash_calls), crash_target)
         self.nan_fraction = nan_fraction
         self._on_stall = on_stall or (lambda: time.sleep(stall_s))
         self.calls = {"prefill": 0, "decode": 0}
-        self.injected = {"error": 0, "nan": 0, "stall": 0}
+        self.injected = {"error": 0, "nan": 0, "stall": 0, "crash": 0}
 
     def __getattr__(self, name):
         return getattr(object.__getattribute__(self, "_runner"), name)
@@ -115,6 +134,10 @@ class FaultInjector:
         if self._hits(self._stall, op, n):
             self.injected["stall"] += 1
             self._on_stall()
+        if self._hits(self._crash, op, n):
+            self.injected["crash"] += 1
+            raise ReplicaCrashError(
+                f"injected replica crash: {op} call {n}")
         if self._hits(self._error, op, n):
             self.injected["error"] += 1
             raise InjectedDeviceError(f"injected device error: {op} call {n}")
@@ -350,3 +373,72 @@ def audit_engine(engine) -> None:
 
     if problems:
         raise InvariantViolation("; ".join(problems))
+
+
+def audit_router(router) -> None:
+    """Tier-level invariant auditor (ISSUE 8): every LIVE replica passes
+    audit_engine, and the router's at-most-once bookkeeping is
+    consistent — each unfinished request is owned by exactly one live
+    replica (or by a failed one the supervisor has not yet recovered,
+    never by two), no request id is in flight on two live engines at
+    once (the double-completion hazard resubmission must never create),
+    delivery cursors match the delivered token streams, and the
+    prefix-affinity index only names valid replicas. Raises
+    InvariantViolation listing every broken invariant."""
+    problems = []
+    replicas = list(router._replicas)
+    for rep in replicas:
+        if rep.status != "live":
+            continue
+        try:
+            with rep.lock:
+                audit_engine(rep.engine)
+        except InvariantViolation as e:
+            problems.append(f"replica {rep.index}: {e}")
+
+    with router._lock:
+        n = len(replicas)
+        inflight = {}
+        for rep in replicas:
+            if rep.status != "live":
+                continue
+            for rid, req in rep.engine._requests.items():
+                if not req.done:
+                    if rid in inflight:
+                        problems.append(
+                            f"request {rid} in flight on replicas "
+                            f"{inflight[rid]} AND {rep.index}")
+                    inflight[rid] = rep.index
+        for rid, rec in router._reqs.items():
+            if rec.cursor != len(rec.tokens):
+                problems.append(f"request {rid} cursor {rec.cursor} != "
+                                f"{len(rec.tokens)} delivered tokens")
+            if rec.done:
+                continue
+            if not 0 <= rec.owner_idx < n:
+                problems.append(f"request {rid} owned by replica "
+                                f"{rec.owner_idx} out of range")
+                continue
+            owner = replicas[rec.owner_idx]
+            if owner.status == "live":
+                if rec.owner_epoch != owner.epoch:
+                    problems.append(
+                        f"request {rid} owned by stale epoch "
+                        f"{rec.owner_epoch} of live replica {rec.owner_idx}"
+                        f" (now epoch {owner.epoch})")
+                elif rid not in rep_requests(owner):
+                    problems.append(
+                        f"request {rid} owned by live replica "
+                        f"{rec.owner_idx} but unknown to its engine")
+        for h, idx in router._affinity.items():
+            if not 0 <= idx < n:
+                problems.append(f"affinity entry {h} -> replica {idx} "
+                                "out of range")
+
+    if problems:
+        raise InvariantViolation("; ".join(problems))
+
+
+def rep_requests(rep) -> frozenset:
+    """Request ids a replica's engine knows (finished included)."""
+    return frozenset(rep.engine._requests)

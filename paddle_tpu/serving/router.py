@@ -1,0 +1,803 @@
+"""Multi-engine serving router: prefix-affinity routing over N engine
+replicas (ISSUE 8 tentpole).
+
+One ServingEngine is not "millions of users": this module is the
+front-end tier the reference runs above its serving engines (paddle
+`distributed/fleet` orchestration / the fastdeploy router), collapsed
+to a single process — `ServingRouter` owns N engine replicas, each a
+full PR-1..7 ServingEngine (own paged KV pool, scheduler, prefix cache,
+optionally its own `(model,)` sub-mesh — `parallel.mesh.replica_submeshes`
+finally maps the serving mesh's idle data axis onto replicas) driven by
+a dedicated worker thread, and exposes the same submit / abort /
+outputs surface.
+
+Routing is PREFIX-AFFINITY first: the router hashes each request's
+page-aligned token-prefix chain with the exact content-hash scheme the
+PrefixCache indexes pages by (`kv_cache.page_content_hash` over the
+same chain seed), remembers which replica last served each chain hash,
+and routes a new request to the replica whose PrefixCache already holds
+its longest cached prefix — shared-tenant traffic keeps landing where
+its pages live, so the tier's aggregate prefix-hit rate matches a
+single engine's instead of diluting 1/N. When the affinity target's
+bounded queue is full, the request SHEDS TO A SIBLING (least-loaded by
+a queue-depth x pool-headroom score) instead of rejecting; only when
+every replica's queue is full does tier-level admission control apply
+the shed policy (reject, or overflow into the least-loaded engine's own
+drop-oldest gate).
+
+Delivery is AT-MOST-ONCE by construction: the router keeps one record
+per request (prompt, sampling, owner replica + epoch, a delivery cursor
+over the tokens the client has seen). Engines are deterministic and
+token-exact vs `naive_generate`, so any re-execution — a supervisor
+restore from a stale snapshot, a registry resubmission onto a sibling —
+regenerates the identical prefix, and the cursor drops already-
+delivered indices while epoch fencing discards anything a retired
+replica object says after its failure was declared. No request is lost
+(the registry is authoritative; see supervisor.py for the recovery
+path) and none is double-completed.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.serving.engine import ServingEngine
+from paddle_tpu.serving.kv_cache import _CHAIN_SEED, page_content_hash
+from paddle_tpu.serving.metrics import (
+    Counter, Gauge, Histogram, aggregate_snapshots,
+)
+from paddle_tpu.serving.resilience import QueueFullError
+from paddle_tpu.serving.scheduler import SamplingParams
+
+logger = logging.getLogger(__name__)
+
+ROUTING_POLICIES = ("prefix", "least_loaded", "round_robin", "random")
+
+
+@dataclass
+class RouterOutput:
+    """Tier-level completion record — the router's RequestOutput."""
+
+    request_id: str
+    prompt_tokens: List[int]
+    output_tokens: List[int]
+    finish_reason: str
+    replica: int                      # final owner replica index
+    resubmissions: int = 0            # recovery/migration hops
+    replicas: List[int] = field(default_factory=list)   # ownership history
+    ttft_s: Optional[float] = None
+    e2e_s: Optional[float] = None
+
+
+class _RequestRecord:
+    """The router's per-request bookkeeping: everything needed to (a)
+    deliver each token exactly once and (b) resubmit the request from
+    scratch if every engine-side trace of it is lost."""
+
+    __slots__ = ("request_id", "prompt_tokens", "sampling", "owner_idx",
+                 "owner_epoch", "arrival_index", "submit_time",
+                 "first_token_time", "finish_time", "cursor", "tokens",
+                 "done", "finish_reason", "resubmissions", "replicas")
+
+    def __init__(self, request_id, prompt_tokens, sampling, owner_idx,
+                 owner_epoch, arrival_index, submit_time):
+        self.request_id = request_id
+        self.prompt_tokens = prompt_tokens
+        self.sampling = sampling
+        self.owner_idx = owner_idx
+        self.owner_epoch = owner_epoch
+        self.arrival_index = arrival_index
+        self.submit_time = submit_time
+        self.first_token_time = None
+        self.finish_time = None
+        self.cursor = 0               # tokens delivered to the client
+        self.tokens: List[int] = []   # the delivered stream
+        self.done = False
+        self.finish_reason: Optional[str] = None
+        self.resubmissions = 0
+        self.replicas: List[int] = [owner_idx]
+
+
+class EngineReplica:
+    """One engine + its worker-thread state. The `lock` serializes every
+    touch of the engine (step, add, extract, snapshot); `fenced` is the
+    at-most-once kill switch — once set, nothing this object's thread
+    delivers is believed, even if the thread is still un-hanging."""
+
+    def __init__(self, index: int, epoch: int, engine: ServingEngine,
+                 runner, now: float):
+        self.index = index
+        self.epoch = epoch
+        self.engine = engine
+        self.runner = runner
+        self.lock = threading.RLock()
+        self.wake = threading.Event()
+        self.stop = False
+        self.fenced = False
+        self.status = "live"          # live | crashed | hung | retired
+        self.crash: Optional[str] = None
+        self.steps_done = 0
+        self.last_beat = now          # step-progress heartbeat
+        self.last_snapshot: Optional[dict] = None
+        self.thread: Optional[threading.Thread] = None
+
+
+class RouterMetrics:
+    """Tier-level instrument panel (the engine metrics stay per-replica;
+    `ServingRouter.metrics_snapshot` aggregates both)."""
+
+    def __init__(self, clock: Optional[Callable[[], float]] = None):
+        self.clock = clock or time.monotonic
+        self.requests_routed = Counter("requests_routed")
+        self.routed_affinity = Counter("routed_affinity")
+        self.routed_least_loaded = Counter("routed_least_loaded")
+        self.routed_round_robin = Counter("routed_round_robin")
+        self.routed_random = Counter("routed_random")
+        # a hot affinity target's full queue shed the request to a
+        # sibling instead of rejecting it (the tier admission story)
+        self.shed_reroutes = Counter("shed_reroutes")
+        self.tier_rejections = Counter("tier_rejections")
+        self.tier_overflow = Counter("tier_overflow")
+        self.requests_completed = Counter("requests_completed")
+        self.tokens_delivered = Counter("tokens_delivered")
+        # at-most-once bookkeeping: tokens a recovered/stale execution
+        # regenerated that the cursor refused to deliver twice
+        self.duplicate_tokens_dropped = Counter("duplicate_tokens_dropped")
+        self.replica_crashes = Counter("replica_crashes")
+        self.replica_hangs = Counter("replica_hangs")
+        self.replica_restarts = Counter("replica_restarts")
+        self.resubmitted_requests = Counter("resubmitted_requests")
+        self.redistributed_requests = Counter("redistributed_requests")
+        self.live_replicas = Gauge("live_replicas")
+        self.ttft_s = Histogram("router_ttft_s")
+        self.e2e_latency_s = Histogram("router_e2e_latency_s")
+
+    def snapshot(self) -> Dict[str, float]:
+        out = {c.name: c.value for c in (
+            self.requests_routed, self.routed_affinity,
+            self.routed_least_loaded, self.routed_round_robin,
+            self.routed_random, self.shed_reroutes, self.tier_rejections,
+            self.tier_overflow, self.requests_completed,
+            self.tokens_delivered, self.duplicate_tokens_dropped,
+            self.replica_crashes, self.replica_hangs,
+            self.replica_restarts, self.resubmitted_requests,
+            self.redistributed_requests)}
+        out["live_replicas"] = self.live_replicas.value
+        out["ttft_s_p50"] = self.ttft_s.percentile(50)
+        out["ttft_s_p99"] = self.ttft_s.percentile(99)
+        out["ttft_s_mean"] = self.ttft_s.mean
+        out["e2e_latency_s_p50"] = self.e2e_latency_s.percentile(50)
+        out["e2e_latency_s_p99"] = self.e2e_latency_s.percentile(99)
+        return out
+
+
+class ServingRouter:
+    """N engine replicas behind one submit/abort/outputs surface.
+
+    router = ServingRouter(runner_factory, replicas=2, num_blocks=64,
+                           max_batch_size=4, enable_prefix_cache=True)
+    rid = router.submit([1, 2, 3], SamplingParams(max_tokens=8))
+    outs = router.drain(timeout_s=60)
+    router.shutdown()        # or `with ServingRouter(...) as router:`
+
+    `runner_factory(replica_index)` builds one PagedModelRunner per
+    replica (and per restart — a dead replica never reuses its possibly
+    wedged runner). Every other keyword is either a router knob below or
+    passed through to each replica's ServingEngine verbatim.
+
+    Router knobs:
+      replicas             engine replica count (thread-per-engine)
+      policy               "prefix" (default; affinity first, least-
+                           loaded fallback), "least_loaded",
+                           "round_robin", or "random" (seeded — the
+                           bench's affinity-vs-random comparison arm)
+      max_queue_depth      per-REPLICA bounded queue (also given to each
+                           engine); the router pre-checks it so a full
+                           affinity target sheds to a sibling
+      shed_policy          tier behavior when EVERY replica is full:
+                           "reject" raises QueueFullError at submit,
+                           "drop_oldest" overflows into the least-loaded
+                           engine, whose own gate sheds its oldest
+      snapshot_every_steps worker snapshot cadence (crash-restore
+                           freshness; 0 = never — recovery then rebuilds
+                           purely from the router registry)
+      supervise            attach a Supervisor (crash/hang detection +
+                           restore); drain() also polls it inline, so
+                           recovery works even without its thread
+      heartbeat_timeout_s  no step progress for this long while work is
+                           pending = the replica is declared HUNG
+      poll_interval_s      supervisor thread poll cadence
+      redistribute         after a restore, spread the recovered queue
+                           back over the tier through the normal routing
+                           policy instead of leaving it all on the
+                           restarted replica
+    """
+
+    def __init__(self, runner_factory: Callable, *, replicas: int = 2,
+                 policy: str = "prefix",
+                 max_queue_depth: Optional[int] = None,
+                 shed_policy: str = "reject",
+                 snapshot_every_steps: int = 1,
+                 idle_wait_s: float = 0.005,
+                 supervise: bool = True,
+                 heartbeat_timeout_s: float = 5.0,
+                 poll_interval_s: float = 0.2,
+                 redistribute: bool = True,
+                 clock: Optional[Callable[[], float]] = None,
+                 metrics: Optional[RouterMetrics] = None,
+                 **engine_kw):
+        if replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        if policy not in ROUTING_POLICIES:
+            raise ValueError(f"policy={policy!r}; expected one of "
+                             f"{ROUTING_POLICIES}")
+        if shed_policy not in ("reject", "drop_oldest"):
+            raise ValueError(f"shed_policy={shed_policy!r}; expected "
+                             "'reject' or 'drop_oldest'")
+        self._runner_factory = runner_factory
+        self._policy = policy
+        self.max_queue_depth = max_queue_depth
+        self.shed_policy = shed_policy
+        self._snapshot_every = max(0, int(snapshot_every_steps))
+        self._idle_wait_s = float(idle_wait_s)
+        self._clock = clock or time.monotonic
+        self.metrics = metrics or RouterMetrics(clock=self._clock)
+        # each engine enforces the same bounded queue + shed policy —
+        # the router's pre-check sheds across replicas, the engine's own
+        # gate is the authoritative single-replica backstop
+        engine_kw["max_queue_depth"] = max_queue_depth
+        engine_kw["shed_policy"] = shed_policy
+        self._engine_kw = dict(engine_kw)
+        self._lock = threading.RLock()
+        self._completion = threading.Event()
+        self._reqs: Dict[str, _RequestRecord] = {}
+        self._affinity: Dict[int, int] = {}      # chain hash -> replica
+        self._retired_metrics: List[Dict[str, float]] = []
+        self._epochs = itertools.count()
+        self._rr = itertools.count()
+        self._rng = np.random.default_rng(0)
+        self._replicas: List[EngineReplica] = []
+        for idx in range(replicas):
+            runner = self._make_runner(idx)
+            self._spawn(idx, self._build_engine(runner), runner,
+                        start=False)
+        self.block_size = self._replicas[0].engine.pool.block_size
+        for rep in self._replicas:
+            self._start_worker(rep)
+        self.metrics.live_replicas.set(replicas)
+        self.supervisor = None
+        if supervise:
+            from paddle_tpu.serving.supervisor import Supervisor
+
+            self.supervisor = Supervisor(
+                self, heartbeat_timeout_s=heartbeat_timeout_s,
+                poll_interval_s=poll_interval_s,
+                redistribute=redistribute)
+            self.supervisor.start()
+
+    # --------------------------------------------------------- plumbing
+
+    def _make_runner(self, idx: int):
+        try:
+            return self._runner_factory(idx)
+        except TypeError:
+            # zero-arg factories are fine too (index-blind replicas)
+            return self._runner_factory()
+
+    def _build_engine(self, runner) -> ServingEngine:
+        return ServingEngine(runner, **self._engine_kw)
+
+    def _spawn(self, idx: int, engine: ServingEngine, runner,
+               start: bool = True) -> EngineReplica:
+        rep = EngineReplica(idx, next(self._epochs), engine, runner,
+                            self._clock())
+        with self._lock:
+            if idx == len(self._replicas):
+                self._replicas.append(rep)
+            else:
+                self._replicas[idx] = rep
+                # the old replica's cached pages died with its pool: any
+                # affinity pointing there is stale
+                self._affinity = {h: i for h, i in self._affinity.items()
+                                  if i != idx}
+            self.metrics.live_replicas.set(
+                sum(1 for r in self._replicas if r.status == "live"))
+        if start:
+            self._start_worker(rep)
+        return rep
+
+    def _start_worker(self, rep: EngineReplica) -> None:
+        t = threading.Thread(
+            target=self._worker, args=(rep,), daemon=True,
+            name=f"serving-router-r{rep.index}e{rep.epoch}")
+        rep.thread = t
+        t.start()
+
+    def _worker(self, rep: EngineReplica) -> None:
+        """The replica's step loop. Everything engine-touching runs
+        under rep.lock; a BaseException escaping step() (the engine
+        absorbs every Exception-level fault itself) means the replica is
+        DEAD — fence it and let the supervisor take over."""
+        while True:
+            if rep.stop:
+                return
+            stepped = False
+            with rep.lock:
+                if not rep.stop and not rep.fenced \
+                        and rep.engine.has_work():
+                    epoch = rep.epoch
+                    try:
+                        events = rep.engine.step()
+                    except BaseException as e:   # replica death, not load
+                        rep.crash = f"{type(e).__name__}: {e}"
+                        rep.status = "crashed"
+                        rep.fenced = True
+                        self.metrics.replica_crashes.inc()
+                        self.metrics.live_replicas.set(
+                            sum(1 for r in self._replicas
+                                if r.status == "live"))
+                        self._completion.set()
+                        logger.warning("replica %d crashed: %s",
+                                       rep.index, rep.crash)
+                        return
+                    rep.steps_done += 1
+                    rep.last_beat = self._clock()
+                    self._deliver(rep, epoch, events)
+                    self._collect(rep)
+                    if (self._snapshot_every and not rep.fenced
+                            and rep.steps_done % self._snapshot_every == 0):
+                        rep.last_snapshot = rep.engine.snapshot()
+                    stepped = True
+            if not stepped:
+                rep.wake.wait(self._idle_wait_s)
+                rep.wake.clear()
+
+    # --------------------------------------------------------- delivery
+
+    def _deliver(self, rep: EngineReplica, epoch: int, events) -> None:
+        """Fold one step's TokenEvents into the registry. Caller holds
+        rep.lock. Fencing first, then the cursor: a stale execution
+        (recovered elsewhere, or re-running delivered history after a
+        restore) can only ever re-say what was already said — drop it."""
+        if not events:
+            return
+        now = self._clock()
+        with self._lock:
+            if rep.fenced:
+                return
+            for ev in events:
+                rec = self._reqs.get(ev.request_id)
+                if (rec is None or rec.done
+                        or rec.owner_idx != rep.index
+                        or rec.owner_epoch != epoch):
+                    continue
+                if ev.index < rec.cursor:
+                    self.metrics.duplicate_tokens_dropped.inc()
+                    continue
+                # deterministic engines emit indices densely, so the
+                # next undelivered index is the only possible new event
+                rec.tokens.append(int(ev.token))
+                rec.cursor += 1
+                self.metrics.tokens_delivered.inc()
+                if rec.first_token_time is None:
+                    rec.first_token_time = now
+                    self.metrics.ttft_s.observe(now - rec.submit_time)
+                if ev.finished:
+                    self._finish(rec, ev.finish_reason)
+
+    def _collect(self, rep: EngineReplica) -> None:
+        """Pick up completions that produced no TokenEvent (timeout,
+        abort, shed, error — and finished outputs a restore carried).
+        Caller holds rep.lock."""
+        outs = rep.engine._outputs
+        if not outs:
+            return
+        with self._lock:
+            if rep.fenced:
+                return
+            for rid, out in list(outs.items()):
+                rec = self._reqs.get(rid)
+                if (rec is None or rec.done
+                        or rec.owner_idx != rep.index
+                        or rec.owner_epoch != rep.epoch):
+                    continue
+                for tok in out.output_tokens[rec.cursor:]:
+                    rec.tokens.append(int(tok))
+                    rec.cursor += 1
+                    self.metrics.tokens_delivered.inc()
+                self._finish(rec, out.finish_reason)
+
+    def _finish(self, rec: _RequestRecord, reason: str) -> None:
+        """Caller holds self._lock."""
+        rec.done = True
+        rec.finish_reason = reason
+        rec.finish_time = self._clock()
+        self.metrics.requests_completed.inc()
+        self.metrics.e2e_latency_s.observe(rec.finish_time
+                                           - rec.submit_time)
+        self._completion.set()
+
+    # ---------------------------------------------------------- routing
+
+    def _affinity_chain(self, tokens: Sequence[int]) -> List[int]:
+        """Page-aligned content-hash chain of a prompt — the SAME hashes
+        PrefixCache.match computes, capped strictly below len(tokens)
+        exactly like match() (at least one token is always computed)."""
+        bs = self.block_size
+        chain: List[int] = []
+        prev = _CHAIN_SEED
+        for i in range((len(tokens) - 1) // bs):
+            prev = page_content_hash(prev, tokens[i * bs:(i + 1) * bs])
+            chain.append(prev)
+        return chain
+
+    def _load(self, rep: EngineReplica) -> float:
+        """Queue-depth x pool-headroom load score (advisory, lock-free
+        reads): replicas with deeper queues and fuller pools score
+        higher; ties break on replica index via the sort below."""
+        sched = rep.engine.scheduler
+        alloc = rep.engine.pool.allocator
+        depth = sched.queue_depth + len(sched.running)
+        headroom = ((alloc.num_free + alloc.num_evictable)
+                    / max(alloc.num_usable, 1))
+        return (1.0 + depth) * (2.0 - headroom)
+
+    def _has_capacity(self, rep: EngineReplica) -> bool:
+        if self.max_queue_depth is None:
+            return True
+        return rep.engine.scheduler.queue_depth < self.max_queue_depth
+
+    def _choose(self, chain: Sequence[int]) -> Tuple[EngineReplica, str]:
+        with self._lock:
+            live = [r for r in self._replicas if r.status == "live"]
+            if not live:
+                raise RuntimeError("no live replicas")
+            first, how = None, None
+            if self._policy == "prefix":
+                for h in reversed(chain):
+                    idx = self._affinity.get(h)
+                    if idx is not None \
+                            and self._replicas[idx].status == "live":
+                        first, how = self._replicas[idx], "affinity"
+                        break
+            elif self._policy == "round_robin":
+                first, how = live[next(self._rr) % len(live)], "round_robin"
+            elif self._policy == "random":
+                first = live[int(self._rng.integers(len(live)))]
+                how = "random"
+        if first is not None and self._has_capacity(first):
+            return first, how
+        if how == "affinity" and first is not None:
+            # hot affinity target: shed to a sibling, don't reject
+            self.metrics.shed_reroutes.inc()
+        ordered = sorted(live, key=lambda r: (self._load(r), r.index))
+        for rep in ordered:
+            if self._has_capacity(rep):
+                return rep, "least_loaded"
+        # every replica's queue is full: tier-level admission control
+        if self.shed_policy == "reject":
+            self.metrics.tier_rejections.inc()
+            raise QueueFullError(
+                f"all {len(live)} replica queues full "
+                f"(max_queue_depth={self.max_queue_depth} each); tier "
+                "shed_policy='reject'")
+        self.metrics.tier_overflow.inc()
+        return ordered[0], "overflow"
+
+    # ----------------------------------------------------------- intake
+
+    def submit(self, prompt_tokens: Sequence[int],
+               sampling: Optional[SamplingParams] = None,
+               request_id: Optional[str] = None) -> str:
+        """Route one request to a replica and enqueue it. Raises
+        QueueFullError only when EVERY replica's bounded queue is full
+        under shed_policy='reject'; a merely hot affinity target sheds
+        to the least-loaded sibling instead."""
+        sampling = sampling or SamplingParams()
+        prompt = list(map(int, prompt_tokens))
+        if request_id is not None:
+            with self._lock:
+                if request_id in self._reqs:
+                    raise ValueError(f"request {request_id!r} already "
+                                     "submitted")
+        chain = self._affinity_chain(prompt)
+        for _ in range(len(self._replicas) + 2):
+            rep, how = self._choose(chain)
+            with rep.lock:
+                if rep.fenced or rep.status != "live":
+                    continue           # died between choose and lock
+                rid = rep.engine.add_request(prompt, sampling,
+                                             request_id=request_id)
+                arrival_index = rep.engine._requests[rid].arrival_index
+                with self._lock:
+                    rec = _RequestRecord(rid, prompt, sampling, rep.index,
+                                         rep.epoch, arrival_index,
+                                         self._clock())
+                    self._reqs[rid] = rec
+                    for h in chain:
+                        self._affinity[h] = rep.index
+                # a drop_oldest overflow may have shed a sibling request
+                # synchronously inside add_request — record it now
+                self._collect(rep)
+                rep.last_beat = max(rep.last_beat, self._clock())
+            self.metrics.requests_routed.inc()
+            if how != "overflow":      # tier_overflow counted in _choose
+                {"affinity": self.metrics.routed_affinity,
+                 "round_robin": self.metrics.routed_round_robin,
+                 "random": self.metrics.routed_random,
+                 }.get(how, self.metrics.routed_least_loaded).inc()
+            rep.wake.set()
+            return rid
+        raise RuntimeError("no live replicas accepted the request")
+
+    def abort(self, request_id: str, reason: str = "aborted") -> bool:
+        """Cancel an in-flight request tier-wide. Works even while its
+        owner replica is dead and awaiting recovery (the registry is
+        then the only live record — finish it there; a later restore
+        sees the record done and aborts the engine-side zombie)."""
+        with self._lock:
+            rec = self._reqs.get(request_id)
+            if rec is None or rec.done:
+                return False
+            rep = self._replicas[rec.owner_idx]
+            live_owner = (rep.status == "live"
+                          and rec.owner_epoch == rep.epoch)
+            if not live_owner:
+                self._finish(rec, reason)
+                return True
+        with rep.lock:
+            ok = rep.engine.abort(request_id, reason)
+            self._collect(rep)
+        if not ok:
+            with self._lock:
+                if not rec.done:
+                    self._finish(rec, reason)
+        return True
+
+    # ------------------------------------------------ recovery plumbing
+    # (driven by supervisor.Supervisor — kept here because they touch
+    # the registry/affinity internals under the router lock)
+
+    def _record_state(self, rec: _RequestRecord) -> dict:
+        """Serialized request state from the registry alone — the
+        resubmission source when no engine-side trace survives. The
+        delivered-token prefix is authoritative: it is >= any snapshot
+        (snapshots are taken after delivery) and is exactly what the
+        client has seen."""
+        now = self._clock()
+        return {
+            "request_id": rec.request_id,
+            "prompt_tokens": list(rec.prompt_tokens),
+            "output_tokens": list(rec.tokens),
+            "sampling": rec.sampling,
+            "arrival_index": rec.arrival_index,
+            "num_preemptions": 0,
+            "elapsed_s": now - rec.submit_time,
+            "first_token_elapsed_s": (
+                rec.first_token_time - rec.submit_time
+                if rec.first_token_time is not None else None),
+        }
+
+    def _inject(self, rep: EngineReplica, rec: _RequestRecord,
+                state: Optional[dict] = None) -> None:
+        """Resubmit a registry request into `rep`'s engine (restore
+        backfill / redistribution). Prefers the registry's delivered
+        prefix over any engine-side partial so the engine recomputes as
+        little already-delivered history as possible."""
+        if state is None:
+            state = self._record_state(rec)
+        out = list(state.get("output_tokens") or ())
+        if len(rec.tokens) > len(out):
+            out = list(rec.tokens)
+        with rep.lock:
+            rep.engine.inject_request(
+                state["prompt_tokens"], state["sampling"],
+                request_id=rec.request_id, output_tokens=out,
+                arrival_index=state["arrival_index"],
+                num_preemptions=int(state.get("num_preemptions", 0)),
+                elapsed_s=float(state.get("elapsed_s", 0.0)),
+                first_token_elapsed_s=state.get("first_token_elapsed_s"))
+            rep.last_beat = max(rep.last_beat, self._clock())
+        with self._lock:
+            rec.owner_idx, rec.owner_epoch = rep.index, rep.epoch
+            rec.resubmissions += 1
+            rec.replicas.append(rep.index)
+            for h in self._affinity_chain(state["prompt_tokens"]):
+                self._affinity[h] = rep.index
+        self.metrics.resubmitted_requests.inc()
+        rep.wake.set()
+
+    def _adopt(self, rep: EngineReplica, rec: _RequestRecord) -> None:
+        """Re-own a record restored onto replica `rep` (no engine work:
+        the restore already carries the request)."""
+        with self._lock:
+            rec.owner_idx, rec.owner_epoch = rep.index, rep.epoch
+            if not rec.replicas or rec.replicas[-1] != rep.index:
+                rec.replicas.append(rep.index)
+
+    def _orphans(self, idx: int, epoch: int) -> List[_RequestRecord]:
+        with self._lock:
+            return [rec for rec in self._reqs.values()
+                    if not rec.done and rec.owner_idx == idx
+                    and rec.owner_epoch == epoch]
+
+    def _redistribute_from(self, rep: EngineReplica) -> int:
+        """Drain the restored replica's queue back through the routing
+        policy: the first max_batch_size requests stay (they refill its
+        batch immediately), the rest re-route — with the dead pool's
+        affinity purged that means least-loaded, i.e. the tier absorbs
+        the dead replica's backlog instead of serializing behind its
+        re-warm. Stops as soon as the policy routes a request back to
+        the restored replica (the tier is balanced again)."""
+        with self._lock:
+            siblings = [r for r in self._replicas
+                        if r.status == "live" and r is not rep]
+        if not siblings:
+            return 0
+        with rep.lock:
+            queue = [r.request_id
+                     for r in rep.engine.scheduler.waiting]
+        moved = 0
+        for rid in queue[rep.engine.max_batch_size:]:
+            with self._lock:
+                rec = self._reqs.get(rid)
+            if rec is None or rec.done:
+                continue
+            # deliberately least-loaded, NOT the affinity policy: the
+            # dead pool's pages are gone (and backfill re-pins affinity
+            # to the restored replica), so spreading the backlog is the
+            # whole point here
+            with self._lock:
+                ordered = sorted(
+                    (r for r in self._replicas if r.status == "live"),
+                    key=lambda r: (self._load(r), r.index))
+            target = next((t for t in ordered
+                           if self._has_capacity(t)), None)
+            if target is None or target is rep:
+                break                  # tier is balanced (or full) again
+            try:
+                with rep.lock:
+                    state = rep.engine.extract_request(rid)
+            except (KeyError, ValueError):
+                continue               # raced into RUNNING/FINISHED
+            self._inject(target, rec, state)
+            self.metrics.redistributed_requests.inc()
+            moved += 1
+        return moved
+
+    # ----------------------------------------------------------- drills
+
+    def kill_replica(self, idx: int, reason: str = "killed") -> bool:
+        """Simulate a replica process death (test/drill hook): fence it
+        immediately — even mid-step — and leave recovery to the
+        supervisor. Returns False if the replica is not live."""
+        with self._lock:
+            rep = self._replicas[idx]
+            if rep.status != "live":
+                return False
+            rep.fenced = True
+            rep.stop = True
+            rep.status = "crashed"
+            rep.crash = f"ReplicaKilled: {reason}"
+            self.metrics.replica_crashes.inc()
+            self.metrics.live_replicas.set(
+                sum(1 for r in self._replicas if r.status == "live"))
+        rep.wake.set()
+        self._completion.set()
+        return True
+
+    # ------------------------------------------------------------ drain
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return any(not rec.done for rec in self._reqs.values())
+
+    def drain(self, timeout_s: Optional[float] = None,
+              poll_s: float = 0.02) -> Dict[str, RouterOutput]:
+        """Block until every submitted request has finished; returns
+        outputs(). Polls the supervisor inline, so crash/hang recovery
+        happens even when its background thread is disabled. Raises
+        TimeoutError (listing the stuck requests) after `timeout_s`."""
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        while True:
+            with self._lock:
+                pending = [rid for rid, rec in self._reqs.items()
+                           if not rec.done]
+            if not pending:
+                return self.outputs()
+            if self.supervisor is not None:
+                self.supervisor.poll()
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"{len(pending)} requests still pending after "
+                    f"{timeout_s}s: {pending[:8]}")
+            self._completion.wait(poll_s)
+            self._completion.clear()
+
+    def outputs(self) -> Dict[str, RouterOutput]:
+        with self._lock:
+            return {
+                rid: RouterOutput(
+                    request_id=rid,
+                    prompt_tokens=list(rec.prompt_tokens),
+                    output_tokens=list(rec.tokens),
+                    finish_reason=rec.finish_reason,
+                    replica=rec.owner_idx,
+                    resubmissions=rec.resubmissions,
+                    replicas=list(rec.replicas),
+                    ttft_s=(rec.first_token_time - rec.submit_time
+                            if rec.first_token_time is not None else None),
+                    e2e_s=(rec.finish_time - rec.submit_time
+                           if rec.finish_time is not None else None))
+                for rid, rec in self._reqs.items() if rec.done}
+
+    # ---------------------------------------------------------- metrics
+
+    def metrics_snapshot(self) -> dict:
+        """{"router": tier counters/latencies, "engines": the summed
+        per-replica EngineMetrics (retired epochs included — a restart
+        never loses history), "per_replica": live engine snapshots}."""
+        with self._lock:
+            reps = list(self._replicas)
+            retired = list(self._retired_metrics)
+        per = []
+        for rep in reps:
+            if rep.status != "live":
+                continue
+            with rep.lock:
+                snap = rep.engine.metrics.snapshot()
+            per.append({"replica": rep.index, "epoch": rep.epoch,
+                        "steps": rep.steps_done, **snap})
+        engine_snaps = [{k: v for k, v in p.items()
+                         if k not in ("replica", "epoch", "steps")}
+                        for p in per] + retired
+        return {"router": self.metrics.snapshot(),
+                "engines": aggregate_snapshots(engine_snaps),
+                "per_replica": per}
+
+    # --------------------------------------------------------- teardown
+
+    def release_prefix_caches(self) -> int:
+        """release_prefix_cache() on every live replica (the tier leak-
+        audit hook). Returns total pages released."""
+        total = 0
+        for rep in self._replicas:
+            if rep.status != "live":
+                continue
+            with rep.lock:
+                total += rep.engine.release_prefix_cache()
+        return total
+
+    def check_no_leaks(self) -> bool:
+        for rep in self._replicas:
+            if rep.status != "live":
+                continue
+            with rep.lock:
+                if not rep.engine.pool.allocator.check_no_leaks():
+                    return False
+        return True
+
+    def shutdown(self, timeout_s: float = 2.0) -> None:
+        if self.supervisor is not None:
+            self.supervisor.stop()
+        for rep in list(self._replicas):
+            rep.stop = True
+            rep.wake.set()
+        for rep in list(self._replicas):
+            t = rep.thread
+            if t is not None and t.is_alive():
+                t.join(timeout_s)
+
+    def __enter__(self) -> "ServingRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

@@ -39,13 +39,19 @@ def test_strategy_sections():
 
 def test_engine_fit_evaluate_predict_save_load(tmp_path):
     paddle.seed(0)
-    np.random.seed(0)   # loader shuffle rides global numpy RNG
+    # shuffle=False keeps the batch order off the GLOBAL numpy RNG: under
+    # full-suite contention, daemon threads left by earlier tests can
+    # consume np.random concurrently with the loader's shuffle, changing
+    # the trajectory and intermittently breaking the loss assertion (the
+    # long-standing "fit-loss flake"). A fixed order is deterministic no
+    # matter what else is running, and Adam on the linear-regression set
+    # still descends monotonically enough for the end-to-end comparison.
     model = nn.Linear(8, 1)
     opt = paddle.optimizer.Adam(learning_rate=0.05,
                                 parameters=model.parameters())
     eng = Engine(model, loss=_mse, optimizer=opt)
     ds = RegData()
-    hist = eng.fit(ds, epochs=2, batch_size=16, verbose=0)
+    hist = eng.fit(ds, epochs=2, batch_size=16, verbose=0, shuffle=False)
     assert len(hist["loss"]) == 8
     assert hist["loss"][-1] < hist["loss"][0]
 

@@ -61,6 +61,19 @@ classes still require token equality with the naive oracle — injected
 sharded-launch errors must retry exactly like single-device ones.
 Records add tp / attn bytes, which are counted PER SHARD when tp > 1.
 
+ISSUE 8: `--router N` (N >= 2) switches to the TIER drill: N engine
+replicas behind a ServingRouter (prefix-affinity routing, supervisor
+attached) run a mixed shared-header workload under the tier fault
+classes — none (baseline + oracle equality), replica_kill (one replica
+fenced mid-run; the supervisor restores it from its crash-safe snapshot
+and redistributes), replica_hang (an injected clock stall trips the
+step-progress heartbeat), and tier_shed (per-replica bounded queues
+under a 3x burst; a hot replica sheds to siblings, tier overflow drops
+oldest). Every class must recover with ZERO lost and ZERO duplicated
+requests (token-exact vs the naive oracle where no request was shed),
+and the per-replica invariant auditor (audit_router) must come back
+green.
+
 ISSUE 5: `--speculate [K]` (K defaults to 4) drills every fault class
 with speculative decoding ON: decode rides n-gram verify spans through
 the full-logits ragged call — the same decode-op fault schedules now
@@ -215,6 +228,128 @@ def run_class(fault: str, runner, args) -> dict:
     }
 
 
+ROUTER_FAULTS = ("none", "replica_kill", "replica_hang", "tier_shed")
+
+
+def run_router_class(fault: str, runner, args) -> dict:
+    """One tier-level fault class through a ServingRouter (ISSUE 8)."""
+    import time as _time
+
+    import numpy as np
+
+    from paddle_tpu.serving import (
+        FaultInjector, SamplingParams, ServingRouter, audit_router,
+        naive_generate,
+    )
+
+    stalled = []
+
+    def factory(idx):
+        # all replicas share ONE warmed runner (the classes reuse its jit
+        # cache); replica 0 gets the class's fault wrapper exactly once —
+        # the restarted epoch must come back healthy
+        if fault == "replica_hang" and idx == 0 and not stalled:
+            stalled.append(1)
+            return FaultInjector(runner, stall_calls=[4],
+                                 stall_target="decode", stall_s=0.8)
+        return runner
+
+    router_kw = {}
+    if fault == "tier_shed":
+        router_kw.update(max_queue_depth=max(2, args.requests // 4),
+                         shed_policy="drop_oldest")
+    router = ServingRouter(
+        factory, replicas=args.router,
+        num_blocks=args.num_blocks, max_batch_size=args.max_batch,
+        max_model_len=args.max_model_len, max_step_retries=2,
+        retry_backoff_s=0.001, audit=True,
+        enable_prefix_cache=args.prefix_cache,
+        max_prefill_tokens_per_step=args.chunk or None,
+        heartbeat_timeout_s=0.25, poll_interval_s=0.05,
+        **router_kw)
+
+    rng = np.random.default_rng(0)
+    vocab = runner.vocab_size
+    n = args.requests * (3 if fault == "tier_shed" else 1)
+    header = list(rng.integers(1, vocab, 9))
+    work = []
+    crashed = None
+    try:
+        for i in range(n):
+            plen = int(rng.integers(4, 20))
+            prompt = list(rng.integers(1, vocab, plen))
+            if i % 2:
+                prompt[:min(len(header), len(prompt) - 1)] = \
+                    header[:len(prompt) - 1]
+            sp = SamplingParams(
+                max_tokens=int(rng.integers(3, args.max_tokens)))
+            rid = router.submit(prompt, sp)
+            work.append((rid, prompt, sp))
+        if fault == "replica_kill":
+            # let the tier make some progress first, then fence one
+            deadline = _time.monotonic() + 10.0
+            while (router.metrics.tokens_delivered.value < n
+                    and _time.monotonic() < deadline):
+                _time.sleep(0.005)
+            router.kill_replica(0)
+        outs = router.drain(timeout_s=120.0)
+        audit_router(router)
+    except Exception as e:      # must never happen — that's the point
+        crashed = f"{type(e).__name__}: {e}"
+        outs = router.outputs()
+
+    rm = router.metrics.snapshot()
+    agg = router.metrics_snapshot()["engines"]
+    router.release_prefix_caches()
+    leaks_ok = router.check_no_leaks()
+
+    oracle_ok = True
+    shed = 0
+    for rid, prompt, sp in work:
+        o = outs.get(rid)
+        if o is None:
+            oracle_ok = False
+            break
+        if o.finish_reason == "shed":
+            shed += 1
+            continue
+        ref = naive_generate(runner, prompt, sp,
+                             max_model_len=args.max_model_len)
+        if o.output_tokens != ref:
+            oracle_ok = False
+            break
+    router.shutdown()
+
+    ok = (crashed is None and leaks_ok and oracle_ok
+          and len(outs) == n
+          and all(o.finish_reason for o in outs.values())
+          and rm["duplicate_tokens_dropped"] >= 0
+          and (fault != "replica_kill" or rm["replica_restarts"] >= 1)
+          and (fault != "replica_hang" or rm["replica_hangs"] >= 1)
+          and (fault != "tier_shed" or shed > 0))
+    return {
+        "fault": f"router_{fault}", "ok": ok, "requests": n,
+        "replicas": args.router,
+        "no_unhandled_exception": crashed is None, "crash": crashed,
+        "requests_lost": n - len(outs),
+        "requests_shed": shed,
+        "pages_leaked": not leaks_ok,
+        "oracle_token_equal": oracle_ok,
+        "routed_affinity": rm["routed_affinity"],
+        "shed_reroutes": rm["shed_reroutes"],
+        "tier_overflow": rm["tier_overflow"],
+        "replica_crashes": rm["replica_crashes"],
+        "replica_hangs": rm["replica_hangs"],
+        "replica_restarts": rm["replica_restarts"],
+        "resubmitted_requests": rm["resubmitted_requests"],
+        "redistributed_requests": rm["redistributed_requests"],
+        "duplicate_tokens_dropped": rm["duplicate_tokens_dropped"],
+        "prefix_hit_tokens": agg["prefix_hit_tokens"],
+        "step_retries": agg["step_retries"],
+        "preemptions": agg["preemptions"],
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--faults", default=",".join(FAULTS),
@@ -250,6 +385,12 @@ def main() -> int:
                     help="multi-step decode: sync with the host every N "
                          "steps on pure-greedy decode batches "
                          "(runner.decode_multi; default 1 = per-step)")
+    ap.add_argument("--router", type=int, default=0, metavar="N",
+                    help="tier drill (ISSUE 8): run the router fault "
+                         "classes (replica_kill / replica_hang / "
+                         "tier_shed) over N engine replicas behind a "
+                         "ServingRouter + Supervisor instead of the "
+                         "single-engine classes")
     ap.add_argument("--tp", type=int, default=1, metavar="N",
                     help="tensor-parallel degree: shard weights + KV "
                          "pools over a (data=1, model=N) mesh (ISSUE 7; "
@@ -297,6 +438,17 @@ def main() -> int:
     warm.run()
 
     all_ok = True
+    if args.router >= 2:
+        # ISSUE 8 tier drill: the router fault classes replace the
+        # single-engine ones (the engine classes are the tier's
+        # substrate and keep their own default drill)
+        for fault in ROUTER_FAULTS:
+            rec = run_router_class(fault, runner, args)
+            all_ok &= rec["ok"]
+            print(json.dumps(rec))
+        print(f"\nfault smoke (router x{args.router}): "
+              f"{'ALL RECOVERED' if all_ok else 'FAILURES'}")
+        return 0 if all_ok else 1
     for fault in args.faults.split(","):
         fault = fault.strip()
         if fault not in FAULTS:
