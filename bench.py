@@ -555,6 +555,131 @@ def child_serving_long(layers: int, hidden: int, max_batch: int,
                   "workload": "long_context", "point": point})
 
 
+def child_serving_kvq(layers: int, hidden: int, max_batch: int,
+                      requests: int, prompt: int, gen: int, vocab: int):
+    """Quantized-KV serving rung (ISSUE 9): the long-context chunked
+    workload run in fp32-vs-int8 arms. Each arm reports tokens/s and the
+    instrumented `attn_kv_bytes_read` (which on the int8 arm counts the
+    quantized page bytes PLUS the per-page-per-head scale bytes — the
+    accounting is honest, so the committed reduction is measured, not
+    assumed). A third arm adds weight-only int8. The accuracy record is
+    teacher-forced: the fp32 arm's greedy token stream is replayed
+    through each quantized runner and the per-step logits compared —
+    mean |Δlogit|, top-5 overlap, and greedy-token agreement vs the
+    fp32 oracle ride the structured JSON result."""
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.serving import (
+        GPTRunner, KVCachePool, SamplingParams, ServingEngine,
+    )
+
+    backend = jax.default_backend()
+    paddle.seed(0)
+    max_len = prompt + gen
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+                    num_heads=max(hidden // 64, 1), max_seq_len=max_len,
+                    dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+    block_size = min(16, max_len)
+    pages_per_seq = -(-max_len // block_size)
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(0, vocab, prompt)) for _ in range(requests)]
+
+    def make_runner(kv_dtype, weight_dtype):
+        return GPTRunner(model, block_size=block_size, max_model_len=max_len,
+                         kv_dtype=kv_dtype, weight_dtype=weight_dtype)
+
+    def run_arm(runner) -> dict:
+        def once():
+            runner.reset_attn_counters()
+            eng = ServingEngine(runner,
+                                num_blocks=max_batch * pages_per_seq + 1,
+                                max_batch_size=max_batch,
+                                max_model_len=max_len,
+                                max_prefill_tokens_per_step=4 * block_size,
+                                ragged_batch=True)
+            t0 = time.time()
+            for i, p in enumerate(prompts):
+                eng.add_request(p, SamplingParams(max_tokens=gen),
+                                request_id=f"r{i}")
+            eng.run()
+            wall = time.time() - t0
+            snap = eng.metrics.snapshot()
+            return {"wall_s": round(wall, 3),
+                    "kv_dtype": runner.kv_dtype,
+                    "weight_dtype": runner.weight_dtype,
+                    "tokens_per_sec": snap["tokens_generated"] / wall,
+                    "ttft_s_p50": snap["ttft_s_p50"],
+                    "attn_kv_gb_read": snap["attn_kv_bytes_read"] / 1e9,
+                    "kv_bytes_reduction_x": snap["kv_bytes_reduction_x"],
+                    "sessions_per_pool_x": snap["sessions_per_pool_x"]}
+
+        once()              # warmup compiles this arm's buckets
+        return once()
+
+    def teacher_forced_accuracy(r_ref, r_q, n_prompts=2, steps=24) -> dict:
+        """Replay the fp32 arm's greedy stream through the quantized
+        runner and compare per-step logits (the accuracy gate's raw
+        material, workload-matched)."""
+        dl, overlap, agree, total = [], [], 0, 0
+        for p in prompts[:n_prompts]:
+            pools, tbls = [], []
+            for r in (r_ref, r_q):
+                pool = KVCachePool(r.num_layers, pages_per_seq + 1,
+                                   block_size, r.n_kv_heads, r.head_dim,
+                                   r.dtype, kv_dtype=r.kv_dtype)
+                pages = pool.allocator.alloc(pages_per_seq)
+                tbls.append(pool.pad_table(pages, pages_per_seq))
+                pools.append(pool.pools)
+            l_ref, pools[0] = r_ref.prefill(p, tbls[0], pools[0])
+            l_q, pools[1] = r_q.prefill(p, tbls[1], pools[1])
+            toks = list(p)
+            for _ in range(steps):
+                a, b = np.asarray(l_ref), np.asarray(l_q)
+                dl.append(np.abs(a - b).mean())
+                top_ref = set(np.argsort(a)[-5:].tolist())
+                top_q = set(np.argsort(b)[-5:].tolist())
+                overlap.append(len(top_ref & top_q) / 5.0)
+                agree += int(np.argmax(a) == np.argmax(b))
+                total += 1
+                tok = int(np.argmax(a))          # teacher: the fp32 path
+                pos = np.asarray([len(toks)], np.int32)
+                toks.append(tok)
+                l_ref, pools[0] = r_ref.decode(
+                    np.asarray([tok], np.int32),
+                    np.asarray(tbls[0], np.int32)[None], pos, pools[0])
+                l_q, pools[1] = r_q.decode(
+                    np.asarray([tok], np.int32),
+                    np.asarray(tbls[1], np.int32)[None], pos, pools[1])
+                l_ref, l_q = l_ref[0], l_q[0]
+        return {"mean_abs_dlogit": float(np.mean(dl)),
+                "top5_overlap": float(np.mean(overlap)),
+                "greedy_agreement": agree / total if total else 0.0}
+
+    r_fp32 = make_runner("fp32", "fp32")
+    r_int8 = make_runner("int8", "fp32")
+    r_int8w = make_runner("int8", "int8")
+    arms = [run_arm(r_fp32), run_arm(r_int8), run_arm(r_int8w)]
+    read_fp32 = arms[0]["attn_kv_gb_read"]
+    read_int8 = arms[1]["attn_kv_gb_read"]
+    _write_child({
+        "backend": backend, "layers": layers, "hidden": hidden,
+        "max_batch": max_batch, "requests": requests, "prompt": prompt,
+        "gen": gen, "workload": "kv_quant", "arms": arms,
+        # THE acceptance number: measured bytes the attention path read,
+        # scale bytes counted on the int8 side
+        "attn_kv_bytes_reduction_x": (read_fp32 / read_int8
+                                      if read_int8 else 0.0),
+        "accuracy_int8_kv": teacher_forced_accuracy(r_fp32, r_int8),
+        "accuracy_int8_kv_w": teacher_forced_accuracy(r_fp32, r_int8w),
+    })
+
+
 def child_serving_spec(layers: int, hidden: int, max_batch: int,
                        requests: int, prompt: int, gen: int, vocab: int):
     """Speculative-decoding serving rung (ISSUE 5): a repetition-heavy
@@ -1246,6 +1371,40 @@ def main():
                 f"attn bytes reduction {pt['attn_bytes_reduction_x']:.1f}x "
                 f"vs gather")
 
+    # quantized-KV rung (ISSUE 9): the long-context chunked workload in
+    # fp32-vs-int8 arms; commits the MEASURED attn_kv_bytes_read
+    # reduction (int8 page bytes + scale bytes counted), tokens/s per
+    # arm, and the teacher-forced accuracy record vs the fp32 oracle
+    if on_tpu and remaining() > 120:
+        r = run_child("serving:6:512:4:6:448:64:32768:kv_quant",
+                      min(900, remaining()))
+        if r is not None:
+            acc = r["accuracy_int8_kv"]
+            int8_arm = r["arms"][1]
+            line = {"metric": "serving_kv_quant_bytes_reduction_x",
+                    "value": round(r["attn_kv_bytes_reduction_x"], 2),
+                    "unit": "x", "vs_baseline": 0.0,
+                    "tokens_per_sec_fp32":
+                        round(r["arms"][0]["tokens_per_sec"], 1),
+                    "tokens_per_sec_int8":
+                        round(int8_arm["tokens_per_sec"], 1),
+                    "tokens_per_sec_int8_w":
+                        round(r["arms"][2]["tokens_per_sec"], 1),
+                    "kv_bytes_reduction_x":
+                        round(int8_arm["kv_bytes_reduction_x"], 2),
+                    "sessions_per_pool_x":
+                        round(int8_arm["sessions_per_pool_x"], 2),
+                    "mean_abs_dlogit": round(acc["mean_abs_dlogit"], 6),
+                    "top5_overlap": round(acc["top5_overlap"], 4),
+                    "greedy_agreement": round(acc["greedy_agreement"], 4),
+                    "backend": r["backend"]}
+            emit(line)
+            _cache_result(line)
+            log(f"kv-quant rung: attn bytes reduction "
+                f"{r['attn_kv_bytes_reduction_x']:.2f}x, top-5 overlap "
+                f"{acc['top5_overlap']:.3f}, greedy agreement "
+                f"{acc['greedy_agreement']*100:.1f}%")
+
     # speculative-decoding rung (ISSUE 5): repetition-heavy workload run
     # with and without n-gram speculation; commits tokens/s, acceptance
     # rate, steps/token, and the engine-step reduction factor
@@ -1419,6 +1578,8 @@ def _child_main(mode: str) -> None:
         parts = mode.split(":")[1:]
         if parts and parts[-1] == "long_context":
             child_serving_long(*[int(x) for x in parts[:-1]])
+        elif parts and parts[-1] == "kv_quant":
+            child_serving_kvq(*[int(x) for x in parts[:-1]])
         elif parts and parts[-1] == "speculative":
             child_serving_spec(*[int(x) for x in parts[:-1]])
         elif parts and parts[-1] == "multistep":

@@ -64,8 +64,16 @@ NEG_INF = -1e30
 
 def _ragged_kernel(table_ref, start_ref, qlen_ref, q_ref, k_ref, v_ref,
                    o_ref, m_ref, l_ref, acc_ref, *, page_size: int,
-                   n_rep: int, scale: float):
-    """Grid (b, page): fold one KV page into sequence b's span rows."""
+                   n_rep: int, scale: float,
+                   kscale_ref=None, vscale_ref=None):
+    """Grid (b, page): fold one KV page into sequence b's span rows.
+
+    With kscale_ref/vscale_ref (ISSUE 9: int8 pools), the K/V block is
+    int8 codes and the per-page-per-head scales ride the SMEM scalar
+    prefetch ([num_pages, n_kv] fp32, indexed by the SAME clamped page
+    id the BlockSpec index_map DMA'd): the dequantize happens right
+    here inside the page walk, and the online softmax stays fp32 — the
+    page walk reads half the bytes, the math above it is unchanged."""
     b = pl.program_id(0)
     j = pl.program_id(1)
     n_pages = pl.num_programs(1)
@@ -90,6 +98,15 @@ def _ragged_kernel(table_ref, start_ref, qlen_ref, q_ref, k_ref, v_ref,
         q = q_ref[0].astype(jnp.float32)           # [n_kv, G, d]
         k = k_ref[0].astype(jnp.float32)           # [ps, n_kv, d]
         v = v_ref[0].astype(jnp.float32)
+        if kscale_ref is not None:
+            # same clamp as the index_map: the page id whose block is
+            # VMEM-resident right now; its scale row dequantizes it
+            jc = jnp.minimum(j, jnp.maximum(last_pos, 0) // page_size)
+            pid = table_ref[b, jc]
+            ks = jnp.stack([kscale_ref[pid, h] for h in range(n_kv)])
+            vs = jnp.stack([vscale_ref[pid, h] for h in range(n_kv)])
+            k = k * ks[None, :, None]
+            v = v * vs[None, :, None]
         # scores[n_kv, G, ps]: batch the KV-head dim, contract d — each
         # KV head serves its n_rep grouped query rows with no replication
         s = jax.lax.dot_general(
@@ -123,7 +140,8 @@ def _ragged_kernel(table_ref, start_ref, qlen_ref, q_ref, k_ref, v_ref,
 
 
 def ragged_paged_attention(q, k_pool, v_pool, block_table, start_pos, q_len,
-                           scale=None, interpret: bool | None = None):
+                           scale=None, interpret: bool | None = None,
+                           k_scale=None, v_scale=None):
     """Causal attention for a ragged batch of query spans over paged KV.
 
     q: [B, T, n_q_heads, d] — T is the PADDED span length (power-of-2
@@ -133,6 +151,13 @@ def ragged_paged_attention(q, k_pool, v_pool, block_table, start_pos, q_len,
     span; 0 = dead slot). Query row t of sequence b attends keys at
     positions <= start_pos[b] + t. Rows past q_len output exact zeros.
     Returns [B, T, n_q_heads, d].
+
+    Quantized pools (ISSUE 9): pass int8 code pools plus
+    k_scale/v_scale [num_pages, n_kv_heads] fp32 (one scale per page
+    per kv-head). The scales ride the SMEM scalar prefetch next to the
+    block tables and each page tile is dequantized inside the page walk
+    — HBM traffic is the int8 bytes + the scale rows, while the online
+    softmax stays fp32.
     """
     B, T, n_q, d = q.shape
     page_size = k_pool.shape[1]
@@ -140,6 +165,9 @@ def ragged_paged_attention(q, k_pool, v_pool, block_table, start_pos, q_len,
     if n_q % n_kv:
         raise ValueError(f"n_q_heads={n_q} not a multiple of "
                          f"n_kv_heads={n_kv}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale or neither")
+    quantized = k_scale is not None
     n_rep = n_q // n_kv
     n_pages = block_table.shape[1]
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
@@ -155,7 +183,7 @@ def ragged_paged_attention(q, k_pool, v_pool, block_table, start_pos, q_len,
     qg = q.reshape(B, T, n_kv, n_rep, d).transpose(0, 2, 3, 1, 4)
     qg = qg.reshape(B, n_kv, G, d)
 
-    def kv_map(b, j, t, s, ql):
+    def kv_map(b, j, t, s, ql, *_):
         # clamp dead pages (past the span's last visible key) to the last
         # live page: the pipeline sees an unchanged block index and
         # elides the DMA (dead slots clamp to the table's first entry)
@@ -164,7 +192,9 @@ def ragged_paged_attention(q, k_pool, v_pool, block_table, start_pos, q_len,
         return (t[b, jc], 0, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        # quantized pools prefetch the scale rows alongside the tables:
+        # scalars 3/4 are k_scale/v_scale, read per clamped page id
+        num_scalar_prefetch=5 if quantized else 3,
         grid=(B, n_pages),
         in_specs=[
             pl.BlockSpec((1, n_kv, G, d), lambda b, j, *_: (b, 0, 0, 0)),
@@ -179,13 +209,27 @@ def ragged_paged_attention(q, k_pool, v_pool, block_table, start_pos, q_len,
             pltpu.VMEM((n_kv, G, d), jnp.float32),
         ],
     )
+    if quantized:
+        def kernel(table_ref, start_ref, qlen_ref, ks_ref, vs_ref, *rest):
+            _ragged_kernel(table_ref, start_ref, qlen_ref, *rest,
+                           page_size=page_size, n_rep=n_rep, scale=scale,
+                           kscale_ref=ks_ref, vscale_ref=vs_ref)
+
+        scalars = (block_table.astype(jnp.int32), start_arr, qlen_arr,
+                   jnp.asarray(k_scale, jnp.float32),
+                   jnp.asarray(v_scale, jnp.float32))
+    else:
+        kernel = functools.partial(_ragged_kernel, page_size=page_size,
+                                   n_rep=n_rep, scale=scale)
+        scalars = (block_table.astype(jnp.int32), start_arr, qlen_arr)
     out = pl.pallas_call(
-        functools.partial(_ragged_kernel, page_size=page_size, n_rep=n_rep,
-                          scale=scale),
+        kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, n_kv, G, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, n_kv, G, d),
+                                       jnp.float32 if quantized else q.dtype),
         interpret=interpret,
-    )(block_table.astype(jnp.int32), start_arr, qlen_arr, qg, k_pool, v_pool)
+    )(*scalars, qg, k_pool, v_pool)
+    out = out.astype(q.dtype)
     out = out.reshape(B, n_kv, n_rep, T, d).transpose(0, 3, 1, 2, 4)
     return out.reshape(B, T, n_q, d)
 
@@ -198,11 +242,16 @@ def ragged_attention_ok(head_dim: int, n_q_heads: int,
 
 
 def ragged_reference(q, k_pool, v_pool, block_table, start_pos, q_len,
-                     scale=None):
+                     scale=None, k_scale=None, v_scale=None):
     """Gather + dense-mask oracle with the kernel's exact output contract
     (padded rows and dead slots produce exact zeros). O(B * pages_per_seq
     * page_size) HBM — the path the kernel exists to retire; kept as the
-    bit-level comparison target for tests and the CPU reference."""
+    bit-level comparison target for tests and the CPU reference.
+
+    With k_scale/v_scale (int8 pools, ISSUE 9) the gathered codes are
+    dequantized with the SAME per-page-per-head scales the kernel reads
+    — kernel-vs-reference comparisons stay exact in the int8 domain
+    (both dequantize identical codes with identical scales)."""
     B, T, n_q, d = q.shape
     page_size = k_pool.shape[1]
     n_kv = k_pool.shape[2]
@@ -210,6 +259,11 @@ def ragged_reference(q, k_pool, v_pool, block_table, start_pos, q_len,
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     kg = k_pool[block_table]             # [B, P, ps, n_kv, d]
     vg = v_pool[block_table]
+    if k_scale is not None:
+        ks = jnp.asarray(k_scale, jnp.float32)[block_table]  # [B, P, n_kv]
+        vs = jnp.asarray(v_scale, jnp.float32)[block_table]
+        kg = kg.astype(jnp.float32) * ks[:, :, None, :, None]
+        vg = vg.astype(jnp.float32) * vs[:, :, None, :, None]
     L = kg.shape[1] * page_size
     kg = kg.reshape(B, L, n_kv, d)
     vg = vg.reshape(B, L, n_kv, d)

@@ -68,7 +68,24 @@ def _weight_quantize(w, algo="weight_only_int8", group_size=-1):
     (qw int8, scale fp [out]). int4 packs two values per byte along the
     in-dim, so qw is [in//2, out] for int4 (not interchangeable with
     reference CUDA tile-permuted layouts, but the same density; layout is
-    documented on _pack_int4)."""
+    documented on _pack_int4).
+
+    STRICTLY 2-D: the per-channel scale is computed over axis 0 (the
+    in-dim). A fused-QKV weight stored (3, num_heads, head_dim) — the
+    layout GPT's attention block reshapes into — would silently get its
+    scales computed over the q/k/v axis instead of the in-dim, so
+    non-2-D inputs are a loud error rather than a wrong answer: reshape
+    to [in, 3 * num_heads * head_dim] first (per fused output column,
+    which is what the serving runner quantizes)."""
+    if w.ndim != 2:
+        raise ValueError(
+            f"weight_quantize needs a 2-D [in, out] matrix, got shape "
+            f"{tuple(w.shape)}: per-output-channel scales reduce over "
+            "axis 0 (the in-dim). A fused-QKV weight in the (3, "
+            "num_heads, head_dim) layout must be reshaped/flattened to "
+            "[in, 3*num_heads*head_dim] before quantizing — quantizing "
+            "the raw 3-D layout would silently compute scales over the "
+            "qkv axis and mis-scale every channel")
     bits = 4 if "int4" in algo else 8
     qmax = 2.0 ** (bits - 1) - 1
     if group_size and group_size > 0:

@@ -69,6 +69,18 @@ allocator, scheduler, block tables, and PrefixCache stay host-side and
 replicated. Token streams are identical to the single-device engine;
 per-shard pool and attention bytes drop to 1/tp.
 
+Quantized serving (ISSUE 9): `kv_dtype="int8"` on the runner births
+int8 K/V page pools plus per-page-per-kv-head scale pools (one layer
+tuple `(k, v, k_scale, v_scale)`); every write path quantizes at
+append time inside jit and the ragged kernel dequantizes inside its
+page walk with the fp32 online softmax kept. `weight_dtype="int8"`
+runs the matmuls weight-only int8 (per-output-channel scales, dequant
+in the epilogue). The fp32 default stays bit-exact vs naive_generate;
+the quantized path is accuracy-gated (top-5 overlap >= 0.99, greedy
+agreement >= 99% vs the fp32 oracle — tests/test_serving_quant.py)
+and the byte accounting counts code + scale bytes honestly
+(`kv_bytes_reduction_x` ~3.9x at block 16 / head_dim 64).
+
 The serving TIER (ISSUE 8): `router.py` (ServingRouter — N engine
 replicas, thread-per-engine, prefix-affinity routing keyed by the
 PrefixCache content-hash chain with least-loaded fallback, tier
@@ -96,7 +108,7 @@ from paddle_tpu.serving.engine import (  # noqa: F401
 )
 from paddle_tpu.serving.kv_cache import (  # noqa: F401
     BlockAllocator, KVCachePool, PrefixCache, SCRATCH_PAGE, SequenceKV,
-    page_content_hash,
+    page_content_hash, quantized_page_write,
 )
 from paddle_tpu.serving.metrics import (  # noqa: F401
     Counter, EngineMetrics, Gauge, Histogram, aggregate_snapshots,
@@ -136,5 +148,6 @@ __all__ = [
     "TokenizerAdapter", "audit_engine", "audit_router",
     "aggregate_snapshots", "bucket_len", "complete_utf8_prefix",
     "create_engine", "greedy_grid", "naive_generate", "page_content_hash",
-    "replica_submeshes", "runner_for", "sample_token", "serving_mesh",
+    "quantized_page_write", "replica_submeshes", "runner_for",
+    "sample_token", "serving_mesh",
 ]

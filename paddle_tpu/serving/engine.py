@@ -292,11 +292,16 @@ class ServingEngine:
         # over the model axis — everything host-side (allocator, block
         # tables, scheduler, PrefixCache) stays replicated and mesh-blind
         self.mesh = getattr(runner, "mesh", None)
+        # quantized serving (ISSUE 9) is a RUNNER property like the mesh:
+        # a kv_dtype="int8" runner quantizes at append time, so the
+        # engine births int8 code pools + the parallel scale pools
+        self.kv_dtype = getattr(runner, "kv_dtype", "fp32")
         self.pool = KVCachePool(runner.num_layers, num_blocks, block_size,
                                 runner.n_kv_heads, runner.head_dim,
                                 runner.dtype, mesh=self.mesh,
                                 model_axis=getattr(runner, "model_axis",
-                                                   "model"))
+                                                   "model"),
+                                kv_dtype=self.kv_dtype)
         self.enable_prefix_cache = bool(enable_prefix_cache)
         if self.enable_prefix_cache:
             self.pool.enable_prefix_cache()
@@ -338,6 +343,13 @@ class ServingEngine:
                                    "") not in ("", "0")
         self.audit = audit
         self.metrics = metrics or EngineMetrics()
+        # static per-pool ratios (ISSUE 9 satellite): the measured page-
+        # byte reduction (scale bytes counted) and the matching sessions-
+        # per-fixed-HBM factor — 1.0 on fp32 pools
+        self.metrics.kv_bytes_reduction_x.set(
+            self.pool.kv_bytes_reduction_x())
+        self.metrics.sessions_per_pool_x.set(
+            self.pool.kv_bytes_reduction_x())
         self._requests: Dict[str, Request] = {}
         self._outputs: Dict[str, RequestOutput] = {}
 
@@ -1257,6 +1269,14 @@ class ServingEngine:
                 "num_speculative_tokens": self.num_speculative_tokens,
                 "spec_max_ngram": self.spec_max_ngram,
                 "spec_min_ngram": self.spec_min_ngram,
+                # quantization knobs ride along for the record (ISSUE 9);
+                # restore() follows the NEW runner's dtypes — recompute-
+                # on-resume rebuilds KV from scratch, so it is
+                # quantization-agnostic (token streams only stay
+                # identical when the dtypes match, logged otherwise)
+                "kv_dtype": self.kv_dtype,
+                "weight_dtype": getattr(self.runner, "weight_dtype",
+                                        "fp32"),
                 # mesh shape rides along for the record (ISSUE 7); the
                 # restored engine follows the NEW runner's mesh — the
                 # recompute-on-resume path is sharding-agnostic, so a
@@ -1325,6 +1345,14 @@ class ServingEngine:
             # exact) but worth a breadcrumb: capacity/throughput differ
             logger.info("restore: snapshot mesh %s -> runner mesh %s",
                         snap_mesh, run_mesh)
+        snap_q = (cfg.get("kv_dtype", "fp32"),
+                  cfg.get("weight_dtype", "fp32"))
+        run_q = (eng.kv_dtype, getattr(runner, "weight_dtype", "fp32"))
+        if snap_q != run_q:
+            # also legal (restore recomputes KV from tokens), but the
+            # continued stream follows the NEW runner's quantization
+            logger.info("restore: snapshot kv/weight dtype %s -> runner "
+                        "%s", snap_q, run_q)
         return eng
 
 
@@ -1342,7 +1370,8 @@ def naive_generate(runner: PagedModelRunner, prompt_tokens: Sequence[int],
     max_pages = -(-max_model_len // runner.block_size)
     pool = KVCachePool(runner.num_layers, max_pages + 1,
                        runner.block_size, runner.n_kv_heads,
-                       runner.head_dim, runner.dtype)
+                       runner.head_dim, runner.dtype,
+                       kv_dtype=getattr(runner, "kv_dtype", "fp32"))
     pages = pool.allocator.alloc(max_pages)
     table = pool.pad_table(pages, max_pages)
     tokens = list(map(int, prompt_tokens))
@@ -1367,15 +1396,22 @@ def create_engine(model, *, num_blocks: int = 128,
                   max_model_len: Optional[int] = None,
                   attn_impl: str = "auto", mesh=None,
                   data_axis: str = "data", model_axis: str = "model",
+                  kv_dtype: str = "fp32", weight_dtype: str = "fp32",
                   **engine_kw) -> ServingEngine:
     """Build a ServingEngine for a supported decoder Layer (Llama, GPT).
 
     Pass a `(data, model)` jax mesh (parallel.mesh.serving_mesh) to serve
     tensor-parallel (ISSUE 7): weights and the paged K/V pools shard over
     the model axis; token streams stay identical to the single-device
-    engine. n_kv_heads must divide by the model-axis degree."""
+    engine. n_kv_heads must divide by the model-axis degree.
+
+    `kv_dtype="int8"` / `weight_dtype="int8"` (ISSUE 9) serve with
+    quantized K/V pools (per-page-per-head scales, dequant inside the
+    ragged kernel's page walk) and/or weight-only int8 linears —
+    accuracy-gated vs the fp32 oracle, ~half the attention HBM bytes."""
     runner = runner_for(model, block_size=block_size,
-                        max_model_len=max_model_len, attn_impl=attn_impl)
+                        max_model_len=max_model_len, attn_impl=attn_impl,
+                        kv_dtype=kv_dtype, weight_dtype=weight_dtype)
     if mesh is not None:
         runner.shard(mesh, data_axis=data_axis, model_axis=model_axis)
     return ServingEngine(runner, num_blocks=num_blocks,

@@ -21,6 +21,22 @@ cached page-aligned prefix of its context straight into its block table
 copy-on-write forked first, so a shared page is never mutated in place.
 Cached pages the cache alone still references (refcount 1) are evictable
 in LRU order when the free list runs dry.
+
+ISSUE 9 adds quantized pools: ``KVCachePool(kv_dtype="int8")`` stores
+K/V pages as int8 codes plus a parallel SCALE pool — one fp32 scale per
+page per kv-head, the exact granularity the ragged kernel dequantizes
+at inside its page walk. Each layer entry becomes a 4-tuple
+``(k_codes, v_codes, k_scale, v_scale)`` instead of the fp32 ``(k, v)``
+pair; everything host-side treats pages as opaque blocks, so the
+allocator, block tables, PrefixCache, COW forking (`copy_page` copies
+the scale row with the codes), truncate/rollback, and snapshot/restore
+are all quantization-blind. `quantized_page_write` is the jit-pure
+append: incoming K/V rows grow the per-page running-max scale (a write
+landing on slot 0 RESTARTS the page's scale — page lifecycle begins
+there), already-resident codes are requantized to the grown scale, and
+the new rows are quantized at it — so one (page, head) scale always
+dequantizes every live code in the page. Default stays "fp32": those
+pools are byte-identical to the pre-ISSUE-9 layout.
 """
 
 from __future__ import annotations
@@ -31,6 +47,57 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 
 SCRATCH_PAGE = 0
+
+# int8 symmetric quantization range of the quantized KV pools (ISSUE 9)
+KV_QMAX = 127.0
+
+KV_DTYPES = ("fp32", "int8")
+
+
+def quantized_page_write(codes, scales, write_page, write_off, x):
+    """Append fp K/V rows into an int8 page pool, jit-pure (ISSUE 9).
+
+    codes: [num_blocks, page_size, n_kv, d] int8; scales: [num_blocks,
+    n_kv] fp32 (one scale per page per kv-head); write_page/write_off:
+    [B, T] int32; x: [B, T, n_kv, d] float. Returns (codes, scales).
+
+    Scale lifecycle: a write that lands on slot 0 of a page RESTARTS
+    that page's scale (page occupancy begins there — a page recycled
+    from the free list must not inherit its previous tenant's range),
+    otherwise the scale is the running abs-max over everything written
+    to the page so far. When a write grows a page's scale, the codes
+    already resident in that page are requantized to the new scale
+    (round(code * old/new)) so ONE (page, head) scale dequantizes every
+    live code; pages whose scale is unchanged keep their codes
+    bit-identical (ratio is exactly 1.0). Deterministic and idempotent:
+    re-running the same write on the same pools produces the same pools,
+    which is what makes engine step retries exact on the int8 path."""
+    P, _, H, _ = codes.shape
+    pages = write_page.reshape(-1)                          # [N]
+    offs = write_off.reshape(-1)                            # [N]
+    amax = jnp.max(jnp.abs(x), axis=-1)                     # [B, T, H]
+    amax = amax.reshape(-1, H).astype(jnp.float32)          # [N, H]
+    # slot-0 writes restart the page's scale (int32 scatter-max: bool
+    # scatter-max is not universally supported)
+    starts = jnp.zeros((P,), jnp.int32).at[pages].max(
+        (offs == 0).astype(jnp.int32))
+    base = jnp.where(starts[:, None] > 0, 0.0, scales)      # [P, H]
+    contrib = jnp.zeros_like(scales).at[pages].max(amax / KV_QMAX)
+    new_scales = jnp.maximum(base, contrib)
+    # requantize the touched pages' resident codes to the grown scale
+    # (ratio == 1 exactly where nothing grew -> codes unchanged; a
+    # restarted page's stale codes go to 0 and are rewritten/dead)
+    ratio = jnp.where(new_scales > 0.0,
+                      base / jnp.maximum(new_scales, 1e-30), 1.0)
+    resc = jnp.round(codes[pages].astype(jnp.float32)
+                     * ratio[pages][:, None, :, None])
+    codes = codes.at[pages].set(resc.astype(jnp.int8))
+    # quantize the incoming rows at the new scale and write them through
+    s = new_scales[write_page]                              # [B, T, H]
+    q = jnp.round(x.astype(jnp.float32)
+                  / jnp.maximum(s, 1e-30)[..., None])
+    q = jnp.clip(q, -KV_QMAX, KV_QMAX).astype(jnp.int8)
+    return codes.at[write_page, write_off].set(q), new_scales
 
 # seed of the per-page content hash chain (any fixed int; the chain makes
 # page i's key depend on every token in pages 0..i, so equal hash ==
@@ -291,19 +358,25 @@ class KVCachePool:
 
     def __init__(self, num_layers: int, num_blocks: int, block_size: int,
                  n_kv_heads: int, head_dim: int, dtype=jnp.float32,
-                 mesh=None, model_axis: str = "model"):
+                 mesh=None, model_axis: str = "model",
+                 kv_dtype: str = "fp32"):
         self.num_layers = num_layers
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.n_kv_heads = n_kv_heads
         self.head_dim = head_dim
         self.dtype = dtype
+        if kv_dtype not in KV_DTYPES:
+            raise ValueError(f"kv_dtype={kv_dtype!r}; expected one of "
+                             f"{KV_DTYPES}")
+        self.kv_dtype = kv_dtype
         self.mesh = mesh
         self.model_axis = model_axis
         self.tp_size = 1
         self.allocator = BlockAllocator(num_blocks)
         self.prefix_cache: Optional[PrefixCache] = None
         shape = (num_blocks, block_size, n_kv_heads, head_dim)
+        sshape = (num_blocks, n_kv_heads)     # one scale per page per head
         if mesh is not None:
             import jax
             from jax.sharding import NamedSharding, PartitionSpec
@@ -316,10 +389,28 @@ class KVCachePool:
                     "shard in whole kv-heads (GQA rule)")
             sharding = NamedSharding(
                 mesh, PartitionSpec(None, None, model_axis, None))
-            self.pools = [
-                (jax.device_put(jnp.zeros(shape, dtype), sharding),
-                 jax.device_put(jnp.zeros(shape, dtype), sharding))
-                for _ in range(num_layers)]
+            if kv_dtype == "int8":
+                # the scale pool shares the pool's page geometry and
+                # shards along the SAME kv-head axis: each model shard
+                # dequantizes its own head slice with its own scales
+                s_shard = NamedSharding(mesh, PartitionSpec(None, model_axis))
+                self.pools = [
+                    (jax.device_put(jnp.zeros(shape, jnp.int8), sharding),
+                     jax.device_put(jnp.zeros(shape, jnp.int8), sharding),
+                     jax.device_put(jnp.zeros(sshape, jnp.float32), s_shard),
+                     jax.device_put(jnp.zeros(sshape, jnp.float32), s_shard))
+                    for _ in range(num_layers)]
+            else:
+                self.pools = [
+                    (jax.device_put(jnp.zeros(shape, dtype), sharding),
+                     jax.device_put(jnp.zeros(shape, dtype), sharding))
+                    for _ in range(num_layers)]
+        elif kv_dtype == "int8":
+            self.pools = [(jnp.zeros(shape, jnp.int8),
+                           jnp.zeros(shape, jnp.int8),
+                           jnp.zeros(sshape, jnp.float32),
+                           jnp.zeros(sshape, jnp.float32))
+                          for _ in range(num_layers)]
         else:
             self.pools = [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
                           for _ in range(num_layers)]
@@ -344,26 +435,54 @@ class KVCachePool:
         return list(pages) + [SCRATCH_PAGE] * (max_pages - len(pages))
 
     def copy_page(self, src: int, dst: int) -> None:
-        """Device-side page copy across every layer's (k, v) pool — the
-        data move behind a copy-on-write fork."""
-        self.pools = [(k.at[dst].set(k[src]), v.at[dst].set(v[src]))
-                      for k, v in self.pools]
+        """Device-side page copy across every layer's pools — the data
+        move behind a copy-on-write fork. Pages are copied as OPAQUE
+        blocks: on an int8 pool the layer tuples carry the scale pools
+        too ([num_blocks, n_kv] — page-indexed like the code pools), so
+        a fork carries its source's quantization state verbatim."""
+        self.pools = [tuple(a.at[dst].set(a[src]) for a in layer)
+                      for layer in self.pools]
 
     def utilization(self) -> float:
         a = self.allocator
         return 1.0 - a.num_free / a.num_usable
 
+    def page_bytes(self) -> int:
+        """HBM bytes ONE page actually occupies across all layers and
+        both (k, v) pools — quantized code bytes PLUS scale bytes on an
+        int8 pool (ISSUE 9: the byte accounting is honest, not derived
+        from the logical dtype's itemsize)."""
+        per_kv = self.block_size * self.n_kv_heads * self.head_dim
+        if self.kv_dtype == "int8":
+            return 2 * self.num_layers * (per_kv + self.n_kv_heads * 4)
+        itemsize = jnp.zeros((), self.dtype).dtype.itemsize
+        return 2 * self.num_layers * per_kv * itemsize
+
+    def unquantized_page_bytes(self) -> int:
+        """What the same page would cost stored at the pool's logical
+        dtype — the denominator of the quantization win."""
+        itemsize = jnp.zeros((), self.dtype).dtype.itemsize
+        return (2 * self.num_layers * self.block_size * self.n_kv_heads
+                * self.head_dim * itemsize)
+
+    def kv_bytes_reduction_x(self) -> float:
+        """Per-page byte reduction vs the unquantized pool, scale bytes
+        counted (1.0 on fp32 pools). Because page count is fixed, this
+        is also the factor by which a fixed HBM budget holds more pages
+        — i.e. more concurrent sessions per pool."""
+        return self.unquantized_page_bytes() / self.page_bytes()
+
     def memory_bytes(self) -> int:
         """Total logical pool bytes across the whole mesh (the single-
-        device number — sharding never changes it)."""
-        itemsize = jnp.zeros((), self.dtype).dtype.itemsize
-        return (2 * self.num_layers * self.num_blocks * self.block_size
-                * self.n_kv_heads * self.head_dim * itemsize)
+        device number — sharding never changes it). Counts what the
+        pools actually store: int8 code bytes + scale bytes on a
+        quantized pool."""
+        return self.num_blocks * self.page_bytes()
 
     def per_shard_memory_bytes(self) -> int:
         """Pool bytes ONE model shard holds: total / tp (each shard
-        stores its n_kv/tp kv-head slice of every page) — the ISSUE 7
-        capacity acceptance number."""
+        stores its n_kv/tp kv-head slice of every page AND of every
+        scale row) — the ISSUE 7 capacity acceptance number."""
         return self.memory_bytes() // self.tp_size
 
 

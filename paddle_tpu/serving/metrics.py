@@ -187,6 +187,14 @@ class EngineMetrics:
         # CPU-countable form of the ragged kernel's bandwidth win
         self.attn_kv_bytes_read = Gauge("attn_kv_bytes_read")
         self.attn_kv_bytes_gather = Gauge("attn_kv_bytes_gather")
+        # quantized-KV accounting (ISSUE 9): per-page byte reduction of
+        # the pool vs storing at the logical dtype (scale bytes counted;
+        # 1.0 on fp32 pools), and the matching concurrent-sessions-per-
+        # fixed-HBM factor — page count per byte budget scales by the
+        # same ratio. Set from KVCachePool geometry, i.e. MEASURED from
+        # what the pools actually store, never assumed
+        self.kv_bytes_reduction_x = Gauge("kv_bytes_reduction_x")
+        self.sessions_per_pool_x = Gauge("sessions_per_pool_x")
         self.pool_used_pages = Gauge("pool_used_pages")
         self.pool_utilization = Gauge("pool_utilization")
         self.batch_occupancy = Histogram("batch_occupancy")
@@ -248,6 +256,8 @@ class EngineMetrics:
             "prefix_cached_pages": self.prefix_cached_pages.value,
             "attn_kv_bytes_read": self.attn_kv_bytes_read.value,
             "attn_kv_bytes_gather": self.attn_kv_bytes_gather.value,
+            "kv_bytes_reduction_x": self.kv_bytes_reduction_x.value,
+            "sessions_per_pool_x": self.sessions_per_pool_x.value,
             "spec_proposed_tokens": self.spec_proposed_tokens.value,
             "spec_accepted_tokens": self.spec_accepted_tokens.value,
             "spec_rollback_pages": self.spec_rollback_pages.value,

@@ -49,6 +49,21 @@ case, so both share one jit cache keyed by the chunk-length bucket, and
 jit cache logs every compile and can be capped via the
 PADDLE_TPU_MAX_JIT_CACHE env var (LRU eviction; 0/unset = unbounded).
 
+Quantized serving (ISSUE 9): `kv_dtype="int8"` stores the paged K/V
+pools as int8 codes plus per-page-per-head fp32 scale pools — every
+write path (prefill, chunks, decode, the decode_multi scan, ragged/
+verify) quantizes at append time inside jit via
+`kv_cache.quantized_page_write`, and the attend paths dequantize: the
+ragged kernel inside its page walk (scales ride the SMEM scalar
+prefetch), the gather reference after its gather. `weight_dtype="int8"`
+converts the 2-D matmul weights to int8 codes + per-output-channel
+scales at construction; `_mm` dequantizes in the matmul epilogue. Both
+default "fp32" — the default runner is bit-identical to pre-ISSUE-9 —
+and the quantized paths are accuracy-gated (bounded logit error,
+top-k overlap) rather than exactness-pinned. The instrumented byte
+counters count the quantized page bytes PLUS scale bytes, so the
+fp32-vs-int8 bandwidth claim is measured, not assumed.
+
 `shard(mesh)` (ISSUE 7 tentpole) turns any runner tensor-parallel over
 a `(data, model)` jax mesh: weights get the Megatron column/row
 PartitionSpecs (`parallel.compat.SpecLayout` — column-wise QKV/up/gate,
@@ -84,7 +99,15 @@ from paddle_tpu.models.generation import (
     _block_params, _layer_norm, _mlp, masked_cache_attention, paged_gather,
 )
 from paddle_tpu.models.llama import _rope_tables
-from paddle_tpu.serving.kv_cache import SCRATCH_PAGE
+from paddle_tpu.serving.kv_cache import (
+    KV_DTYPES, SCRATCH_PAGE, quantized_page_write,
+)
+
+# params-dict key suffix of a weight-only-int8 weight's per-output-channel
+# scale vector (ISSUE 9): "layers.0.self_attn.q_proj.weight::scale"
+SCALE_SUFFIX = "::scale"
+
+WEIGHT_DTYPES = ("fp32", "int8")
 
 
 def bucket_len(t: int, minimum: int = 8) -> int:
@@ -102,23 +125,27 @@ def bucket_len(t: int, minimum: int = 8) -> int:
 _bucket_len = bucket_len          # pre-rename spelling (internal callers)
 
 
-def _shard_mapped_kernel(kernel, shard_ctx, q_spec):
+def _shard_mapped_kernel(kernel, shard_ctx, q_spec, rest_specs=()):
     """Wrap a paged-attention Pallas kernel so it runs PER MODEL SHARD
     (ISSUE 7): q and the K/V pools split on their (kv-)head axis, the
     block tables and positions ride replicated — every shard walks the
     SAME page ids over its own kv-head slice, so the kernel body is
     unchanged (GQA's n_rep is shard-invariant because n_heads and
     n_kv_heads divide by tp together). Pallas calls are opaque to GSPMD,
-    hence shard_map instead of a sharding annotation."""
+    hence shard_map instead of a sharding annotation. `rest_specs` give
+    explicit specs for leading trailing args (ISSUE 9: the per-page
+    scale pools shard on their kv-head axis); unlisted trailing args
+    ride replicated."""
     from paddle_tpu.parallel.pipeline import compat_shard_map
 
     mesh, model_axis = shard_ctx
     pool_spec = P(None, None, model_axis, None)
 
     def run(q, k_pool, v_pool, tables, pos_q, *rest):
+        extra = tuple(rest_specs) + (P(),) * (len(rest) - len(rest_specs))
         return compat_shard_map(
             kernel, mesh=mesh,
-            in_specs=(q_spec, pool_spec, pool_spec) + (P(),) * (2 + len(rest)),
+            in_specs=(q_spec, pool_spec, pool_spec, P(), P()) + extra,
             out_specs=q_spec,
             axis_names=frozenset({model_axis}),
         )(q, k_pool, v_pool, tables, pos_q, *rest)
@@ -126,12 +153,16 @@ def _shard_mapped_kernel(kernel, shard_ctx, q_spec):
     return run
 
 
-def paged_attend(q, k_new, v_new, k_pool, v_pool, tables, write_page,
+def paged_attend(q, k_new, v_new, layer_pools, tables, write_page,
                  write_off, pos_q, q_len, n_rep: int, impl: str,
                  shard_ctx=None):
     """Write this step's K/V through the block table, then attend.
 
-    q: [B, T, n_h, d]; k_new/v_new: [B, T, n_kv, d]; tables: [B, P];
+    q: [B, T, n_h, d]; k_new/v_new: [B, T, n_kv, d]; layer_pools: one
+    layer's pool tuple — fp32 `(k_pool, v_pool)` or int8
+    `(k_codes, v_codes, k_scale, v_scale)` (ISSUE 9: the write path
+    quantizes at append time via `quantized_page_write`, the attend
+    paths dequantize with the per-page-per-head scales); tables: [B, P];
     write_page/write_off: [B, T] int32; pos_q: [B] context position of q
     row 0; q_len: [B] live rows per span (rows past it are padding).
     impl is the statically-resolved attention path ("reference" |
@@ -140,37 +171,73 @@ def paged_attend(q, k_new, v_new, k_pool, v_pool, tables, write_page,
     (ISSUE 7): the kernels then run per-shard via shard_map on each
     shard's kv-head slice; the gather reference path needs no wrapper —
     GSPMD partitions it from the pool sharding alone. Returns
-    ([B, T, n_h*d], k_pool, v_pool)."""
-    k_pool = k_pool.at[write_page, write_off].set(k_new)
-    v_pool = v_pool.at[write_page, write_off].set(v_new)
+    ([B, T, n_h*d], new_layer_pools)."""
+    quantized = len(layer_pools) == 4
+    if quantized:
+        k_pool, v_pool, k_scale, v_scale = layer_pools
+        k_pool, k_scale = quantized_page_write(k_pool, k_scale, write_page,
+                                               write_off, k_new)
+        v_pool, v_scale = quantized_page_write(v_pool, v_scale, write_page,
+                                               write_off, v_new)
+        out_pools = (k_pool, v_pool, k_scale, v_scale)
+    else:
+        k_pool, v_pool = layer_pools
+        k_pool = k_pool.at[write_page, write_off].set(k_new)
+        v_pool = v_pool.at[write_page, write_off].set(v_new)
+        out_pools = (k_pool, v_pool)
     B, T = q.shape[0], q.shape[1]
     if impl == "paged_decode":
         from paddle_tpu.ops.pallas.paged_attention import \
             paged_decode_attention
 
+        if quantized:   # dispatch never routes int8 pools here
+            raise ValueError("paged_decode has no int8-pool path — "
+                             "_attn_impl_for routes int8 pools to the "
+                             "ragged kernel or the gather reference")
         fn = paged_decode_attention
         if shard_ctx is not None:
             fn = _shard_mapped_kernel(fn, shard_ctx,
                                       P(None, shard_ctx[1], None))
         out = fn(q[:, 0], k_pool, v_pool, tables, pos_q)
-        return out.reshape(B, 1, -1), k_pool, v_pool
+        return out.reshape(B, 1, -1), out_pools
     if impl == "ragged":
         from paddle_tpu.ops.pallas.ragged_paged_attention import \
             ragged_paged_attention
 
+        if quantized:
+            def fn(q_, kp, vp, t, p, ql, ks, vs):
+                return ragged_paged_attention(q_, kp, vp, t, p, ql,
+                                              k_scale=ks, v_scale=vs)
+
+            if shard_ctx is not None:
+                sc = P(None, shard_ctx[1])     # scale rows: heads sharded
+                fn = _shard_mapped_kernel(
+                    fn, shard_ctx, P(None, None, shard_ctx[1], None),
+                    rest_specs=(P(), sc, sc))
+            out = fn(q, k_pool, v_pool, tables, pos_q, q_len,
+                     k_scale, v_scale)
+            return out.reshape(B, T, -1), out_pools
         fn = ragged_paged_attention
         if shard_ctx is not None:
             fn = _shard_mapped_kernel(fn, shard_ctx,
                                       P(None, None, shard_ctx[1], None))
         out = fn(q, k_pool, v_pool, tables, pos_q, q_len)
-        return out.reshape(B, T, -1), k_pool, v_pool
+        return out.reshape(B, T, -1), out_pools
     kg = paged_gather(k_pool, tables)
     vg = paged_gather(v_pool, tables)
+    if quantized:
+        # dequantize the gathered codes with their page/head scales —
+        # the CPU oracle path reads the same int8 domain the kernel does
+        ps = k_pool.shape[1]
+        ks = jnp.repeat(k_scale[tables], ps, axis=1)    # [B, L, n_kv]
+        vs = jnp.repeat(v_scale[tables], ps, axis=1)
+        kg = kg.astype(jnp.float32) * ks[..., None]
+        vg = vg.astype(jnp.float32) * vs[..., None]
     if n_rep > 1:  # GQA: repeat kv groups up to the query heads
         kg = jnp.repeat(kg, n_rep, axis=2)
         vg = jnp.repeat(vg, n_rep, axis=2)
     out = masked_cache_attention(q, kg, vg, pos_q)
-    return out, k_pool, v_pool
+    return out, out_pools
 
 
 class PagedModelRunner:
@@ -190,7 +257,8 @@ class PagedModelRunner:
     ATTN_IMPLS = ("auto", "pallas", "ragged", "reference")
 
     def __init__(self, params: Dict[str, jnp.ndarray], block_size: int,
-                 max_model_len: int, attn_impl: str = "auto"):
+                 max_model_len: int, attn_impl: str = "auto",
+                 kv_dtype: str = "fp32", weight_dtype: str = "fp32"):
         self.params = params
         self.block_size = block_size
         self.max_model_len = max_model_len
@@ -198,6 +266,21 @@ class PagedModelRunner:
             raise ValueError(f"attn_impl={attn_impl!r}; expected one of "
                              f"{self.ATTN_IMPLS}")
         self.attn_impl = attn_impl
+        # quantized serving knobs (ISSUE 9): kv_dtype="int8" makes the
+        # engine build int8 page pools + per-page-per-head scale pools
+        # (this runner quantizes at append time and dequantizes in the
+        # attend paths); weight_dtype="int8" converts the 2-D matmul
+        # weights to int8 codes + per-output-channel scales at
+        # construction (subclasses call _quantize_weights). Both default
+        # to "fp32", which is bit-identical to the pre-ISSUE-9 runner.
+        if kv_dtype not in KV_DTYPES:
+            raise ValueError(f"kv_dtype={kv_dtype!r}; expected one of "
+                             f"{KV_DTYPES}")
+        if weight_dtype not in WEIGHT_DTYPES:
+            raise ValueError(f"weight_dtype={weight_dtype!r}; expected one "
+                             f"of {WEIGHT_DTYPES}")
+        self.kv_dtype = kv_dtype
+        self.weight_dtype = weight_dtype
         self._jit_cache: "OrderedDict" = OrderedDict()
         self._impl_logged: set = set()
         # tensor-parallel state (ISSUE 7): set by shard(); mesh=None is
@@ -218,11 +301,46 @@ class PagedModelRunner:
 
     @property
     def dtype(self):
+        """The runner's COMPUTE dtype: the first floating param (int8
+        weight codes are storage, not the serving precision)."""
+        for v in self.params.values():
+            if jnp.issubdtype(v.dtype, jnp.floating):
+                return v.dtype
         return next(iter(self.params.values())).dtype
 
     @property
     def n_rep(self) -> int:
         return self.n_heads // self.n_kv_heads
+
+    # ------------------------------------------- weight-only int8 (ISSUE 9)
+
+    def _quantize_weights(self, names) -> None:
+        """Convert the named 2-D [in, out] matmul weights to int8 codes
+        plus per-output-channel fp32 scales (`name + "::scale"` params).
+        Uses the established quantization/int8.py abs-max scheme; the
+        matmul epilogue dequant lives in `_mm`. Norms, biases, and
+        embeddings stay floating — only the HBM-heavy matrices halve."""
+        from paddle_tpu.quantization.int8 import _weight_quantize
+
+        for name in names:
+            w = self.params[name]
+            qw, scale = _weight_quantize(w)
+            self.params[name] = qw
+            self.params[name + SCALE_SUFFIX] = scale.astype(jnp.float32)
+        logger.info("serving weights quantized int8: %d matrices "
+                    "(per-output-channel scales)", len(names))
+
+    def _mm(self, params, name, x):
+        """Matmul against a possibly-quantized weight: fp32 weights take
+        the exact pre-ISSUE-9 `x @ w` (bit-identical default path);
+        int8 weights dequantize in the matmul epilogue — the int8 codes
+        are what HBM reads, the per-output-channel scale multiplies the
+        dot output (exactly `x @ (qw * scale)` by column linearity)."""
+        w = params[name]
+        s = params.get(name + SCALE_SUFFIX)
+        if s is None:
+            return x @ w
+        return (x @ w.astype(x.dtype)) * s.astype(x.dtype)
 
     # --------------------------------------------------- sharding (ISSUE 7)
 
@@ -284,6 +402,16 @@ class PagedModelRunner:
 
         layout = SpecLayout(data_axis=data_axis, model_axis=model_axis)
         specs = self._param_specs(layout)
+        # weight-only int8 (ISSUE 9): a quantized weight's scale vector
+        # shards WITH its output columns — column-parallel weights
+        # ([in, out] split on out) carry P(model) scales, row-parallel
+        # ones ([in, out] split on in) carry replicated scales. Derived
+        # from the weight's own spec so the two can never disagree.
+        for name in list(specs):
+            sname = name + SCALE_SUFFIX
+            if sname in self.params:
+                spec = tuple(specs[name])
+                specs[sname] = P(spec[1]) if len(spec) >= 2 else P()
         shardings: Dict[str, NamedSharding] = {}
         for name, v in self.params.items():
             spec = specs.get(name, P())
@@ -340,11 +468,18 @@ class PagedModelRunner:
         """Explicit (in_shardings, out_shardings) for one jitted step:
         params per their specs, host operands replicated, K/V pools
         split on the kv-head axis in AND out — the pools never leave the
-        mesh sharded layout, so no step pays a gather/reshard."""
+        mesh sharded layout, so no step pays a gather/reshard. Int8
+        pools (ISSUE 9) carry their scale pools in the layer tuple,
+        sharded along the same kv-head axis."""
         mesh = self.mesh
         rep = NamedSharding(mesh, P())
         kv = NamedSharding(mesh, self._layout.kv_pool())
-        pools = [(kv, kv) for _ in range(self.num_layers)]
+        if self.kv_dtype == "int8":
+            sc = NamedSharding(mesh, P(None, self.model_axis))
+            layer = (kv, kv, sc, sc)
+        else:
+            layer = (kv, kv)
+        pools = [layer for _ in range(self.num_layers)]
         ins = ([self._param_shardings] + [rep] * (pools_arg - 1) + [pools])
         return tuple(ins), (rep, pools)
 
@@ -379,6 +514,16 @@ class PagedModelRunner:
             else:          # auto: kernels on TPU, gather oracle on CPU
                 impl = (best or "reference"
                         if jax.default_backend() == "tpu" else "reference")
+        if self.kv_dtype == "int8" and impl == "paged_decode":
+            # the single-token paged-decode kernel has no dequant step;
+            # int8 pools route to the ragged kernel (which dequantizes
+            # in its page walk) or the dequantizing gather reference
+            from paddle_tpu.ops.pallas.ragged_paged_attention import \
+                ragged_attention_ok
+
+            impl = ("ragged" if ragged_attention_ok(
+                self.head_dim, self.n_heads, self.n_kv_heads)
+                else "reference")
         key = (q_len_bucket, impl)
         if key not in self._impl_logged:
             self._impl_logged.add(key)
@@ -388,6 +533,17 @@ class PagedModelRunner:
                 self.n_heads, self.n_kv_heads, self.head_dim, self.attn_impl)
         return impl
 
+    def _kv_page_bytes(self) -> int:
+        """HBM bytes ONE page costs this runner's attention per call,
+        PER SHARD: honest accounting (ISSUE 9) — int8 pools count the
+        int8 code bytes PLUS the per-page-per-head scale bytes the
+        dequant reads, never the logical dtype's itemsize."""
+        nkv = self.n_kv_heads // self.tp_size
+        data = self.block_size * nkv * self.head_dim
+        if self.kv_dtype == "int8":
+            return 2 * self.num_layers * (data + nkv * 4)
+        return 2 * self.num_layers * data * np.dtype(self.dtype).itemsize
+
     def _account_attn(self, impl: str, starts, q_lens, table_width: int):
         """Bump the instrumented-pool counters for one step call: the
         kernels read only each span's live pages (clamped index_map);
@@ -396,13 +552,14 @@ class PagedModelRunner:
         bandwidth claim is verifiable without TPU access. On a sharded
         runner the count is PER SHARD — each shard reads only its
         n_kv/tp kv-head slice of every page, so sharded bytes equal the
-        single-device bytes / tp (the ISSUE 7 acceptance number)."""
+        single-device bytes / tp (the ISSUE 7 acceptance number). On an
+        int8 pool (ISSUE 9) the per-page bytes are the quantized bytes
+        + scale bytes, so fp32-vs-int8 arms of the same workload expose
+        the real bandwidth reduction."""
         from paddle_tpu.ops.pallas.ragged_paged_attention import \
             attention_page_reads
 
-        per_page = (2 * self.num_layers * self.block_size
-                    * (self.n_kv_heads // self.tp_size)
-                    * self.head_dim * np.dtype(self.dtype).itemsize)
+        per_page = self._kv_page_bytes()
         gather_pages = len(np.asarray(starts).reshape(-1)) * table_width
         if impl in ("paged_decode", "ragged"):
             pages = int(attention_page_reads(starts, q_lens,
@@ -648,13 +805,15 @@ class LlamaRunner(PagedModelRunner):
     weights of the Layer it was built from."""
 
     def __init__(self, model, block_size: int = 16,
-                 max_model_len: int | None = None, attn_impl: str = "auto"):
+                 max_model_len: int | None = None, attn_impl: str = "auto",
+                 kv_dtype: str = "fp32", weight_dtype: str = "fp32"):
         from paddle_tpu.jit.functionalize import functionalize
 
         cfg = model.cfg
         params = functionalize(model).param_values()
         super().__init__(params, block_size,
-                         max_model_len or cfg.max_seq_len, attn_impl)
+                         max_model_len or cfg.max_seq_len, attn_impl,
+                         kv_dtype, weight_dtype)
         self.cfg = cfg
         self.num_layers = cfg.num_layers
         self.n_heads = cfg.num_heads
@@ -664,6 +823,19 @@ class LlamaRunner(PagedModelRunner):
         cos, sin = _rope_tables(self.max_model_len, self.head_dim,
                                 cfg.rope_theta)
         self._rope_cos, self._rope_sin = cos, sin      # [L, d] fp32
+        if weight_dtype == "int8":
+            names = []
+            for i in range(self.num_layers):
+                pre = f"layers.{i}."
+                names += [pre + n for n in (
+                    "self_attn.q_proj.weight", "self_attn.k_proj.weight",
+                    "self_attn.v_proj.weight", "self_attn.o_proj.weight",
+                    "mlp.gate_proj.weight", "mlp.up_proj.weight",
+                    "mlp.down_proj.weight")]
+            if "lm_head.weight" in self.params:
+                names.append("lm_head.weight")
+            # embeddings stay floating (lookup table; tied heads reuse it)
+            self._quantize_weights(names)
 
     def _param_specs(self, layout):
         """Megatron placements for the Llama block (ISSUE 7): column-
@@ -711,32 +883,32 @@ class LlamaRunner(PagedModelRunner):
             pre = f"layers.{i}."
             h = self._rms(x, params[pre + "input_layernorm.weight"],
                           cfg.rms_eps)
-            q = (h @ params[pre + "self_attn.q_proj.weight"]
-                 ).reshape(B, T, self.n_heads, d)
-            k = (h @ params[pre + "self_attn.k_proj.weight"]
-                 ).reshape(B, T, self.n_kv_heads, d)
-            v = (h @ params[pre + "self_attn.v_proj.weight"]
-                 ).reshape(B, T, self.n_kv_heads, d)
+            q = self._mm(params, pre + "self_attn.q_proj.weight", h
+                         ).reshape(B, T, self.n_heads, d)
+            k = self._mm(params, pre + "self_attn.k_proj.weight", h
+                         ).reshape(B, T, self.n_kv_heads, d)
+            v = self._mm(params, pre + "self_attn.v_proj.weight", h
+                         ).reshape(B, T, self.n_kv_heads, d)
             q = self._rope(q, cos, sin)
             k = self._rope(k, cos, sin)
             q, k, v = self._constrain_heads(q, k, v)
-            out, kp, vp = paged_attend(
-                q, k, v, pools[i][0], pools[i][1], tables, write_page,
+            out, layer = paged_attend(
+                q, k, v, pools[i], tables, write_page,
                 write_off, pos_q, q_lens, self.n_rep, impl,
                 shard_ctx=self._shard_ctx)
-            x = x + out @ params[pre + "self_attn.o_proj.weight"]
+            x = x + self._mm(params, pre + "self_attn.o_proj.weight", out)
             h = self._rms(x, params[pre + "post_attention_layernorm.weight"],
                           cfg.rms_eps)
-            gate = h @ params[pre + "mlp.gate_proj.weight"]
-            up = h @ params[pre + "mlp.up_proj.weight"]
-            x = x + (jax.nn.silu(gate) * up) @ params[pre
-                                                      + "mlp.down_proj.weight"]
-            new_pools.append((kp, vp))
+            gate = self._mm(params, pre + "mlp.gate_proj.weight", h)
+            up = self._mm(params, pre + "mlp.up_proj.weight", h)
+            x = x + self._mm(params, pre + "mlp.down_proj.weight",
+                             jax.nn.silu(gate) * up)
+            new_pools.append(layer)
         x = self._rms(x, params["norm.weight"], cfg.rms_eps)
         if cfg.tie_embeddings:
             logits = x @ params["embed_tokens.weight"].T
         else:
-            logits = x @ params["lm_head.weight"]
+            logits = self._mm(params, "lm_head.weight", x)
         return logits, new_pools
 
 
@@ -745,19 +917,38 @@ class GPTRunner(PagedModelRunner):
     helpers the dense-cache generator already runs."""
 
     def __init__(self, model, block_size: int = 16,
-                 max_model_len: int | None = None, attn_impl: str = "auto"):
+                 max_model_len: int | None = None, attn_impl: str = "auto",
+                 kv_dtype: str = "fp32", weight_dtype: str = "fp32"):
         from paddle_tpu.jit.functionalize import functionalize
 
         cfg = model.cfg
         params = functionalize(model).param_values()
         super().__init__(params, block_size,
-                         max_model_len or cfg.max_seq_len, attn_impl)
+                         max_model_len or cfg.max_seq_len, attn_impl,
+                         kv_dtype, weight_dtype)
         self.cfg = cfg
         self.num_layers = cfg.num_layers
         self.n_heads = cfg.num_heads
         self.n_kv_heads = cfg.num_heads
         self.head_dim = cfg.hidden_size // cfg.num_heads
         self.vocab_size = cfg.vocab_size
+        if weight_dtype == "int8":
+            # GPT stores the fused QKV weight FLAT as [hidden, 3*nh*d]
+            # (column order (3, nh, d)), so per-output-channel abs-max
+            # quantization is exact per fused column; _weight_quantize
+            # itself rejects a raw (3, nh, d) tensor loudly (ISSUE 9
+            # satellite) rather than silently scaling over the qkv axis.
+            # MoE blocks (mlp.gate present) keep their expert weights
+            # floating — only dense matmul matrices quantize.
+            names = []
+            for i in range(self.num_layers):
+                pre = f"blocks.{i}."
+                names += [pre + "attn.qkv.weight", pre + "attn.out.weight"]
+                if pre + "mlp.fc1.weight" in self.params:
+                    names += [pre + "mlp.fc1.weight", pre + "mlp.fc2.weight"]
+            if "lm_head.weight" in self.params:
+                names.append("lm_head.weight")
+            self._quantize_weights(names)
 
     def _param_specs(self, layout):
         """GPT placements (ISSUE 7). The fused attn.qkv weight keeps its
@@ -791,20 +982,31 @@ class GPTRunner(PagedModelRunner):
         for i in range(cfg.num_layers):
             p = _block_params(params, i)
             h = _layer_norm(x, p["ln1.weight"], p["ln1.bias"])
-            qkv = (h @ p["attn.qkv.weight"] + p["attn.qkv.bias"]
+            qkv = (self._mm(p, "attn.qkv.weight", h) + p["attn.qkv.bias"]
                    ).reshape(B, T, 3, self.n_heads, d)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
             q, k, v = self._constrain_heads(q, k, v)
-            out, kp, vp = paged_attend(
-                q, k, v, pools[i][0], pools[i][1], tables, write_page,
+            out, layer = paged_attend(
+                q, k, v, pools[i], tables, write_page,
                 write_off, pos_q, q_lens, 1, impl,
                 shard_ctx=self._shard_ctx)
-            x = x + (out @ p["attn.out.weight"] + p["attn.out.bias"])
+            x = x + (self._mm(p, "attn.out.weight", out)
+                     + p["attn.out.bias"])
             h = _layer_norm(x, p["ln2.weight"], p["ln2.bias"])
-            x = x + _mlp(p, h)
-            new_pools.append((kp, vp))
+            if "mlp.fc1.weight" + SCALE_SUFFIX in p:
+                # dense MLP with int8 weights: same gelu(fc1)+fc2 math,
+                # matmuls through the dequant epilogue (_mlp stays the
+                # untouched fp32 path so the default is bit-identical)
+                hm = jax.nn.gelu(self._mm(p, "mlp.fc1.weight", h)
+                                 + p["mlp.fc1.bias"], approximate=True)
+                x = x + self._mm(p, "mlp.fc2.weight", hm) + p["mlp.fc2.bias"]
+            else:
+                x = x + _mlp(p, h)
+            new_pools.append(layer)
         x = _layer_norm(x, params["ln_f.weight"], params["ln_f.bias"])
-        if "lm_head.weight" in params:
+        if "lm_head.weight" + SCALE_SUFFIX in params:
+            logits = self._mm(params, "lm_head.weight", x)
+        elif "lm_head.weight" in params:
             logits = jnp.einsum("bth,hv->btv", x, params["lm_head.weight"])
         else:
             logits = jnp.einsum("bth,vh->btv", x, params["wte.weight"])
@@ -812,15 +1014,18 @@ class GPTRunner(PagedModelRunner):
 
 
 def runner_for(model, block_size: int = 16, max_model_len: int | None = None,
-               attn_impl: str = "auto") -> PagedModelRunner:
+               attn_impl: str = "auto", kv_dtype: str = "fp32",
+               weight_dtype: str = "fp32") -> PagedModelRunner:
     """Pick the runner for a supported decoder Layer."""
     from paddle_tpu.models.gpt import GPT
     from paddle_tpu.models.llama import Llama
 
     if isinstance(model, Llama):
-        return LlamaRunner(model, block_size, max_model_len, attn_impl)
+        return LlamaRunner(model, block_size, max_model_len, attn_impl,
+                           kv_dtype, weight_dtype)
     if isinstance(model, GPT):
-        return GPTRunner(model, block_size, max_model_len, attn_impl)
+        return GPTRunner(model, block_size, max_model_len, attn_impl,
+                         kv_dtype, weight_dtype)
     raise TypeError(
         f"no serving runner for {type(model).__name__}; supported: Llama, "
         "GPT (write a PagedModelRunner subclass for custom decoders)")

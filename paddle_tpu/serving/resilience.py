@@ -328,16 +328,48 @@ def audit_engine(engine) -> None:
                 for p in cached):
             problems.append("prefix-cache hash index and page index disagree")
 
+    # -- quantized pools (ISSUE 9): an int8 pool's layer tuples must
+    #    carry the parallel scale pools — ONE scale per page per kv-head
+    #    — and the code pools must actually be int8; an fp32 pool must
+    #    carry the plain (k, v) pairs
+    pool = engine.pool
+    kv_dtype = getattr(pool, "kv_dtype", "fp32")
+    want_len = 4 if kv_dtype == "int8" else 2
+    for li, layer in enumerate(pool.pools):
+        if len(layer) != want_len:
+            problems.append(
+                f"layer {li} pool tuple has {len(layer)} entries != "
+                f"{want_len} for kv_dtype={kv_dtype}")
+            continue
+        if kv_dtype == "int8":
+            k, v, ks, vs = layer
+            for nm, arr in (("k", k), ("v", v)):
+                if str(arr.dtype) != "int8":
+                    problems.append(f"layer {li} {nm}-pool dtype "
+                                    f"{arr.dtype} != int8 on an int8 pool")
+            for nm, arr in (("k", ks), ("v", vs)):
+                if tuple(arr.shape) != (pool.num_blocks, pool.n_kv_heads):
+                    problems.append(
+                        f"layer {li} {nm}-scale pool shape "
+                        f"{tuple(arr.shape)} != "
+                        f"{(pool.num_blocks, pool.n_kv_heads)} — one scale "
+                        "per page per kv-head")
+
     # -- sharded pools (ISSUE 7): per-shard shapes must agree with the
     #    replicated block tables — every model shard holds EVERY page's
     #    kv-head slice (pages replicated across shards, only kv-heads
-    #    split), or a page id in a block table would dangle on some shard
-    pool = engine.pool
+    #    split), or a page id in a block table would dangle on some shard.
+    #    Int8 scale pools shard along the same kv-head axis (ISSUE 9).
     if getattr(pool, "mesh", None) is not None:
         expect = (pool.num_blocks, pool.block_size,
                   pool.n_kv_heads // pool.tp_size, pool.head_dim)
-        for li, (k, v) in enumerate(pool.pools):
-            for nm, arr in (("k", k), ("v", v)):
+        s_expect = (pool.num_blocks, pool.n_kv_heads // pool.tp_size)
+        for li, layer in enumerate(pool.pools):
+            named = [("k", layer[0], expect), ("v", layer[1], expect)]
+            if len(layer) == 4:
+                named += [("k-scale", layer[2], s_expect),
+                          ("v-scale", layer[3], s_expect)]
+            for nm, arr, want in named:
                 shards = getattr(arr, "addressable_shards", None)
                 if not shards:
                     problems.append(
@@ -345,12 +377,13 @@ def audit_engine(engine) -> None:
                         "array on a mesh-backed pool")
                     continue
                 shapes = {tuple(s.data.shape) for s in shards}
-                if shapes != {expect}:
+                if shapes != {want}:
                     problems.append(
                         f"layer {li} {nm}-pool per-shard shapes "
-                        f"{sorted(shapes)} != {expect} — block tables are "
+                        f"{sorted(shapes)} != {want} — block tables are "
                         "replicated, so every shard must hold all "
-                        f"{pool.num_blocks} pages at n_kv/tp heads")
+                        f"{pool.num_blocks} pages sharded only on the "
+                        "kv-head axis")
 
     # -- slot accounting -------------------------------------------------
     slots = [r.slot for r in sched.running]

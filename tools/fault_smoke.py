@@ -74,6 +74,20 @@ requests (token-exact vs the naive oracle where no request was shed),
 and the per-replica invariant auditor (audit_router) must come back
 green.
 
+ISSUE 9: `--kv-dtype int8 [--weight-dtype int8]` drills every fault
+class with QUANTIZED serving on: the paged K/V pools store int8 codes
+plus per-page-per-head scale pools (the armed auditor checks the scale
+-pool shape invariant — one scale per page per kv-head, sharded like
+its pool under --tp), and/or the matmul weights run the weight-only
+int8 path. COW forks, prefix-cache adoption, and speculative/horizon
+rollback all operate on the quantized pools. The naive oracle cannot
+pin token equality here (chunked prefill legitimately changes int8
+rounding vs a monolithic prefill), so the none/device_error classes
+instead compare against a fault-free TWIN engine with the identical
+config — determinism and retry-exactness stay hard-pinned while the
+accuracy gate vs fp32 lives in tests/bench. Records add
+kv_bytes_reduction_x / sessions_per_pool_x.
+
 ISSUE 5: `--speculate [K]` (K defaults to 4) drills every fault class
 with speculative decoding ON: decode rides n-gram verify spans through
 the full-logits ragged call — the same decode-op fault schedules now
@@ -183,16 +197,34 @@ def run_class(fault: str, runner, args) -> dict:
     slots_ok = sorted(eng.scheduler._free_slots) == list(range(args.max_batch))
 
     oracle_ok = True
+    quantized = (args.kv_dtype != "fp32" or args.weight_dtype != "fp32")
     if fault in ("none", "device_error"):
-        # retries are exact: tokens must equal the fault-free oracle
-        from paddle_tpu.serving import naive_generate
+        if quantized:
+            # int8 pools: chunked prefill legitimately changes int8
+            # rounding vs the naive monolithic prefill, so the pin is a
+            # fault-free TWIN engine with the identical config — exact
+            # determinism + retry-exactness, accuracy gate lives in tests
+            twin = build_engine(runner, args, **engine_kw)
+            twin_ids = {}
+            for rid, prompt, sp in work:
+                twin_ids[rid] = twin.add_request(prompt, sp)
+            twin_outs = twin.run()
+            twin.release_prefix_cache()
+            for rid, prompt, sp in work:
+                if (outs[rid].output_tokens
+                        != twin_outs[twin_ids[rid]].output_tokens):
+                    oracle_ok = False
+                    break
+        else:
+            # retries are exact: tokens must equal the fault-free oracle
+            from paddle_tpu.serving import naive_generate
 
-        for rid, prompt, sp in work:
-            ref = naive_generate(runner, prompt, sp,
-                                 max_model_len=args.max_model_len)
-            if outs[rid].output_tokens != ref:
-                oracle_ok = False
-                break
+            for rid, prompt, sp in work:
+                ref = naive_generate(runner, prompt, sp,
+                                     max_model_len=args.max_model_len)
+                if outs[rid].output_tokens != ref:
+                    oracle_ok = False
+                    break
 
     ok = (crashed is None and leaks_ok and slots_ok and oracle_ok
           and len(outs) == n
@@ -200,6 +232,9 @@ def run_class(fault: str, runner, args) -> dict:
     return {
         "fault": fault, "ok": ok, "requests": n,
         "tp": getattr(runner, "tp_size", 1),
+        "kv_dtype": args.kv_dtype, "weight_dtype": args.weight_dtype,
+        "kv_bytes_reduction_x": m["kv_bytes_reduction_x"],
+        "sessions_per_pool_x": m["sessions_per_pool_x"],
         "finish_reasons": reasons,
         "no_unhandled_exception": crashed is None,
         "crash": crashed,
@@ -400,6 +435,16 @@ def main() -> int:
                     help="attention path (auto: kernels on TPU, gather "
                          "oracle on CPU; ragged: force the ragged "
                          "paged-attention kernel, interpret mode off-TPU)")
+    ap.add_argument("--kv-dtype", default="fp32",
+                    choices=("fp32", "int8"),
+                    help="K/V page pool storage (ISSUE 9): int8 codes + "
+                         "per-page-per-head scale pools, dequantized in "
+                         "the attention page walk (default fp32)")
+    ap.add_argument("--weight-dtype", default="fp32",
+                    choices=("fp32", "int8"),
+                    help="matmul weight storage (ISSUE 9): weight-only "
+                         "int8 with per-output-channel scales, dequant "
+                         "in the matmul epilogue (default fp32)")
     args = ap.parse_args()
     # refcounted invariants audited after every step, engine-independent
     os.environ["PADDLE_TPU_SERVING_AUDIT"] = "1"
@@ -419,7 +464,9 @@ def main() -> int:
     # the first class pays compile time (engines/pools stay per-class)
     runner = LlamaRunner(model, block_size=args.block_size,
                          max_model_len=args.max_model_len,
-                         attn_impl=args.attn_impl)
+                         attn_impl=args.attn_impl,
+                         kv_dtype=args.kv_dtype,
+                         weight_dtype=args.weight_dtype)
     if args.tp > 1:
         from paddle_tpu.parallel.mesh import serving_mesh
 
