@@ -680,6 +680,141 @@ def child_serving_kvq(layers: int, hidden: int, max_batch: int,
     })
 
 
+def child_serving_offload(layers: int, hidden: int, max_batch: int,
+                          requests: int, prompt: int, gen: int, vocab: int):
+    """Tiered-KV offload rung (ISSUE 10): a deliberately TIGHT pool
+    (about 1.5 sequences' worth) drives continuous youngest-first
+    preemption, run in two arms — `recompute` (no host tier: every
+    resume re-prefills its full context, the pre-ISSUE-10 cost) and
+    `pagein` (host tier on: victims spill to pinned host buffers and
+    resume by async page-in). The committed acceptance number is
+    `resume_compute_reduction_x`: resume-side prefill tokens computed,
+    recompute / pagein (>= 3x required — the page-in arm only computes
+    the one outstanding token per resume), plus the measured
+    `pagein_hidden_ratio` (transfers issued a step ahead of their
+    fence). A third arm turns on `host_tier_headroom` under a 0.6
+    admission watermark and commits the sessions-per-pool uplift (peak
+    concurrent running). A host<->device page copy-bandwidth microbench
+    (spill and page-in GB/s over the pool's real page bytes) rides
+    along — this is the copy/infeed share PERF_BREAKDOWN predicted
+    actually earning its keep."""
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.serving import GPTRunner, SamplingParams, ServingEngine
+
+    backend = jax.default_backend()
+    paddle.seed(0)
+    max_len = prompt + gen
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+                    num_heads=max(hidden // 64, 1), max_seq_len=max_len,
+                    dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+    block_size = min(16, max_len)
+    runner = GPTRunner(model, block_size=block_size, max_model_len=max_len)
+    pages_per_seq = -(-max_len // block_size)
+    # tight pool: exactly two sequences fit at ADMISSION (context + 1
+    # token), then both grow toward prompt+gen and collide — the
+    # youngest preempts, spills, and resumes; the preemption regime
+    # offload exists for. Admission reserves blocks_for(prompt + 1), so
+    # sizing must come from that, not from the final footprint.
+    admit_pages = -(-(prompt + 1) // block_size)
+    tight_blocks = 2 * admit_pages + 2
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(0, vocab, prompt)) for _ in range(requests)]
+
+    def run_arm(tier_pages, headroom=False, watermark=1.0) -> dict:
+        eng = ServingEngine(runner, num_blocks=tight_blocks,
+                            max_batch_size=max_batch, max_model_len=max_len,
+                            admission_watermark=watermark,
+                            host_tier_pages=tier_pages,
+                            host_tier_headroom=headroom)
+        t0 = time.time()
+        for i, p in enumerate(prompts):
+            eng.add_request(p, SamplingParams(max_tokens=gen),
+                            request_id=f"r{i}")
+        eng.run()
+        wall = time.time() - t0
+        snap = eng.metrics.snapshot()
+        initial = sum(len(p) for p in prompts)
+        return {"wall_s": round(wall, 3),
+                "host_tier_pages": tier_pages,
+                "host_tier_headroom": headroom,
+                "tokens_per_sec": snap["tokens_generated"] / wall,
+                "preemptions": snap["preemptions"],
+                "prefill_tokens": snap["prefill_tokens"],
+                # resume compute = prefill beyond the unavoidable first
+                # pass over every prompt: what preemption recovery COST
+                "resume_compute_tokens": snap["prefill_tokens"] - initial,
+                "offload_spill_pages": snap["offload_spill_pages"],
+                "pagein_pages": snap["pagein_pages"],
+                "pagein_hidden_ratio": snap["pagein_hidden_ratio"],
+                "offload_resumes": snap["offload_resumes"],
+                "offload_recompute_fallbacks":
+                    snap["offload_recompute_fallbacks"],
+                "host_tier_bytes_peak": eng.metrics.host_tier_bytes.peak,
+                "peak_running": eng.metrics.running.peak,
+                "ttft_s_p99": snap["ttft_s_p99"]}
+
+    def copy_bandwidth(n_pages=16) -> dict:
+        """Host<->device page copy microbench over the REAL pool page
+        bytes (all layers, k+v): the raw rates the async page-in hides
+        behind decode."""
+        from paddle_tpu.serving import KVCachePool
+
+        pool = KVCachePool(runner.num_layers, n_pages + 1, block_size,
+                           runner.n_kv_heads, runner.head_dim, runner.dtype)
+        tier = pool.enable_host_tier(n_pages)
+        pages = pool.allocator.alloc(n_pages)
+        for layer in pool.pools:        # materialize before timing
+            layer[0].block_until_ready()
+        t0 = time.perf_counter()
+        slots = tier.spill_pages(pages)
+        spill_s = time.perf_counter() - t0
+        data = [tier.read_slot(s) for s in slots]
+        t0 = time.perf_counter()
+        staged = [runner.stage_host_pages(d) for d in data]
+        stacked = [tuple(np.stack([s[li][j] for s in staged])
+                         for j in range(len(pool.pools[li])))
+                   for li in range(runner.num_layers)]
+        pool.write_pages(pages, stacked)
+        for layer in pool.pools:
+            layer[0].block_until_ready()
+        pagein_s = time.perf_counter() - t0
+        moved = n_pages * pool.page_bytes()
+        return {"pages": n_pages, "bytes": moved,
+                "spill_gbps": moved / spill_s / 1e9,
+                "pagein_gbps": moved / pagein_s / 1e9}
+
+    run_arm(0)                    # warmup: compile buckets + decode step
+    recompute = run_arm(0)
+    pagein = run_arm(4 * pages_per_seq)
+    # sessions-per-pool uplift: same watermark, knob off vs on — the
+    # host headroom lets admission run the pool hotter
+    base_sessions = run_arm(4 * pages_per_seq, headroom=False,
+                            watermark=0.6)
+    headroom = run_arm(4 * pages_per_seq, headroom=True, watermark=0.6)
+    reduction = (recompute["resume_compute_tokens"]
+                 / max(pagein["resume_compute_tokens"], 1))
+    _write_child({
+        "backend": backend, "layers": layers, "hidden": hidden,
+        "max_batch": max_batch, "requests": requests, "prompt": prompt,
+        "gen": gen, "workload": "kv_offload",
+        "num_blocks": tight_blocks,
+        "recompute": recompute, "pagein": pagein,
+        "watermark_base": base_sessions, "watermark_headroom": headroom,
+        # THE acceptance number: resume cost in computed prefill tokens
+        "resume_compute_reduction_x": reduction,
+        "sessions_uplift_x": (headroom["peak_running"]
+                              / max(base_sessions["peak_running"], 1)),
+        "copy_bandwidth": copy_bandwidth(),
+    })
+
+
 def child_serving_spec(layers: int, hidden: int, max_batch: int,
                        requests: int, prompt: int, gen: int, vocab: int):
     """Speculative-decoding serving rung (ISSUE 5): a repetition-heavy
@@ -1405,6 +1540,44 @@ def main():
                 f"{acc['top5_overlap']:.3f}, greedy agreement "
                 f"{acc['greedy_agreement']*100:.1f}%")
 
+    # tiered-KV offload rung (ISSUE 10): recompute-vs-pagein resume cost
+    # on a deliberately tight pool, the sessions uplift from the
+    # watermark headroom knob, and the host<->device page copy-bandwidth
+    # microbench — the committed number is the resume compute reduction
+    if on_tpu and remaining() > 120:
+        r = run_child("serving:6:512:4:8:96:48:32768:kv_offload",
+                      min(900, remaining()))
+        if r is not None:
+            bw = r["copy_bandwidth"]
+            line = {"metric": "serving_kv_offload_resume_reduction_x",
+                    "value": round(r["resume_compute_reduction_x"], 2),
+                    "unit": "x", "vs_baseline": 0.0,
+                    "resume_tokens_recompute":
+                        r["recompute"]["resume_compute_tokens"],
+                    "resume_tokens_pagein":
+                        r["pagein"]["resume_compute_tokens"],
+                    "preemptions": r["pagein"]["preemptions"],
+                    "offload_resumes": r["pagein"]["offload_resumes"],
+                    "pagein_hidden_ratio":
+                        round(r["pagein"]["pagein_hidden_ratio"], 4),
+                    "tokens_per_sec_recompute":
+                        round(r["recompute"]["tokens_per_sec"], 1),
+                    "tokens_per_sec_pagein":
+                        round(r["pagein"]["tokens_per_sec"], 1),
+                    "sessions_uplift_x": round(r["sessions_uplift_x"], 2),
+                    "spill_gbps": round(bw["spill_gbps"], 3),
+                    "pagein_gbps": round(bw["pagein_gbps"], 3),
+                    "backend": r["backend"]}
+            emit(line)
+            _cache_result(line)
+            log(f"kv-offload rung: resume compute "
+                f"{r['resume_compute_reduction_x']:.1f}x cheaper "
+                f"({r['recompute']['resume_compute_tokens']:.0f} -> "
+                f"{r['pagein']['resume_compute_tokens']:.0f} tokens), "
+                f"hidden ratio {r['pagein']['pagein_hidden_ratio']:.2f}, "
+                f"copy {bw['spill_gbps']:.2f}/{bw['pagein_gbps']:.2f} GB/s "
+                f"out/in")
+
     # speculative-decoding rung (ISSUE 5): repetition-heavy workload run
     # with and without n-gram speculation; commits tokens/s, acceptance
     # rate, steps/token, and the engine-step reduction factor
@@ -1580,6 +1753,8 @@ def _child_main(mode: str) -> None:
             child_serving_long(*[int(x) for x in parts[:-1]])
         elif parts and parts[-1] == "kv_quant":
             child_serving_kvq(*[int(x) for x in parts[:-1]])
+        elif parts and parts[-1] == "kv_offload":
+            child_serving_offload(*[int(x) for x in parts[:-1]])
         elif parts and parts[-1] == "speculative":
             child_serving_spec(*[int(x) for x in parts[:-1]])
         elif parts and parts[-1] == "multistep":

@@ -14,7 +14,15 @@ paged-decode kernel (`ops/pallas/paged_attention.py`):
                    manager), plus the PrefixCache (ISSUE 3): a
                    hash-indexed cache of full immutable KV pages shared
                    across requests with copy-on-write forking and
-                   LRU eviction of cached-free pages;
+                   LRU eviction of cached-free pages; plus the
+                   HostKVTier (ISSUE 10): pinned host-RAM page buffers
+                   under the device pool — preemption spills victims'
+                   pages to host (phase="offloaded") and prefix
+                   eviction demotes cached pages through evict_hook,
+                   so resume and re-match page bytes back in (async
+                   device_put ahead of the step, fence at read time)
+                   instead of recomputing, with recompute as the
+                   always-correct fallback;
   scheduler.py     FCFS continuous-batching scheduler with prefill/decode
                    phases, chunked prefill under a per-step token budget
                    (max_prefill_tokens_per_step), and youngest-first
@@ -107,8 +115,8 @@ from paddle_tpu.serving.engine import (  # noqa: F401
     naive_generate, sample_token,
 )
 from paddle_tpu.serving.kv_cache import (  # noqa: F401
-    BlockAllocator, KVCachePool, PrefixCache, SCRATCH_PAGE, SequenceKV,
-    page_content_hash, quantized_page_write,
+    BlockAllocator, HostKVTier, KVCachePool, OffloadRecord, PrefixCache,
+    SCRATCH_PAGE, SequenceKV, page_content_hash, quantized_page_write,
 )
 from paddle_tpu.serving.metrics import (  # noqa: F401
     Counter, EngineMetrics, Gauge, Histogram, aggregate_snapshots,
@@ -139,8 +147,9 @@ from paddle_tpu.parallel.compat import SpecLayout  # noqa: F401
 __all__ = [
     "BlockAllocator", "Counter", "EngineMetrics", "EngineReplica",
     "FCFSScheduler", "FaultInjector", "GPTRunner", "Gauge", "Histogram",
-    "InjectedDeviceError", "InvariantViolation", "KVCachePool",
-    "LlamaRunner", "NgramProposer", "PagedModelRunner", "PrefixCache",
+    "HostKVTier", "InjectedDeviceError", "InvariantViolation",
+    "KVCachePool", "LlamaRunner", "NgramProposer", "OffloadRecord",
+    "PagedModelRunner", "PrefixCache",
     "QueueFullError", "ReplicaCrashError", "Request", "RequestOutput",
     "RequestState", "RouterMetrics", "RouterOutput", "SCRATCH_PAGE",
     "SamplingParams", "SequenceKV", "ServingEngine", "ServingRouter",

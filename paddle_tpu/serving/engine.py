@@ -43,6 +43,16 @@ and reclaiming its pages, so the token streams are the s=1 streams
 verbatim; batches the horizon can't serve (temperature > 0, verify
 spans, prefill chunks in flight) fall back to the per-step path.
 
+With `host_tier_pages=N > 0` (ISSUE 10) preemption stops costing a
+re-prefill: victims spill their exclusively-owned KV pages to a pinned
+host-RAM tier (phase="offloaded") and prefix-cache evictions demote
+there too; resume and host-prefix hits restore by an async page-in —
+device_put issued a step AHEAD of the admission that maps the pages
+(queue-head prefetch at step end, `pagein_hidden_ratio`), scatter
+applied at the fence right after admission — with recompute as the
+fallback for every miss, so token streams are untouched by
+construction.
+
 The engine is deterministic end-to-end: FCFS admission, sorted-free-list
 pages, greedy (or seeded per-request) sampling, step-indexed sample keys
 that survive preemption. `naive_generate` is the scheduling oracle: the
@@ -209,6 +219,40 @@ class ServingEngine:
                            greedy acceptance is argmax equality, and
                            temperature > 0 compares the draft against
                            the request's seeded step-indexed sample.
+      host_tier_pages      tiered KV offload (ISSUE 10): capacity (in
+                           pages) of a pinned host-RAM tier under the
+                           device pool. Preemption then SPILLS the
+                           victim's exclusively-owned pages to host
+                           (phase="offloaded") instead of dropping
+                           them, and prefix-cache LRU eviction demotes
+                           cached pages to host; resume and host-prefix
+                           hits restore by an async page-in — the
+                           engine issues jax.device_put for the needed
+                           pages AHEAD of the step that reads them
+                           (prefetched while the previous step's
+                           compute runs) and only applies the scatter
+                           at fence time, so restore-after-preempt is
+                           O(bytes) copied instead of O(prefill)
+                           recomputed. Misses and tier-cap overflow
+                           fall back to the recompute path: token
+                           streams are untouched by construction
+                           (fp32 bit-exact; int8 restores the exact
+                           codes+scales, which recompute could not).
+                           0 = off (the pre-ISSUE-10 engine).
+      host_tier_headroom   knob-gated watermark credit (ISSUE 10): the
+                           admission watermark counts free host-tier
+                           slots as near-headroom, so the pool runs
+                           hotter — overflow degrades to a cheap
+                           spill/page-in instead of a recompute —
+                           raising sustainable concurrent sessions.
+      pagein_prefetch      how many queue-head offloaded requests get
+                           their host pages staged (device_put issued)
+                           at the END of each step, one step before
+                           the fence that will read them — the double
+                           buffer that makes the copy overlap decode
+                           (pagein_hidden_ratio measures it). 0
+                           disables prefetch (page-ins then stage at
+                           the fence itself).
       decode_horizon       multi-step decode (ISSUE 6): sync with the
                            host every `s` steps instead of every step.
                            A pure-greedy decode batch (no prefill
@@ -261,6 +305,9 @@ class ServingEngine:
                  nan_policy: str = "abort",
                  max_prefill_tokens_per_step: Optional[int] = None,
                  enable_prefix_cache: bool = False,
+                 host_tier_pages: int = 0,
+                 host_tier_headroom: bool = False,
+                 pagein_prefetch: int = 2,
                  ragged_batch: bool = False,
                  decode_horizon: int = 1,
                  num_speculative_tokens: int = 0,
@@ -305,6 +352,14 @@ class ServingEngine:
         self.enable_prefix_cache = bool(enable_prefix_cache)
         if self.enable_prefix_cache:
             self.pool.enable_prefix_cache()
+        if host_tier_pages < 0:
+            raise ValueError("host_tier_pages must be >= 0 (0 = no host "
+                             "tier)")
+        if pagein_prefetch < 0:
+            raise ValueError("pagein_prefetch must be >= 0")
+        self.host_tier_pages = int(host_tier_pages)
+        self.host_tier_headroom = bool(host_tier_headroom)
+        self.pagein_prefetch = int(pagein_prefetch)
         self.max_prefill_tokens_per_step = max_prefill_tokens_per_step
         self.ragged_batch = bool(ragged_batch)
         if decode_horizon < 1:
@@ -329,7 +384,9 @@ class ServingEngine:
         self.scheduler = FCFSScheduler(self.pool, max_batch_size,
                                        self.max_pages_per_seq,
                                        admission_watermark,
-                                       max_prefill_tokens_per_step)
+                                       max_prefill_tokens_per_step,
+                                       count_host_headroom=(
+                                           self.host_tier_headroom))
         self.max_batch_size = max_batch_size
         self.max_queue_depth = max_queue_depth
         self.shed_policy = shed_policy
@@ -350,6 +407,17 @@ class ServingEngine:
             self.pool.kv_bytes_reduction_x())
         self.metrics.sessions_per_pool_x.set(
             self.pool.kv_bytes_reduction_x())
+        # host-RAM KV tier (ISSUE 10): built after the metrics so the
+        # tier mirrors its spill/drop accounting straight into them
+        if self.host_tier_pages:
+            self.pool.enable_host_tier(self.host_tier_pages,
+                                       metrics=self.metrics)
+        # async page-in double buffer: (slot, generation) -> (step the
+        # device_put was issued, staged per-layer device arrays). The
+        # generation key makes a staged transfer self-invalidating when
+        # its slot is freed/reused before the fence consumes it.
+        self._pagein_staged: Dict[tuple, tuple] = {}
+        self._step_count = 0
         self._requests: Dict[str, Request] = {}
         self._outputs: Dict[str, RequestOutput] = {}
 
@@ -475,6 +543,92 @@ class ServingEngine:
         return self._resolve_token(req, step, am, fin,
                                    lambda: np.asarray(logits_row))
 
+    # ----------------------------------------- async page-in (ISSUE 10)
+
+    def _stage_slot(self, tier, slot):
+        """Issue the host->device transfer for one host-tier slot: one
+        jax.device_put over the slot's per-layer page arrays, through
+        the runner's staging hook when it has one (sharded runners
+        place the slice kv-head-sharded so the fence scatter never
+        reshards). Returns the staged device pytree; nothing blocks —
+        the transfer runs while the device keeps computing."""
+        data = tier.read_slot(slot)
+        stage = getattr(self.runner, "stage_host_pages", None)
+        if stage is not None:
+            return stage(data)
+        return jax.device_put(data)
+
+    def _fence_pagein(self, admitted: Sequence[Request]) -> None:
+        """Apply every pending page-in of this step's admissions to the
+        pools — THE fence: after this, the restored pages are ordinary
+        pool state that this step's prefill/decode reads. Prefetched
+        transfers (staged in an earlier step, keyed by (slot,
+        generation)) resolve here and count as HIDDEN — their copy had
+        a whole step of device compute to overlap; everything else
+        stages now. Consumed slots return to the tier."""
+        tier = self.pool.host_tier
+        pending = [r for r in admitted if r.pending_pagein]
+        if tier is None or not pending:
+            return
+        pages: List[int] = []
+        slots: List[int] = []
+        staged_list = []
+        hidden = 0
+        for req in pending:
+            for page, slot in req.pending_pagein:
+                entry = self._pagein_staged.pop(
+                    (slot, tier.generation(slot)), None)
+                if entry is not None:
+                    issued_step, staged = entry
+                    if issued_step < self._step_count:
+                        hidden += 1
+                else:
+                    staged = self._stage_slot(tier, slot)
+                pages.append(page)
+                slots.append(slot)
+                staged_list.append(staged)
+            req.pending_pagein = []
+        # stack per (layer, array) and scatter once — one functional
+        # pool update for the whole step's restores
+        layer_data = []
+        for li, layer in enumerate(self.pool.pools):
+            layer_data.append(tuple(
+                jnp.stack([s[li][j] for s in staged_list])
+                for j in range(len(layer))))
+        self.pool.write_pages(pages, layer_data)
+        tier.free_slots(slots)
+        self.metrics.pagein_pages.inc(len(pages))
+        if hidden:
+            self.metrics.pagein_hidden_pages.inc(hidden)
+
+    def _prefetch_pagein(self) -> None:
+        """Stage the host pages of the next `pagein_prefetch` offloaded
+        waiters at the END of a step — ahead of the admission that will
+        map them — so their host->device copies run while the device is
+        busy with this step's launches (the async double buffer). Best-
+        effort and safe by construction: a staged entry keyed by a slot
+        generation that moved on (the waiter was shed, the slot reused)
+        simply never resolves and is pruned here."""
+        tier = self.pool.host_tier
+        if tier is None or self.pagein_prefetch <= 0:
+            return
+        for key in list(self._pagein_staged):
+            slot, gen = key
+            if tier.generation(slot) != gen:
+                del self._pagein_staged[key]
+        seen = 0
+        for req in self.scheduler.waiting:
+            if seen >= self.pagein_prefetch:
+                break
+            if req.offload is None:
+                continue
+            seen += 1
+            for slot in req.offload.slots:
+                key = (slot, tier.generation(slot))
+                if key not in self._pagein_staged:
+                    self._pagein_staged[key] = (
+                        self._step_count, self._stage_slot(tier, slot))
+
     # ------------------------------------------------------------- step
 
     def step(self) -> List[TokenEvent]:
@@ -488,16 +642,27 @@ class ServingEngine:
         if not self.scheduler.has_work():
             return []
         self.metrics.mark_active()
+        self._step_count += 1
         events: List[TokenEvent] = []
 
         # 0. deadlines first: an expired request must not win admission
         self._expire_deadlines()
 
         # 1. admission: slot + pages (the longest cached prefix maps in
-        #    for free — those tokens never reach the prefill chunks)
-        for req in self.scheduler.admit():
-            if req.kv.num_tokens:
-                self.metrics.prefix_hit_tokens.inc(req.kv.num_tokens)
+        #    for free — those tokens never reach the prefill chunks;
+        #    host-restored coverage counts separately — those tokens are
+        #    paged-in bytes, not cache hits)
+        admitted = self.scheduler.admit()
+        for req in admitted:
+            if req.admit_prefix_tokens:
+                self.metrics.prefix_hit_tokens.inc(req.admit_prefix_tokens)
+        # 1b. page-in fence (ISSUE 10): every host-resident page an
+        #     admission mapped must be IN the pools before anything this
+        #     step computes reads it — prefetched transfers resolve here
+        #     (their copy overlapped the previous step), the rest stage
+        #     now; the scatter itself dispatches async like every other
+        #     pool write
+        self._fence_pagein(admitted)
 
         # 2-4. compute this step's spans. ragged_batch mode collapses the
         # chunk-then-decode sequencing: when the step has BOTH prefill
@@ -568,6 +733,14 @@ class ServingEngine:
         self.metrics.pool_utilization.set(self.pool.utilization())
         if self.pool.prefix_cache is not None:
             self.metrics.prefix_cached_pages.set(len(self.pool.prefix_cache))
+        tier = self.pool.host_tier
+        if tier is not None:
+            # stage the NEXT resumable requests' host pages while this
+            # step's compute is still in flight on the device — the
+            # double buffer the pagein_hidden_ratio metric measures
+            self._prefetch_pagein()
+            self.metrics.host_tier_bytes.set(tier.bytes_used)
+            self.metrics.host_tier_pages_used.set(tier.used_count)
         if self.audit:
             audit_engine(self)
         return events
@@ -1264,6 +1437,15 @@ class ServingEngine:
                 "max_prefill_tokens_per_step":
                     self.max_prefill_tokens_per_step,
                 "enable_prefix_cache": self.enable_prefix_cache,
+                # host-tier knobs ride along (ISSUE 10) so a restored
+                # engine keeps offloading — but host PAGES deliberately
+                # do not: they died with the crashed process (pinned
+                # host RAM has no crash story), so every restored
+                # request re-enters through the recompute path and the
+                # tier refills from fresh spills
+                "host_tier_pages": self.host_tier_pages,
+                "host_tier_headroom": self.host_tier_headroom,
+                "pagein_prefetch": self.pagein_prefetch,
                 "ragged_batch": self.ragged_batch,
                 "decode_horizon": self.decode_horizon,
                 "num_speculative_tokens": self.num_speculative_tokens,
@@ -1316,6 +1498,9 @@ class ServingEngine:
                   max_prefill_tokens_per_step=cfg.get(
                       "max_prefill_tokens_per_step"),
                   enable_prefix_cache=cfg.get("enable_prefix_cache", False),
+                  host_tier_pages=cfg.get("host_tier_pages", 0),
+                  host_tier_headroom=cfg.get("host_tier_headroom", False),
+                  pagein_prefetch=cfg.get("pagein_prefetch", 2),
                   ragged_batch=cfg.get("ragged_batch", False),
                   decode_horizon=cfg.get("decode_horizon", 1),
                   num_speculative_tokens=cfg.get("num_speculative_tokens", 0),

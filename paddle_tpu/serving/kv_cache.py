@@ -22,6 +22,21 @@ copy-on-write forked first, so a shared page is never mutated in place.
 Cached pages the cache alone still references (refcount 1) are evictable
 in LRU order when the free list runs dry.
 
+ISSUE 10 adds a HOST tier under the device pool: ``HostKVTier`` keeps
+pinned numpy page buffers mirroring the device layout (one buffer per
+layer per pool array — int8 code + scale pages ride along unchanged, so
+offload composes with ISSUE 9). Two spill paths feed it: youngest-first
+preemption spills the victim's exclusively-owned pages instead of
+dropping them (``Request.phase = "offloaded"``; restore becomes an
+O(bytes) copy instead of an O(prefill) recompute), and PrefixCache LRU
+eviction DEMOTES full cached pages through ``evict_hook`` before the
+device page is reclaimed (a later prefix match can then hit the
+host-resident page and page it back in). Spilled bytes are exactly the
+device bytes — page-in restores them bit-identically — so the engine's
+token streams are untouched by construction, and any miss (eviction
+hole, tier-cap overflow, crash) falls back to the existing
+recompute-on-resume path.
+
 ISSUE 9 adds quantized pools: ``KVCachePool(kv_dtype="int8")`` stores
 K/V pages as int8 codes plus a parallel SCALE pool — one fp32 scale per
 page per kv-head, the exact granularity the ragged kernel dequantizes
@@ -42,9 +57,11 @@ pools are byte-identical to the pre-ISSUE-9 layout.
 from __future__ import annotations
 
 from bisect import insort
-from typing import Dict, List, Optional, Sequence, Tuple
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
 SCRATCH_PAGE = 0
 
@@ -232,6 +249,14 @@ class PrefixCache:
         self.hit_pages = 0
         self.miss_pages = 0
         self.evictions = 0
+        # demotion intercept (ISSUE 10 satellite): called as
+        # hook(page, chain_hash, reason) for EVERY page leaving the
+        # index — reason "evict" on LRU reclaim, "clear" on clear() —
+        # while the page is still allocated and its content intact, so
+        # a host tier can copy it out without subclassing. clear() fires
+        # it too on purpose: a hook that only saw evictions would leak
+        # host-tier bookkeeping for every page dropped at teardown.
+        self.evict_hook: Optional[Callable[[int, int, str], None]] = None
 
     def __len__(self) -> int:
         return len(self._index)
@@ -264,6 +289,32 @@ class PrefixCache:
             prev = h
         self.hit_pages += len(out)
         return out
+
+    def match_tiered(self, tokens: Sequence[int]
+                     ) -> Tuple[List[Tuple[int, int]], List[int]]:
+        """match() extended into the host tier (ISSUE 10): after the
+        device index misses, the chain continues against the tier's
+        demoted-prefix index. Returns (device_matches, host_hashes) —
+        device matches are (hash, page) pairs exactly like match();
+        host hashes name host-resident pages the scheduler must fund a
+        fresh device page for and the engine must page in before the
+        step that reads them. Same strict cap as match(): the combined
+        prefix always leaves at least one token to compute."""
+        matched = self.match(tokens)
+        tier = self.pool.host_tier
+        host: List[int] = []
+        if tier is not None and tier.prefix_count:
+            limit = (len(tokens) - 1) // self.block_size
+            prev = matched[-1][0] if matched else _CHAIN_SEED
+            for i in range(len(matched), limit):
+                h = page_content_hash(
+                    prev,
+                    tokens[i * self.block_size:(i + 1) * self.block_size])
+                if not tier.has_prefix(h):
+                    break
+                host.append(h)
+                prev = h
+        return matched, host
 
     def acquire(self, matched: List[Tuple[int, int]]) -> None:
         """Pin a match() result for a sequence: one incref per page (and
@@ -298,10 +349,38 @@ class PrefixCache:
                 self._page_hash[page] = h
                 self.pool.allocator.incref(page)   # the cache's own ref
                 self._touch(page)
+                self._drop_host_duplicate(h)
             kv.hash_chain.append(h)
             kv.registered_pages += 1
             added += 1
         return added
+
+    def register_page(self, page: int, h: int) -> bool:
+        """Re-index an already-restored page under its chain hash — the
+        host-tier PROMOTION re-entry (ISSUE 10): a fresh device page
+        whose content the engine pages in from a demoted host copy joins
+        the index exactly as if its first writer had registered it.
+        First-writer-wins like register_seq; returns False if the hash
+        is already indexed (the page then stays private)."""
+        if h in self._index:
+            return False
+        self._index[h] = page
+        self._page_hash[page] = h
+        self.pool.allocator.incref(page)       # the cache's own ref
+        self._touch(page)
+        self._drop_host_duplicate(h)
+        return True
+
+    def _drop_host_duplicate(self, h: int) -> None:
+        """Keep chain hashes device-live XOR host-resident (the
+        auditor's tier invariant): when a recomputed sequence registers
+        a hash the host tier still mirrors — its page was demoted AFTER
+        this sequence's admission match, or sat past match()'s strict
+        cap — the freshly computed device page wins and the redundant
+        host copy is dropped."""
+        tier = self.pool.host_tier
+        if tier is not None and tier.has_prefix(h):
+            tier.free_slots([tier.promote(h)])
 
     # ---------------------------------------------------------- eviction
 
@@ -318,6 +397,11 @@ class PrefixCache:
                           if alloc.refcount(p) == 1),
                          key=lambda p: self._page_tick[p])[:n]
         for page in victims:
+            if self.evict_hook is not None:
+                # demotion intercept fires BEFORE the decref: the page is
+                # still allocated and its content intact, so the host
+                # tier can copy it out (ISSUE 10)
+                self.evict_hook(page, self._page_hash[page], "evict")
             self._unregister(page)
             alloc.decref(page)         # rc 1 -> 0: back to the free list
             self.evictions += 1
@@ -331,12 +415,258 @@ class PrefixCache:
     def clear(self) -> int:
         """Drop the whole index (the cache's references with it). Pages
         still mapped by running sequences stay live; cached-free pages
-        return to the free list. Used by snapshot/teardown paths."""
+        return to the free list. Used by snapshot/teardown paths.
+
+        Fires evict_hook(page, hash, "clear") for every dropped page —
+        the same intercept evict() fires (ISSUE 10 satellite): a host
+        tier that only saw LRU demotions would silently leak its
+        bookkeeping for pages dropped wholesale here."""
         pages = list(self._page_hash)
         for page in pages:
+            if self.evict_hook is not None:
+                self.evict_hook(page, self._page_hash[page], "clear")
             self._unregister(page)
             self.pool.allocator.decref(page)
         return len(pages)
+
+
+@dataclass
+class OffloadRecord:
+    """One preempted sequence's host-resident KV state (ISSUE 10).
+
+    `slots[j]` holds the host copy of the sequence's page index
+    `start_page + j`; token positions [0, covered_tokens) are restorable
+    from (prefix-cache pages for [0, start_page)) + (these slots). The
+    record rides `Request.offload` while the request waits with
+    phase="offloaded"; admission either connects it back to a matching
+    prefix (page-in resume) or drops it (recompute fallback)."""
+
+    start_page: int                        # first page index the slots cover
+    covered_tokens: int                    # positions [0, covered) restorable
+    slots: List[int] = field(default_factory=list)
+
+
+class HostKVTier:
+    """Host-RAM page tier under the device pool (ISSUE 10 tentpole).
+
+    Pinned numpy buffers mirror the device pool layout exactly: one
+    buffer per layer per pool array — fp32 pools spill (k, v) pages,
+    int8 pools spill (k_codes, v_codes, k_scale, v_scale) including the
+    scale rows, so a page-in is bit-identical to the spilled page on
+    either dtype (offload composes with ISSUE 9 by construction). Slots
+    are handed out lowest-id-first from a sorted free list, mirroring
+    the device BlockAllocator, so spill traces are deterministic.
+
+    Two populations share the buffers, each owned by exactly one party
+    (the auditor pins it):
+
+      offload slots  owned by one waiting request's OffloadRecord —
+                     preemption spilled its exclusively-owned pages;
+      prefix slots   owned by the tier's own hash index — PrefixCache
+                     LRU eviction / clear demoted a full cached page
+                     through `evict_hook`; a later tiered prefix match
+                     promotes it back onto a fresh device page.
+
+    A full tier never blocks anything: spill_pages copies as many pages
+    as fit and DROPS the rest (`host_tier_drops`), which degrades the
+    affected resume back to the existing recompute path — exactness is
+    therefore untouched by the cap. Every spilled slot records a
+    content hash over its bytes; the auditor spot-checks a rotating
+    sample so silent host-buffer corruption is caught, not served.
+    """
+
+    def __init__(self, pool: "KVCachePool", max_pages: int, metrics=None):
+        if max_pages < 1:
+            raise ValueError("host tier needs max_pages >= 1 (omit the "
+                             "tier entirely to disable offload)")
+        self.pool = pool
+        self.max_pages = int(max_pages)
+        self.metrics = metrics             # optional EngineMetrics mirror
+        # pinned host mirrors of the device pool layout, one buffer per
+        # (layer, pool-array): [max_pages, *page_shape] at the pool dtype
+        self._bufs: List[Tuple[np.ndarray, ...]] = [
+            tuple(np.zeros((self.max_pages,) + tuple(a.shape[1:]),
+                           np.dtype(str(a.dtype))) for a in layer)
+            for layer in pool.pools]
+        self._free: List[int] = list(range(self.max_pages))   # ascending
+        self._hash: Dict[int, int] = {}     # slot -> content hash (used set)
+        self._gen: Dict[int, int] = {}      # slot -> reuse generation
+        self._prefix: Dict[int, int] = {}   # chain hash -> slot
+        self._prefix_slot: Dict[int, int] = {}   # slot -> chain hash
+        # cumulative accounting (authoritative; the engine mirrors them
+        # into EngineMetrics when `metrics` is set)
+        self.spilled_pages = 0
+        self.paged_in_pages = 0
+        self.dropped_pages = 0              # spills a full tier refused
+        self.resumes = 0                    # page-in resumes served
+        self.fallbacks = 0                  # offload records dropped to
+        #                                     the recompute path
+
+    # ------------------------------------------------------- accounting
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return len(self._hash)
+
+    @property
+    def prefix_count(self) -> int:
+        return len(self._prefix)
+
+    @property
+    def bytes_used(self) -> int:
+        """Host bytes the used slots pin — same per-page cost as the
+        device pool (code + scale bytes on int8, ISSUE 9 honesty)."""
+        return self.used_count * self.pool.page_bytes()
+
+    @property
+    def capacity_bytes(self) -> int:
+        return self.max_pages * self.pool.page_bytes()
+
+    def generation(self, slot: int) -> int:
+        """Reuse generation of a slot — bumped on every free, so a
+        staged device_put keyed by (slot, generation) can never serve a
+        later tenant's bytes."""
+        return self._gen.get(slot, 0)
+
+    def slot_hash(self, slot: int) -> int:
+        return self._hash[slot]
+
+    def content_hash(self, slot: int) -> int:
+        """Deterministic hash over the slot's bytes across every layer
+        buffer — recorded at spill time, re-checked by the auditor."""
+        h = 0x9E3779B9
+        for layer in self._bufs:
+            for buf in layer:
+                h = hash((h, buf[slot].tobytes()))
+        return h
+
+    # ------------------------------------------------------------ spill
+
+    def spill_pages(self, device_pages: Sequence[int]) -> List[int]:
+        """Copy device pages into host slots (device->host sync copy —
+        the cost preemption pays ONCE instead of a full re-prefill
+        later). Takes as many as fit; the overflow is dropped and
+        counted, never an error. Returns the slots, aligned with the
+        leading device_pages they hold."""
+        n = min(len(device_pages), len(self._free))
+        dropped = len(device_pages) - n
+        if dropped:
+            self.dropped_pages += dropped
+            if self.metrics is not None:
+                self.metrics.host_tier_drops.inc(dropped)
+        if n == 0:
+            return []
+        slots = self._free[:n]
+        del self._free[:n]
+        data = self.pool.read_pages(list(device_pages)[:n])
+        for layer_bufs, layer_data in zip(self._bufs, data):
+            for buf, arr in zip(layer_bufs, layer_data):
+                buf[slots] = arr
+        for s in slots:
+            self._hash[s] = self.content_hash(s)
+        self.spilled_pages += n
+        if self.metrics is not None:
+            self.metrics.offload_spill_pages.inc(n)
+        return slots
+
+    def spill_sequence(self, kv: "SequenceKV",
+                       covered_tokens: int) -> Optional[OffloadRecord]:
+        """Spill a preemption victim's exclusively-owned pages (the ones
+        release() would send back to the free list) covering token
+        positions [registered_pages * bs, covered_tokens). Leading
+        registered pages stay on device inside the PrefixCache at
+        refcount 1 — they re-match at re-admission (or get demoted
+        through evict_hook and re-match from the host index). Returns
+        None when nothing spillable exists (then the existing recompute
+        path simply applies); a partial fit trims covered_tokens down
+        to the spilled page boundary."""
+        bs = self.pool.block_size
+        covered = min(int(covered_tokens), kv.num_tokens)
+        start = kv.registered_pages
+        end = -(-covered // bs) if covered > 0 else 0
+        if end <= start:
+            return None
+        cand = kv.pages[start:end]
+        alloc = self.pool.allocator
+        if any(alloc.refcount(p) != 1 for p in cand):
+            # a shared page past the registered range would break the
+            # record's contiguity — never expected (COW keeps writes
+            # private), so decline loudly-by-metrics rather than corrupt
+            self.fallbacks += 1
+            if self.metrics is not None:
+                self.metrics.offload_recompute_fallbacks.inc()
+            return None
+        slots = self.spill_pages(cand)
+        if not slots:
+            return None
+        if len(slots) < len(cand):
+            covered = (start + len(slots)) * bs
+        return OffloadRecord(start_page=start, covered_tokens=covered,
+                             slots=slots)
+
+    # -------------------------------------------- prefix demotion (hook)
+
+    def on_evict(self, page: int, chain_hash: int, reason: str) -> bool:
+        """PrefixCache.evict_hook target: demote a full cached page to
+        the host before the device page is reclaimed. Fires for both
+        LRU eviction and clear() — the clear-path hook is what keeps
+        teardown from silently leaking tier bookkeeping."""
+        if chain_hash in self._prefix:      # pragma: no cover — the
+            return False                    # index is hash-unique
+        slots = self.spill_pages([page])
+        if not slots:
+            return False                    # tier full: the page just dies
+        self._prefix[chain_hash] = slots[0]
+        self._prefix_slot[slots[0]] = chain_hash
+        return True
+
+    def has_prefix(self, h: int) -> bool:
+        return h in self._prefix
+
+    def promote(self, h: int) -> int:
+        """Claim a demoted prefix page for re-promotion: the hash leaves
+        the host index (device-live XOR host-resident — the auditor's
+        invariant), and the slot stays pinned until the engine's fence
+        pages it in and frees it."""
+        slot = self._prefix.pop(h)
+        del self._prefix_slot[slot]
+        return slot
+
+    # ---------------------------------------------------------- page-in
+
+    def read_slot(self, slot: int) -> List[Tuple[np.ndarray, ...]]:
+        """One slot's per-layer page arrays, COPIED (a device_put may
+        alias host memory on CPU backends; the copy makes slot reuse
+        safe while a staged transfer is still in flight)."""
+        return [tuple(np.array(buf[slot]) for buf in layer)
+                for layer in self._bufs]
+
+    def free_slots(self, slots: Sequence[int]) -> None:
+        """Return slots to the (sorted) free list, bumping each slot's
+        generation so stale staged transfers can never resolve."""
+        for s in slots:
+            if s not in self._hash:
+                raise ValueError(f"double free of host slot {s}")
+            del self._hash[s]
+            h = self._prefix_slot.pop(s, None)
+            if h is not None:               # dropped without promotion
+                del self._prefix[h]
+            self._gen[s] = self._gen.get(s, 0) + 1
+            insort(self._free, s)
+
+    def note_resume(self) -> None:
+        self.resumes += 1
+        if self.metrics is not None:
+            self.metrics.offload_resumes.inc()
+
+    def note_fallback(self) -> None:
+        self.fallbacks += 1
+        if self.metrics is not None:
+            self.metrics.offload_recompute_fallbacks.inc()
 
 
 class KVCachePool:
@@ -375,6 +705,7 @@ class KVCachePool:
         self.tp_size = 1
         self.allocator = BlockAllocator(num_blocks)
         self.prefix_cache: Optional[PrefixCache] = None
+        self.host_tier: Optional[HostKVTier] = None
         shape = (num_blocks, block_size, n_kv_heads, head_dim)
         sshape = (num_blocks, n_kv_heads)     # one scale per page per head
         if mesh is not None:
@@ -420,7 +751,45 @@ class KVCachePool:
         if self.prefix_cache is None:
             self.prefix_cache = PrefixCache(self)
             self.allocator.evictor = self.prefix_cache
+            if self.host_tier is not None:
+                self.prefix_cache.evict_hook = self.host_tier.on_evict
         return self.prefix_cache
+
+    def enable_host_tier(self, max_pages: int,
+                         metrics=None) -> HostKVTier:
+        """Turn on the host-RAM offload tier (ISSUE 10, idempotent):
+        preemption spills exclusively-owned pages to pinned host
+        buffers, and prefix-cache eviction demotes cached pages through
+        evict_hook instead of dropping them."""
+        if self.host_tier is None:
+            self.host_tier = HostKVTier(self, max_pages, metrics=metrics)
+            if self.prefix_cache is not None:
+                self.prefix_cache.evict_hook = self.host_tier.on_evict
+        return self.host_tier
+
+    def read_pages(self, pages: Sequence[int]
+                   ) -> List[Tuple[np.ndarray, ...]]:
+        """Host copies of the named device pages across every layer's
+        pool arrays — the device->host half of a spill. One gather per
+        pool array (sharded pools gather per shard under GSPMD), then
+        one blocking transfer."""
+        idx = jnp.asarray(list(pages), jnp.int32)
+        return [tuple(np.asarray(a[idx]) for a in layer)
+                for layer in self.pools]
+
+    def write_pages(self, pages: Sequence[int], layer_data) -> None:
+        """Scatter staged page contents into the named device pages —
+        the fence half of a page-in (ISSUE 10). `layer_data` mirrors
+        `pools`: per layer a tuple of [len(pages), *page_shape] arrays
+        (device-staged by the engine via runner.stage_host_pages, or
+        plain host arrays). Functional update like every other pool
+        write: jax dispatches the scatters asynchronously, so the call
+        itself never blocks."""
+        idx = jnp.asarray(list(pages), jnp.int32)
+        self.pools = [
+            tuple(a.at[idx].set(jnp.asarray(d).astype(a.dtype))
+                  for a, d in zip(layer, data))
+            for layer, data in zip(self.pools, layer_data)]
 
     def blocks_for_tokens(self, n_tokens: int) -> int:
         """Pages needed to hold n_tokens KV entries."""

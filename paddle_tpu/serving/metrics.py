@@ -92,6 +92,9 @@ SUMMABLE_KEYS = (
     "prefix_cached_pages", "attn_kv_bytes_read", "attn_kv_bytes_gather",
     "spec_proposed_tokens", "spec_accepted_tokens", "spec_rollback_pages",
     "host_syncs", "decode_horizon_steps", "horizon_overshoot_tokens",
+    "offload_spill_pages", "pagein_pages", "pagein_hidden_pages",
+    "offload_resumes", "offload_recompute_fallbacks", "host_tier_drops",
+    "host_tier_bytes",
     "decode_steps", "queue_depth", "running", "pool_used_pages",
 )
 
@@ -118,6 +121,9 @@ def aggregate_snapshots(snaps) -> Dict[str, float]:
     prop = out["spec_proposed_tokens"]
     out["spec_acceptance_rate"] = (out["spec_accepted_tokens"] / prop
                                    if prop > 0 else 0.0)
+    pin = out["pagein_pages"]
+    out["pagein_hidden_ratio"] = (out["pagein_hidden_pages"] / pin
+                                  if pin > 0 else 0.0)
     out["steps_per_token"] = out["decode_steps"] / toks if toks > 0 else 0.0
     out["host_syncs_per_token"] = out["host_syncs"] / toks if toks > 0 \
         else 0.0
@@ -176,6 +182,25 @@ class EngineMetrics:
         self.host_syncs = Counter("host_syncs")
         self.decode_horizon_steps = Counter("decode_horizon_steps")
         self.horizon_overshoot_tokens = Counter("horizon_overshoot_tokens")
+        # tiered KV offload (ISSUE 10): offload_spill_pages counts device
+        # pages copied to the host tier (preemption spills AND prefix
+        # demotions), pagein_pages counts pages restored to device, and
+        # pagein_hidden_pages the subset whose device_put was issued in
+        # an EARLIER engine step than the fence that consumed it — i.e.
+        # the host->device copy had a whole step of device compute to
+        # hide behind (pagein_hidden_ratio is the overlap headline).
+        # offload_resumes / offload_recompute_fallbacks split resumed
+        # requests by path; host_tier_drops counts spills a full tier
+        # refused (those resumes degrade to recompute, exactness kept).
+        self.offload_spill_pages = Counter("offload_spill_pages")
+        self.pagein_pages = Counter("pagein_pages")
+        self.pagein_hidden_pages = Counter("pagein_hidden_pages")
+        self.offload_resumes = Counter("offload_resumes")
+        self.offload_recompute_fallbacks = Counter(
+            "offload_recompute_fallbacks")
+        self.host_tier_drops = Counter("host_tier_drops")
+        self.host_tier_bytes = Gauge("host_tier_bytes")
+        self.host_tier_pages_used = Gauge("host_tier_pages_used")
         self.decode_steps = Counter("decode_steps")
         self.queue_depth = Gauge("queue_depth")
         self.running = Gauge("running")
@@ -225,6 +250,14 @@ class EngineMetrics:
         p = self.spec_proposed_tokens.value
         return self.spec_accepted_tokens.value / p if p > 0 else 0.0
 
+    def pagein_hidden_ratio(self) -> float:
+        """Fraction of paged-in pages whose host->device transfer was
+        issued at least one engine step before the fence that read them
+        (ISSUE 10) — the overlap the async double-buffered page-in
+        exists to create. 0.0 when nothing paged in."""
+        p = self.pagein_pages.value
+        return self.pagein_hidden_pages.value / p if p > 0 else 0.0
+
     def host_syncs_per_token(self) -> float:
         """Blocking device->host drains per generated token (ISSUE 6) —
         1.0 on the per-step loop, ~1/s with decode_horizon=s."""
@@ -267,6 +300,16 @@ class EngineMetrics:
             "host_syncs_per_token": self.host_syncs_per_token(),
             "decode_horizon_steps": self.decode_horizon_steps.value,
             "horizon_overshoot_tokens": self.horizon_overshoot_tokens.value,
+            "offload_spill_pages": self.offload_spill_pages.value,
+            "pagein_pages": self.pagein_pages.value,
+            "pagein_hidden_pages": self.pagein_hidden_pages.value,
+            "pagein_hidden_ratio": self.pagein_hidden_ratio(),
+            "offload_resumes": self.offload_resumes.value,
+            "offload_recompute_fallbacks":
+                self.offload_recompute_fallbacks.value,
+            "host_tier_drops": self.host_tier_drops.value,
+            "host_tier_bytes": self.host_tier_bytes.value,
+            "host_tier_pages_used": self.host_tier_pages_used.value,
             "decode_steps": self.decode_steps.value,
             "queue_depth": self.queue_depth.value,
             "queue_depth_peak": self.queue_depth.peak,

@@ -453,6 +453,24 @@ class PagedModelRunner:
         out = tuple(jax.lax.with_sharding_constraint(x, sh) for x in xs)
         return out if len(out) > 1 else out[0]
 
+    def stage_host_pages(self, layer_data):
+        """Stage one host-tier KV page onto the device AHEAD of the step
+        that reads it (ISSUE 10 page-in hook): `layer_data` is the
+        HostKVTier slot layout — per layer a tuple of page arrays
+        ([block, n_kv, d] K/V, plus [n_kv] scale rows on int8 pools).
+        One jax.device_put per page, issued at prefetch/fence time so
+        the host->device copy overlaps whatever the device is running;
+        the engine's fence later scatters the staged values into the
+        pools. On a sharded runner the slices land kv-head-sharded like
+        the pools themselves, so the fence scatter never reshards."""
+        if self.mesh is None:
+            return jax.device_put(layer_data)
+        kv = NamedSharding(self.mesh, P(None, self.model_axis, None))
+        sc = NamedSharding(self.mesh, P(self.model_axis))
+        return [tuple(jax.device_put(a, kv if np.ndim(a) == 3 else sc)
+                      for a in layer)
+                for layer in layer_data]
+
     def _stage(self, *host_arrays):
         """Stage host operands for a sharded call (ISSUE 7 satellite):
         ONE jax.device_put of the whole tuple with a replicated

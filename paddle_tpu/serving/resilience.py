@@ -385,6 +385,85 @@ def audit_engine(engine) -> None:
                         f"{pool.num_blocks} pages sharded only on the "
                         "kv-head axis")
 
+    # -- host KV tier (ISSUE 10): every page is device-live XOR host-
+    #    resident XOR free. Host-slot accounting mirrors the device
+    #    allocator's (free/used partition, single ownership: one
+    #    OffloadRecord or the tier's own prefix index per slot), a chain
+    #    hash may be indexed on at most ONE tier, and a rotating sample
+    #    of spilled slots is content-hash spot-checked so a corrupted
+    #    host buffer is caught before it is ever paged back in.
+    tier = getattr(engine.pool, "host_tier", None)
+    if tier is not None:
+        hfree, hused = list(tier._free), set(tier._hash)
+        hfset = set(hfree)
+        if len(hfree) != len(hfset):
+            problems.append("duplicate slots in the host tier free list")
+        if hfset & hused:
+            problems.append(
+                f"host slots both free and used: {sorted(hfset & hused)}")
+        if (hfset | hused) != set(range(tier.max_pages)):
+            problems.append(
+                "host tier slot accounting broken: "
+                f"lost={sorted(set(range(tier.max_pages)) - hfset - hused)}")
+        slot_owner: dict = {}
+        for req in sched.waiting:
+            off = getattr(req, "offload", None)
+            if off is not None:
+                if req.phase != "offloaded":
+                    problems.append(
+                        f"{req.request_id} holds an offload record but "
+                        f"phase={req.phase!r}")
+                for s in off.slots:
+                    slot_owner[s] = slot_owner.get(s, 0) + 1
+            elif req.phase == "offloaded":
+                problems.append(f"{req.request_id} phase 'offloaded' "
+                                "without an offload record")
+        for req in sched.running:
+            if getattr(req, "offload", None) is not None:
+                problems.append(f"{req.request_id} RUNNING with an "
+                                "offload record")
+            if getattr(req, "pending_pagein", None):
+                problems.append(f"{req.request_id} pending page-ins "
+                                "survived the step fence")
+        dupes = sorted(s for s, c in slot_owner.items() if c > 1)
+        if dupes:
+            problems.append(f"host slots owned by two requests: {dupes}")
+        pslots = set(tier._prefix.values())
+        if len(pslots) != len(tier._prefix):
+            problems.append("host tier prefix index maps two hashes to "
+                            "one slot")
+        if {s: h for h, s in tier._prefix.items()} != tier._prefix_slot:
+            problems.append("host tier prefix index and reverse map "
+                            "disagree")
+        overlap = set(slot_owner) & pslots
+        if overlap:
+            problems.append("host slots owned by a request AND the "
+                            f"prefix index: {sorted(overlap)}")
+        orphans = hused - set(slot_owner) - pslots
+        if orphans:
+            problems.append(f"host slots used but unowned: "
+                            f"{sorted(orphans)}")
+        unbacked = (set(slot_owner) | pslots) - hused
+        if unbacked:
+            problems.append(f"host slots owned but not marked used: "
+                            f"{sorted(unbacked)}")
+        if cache is not None:
+            both = set(cache._index) & set(tier._prefix)
+            if both:
+                problems.append(f"{len(both)} prefix hashes resident on "
+                                "device AND host (XOR violated)")
+        sample = sorted(hused)
+        if sample:
+            # rotating window keyed by the step counter: over a run the
+            # spot check sweeps the whole tier, each audit stays O(4)
+            start = int(engine.metrics.decode_steps.value) % len(sample)
+            for i in range(min(4, len(sample))):
+                s = sample[(start + i) % len(sample)]
+                if tier.content_hash(s) != tier._hash[s]:
+                    problems.append(
+                        f"host slot {s} content-hash mismatch — spilled "
+                        "bytes corrupted in the host buffer")
+
     # -- slot accounting -------------------------------------------------
     slots = [r.slot for r in sched.running]
     if any(s is None for s in slots):

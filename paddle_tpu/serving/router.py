@@ -11,7 +11,13 @@ finally maps the serving mesh's idle data axis onto replicas) driven by
 a dedicated worker thread, and exposes the same submit / abort /
 outputs surface.
 
-Routing is PREFIX-AFFINITY first: the router hashes each request's
+Routing is SESSION-STICKY first (ISSUE 10 satellite): a request whose
+`SamplingParams.session_id` names a session the router has seen before
+goes straight back to the replica that served it — multi-turn chat
+keeps landing where the session's KV pages (device prefix cache AND
+host tier) already live, ahead of any content hashing
+(`RouterMetrics.session_sticky_hits`). Then PREFIX-AFFINITY: the
+router hashes each request's
 page-aligned token-prefix chain with the exact content-hash scheme the
 PrefixCache indexes pages by (`kv_cache.page_content_hash` over the
 same chain seed), remembers which replica last served each chain hash,
@@ -136,6 +142,10 @@ class RouterMetrics:
     def __init__(self, clock: Optional[Callable[[], float]] = None):
         self.clock = clock or time.monotonic
         self.requests_routed = Counter("requests_routed")
+        # multi-turn stickiness (ISSUE 10 satellite): requests whose
+        # session_id re-routed to the replica that served the session's
+        # previous turn, ahead of prefix-affinity
+        self.session_sticky_hits = Counter("session_sticky_hits")
         self.routed_affinity = Counter("routed_affinity")
         self.routed_least_loaded = Counter("routed_least_loaded")
         self.routed_round_robin = Counter("routed_round_robin")
@@ -161,7 +171,8 @@ class RouterMetrics:
 
     def snapshot(self) -> Dict[str, float]:
         out = {c.name: c.value for c in (
-            self.requests_routed, self.routed_affinity,
+            self.requests_routed, self.session_sticky_hits,
+            self.routed_affinity,
             self.routed_least_loaded, self.routed_round_robin,
             self.routed_random, self.shed_reroutes, self.tier_rejections,
             self.tier_overflow, self.requests_completed,
@@ -259,6 +270,7 @@ class ServingRouter:
         self._completion = threading.Event()
         self._reqs: Dict[str, _RequestRecord] = {}
         self._affinity: Dict[int, int] = {}      # chain hash -> replica
+        self._sessions: Dict[str, int] = {}      # session_id -> replica
         self._retired_metrics: List[Dict[str, float]] = []
         self._epochs = itertools.count()
         self._rr = itertools.count()
@@ -304,8 +316,10 @@ class ServingRouter:
             else:
                 self._replicas[idx] = rep
                 # the old replica's cached pages died with its pool: any
-                # affinity pointing there is stale
+                # affinity (or session pin) pointing there is stale
                 self._affinity = {h: i for h, i in self._affinity.items()
+                                  if i != idx}
+                self._sessions = {s: i for s, i in self._sessions.items()
                                   if i != idx}
             self.metrics.live_replicas.set(
                 sum(1 for r in self._replicas if r.status == "live"))
@@ -454,19 +468,30 @@ class ServingRouter:
             return True
         return rep.engine.scheduler.queue_depth < self.max_queue_depth
 
-    def _choose(self, chain: Sequence[int]) -> Tuple[EngineReplica, str]:
+    def _choose(self, chain: Sequence[int],
+                session_id: Optional[str] = None
+                ) -> Tuple[EngineReplica, str]:
         with self._lock:
             live = [r for r in self._replicas if r.status == "live"]
             if not live:
                 raise RuntimeError("no live replicas")
             first, how = None, None
             if self._policy == "prefix":
-                for h in reversed(chain):
-                    idx = self._affinity.get(h)
+                # session stickiness outranks content affinity (ISSUE 10
+                # satellite): a repeat turn goes where the session's KV
+                # pages — prefix cache AND host tier — already live
+                if session_id is not None:
+                    idx = self._sessions.get(session_id)
                     if idx is not None \
                             and self._replicas[idx].status == "live":
-                        first, how = self._replicas[idx], "affinity"
-                        break
+                        first, how = self._replicas[idx], "session"
+                if first is None:
+                    for h in reversed(chain):
+                        idx = self._affinity.get(h)
+                        if idx is not None \
+                                and self._replicas[idx].status == "live":
+                            first, how = self._replicas[idx], "affinity"
+                            break
             elif self._policy == "round_robin":
                 first, how = live[next(self._rr) % len(live)], "round_robin"
             elif self._policy == "random":
@@ -474,8 +499,8 @@ class ServingRouter:
                 how = "random"
         if first is not None and self._has_capacity(first):
             return first, how
-        if how == "affinity" and first is not None:
-            # hot affinity target: shed to a sibling, don't reject
+        if how in ("affinity", "session") and first is not None:
+            # hot affinity/session target: shed to a sibling, don't reject
             self.metrics.shed_reroutes.inc()
         ordered = sorted(live, key=lambda r: (self._load(r), r.index))
         for rep in ordered:
@@ -509,7 +534,7 @@ class ServingRouter:
                                      "submitted")
         chain = self._affinity_chain(prompt)
         for _ in range(len(self._replicas) + 2):
-            rep, how = self._choose(chain)
+            rep, how = self._choose(chain, sampling.session_id)
             with rep.lock:
                 if rep.fenced or rep.status != "live":
                     continue           # died between choose and lock
@@ -523,13 +548,16 @@ class ServingRouter:
                     self._reqs[rid] = rec
                     for h in chain:
                         self._affinity[h] = rep.index
+                    if sampling.session_id is not None:
+                        self._sessions[sampling.session_id] = rep.index
                 # a drop_oldest overflow may have shed a sibling request
                 # synchronously inside add_request — record it now
                 self._collect(rep)
                 rep.last_beat = max(rep.last_beat, self._clock())
             self.metrics.requests_routed.inc()
             if how != "overflow":      # tier_overflow counted in _choose
-                {"affinity": self.metrics.routed_affinity,
+                {"session": self.metrics.session_sticky_hits,
+                 "affinity": self.metrics.routed_affinity,
                  "round_robin": self.metrics.routed_round_robin,
                  "random": self.metrics.routed_random,
                  }.get(how, self.metrics.routed_least_loaded).inc()
@@ -611,6 +639,9 @@ class ServingRouter:
             rec.replicas.append(rep.index)
             for h in self._affinity_chain(state["prompt_tokens"]):
                 self._affinity[h] = rep.index
+            sid = getattr(rec.sampling, "session_id", None)
+            if sid is not None:      # the session follows its request
+                self._sessions[sid] = rep.index
         self.metrics.resubmitted_requests.inc()
         rep.wake.set()
 

@@ -37,6 +37,23 @@ horizon degrades, never thrashes: when the free list or the admission
 watermark can't fund the extra pages, `s` is trimmed down (to 1 in the
 worst case) instead of preempting anyone — preemption stays the
 exclusive business of `reserve_decode()`, which must have run first.
+
+ISSUE 10 tiers the preemption story: with the pool's HostKVTier on,
+`_preempt` SPILLS the victim's exclusively-owned pages to pinned host
+buffers instead of just dropping them (the request waits with
+phase="offloaded" and an OffloadRecord), and `admit()` plans the
+resume: the tiered prefix match (device pages free, host-demoted pages
+staged for page-in) is connected to the offload record's page range,
+fresh device pages are allocated for everything host-resident, and the
+engine pages the bytes in before the step that reads them — restore
+becomes an O(bytes) copy instead of an O(prefill) recompute. Any hole
+(evicted-and-dropped prefix page, tier cap overflow, crash) falls back
+to the existing recompute-on-resume path, so token exactness is
+untouched by construction. `count_host_headroom=True` additionally
+lets the admission watermark treat free host-tier slots as
+near-headroom: growth overflow now degrades to a cheap spill/page-in
+round-trip rather than a full recompute, so the same pool sustains
+more concurrent sessions.
 """
 
 from __future__ import annotations
@@ -47,7 +64,9 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
-from paddle_tpu.serving.kv_cache import KVCachePool, SequenceKV
+from paddle_tpu.serving.kv_cache import (
+    KVCachePool, OffloadRecord, SequenceKV,
+)
 
 
 @dataclass
@@ -62,6 +81,11 @@ class SamplingParams:
     seed: Optional[int] = None        # None -> derived from request id
     stop_token_ids: Tuple[int, ...] = ()
     timeout_s: Optional[float] = None   # deadline from arrival; None = never
+    # multi-turn chat affinity (ISSUE 10 satellite): the router pins
+    # every request carrying the same session_id to one replica AHEAD of
+    # prefix-affinity, so repeat turns land where the session's KV pages
+    # (device prefix cache + host tier) already live. None = stateless.
+    session_id: Optional[str] = None
 
     def __post_init__(self):
         if self.max_tokens < 1:
@@ -111,6 +135,15 @@ class Request:                # requests by object, never by field value
     # rescue without the row (nan_policy="greedy"): the next engine step
     # takes the per-step path once, which refetches real logits
     defer_horizon: bool = False
+    # host-tier state (ISSUE 10): while WAITING with phase="offloaded",
+    # `offload` names the host slots holding this request's spilled KV;
+    # admission converts it into `pending_pagein` (device page, host
+    # slot) pairs the engine's fence restores before this step's
+    # compute, and stamps the admit_* token splits for the metrics
+    offload: Optional[OffloadRecord] = None
+    pending_pagein: List[Tuple[int, int]] = field(default_factory=list)
+    admit_prefix_tokens: int = 0
+    admit_pagein_tokens: int = 0
     admission_index: int = -1              # set fresh at every admission
     num_preemptions: int = 0
     arrival_time: float = 0.0
@@ -142,7 +175,8 @@ class FCFSScheduler:
 
     def __init__(self, pool: KVCachePool, max_batch_size: int,
                  max_pages_per_seq: int, admission_watermark: float = 1.0,
-                 max_prefill_tokens_per_step: Optional[int] = None):
+                 max_prefill_tokens_per_step: Optional[int] = None,
+                 count_host_headroom: bool = False):
         if max_pages_per_seq > pool.allocator.num_usable:
             raise ValueError(
                 f"max_pages_per_seq={max_pages_per_seq} exceeds the pool's "
@@ -164,6 +198,11 @@ class FCFSScheduler:
         # overload then degrades throughput instead of thrashing preemptions
         self._watermark_pages = int(admission_watermark
                                     * pool.allocator.num_usable)
+        # knob-gated (ISSUE 10): free host-tier slots count as NEAR-
+        # headroom above the watermark — overflow then degrades to a
+        # spill/page-in round-trip instead of a recompute, so admission
+        # can afford to run the pool hotter
+        self.count_host_headroom = bool(count_host_headroom)
         self.waiting: Deque[Request] = deque()
         self.running: List[Request] = []     # kept in admission order
         self._admission_counter = itertools.count()
@@ -184,6 +223,18 @@ class FCFSScheduler:
 
     # --------------------------------------------------------- admission
 
+    def _effective_watermark(self) -> int:
+        """The admission high watermark in pages. With the host tier on
+        and `count_host_headroom` set, free host slots count as NEAR-
+        headroom (capped at the pool size): running the pool past the
+        bare watermark is now safe-ish because a growth overflow spills
+        to host and pages back in instead of recomputing (ISSUE 10)."""
+        wm = self._watermark_pages
+        tier = self.pool.host_tier
+        if tier is not None and self.count_host_headroom:
+            wm = min(self.pool.allocator.num_usable, wm + tier.free_count)
+        return wm
+
     def admit(self) -> List[Request]:
         """Admit queue-head requests while a slot and enough pages exist
         for their full context PLUS one decode token (so every admitted
@@ -195,10 +246,22 @@ class FCFSScheduler:
         page-aligned prefix of the request's context is mapped (shared,
         increfed) into its block table before the remainder is allocated
         — those tokens are already live KV, so prefill starts after them
-        and the pool only has to fund the unmatched tail."""
+        and the pool only has to fund the unmatched tail.
+
+        With the HostKVTier enabled (ISSUE 10) the match extends into
+        the host: demoted prefix pages and the request's own
+        OffloadRecord map onto FRESH device pages whose contents the
+        engine pages in before this step's compute (`pending_pagein`),
+        so a preempted request resumes by copy instead of recompute.
+        The offload record must CONNECT to the matched prefix (its
+        start_page covered by device+host matches); a hole — an evicted
+        prefix page the tier dropped, a partial spill, a crash — falls
+        back to the recompute path, exactness untouched."""
         admitted: List[Request] = []
         alloc = self.pool.allocator
         cache = self.pool.prefix_cache
+        tier = self.pool.host_tier
+        bs = self.pool.block_size
         while self.waiting and self._free_slots:
             req = self.waiting[0]
             need = self.pool.blocks_for_tokens(req.num_context + 1)
@@ -206,7 +269,11 @@ class FCFSScheduler:
                 raise ValueError(
                     f"request {req.request_id} needs {need} pages > "
                     f"max_pages_per_seq={self.max_pages_per_seq}")
-            matched = cache.match(req.context_tokens) if cache else []
+            if cache is not None:
+                matched, host_matched = cache.match_tiered(
+                    req.context_tokens)
+            else:
+                matched, host_matched = [], []
             if matched:
                 # pin the match BEFORE any allocation: an incref lifts
                 # the pages above refcount 1, so eviction (which alloc
@@ -217,7 +284,8 @@ class FCFSScheduler:
             # are reclaimable, so they count as headroom, not pressure
             used_live = (alloc.num_usable - alloc.num_free
                          - alloc.num_evictable)
-            over_watermark = (used_live + need_new > self._watermark_pages
+            over_watermark = (used_live + need_new
+                              > self._effective_watermark()
                               and (self.running or admitted))
             if not alloc.can_alloc(need_new) or over_watermark:
                 if matched:
@@ -229,7 +297,49 @@ class FCFSScheduler:
             self.waiting.popleft()
             req.kv = SequenceKV(self.pool)
             if matched:
-                req.kv.adopt_prefix(matched, self.pool.block_size)
+                req.kv.adopt_prefix(matched, bs)
+            # host-demoted prefix pages: a fresh device page per hash,
+            # content restored by the engine's fence before this step's
+            # compute; the page re-enters the device index (promotion)
+            for h in host_matched:
+                page = alloc.alloc(1)[0]
+                slot = tier.promote(h)
+                cache.register_page(page, h)
+                req.kv.pages.append(page)
+                req.kv.hash_chain.append(h)
+                req.kv.registered_pages += 1
+                req.kv.num_tokens = len(req.kv.pages) * bs
+                req.pending_pagein.append((page, slot))
+            req.admit_prefix_tokens = req.kv.num_tokens
+            req.admit_pagein_tokens = 0
+            m_total = len(matched) + len(host_matched)
+            off, req.offload = req.offload, None
+            if off is not None and tier is not None:
+                connected = (m_total >= off.start_page
+                             and off.covered_tokens > req.kv.num_tokens)
+                if connected:
+                    for j, slot in enumerate(off.slots):
+                        idx = off.start_page + j
+                        if idx < m_total:
+                            # the prefix match already covers this page
+                            # (same tokens -> same KV); the host copy is
+                            # redundant — drop it
+                            tier.free_slots([slot])
+                            continue
+                        page = alloc.alloc(1)[0]
+                        req.kv.pages.append(page)
+                        req.pending_pagein.append((page, slot))
+                    req.admit_pagein_tokens = (off.covered_tokens
+                                               - req.kv.num_tokens)
+                    req.kv.num_tokens = off.covered_tokens
+                    tier.note_resume()
+                else:
+                    # recompute fallback: a hole in the restorable prefix
+                    # (or the prefix match already covers everything) —
+                    # release the host copies and re-prefill as before
+                    tier.free_slots(off.slots)
+                    if m_total < off.start_page:
+                        tier.note_fallback()
             req.kv.grow(req.num_context + 1 - req.kv.num_tokens)
             req.slot = self._free_slots.pop(0)
             req.admission_index = next(self._admission_counter)
@@ -342,7 +452,7 @@ class FCFSScheduler:
             used_live = (alloc.num_usable - alloc.num_free
                          - alloc.num_evictable)
             if (alloc.can_alloc(short)
-                    and used_live + short <= self._watermark_pages):
+                    and used_live + short <= self._effective_watermark()):
                 break
             s -= 1
         if s > 1:
@@ -382,19 +492,46 @@ class FCFSScheduler:
         return victims
 
     def _preempt(self, req: Request) -> None:
+        tier = self.pool.host_tier
+        if tier is not None and req.kv is not None:
+            # spill the victim's exclusively-owned pages to host BEFORE
+            # release() sends them back to the free list (ISSUE 10):
+            # resume then restores them by copy instead of recompute.
+            # Coverage is clamped to context-1 so the resumed request
+            # always has at least one token to compute (admission's
+            # first-token guarantee, and the logits it samples from).
+            covered = min(req.kv.num_tokens, req.num_context - 1)
+            req.offload = tier.spill_sequence(req.kv, covered)
         req.kv.release()
         req.kv = None
         self._release_slot(req)
         self.running.remove(req)
         req.state = RequestState.WAITING
+        if req.offload is not None:
+            req.phase = "offloaded"
         req.num_preemptions += 1
+
+    def _drop_offload(self, req: Request) -> None:
+        """Release a request's host-tier state (abort/timeout/shed/
+        extract of an offloaded waiter): the slots return to the tier,
+        the request reverts to a plain recompute-on-resume waiter."""
+        if req.offload is not None:
+            tier = self.pool.host_tier
+            if tier is not None:
+                tier.free_slots(req.offload.slots)
+            req.offload = None
+            if req.phase == "offloaded":
+                req.phase = "prefill"
 
     # ---------------------------------------------------------- finish
 
     def remove_waiting(self, req: Request) -> None:
         """Drop a queued (never-admitted or preempted) request — the
-        deadline/abort/shed path. Holds no pages or slot by invariant."""
+        deadline/abort/shed path. Holds no device pages or slot by
+        invariant; host-tier slots (an offloaded waiter) are released
+        here so a shed request never pins host memory."""
         self.waiting.remove(req)      # identity match (Request is eq=False)
+        self._drop_offload(req)
 
     def finish(self, req: Request, reason: str) -> None:
         req.kv.release()

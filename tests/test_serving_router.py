@@ -130,6 +130,56 @@ def test_affinity_routes_same_tenant_to_same_replica():
         router.drain(timeout_s=30)
 
 
+def test_session_stickiness_routes_repeat_turns_home():
+    """ISSUE 10 satellite: SamplingParams.session_id pins repeat turns
+    to the replica that served the session, AHEAD of prefix affinity —
+    even when the turns share no token prefix at all (multi-turn chat
+    whose context diverges per turn)."""
+    with make_router(enable_prefix_cache=True) as router:
+        rid0 = router.submit([7, 8, 9], SamplingParams(
+            max_tokens=2, session_id="chat-a"))
+        home = router._reqs[rid0].owner_idx
+        for k in range(4):
+            # disjoint prompts: prefix affinity alone could not pin these
+            rid = router.submit([10 + 3 * k, 11 + 3 * k],
+                                SamplingParams(max_tokens=2,
+                                               session_id="chat-a"))
+            assert router._reqs[rid].owner_idx == home
+        assert router.metrics.session_sticky_hits.value == 4
+        assert router.metrics.snapshot()["session_sticky_hits"] == 4
+        # a different session is free to land elsewhere; stickiness must
+        # not leak across session ids
+        router.submit([1, 2], SamplingParams(max_tokens=2,
+                                             session_id="chat-b"))
+        assert router.metrics.session_sticky_hits.value == 4
+        outs = router.drain(timeout_s=30)
+        audit_router(router)
+        assert all(o.finish_reason == "length" for o in outs.values())
+
+
+def test_session_pin_purged_when_replica_restarts():
+    """A restarted replica's pool lost the session's pages: the pin is
+    purged with the affinity entries, and the next turn re-pins to
+    wherever it lands."""
+    with make_router(enable_prefix_cache=True) as router:
+        rid = router.submit([5, 6, 7], SamplingParams(
+            max_tokens=2, session_id="chat-x"))
+        home = router._reqs[rid].owner_idx
+        router.drain(timeout_s=30)
+        assert router._sessions["chat-x"] == home
+        router.kill_replica(home)
+        deadline = time.monotonic() + 30
+        while (router._replicas[home].status != "live"
+               and time.monotonic() < deadline):
+            router.supervisor.poll()
+            time.sleep(0.01)
+        assert "chat-x" not in router._sessions
+        rid2 = router.submit([5, 6, 7], SamplingParams(
+            max_tokens=2, session_id="chat-x"))
+        assert router._sessions["chat-x"] == router._reqs[rid2].owner_idx
+        router.drain(timeout_s=30)
+
+
 def test_prefix_affinity_hit_rate_beats_random_and_matches_single():
     prompts = tenant_workload(20, seed=5)
     sp = SamplingParams(max_tokens=4)

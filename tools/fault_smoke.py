@@ -88,6 +88,19 @@ config — determinism and retry-exactness stay hard-pinned while the
 accuracy gate vs fp32 lives in tests/bench. Records add
 kv_bytes_reduction_x / sessions_per_pool_x.
 
+ISSUE 10: `--offload [N]` (N defaults to 64 host pages) drills every
+fault class with the TIERED KV host offload on: preemption victims
+spill their pages to pinned host buffers (phase="offloaded") and
+resume by async page-in instead of recompute, prefix-cache evictions
+demote to the host index, and the armed auditor additionally checks
+host-slot accounting, single ownership, device-XOR-host residency, and
+content-hash spot checks of spilled bytes. A seventh class
+`preempt_storm` joins the drill: a deliberately tight pool (barely
+above one sequence's worth) under a 2x request load churns
+preempt/spill/page-in continuously — it must stay token-exact vs the
+oracle with zero device OR host leaks. Records add the offload
+counters (spill/page-in/hidden-ratio/resumes/fallbacks/drops).
+
 ISSUE 5: `--speculate [K]` (K defaults to 4) drills every fault class
 with speculative decoding ON: decode rides n-gram verify spans through
 the full-logits ragged call — the same decode-op fault schedules now
@@ -124,6 +137,8 @@ def build_engine(runner, args, **kw):
     kw.setdefault("ragged_batch", args.ragged_batch)
     kw.setdefault("num_speculative_tokens", args.speculate)
     kw.setdefault("decode_horizon", args.decode_horizon)
+    kw.setdefault("host_tier_pages", args.offload)
+    kw.setdefault("host_tier_headroom", args.offload > 0)
     return ServingEngine(runner, **kw)
 
 
@@ -155,11 +170,16 @@ def run_class(fault: str, runner, args) -> dict:
     if fault == "overload":
         engine_kw.update(max_queue_depth=max(2, args.requests // 4),
                          shed_policy="drop_oldest")
+    if fault == "preempt_storm":
+        # barely more than one sequence's worth of pool (ISSUE 10): the
+        # running set churns preempt/spill/page-in on nearly every step
+        pages_per_seq = -(-args.max_model_len // args.block_size)
+        engine_kw["num_blocks"] = min(args.num_blocks, pages_per_seq + 2)
     eng = build_engine(target, args, **engine_kw)
 
     rng = np.random.default_rng(0)
     vocab = runner.vocab_size
-    n = args.requests * (2 if fault == "overload" else 1)
+    n = args.requests * (2 if fault in ("overload", "preempt_storm") else 1)
     # half the workload shares a common header: with the prefix cache on,
     # every fault class also exercises shared-page refcounts + COW paths
     header = list(rng.integers(1, vocab, 9))
@@ -195,10 +215,16 @@ def run_class(fault: str, runner, args) -> dict:
     eng.release_prefix_cache()      # cached-free pages back to the pool
     leaks_ok = eng.pool.allocator.check_no_leaks()
     slots_ok = sorted(eng.scheduler._free_slots) == list(range(args.max_batch))
+    # host tier (ISSUE 10): after the drain, every surviving host slot
+    # must belong to the tier's own prefix index (clear() demotions) —
+    # an orphan slot is a host-RAM leak
+    tier = eng.pool.host_tier
+    host_ok = (tier is None
+               or set(tier._hash) == set(tier._prefix.values()))
 
     oracle_ok = True
     quantized = (args.kv_dtype != "fp32" or args.weight_dtype != "fp32")
-    if fault in ("none", "device_error"):
+    if fault in ("none", "device_error", "preempt_storm"):
         if quantized:
             # int8 pools: chunked prefill legitimately changes int8
             # rounding vs the naive monolithic prefill, so the pin is a
@@ -226,12 +252,20 @@ def run_class(fault: str, runner, args) -> dict:
                     oracle_ok = False
                     break
 
-    ok = (crashed is None and leaks_ok and slots_ok and oracle_ok
-          and len(outs) == n
+    ok = (crashed is None and leaks_ok and slots_ok and host_ok
+          and oracle_ok and len(outs) == n
           and all(o.finish_reason for o in outs.values()))
     return {
         "fault": fault, "ok": ok, "requests": n,
         "tp": getattr(runner, "tp_size", 1),
+        "host_tier_pages": args.offload,
+        "host_slots_leaked": not host_ok,
+        "offload_spill_pages": m["offload_spill_pages"],
+        "pagein_pages": m["pagein_pages"],
+        "pagein_hidden_ratio": m["pagein_hidden_ratio"],
+        "offload_resumes": m["offload_resumes"],
+        "offload_recompute_fallbacks": m["offload_recompute_fallbacks"],
+        "host_tier_drops": m["host_tier_drops"],
         "kv_dtype": args.kv_dtype, "weight_dtype": args.weight_dtype,
         "kv_bytes_reduction_x": m["kv_bytes_reduction_x"],
         "sessions_per_pool_x": m["sessions_per_pool_x"],
@@ -416,6 +450,13 @@ def main() -> int:
                          "tokens per verify span (bare flag: K=4; "
                          "default: off) — half the prompts become "
                          "periodic so proposals fire")
+    ap.add_argument("--offload", type=int, nargs="?", const=64, default=0,
+                    metavar="N",
+                    help="tiered KV host offload (ISSUE 10): an N-page "
+                         "pinned host tier under the pool (bare flag: "
+                         "N=64; default: off) — preemption spills / "
+                         "async page-in resume, watermark headroom on, "
+                         "and the extra preempt_storm drill class")
     ap.add_argument("--decode-horizon", type=int, default=1, metavar="N",
                     help="multi-step decode: sync with the host every N "
                          "steps on pure-greedy decode batches "
@@ -496,11 +537,14 @@ def main() -> int:
         print(f"\nfault smoke (router x{args.router}): "
               f"{'ALL RECOVERED' if all_ok else 'FAILURES'}")
         return 0 if all_ok else 1
-    for fault in args.faults.split(","):
-        fault = fault.strip()
-        if fault not in FAULTS:
+    classes = [f.strip() for f in args.faults.split(",")]
+    if args.offload and args.faults == ",".join(FAULTS):
+        # the host tier on: the default drill gains the preempt storm
+        classes.append("preempt_storm")
+    for fault in classes:
+        if fault not in FAULTS + ("preempt_storm",):
             raise SystemExit(f"unknown fault class {fault!r}; "
-                             f"choose from {FAULTS}")
+                             f"choose from {FAULTS + ('preempt_storm',)}")
         rec = run_class(fault, runner, args)
         all_ok &= rec["ok"]
         print(json.dumps(rec))
