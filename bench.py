@@ -968,6 +968,114 @@ def child_serving_multistep(layers: int, hidden: int, max_batch: int,
                                        else 0.0)})
 
 
+def child_serving_zero_bubble(layers: int, hidden: int, max_batch: int,
+                              requests: int, prompt: int, gen: int,
+                              vocab: int):
+    """Zero-bubble engine-loop rung (ISSUE 11): the multistep workload
+    (decode_horizon=8) with MIXED per-request budgets (half gen, half
+    gen/2 — stops land mid-horizon) swept over four arms:
+
+      s8_baseline     the PR-6 half-duplex loop (plan blocks on drain)
+      s8_pipelined    + pipelined: host plans step N+1 under step N's
+                      in-flight launch (planned_ahead_steps,
+                      device_idle_fraction are the structural numbers)
+      s8_early_stop   + horizon_early_stop: the on-device done bit —
+                      horizon_overshoot_tokens must go to ~0 and the
+                      host_syncs_per_token <= 0.15 acceptance reads
+                      off this arm
+      s8_sampled      temperature=0.8 seeded on EVERY request with
+                      horizon_sampling: the workload that used to pay
+                      ~1 sync/token (per-step fallback) now rides
+                      horizons bit-exactly
+
+    Each arm commits tokens/s, host_syncs_per_token,
+    device_idle_fraction, planned_ahead_steps, and overshoot tokens;
+    the parent derives overshoot_saved = baseline - early_stop."""
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.serving import GPTRunner, SamplingParams, ServingEngine
+
+    backend = jax.default_backend()
+    paddle.seed(0)
+    max_len = prompt + gen
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+                    num_heads=max(hidden // 64, 1), max_seq_len=max_len,
+                    dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+    block_size = min(16, max_len)
+    runner = GPTRunner(model, block_size=block_size, max_model_len=max_len)
+    pages_per_seq = -(-max_len // block_size)
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(0, vocab, prompt)) for _ in range(requests)]
+    budgets = [gen if i % 2 == 0 else max(2, gen // 2)
+               for i in range(requests)]
+
+    def run_once(name: str, sampled: bool = False, **kw) -> dict:
+        eng = ServingEngine(runner,
+                            num_blocks=max_batch * pages_per_seq + 1,
+                            max_batch_size=max_batch, max_model_len=max_len,
+                            decode_horizon=8, **kw)
+        t0 = time.time()
+        for i, p in enumerate(prompts):
+            sp = SamplingParams(
+                max_tokens=budgets[i],
+                temperature=0.8 if sampled else 0.0,
+                seed=1000 + i if sampled else None)
+            eng.add_request(p, sp, request_id=f"r{i}")
+        eng.run()
+        wall = time.time() - t0
+        snap = eng.metrics.snapshot()
+        return {"arm": name, "wall_s": round(wall, 3),
+                "tokens_per_sec": snap["tokens_generated"] / wall,
+                "tokens_generated": snap["tokens_generated"],
+                "host_syncs": snap["host_syncs"],
+                "host_syncs_per_token": snap["host_syncs_per_token"],
+                "device_idle_fraction": snap["device_idle_fraction"],
+                "planned_ahead_steps": snap["planned_ahead_steps"],
+                "host_plan_seconds": round(snap["host_plan_seconds"], 4),
+                "overlapped_plan_seconds":
+                    round(snap["overlapped_plan_seconds"], 4),
+                "drain_wait_seconds":
+                    round(snap["drain_wait_seconds"], 4),
+                "decode_horizon_steps": snap["decode_horizon_steps"],
+                "horizon_overshoot_tokens":
+                    snap["horizon_overshoot_tokens"]}
+
+    arms_spec = [
+        ("s8_baseline", False, {}),
+        ("s8_pipelined", False, {"pipelined": True}),
+        ("s8_early_stop", False, {"pipelined": True,
+                                  "horizon_early_stop": True}),
+        ("s8_sampled", True, {"pipelined": True,
+                              "horizon_early_stop": True,
+                              "horizon_sampling": True}),
+    ]
+    for name, sampled, kw in arms_spec:      # warmup/compile pass
+        run_once(name, sampled, **kw)
+    arms = [run_once(name, sampled, **kw)
+            for name, sampled, kw in arms_spec]
+    base, early = arms[0], arms[2]
+    _write_child({"backend": backend, "layers": layers, "hidden": hidden,
+                  "max_batch": max_batch, "requests": requests,
+                  "prompt": prompt, "gen": gen, "workload": "zero_bubble",
+                  "arms": arms,
+                  "overshoot_tokens_saved":
+                      base["horizon_overshoot_tokens"]
+                      - early["horizon_overshoot_tokens"],
+                  "idle_fraction_drop":
+                      round(base["device_idle_fraction"]
+                            - early["device_idle_fraction"], 4),
+                  "tokens_per_sec_x": (early["tokens_per_sec"]
+                                       / base["tokens_per_sec"]
+                                       if base["tokens_per_sec"]
+                                       else 0.0)})
+
+
 def child_serving_tp(layers: int, hidden: int, max_batch: int,
                      requests: int, prompt: int, gen: int, vocab: int):
     """Tensor-parallel serving rung (ISSUE 7): the same closed-batch
@@ -1637,6 +1745,38 @@ def main():
                 f"({r['host_syncs_reduction_x']:.1f}x fewer), tokens/s "
                 f"{r['tokens_per_sec_x']:.2f}x at s=8")
 
+    # zero-bubble rung (ISSUE 11): pipelined-off vs -on vs +early-stop
+    # (plus the sampled-horizon arm) on the multistep workload — the
+    # device_idle_fraction drop and overshoot-tokens-saved numbers are
+    # structural (CPU-countable); the tokens/s multiplier is the one to
+    # watch on a real tunnel, where the host-planning interval is pure
+    # device idle time
+    if on_tpu and remaining() > 120:
+        r = run_child("serving:6:512:4:8:64:96:32768:zero_bubble",
+                      min(900, remaining()))
+        if r is not None:
+            for arm in r["arms"]:
+                line = {"metric": f"serving_zero_bubble_{arm['arm']}"
+                                  "_tokens_per_sec",
+                        "value": round(arm["tokens_per_sec"], 1),
+                        "unit": "tokens/s", "vs_baseline": 0.0,
+                        "host_syncs_per_token":
+                            round(arm["host_syncs_per_token"], 4),
+                        "device_idle_fraction":
+                            round(arm["device_idle_fraction"], 4),
+                        "planned_ahead_steps": arm["planned_ahead_steps"],
+                        "horizon_overshoot_tokens":
+                            arm["horizon_overshoot_tokens"],
+                        "backend": r["backend"]}
+                emit(line)
+                _cache_result(line)
+            log(f"zero-bubble rung: idle fraction "
+                f"{r['arms'][0]['device_idle_fraction']:.3f} -> "
+                f"{r['arms'][2]['device_idle_fraction']:.3f}, overshoot "
+                f"saved {r['overshoot_tokens_saved']:.0f} tokens, "
+                f"syncs/token {r['arms'][2]['host_syncs_per_token']:.3f}, "
+                f"tokens/s {r['tokens_per_sec_x']:.2f}x")
+
     # tensor-parallel serving rung (ISSUE 7): mesh-shape sweep — the
     # carried-over "committed on-TPU sharded number" lands here the
     # first healthy tunnel window. On a single-chip tunnel only the
@@ -1759,6 +1899,8 @@ def _child_main(mode: str) -> None:
             child_serving_spec(*[int(x) for x in parts[:-1]])
         elif parts and parts[-1] == "multistep":
             child_serving_multistep(*[int(x) for x in parts[:-1]])
+        elif parts and parts[-1] == "zero_bubble":
+            child_serving_zero_bubble(*[int(x) for x in parts[:-1]])
         elif parts and parts[-1] == "tp":
             child_serving_tp(*[int(x) for x in parts[:-1]])
         elif parts and parts[-1] == "router":

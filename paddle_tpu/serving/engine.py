@@ -53,6 +53,20 @@ applied at the fence right after admission — with recompute as the
 fallback for every miss, so token streams are untouched by
 construction.
 
+With `pipelined=True` (ISSUE 11) the loop itself stops costing device
+time: step() plans step N+1 (deadline expiry, admission, chunk slicing,
+prefix matching, page-in staging — pure host work) while step N's
+decode/horizon launch is still executing on device, commits N's drained
+buffer through the standard replay, and only then dispatches N+1 —
+jax's async dispatch makes the whole thing a scheduling reorder with
+ONE launch in flight, measured by `planned_ahead_steps` and the
+`device_idle_fraction` proxy. `horizon_sampling=True` widens horizons
+to temperature > 0 (per-request seeded key schedules inside the
+decode_multi scan, bit-identical to the per-step streams) and
+`horizon_early_stop=True` adds an on-device per-row done bit
+(stop-token/budget hit freezes the row's KV writes and marks the
+drained tail dead), so overshoot is neither computed nor replayed.
+
 The engine is deterministic end-to-end: FCFS admission, sorted-free-list
 pages, greedy (or seeded per-request) sampling, step-indexed sample keys
 that survive preemption. `naive_generate` is the scheduling oracle: the
@@ -127,6 +141,21 @@ class RequestOutput:
     e2e_s: Optional[float] = None
 
 
+def seeded_sample(logits_row, seed: int, step: int, temperature: float,
+                  top_k, top_p) -> int:
+    """THE host-side seeded sampler (temperature > 0): one [V] row drawn
+    with fold_in(key(seed), step). The in-scan horizon sampler
+    (model_runner._sampled_rows, ISSUE 11) and the test stubs reproduce
+    exactly this math, which is what makes temperature>0 horizons
+    bit-identical to the per-step streams."""
+    from paddle_tpu.models.generation import _sample
+
+    key = jax.random.fold_in(jax.random.key(int(seed)), int(step))
+    tok = _sample(jnp.asarray(logits_row)[None], key, temperature,
+                  top_k, top_p)
+    return int(np.asarray(tok)[0])
+
+
 def sample_token(logits_row: np.ndarray, sampling: SamplingParams,
                  step: int, fallback_seed: int) -> int:
     """Sample the next token from one [V] logits row, host-side.
@@ -135,13 +164,9 @@ def sample_token(logits_row: np.ndarray, sampling: SamplingParams,
     so a preempted request resumes the identical sample stream."""
     if sampling.temperature == 0.0:
         return int(np.argmax(logits_row))
-    from paddle_tpu.models.generation import _sample
-
     seed = sampling.seed if sampling.seed is not None else fallback_seed
-    key = jax.random.fold_in(jax.random.key(seed), step)
-    tok = _sample(jnp.asarray(logits_row)[None], key, sampling.temperature,
-                  sampling.top_k, sampling.top_p)
-    return int(np.asarray(tok)[0])
+    return seeded_sample(logits_row, seed, step, sampling.temperature,
+                         sampling.top_k, sampling.top_p)
 
 
 def _to_host(x) -> np.ndarray:
@@ -169,6 +194,22 @@ def greedy_grid(logits):
         [jnp.argmax(logits, axis=-1).astype(jnp.int32),
          jnp.all(jnp.isfinite(logits), axis=-1).astype(jnp.int32)]))
     return packed[0], packed[1].astype(bool)
+
+
+@dataclass
+class _InflightLaunch:
+    """One dispatched-but-undrained device launch (the pipelined loop's
+    unit of deferred work, ISSUE 11). `batch` pins (request, slot) pairs
+    as of launch time — a member aborted/expired before the commit is
+    skipped at replay; `prev_pools` is the functional pool snapshot the
+    launch consumed, kept so a drain-time device error can roll back and
+    rerun the step through the normal retry path."""
+
+    kind: str                    # "decode" | "decode_multi"
+    batch: list                  # [(Request, slot), ...] at launch
+    result: object               # logits [B, V] or packed [2|3, B, s]
+    prev_pools: list             # pool snapshot for drain-failure rollback
+    s: int = 1                   # horizon length (decode_multi)
 
 
 class ServingEngine:
@@ -275,8 +316,62 @@ class ServingEngine:
                            reclaimed (horizon_overshoot_tokens).
                            Default 1 = today's per-step loop, bit-
                            exact. Batches that can't ride a horizon
-                           (temperature > 0, verify spans, chunks in
-                           flight) fall back to the per-step path.
+                           (temperature > 0 with horizon_sampling off,
+                           verify spans, chunks in flight) fall back to
+                           the per-step path.
+      pipelined            zero-bubble engine loop (ISSUE 11 tentpole):
+                           step() splits into a PLAN phase (deadline
+                           expiry, admission, chunk slicing, prefix
+                           matching, page-in staging — pure host work,
+                           run against a scheduler snapshot while the
+                           PREVIOUS step's decode launch is still
+                           executing on device) and a COMMIT phase
+                           (drain + replay of that in-flight launch),
+                           after which this step's decode/horizon
+                           launch is dispatched and left in flight.
+                           jax's async dispatch makes this a
+                           scheduling reorder, not a threading change:
+                           one launch is in flight at a time, pool
+                           updates stay functional (dataflow orders
+                           every later write after the launch), and
+                           the drained buffer replays through exactly
+                           the per-step bookkeeping — token streams
+                           are the unpipelined streams verbatim, only
+                           the streaming surface shifts one step (a
+                           step returns the PREVIOUS launch's tokens;
+                           run()/has_work() drain the tail). Off by
+                           default: pipelining changes step timing and
+                           the events-per-step trace, never tokens.
+      horizon_sampling     widen decode horizons to temperature > 0
+                           (ISSUE 11): per-request seeded key
+                           schedules ride INSIDE the decode_multi scan
+                           (fold_in(key(seed), generated-token index)
+                           — the naive_generate keys), so a sampled
+                           batch runs device-resident horizons
+                           bit-identically to the per-step seeded
+                           streams. Batches whose sampled rows mix
+                           (top_k, top_p) configs still take the
+                           per-step path (those are static per jit
+                           entry). Off by default.
+      horizon_early_stop   on-device stop flag (ISSUE 11): each
+                           horizon row carries its stop-token set and
+                           remaining-token budget into the scan; a hit
+                           sets a per-row done bit that freezes the
+                           row's KV writes (masked to scratch) and
+                           marks every later drained token dead, so
+                           overshoot past a stop is neither computed
+                           into the pools nor replayed
+                           (horizon_overshoot_tokens -> ~0), and the
+                           scheduler funds only min(s, remaining)
+                           pages per row. Off by default.
+      spill_async          threaded spill I/O (ISSUE 11 satellite):
+                           preemption's device->host page copy runs on
+                           a worker thread against the immutable
+                           functional pool snapshot instead of
+                           blocking the engine loop on one np.asarray
+                           per spilled page; every consumer of the
+                           spilled bytes joins the copy first. Off by
+                           default.
       spec_max_ngram /     suffix n-gram lengths the draft proposer
       spec_min_ngram       matches (longest first, most recent wins)
       tokenizer            optional tokenizer (id_to_bytes(tok) or
@@ -310,6 +405,10 @@ class ServingEngine:
                  pagein_prefetch: int = 2,
                  ragged_batch: bool = False,
                  decode_horizon: int = 1,
+                 pipelined: bool = False,
+                 horizon_sampling: bool = False,
+                 horizon_early_stop: bool = False,
+                 spill_async: bool = False,
                  num_speculative_tokens: int = 0,
                  spec_max_ngram: int = 3,
                  spec_min_ngram: int = 1,
@@ -366,6 +465,14 @@ class ServingEngine:
             raise ValueError("decode_horizon must be >= 1 (1 = sync with "
                              "the host every step)")
         self.decode_horizon = int(decode_horizon)
+        self.pipelined = bool(pipelined)
+        self.horizon_sampling = bool(horizon_sampling)
+        self.horizon_early_stop = bool(horizon_early_stop)
+        self.spill_async = bool(spill_async)
+        # the pipelined loop's single in-flight launch (ISSUE 11):
+        # dispatched at the end of one step, drained + replayed at the
+        # next step's commit phase (or by flush())
+        self._inflight: Optional[_InflightLaunch] = None
         if num_speculative_tokens < 0:
             raise ValueError("num_speculative_tokens must be >= 0 (0 = "
                              "speculation off)")
@@ -411,7 +518,8 @@ class ServingEngine:
         # tier mirrors its spill/drop accounting straight into them
         if self.host_tier_pages:
             self.pool.enable_host_tier(self.host_tier_pages,
-                                       metrics=self.metrics)
+                                       metrics=self.metrics,
+                                       async_spill=self.spill_async)
         # async page-in double buffer: (slot, generation) -> (step the
         # device_put was issued, staged per-layer device arrays). The
         # generation key makes a staged transfer self-invalidating when
@@ -465,7 +573,19 @@ class ServingEngine:
         return True
 
     def has_work(self) -> bool:
-        return self.scheduler.has_work()
+        # an in-flight launch IS work: the pipelined loop's last horizon
+        # still needs its commit step even after the queue drains
+        return self.scheduler.has_work() or self._inflight is not None
+
+    def _timed_drain(self, fn):
+        """Run one blocking device->host drain, charging its wall time
+        to drain_wait_seconds — the 'host blocked on device' share of
+        the step-time split the zero-bubble bench commits."""
+        t0 = self.metrics.clock()
+        try:
+            return fn()
+        finally:
+            self.metrics.drain_wait_seconds.inc(self.metrics.clock() - t0)
 
     # ------------------------------------------------- failure plumbing
 
@@ -639,11 +759,16 @@ class ServingEngine:
         Returns the tokens produced this step (streaming surface). Load-
         and fault-induced failures never escape: they end requests with
         an explicit finish_reason."""
-        if not self.scheduler.has_work():
+        if not self.has_work():
             return []
         self.metrics.mark_active()
         self._step_count += 1
+        t0 = self.metrics.clock()
         events: List[TokenEvent] = []
+
+        # ---- PLAN phase (pure host work; with `pipelined` this runs
+        # while the PREVIOUS step's launch is still executing on device
+        # — jax's async dispatch means nothing below blocks on it)
 
         # 0. deadlines first: an expired request must not win admission
         self._expire_deadlines()
@@ -651,18 +776,22 @@ class ServingEngine:
         # 1. admission: slot + pages (the longest cached prefix maps in
         #    for free — those tokens never reach the prefill chunks;
         #    host-restored coverage counts separately — those tokens are
-        #    paged-in bytes, not cache hits)
+        #    paged-in bytes, not cache hits). Planning against a
+        #    scheduler snapshot that predates the in-flight launch's
+        #    tokens is safe: the commit only ever FREES resources
+        #    (finish/stop), so a plan made here is at worst conservative
         admitted = self.scheduler.admit()
         for req in admitted:
             if req.admit_prefix_tokens:
                 self.metrics.prefix_hit_tokens.inc(req.admit_prefix_tokens)
-        # 1b. page-in fence (ISSUE 10): every host-resident page an
-        #     admission mapped must be IN the pools before anything this
-        #     step computes reads it — prefetched transfers resolve here
-        #     (their copy overlapped the previous step), the rest stage
-        #     now; the scatter itself dispatches async like every other
-        #     pool write
-        self._fence_pagein(admitted)
+        if not self.pipelined:
+            # 1b. page-in fence (ISSUE 10): every host-resident page an
+            #     admission mapped must be IN the pools before anything
+            #     this step computes reads it — prefetched transfers
+            #     resolve here (their copy overlapped the previous
+            #     step), the rest stage now; the scatter itself
+            #     dispatches async like every other pool write
+            self._fence_pagein(admitted)
 
         # 2-4. compute this step's spans. ragged_batch mode collapses the
         # chunk-then-decode sequencing: when the step has BOTH prefill
@@ -682,6 +811,28 @@ class ServingEngine:
         # Chunks fuse into the same launch under ragged_batch, otherwise
         # they keep the sequential chunk-then-decode sequencing.
         plan = self.scheduler.prefill_plan()
+        t_plan = self.metrics.clock() - t0
+        self.metrics.host_plan_seconds.inc(t_plan)
+        if self._inflight is not None:
+            # the whole planning interval above ran under an in-flight
+            # launch — host time the device no longer waits for (the
+            # zero-bubble overlap the planned_ahead_steps counter and
+            # device_idle_fraction gauge measure)
+            self.metrics.planned_ahead_steps.inc()
+            self.metrics.overlapped_plan_seconds.inc(t_plan)
+        if self.pipelined:
+            # ---- COMMIT phase: drain + replay the previous step's
+            # launch (stop/length/NaN handling, page release — all the
+            # per-step bookkeeping, one step deferred), THEN apply the
+            # page-in fence: the fence's pool writes must stay on the
+            # committed side so a drain-failure rollback to the
+            # pre-launch pools can never lose them
+            events.extend(self._commit_inflight())
+            self._fence_pagein(admitted)
+            # a commit quarantine can end a planned request; drop it
+            plan = [(r, a, b) for r, a, b in plan if not r.done]
+
+        # ---- EXECUTE phase: this step's launches
         fused = bool(self.ragged_batch and plan
                      and self.scheduler.decode_ready())
         if self.num_speculative_tokens > 0 and self.scheduler.decode_ready():
@@ -715,9 +866,11 @@ class ServingEngine:
             if self.scheduler.running:
                 s = self._plan_horizon(chunks_in_flight=bool(plan))
                 if s > 1:
-                    events.extend(self._decode_multi_with_recovery(s))
+                    events.extend(self._decode_multi_with_recovery(
+                        s, defer=self.pipelined))
                 else:
-                    events.extend(self._decode_with_recovery())
+                    events.extend(self._decode_with_recovery(
+                        defer=self.pipelined))
         self.metrics.decode_steps.inc()
 
         # bookkeeping gauges
@@ -741,6 +894,15 @@ class ServingEngine:
             self._prefetch_pagein()
             self.metrics.host_tier_bytes.set(tier.bytes_used)
             self.metrics.host_tier_pages_used.set(tier.used_count)
+        self.metrics.step_seconds.inc(self.metrics.clock() - t0)
+        tot = self.metrics.step_seconds.value
+        blocked = (self.metrics.drain_wait_seconds.value
+                   + self.metrics.overlapped_plan_seconds.value)
+        # host-derived zero-bubble proxy: loop time during which the
+        # host was neither blocked on a drain nor planning under an
+        # in-flight launch — i.e. time the device plausibly waited
+        self.metrics.device_idle_fraction.set(
+            max(0.0, 1.0 - min(blocked / tot, 1.0)) if tot > 0 else 0.0)
         if self.audit:
             audit_engine(self)
         return events
@@ -1033,8 +1195,30 @@ class ServingEngine:
             if r.defer_horizon:
                 r.defer_horizon = False
                 deferred = True
-        if deferred or any(r.sampling.temperature != 0.0 for r in batch):
+        if deferred:
             return 1
+        sampled = [r for r in batch if r.sampling.temperature != 0.0]
+        if sampled:
+            if not self.horizon_sampling:
+                return 1
+            # in-scan seeded sampling (ISSUE 11) bakes ONE (top_k,
+            # top_p) pair per jit entry and carries seeds as int32;
+            # batches outside that envelope take the per-step path
+            if len({(r.sampling.top_k, r.sampling.top_p)
+                    for r in sampled}) > 1:
+                return 1
+            if any((r.sampling.seed if r.sampling.seed is not None
+                    else r.arrival_index) >= 2 ** 31 for r in sampled):
+                return 1
+        if self.horizon_early_stop:
+            # rows self-freeze on device at their own stop/budget, so
+            # only the LONGEST row's remaining budget caps s, and each
+            # row funds pages for just min(s, its remaining) tokens
+            rem = {r: self._row_remaining(r) for r in batch}
+            s = min(s, max(rem.values()))
+            if s <= 1:
+                return 1
+            return self.scheduler.plan_decode_horizon(s, row_caps=rem)
         s = min(s, max(r.sampling.max_tokens - len(r.output_tokens)
                        for r in batch))
         s = min(s, min(self.max_model_len - r.num_context + 1
@@ -1043,7 +1227,57 @@ class ServingEngine:
             return 1
         return self.scheduler.plan_decode_horizon(s)
 
-    def _decode_multi_with_recovery(self, s: int) -> List[TokenEvent]:
+    def _row_remaining(self, req: Request) -> int:
+        """Tokens this request may still emit before a length finish or
+        the model-length wall — the on-device early-stop budget and the
+        per-row page-funding cap (ISSUE 11)."""
+        return min(req.sampling.max_tokens - len(req.output_tokens),
+                   self.max_model_len - req.num_context + 1)
+
+    def _horizon_ctx(self, batch: List[Request], s: int) -> dict:
+        """Extension operands for one decode_multi launch (ISSUE 11):
+        the per-row seeded key schedule (horizon_sampling — seeds,
+        generated-token base indices, temperatures, plus the batch's
+        single static (top_k, top_p)) and the on-device stop state
+        (horizon_early_stop — -1-padded stop-token sets and
+        remaining-token budgets). Empty dict = the classic pure-greedy
+        [2, B, s] scan."""
+        sampling = any(r.sampling.temperature != 0.0 for r in batch)
+        if not (sampling or self.horizon_early_stop):
+            return {}
+        B = self.max_batch_size
+        ctx: dict = {}
+        if sampling:
+            seeds = np.zeros((B,), np.int32)
+            base = np.zeros((B,), np.int32)
+            temps = np.zeros((B,), np.float32)
+            top_k = top_p = None
+            for r in batch:
+                sp = r.sampling
+                sl = r.slot
+                seeds[sl] = (sp.seed if sp.seed is not None
+                             else r.arrival_index)
+                base[sl] = len(r.output_tokens)
+                temps[sl] = sp.temperature
+                if sp.temperature != 0.0:
+                    top_k, top_p = sp.top_k, sp.top_p
+            ctx.update(seeds=seeds, base_steps=base, temps=temps,
+                       top_k=top_k, top_p=top_p)
+        if self.horizon_early_stop:
+            S = max([1] + [len(r.sampling.stop_token_ids) for r in batch])
+            stop_ids = np.full((B, S), -1, np.int32)
+            remaining = np.ones((B,), np.int32)
+            for r in batch:
+                ids = tuple(r.sampling.stop_token_ids)
+                stop_ids[r.slot, :len(ids)] = ids
+                remaining[r.slot] = self._row_remaining(r)
+            ctx.update(stop_ids=stop_ids, remaining=remaining,
+                       early_stop=True)
+        return ctx
+
+    def _decode_multi_with_recovery(self, s: int,
+                                    defer: bool = False
+                                    ) -> List[TokenEvent]:
         """One device-resident multi-step decode horizon (ISSUE 6
         tentpole) with the per-step path's transient-failure recovery.
         The batch's next `s` decode steps run in ONE
@@ -1062,7 +1296,13 @@ class ServingEngine:
         reached the device or re-writes identical K/V (the greedy
         feedback chain is deterministic) through the same block tables;
         exhausted retries quarantine the youngest spanning request and
-        rebuild, exactly like the per-step loop."""
+        rebuild, exactly like the per-step loop.
+
+        With `defer` (the pipelined loop, ISSUE 11) the launch is
+        dispatched and left IN FLIGHT — the next step's commit phase
+        (or flush()) drains and replays it; dispatch-time failures
+        still retry here, drain-time failures roll the pools back to
+        the captured pre-launch snapshot and rerun synchronously."""
         attempts = 0
         delay = self.retry_backoff_s
         while True:
@@ -1076,18 +1316,24 @@ class ServingEngine:
             pos = np.zeros((B,), np.int32)
             for req in batch:
                 # every page the horizon will write must be private
-                # BEFORE launch (idempotent: forks survive a retry)
+                # BEFORE launch (idempotent: forks survive a retry).
+                # Early-stop rows freeze their writes past their own
+                # remaining budget, so only that span needs forking
+                w = s if not self.horizon_early_stop else \
+                    min(s, self._row_remaining(req))
                 cow = req.kv.ensure_writable(req.num_context - 1,
-                                             req.num_context - 1 + s)
+                                             req.num_context - 1 + w)
                 if cow:
                     self.metrics.cow_copies.inc(cow)
                 sl = req.slot
                 tokens[sl] = req.output_tokens[-1]
                 tables[sl, :len(req.kv.pages)] = req.kv.pages
                 pos[sl] = req.num_context - 1
+            ctx = self._horizon_ctx(batch, s)
+            prev = self.pool.pools
             try:
                 packed, new_pools = self.runner.decode_multi(
-                    tokens, tables, pos, self.pool.pools, s)
+                    tokens, tables, pos, self.pool.pools, s, **ctx)
                 break
             except Exception:
                 if attempts < self.max_step_retries:
@@ -1102,15 +1348,39 @@ class ServingEngine:
         self.pool.pools = new_pools
         self.metrics.batch_occupancy.observe(len(batch))
         self.metrics.decode_horizon_steps.inc(s)
-        drained = _to_host(packed)      # the horizon's ONE host sync
-        self.metrics.host_syncs.inc()
+        slots = [(r, r.slot) for r in batch]
+        if defer:
+            self._inflight = _InflightLaunch("decode_multi", slots,
+                                             packed, prev, s)
+            return []
+        drained = self._timed_drain(lambda: _to_host(packed))
+        self.metrics.host_syncs.inc()       # the horizon's ONE host sync
+        return self._replay_horizon(slots, drained, s)
+
+    def _replay_horizon(self, batch_slots, drained, s: int
+                        ) -> List[TokenEvent]:
+        """Replay one drained horizon buffer through the per-step
+        bookkeeping: _append_token's stop/length handling, prefix-cache
+        registration at each coverage point, the NaN policy — so token
+        streams, finish reasons, and metrics match the s=1 loop
+        verbatim. `drained` is [2, B, s] (tokens, finite) or, on the
+        extended scan (ISSUE 11), [3, B, s] with a LIVE plane: entries
+        past a row's on-device done bit are dead and never replayed
+        (overshoot -> ~0 by construction). A batch member that finished
+        while the launch was in flight (pipelined abort/deadline) is
+        skipped — its drained tokens are discarded, never
+        half-committed."""
         toks, fins = drained[0], drained[1]
+        live = drained[2] if drained.shape[0] > 2 else None
         events: List[TokenEvent] = []
-        for req in batch:
-            sl = req.slot
+        for req, sl in batch_slots:
+            if req.done:
+                continue
             C = req.num_context
             accepted = 0
             for j in range(s):
+                if live is not None and not live[sl, j]:
+                    break          # row froze on device: tail is dead
                 if not fins[sl, j]:
                     self._horizon_nan(req, C, accepted)
                     break
@@ -1121,7 +1391,9 @@ class ServingEngine:
                 events.append(self._append_token(req, int(toks[sl, j])))
                 accepted += 1
                 if req.done:
-                    self.metrics.horizon_overshoot_tokens.inc(s - accepted)
+                    tail = (s - accepted if live is None
+                            else int(np.sum(live[sl, accepted:] != 0)))
+                    self.metrics.horizon_overshoot_tokens.inc(tail)
                     break
         return events
 
@@ -1140,7 +1412,8 @@ class ServingEngine:
         req.kv.truncate(max(C + accepted - 1, 1))
         req.defer_horizon = True
 
-    def _decode_with_recovery(self) -> List[TokenEvent]:
+    def _decode_with_recovery(self, defer: bool = False
+                              ) -> List[TokenEvent]:
         """One batched decode step with transient-failure recovery: retry
         with backoff; once retries are exhausted, quarantine the youngest
         running request (the step is then rebuilt without it). The loop is
@@ -1177,6 +1450,7 @@ class ServingEngine:
                 tokens[s] = req.output_tokens[-1]
                 tables[s, :len(req.kv.pages)] = req.kv.pages
                 pos[s] = req.num_context - 1   # position of the fed token
+            prev = self.pool.pools
             try:
                 logits, new_pools = self.runner.decode(tokens, tables, pos,
                                                        self.pool.pools)
@@ -1193,32 +1467,95 @@ class ServingEngine:
                 delay = self.retry_backoff_s
         self.pool.pools = new_pools
         self.metrics.batch_occupancy.observe(len(batch))
-        # one vectorized greedy/finite pass for the whole batch; the
-        # [B, V] array only reaches the host for temp>0 / NaN-rescue rows
-        am, fin = greedy_grid(logits)
-        self.metrics.host_syncs.inc()
+        slots = [(r, r.slot) for r in batch]
+        if defer:
+            # pipelined (ISSUE 11): leave the launch in flight; the
+            # next step's commit (or flush()) drains and resolves it
+            self._inflight = _InflightLaunch("decode", slots, logits,
+                                             prev, 1)
+            return []
+        return self._finish_decode(slots, logits)
+
+    def _finish_decode(self, batch_slots, logits,
+                       grid=None) -> List[TokenEvent]:
+        """Resolve one drained decode launch: one vectorized greedy/
+        finite pass for the whole batch (the [B, V] array only reaches
+        the host for temp>0 / NaN-rescue rows), then the per-request
+        append/stop/NaN bookkeeping. Shared by the synchronous loop and
+        the pipelined commit (which passes the already-drained grid). A
+        batch member that finished while the launch was in flight is
+        skipped."""
+        if grid is None:
+            grid = self._timed_drain(lambda: greedy_grid(logits))
+            self.metrics.host_syncs.inc()
+        am, fin = grid
         host: Dict[str, np.ndarray] = {}
 
         def _rows() -> np.ndarray:
             if "l" not in host:
-                host["l"] = _to_host(logits)
+                host["l"] = self._timed_drain(lambda: _to_host(logits))
                 self.metrics.host_syncs.inc()
             return host["l"]
 
         events = []
-        for req in batch:
+        for req, sl in batch_slots:
+            if req.done:
+                continue
             req.kv.num_tokens = req.num_context
             if self.pool.prefix_cache is not None:
                 self.pool.prefix_cache.register_seq(req.kv,
                                                     req.context_tokens)
             tok = self._resolve_token(req, len(req.output_tokens),
-                                      am[req.slot], fin[req.slot],
-                                      lambda s=req.slot: _rows()[s])
+                                      am[sl], fin[sl],
+                                      lambda s=sl: _rows()[s])
             if tok is None:
                 self._finish_abnormal(req, "error")
                 continue
             events.append(self._append_token(req, tok))
         return events
+
+    # ------------------------------------------- pipelined loop (ISSUE 11)
+
+    def _commit_inflight(self) -> List[TokenEvent]:
+        """COMMIT phase of the zero-bubble loop: drain the in-flight
+        launch and replay it through the standard per-step bookkeeping.
+        The plan phase that just ran (admission, chunk slicing, page-in
+        staging) overlapped this launch's device time — that ordering
+        IS the optimization. A drain-time device error rolls the pools
+        back to the pre-launch snapshot (no pool write has happened
+        since the launch: the fence deliberately runs after this
+        commit) and reruns the step synchronously through the normal
+        retry/quarantine path — a retried launch re-writes identical
+        K/V through the same block tables, so streams stay exact."""
+        inf = self._inflight
+        if inf is None:
+            return []
+        self._inflight = None
+        try:
+            if inf.kind == "decode":
+                grid = self._timed_drain(lambda: greedy_grid(inf.result))
+            else:
+                drained = self._timed_drain(lambda: _to_host(inf.result))
+        except Exception:
+            self.metrics.step_retries.inc()
+            self._sleep(self.retry_backoff_s)
+            self.pool.pools = inf.prev_pools
+            if inf.kind == "decode":
+                return self._decode_with_recovery()
+            return self._decode_multi_with_recovery(inf.s)
+        self.metrics.host_syncs.inc()
+        if inf.kind == "decode":
+            return self._finish_decode(inf.batch, inf.result, grid)
+        return self._replay_horizon(inf.batch, drained, inf.s)
+
+    def flush(self) -> List[TokenEvent]:
+        """Fence the pipeline (ISSUE 11): commit any in-flight launch
+        and return its events. No-op on an unpipelined engine (or with
+        nothing in flight). Router workers call this on a graceful stop
+        so committed-but-undelivered tokens reach the delivery
+        registry; tests and tools use it before inspecting engine
+        state mid-run."""
+        return self._commit_inflight()
 
     def _append_token(self, req: Request, tok: int) -> TokenEvent:
         now = self.metrics.clock()
@@ -1281,8 +1618,10 @@ class ServingEngine:
     # -------------------------------------------------------------- run
 
     def run(self) -> Dict[str, RequestOutput]:
-        """Drain the engine; returns every finished RequestOutput."""
-        while self.scheduler.has_work():
+        """Drain the engine; returns every finished RequestOutput.
+        has_work() counts an in-flight pipelined launch, so the loop's
+        last iteration commits the tail of the pipeline."""
+        while self.has_work():
             self.step()
         return dict(self._outputs)
 
@@ -1448,6 +1787,15 @@ class ServingEngine:
                 "pagein_prefetch": self.pagein_prefetch,
                 "ragged_batch": self.ragged_batch,
                 "decode_horizon": self.decode_horizon,
+                # zero-bubble knobs (ISSUE 11) ride along; the snapshot
+                # itself is always pipeline-consistent — output_tokens
+                # hold only COMMITTED tokens, an in-flight launch's
+                # drained-but-unreplayed buffer dies with the crash and
+                # is regenerated by recompute (never half-committed)
+                "pipelined": self.pipelined,
+                "horizon_sampling": self.horizon_sampling,
+                "horizon_early_stop": self.horizon_early_stop,
+                "spill_async": self.spill_async,
                 "num_speculative_tokens": self.num_speculative_tokens,
                 "spec_max_ngram": self.spec_max_ngram,
                 "spec_min_ngram": self.spec_min_ngram,
@@ -1503,6 +1851,10 @@ class ServingEngine:
                   pagein_prefetch=cfg.get("pagein_prefetch", 2),
                   ragged_batch=cfg.get("ragged_batch", False),
                   decode_horizon=cfg.get("decode_horizon", 1),
+                  pipelined=cfg.get("pipelined", False),
+                  horizon_sampling=cfg.get("horizon_sampling", False),
+                  horizon_early_stop=cfg.get("horizon_early_stop", False),
+                  spill_async=cfg.get("spill_async", False),
                   num_speculative_tokens=cfg.get("num_speculative_tokens", 0),
                   spec_max_ngram=cfg.get("spec_max_ngram", 3),
                   spec_min_ngram=cfg.get("spec_min_ngram", 1),

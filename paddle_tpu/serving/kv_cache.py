@@ -475,13 +475,28 @@ class HostKVTier:
     sample so silent host-buffer corruption is caught, not served.
     """
 
-    def __init__(self, pool: "KVCachePool", max_pages: int, metrics=None):
+    def __init__(self, pool: "KVCachePool", max_pages: int, metrics=None,
+                 async_spill: bool = False):
         if max_pages < 1:
             raise ValueError("host tier needs max_pages >= 1 (omit the "
                              "tier entirely to disable offload)")
         self.pool = pool
         self.max_pages = int(max_pages)
         self.metrics = metrics             # optional EngineMetrics mirror
+        # threaded spill I/O (ISSUE 11 satellite): with async_spill the
+        # device->host copy of a spill runs on a single worker thread
+        # instead of blocking the engine loop on one np.asarray per
+        # page. Safe by construction: the worker copies from the
+        # FUNCTIONAL pool snapshot captured at spill time (jax arrays
+        # are immutable — later launches produce new arrays, so page
+        # reuse can never race the copy), and every consumer of a
+        # slot's bytes (read_slot, free_slots, slot_hash, the auditor's
+        # content spot check via sync()) joins the pending copy first.
+        # Slot ALLOCATION and all accounting stay synchronous on the
+        # loop thread, so spill traces are as deterministic as before.
+        self.async_spill = bool(async_spill)
+        self._executor = None
+        self._pending: Dict[int, object] = {}     # slot -> Future
         # pinned host mirrors of the device pool layout, one buffer per
         # (layer, pool-array): [max_pages, *page_shape] at the pool dtype
         self._bufs: List[Tuple[np.ndarray, ...]] = [
@@ -533,7 +548,44 @@ class HostKVTier:
         return self._gen.get(slot, 0)
 
     def slot_hash(self, slot: int) -> int:
+        self._wait_slot(slot)
         return self._hash[slot]
+
+    # ------------------------------------------ async spill worker plumbing
+
+    def _ensure_executor(self):
+        if self._executor is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="kv-spill")
+        return self._executor
+
+    def _wait_slot(self, slot: int) -> None:
+        """Join the pending spill copy covering one slot (no-op when the
+        slot has none). A future may cover several slots; popping one
+        leaves the rest mapped — result() is idempotent."""
+        fut = self._pending.pop(slot, None)
+        if fut is not None:
+            fut.result()
+
+    def sync(self) -> None:
+        """Join EVERY pending async spill copy — the fence the auditor
+        (and any bulk reader) runs before trusting slot contents or
+        content hashes."""
+        pending, self._pending = self._pending, {}
+        for fut in {id(f): f for f in pending.values()}.values():
+            fut.result()
+
+    def _spill_job(self, slots: List[int], arrs) -> None:
+        """Worker-thread half of an async spill: materialize the device
+        gather (np.asarray blocks HERE, not on the engine loop) into the
+        pinned buffers and record the content hashes."""
+        for layer_bufs, layer_data in zip(self._bufs, arrs):
+            for buf, arr in zip(layer_bufs, layer_data):
+                buf[slots] = np.asarray(arr)
+        for s in slots:
+            self._hash[s] = self.content_hash(s)
 
     def content_hash(self, slot: int) -> int:
         """Deterministic hash over the slot's bytes across every layer
@@ -562,12 +614,26 @@ class HostKVTier:
             return []
         slots = self._free[:n]
         del self._free[:n]
-        data = self.pool.read_pages(list(device_pages)[:n])
-        for layer_bufs, layer_data in zip(self._bufs, data):
-            for buf, arr in zip(layer_bufs, layer_data):
-                buf[slots] = arr
-        for s in slots:
-            self._hash[s] = self.content_hash(s)
+        if self.async_spill:
+            # dispatch the device-side gather now (async, immutable
+            # functional snapshot) and hand the blocking np.asarray +
+            # buffer write + hashing to the worker; the slot is "used"
+            # immediately (placeholder hash) so accounting stays
+            # synchronous and deterministic
+            arrs = self.pool.gather_pages(list(device_pages)[:n])
+            for s in slots:
+                self._hash[s] = None
+            fut = self._ensure_executor().submit(self._spill_job, slots,
+                                                 arrs)
+            for s in slots:
+                self._pending[s] = fut
+        else:
+            data = self.pool.read_pages(list(device_pages)[:n])
+            for layer_bufs, layer_data in zip(self._bufs, data):
+                for buf, arr in zip(layer_bufs, layer_data):
+                    buf[slots] = arr
+            for s in slots:
+                self._hash[s] = self.content_hash(s)
         self.spilled_pages += n
         if self.metrics is not None:
             self.metrics.offload_spill_pages.inc(n)
@@ -641,14 +707,20 @@ class HostKVTier:
     def read_slot(self, slot: int) -> List[Tuple[np.ndarray, ...]]:
         """One slot's per-layer page arrays, COPIED (a device_put may
         alias host memory on CPU backends; the copy makes slot reuse
-        safe while a staged transfer is still in flight)."""
+        safe while a staged transfer is still in flight). Joins any
+        pending async spill of the slot first."""
+        self._wait_slot(slot)
         return [tuple(np.array(buf[slot]) for buf in layer)
                 for layer in self._bufs]
 
     def free_slots(self, slots: Sequence[int]) -> None:
         """Return slots to the (sorted) free list, bumping each slot's
-        generation so stale staged transfers can never resolve."""
+        generation so stale staged transfers can never resolve. A slot
+        with a spill copy still in flight is joined first — a freed
+        (and possibly re-spilled) slot must never be written by a
+        worker job from its previous tenancy."""
         for s in slots:
+            self._wait_slot(s)
             if s not in self._hash:
                 raise ValueError(f"double free of host slot {s}")
             del self._hash[s]
@@ -755,17 +827,30 @@ class KVCachePool:
                 self.prefix_cache.evict_hook = self.host_tier.on_evict
         return self.prefix_cache
 
-    def enable_host_tier(self, max_pages: int,
-                         metrics=None) -> HostKVTier:
+    def enable_host_tier(self, max_pages: int, metrics=None,
+                         async_spill: bool = False) -> HostKVTier:
         """Turn on the host-RAM offload tier (ISSUE 10, idempotent):
         preemption spills exclusively-owned pages to pinned host
         buffers, and prefix-cache eviction demotes cached pages through
-        evict_hook instead of dropping them."""
+        evict_hook instead of dropping them. `async_spill` (ISSUE 11
+        satellite) moves the blocking device->host copy of each spill
+        onto a worker thread."""
         if self.host_tier is None:
-            self.host_tier = HostKVTier(self, max_pages, metrics=metrics)
+            self.host_tier = HostKVTier(self, max_pages, metrics=metrics,
+                                        async_spill=async_spill)
             if self.prefix_cache is not None:
                 self.prefix_cache.evict_hook = self.host_tier.on_evict
         return self.host_tier
+
+    def gather_pages(self, pages: Sequence[int]) -> List[Tuple]:
+        """DEVICE-side gather of the named pages across every layer's
+        pool arrays — dispatches asynchronously and returns the jnp
+        arrays without materializing them. The arrays are a functional
+        snapshot: later pool writes produce new arrays, so a worker
+        thread can np.asarray these at leisure even after the pages are
+        freed and reused (the threaded-spill foundation, ISSUE 11)."""
+        idx = jnp.asarray(list(pages), jnp.int32)
+        return [tuple(a[idx] for a in layer) for layer in self.pools]
 
     def read_pages(self, pages: Sequence[int]
                    ) -> List[Tuple[np.ndarray, ...]]:
@@ -773,9 +858,8 @@ class KVCachePool:
         pool arrays — the device->host half of a spill. One gather per
         pool array (sharded pools gather per shard under GSPMD), then
         one blocking transfer."""
-        idx = jnp.asarray(list(pages), jnp.int32)
-        return [tuple(np.asarray(a[idx]) for a in layer)
-                for layer in self.pools]
+        return [tuple(np.asarray(a) for a in layer)
+                for layer in self.gather_pages(pages)]
 
     def write_pages(self, pages: Sequence[int], layer_data) -> None:
         """Scatter staged page contents into the named device pages —

@@ -92,6 +92,8 @@ SUMMABLE_KEYS = (
     "prefix_cached_pages", "attn_kv_bytes_read", "attn_kv_bytes_gather",
     "spec_proposed_tokens", "spec_accepted_tokens", "spec_rollback_pages",
     "host_syncs", "decode_horizon_steps", "horizon_overshoot_tokens",
+    "planned_ahead_steps", "host_plan_seconds", "overlapped_plan_seconds",
+    "drain_wait_seconds", "step_seconds",
     "offload_spill_pages", "pagein_pages", "pagein_hidden_pages",
     "offload_resumes", "offload_recompute_fallbacks", "host_tier_drops",
     "host_tier_bytes",
@@ -127,6 +129,11 @@ def aggregate_snapshots(snaps) -> Dict[str, float]:
     out["steps_per_token"] = out["decode_steps"] / toks if toks > 0 else 0.0
     out["host_syncs_per_token"] = out["host_syncs"] / toks if toks > 0 \
         else 0.0
+    st = out["step_seconds"]
+    out["device_idle_fraction"] = (
+        max(0.0, 1.0 - min((out["drain_wait_seconds"]
+                            + out["overlapped_plan_seconds"]) / st, 1.0))
+        if st > 0 else 0.0)
     out["tokens_per_sec"] = (toks / out["busy_seconds"]
                              if out["busy_seconds"] > 0 else 0.0)
     out["replicas"] = float(len(snaps))
@@ -182,6 +189,24 @@ class EngineMetrics:
         self.host_syncs = Counter("host_syncs")
         self.decode_horizon_steps = Counter("decode_horizon_steps")
         self.horizon_overshoot_tokens = Counter("horizon_overshoot_tokens")
+        # zero-bubble pipelined loop (ISSUE 11): planned_ahead_steps
+        # counts steps whose host planning ran while a previous launch
+        # was still in flight on the device; the *_seconds counters
+        # split each step's wall time into host planning (overlapped_
+        # plan_seconds is the subset that had device compute to hide
+        # behind), blocking device->host drain waits, and the rest.
+        # device_idle_fraction is the host-derived proxy the bench
+        # commits: the share of loop wall time during which the host
+        # was neither blocked on the device nor planning under an
+        # in-flight launch — i.e. time the device plausibly idled
+        # waiting for the host (~the whole planning interval on the
+        # unpipelined loop, ~0 pipelined).
+        self.planned_ahead_steps = Counter("planned_ahead_steps")
+        self.host_plan_seconds = Counter("host_plan_seconds")
+        self.overlapped_plan_seconds = Counter("overlapped_plan_seconds")
+        self.drain_wait_seconds = Counter("drain_wait_seconds")
+        self.step_seconds = Counter("step_seconds")
+        self.device_idle_fraction = Gauge("device_idle_fraction")
         # tiered KV offload (ISSUE 10): offload_spill_pages counts device
         # pages copied to the host tier (preemption spills AND prefix
         # demotions), pagein_pages counts pages restored to device, and
@@ -300,6 +325,12 @@ class EngineMetrics:
             "host_syncs_per_token": self.host_syncs_per_token(),
             "decode_horizon_steps": self.decode_horizon_steps.value,
             "horizon_overshoot_tokens": self.horizon_overshoot_tokens.value,
+            "planned_ahead_steps": self.planned_ahead_steps.value,
+            "host_plan_seconds": self.host_plan_seconds.value,
+            "overlapped_plan_seconds": self.overlapped_plan_seconds.value,
+            "drain_wait_seconds": self.drain_wait_seconds.value,
+            "step_seconds": self.step_seconds.value,
+            "device_idle_fraction": self.device_idle_fraction.value,
             "offload_spill_pages": self.offload_spill_pages.value,
             "pagein_pages": self.pagein_pages.value,
             "pagein_hidden_pages": self.pagein_hidden_pages.value,

@@ -482,7 +482,8 @@ class PagedModelRunner:
             return host_arrays
         return jax.device_put(host_arrays, NamedSharding(self.mesh, P()))
 
-    def _step_shardings(self, kind: str, pools_arg: int):
+    def _step_shardings(self, kind: str, pools_arg: int,
+                        trailing_args: int = 0):
         """Explicit (in_shardings, out_shardings) for one jitted step:
         params per their specs, host operands replicated, K/V pools
         split on the kv-head axis in AND out — the pools never leave the
@@ -498,7 +499,8 @@ class PagedModelRunner:
         else:
             layer = (kv, kv)
         pools = [layer for _ in range(self.num_layers)]
-        ins = ([self._param_shardings] + [rep] * (pools_arg - 1) + [pools])
+        ins = ([self._param_shardings] + [rep] * (pools_arg - 1) + [pools]
+               + [rep] * trailing_args)
         return tuple(ins), (rep, pools)
 
     # --------------------------------------------------------- dispatch
@@ -614,9 +616,14 @@ class PagedModelRunner:
                                       jnp.reshape(real_len, (1,)), pools)
         return logits[0, real_len - 1], pools
 
-    def _decode_step(self, params, tokens, tables, pos, pools):
+    def _decode_step(self, params, tokens, tables, pos, pools,
+                     write_mask=None):
         positions = pos[:, None].astype(jnp.int32)                 # [B, 1]
-        valid = jnp.ones_like(positions, bool)  # dead slots: scratch tables
+        # dead slots carry all-scratch tables; an early-stopped horizon
+        # row (ISSUE 11) additionally masks its write so a frozen row's
+        # garbage feedback token never lands in a live page
+        valid = (jnp.ones_like(positions, bool) if write_mask is None
+                 else write_mask[:, None])
         page, off = self._write_indices(positions, tables, valid)
         B = tokens.shape[0]
         logits, pools = self._forward(params, tokens, positions, page, off,
@@ -651,6 +658,85 @@ class PagedModelRunner:
         (_, _, pools), (toks, fins) = jax.lax.scan(body, init, None,
                                                    length=num_steps)
         packed = jnp.stack([toks.T, fins.T.astype(jnp.int32)])  # [2, B, s]
+        return packed, pools
+
+    @staticmethod
+    def _sampled_rows(logits, seeds, steps, temps, top_k, top_p):
+        """Per-row seeded sampling INSIDE the decode_multi scan (ISSUE
+        11 tentpole): row b is sampled with the key
+        fold_in(key(seeds[b]), steps[b]) at temperature temps[b] —
+        exactly the step-indexed stream engine.sample_token draws on
+        the host, so a temperature>0 horizon is bit-identical to the
+        per-step seeded path. The division by temperature happens HERE
+        (astype-then-divide, the host order) and `_sample` is then
+        invoked at temperature 1.0 — x/1.0 is an IEEE identity, so the
+        remaining top-k/top-p/categorical math is the verbatim host
+        code path on the same [1, V] shape. top_k/top_p are static
+        (one pair per jit entry — the engine only routes homogeneous
+        batches here); rows with temps[b] == 0 are ignored by the
+        caller (greedy argmax selected via where)."""
+        from paddle_tpu.models.generation import _sample
+
+        def one(row, seed, step, temp):
+            key = jax.random.fold_in(jax.random.key(seed), step)
+            l = row[None].astype(jnp.float32) / jnp.where(temp > 0.0,
+                                                          temp, 1.0)
+            return _sample(l, key, 1.0, top_k, top_p)[0]
+
+        return jax.vmap(one)(logits, seeds, steps, temps)
+
+    def _decode_multi_x_step(self, params, tokens, tables, pos, pools,
+                             seeds, base_steps, temps, stop_ids, remaining,
+                             num_steps: int, top_k, top_p,
+                             sampling: bool, early_stop: bool):
+        """Extended device-resident horizon (ISSUE 11 tentpole): the
+        decode_multi scan widened with (a) per-request seeded key
+        schedules — rows with temps > 0 draw their step-indexed sample
+        stream inside the scan instead of forcing the whole batch back
+        to the per-step path — and (b) an on-device stop-condition
+        flag: a row whose emitted token hits its stop set (stop_ids,
+        -1-padded) or exhausts its remaining-token budget sets a done
+        bit that freezes the row's KV writes (masked to scratch) and
+        its position, so overshoot past a stop is never computed into
+        the pools and never drained as a real token. Returns a packed
+        [3, B, s] int32 buffer: row 0 the token buffer, row 1 the
+        per-step finiteness flags, row 2 the LIVE flags (1 = this
+        token is a real emission; everything after a row's done bit is
+        garbage the host must not replay)."""
+
+        def body(carry, _):
+            toks, p, done, cnt, pools = carry
+            logits, pools = self._decode_step(
+                params, toks[:, None], tables, p, pools,
+                write_mask=jnp.logical_not(done))
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            fin = jnp.all(jnp.isfinite(logits), axis=-1)
+            if sampling:
+                # per-row step index = generated-token count so far
+                sampled = self._sampled_rows(logits, seeds,
+                                             base_steps + cnt, temps,
+                                             top_k, top_p)
+                nxt = jnp.where(temps > 0.0, sampled, greedy)
+            else:
+                nxt = greedy
+            live = jnp.logical_not(done)
+            if early_stop:
+                hit = jnp.any(nxt[:, None] == stop_ids, axis=1)
+                cnt2 = cnt + live.astype(jnp.int32)
+                done2 = done | (live & (hit | (cnt2 >= remaining)))
+            else:
+                cnt2 = cnt + 1
+                done2 = done
+            p2 = jnp.where(live, p + 1, p)    # frozen rows hold position
+            return (nxt, p2, done2, cnt2, pools), (nxt, fin, live)
+
+        B = tokens.shape[0]
+        init = (tokens.astype(jnp.int32), pos.astype(jnp.int32),
+                jnp.zeros((B,), bool), jnp.zeros((B,), jnp.int32), pools)
+        (_, _, _, _, pools), (toks, fins, lives) = jax.lax.scan(
+            body, init, None, length=num_steps)
+        packed = jnp.stack([toks.T, fins.T.astype(jnp.int32),
+                            lives.T.astype(jnp.int32)])     # [3, B, s]
         return packed, pools
 
     def _ragged_core(self, params, tokens, tables, start_pos, q_lens,
@@ -694,18 +780,25 @@ class PagedModelRunner:
         fn = {"prefill": self._prefill_step,
               "decode": self._decode_step,
               "decode_multi": self._decode_multi_step,
+              "decode_multi_x": self._decode_multi_x_step,
               "ragged": self._ragged_step,
               "ragged_full": self._ragged_core}[kind]
         pools_arg = {"prefill": 5, "decode": 4, "decode_multi": 4,
+                     "decode_multi_x": 4,
                      "ragged": 5, "ragged_full": 5}[kind]
         donate = (pools_arg,) if jax.default_backend() == "tpu" else ()
-        # decode_multi's horizon length is a lax.scan bound — static
-        static = (5,) if kind == "decode_multi" else ()
+        # decode_multi's horizon length is a lax.scan bound — static;
+        # the extended horizon additionally bakes the sampling config
+        # and the early-stop switch per jit entry
+        static = {"decode_multi": (5,),
+                  "decode_multi_x": (10, 11, 12, 13, 14)}.get(kind, ())
         if self.mesh is not None:
             # sharded runner (ISSUE 7): every step is pjit'd with
             # explicit in/out shardings — params per spec, pools split
             # on the kv-head axis both ways, host operands replicated
-            ins, outs = self._step_shardings(kind, pools_arg)
+            ins, outs = self._step_shardings(
+                kind, pools_arg,
+                trailing_args=5 if kind == "decode_multi_x" else 0)
             jitted = jax.jit(fn, donate_argnums=donate,
                              static_argnums=static, in_shardings=ins,
                              out_shardings=outs)
@@ -766,28 +859,70 @@ class PagedModelRunner:
             np.asarray(tables, np.int32), pos_np)
         return fn(self.params, toks, tabs, pos_a, pools)
 
-    def decode_multi(self, tokens, tables, pos, pools, num_steps: int):
+    def decode_multi(self, tokens, tables, pos, pools, num_steps: int, *,
+                     seeds=None, base_steps=None, temps=None,
+                     top_k=None, top_p=None,
+                     stop_ids=None, remaining=None,
+                     early_stop: bool = False):
         """Device-resident multi-step decode (ISSUE 6): run `num_steps`
-        consecutive greedy decode steps in ONE jitted lax.scan launch,
-        feeding each step's on-device argmax back as the next input.
-        tokens [B] (the fed last tokens), tables [B, P] (must already
-        map every page positions pos .. pos+num_steps-1 will write),
-        pos [B]. Returns (packed[2, B, num_steps] int32, pools): row 0
-        the greedy token buffer, row 1 the per-step finiteness flags —
-        one host transfer drains the whole horizon."""
+        consecutive decode steps in ONE jitted lax.scan launch, feeding
+        each step's on-device token back as the next input. tokens [B]
+        (the fed last tokens), tables [B, P] (must already map every
+        page the horizon's live rows will write), pos [B].
+
+        With no extension operands the scan is pure greedy and returns
+        (packed[2, B, num_steps] int32, pools): row 0 the greedy token
+        buffer, row 1 the per-step finiteness flags — one host transfer
+        drains the whole horizon.
+
+        Extended horizons (ISSUE 11): `seeds`/`base_steps`/`temps` [B]
+        turn on per-row seeded sampling inside the scan (rows with
+        temps > 0 draw fold_in(key(seed), base_step + emitted) — the
+        host sample stream, bit-identical; top_k/top_p are static and
+        must be homogeneous across the sampled rows), and
+        `stop_ids` [B, S] (-1-padded) + `remaining` [B] with
+        `early_stop=True` set a per-row done bit on device: the row's
+        KV writes freeze and subsequent steps emit dead tokens flagged
+        by a third packed plane. Any extension makes the return shape
+        [3, B, num_steps] (tokens, finite, LIVE)."""
         if num_steps < 1:
             raise ValueError("decode_multi needs num_steps >= 1")
         pos_np = np.asarray(pos, np.int32)
         impl = self._attn_impl_for(1)
         width = np.asarray(tables).shape[1]
         for t in range(num_steps):      # inner step t attends at pos + t
+            # host-side byte analytics; early-stopped rows may freeze
+            # earlier, so this upper-bounds the extended horizon's reads
             self._account_attn(impl, pos_np + t, np.ones_like(pos_np),
                                width)
-        fn = self._jitted("decode_multi", (pos_np.shape[0], num_steps))
-        toks, tabs, pos_a = self._stage(np.asarray(tokens, np.int32),
-                                        np.asarray(tables, np.int32),
-                                        pos_np)
-        return fn(self.params, toks, tabs, pos_a, pools, num_steps)
+        B = pos_np.shape[0]
+        sampling = temps is not None
+        extended = sampling or early_stop
+        if not extended:
+            fn = self._jitted("decode_multi", (B, num_steps))
+            toks, tabs, pos_a = self._stage(np.asarray(tokens, np.int32),
+                                            np.asarray(tables, np.int32),
+                                            pos_np)
+            return fn(self.params, toks, tabs, pos_a, pools, num_steps)
+        seeds = np.zeros((B,), np.int32) if seeds is None \
+            else np.asarray(seeds, np.int32)
+        base_steps = np.zeros((B,), np.int32) if base_steps is None \
+            else np.asarray(base_steps, np.int32)
+        temps = np.zeros((B,), np.float32) if temps is None \
+            else np.asarray(temps, np.float32)
+        stop_ids = np.full((B, 1), -1, np.int32) if stop_ids is None \
+            else np.asarray(stop_ids, np.int32)
+        remaining = np.full((B,), num_steps, np.int32) if remaining is None \
+            else np.asarray(remaining, np.int32)
+        fn = self._jitted("decode_multi_x",
+                          (B, num_steps, top_k, top_p, sampling,
+                           bool(early_stop), stop_ids.shape[1]))
+        toks, tabs, pos_a, sd, bs, tp, si, rem = self._stage(
+            np.asarray(tokens, np.int32), np.asarray(tables, np.int32),
+            pos_np, seeds, base_steps, temps, stop_ids, remaining)
+        return fn(self.params, toks, tabs, pos_a, pools, sd, bs, tp, si,
+                  rem, num_steps, top_k, top_p, sampling,
+                  bool(early_stop))
 
     def ragged_step(self, tokens, tables, start_pos, q_lens, pools,
                     full_logits: bool = False):

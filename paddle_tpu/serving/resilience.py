@@ -170,7 +170,7 @@ class FaultInjector:
             logits = self._corrupt(logits)
         return logits, pools
 
-    def decode_multi(self, tokens, tables, pos, pools, num_steps):
+    def decode_multi(self, tokens, tables, pos, pools, num_steps, **kw):
         # the multi-step horizon (ISSUE 6) IS the step's decode call
         # site — it shares the "decode" op counter like ragged_step, so
         # a decode fault schedule keeps firing when the engine batches s
@@ -179,9 +179,12 @@ class FaultInjector:
         # flags instead (every step of the call): the engine sees the
         # horizon "go NaN" at step one, exactly like a full-vocab
         # corruption of the first step's logits on the per-step path.
+        # The extended-horizon operands (ISSUE 11: seeded sampling /
+        # early stop) pass through untouched; plane 1 is the finiteness
+        # plane on both the [2, B, s] and [3, B, s] layouts.
         n = self._pre("decode")
         packed, pools = self._runner.decode_multi(tokens, tables, pos,
-                                                  pools, num_steps)
+                                                  pools, num_steps, **kw)
         if self._hits(self._nan, "decode", n):
             self.injected["nan"] += 1
             arr = np.array(packed, np.int32, copy=True)
@@ -230,6 +233,14 @@ def audit_engine(engine) -> None:
     sched = engine.scheduler
     cache = engine.pool.prefix_cache
     problems = []
+
+    # pipelined loop (ISSUE 11): the auditor must hold with ONE launch
+    # in flight — map its batch members to their undrained horizon
+    # length so the over-provision check can credit their pre-committed
+    # pages (and pin that at most one launch is ever outstanding)
+    inflight = getattr(engine, "_inflight", None)
+    inflight_horizon = ({id(r): inflight.s for r, _ in inflight.batch}
+                        if inflight is not None else {})
 
     # -- allocator self-consistency -------------------------------------
     free_list = list(alloc._free)
@@ -287,13 +298,18 @@ def audit_engine(engine) -> None:
         # rejected tail AND a decode horizon's pre-committed pages must
         # both have been reclaimed (truncate / finish-release) by the
         # time the step ends, whether the tokens were rejected, the
-        # request stopped mid-horizon, or a NaN cut the horizon short
-        cap = engine.pool.blocks_for_tokens(req.num_context + 1)
+        # request stopped mid-horizon, or a NaN cut the horizon short.
+        # EXCEPTION (ISSUE 11): a pipelined engine audits with one
+        # launch legitimately in flight — its batch members hold pages
+        # pre-committed for the whole undrained horizon until the next
+        # step's commit replays (and finish-releases / truncates) them
+        upcoming = 1 + inflight_horizon.get(id(req), 0)
+        cap = engine.pool.blocks_for_tokens(req.num_context + upcoming)
         if len(req.kv.pages) > cap:
             problems.append(
                 f"{req.request_id} holds {len(req.kv.pages)} pages > "
-                f"{cap} needed for context+1 — speculative/horizon "
-                "pages survived rejection")
+                f"{cap} needed for context+{upcoming} — speculative/"
+                "horizon pages survived rejection")
         for p in req.kv.pages:
             owner_counts[p] = owner_counts.get(p, 0) + 1
     cached = set(cache.pages()) if cache is not None else set()
@@ -394,6 +410,12 @@ def audit_engine(engine) -> None:
     #    host buffer is caught before it is ever paged back in.
     tier = getattr(engine.pool, "host_tier", None)
     if tier is not None:
+        # threaded spill I/O (ISSUE 11): join any in-flight worker
+        # copies first — slot contents and content hashes are only
+        # defined once the copy lands, and the auditor must never race
+        # the worker into a false corruption report
+        if hasattr(tier, "sync"):
+            tier.sync()
         hfree, hused = list(tier._free), set(tier._hash)
         hfset = set(hfree)
         if len(hfree) != len(hfset):

@@ -341,6 +341,23 @@ class ServingRouter:
         DEAD — fence it and let the supervisor take over."""
         while True:
             if rep.stop:
+                # graceful stop with the zero-bubble loop (ISSUE 11):
+                # commit any in-flight pipelined launch so its tokens
+                # reach the delivery registry instead of dying with the
+                # thread. A FENCED replica deliberately skips this —
+                # whatever a failed replica's pipeline held is
+                # discarded wholesale and regenerated by recovery
+                # (at-most-once: the cursor absorbs any overlap).
+                if not rep.fenced:
+                    with rep.lock:
+                        epoch = rep.epoch
+                        try:
+                            events = rep.engine.flush()
+                        except BaseException:   # dying flush: recovery
+                            events = []         # regenerates its tokens
+                        if events and not rep.fenced:
+                            self._deliver(rep, epoch, events)
+                            self._collect(rep)
                 return
             stepped = False
             with rep.lock:

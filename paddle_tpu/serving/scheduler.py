@@ -428,7 +428,7 @@ class FCFSScheduler:
 
     # ------------------------------------------------- multi-step decode
 
-    def plan_decode_horizon(self, s: int) -> int:
+    def plan_decode_horizon(self, s: int, row_caps=None) -> int:
         """Pre-commit pages for up to `s` future decode tokens per
         decode-ready request (ISSUE 6): the multi-step device loop
         writes K/V for its whole horizon against block tables that are
@@ -439,14 +439,25 @@ class FCFSScheduler:
         of evicting anyone. Assumes reserve_decode() already funded
         step one (s=1 needs no new pages by that invariant). Grows
         every decode-ready sequence to the returned effective horizon
-        and returns it (0 with no decode-ready requests)."""
+        and returns it (0 with no decode-ready requests).
+
+        `row_caps` (ISSUE 11, on-device early stop): an optional
+        {request: max_upcoming_tokens} map — a row that will provably
+        freeze after its remaining-token budget only funds pages for
+        min(s, cap) tokens, so a near-finished or near-model-length
+        row neither blocks a long horizon nor over-allocates pages its
+        frozen KV writes would never touch."""
         batch = self.decode_ready()
         if not batch:
             return 0
         s = max(1, int(s))
         alloc = self.pool.allocator
+
+        def up(r, n):
+            return min(n, row_caps[r]) if row_caps else n
+
         while s > 1:
-            short = sum(r.kv.pages_short(s) for r in batch)
+            short = sum(r.kv.pages_short(up(r, s)) for r in batch)
             if short == 0:
                 break
             used_live = (alloc.num_usable - alloc.num_free
@@ -457,7 +468,7 @@ class FCFSScheduler:
             s -= 1
         if s > 1:
             for r in batch:
-                r.kv.grow(s)
+                r.kv.grow(up(r, s))
         return s
 
     # -------------------------------------------------------- preemption

@@ -29,6 +29,9 @@ class StubPagedRunner:
         self.block_size = block_size
         self.max_model_len = max_model_len
         self.dtype = jnp.float32
+        # per-row decode_multi steps actually computed (ISSUE 11: the
+        # early-stop saves-compute pin counts frozen rows' skipped work)
+        self.counted_row_steps = 0
 
     def _logits(self, history):
         import numpy as np
@@ -83,14 +86,22 @@ class StubPagedRunner:
             out[b] = self._logits(hist)
         return jnp.asarray(out), [(jnp.asarray(k), v)]
 
-    def decode_multi(self, tokens, tables, pos, pools, num_steps):
+    def decode_multi(self, tokens, tables, pos, pools, num_steps,
+                     seeds=None, base_steps=None, temps=None,
+                     top_k=None, top_p=None, stop_ids=None,
+                     remaining=None, early_stop=False):
         """Device-resident horizon (ISSUE 6): num_steps consecutive
-        decode steps, each argmax token fed back as the next input,
+        decode steps, each step's token fed back as the next input,
         history gathered from the pool every step — so a missing
         pre-committed horizon page, a stale table, or a wrong feedback
         token changes the buffer and breaks oracle equality. Returns
         the packed [2, B, s] (tokens, finite-flags) buffer the real
-        runner's scan emits."""
+        runner's scan emits — or, with the ISSUE-11 extension operands
+        (per-row seeded sampling via the engine's own `seeded_sample`
+        host math, and/or the on-device stop flag that freezes a done
+        row's KV writes), the extended [3, B, s] buffer with the LIVE
+        plane. `counted_row_steps` tallies the per-row steps actually
+        computed, so tests can pin that early stop SAVES compute."""
         import jax.numpy as jnp
         import numpy as np
 
@@ -100,21 +111,45 @@ class StubPagedRunner:
         tables = np.asarray(tables)
         pos = np.asarray(pos).copy()
         B = tokens.shape[0]
+        extended = temps is not None or early_stop
         toks = np.zeros((B, num_steps), np.int32)
         fins = np.zeros((B, num_steps), np.int32)
+        lives = np.zeros((B, num_steps), np.int32)
+        done = np.zeros((B,), bool)
+        cnt = np.zeros((B,), np.int32)
         for t in range(num_steps):
             for b in range(B):
+                if done[b]:
+                    continue          # frozen row: no write, no compute
                 p = int(pos[b])
                 page = int(tables[b, p // self.block_size])
                 k[page, p % self.block_size, 0, 0] = float(tokens[b])
                 hist = [k[int(tables[b, i // self.block_size]),
                           i % self.block_size, 0, 0] for i in range(p + 1)]
                 row = self._logits(hist)
-                toks[b, t] = int(np.argmax(row))
+                self.counted_row_steps += 1
+                if (temps is not None and float(temps[b]) > 0.0
+                        and np.all(np.isfinite(row))):
+                    from paddle_tpu.serving.engine import seeded_sample
+
+                    toks[b, t] = seeded_sample(
+                        row, int(seeds[b]), int(base_steps[b]) + int(cnt[b]),
+                        float(temps[b]), top_k, top_p)
+                else:
+                    toks[b, t] = int(np.argmax(row))
                 fins[b, t] = int(np.all(np.isfinite(row)))
-            tokens = toks[:, t].copy()
-            pos += 1
-        return (jnp.asarray(np.stack([toks, fins])),
+                lives[b, t] = 1
+                cnt[b] += 1
+                if early_stop:
+                    hit = (stop_ids is not None
+                           and toks[b, t] in set(int(x)
+                                                 for x in stop_ids[b]))
+                    if hit or cnt[b] >= int(remaining[b]):
+                        done[b] = True
+                tokens[b] = toks[b, t]
+                pos[b] += 1
+        planes = [toks, fins] + ([lives] if extended else [])
+        return (jnp.asarray(np.stack(planes)),
                 [(jnp.asarray(k), v)])
 
     def ragged_step(self, tokens, tables, start_pos, q_lens, pools,

@@ -1,0 +1,570 @@
+"""Zero-bubble engine loop (ISSUE 11): pipelined plan/commit stepping,
+seeded-temperature horizons inside the decode_multi scan, and the
+on-device early-stop flag.
+
+Contract mirrored from PRs 3-6: every knob here is a scheduling/
+transfer-count optimization, never a sampling change — `pipelined=True`
+overlaps host planning with the in-flight device launch (one launch in
+flight, committed next step), `horizon_sampling=True` runs
+temperature>0 batches device-resident with per-request seeded key
+schedules BIT-IDENTICAL to the per-step streams, and
+`horizon_early_stop=True` freezes a done row's KV writes on device so
+overshoot is neither computed nor replayed. All of it must stay
+token-for-token identical to `naive_generate` and to the unpipelined
+engine — including stop conditions, deadlines, aborts, fault-injected
+retries (dispatch-time AND drain-time), preemption + offload churn,
+and kill-and-restore with a launch in flight — under the invariant
+auditor, which must hold with one launch outstanding.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from _helpers import StubPagedRunner
+from paddle_tpu.serving import (
+    FaultInjector, SamplingParams, ServingEngine, naive_generate,
+)
+from paddle_tpu.serving import engine as engine_mod
+
+
+@pytest.fixture(autouse=True)
+def _audit_every_engine(monkeypatch):
+    """Every pipeline test runs under the invariant auditor — including
+    the steps that end with a launch still in flight."""
+    monkeypatch.setenv("PADDLE_TPU_SERVING_AUDIT", "1")
+
+
+def _drain(eng, pending=None, rng=None):
+    work = []
+    pending = list(pending or [])
+    while pending or eng.has_work():
+        if pending:
+            n = 1 if rng is None else int(rng.integers(0, 3))
+            for _ in range(n):
+                if pending:
+                    p, sp = pending.pop(0)
+                    work.append((eng.add_request(p, sp), p, sp))
+        eng.step()
+    return work
+
+
+def _outputs_match_naive(eng, work, runner, max_model_len=64):
+    for rid, p, sp in work:
+        ref = naive_generate(runner, p, sp, max_model_len=max_model_len)
+        got = eng.outputs()[rid].output_tokens
+        assert got == ref, (rid, got, ref)
+
+
+# ------------------------------------------------------------ knob units
+
+
+def test_snapshot_roundtrips_pipeline_knobs():
+    eng = ServingEngine(StubPagedRunner(), num_blocks=20, decode_horizon=4,
+                        pipelined=True, horizon_sampling=True,
+                        horizon_early_stop=True, spill_async=True,
+                        host_tier_pages=8)
+    state = json.loads(json.dumps(eng.snapshot()))
+    cfg = state["config"]
+    assert cfg["pipelined"] and cfg["horizon_sampling"]
+    assert cfg["horizon_early_stop"] and cfg["spill_async"]
+    eng2 = ServingEngine.restore(StubPagedRunner(), state)
+    assert (eng2.pipelined, eng2.horizon_sampling,
+            eng2.horizon_early_stop, eng2.spill_async) == (True,) * 4
+
+
+def test_one_launch_in_flight_invariant():
+    """The pipeline's depth is exactly one: a second decode launch can
+    never be dispatched before the previous one's commit drained it —
+    counted at the runner seam across a whole pipelined run."""
+    runner = StubPagedRunner(block_size=4, max_model_len=64)
+    state = {"outstanding": 0, "max_outstanding": 0, "commits": 0}
+
+    class Tracking:
+        def __getattr__(self, name):
+            return getattr(runner, name)
+
+        def decode_multi(self, *a, **kw):
+            state["outstanding"] += 1
+            state["max_outstanding"] = max(state["max_outstanding"],
+                                           state["outstanding"])
+            return runner.decode_multi(*a, **kw)
+
+        def decode(self, *a, **kw):
+            state["outstanding"] += 1
+            state["max_outstanding"] = max(state["max_outstanding"],
+                                           state["outstanding"])
+            return runner.decode(*a, **kw)
+
+    eng = ServingEngine(Tracking(), num_blocks=40, max_batch_size=3,
+                        max_model_len=64, decode_horizon=4, pipelined=True)
+    real = engine_mod._to_host
+
+    def draining(x):
+        # every blocking drain marks the launch as retired
+        if state["outstanding"]:
+            state["outstanding"] -= 1
+            state["commits"] += 1
+        return real(x)
+
+    engine_mod._to_host, orig = draining, engine_mod._to_host
+    try:
+        for i in range(3):
+            eng.add_request([1 + i, 2, 3], SamplingParams(max_tokens=8))
+        while eng.has_work():
+            eng.step()
+            assert state["outstanding"] <= 1
+    finally:
+        engine_mod._to_host = orig
+    assert state["max_outstanding"] == 1
+    assert state["commits"] > 0
+    assert eng._inflight is None
+    assert eng.pool.allocator.check_no_leaks()
+
+
+def test_pipelined_streams_match_unpipelined_and_naive():
+    """Token-for-token: pipelined vs unpipelined vs the oracle, with
+    planned_ahead_steps proving the plan phase actually ran under an
+    in-flight launch."""
+    outs = {}
+    for pipelined in (False, True):
+        runner = StubPagedRunner(block_size=4, max_model_len=64)
+        eng = ServingEngine(runner, num_blocks=40, max_batch_size=3,
+                            max_model_len=64, decode_horizon=4,
+                            pipelined=pipelined)
+        rng = np.random.default_rng(7)
+        pending = [(list(map(int, rng.integers(0, 31,
+                                               int(rng.integers(2, 9))))),
+                    SamplingParams(max_tokens=int(rng.integers(2, 14))))
+                   for _ in range(6)]
+        work = _drain(eng, pending)
+        outs[pipelined] = [eng.outputs()[rid].output_tokens
+                           for rid, _, _ in work]
+        if pipelined:
+            _outputs_match_naive(eng, work, runner)
+            m = eng.metrics.snapshot()
+            assert m["planned_ahead_steps"] > 0
+        assert eng.pool.allocator.check_no_leaks()
+    assert outs[False] == outs[True]
+
+
+def test_step_returns_previous_launch_tokens_and_flush_fences():
+    """The pipelined streaming surface shifts one step: the decode
+    launch dispatched by step N surfaces its tokens at step N+1 (or at
+    an explicit flush())."""
+    runner = StubPagedRunner(block_size=4, max_model_len=64)
+    eng = ServingEngine(runner, num_blocks=20, max_batch_size=2,
+                        max_model_len=64, decode_horizon=4, pipelined=True)
+    eng.add_request([3, 1, 4], SamplingParams(max_tokens=8))
+    ev1 = eng.step()   # admit + prefill (token 0 sync) + decode in flight
+    assert [e.index for e in ev1] == [0]
+    ev2 = eng.step()   # commits token 1, leaves horizon 1 in flight
+    assert [e.index for e in ev2] == [1]
+    assert eng._inflight is not None and eng._inflight.s == 4
+    fl = eng.flush()            # fence: commits the in-flight horizon
+    assert [e.index for e in fl] == [2, 3, 4, 5]
+    assert eng._inflight is None
+    eng.flush()                 # idempotent no-op
+    while eng.has_work():
+        eng.step()
+    ref = naive_generate(runner, [3, 1, 4],
+                         SamplingParams(max_tokens=8), max_model_len=64)
+    assert eng.outputs()[next(iter(eng.outputs()))].output_tokens == ref
+
+
+def test_auditor_holds_with_launch_in_flight():
+    """resilience.audit_engine must pass mid-pipeline: the in-flight
+    batch legitimately holds horizon pages past the context+1 cap."""
+    from paddle_tpu.serving.resilience import audit_engine
+
+    runner = StubPagedRunner(block_size=4, max_model_len=64)
+    eng = ServingEngine(runner, num_blocks=20, max_batch_size=2,
+                        max_model_len=64, decode_horizon=8, pipelined=True)
+    eng.add_request([3, 1, 4], SamplingParams(max_tokens=12))
+    eng.step()
+    eng.step()
+    assert eng._inflight is not None and eng._inflight.s > 1
+    audit_engine(eng)           # must not raise with a launch in flight
+    eng.flush()
+    audit_engine(eng)
+
+
+# ----------------------------------------- seeded-temperature horizons
+
+
+def test_seeded_temperature_horizon_matches_per_step_stream():
+    """The ISSUE 11 bit-exact pin (stub tier): a temperature>0 batch on
+    horizon_sampling=True reproduces the per-step seeded streams and
+    the oracle exactly, while actually running device-resident
+    horizons."""
+    outs = {}
+    for s, kw in ((1, {}), (6, {"horizon_sampling": True}),
+                  (6, {"horizon_sampling": True, "pipelined": True,
+                       "horizon_early_stop": True})):
+        runner = StubPagedRunner(block_size=4, max_model_len=64)
+        eng = ServingEngine(runner, num_blocks=40, max_batch_size=3,
+                            max_model_len=64, decode_horizon=s, **kw)
+        work = []
+        for i, temp in enumerate((0.0, 0.7, 1.3)):
+            sp = SamplingParams(max_tokens=11, temperature=temp,
+                                seed=50 + i if temp else None)
+            work.append((eng.add_request([5 + i, 9, 2], sp),
+                         [5 + i, 9, 2], sp))
+        while eng.has_work():
+            eng.step()
+        outs[(s, tuple(kw))] = [eng.outputs()[rid].output_tokens
+                                for rid, _, _ in work]
+        if s > 1:
+            assert eng.metrics.snapshot()["decode_horizon_steps"] > 0, \
+                "sampled batch must actually ride the horizon"
+            _outputs_match_naive(eng, work, runner)
+        assert eng.pool.allocator.check_no_leaks()
+    vals = list(outs.values())
+    assert vals[0] == vals[1] == vals[2]
+
+
+def test_heterogeneous_topk_falls_back_to_per_step():
+    """Mixed (top_k, top_p) among the sampled rows can't share one
+    static jit config — the batch takes the per-step path, still
+    token-exact."""
+    runner = StubPagedRunner(block_size=4, max_model_len=64)
+    eng = ServingEngine(runner, num_blocks=40, max_batch_size=2,
+                        max_model_len=64, decode_horizon=8,
+                        horizon_sampling=True)
+    work = [(eng.add_request([2, 3, 4], sp), [2, 3, 4], sp) for sp in
+            (SamplingParams(max_tokens=8, temperature=0.7, seed=5,
+                            top_k=4),
+             SamplingParams(max_tokens=8, temperature=0.7, seed=6,
+                            top_k=8))]
+    while eng.has_work():
+        eng.step()
+    assert eng.metrics.snapshot()["decode_horizon_steps"] == 0
+    _outputs_match_naive(eng, work, runner)
+
+
+# --------------------------------------------------- on-device early stop
+
+
+def test_early_stop_zero_overshoot_and_saves_compute():
+    """The on-device done bit: same tokens, horizon_overshoot_tokens
+    drops to 0 (nothing drained past a stop is live), and the stub's
+    per-row step counter proves frozen rows stopped computing."""
+    ref_runner = StubPagedRunner(block_size=4, max_model_len=64)
+    sp0 = SamplingParams(max_tokens=24)
+    ref = naive_generate(ref_runner, [5, 9], sp0, max_model_len=64)
+    stop = int(ref[3])                    # stop on the 4th token
+    sp = SamplingParams(max_tokens=24, stop_token_ids=(stop,))
+    counts = {}
+    for early in (False, True):
+        runner = StubPagedRunner(block_size=4, max_model_len=64)
+        eng = ServingEngine(runner, num_blocks=30, max_batch_size=2,
+                            max_model_len=64, decode_horizon=8,
+                            horizon_early_stop=early)
+        rid = eng.add_request([5, 9], sp)
+        while eng.has_work():
+            eng.step()
+        out = eng.outputs()[rid]
+        assert out.finish_reason == "stop"
+        assert out.output_tokens == naive_generate(
+            runner, [5, 9], sp, max_model_len=64)
+        m = eng.metrics.snapshot()
+        if early:
+            assert m["horizon_overshoot_tokens"] == 0
+        else:
+            assert m["horizon_overshoot_tokens"] > 0
+        counts[early] = runner.counted_row_steps
+        assert eng.pool.allocator.check_no_leaks()
+    assert counts[True] < counts[False], \
+        f"early stop must SAVE row-steps ({counts})"
+
+
+def test_early_stop_mixed_budgets_run_full_horizon():
+    """With per-row budgets a short row freezes on device instead of
+    trimming the whole batch's horizon (the old batch-wide max_tokens
+    cap) — the long row still rides full horizons, token-exact."""
+    runner = StubPagedRunner(block_size=4, max_model_len=64)
+    eng = ServingEngine(runner, num_blocks=40, max_batch_size=2,
+                        max_model_len=64, decode_horizon=8,
+                        horizon_early_stop=True, pipelined=True)
+    work = [(eng.add_request([2, 3, 4], sp), [2, 3, 4], sp) for sp in
+            (SamplingParams(max_tokens=3),
+             SamplingParams(max_tokens=21))]
+    while eng.has_work():
+        eng.step()
+    m = eng.metrics.snapshot()
+    assert m["horizon_overshoot_tokens"] == 0
+    _outputs_match_naive(eng, work, runner)
+    assert eng.pool.allocator.check_no_leaks()
+
+
+# -------------------------------------------------- faults and recovery
+
+
+def test_dispatch_time_fault_retries_token_exact():
+    """Injected device errors fire at dispatch (before the launch is
+    deferred): the standard retry path absorbs them under pipelining."""
+    runner = StubPagedRunner(block_size=4, max_model_len=64)
+    inj = FaultInjector(runner, error_every=4, error_target="decode")
+    eng = ServingEngine(inj, num_blocks=30, max_batch_size=2,
+                        max_model_len=64, decode_horizon=4, pipelined=True,
+                        retry_backoff_s=0.0)
+    sp = SamplingParams(max_tokens=12)
+    rid = eng.add_request([5, 9, 2], sp)
+    while eng.has_work():
+        eng.step()
+    assert eng.metrics.snapshot()["step_retries"] > 0
+    assert eng.outputs()[rid].output_tokens == naive_generate(
+        runner, [5, 9, 2], sp, max_model_len=64)
+    assert eng.pool.allocator.check_no_leaks()
+
+
+def test_drain_time_fault_rolls_back_and_reruns():
+    """A device error that only surfaces at the deferred drain (the
+    commit phase) rolls the pools back to the pre-launch snapshot and
+    reruns the step synchronously — token-exact, zero leaks."""
+    runner = StubPagedRunner(block_size=4, max_model_len=64)
+    eng = ServingEngine(runner, num_blocks=30, max_batch_size=2,
+                        max_model_len=64, decode_horizon=4, pipelined=True,
+                        retry_backoff_s=0.0)
+    sp = SamplingParams(max_tokens=12)
+    rid = eng.add_request([5, 9, 2], sp)
+    real = engine_mod._to_host
+    state = {"armed": 0, "fired": 0}
+
+    def flaky(x):
+        if state["armed"] > 0:
+            state["armed"] -= 1
+            state["fired"] += 1
+            raise RuntimeError("injected drain-time device error")
+        return real(x)
+
+    engine_mod._to_host = flaky
+    try:
+        steps = 0
+        while eng.has_work():
+            steps += 1
+            if steps == 3:          # arm while a horizon is in flight
+                assert eng._inflight is not None
+                state["armed"] = 1
+            eng.step()
+    finally:
+        engine_mod._to_host = real
+    assert state["fired"] == 1
+    assert eng.metrics.snapshot()["step_retries"] >= 1
+    assert eng.outputs()[rid].output_tokens == naive_generate(
+        runner, [5, 9, 2], sp, max_model_len=64)
+    assert eng.pool.allocator.check_no_leaks()
+
+
+def test_abort_mid_flight_discards_inflight_tokens():
+    """abort() between dispatch and commit: the in-flight tokens are
+    discarded wholesale (never half-committed), pages fully released."""
+    runner = StubPagedRunner(block_size=4, max_model_len=64)
+    eng = ServingEngine(runner, num_blocks=30, max_batch_size=2,
+                        max_model_len=64, decode_horizon=4, pipelined=True)
+    rid = eng.add_request([5, 9, 2], SamplingParams(max_tokens=20))
+    eng.step()
+    eng.step()
+    assert eng._inflight is not None
+    n_before = len(eng._requests[rid].output_tokens)
+    assert eng.abort(rid)
+    assert eng.outputs()[rid].finish_reason == "aborted"
+    while eng.has_work():
+        eng.step()
+    assert len(eng.outputs()[rid].output_tokens) == n_before
+    assert eng.pool.allocator.check_no_leaks()
+
+
+def test_kill_and_restore_with_launch_in_flight():
+    """snapshot() taken with a horizon in flight holds only COMMITTED
+    tokens; the restored engine regenerates the in-flight tail through
+    recompute — the continued stream is the oracle's exactly."""
+    runner = StubPagedRunner(block_size=4, max_model_len=64)
+    eng = ServingEngine(runner, num_blocks=30, max_batch_size=2,
+                        max_model_len=64, decode_horizon=4, pipelined=True,
+                        horizon_early_stop=True, horizon_sampling=True)
+    sps = [SamplingParams(max_tokens=14),
+           SamplingParams(max_tokens=14, temperature=0.8, seed=3)]
+    rids = [eng.add_request([5, 9, 2 + i], sp)
+            for i, sp in enumerate(sps)]
+    for _ in range(4):
+        eng.step()
+    assert eng._inflight is not None      # mid-flight crash point
+    state = json.loads(json.dumps(eng.snapshot()))
+    eng2 = ServingEngine.restore(StubPagedRunner(block_size=4,
+                                                 max_model_len=64), state)
+    while eng2.has_work():
+        eng2.step()
+    for i, rid in enumerate(rids):
+        assert eng2.outputs()[rid].output_tokens == naive_generate(
+            runner, [5, 9, 2 + i], sps[i], max_model_len=64)
+    assert eng2.pool.allocator.check_no_leaks()
+
+
+# ------------------------------------------------------- threaded spill
+
+
+def test_async_spill_preemption_token_exact():
+    """spill_async moves the device->host copy off the loop thread;
+    preemption churn + page-in resume stay token-exact and the
+    tier-aware auditor (which syncs the worker) stays green."""
+    runner = StubPagedRunner(block_size=4, max_model_len=40)
+    eng = ServingEngine(runner, num_blocks=11, max_batch_size=3,
+                        max_model_len=40, host_tier_pages=32,
+                        spill_async=True, pipelined=True, decode_horizon=4)
+    rng = np.random.default_rng(3)
+    pending = [(list(map(int, rng.integers(0, 31,
+                                           int(rng.integers(2, 8))))),
+                SamplingParams(max_tokens=int(rng.integers(4, 12))))
+               for _ in range(6)]
+    work = _drain(eng, pending)
+    m = eng.metrics.snapshot()
+    assert m["offload_spill_pages"] > 0, "workload must actually spill"
+    _outputs_match_naive(eng, work, runner, max_model_len=40)
+    assert eng.pool.allocator.check_no_leaks()
+    tier = eng.pool.host_tier
+    assert not tier._pending, "sync points must have joined every copy"
+
+
+def test_async_spill_readers_join_pending_copy():
+    """Unit: read_slot / free_slots / slot_hash on a slot whose copy is
+    still queued behind a slow worker job block until the bytes land."""
+    import threading
+
+    runner = StubPagedRunner(block_size=4, max_model_len=40)
+    # audit=False: the post-step auditor would sync() the tier and
+    # deadlock against the deliberately-stalled worker below
+    eng = ServingEngine(runner, num_blocks=11, max_batch_size=1,
+                        max_model_len=40, host_tier_pages=8,
+                        spill_async=True, audit=False)
+    tier = eng.pool.host_tier
+    gate = threading.Event()
+    try:
+        eng.add_request([1, 2, 3, 4, 5, 6, 7, 8],
+                        SamplingParams(max_tokens=8))
+        eng.step()                       # admit + prefill: kv pages live
+        req = next(iter(eng._requests.values()))
+        assert req.kv is not None and req.kv.pages
+        ex = tier._ensure_executor()
+        ex.submit(gate.wait)             # stall the single worker
+        slots = tier.spill_pages(list(req.kv.pages[:1]))
+        assert slots and tier._hash[slots[0]] is None   # copy queued
+        gate.set()
+        data = tier.read_slot(slots[0])  # joins the copy
+        assert tier._hash[slots[0]] is not None
+        assert float(data[0][0][0, 0, 0]) == 1.0    # first token landed
+        tier.free_slots(slots)
+    finally:
+        gate.set()                       # never strand the worker
+    eng.run()
+    assert eng.pool.allocator.check_no_leaks()
+
+
+# ------------------------------------------------------------- the fuzz
+
+
+def test_fuzz_pipeline_oracle_equivalence():
+    """200 trials: random horizons, prefill budgets, temperatures,
+    prefix cache, offload tier (sync + threaded spill), early stop,
+    pipelining, and mid-flight kill-and-restore — token streams must be
+    naive_generate's exactly, with zero device or host leaks, all under
+    the armed tier-aware auditor."""
+    rng = np.random.default_rng(1234)
+    for trial in range(200):
+        block = int(rng.choice([2, 4, 8]))
+        max_len = 48
+        runner = StubPagedRunner(block_size=block, max_model_len=max_len)
+        tier_pages = int(rng.choice([0, 4, 24]))
+        pages_per_seq = -(-max_len // block)
+        kw = dict(
+            num_blocks=max(pages_per_seq + 2, int(rng.integers(10, 30))),
+            max_batch_size=int(rng.integers(1, 4)),
+            max_model_len=max_len,
+            decode_horizon=int(rng.integers(1, 9)),
+            pipelined=bool(rng.integers(0, 2)),
+            horizon_sampling=bool(rng.integers(0, 2)),
+            horizon_early_stop=bool(rng.integers(0, 2)),
+            enable_prefix_cache=bool(rng.integers(0, 2)),
+            host_tier_pages=tier_pages,
+            spill_async=bool(tier_pages and rng.integers(0, 2)),
+            max_prefill_tokens_per_step=(
+                int(rng.integers(2, 9)) if rng.integers(0, 2) else None),
+        )
+        eng = ServingEngine(runner, **kw)
+        n_req = int(rng.integers(1, 6))
+        pending = []
+        for i in range(n_req):
+            plen = int(rng.integers(1, 10))
+            prompt = list(map(int, rng.integers(0, 31, plen)))
+            temp = float(rng.choice([0.0, 0.0, 0.9]))
+            sp = SamplingParams(
+                max_tokens=int(rng.integers(1, max_len - plen)),
+                temperature=temp,
+                seed=int(rng.integers(0, 1000)) if temp else None,
+                stop_token_ids=(tuple(map(int, rng.integers(0, 31, 2)))
+                                if rng.integers(0, 2) else ()))
+            pending.append((prompt, sp))
+        kill_at = (int(rng.integers(2, 8))
+                   if kw["pipelined"] and rng.integers(0, 4) == 0 else None)
+        work = []
+        steps = 0
+        while pending or eng.has_work():
+            for _ in range(int(rng.integers(0, 3))):
+                if pending:
+                    p, sp = pending.pop(0)
+                    work.append((eng.add_request(p, sp), p, sp))
+            eng.step()
+            steps += 1
+            if kill_at is not None and steps == kill_at:
+                state = json.loads(json.dumps(eng.snapshot()))
+                runner = StubPagedRunner(block_size=block,
+                                         max_model_len=max_len)
+                eng = ServingEngine.restore(runner, state)
+                kill_at = None
+        for rid, p, sp in work:
+            out = eng.outputs()[rid]
+            ref = naive_generate(runner, p, sp, max_model_len=max_len)
+            assert out.output_tokens == ref, (
+                trial, kw, rid, out.output_tokens, ref)
+        eng.release_prefix_cache()    # cached-free pages back first
+        assert eng.pool.allocator.check_no_leaks(), (trial, kw)
+        tier = eng.pool.host_tier
+        if tier is not None:
+            # surviving host slots must all belong to the tier's own
+            # prefix index (clear()-path demotions) — anything else is
+            # a host-RAM leak
+            assert set(tier._hash) == set(tier._prefix.values()), (
+                trial, "host slots leaked")
+
+
+# ------------------------------------------------- structural sync pins
+
+
+def test_pipelined_syncs_per_token_pin_at_s8():
+    """The acceptance-shaped structural pin: at s=8 on a pure-greedy
+    closed batch the pipelined engine performs at most
+    prefill_steps + ceil(tokens/8) blocking drains — host_syncs_per_
+    token lands well under the 0.15 bar for gen >> prompt-steps."""
+    import math
+
+    runner = StubPagedRunner(block_size=4, max_model_len=64)
+    eng = ServingEngine(runner, num_blocks=40, max_batch_size=2,
+                        max_model_len=64, decode_horizon=8, pipelined=True,
+                        horizon_early_stop=True)
+    gen = 40
+    rids = [eng.add_request([7, 3], SamplingParams(max_tokens=gen)),
+            eng.add_request([4, 4], SamplingParams(max_tokens=gen))]
+    while eng.has_work():
+        eng.step()
+    m = eng.metrics.snapshot()
+    toks = m["tokens_generated"]
+    assert toks == 2 * gen
+    # 2 prefill samples + 1 per-step admission decode + horizons
+    assert m["host_syncs"] <= 3 + math.ceil((toks - 3) / 8) + 1
+    assert m["host_syncs_per_token"] <= 0.15
+    assert m["planned_ahead_steps"] > 0
+    for rid in rids:
+        assert eng.outputs()[rid].output_tokens == naive_generate(
+            runner, eng.outputs()[rid].prompt_tokens,
+            SamplingParams(max_tokens=gen), max_model_len=64)

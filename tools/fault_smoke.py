@@ -52,6 +52,17 @@ pages. Records add host_syncs / host_syncs_per_token /
 decode_horizon_steps / horizon_overshoot_tokens. Mutually exclusive
 with --speculate (speculative batches fall back to per-step decode).
 
+ISSUE 11: `--pipelined` drills every class (plus preempt_storm) with
+the ZERO-BUBBLE loop on: host planning runs under the in-flight launch
+(one launch outstanding), half the requests sample at temperature 0.8
+so seeded horizons ride the decode_multi scan, the on-device stop flag
+freezes done rows, and spill I/O is threaded when the host tier is on.
+Injected failures now land either at dispatch (retried before the
+launch defers) or surface at the deferred drain (pool rollback + sync
+rerun) — recovery must stay token-exact against the same oracles, and
+the auditor holds with a launch in flight. Records add
+planned_ahead_steps / device_idle_fraction.
+
 ISSUE 7: `--tp N` drills all fault classes on a TENSOR-PARALLEL engine:
 the runner's weights and the paged K/V pools shard over a (data=1,
 model=N) mesh (8-way virtual CPU mesh off-TPU; n_kv_heads must divide
@@ -139,6 +150,15 @@ def build_engine(runner, args, **kw):
     kw.setdefault("decode_horizon", args.decode_horizon)
     kw.setdefault("host_tier_pages", args.offload)
     kw.setdefault("host_tier_headroom", args.offload > 0)
+    if getattr(args, "pipelined", False):
+        # zero-bubble drill (ISSUE 11): plan-under-launch pipelining,
+        # temperature>0 horizons, the on-device stop flag, and threaded
+        # spill I/O all armed at once — injected failures now land
+        # mid-in-flight-launch (dispatch-time) or at the deferred drain
+        kw.setdefault("pipelined", True)
+        kw.setdefault("horizon_sampling", True)
+        kw.setdefault("horizon_early_stop", True)
+        kw.setdefault("spill_async", args.offload > 0)
     return ServingEngine(runner, **kw)
 
 
@@ -197,7 +217,15 @@ def run_class(fault: str, runner, args) -> dict:
         if i % 2:
             prompt[:min(len(header), len(prompt) - 1)] = \
                 header[:len(prompt) - 1]
+        # pipelined drill (ISSUE 11): half the workload samples at
+        # temperature > 0 with a fixed seed — those rows now ride
+        # device-resident horizons (horizon_sampling) instead of the
+        # per-step fallback, and the oracle comparison still holds
+        # because the in-scan key schedule IS the naive_generate one
+        temp = 0.8 if getattr(args, "pipelined", False) and i % 2 else 0.0
         sp = SamplingParams(max_tokens=int(rng.integers(3, args.max_tokens)),
+                            temperature=temp,
+                            seed=1000 + i if temp else None,
                             timeout_s=timeout_s)
         work.append((eng.add_request(prompt, sp), prompt, sp))
 
@@ -293,6 +321,9 @@ def run_class(fault: str, runner, args) -> dict:
         "host_syncs_per_token": m["host_syncs_per_token"],
         "decode_horizon_steps": m["decode_horizon_steps"],
         "horizon_overshoot_tokens": m["horizon_overshoot_tokens"],
+        "pipelined": getattr(args, "pipelined", False),
+        "planned_ahead_steps": m["planned_ahead_steps"],
+        "device_idle_fraction": m["device_idle_fraction"],
         "injected": dict(getattr(target, "injected", {})) or None,
     }
 
@@ -461,6 +492,15 @@ def main() -> int:
                     help="multi-step decode: sync with the host every N "
                          "steps on pure-greedy decode batches "
                          "(runner.decode_multi; default 1 = per-step)")
+    ap.add_argument("--pipelined", action="store_true",
+                    help="zero-bubble drill (ISSUE 11): pipelined "
+                         "plan/commit loop + temperature>0 horizons + "
+                         "on-device early stop + threaded spill, with "
+                         "half the requests sampling at temp=0.8 — "
+                         "injected failures land mid-in-flight-launch "
+                         "and must recover token-exact; implies "
+                         "--decode-horizon 4 when left at 1, and adds "
+                         "the preempt_storm class to the default drill")
     ap.add_argument("--router", type=int, default=0, metavar="N",
                     help="tier drill (ISSUE 8): run the router fault "
                          "classes (replica_kill / replica_hang / "
@@ -487,6 +527,8 @@ def main() -> int:
                          "int8 with per-output-channel scales, dequant "
                          "in the matmul epilogue (default fp32)")
     args = ap.parse_args()
+    if args.pipelined and args.decode_horizon == 1:
+        args.decode_horizon = 4     # horizons must actually engage
     # refcounted invariants audited after every step, engine-independent
     os.environ["PADDLE_TPU_SERVING_AUDIT"] = "1"
 
@@ -538,8 +580,9 @@ def main() -> int:
               f"{'ALL RECOVERED' if all_ok else 'FAILURES'}")
         return 0 if all_ok else 1
     classes = [f.strip() for f in args.faults.split(",")]
-    if args.offload and args.faults == ",".join(FAULTS):
-        # the host tier on: the default drill gains the preempt storm
+    if (args.offload or args.pipelined) and args.faults == ",".join(FAULTS):
+        # the host tier (or the zero-bubble drill) on: the default
+        # drill gains the preempt storm class
         classes.append("preempt_storm")
     for fault in classes:
         if fault not in FAULTS + ("preempt_storm",):

@@ -1,4 +1,5 @@
-"""Per-phase cost breakdown of a flagship train step.
+"""Per-phase cost breakdown of a flagship train step — and, with
+`--serving` (ISSUE 11), of the serving engine loop.
 
 VERDICT r3 Missing #4: no committed step-time breakdown existed, so nobody
 could say whether the measured MFU was attention, input feed, launch
@@ -6,6 +7,18 @@ overhead, or missing fusion. This tool produces that evidence tier:
 
   python tools/step_breakdown.py [--model gpt|ernie] [--layers N]
       [--hidden H] [--batch B] [--seq S] [--out PERF_BREAKDOWN.md]
+
+Serving mode (`--serving`): profile a ServingEngine loop instead of a
+train step. Three arms of the same closed-batch GPT workload — s=1
+(the per-token loop), s=8 half-duplex (PR 6 horizons, plan blocks on
+drain), s=8 zero-bubble (pipelined + on-device early stop) — each
+reporting the per-step wall-time split the engine's own instruments
+measure: host planning (and how much of it ran OVERLAPPED under an
+in-flight launch), blocking drain waits (the host-blocked-on-device
+share), and launch/replay. The acceptance evidence is the UNOVERLAPPED
+host-plan share at s=8 pipelined (< 5%), committed into
+PERF_BREAKDOWN.md between the serving-breakdown sentinels (the train
+table above it is left untouched).
 
 Methodology
 -----------
@@ -215,6 +228,143 @@ def emit_markdown(meta, totals, trace_path, out_path):
     print("\n".join(lines))
 
 
+SERVING_BEGIN = "<!-- serving-breakdown:begin -->"
+SERVING_END = "<!-- serving-breakdown:end -->"
+
+
+def run_serving(layers: int, hidden: int, batch: int, requests: int,
+                prompt: int, gen: int, vocab: int):
+    """Profile three serving-loop arms; returns (meta, arms). Each arm
+    is the engine's own per-step instrument split: host planning
+    (overlapped vs not), blocking drain waits, launch/replay = rest."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.serving import GPTRunner, SamplingParams, ServingEngine
+
+    backend = jax.default_backend()
+    paddle.seed(0)
+    max_len = prompt + gen
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                    num_layers=layers, num_heads=max(hidden // 64, 1),
+                    max_seq_len=max_len, dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+    block_size = min(16, max_len)
+    runner = GPTRunner(model, block_size=block_size, max_model_len=max_len)
+    pages = -(-max_len // block_size)
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(0, vocab, prompt))
+               for _ in range(requests)]
+
+    def arm(name, s, **kw):
+        eng = ServingEngine(runner, num_blocks=batch * pages + 1,
+                            max_batch_size=batch, max_model_len=max_len,
+                            decode_horizon=s, **kw)
+        t0 = _time.time()
+        for i, p in enumerate(prompts):
+            eng.add_request(p, SamplingParams(max_tokens=gen),
+                            request_id=f"r{i}")
+        eng.run()
+        wall = _time.time() - t0
+        m = eng.metrics.snapshot()
+        step_s = m["step_seconds"] or 1e-9
+        plan = m["host_plan_seconds"]
+        over = m["overlapped_plan_seconds"]
+        drain = m["drain_wait_seconds"]
+        return {"arm": name, "s": s, "wall_s": wall,
+                "tokens": m["tokens_generated"],
+                "tokens_per_sec": m["tokens_generated"] / wall,
+                "steps": m["decode_steps"],
+                "step_seconds": step_s,
+                "host_plan_share": plan / step_s,
+                "host_plan_unoverlapped_share": (plan - over) / step_s,
+                "drain_wait_share": drain / step_s,
+                "launch_replay_share": max(0.0, (step_s - plan - drain)
+                                           / step_s),
+                "host_syncs_per_token": m["host_syncs_per_token"],
+                "planned_ahead_steps": m["planned_ahead_steps"],
+                "device_idle_fraction": m["device_idle_fraction"]}
+
+    specs = [("s1_per_step", 1, {}),
+             ("s8_half_duplex", 8, {}),
+             ("s8_zero_bubble", 8, {"pipelined": True,
+                                    "horizon_early_stop": True})]
+    for name, s, kw in specs:            # warmup/compile pass
+        arm(name, s, **kw)
+    arms = [arm(name, s, **kw) for name, s, kw in specs]
+    meta = {"backend": backend, "layers": layers, "hidden": hidden,
+            "batch": batch, "requests": requests, "prompt": prompt,
+            "gen": gen}
+    return meta, arms
+
+
+def emit_serving_markdown(meta, arms, out_path):
+    """Write the serving-loop split between the sentinels in out_path,
+    leaving everything else (the train-step table) untouched."""
+    lines = [
+        SERVING_BEGIN,
+        "",
+        "## Serving engine loop breakdown (ISSUE 11)",
+        "",
+        f"Generated by `tools/step_breakdown.py --serving` on backend "
+        f"**{meta['backend']}**"
+        + (" — CPU **proxy**: the 'device' computes on the same host "
+           "cores, so wall-clock gains from overlap are muted; the "
+           "SHARE split below is the structural evidence (on TPU the "
+           "unoverlapped host share is device idle time)"
+           if meta["backend"] != "tpu" else " (real chip)"),
+        "",
+        f"- workload: GPT {meta['layers']}L/{meta['hidden']}h, "
+        f"batch {meta['batch']}, {meta['requests']} reqs x "
+        f"{meta['prompt']}p+{meta['gen']}g tokens",
+        "",
+        "| arm | tok/s | syncs/token | host-plan | unoverlapped plan "
+        "| drain wait | launch+replay | planned-ahead steps |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for a in arms:
+        lines.append(
+            f"| {a['arm']} | {a['tokens_per_sec']:.0f} | "
+            f"{a['host_syncs_per_token']:.3f} | "
+            f"{a['host_plan_share']:.1%} | "
+            f"{a['host_plan_unoverlapped_share']:.1%} | "
+            f"{a['drain_wait_share']:.1%} | "
+            f"{a['launch_replay_share']:.1%} | "
+            f"{a['planned_ahead_steps']:.0f} |")
+    zb = arms[-1]
+    verdict = ("MET" if zb["host_plan_unoverlapped_share"] < 0.05
+               else "NOT MET (CPU-proxy caveat applies)")
+    lines += [
+        "",
+        f"Acceptance: unoverlapped host-plan share at s=8 pipelined = "
+        f"**{zb['host_plan_unoverlapped_share']:.2%}** (< 5% bar: "
+        f"{verdict}). Shares are fractions of total step wall time, "
+        "measured by the engine's own step/plan/drain instruments.",
+        "",
+        SERVING_END,
+    ]
+    block = "\n".join(lines)
+    try:
+        with open(out_path) as f:
+            text = f.read()
+    except FileNotFoundError:
+        text = ""
+    if SERVING_BEGIN in text and SERVING_END in text:
+        pre = text.split(SERVING_BEGIN)[0]
+        post = text.split(SERVING_END, 1)[1]
+        text = pre + block + post
+    else:
+        text = text.rstrip() + "\n\n" + block + "\n"
+    with open(out_path, "w") as f:
+        f.write(text)
+    print(block)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="gpt", choices=("gpt", "ernie"))
@@ -226,6 +376,15 @@ def main():
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--trace-dir", default="perf_trace")
     ap.add_argument("--out", default="PERF_BREAKDOWN.md")
+    ap.add_argument("--serving", action="store_true",
+                    help="profile the serving engine loop instead of a "
+                         "train step (ISSUE 11): s=1 / s=8 half-duplex "
+                         "/ s=8 zero-bubble arms; writes the "
+                         "host-plan/drain/launch split between the "
+                         "serving-breakdown sentinels in --out")
+    ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument("--prompt", type=int, default=64)
+    ap.add_argument("--gen", type=int, default=96)
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu) — the env-var "
                     "route is clobbered back to axon at interpreter "
@@ -236,6 +395,13 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+
+    if args.serving:
+        meta, arms = run_serving(args.layers, args.hidden, args.batch,
+                                 args.requests, args.prompt, args.gen,
+                                 args.vocab)
+        emit_serving_markdown(meta, arms, args.out)
+        return
 
     meta = run_and_trace(args.model, args.layers, args.hidden, args.batch,
                          args.seq, args.vocab, args.iters, args.trace_dir)
