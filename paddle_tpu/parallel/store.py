@@ -7,15 +7,28 @@ create_or_get_global_tcp_store (python/paddle/distributed/parallel.py:1134).
 The C++ core (paddle_tpu/csrc/tcp_store.cpp) is compiled on first use with
 g++ into paddle_tpu/lib/libtcpstore.so and bound via ctypes; a pure-python
 socket fallback keeps the API available if no toolchain is present.
+
+Hardening for slow process spawns (ISSUE 12 satellite): the python
+fallback is a REAL socket store now (it used to be an in-process dict,
+which silently broke any cross-process rendezvous on a toolchain-less
+host), every read/write loops over partial I/O and retries EINTR, and
+the connect path retries with backoff until `connect_timeout` — a
+replica process that takes seconds to import jax before the master
+binds (or vice versa) rendezvouses instead of dying on the first
+ECONNREFUSED. `PADDLE_STORE_CONNECT_TIMEOUT_S` / the `connect_timeout`
+kwarg configure it; op timeouts stay on `timeout`.
 """
 
 from __future__ import annotations
 
 import ctypes
+import errno
 import os
+import socket as _socket
 import struct
 import subprocess
 import threading
+import time
 from typing import Optional
 
 _LIB = None
@@ -68,14 +81,19 @@ class TCPStore:
     the KV server; every rank (master included) is a client."""
 
     def __init__(self, host: str, port: int, is_master: bool = False,
-                 world_size: int = 1, timeout: float = 300.0):
+                 world_size: int = 1, timeout: float = 300.0,
+                 connect_timeout: Optional[float] = None):
+        if connect_timeout is None:
+            connect_timeout = float(os.environ.get(
+                "PADDLE_STORE_CONNECT_TIMEOUT_S", timeout))
         self.host = host
         self.is_master = is_master
         self._server = None
         self._py_impl = None
         lib = _load_lib()
         if lib is None:
-            self._py_impl = _PyStore(host, port, is_master, timeout)
+            self._py_impl = _PyStore(host, port, is_master, timeout,
+                                     connect_timeout)
             self.port = self._py_impl.port
             return
         if is_master:
@@ -85,11 +103,14 @@ class TCPStore:
             port = lib.ts_server_port(self._server)
         self.port = port
         self._client = lib.ts_client_connect(
-            host.encode(), port, int(timeout * 1000))
+            host.encode(), port, int(connect_timeout * 1000))
         if not self._client:
             if self._server:
                 lib.ts_server_stop(self._server)
-            raise TimeoutError(f"TCPStore: cannot reach {host}:{port}")
+            raise TimeoutError(
+                f"TCPStore: cannot reach {host}:{port} within "
+                f"{connect_timeout:.1f}s (connect_timeout / "
+                "PADDLE_STORE_CONNECT_TIMEOUT_S)")
 
     def _req(self, op: int, key: str, val: bytes = b"") -> bytes:
         if self._py_impl is not None:
@@ -155,57 +176,180 @@ class TCPStore:
             pass
 
 
+def _py_send_all(sock, data: bytes) -> None:
+    """sendall with an explicit EINTR retry loop (PEP 475 retries EINTR
+    unless a signal handler raised; the loop makes it unconditional)."""
+    view = memoryview(data)
+    while view:
+        try:
+            n = sock.send(view)
+        except InterruptedError:
+            continue
+        except OSError as e:  # pragma: no cover — platform-dependent
+            if e.errno == errno.EINTR:
+                continue
+            raise
+        if n == 0:
+            raise ConnectionError("store socket closed mid-send")
+        view = view[n:]
+
+
+def _py_recv_exact(sock, n: int) -> bytes:
+    """Read exactly n bytes, looping over partial recvs and EINTR —
+    a SIGCHLD from a dying replica must never tear a store frame."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        try:
+            r = sock.recv_into(view[got:], n - got)
+        except InterruptedError:
+            continue
+        except OSError as e:  # pragma: no cover — platform-dependent
+            if e.errno == errno.EINTR:
+                continue
+            raise
+        if r == 0:
+            raise ConnectionError(
+                f"store socket closed mid-recv ({got}/{n} bytes)")
+        got += r
+    return bytes(buf)
+
+
 class _PyStore:
-    """Pure-python fallback (threading + dict); single-process only."""
+    """Pure-python socket fallback: the master hosts a tiny KV server
+    (one handler thread per connection — worlds are small), every rank
+    connects as a client with retry-until-connect_timeout. Same op
+    vocabulary as the C++ core; WAIT/GET block server-side on a
+    condition so a slow-spawning peer's set() wakes them."""
 
-    _stores = {}
-    _lock = threading.Lock()
-
-    def __init__(self, host, port, is_master, timeout):
-        self.key = (host, port)
-        self.port = port
-        with _PyStore._lock:
-            if is_master:
-                _PyStore._stores[self.key] = {
-                    "data": {}, "cv": threading.Condition()}
+    def __init__(self, host, port, is_master, timeout, connect_timeout):
         self.timeout = timeout
+        self._server_sock = None
+        self._threads = []
+        self._stop = threading.Event()
+        if is_master:
+            self._data = {}
+            self._cv = threading.Condition()
+            srv = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+            srv.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+            srv.bind(("0.0.0.0", port))
+            srv.listen(64)
+            self._server_sock = srv
+            port = srv.getsockname()[1]
+            t = threading.Thread(target=self._accept_loop, daemon=True,
+                                 name="pystore-accept")
+            t.start()
+            self._threads.append(t)
+        self.port = port
+        # connect with retry: the master may still be importing /
+        # binding when a fast client comes up (and vice versa for slow
+        # replica spawns) — ECONNREFUSED retries until connect_timeout
+        deadline = time.monotonic() + connect_timeout
+        delay = 0.01
+        while True:
+            try:
+                self._sock = _socket.create_connection(
+                    (host, port), timeout=max(0.1, connect_timeout))
+                break
+            except OSError as e:
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"TCPStore(py): cannot reach {host}:{port} within "
+                        f"{connect_timeout:.1f}s (connect_timeout / "
+                        f"PADDLE_STORE_CONNECT_TIMEOUT_S): {e}") from e
+                time.sleep(delay)
+                delay = min(delay * 2, 0.25)
+        self._sock.settimeout(None)
+        self._req_lock = threading.Lock()
 
-    @property
-    def _store(self):
-        return _PyStore._stores[self.key]
+    # ------------------------------------------------------ server side
 
-    def request(self, op, key, val):
-        st = self._store
-        with st["cv"]:
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server_sock.accept()
+            except InterruptedError:
+                continue
+            except OSError:
+                return                       # closed during shutdown
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True, name="pystore-conn")
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                head = _py_recv_exact(conn, 9)
+                op, klen, vlen = struct.unpack("<BII", head)
+                key = _py_recv_exact(conn, klen).decode()
+                val = _py_recv_exact(conn, vlen)
+                try:
+                    out = self._handle(op, key, val)
+                    status = b"\x00"
+                except TimeoutError as e:
+                    out, status = str(e).encode(), b"\x01"
+                _py_send_all(conn, status + struct.pack("<I", len(out))
+                             + out)
+        except (ConnectionError, OSError):
+            pass                             # client went away
+        finally:
+            conn.close()
+
+    def _handle(self, op, key, val) -> bytes:
+        with self._cv:
             if op == _OP_SET:
-                st["data"][key] = val
-                st["cv"].notify_all()
+                self._data[key] = val
+                self._cv.notify_all()
                 return b""
             if op in (_OP_GET, _OP_WAIT):
-                ok = st["cv"].wait_for(lambda: key in st["data"],
+                ok = self._cv.wait_for(lambda: key in self._data,
                                        timeout=self.timeout)
                 if not ok:
-                    raise TimeoutError(f"wait for {key!r} timed out")
-                return st["data"][key] if op == _OP_GET else b""
+                    raise TimeoutError(f"wait for {key!r} timed out "
+                                       f"after {self.timeout:.1f}s")
+                return self._data[key] if op == _OP_GET else b""
             if op == _OP_ADD:
-                cur = struct.unpack("<q", st["data"].get(
+                cur = struct.unpack("<q", self._data.get(
                     key, b"\x00" * 8))[0] + struct.unpack("<q", val)[0]
-                st["data"][key] = struct.pack("<q", cur)
-                st["cv"].notify_all()
-                return st["data"][key]
+                self._data[key] = struct.pack("<q", cur)
+                self._cv.notify_all()
+                return self._data[key]
             if op == _OP_CHECK:
-                return b"\x01" if key in st["data"] else b"\x00"
+                return b"\x01" if key in self._data else b"\x00"
             if op == _OP_TRYGET:
-                if key in st["data"]:
-                    return b"\x01" + st["data"][key]
+                if key in self._data:
+                    return b"\x01" + self._data[key]
                 return b""
             if op == _OP_DELETE:
-                st["data"].pop(key, None)
+                self._data.pop(key, None)
                 return b""
         raise ValueError(op)
 
+    # ------------------------------------------------------ client side
+
+    def request(self, op, key, val):
+        k = key.encode()
+        with self._req_lock:
+            _py_send_all(self._sock,
+                         struct.pack("<BII", op, len(k), len(val))
+                         + k + val)
+            status = _py_recv_exact(self._sock, 1)
+            (n,) = struct.unpack("<I", _py_recv_exact(self._sock, 4))
+            out = _py_recv_exact(self._sock, n) if n else b""
+        if status == b"\x01":
+            raise TimeoutError(out.decode() or f"store op {op} timed out")
+        return out
+
     def close(self):
-        pass
+        self._stop.set()
+        for s in (getattr(self, "_sock", None), self._server_sock):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:  # pragma: no cover
+                    pass
 
 
 _global_store: Optional[TCPStore] = None

@@ -100,6 +100,21 @@ backfill, drain/redistribute of the dead replica's queue). Replicas
 may each carry their own `(data=1, model=tp)` sub-mesh
 (`replica_submeshes`), finally mapping the serving mesh's data axis.
 
+DISAGGREGATED serving (ISSUE 12): `ServingRouter(backend="process")`
+makes every replica an OS process — `serving/launch.py`
+(ReplicaLauncher + the EngineClient proxy) spawns
+`python -m paddle_tpu.serving.replica` children rendezvoused through
+the TCPStore barrier and drives each over a length-prefixed socket
+protocol (`serving/wire.py`) whose payloads are the engine's existing
+snapshot/inject/extract serializations. `prefill_replicas=N` splits
+the tier: prefill-role replicas admit + chunk-prefill + sample the
+first token, then hand the KV off — pages spill to the HostKVTier,
+raw page bytes + scale rows + CRC content hashes cross the wire, the
+decode replica verifies-at-receive and resumes through the ordinary
+page-in path, token-exact including int8 codes. The Supervisor
+recovers dead PROCESSES (waitpid probe, socket-EOF ReplicaGoneError,
+SIGSTOP hang fencing) with the same fence/restore/backfill machinery.
+
 Entry points: `paddle_tpu.inference.create_serving_engine(model)` /
 `create_serving_router(model, replicas=N)` are the bridges from the
 Predictor world; `tools/serving_smoke.py` is a runnable demo;
@@ -126,7 +141,15 @@ from paddle_tpu.serving.model_runner import (  # noqa: F401
 )
 from paddle_tpu.serving.resilience import (  # noqa: F401
     FaultInjector, InjectedDeviceError, InvariantViolation, QueueFullError,
-    ReplicaCrashError, audit_engine, audit_router,
+    ReplicaCrashError, ReplicaGoneError, audit_engine, audit_router,
+)
+# process-per-engine replicas (ISSUE 12): the launcher spawns replica
+# processes (paddle_tpu/serving/replica.py command loops) rendezvoused
+# through the TCPStore barrier; EngineClient is the in-router proxy.
+# Imported lazily-by-name here to keep `import paddle_tpu.serving`
+# light — launch pulls subprocess/socket plumbing only
+from paddle_tpu.serving.launch import (  # noqa: F401
+    EngineClient, ReplicaLauncher,
 )
 from paddle_tpu.serving.router import (  # noqa: F401
     EngineReplica, RouterMetrics, RouterOutput, ServingRouter,
@@ -150,7 +173,9 @@ __all__ = [
     "HostKVTier", "InjectedDeviceError", "InvariantViolation",
     "KVCachePool", "LlamaRunner", "NgramProposer", "OffloadRecord",
     "PagedModelRunner", "PrefixCache",
-    "QueueFullError", "ReplicaCrashError", "Request", "RequestOutput",
+    "EngineClient", "ReplicaLauncher",
+    "QueueFullError", "ReplicaCrashError", "ReplicaGoneError",
+    "Request", "RequestOutput",
     "RequestState", "RouterMetrics", "RouterOutput", "SCRATCH_PAGE",
     "SamplingParams", "SequenceKV", "ServingEngine", "ServingRouter",
     "SpecLayout", "StreamDetokenizer", "Supervisor", "TokenEvent",

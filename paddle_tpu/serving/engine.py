@@ -106,7 +106,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.serving.detokenize import StreamDetokenizer
-from paddle_tpu.serving.kv_cache import KVCachePool, SCRATCH_PAGE
+from paddle_tpu.serving.kv_cache import (
+    KVCachePool, OffloadRecord, SCRATCH_PAGE,
+)
 from paddle_tpu.serving.metrics import EngineMetrics
 from paddle_tpu.serving.model_runner import PagedModelRunner, runner_for
 from paddle_tpu.serving.resilience import QueueFullError, audit_engine
@@ -205,11 +207,16 @@ class _InflightLaunch:
     launch consumed, kept so a drain-time device error can roll back and
     rerun the step through the normal retry path."""
 
-    kind: str                    # "decode" | "decode_multi"
+    kind: str                    # "decode" | "decode_multi" | "ragged"
     batch: list                  # [(Request, slot), ...] at launch
     result: object               # logits [B, V] or packed [2|3, B, s]
     prev_pools: list             # pool snapshot for drain-failure rollback
     s: int = 1                   # horizon length (decode_multi)
+    # fused ragged launches (ISSUE 12 satellite) carry their span list
+    # — (req, start, end, prop, slot) per chunk/decode span as of
+    # launch time — so the commit can replay chunk-coverage advances
+    # and completing-chunk samples exactly like the sync path
+    spans: Optional[list] = None
 
 
 class ServingEngine:
@@ -364,6 +371,30 @@ class ServingEngine:
                            (horizon_overshoot_tokens -> ~0), and the
                            scheduler funds only min(s, remaining)
                            pages per row. Off by default.
+      role                 disaggregated-serving role (ISSUE 12):
+                           "mixed" (default — the engine both prefills
+                           and decodes), "prefill" (the engine runs
+                           admission + chunked prefill, samples each
+                           request's FIRST token, then STAGES the
+                           request for handoff: its KV pages spill to
+                           the HostKVTier (content-hashed, scale rows
+                           included) and the request waits in the
+                           handoff buffer until extract_handoff() ships
+                           it — raw page bytes over the wire — to a
+                           sibling, which import_handoff()s the pages
+                           into its own tier and continues decoding via
+                           the normal offload page-in path, token-exact
+                           including int8 codes because pages are
+                           COPIED, never recomputed), or "decode" (a
+                           routing designation: the engine behaves like
+                           "mixed" — it must still prefill for the
+                           recompute fallback — but the router sends it
+                           handoffs instead of fresh prompts). A
+                           prefill engine without a host tier (or with
+                           a full one) still hands off, pages-less: the
+                           decode side recomputes
+                           (handoff_recompute_fallbacks), exactness
+                           untouched.
       spill_async          threaded spill I/O (ISSUE 11 satellite):
                            preemption's device->host page copy runs on
                            a worker thread against the immutable
@@ -409,6 +440,7 @@ class ServingEngine:
                  horizon_sampling: bool = False,
                  horizon_early_stop: bool = False,
                  spill_async: bool = False,
+                 role: str = "mixed",
                  num_speculative_tokens: int = 0,
                  spec_max_ngram: int = 3,
                  spec_min_ngram: int = 1,
@@ -469,6 +501,16 @@ class ServingEngine:
         self.horizon_sampling = bool(horizon_sampling)
         self.horizon_early_stop = bool(horizon_early_stop)
         self.spill_async = bool(spill_async)
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(f"role={role!r}; expected 'mixed', "
+                             "'prefill', or 'decode'")
+        self.role = role
+        # handoff buffer (ISSUE 12): requests a prefill-role engine has
+        # finished prefilling (first token sampled), staged for
+        # migration — request id -> OffloadRecord of its spilled pages
+        # (None = pages could not ride; the receiver recomputes). The
+        # requests stay in self._requests until extract_handoff()
+        self._handoffs: Dict[str, Optional["OffloadRecord"]] = {}
         # the pipelined loop's single in-flight launch (ISSUE 11):
         # dispatched at the end of one step, drained + replayed at the
         # next step's commit phase (or by flush())
@@ -595,7 +637,15 @@ class ServingEngine:
         / error): release whatever it holds, record the RequestOutput with
         the partial generation, bump the matching failure counter."""
         now = self.metrics.clock()
-        if req.state is RequestState.RUNNING:
+        if req.request_id in self._handoffs:
+            # staged for handoff (ISSUE 12): not in the waiting queue —
+            # release the spilled host slots and finish in place
+            rec = self._handoffs.pop(req.request_id)
+            if rec is not None and self.pool.host_tier is not None:
+                self.pool.host_tier.free_slots(rec.slots)
+            req.state = RequestState.FINISHED
+            req.finish_reason = reason
+        elif req.state is RequestState.RUNNING:
             self.scheduler.finish(req, reason)
         elif req.state is RequestState.WAITING:
             self.scheduler.remove_waiting(req)
@@ -829,8 +879,25 @@ class ServingEngine:
             # pre-launch pools can never lose them
             events.extend(self._commit_inflight())
             self._fence_pagein(admitted)
-            # a commit quarantine can end a planned request; drop it
-            plan = [(r, a, b) for r, a, b in plan if not r.done]
+            # re-slice the prefill plan AFTER the commit: a committed
+            # fused ragged launch advanced chunk coverage (planning
+            # from the stale slice would recompute — and double-sample
+            # — the same chunk), and a commit quarantine can end a
+            # planned request. The pre-commit plan's only job was to
+            # measure overlapped host work; identical by construction
+            # when the commit was a plain decode/horizon
+            plan = self.scheduler.prefill_plan()
+
+        if self.role == "prefill":
+            # disaggregated serving (ISSUE 12): every request that
+            # finished its prefill (phase flipped to decode, first
+            # token sampled) leaves the running set here — pages
+            # spilled to the host tier, request parked in the handoff
+            # buffer for the router to ship to a decode replica. Runs
+            # AFTER the commit (a pipelined launch's members are fully
+            # replayed, nothing is in flight) and BEFORE this step's
+            # dispatch, so a staged request never joins a new launch.
+            self._stage_handoffs()
 
         # ---- EXECUTE phase: this step's launches
         fused = bool(self.ragged_batch and plan
@@ -850,7 +917,10 @@ class ServingEngine:
         elif fused:
             for v in self.scheduler.reserve_decode():
                 self.metrics.preemptions.inc()
-            events.extend(self._ragged_step_with_recovery())
+            # pipelined + ragged_batch compose (ISSUE 12 satellite):
+            # the fused launch defers exactly like a decode launch
+            events.extend(self._ragged_step_with_recovery(
+                defer=self.pipelined))
         else:
             for req, start, end in plan:
                 ev = self._prefill_chunk_with_recovery(req, start, end)
@@ -979,7 +1049,8 @@ class ServingEngine:
 
     def _ragged_step_with_recovery(
             self, proposals: Optional[Dict[Request, List[int]]] = None,
-            include_chunks: bool = True) -> List[TokenEvent]:
+            include_chunks: bool = True,
+            defer: bool = False) -> List[TokenEvent]:
         """ONE mixed ragged runner call for this step: every planned
         prefill chunk and every decode-phase request rides its batch
         slot as a (start, q_len) span into runner.ragged_step, which the
@@ -994,7 +1065,17 @@ class ServingEngine:
         forks happen before the call and are idempotent on retry); once
         retries are exhausted the YOUNGEST spanning request is
         quarantined and the batch is rebuilt, so the loop is bounded
-        exactly like the sequential decode path."""
+        exactly like the sequential decode path.
+
+        With `defer` (pipelined + ragged_batch composing, ISSUE 12
+        satellite) the fused launch is dispatched and left IN FLIGHT
+        exactly like a deferred decode: the next step's commit phase
+        (or flush()) drains it and replays the span bookkeeping through
+        _finish_ragged — chunk coverage advances, completing-chunk
+        samples, fused decode appends — and the next step's prefill
+        plan is re-sliced AFTER that commit, so no chunk is ever
+        computed twice. Verify spans (proposals) never defer:
+        speculation keeps its per-step fallback for now."""
         from paddle_tpu.serving.model_runner import bucket_len
 
         full = proposals is not None
@@ -1005,34 +1086,40 @@ class ServingEngine:
             # reservation may have preempted, quarantine may have removed
             spans = []
             if include_chunks:
-                spans += [(req, start, end, None) for req, start, end
+                # slot captured at launch time: the commit of a
+                # deferred launch must index the drained logits by the
+                # slots the launch actually used
+                spans += [(req, start, end, None, req.slot)
+                          for req, start, end
                           in self.scheduler.prefill_plan()]
             for req in self.scheduler.decode_ready():
                 prop = proposals.get(req, []) if full else []
                 spans.append((req, req.num_context - 1,
-                              req.num_context + len(prop), prop))
+                              req.num_context + len(prop), prop,
+                              req.slot))
             if not spans:
                 return []
             B = self.max_batch_size
             P = self.max_pages_per_seq
-            T = bucket_len(max(end - start for _, start, end, _ in spans))
+            T = bucket_len(max(end - start
+                               for _, start, end, _, _ in spans))
             tokens = np.zeros((B, T), np.int32)
             starts = np.zeros((B,), np.int32)
             qlens = np.zeros((B,), np.int32)
             tables = np.full((B, P), SCRATCH_PAGE, np.int32)
-            for req, start, end, prop in spans:
+            for req, start, end, prop, s in spans:
                 # no write may land on a shared page (idempotent: a
                 # forked page is already private when the call retries)
                 cow = req.kv.ensure_writable(start, end)
                 if cow:
                     self.metrics.cow_copies.inc(cow)
-                s = req.slot
                 span_toks = (req.context_tokens[start:end] if prop is None
                              else req.output_tokens[-1:] + list(prop))
                 tokens[s, :end - start] = span_toks
                 starts[s] = start
                 qlens[s] = end - start
                 tables[s, :len(req.kv.pages)] = req.kv.pages
+            prev = self.pool.pools
             try:
                 if full:
                     logits, new_pools = self.runner.ragged_step(
@@ -1056,20 +1143,44 @@ class ServingEngine:
                 delay = self.retry_backoff_s
         self.pool.pools = new_pools
         self.metrics.batch_occupancy.observe(len(spans))
+        if defer and not full:
+            # pipelined fused step (ISSUE 12 satellite): leave the
+            # launch in flight; the next step's commit (or flush())
+            # drains and replays the span bookkeeping
+            self._inflight = _InflightLaunch(
+                "ragged", [(r, sl) for r, _, _, _, sl in spans],
+                logits, prev, 1, spans=spans)
+            return []
+        return self._finish_ragged(spans, logits, full)
+
+    def _finish_ragged(self, spans, logits, full: bool = False,
+                       grid=None) -> List[TokenEvent]:
+        """Resolve one drained fused ragged launch: the per-span
+        bookkeeping half of _ragged_step_with_recovery — chunk
+        coverage advances + prefix registration, completing-chunk and
+        fused-decode sampling, verify-span acceptance. Shared by the
+        synchronous path and the pipelined commit (which passes the
+        already-drained grid); a span member that finished while the
+        launch was in flight (pipelined abort/deadline) is skipped —
+        its drained logits are discarded, never half-committed."""
         # vectorized greedy/finite pass over the whole call's logits
         # ([B, V] or [B, T, V]); rows transfer lazily only when needed
-        am, fin = greedy_grid(logits)
-        self.metrics.host_syncs.inc()
+        if grid is None:
+            grid = self._timed_drain(lambda: greedy_grid(logits))
+            self.metrics.host_syncs.inc()
+        am, fin = grid
         host: Dict[str, np.ndarray] = {}
 
         def _rows() -> np.ndarray:
             if "l" not in host:
-                host["l"] = _to_host(logits)
+                host["l"] = self._timed_drain(lambda: _to_host(logits))
                 self.metrics.host_syncs.inc()
             return host["l"]
 
         events: List[TokenEvent] = []
-        for req, start, end, prop in spans:
+        for req, start, end, prop, s in spans:
+            if req.done:
+                continue
             if prop is None:                    # prefill chunk span
                 req.kv.num_tokens = end
                 self.metrics.prefill_tokens.inc(end - start)
@@ -1078,7 +1189,7 @@ class ServingEngine:
                     self.pool.prefix_cache.register_seq(req.kv,
                                                         req.context_tokens)
                 if end == req.num_context:      # completing chunk
-                    s, r = req.slot, end - start - 1
+                    r = end - start - 1
                     if full:
                         tok = self._resolve_token(
                             req, len(req.output_tokens), am[s, r],
@@ -1097,7 +1208,6 @@ class ServingEngine:
                 if self.pool.prefix_cache is not None:
                     self.pool.prefix_cache.register_seq(req.kv,
                                                         req.context_tokens)
-                s = req.slot
                 tok = self._resolve_token(req, len(req.output_tokens),
                                           am[s], fin[s],
                                           lambda s=s: _rows()[s])
@@ -1106,7 +1216,6 @@ class ServingEngine:
                     continue
                 events.append(self._append_token(req, tok))
             else:                               # verify span (ISSUE 5)
-                s = req.slot
                 self._accept_verify(
                     req, prop, am[s], fin[s],
                     lambda i, s=s: _rows()[s, i], events)
@@ -1532,7 +1641,7 @@ class ServingEngine:
             return []
         self._inflight = None
         try:
-            if inf.kind == "decode":
+            if inf.kind in ("decode", "ragged"):
                 grid = self._timed_drain(lambda: greedy_grid(inf.result))
             else:
                 drained = self._timed_drain(lambda: _to_host(inf.result))
@@ -1542,10 +1651,18 @@ class ServingEngine:
             self.pool.pools = inf.prev_pools
             if inf.kind == "decode":
                 return self._decode_with_recovery()
+            if inf.kind == "ragged":
+                # rerun the fused step synchronously from live state:
+                # chunk coverage never advanced (that happens below, at
+                # commit), so the rebuilt spans recompute the identical
+                # chunks and decode feeds — retry-exact like decode
+                return self._ragged_step_with_recovery()
             return self._decode_multi_with_recovery(inf.s)
         self.metrics.host_syncs.inc()
         if inf.kind == "decode":
             return self._finish_decode(inf.batch, inf.result, grid)
+        if inf.kind == "ragged":
+            return self._finish_ragged(inf.spans, inf.result, False, grid)
         return self._replay_horizon(inf.batch, drained, inf.s)
 
     def flush(self) -> List[TokenEvent]:
@@ -1630,6 +1747,123 @@ class ServingEngine:
 
     # --------------------------------------------- migration (router tier)
 
+    # --- prefill/decode handoff (ISSUE 12): the KV-carrying migration.
+    # A preemption's OffloadRecord + inject_request were already a
+    # migration primitive WITHIN one engine; these four methods stretch
+    # the same machinery across an engine boundary: spill -> serialize
+    # slots (raw page bytes + scale rows + content hashes) -> import
+    # into the sibling's tier -> inject with the record attached, after
+    # which the sibling's ordinary admission page-in path takes over.
+
+    def _stage_handoffs(self) -> None:
+        """Park every request that completed its prefill this step
+        (decode phase, >= 1 sampled token) in the handoff buffer: KV
+        pages spill to the host tier from page 0 (shared prefix pages
+        included — the record must be self-contained on a sibling),
+        device pages and the batch slot are released. Coverage is
+        clamped to context-1 exactly like preemption, so the receiving
+        replica always has at least one token to compute — the position
+        whose logits it samples the next token from."""
+        tier = self.pool.host_tier
+        for req in [r for r in self.scheduler.running
+                    if r.phase == "decode" and r.output_tokens
+                    and not r.done]:
+            rec = None
+            if tier is not None:
+                covered = min(req.kv.num_tokens, req.num_context - 1)
+                rec = tier.spill_sequence(req.kv, covered,
+                                          include_registered=True)
+            self.scheduler.release_running(req)
+            req.phase = "handoff"
+            req.offload = None
+            self._handoffs[req.request_id] = rec
+            self.metrics.handoffs_out.inc()
+            if rec is not None:
+                self.metrics.handoff_pages_out.inc(len(rec.slots))
+
+    def handoff_ready(self) -> List[str]:
+        """Request ids staged for handoff, oldest first — what the
+        router polls after each step on a prefill replica."""
+        return list(self._handoffs)
+
+    def extract_handoff(self, request_id: str):
+        """Remove a staged handoff and return (state, payload): the
+        standard migration state dict plus the page payload — per-layer
+        stacked page arrays (raw bytes, scale rows included on int8
+        pools) and per-slot CRC content hashes for receive-time
+        verification. payload is None when no pages rode along (no
+        tier / tier full); the receiver then recomputes. The host
+        slots are freed here — the payload owns the bytes now."""
+        if request_id not in self._handoffs:
+            raise KeyError(f"request {request_id!r} is not staged for "
+                           "handoff")
+        rec = self._handoffs.pop(request_id)
+        req = self._requests[request_id]
+        now = self.metrics.clock()
+        state = {
+            "request_id": req.request_id,
+            "prompt_tokens": list(req.prompt_tokens),
+            "output_tokens": list(req.output_tokens),
+            "sampling": req.sampling,
+            "arrival_index": req.arrival_index,
+            "num_preemptions": req.num_preemptions,
+            "elapsed_s": now - req.arrival_time,
+            "first_token_elapsed_s": (
+                req.first_token_time - req.arrival_time
+                if req.first_token_time is not None else None),
+        }
+        payload = None
+        tier = self.pool.host_tier
+        if rec is not None and tier is not None:
+            payload = {
+                "start_page": rec.start_page,
+                "covered_tokens": rec.covered_tokens,
+                "hashes": [tier.slot_hash(s) for s in rec.slots],
+                "layers": tier.export_slots(rec.slots),
+            }
+            tier.free_slots(rec.slots)
+        del self._requests[request_id]
+        self._detoks.pop(request_id, None)
+        return state, payload
+
+    def import_handoff(self, state: dict, payload: Optional[dict]) -> str:
+        """Accept a handed-off request: write the page payload into
+        this engine's host tier (content hashes RE-VERIFIED against
+        the written bytes — a corrupted transfer raises, it is never
+        served) and inject the request with the reconstructed
+        OffloadRecord attached. Admission then takes the ordinary
+        offload page-in path — fresh device pages, staged device_put,
+        fence before compute — and the continued stream is token-exact
+        including int8 codes because the pages are copies, not
+        recompute. A payload that cannot land (no tier here, tier
+        full) degrades to the recompute path, counted."""
+        rec = None
+        tier = self.pool.host_tier
+        if payload is not None and tier is not None:
+            slots = tier.import_slots(payload["layers"],
+                                      payload["hashes"])
+            if slots is not None:
+                rec = OffloadRecord(
+                    start_page=int(payload["start_page"]),
+                    covered_tokens=int(payload["covered_tokens"]),
+                    slots=slots)
+        if rec is None:
+            self.metrics.handoff_recompute_fallbacks.inc()
+        else:
+            self.metrics.handoff_pages_in.inc(len(rec.slots))
+        self.metrics.handoffs_in.inc()
+        return self.inject_request(
+            state["prompt_tokens"], state["sampling"],
+            request_id=state["request_id"],
+            output_tokens=state["output_tokens"],
+            arrival_index=(int(state["arrival_index"])
+                           if state.get("arrival_index") is not None
+                           else None),
+            num_preemptions=int(state.get("num_preemptions", 0)),
+            elapsed_s=float(state.get("elapsed_s", 0.0)),
+            first_token_elapsed_s=state.get("first_token_elapsed_s"),
+            offload=rec)
+
     def inject_request(self, prompt_tokens: Sequence[int],
                        sampling: Optional[SamplingParams] = None, *,
                        request_id: Optional[str] = None,
@@ -1637,7 +1871,8 @@ class ServingEngine:
                        arrival_index: Optional[int] = None,
                        num_preemptions: int = 0,
                        elapsed_s: float = 0.0,
-                       first_token_elapsed_s: Optional[float] = None) -> str:
+                       first_token_elapsed_s: Optional[float] = None,
+                       offload: Optional[OffloadRecord] = None) -> str:
         """Admit a request WITH prior generation state — the restore /
         migration primitive (ISSUE 8). The request re-enters the queue
         carrying its prompt AND partial `output_tokens`; admission
@@ -1672,6 +1907,12 @@ class ServingEngine:
         if first_token_elapsed_s is not None:
             req.first_token_time = req.arrival_time + \
                 float(first_token_elapsed_s)
+        if offload is not None:
+            # a handed-off request arrives with its KV already resident
+            # in THIS engine's host tier (import_handoff): admission
+            # connects the record and pages in instead of recomputing
+            req.offload = offload
+            req.phase = "offloaded"
         self._requests[req.request_id] = req
         self.scheduler.add(req)
         self.metrics.requests_added.inc()
@@ -1757,9 +1998,14 @@ class ServingEngine:
 
         # resume priority: running requests first (in admission order —
         # they are the oldest in flight), then the waiting queue left to
-        # right (its head already encodes preempted-first recycle order)
+        # right (its head already encodes preempted-first recycle order).
+        # Handoff-staged requests (ISSUE 12) ride along as plain
+        # waiters: their spilled host pages die with the crash like all
+        # host state, so a restored engine re-prefills them — and on a
+        # restored prefill-role engine they simply re-stage
         reqs = [req_state(r) for r in (*self.scheduler.running,
                                        *self.scheduler.waiting)]
+        reqs += [req_state(self._requests[rid]) for rid in self._handoffs]
         return {
             "version": 1,
             "config": {
@@ -1796,6 +2042,9 @@ class ServingEngine:
                 "horizon_sampling": self.horizon_sampling,
                 "horizon_early_stop": self.horizon_early_stop,
                 "spill_async": self.spill_async,
+                # disaggregated role (ISSUE 12): a restored prefill
+                # replica must keep prefilling-and-handing-off
+                "role": self.role,
                 "num_speculative_tokens": self.num_speculative_tokens,
                 "spec_max_ngram": self.spec_max_ngram,
                 "spec_min_ngram": self.spec_min_ngram,
@@ -1855,6 +2104,7 @@ class ServingEngine:
                   horizon_sampling=cfg.get("horizon_sampling", False),
                   horizon_early_stop=cfg.get("horizon_early_stop", False),
                   spill_async=cfg.get("spill_async", False),
+                  role=cfg.get("role", "mixed"),
                   num_speculative_tokens=cfg.get("num_speculative_tokens", 0),
                   spec_max_ngram=cfg.get("spec_max_ngram", 3),
                   spec_min_ngram=cfg.get("spec_min_ngram", 1),

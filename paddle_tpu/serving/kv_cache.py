@@ -589,11 +589,18 @@ class HostKVTier:
 
     def content_hash(self, slot: int) -> int:
         """Deterministic hash over the slot's bytes across every layer
-        buffer — recorded at spill time, re-checked by the auditor."""
+        buffer — recorded at spill time, re-checked by the auditor.
+        CRC-accumulated (not python hash()) so it is stable ACROSS
+        PROCESSES: the prefill->decode handoff (ISSUE 12) sends these
+        hashes over the wire and the receiving replica re-verifies them
+        against the bytes it wrote — a salted per-process hash could
+        never catch a transfer corruption."""
+        import zlib
+
         h = 0x9E3779B9
         for layer in self._bufs:
             for buf in layer:
-                h = hash((h, buf[slot].tobytes()))
+                h = zlib.crc32(buf[slot].tobytes(), h)
         return h
 
     # ------------------------------------------------------------ spill
@@ -639,8 +646,9 @@ class HostKVTier:
             self.metrics.offload_spill_pages.inc(n)
         return slots
 
-    def spill_sequence(self, kv: "SequenceKV",
-                       covered_tokens: int) -> Optional[OffloadRecord]:
+    def spill_sequence(self, kv: "SequenceKV", covered_tokens: int,
+                       include_registered: bool = False
+                       ) -> Optional[OffloadRecord]:
         """Spill a preemption victim's exclusively-owned pages (the ones
         release() would send back to the free list) covering token
         positions [registered_pages * bs, covered_tokens). Leading
@@ -649,23 +657,33 @@ class HostKVTier:
         through evict_hook and re-match from the host index). Returns
         None when nothing spillable exists (then the existing recompute
         path simply applies); a partial fit trims covered_tokens down
-        to the spilled page boundary."""
+        to the spilled page boundary.
+
+        `include_registered=True` (the prefill->decode handoff, ISSUE
+        12) spills the WHOLE page range from page 0, shared pages
+        included: the spill only READS the pages, and the receiving
+        replica owns its own pool, so refcounts are irrelevant — what
+        matters is that the record is self-contained (start_page=0)
+        and connects on a sibling whose prefix cache may hold none of
+        the sender's pages."""
         bs = self.pool.block_size
         covered = min(int(covered_tokens), kv.num_tokens)
-        start = kv.registered_pages
+        start = 0 if include_registered else kv.registered_pages
         end = -(-covered // bs) if covered > 0 else 0
         if end <= start:
             return None
         cand = kv.pages[start:end]
-        alloc = self.pool.allocator
-        if any(alloc.refcount(p) != 1 for p in cand):
-            # a shared page past the registered range would break the
-            # record's contiguity — never expected (COW keeps writes
-            # private), so decline loudly-by-metrics rather than corrupt
-            self.fallbacks += 1
-            if self.metrics is not None:
-                self.metrics.offload_recompute_fallbacks.inc()
-            return None
+        if not include_registered:
+            alloc = self.pool.allocator
+            if any(alloc.refcount(p) != 1 for p in cand):
+                # a shared page past the registered range would break the
+                # record's contiguity — never expected (COW keeps writes
+                # private), so decline loudly-by-metrics rather than
+                # corrupt
+                self.fallbacks += 1
+                if self.metrics is not None:
+                    self.metrics.offload_recompute_fallbacks.inc()
+                return None
         slots = self.spill_pages(cand)
         if not slots:
             return None
@@ -712,6 +730,60 @@ class HostKVTier:
         self._wait_slot(slot)
         return [tuple(np.array(buf[slot]) for buf in layer)
                 for layer in self._bufs]
+
+    def export_slots(self, slots: Sequence[int]
+                     ) -> List[Tuple[np.ndarray, ...]]:
+        """Stacked host copies of several slots, in pool-array layout:
+        per layer a tuple of [len(slots), *page_shape] arrays — the
+        prefill->decode handoff's wire payload (ISSUE 12). Raw page
+        bytes plus scale rows in pool order; any pending async spill of
+        a slot is joined first."""
+        for s in slots:
+            self._wait_slot(s)
+        return [tuple(np.stack([buf[s] for s in slots]) for buf in layer)
+                for layer in self._bufs]
+
+    def import_slots(self, layer_data, hashes: Sequence[int]
+                     ) -> Optional[List[int]]:
+        """Write wire-received page payloads into fresh slots — the
+        receiving half of the prefill->decode handoff (ISSUE 12).
+        `layer_data` mirrors export_slots' layout; `hashes` are the
+        sender's per-slot content hashes, RE-VERIFIED here against the
+        bytes actually written (content_hash is CRC-based, stable
+        across processes) — a mismatch frees everything and raises
+        ValueError rather than ever serving corrupted KV. Returns None
+        when the tier cannot hold the whole payload (the caller then
+        degrades to the recompute path: partial imports would leave an
+        unconnectable record)."""
+        n = len(hashes)
+        if n == 0:
+            return []
+        if n > len(self._free):
+            self.dropped_pages += n
+            if self.metrics is not None:
+                self.metrics.host_tier_drops.inc(n)
+            return None
+        slots = self._free[:n]
+        del self._free[:n]
+        for layer_bufs, data in zip(self._bufs, layer_data):
+            for buf, arr in zip(layer_bufs, data):
+                buf[slots] = np.asarray(arr).astype(buf.dtype, copy=False)
+        bad = []
+        for j, s in enumerate(slots):
+            h = self.content_hash(s)
+            self._hash[s] = h
+            if h != int(hashes[j]):
+                bad.append(s)
+        if bad:
+            self.free_slots(slots)
+            raise ValueError(
+                f"handoff content-hash mismatch on {len(bad)} of {n} "
+                f"pages (slots {bad}) — page bytes corrupted in "
+                "transfer; refusing to serve them")
+        self.spilled_pages += n
+        if self.metrics is not None:
+            self.metrics.offload_spill_pages.inc(n)
+        return slots
 
     def free_slots(self, slots: Sequence[int]) -> None:
         """Return slots to the (sorted) free list, bumping each slot's

@@ -1,0 +1,530 @@
+"""Process-per-engine replica launcher + client proxy (ISSUE 12
+tentpole).
+
+The PR 8 router bench proved that thread-per-engine replicas sharing
+one host process and one GIL scale pure compute at exactly 1.0x. This
+module is the fix's plumbing, modeled on the reference's
+`distributed/launch` per-rank spawn (launch/main.py: controllers spawn
+processes, rendezvous through a KV store):
+
+  ReplicaLauncher   hosts a TCPStore (the PR 7 rendezvous barrier),
+                    spawns `python -m paddle_tpu.serving.replica`
+                    children, waits for each child's published command
+                    port with a DEADLINE — a rendezvous timeout raises
+                    naming exactly which ranks never arrived and which
+                    of them already died with what exit code — then
+                    connects and initializes each engine over the wire.
+  EngineClient      the parent-side proxy: implements the slice of the
+                    ServingEngine surface the ServingRouter drives
+                    (add_request/abort/step/flush/snapshot/inject/
+                    extract/handoff/audit plus cached scheduler/pool
+                    shims), one socket command per call. All socket
+                    I/O happens under the router's per-replica lock;
+                    the cached stats (queue depth, running count,
+                    allocator counters, has_work) are refreshed from
+                    every reply and read LOCK-FREE by routing and the
+                    supervisor's hang detector — a blocked step can
+                    never deadlock health checks.
+
+Death model: a replica process that exits (SIGKILL, OOM, crash) or
+stops answering surfaces as ReplicaGoneError — a ReplicaCrashError
+subclass, so it rides the exact same BaseException contract the
+in-process crash drill established: it escapes the router worker's
+step loop, fences the replica, and hands recovery to the Supervisor
+(fresh process, restore from the last crash-safe snapshot, registry
+backfill, redistribution, new epoch).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from paddle_tpu.serving.resilience import QueueFullError, ReplicaGoneError
+from paddle_tpu.serving.wire import (
+    events_from_wire, handoff_from_wire, handoff_to_wire, outputs_from_wire,
+    recv_msg, sampling_to_dict, send_msg, state_from_wire, state_to_wire,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _repo_pythonpath(env: dict) -> dict:
+    """Make sure the child can `import paddle_tpu` exactly as we did."""
+    import paddle_tpu
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(paddle_tpu.__file__)))
+    parts = [root] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                      if p]
+    env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
+    return env
+
+
+# ----------------------------------------------------------- client shims
+
+
+class _ReqShim:
+    __slots__ = ("request_id", "arrival_index", "done")
+
+    def __init__(self, request_id, arrival_index, done=False):
+        self.request_id = request_id
+        self.arrival_index = arrival_index
+        self.done = done
+
+
+class _SchedulerShim:
+    """Lock-free cached view of the remote scheduler — enough surface
+    for the router's load scoring (`queue_depth`, `len(running)`) and
+    redistribution (`waiting` ids)."""
+
+    def __init__(self):
+        self.queue_depth = 0
+        self.running: Tuple[int, ...] = ()
+        self.waiting: Tuple[_ReqShim, ...] = ()
+
+
+class _AllocatorShim:
+    def __init__(self, client):
+        self._client = client
+        self.num_free = 0
+        self.num_evictable = 0
+        self.num_usable = 1
+
+    def check_no_leaks(self) -> bool:
+        return self._client._call({"cmd": "check_no_leaks"})[0]["no_leaks"]
+
+
+class _PoolShim:
+    def __init__(self, client):
+        self.block_size = 16
+        self.allocator = _AllocatorShim(client)
+
+
+class _MetricsShim:
+    """Remote metrics with a last-good cache, and a NEVER-BLOCK rule:
+    the supervisor snapshots a replica's counters on its way into
+    recovery — at that moment a SIGSTOP'd replica's worker may be
+    parked inside a long recv HOLDING the command lock, and waiting
+    for it would stall the whole recovery by the command timeout. Lock
+    busy, replica dead, or fetch failed all answer from the cache."""
+
+    def __init__(self, client):
+        self._client = client
+        self._last: dict = {}
+
+    def snapshot(self) -> dict:
+        c = self._client
+        if c.dead or not c._io_lock.acquire(blocking=False):
+            return dict(self._last)
+        c._io_lock.release()
+        try:
+            self._last = c._call(
+                {"cmd": "metrics"},
+                timeout=min(c.command_timeout_s, 30.0))[0]["snapshot"]
+        except BaseException:           # dead replica: serve the cache
+            pass
+        return dict(self._last)
+
+
+class EngineClient:
+    """ServingEngine facade over one replica process."""
+
+    def __init__(self, proc: subprocess.Popen, sock: socket.socket,
+                 rank: int, key: str, command_timeout_s: float = 120.0):
+        self.proc = proc
+        self.sock = sock
+        self.rank = rank
+        self.key = key
+        self.command_timeout_s = command_timeout_s
+        self.dead = False
+        self._io_lock = threading.Lock()
+        self._outputs: Dict[str, object] = {}
+        self._requests: Dict[str, _ReqShim] = {}
+        self.scheduler = _SchedulerShim()
+        self.pool = _PoolShim(self)
+        self.metrics = _MetricsShim(self)
+        self.max_batch_size = 1
+        self.role = "mixed"
+        self._has_work = False
+        self._handoffs: Tuple[str, ...] = ()
+
+    # --------------------------------------------------------- plumbing
+
+    def _gone(self, why: str) -> ReplicaGoneError:
+        self.dead = True
+        rc = self.proc.poll()
+        detail = (f"exit code {rc}" if rc is not None
+                  else "process alive but channel dead")
+        return ReplicaGoneError(
+            f"replica {self.key} (pid {self.proc.pid}) gone: {why} "
+            f"[{detail}]")
+
+    def _call(self, header: dict, bufs=(),
+              timeout: Optional[float] = None):
+        """One command round trip. Serialized by _io_lock (the router's
+        per-replica lock already serializes engine touches; this is the
+        backstop for metrics/audit reads from other threads). Raises
+        ReplicaGoneError on any transport failure or timeout."""
+        if self.dead:
+            raise ReplicaGoneError(f"replica {self.key} already fenced")
+        with self._io_lock:
+            try:
+                self.sock.settimeout(timeout if timeout is not None
+                                     else self.command_timeout_s)
+                send_msg(self.sock, header, bufs)
+                reply, frames = recv_msg(self.sock)
+            except (ConnectionError, socket.timeout, OSError) as e:
+                raise self._gone(f"{type(e).__name__}: {e}") from e
+        self._apply(reply)
+        if not reply.get("ok", False):
+            err = reply.get("error", "unknown")
+            if err == "queue_full":
+                raise QueueFullError(reply.get("message", "queue full"))
+            if err == "KeyError":
+                raise KeyError(reply.get("message", ""))
+            if err in ("ValueError", "handoff_corrupt"):
+                raise ValueError(reply.get("message", ""))
+            raise RuntimeError(f"replica {self.key} command "
+                               f"{header['cmd']!r} failed: {reply}")
+        return reply, frames
+
+    def _apply(self, reply: dict) -> None:
+        """Fold a reply's stats + fresh outputs into the cached view."""
+        stats = reply.get("stats")
+        if stats:
+            sch = self.scheduler
+            sch.queue_depth = int(stats["queue_depth"])
+            sch.running = tuple(range(int(stats["running"])))
+            sch.waiting = tuple(
+                self._requests.get(rid) or _ReqShim(rid, -1)
+                for rid in stats["waiting_ids"])
+            al = self.pool.allocator
+            al.num_free = int(stats["num_free"])
+            al.num_evictable = int(stats["num_evictable"])
+            al.num_usable = int(stats["num_usable"])
+            self._has_work = bool(stats["has_work"])
+            self._handoffs = tuple(stats.get("handoffs", ()))
+        outs = reply.get("outputs")
+        if outs:
+            for rid, o in outputs_from_wire(outs).items():
+                self._outputs[rid] = o
+                shim = self._requests.get(rid)
+                if shim is None:
+                    shim = self._requests[rid] = _ReqShim(rid, -1)
+                shim.done = True
+
+    # --------------------------------------------------- engine surface
+
+    def init(self, spec: dict, engine_kw: dict,
+             snapshot: Optional[dict] = None,
+             init_timeout_s: Optional[float] = None) -> None:
+        reply, _ = self._call(
+            {"cmd": "init", "spec": spec, "engine_kw": engine_kw,
+             "index": self.rank, "snapshot": snapshot},
+            timeout=init_timeout_s or max(self.command_timeout_s, 300.0))
+        self.pool.block_size = int(reply["block_size"])
+        self.max_batch_size = int(reply["max_batch_size"])
+        self.role = reply.get("role", "mixed")
+        for rid, info in reply.get("requests", {}).items():
+            self._requests[rid] = _ReqShim(
+                rid, int(info["arrival_index"]), bool(info["done"]))
+
+    def add_request(self, prompt_tokens, sampling,
+                    request_id: Optional[str] = None) -> str:
+        reply, _ = self._call({
+            "cmd": "submit",
+            "prompt_tokens": [int(t) for t in prompt_tokens],
+            "sampling": sampling_to_dict(sampling),
+            "request_id": request_id})
+        rid = reply["request_id"]
+        self._requests[rid] = _ReqShim(rid, int(reply["arrival_index"]))
+        return rid
+
+    def abort(self, request_id: str, reason: str = "aborted") -> bool:
+        reply, _ = self._call({"cmd": "abort", "request_id": request_id,
+                               "reason": reason})
+        return bool(reply["aborted"])
+
+    def has_work(self) -> bool:
+        # LOCK-FREE cached read (the supervisor's hang detector): a
+        # replica blocked mid-step must not require a round trip here
+        return self._has_work
+
+    def step(self):
+        reply, _ = self._call({"cmd": "step"})
+        return events_from_wire(reply.get("events", ()))
+
+    def flush(self):
+        reply, _ = self._call({"cmd": "flush"})
+        return events_from_wire(reply.get("events", ()))
+
+    def snapshot(self) -> dict:
+        return self._call({"cmd": "snapshot"})[0]["snapshot"]
+
+    def inject_request(self, prompt_tokens, sampling=None, *,
+                       request_id=None, output_tokens=(),
+                       arrival_index=None, num_preemptions=0,
+                       elapsed_s=0.0, first_token_elapsed_s=None) -> str:
+        from paddle_tpu.serving.scheduler import SamplingParams
+
+        state = {
+            "request_id": request_id,
+            "prompt_tokens": [int(t) for t in prompt_tokens],
+            "output_tokens": [int(t) for t in output_tokens],
+            "sampling": sampling or SamplingParams(),
+            "arrival_index": arrival_index,
+            "num_preemptions": num_preemptions,
+            "elapsed_s": elapsed_s,
+            "first_token_elapsed_s": first_token_elapsed_s,
+        }
+        reply, _ = self._call({"cmd": "inject",
+                               "state": state_to_wire(state)})
+        rid = reply["request_id"]
+        self._requests.setdefault(
+            rid, _ReqShim(rid, arrival_index if arrival_index is not None
+                          else -1))
+        return rid
+
+    def extract_request(self, request_id: str) -> dict:
+        reply, _ = self._call({"cmd": "extract",
+                               "request_id": request_id})
+        self._requests.pop(request_id, None)
+        return state_from_wire(reply["state"])
+
+    def handoff_ready(self) -> List[str]:
+        return list(self._handoffs)
+
+    def extract_handoff(self, request_id: str):
+        reply, frames = self._call({"cmd": "handoff_extract",
+                                    "request_id": request_id})
+        self._requests.pop(request_id, None)
+        return (state_from_wire(reply["state"]),
+                handoff_from_wire(reply, frames))
+
+    def import_handoff(self, state: dict, payload) -> str:
+        head, frames = handoff_to_wire(payload)
+        head.update({"cmd": "handoff_inject",
+                     "state": state_to_wire(state)})
+        reply, _ = self._call(head, frames)
+        rid = reply["request_id"]
+        self._requests.setdefault(
+            rid, _ReqShim(rid, int(state.get("arrival_index") or -1)))
+        return rid
+
+    def release_prefix_cache(self) -> int:
+        return int(self._call(
+            {"cmd": "release_prefix_cache"})[0]["released"])
+
+    def remote_audit(self) -> Optional[str]:
+        """Run audit_engine inside the replica process; returns the
+        problem string (or None when clean) — how audit_router reaches
+        across the process boundary."""
+        return self._call({"cmd": "audit"})[0]["problems"]
+
+    def ping(self) -> None:
+        self._call({"cmd": "ping"})
+
+    # --------------------------------------------------------- teardown
+
+    def proc_dead(self) -> bool:
+        """waitpid-style liveness probe (non-blocking)."""
+        return self.proc.poll() is not None
+
+    def shutdown(self, timeout_s: float = 5.0) -> None:
+        try:
+            self._call({"cmd": "shutdown"}, timeout=timeout_s)
+        except BaseException:
+            pass
+        self.kill(timeout_s)
+
+    def kill(self, timeout_s: float = 5.0) -> None:
+        """SIGKILL the replica process and reap it — also the recovery
+        path for a SIGSTOP'd (hung) process: SIGKILL applies to stopped
+        processes, so the fence always completes."""
+        self.dead = True
+        try:
+            if self.proc.poll() is None:
+                self.proc.kill()
+            self.proc.wait(timeout=timeout_s)
+        except Exception:  # pragma: no cover
+            pass
+        try:
+            self.sock.close()
+        except OSError:  # pragma: no cover
+            pass
+
+
+# ------------------------------------------------------------- launcher
+
+
+class ReplicaLauncher:
+    """Spawns replica processes and rendezvouses them through one
+    TCPStore the launcher hosts (port 0 — the OS picks; children get
+    the real port on their command line).
+
+    spec         {"factory": "module:callable", "factory_kw": {...},
+                  "sys_path": [...]} — resolved INSIDE each child; the
+                  factory is called as factory(rank, **factory_kw)
+                  (or factory(**factory_kw) for index-blind ones)
+    engine_kw    ServingEngine kwargs, JSON-serializable (objects like
+                  tokenizers/metrics cannot cross a process boundary —
+                  a loud TypeError here beats a pickle surprise later)
+    """
+
+    def __init__(self, spec: dict, engine_kw: dict, *,
+                 rendezvous_timeout_s: float = 120.0,
+                 command_timeout_s: float = 120.0,
+                 env: Optional[dict] = None):
+        import json as _json
+
+        self.spec = dict(spec)
+        try:
+            _json.dumps(self.spec)
+            self.engine_kw = _json.loads(_json.dumps(engine_kw))
+        except TypeError as e:
+            raise TypeError(
+                "process-backend replica spec/engine_kw must be JSON-"
+                f"serializable (they cross a process boundary): {e}"
+            ) from e
+        self.rendezvous_timeout_s = rendezvous_timeout_s
+        self.command_timeout_s = command_timeout_s
+        self.session = f"serving-{uuid.uuid4().hex[:8]}"
+        self._env = dict(env if env is not None else os.environ)
+        _repo_pythonpath(self._env)
+        self._epoch = 0
+        from paddle_tpu.parallel.store import TCPStore
+
+        self.store = TCPStore("127.0.0.1", 0, is_master=True,
+                              timeout=rendezvous_timeout_s)
+
+    # ------------------------------------------------------------ spawn
+
+    def _spawn_proc(self, rank: int) -> Tuple[subprocess.Popen, str]:
+        key = f"{self.session}/r{rank}e{self._epoch}"
+        self._epoch += 1
+        cmd = [sys.executable, "-m", "paddle_tpu.serving.replica",
+               "--store-host", "127.0.0.1",
+               "--store-port", str(self.store.port),
+               "--key", key, "--session", self.session,
+               "--connect-timeout", str(self.rendezvous_timeout_s)]
+        proc = subprocess.Popen(cmd, env=self._env)
+        return proc, key
+
+    def _await_port(self, proc: subprocess.Popen, key: str,
+                    deadline: float) -> int:
+        while True:
+            raw = self.store.try_get(f"{key}/port")
+            if raw is not None:
+                return int(raw)
+            rc = proc.poll()
+            if rc is not None:
+                raise ReplicaGoneError(
+                    f"replica {key} (pid {proc.pid}) died during "
+                    f"rendezvous with exit code {rc}")
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"rendezvous timeout: replica {key} never published "
+                    f"its command port within "
+                    f"{self.rendezvous_timeout_s:.1f}s "
+                    "(rendezvous_timeout_s; slow spawns may need more)")
+            time.sleep(0.01)
+
+    def _connect(self, proc: subprocess.Popen, key: str,
+                 port: int) -> socket.socket:
+        sock = socket.create_connection(("127.0.0.1", port),
+                                        timeout=self.rendezvous_timeout_s)
+        sock.settimeout(None)
+        return sock
+
+    def spawn(self, rank: int, *, role: str = "mixed",
+              snapshot: Optional[dict] = None,
+              engine_kw: Optional[dict] = None) -> EngineClient:
+        """Spawn + rendezvous + init ONE replica (the supervisor's
+        respawn path). `snapshot` restores the engine from a crash-safe
+        snapshot inside the child instead of building it fresh."""
+        proc, key = self._spawn_proc(rank)
+        deadline = time.monotonic() + self.rendezvous_timeout_s
+        try:
+            port = self._await_port(proc, key, deadline)
+            sock = self._connect(proc, key, port)
+        except BaseException:
+            if proc.poll() is None:
+                proc.kill()
+            raise
+        client = EngineClient(proc, sock, rank, key,
+                              self.command_timeout_s)
+        kw = dict(engine_kw if engine_kw is not None else self.engine_kw)
+        kw["role"] = role
+        try:
+            client.init(self.spec, kw, snapshot=snapshot)
+        except BaseException:
+            client.kill()
+            raise
+        return client
+
+    def spawn_all(self, roles: Sequence[str]) -> List[EngineClient]:
+        """Spawn the initial fleet concurrently and rendezvous with ONE
+        shared deadline; on timeout the error names EXACTLY which ranks
+        are missing — and which of those already died, with their exit
+        codes — instead of a bare hang."""
+        procs = [self._spawn_proc(rank) for rank in range(len(roles))]
+        deadline = time.monotonic() + self.rendezvous_timeout_s
+        ports: Dict[int, int] = {}
+        try:
+            while len(ports) < len(procs):
+                progressed = False
+                for rank, (proc, key) in enumerate(procs):
+                    if rank in ports:
+                        continue
+                    raw = self.store.try_get(f"{key}/port")
+                    if raw is not None:
+                        ports[rank] = int(raw)
+                        progressed = True
+                if len(ports) == len(procs):
+                    break
+                if time.monotonic() > deadline:
+                    missing = []
+                    for rank, (proc, key) in enumerate(procs):
+                        if rank in ports:
+                            continue
+                        rc = proc.poll()
+                        missing.append(
+                            f"rank {rank} ({key}, pid {proc.pid}: "
+                            + ("alive but silent" if rc is None
+                               else f"exited rc={rc}") + ")")
+                    raise TimeoutError(
+                        f"rendezvous timeout after "
+                        f"{self.rendezvous_timeout_s:.1f}s: "
+                        f"{len(ports)}/{len(procs)} replicas arrived; "
+                        "missing: " + "; ".join(missing))
+                if not progressed:
+                    time.sleep(0.01)
+            clients = []
+            for rank, (proc, key) in enumerate(procs):
+                sock = self._connect(proc, key, ports[rank])
+                clients.append(EngineClient(proc, sock, rank, key,
+                                            self.command_timeout_s))
+            for client, role in zip(clients, roles):
+                kw = dict(self.engine_kw)
+                kw["role"] = role
+                client.init(self.spec, kw)
+            return clients
+        except BaseException:
+            for proc, _ in procs:
+                if proc.poll() is None:
+                    proc.kill()
+            raise
+
+    def close(self) -> None:
+        try:
+            self.store.close()
+        except Exception:  # pragma: no cover
+            pass

@@ -97,6 +97,8 @@ SUMMABLE_KEYS = (
     "offload_spill_pages", "pagein_pages", "pagein_hidden_pages",
     "offload_resumes", "offload_recompute_fallbacks", "host_tier_drops",
     "host_tier_bytes",
+    "handoffs_out", "handoffs_in", "handoff_pages_out", "handoff_pages_in",
+    "handoff_recompute_fallbacks",
     "decode_steps", "queue_depth", "running", "pool_used_pages",
 )
 
@@ -226,6 +228,21 @@ class EngineMetrics:
         self.host_tier_drops = Counter("host_tier_drops")
         self.host_tier_bytes = Gauge("host_tier_bytes")
         self.host_tier_pages_used = Gauge("host_tier_pages_used")
+        # prefill/decode split (ISSUE 12): handoffs_out counts requests
+        # a prefill-role engine staged for migration after their first
+        # sampled token (handoff_pages_out = KV pages spilled for them);
+        # handoffs_in counts requests a decode-role engine accepted with
+        # a wire-transferred page payload (handoff_pages_in = pages
+        # imported, content-hash-verified at receive); a handoff whose
+        # pages could not ride along — no host tier, tier full — lands
+        # in handoff_recompute_fallbacks and resumes by recompute,
+        # token-exact as ever
+        self.handoffs_out = Counter("handoffs_out")
+        self.handoffs_in = Counter("handoffs_in")
+        self.handoff_pages_out = Counter("handoff_pages_out")
+        self.handoff_pages_in = Counter("handoff_pages_in")
+        self.handoff_recompute_fallbacks = Counter(
+            "handoff_recompute_fallbacks")
         self.decode_steps = Counter("decode_steps")
         self.queue_depth = Gauge("queue_depth")
         self.running = Gauge("running")
@@ -341,6 +358,12 @@ class EngineMetrics:
             "host_tier_drops": self.host_tier_drops.value,
             "host_tier_bytes": self.host_tier_bytes.value,
             "host_tier_pages_used": self.host_tier_pages_used.value,
+            "handoffs_out": self.handoffs_out.value,
+            "handoffs_in": self.handoffs_in.value,
+            "handoff_pages_out": self.handoff_pages_out.value,
+            "handoff_pages_in": self.handoff_pages_in.value,
+            "handoff_recompute_fallbacks":
+                self.handoff_recompute_fallbacks.value,
             "decode_steps": self.decode_steps.value,
             "queue_depth": self.queue_depth.value,
             "queue_depth_peak": self.queue_depth.peak,

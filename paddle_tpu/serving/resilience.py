@@ -22,12 +22,15 @@ index (never wall time or RNG), so a failing trace replays exactly.
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Callable, Iterable, Optional
 
 import numpy as np
 
 from paddle_tpu.serving.kv_cache import SCRATCH_PAGE
+
+logger = logging.getLogger(__name__)
 
 
 class InjectedDeviceError(RuntimeError):
@@ -54,6 +57,15 @@ class ReplicaCrashError(BaseException):
     router tier's Supervisor, not the engine, owns this failure mode:
     it must fence the dead replica, restore from the last crash-safe
     snapshot, and resubmit anything the snapshot missed."""
+
+
+class ReplicaGoneError(ReplicaCrashError):
+    """A replica PROCESS is unreachable (ISSUE 12): its command socket
+    hit EOF/reset/timeout, or waitpid reported an exit. Subclasses
+    ReplicaCrashError on purpose — the process-backend analogue of a
+    crashed thread rides the exact same uncatchable-by-the-engine
+    contract, so the router worker fences the replica and the
+    Supervisor respawns a fresh process."""
 
 
 class FaultInjector:
@@ -440,6 +452,15 @@ def audit_engine(engine) -> None:
             elif req.phase == "offloaded":
                 problems.append(f"{req.request_id} phase 'offloaded' "
                                 "without an offload record")
+        # handoff buffer (ISSUE 12): a staged request's spilled pages
+        # are a third legitimate slot-owner class — owned by the
+        # engine's handoff record until extract_handoff ships (and
+        # frees) them, or _finish_abnormal releases them on abort
+        for rid, rec in getattr(engine, "_handoffs", {}).items():
+            if rec is None:
+                continue
+            for s in rec.slots:
+                slot_owner[s] = slot_owner.get(s, 0) + 1
         for req in sched.running:
             if getattr(req, "offload", None) is not None:
                 problems.append(f"{req.request_id} RUNNING with an "
@@ -524,11 +545,25 @@ def audit_router(router) -> None:
     for rep in replicas:
         if rep.status != "live":
             continue
+        remote = getattr(rep.engine, "remote_audit", None)
         try:
             with rep.lock:
-                audit_engine(rep.engine)
+                if remote is not None:
+                    # process backend (ISSUE 12): audit_engine runs
+                    # INSIDE the replica process — its pool/scheduler
+                    # never cross the boundary, only the verdict does
+                    p = remote()
+                    if p:
+                        problems.append(f"replica {rep.index}: {p}")
+                else:
+                    audit_engine(rep.engine)
         except InvariantViolation as e:
             problems.append(f"replica {rep.index}: {e}")
+        except BaseException as e:
+            # a replica dying UNDER the audit is a liveness event for
+            # the supervisor, not an invariant violation
+            logger.warning("replica %d unreachable mid-audit: %s",
+                           rep.index, e)
 
     with router._lock:
         n = len(replicas)

@@ -59,7 +59,9 @@ from paddle_tpu.serving.kv_cache import _CHAIN_SEED, page_content_hash
 from paddle_tpu.serving.metrics import (
     Counter, Gauge, Histogram, aggregate_snapshots,
 )
-from paddle_tpu.serving.resilience import QueueFullError
+from paddle_tpu.serving.resilience import (
+    QueueFullError, ReplicaCrashError,
+)
 from paddle_tpu.serving.scheduler import SamplingParams
 
 logger = logging.getLogger(__name__)
@@ -89,8 +91,9 @@ class _RequestRecord:
 
     __slots__ = ("request_id", "prompt_tokens", "sampling", "owner_idx",
                  "owner_epoch", "arrival_index", "submit_time",
-                 "first_token_time", "finish_time", "cursor", "tokens",
-                 "done", "finish_reason", "resubmissions", "replicas")
+                 "first_token_time", "last_token_time", "finish_time",
+                 "cursor", "tokens", "done", "finish_reason",
+                 "resubmissions", "replicas")
 
     def __init__(self, request_id, prompt_tokens, sampling, owner_idx,
                  owner_epoch, arrival_index, submit_time):
@@ -102,6 +105,7 @@ class _RequestRecord:
         self.arrival_index = arrival_index
         self.submit_time = submit_time
         self.first_token_time = None
+        self.last_token_time = None
         self.finish_time = None
         self.cursor = 0               # tokens delivered to the client
         self.tokens: List[int] = []   # the delivered stream
@@ -115,14 +119,21 @@ class EngineReplica:
     """One engine + its worker-thread state. The `lock` serializes every
     touch of the engine (step, add, extract, snapshot); `fenced` is the
     at-most-once kill switch — once set, nothing this object's thread
-    delivers is believed, even if the thread is still un-hanging."""
+    delivers is believed, even if the thread is still un-hanging.
+
+    With the process backend (ISSUE 12) `engine` is an
+    launch.EngineClient — same surface, one socket command per call —
+    and `runner` is None (the real runner lives in the child process).
+    `role` is the disaggregation role: "mixed", or "prefill"/"decode"
+    when the router runs split (prefill_replicas > 0)."""
 
     def __init__(self, index: int, epoch: int, engine: ServingEngine,
-                 runner, now: float):
+                 runner, now: float, role: str = "mixed"):
         self.index = index
         self.epoch = epoch
         self.engine = engine
         self.runner = runner
+        self.role = role
         self.lock = threading.RLock()
         self.wake = threading.Event()
         self.stop = False
@@ -165,8 +176,17 @@ class RouterMetrics:
         self.replica_restarts = Counter("replica_restarts")
         self.resubmitted_requests = Counter("resubmitted_requests")
         self.redistributed_requests = Counter("redistributed_requests")
+        # prefill/decode split (ISSUE 12): requests migrated from a
+        # prefill replica to a decode replica WITH their KV pages, and
+        # the ones whose pages could not ride (decode side recomputed)
+        self.handoffs = Counter("handoffs")
+        self.handoff_fallbacks = Counter("handoff_fallbacks")
         self.live_replicas = Gauge("live_replicas")
         self.ttft_s = Histogram("router_ttft_s")
+        # inter-token latency across the tier (ISSUE 12 bench: the
+        # split-vs-mixed arm commits its p99 — decode ITL is what
+        # chunked prefill stops polluting once prefill is elsewhere)
+        self.itl_s = Histogram("router_itl_s")
         self.e2e_latency_s = Histogram("router_e2e_latency_s")
 
     def snapshot(self) -> Dict[str, float]:
@@ -179,11 +199,14 @@ class RouterMetrics:
             self.tokens_delivered, self.duplicate_tokens_dropped,
             self.replica_crashes, self.replica_hangs,
             self.replica_restarts, self.resubmitted_requests,
-            self.redistributed_requests)}
+            self.redistributed_requests, self.handoffs,
+            self.handoff_fallbacks)}
         out["live_replicas"] = self.live_replicas.value
         out["ttft_s_p50"] = self.ttft_s.percentile(50)
         out["ttft_s_p99"] = self.ttft_s.percentile(99)
         out["ttft_s_mean"] = self.ttft_s.mean
+        out["itl_s_p50"] = self.itl_s.percentile(50)
+        out["itl_s_p99"] = self.itl_s.percentile(99)
         out["e2e_latency_s_p50"] = self.e2e_latency_s.percentile(50)
         out["e2e_latency_s_p99"] = self.e2e_latency_s.percentile(99)
         return out
@@ -204,7 +227,33 @@ class ServingRouter:
     passed through to each replica's ServingEngine verbatim.
 
     Router knobs:
-      replicas             engine replica count (thread-per-engine)
+      replicas             engine replica count
+      backend              "thread" (default: thread-per-engine in this
+                           process) or "process" (ISSUE 12: each
+                           replica is an OS process running
+                           paddle_tpu/serving/replica.py, spawned by
+                           serving/launch.ReplicaLauncher over the
+                           TCPStore rendezvous; `runner_factory` must
+                           then be a JSON spec {"factory":
+                           "module:callable", "factory_kw": {...},
+                           "sys_path": [...]} resolved inside each
+                           child, and engine kwargs must be JSON-
+                           serializable)
+      prefill_replicas     disaggregated split (ISSUE 12): the first N
+                           replicas take role "prefill" (admission +
+                           chunked prefill + first token, then KV
+                           handoff), the rest role "decode"; fresh
+                           prompts route to the prefill tier only and
+                           finished prefills migrate with their pages.
+                           0 = all-mixed (the classic tier)
+      rendezvous_timeout_s process backend: how long spawn/respawn may
+                           take before the launcher raises naming the
+                           missing ranks
+      command_timeout_s    process backend: per-command socket timeout
+                           (a breach surfaces as ReplicaGoneError and
+                           the supervisor respawns)
+      child_env            process backend: environment for replica
+                           children (default: inherit)
       policy               "prefix" (default; affinity first, least-
                            loaded fallback), "least_loaded",
                            "round_robin", or "random" (seeded — the
@@ -231,8 +280,10 @@ class ServingRouter:
                            restarted replica
     """
 
-    def __init__(self, runner_factory: Callable, *, replicas: int = 2,
+    def __init__(self, runner_factory, *, replicas: int = 2,
                  policy: str = "prefix",
+                 backend: str = "thread",
+                 prefill_replicas: int = 0,
                  max_queue_depth: Optional[int] = None,
                  shed_policy: str = "reject",
                  snapshot_every_steps: int = 1,
@@ -241,6 +292,9 @@ class ServingRouter:
                  heartbeat_timeout_s: float = 5.0,
                  poll_interval_s: float = 0.2,
                  redistribute: bool = True,
+                 rendezvous_timeout_s: float = 120.0,
+                 command_timeout_s: float = 120.0,
+                 child_env: Optional[dict] = None,
                  clock: Optional[Callable[[], float]] = None,
                  metrics: Optional[RouterMetrics] = None,
                  **engine_kw):
@@ -249,9 +303,26 @@ class ServingRouter:
         if policy not in ROUTING_POLICIES:
             raise ValueError(f"policy={policy!r}; expected one of "
                              f"{ROUTING_POLICIES}")
+        if backend not in ("thread", "process"):
+            raise ValueError(f"backend={backend!r}; expected 'thread' "
+                             "or 'process'")
+        if not 0 <= prefill_replicas < replicas:
+            if prefill_replicas != 0:
+                raise ValueError(
+                    f"prefill_replicas={prefill_replicas} must leave at "
+                    f"least one decode replica (replicas={replicas})")
         if shed_policy not in ("reject", "drop_oldest"):
             raise ValueError(f"shed_policy={shed_policy!r}; expected "
                              "'reject' or 'drop_oldest'")
+        self.backend = backend
+        # prefill/decode split (ISSUE 12): the first `prefill_replicas`
+        # replicas take role "prefill" (admit + chunked prefill + first
+        # token, then hand the KV off), the rest take "decode"; 0 = the
+        # classic all-mixed tier
+        self._roles = (["prefill"] * prefill_replicas
+                       + ["decode"] * (replicas - prefill_replicas)
+                       if prefill_replicas else ["mixed"] * replicas)
+        self._split = prefill_replicas > 0
         self._runner_factory = runner_factory
         self._policy = policy
         self.max_queue_depth = max_queue_depth
@@ -274,12 +345,32 @@ class ServingRouter:
         self._retired_metrics: List[Dict[str, float]] = []
         self._epochs = itertools.count()
         self._rr = itertools.count()
+        self._rids = itertools.count()
         self._rng = np.random.default_rng(0)
         self._replicas: List[EngineReplica] = []
-        for idx in range(replicas):
-            runner = self._make_runner(idx)
-            self._spawn(idx, self._build_engine(runner), runner,
-                        start=False)
+        self._launcher = None
+        if backend == "process":
+            # the tentpole (ISSUE 12): replicas are OS PROCESSES —
+            # runner_factory is a JSON spec the launcher ships to each
+            # child ({"factory": "module:callable", "factory_kw": ...});
+            # rendezvous rides the TCPStore barrier, and each replica's
+            # `engine` here is an EngineClient proxy over its socket
+            from paddle_tpu.serving.launch import ReplicaLauncher
+
+            self._launcher = ReplicaLauncher(
+                runner_factory, engine_kw,
+                rendezvous_timeout_s=rendezvous_timeout_s,
+                command_timeout_s=command_timeout_s, env=child_env)
+            for idx, client in enumerate(
+                    self._launcher.spawn_all(self._roles)):
+                self._spawn(idx, client, None, start=False,
+                            role=self._roles[idx])
+        else:
+            for idx in range(replicas):
+                runner = self._make_runner(idx)
+                self._spawn(idx, self._build_engine(runner,
+                                                    self._roles[idx]),
+                            runner, start=False, role=self._roles[idx])
         self.block_size = self._replicas[0].engine.pool.block_size
         for rep in self._replicas:
             self._start_worker(rep)
@@ -303,13 +394,65 @@ class ServingRouter:
             # zero-arg factories are fine too (index-blind replicas)
             return self._runner_factory()
 
-    def _build_engine(self, runner) -> ServingEngine:
-        return ServingEngine(runner, **self._engine_kw)
+    def _build_engine(self, runner, role: str = "mixed") -> ServingEngine:
+        return ServingEngine(runner, role=role, **self._engine_kw)
+
+    def _revive_engine(self, rep: "EngineReplica",
+                       snapshot: Optional[dict]):
+        """Build the replacement engine for a dead replica — the
+        backend-split half of supervisor recovery. Thread backend: a
+        FRESH runner + ServingEngine.restore (or a fresh engine).
+        Process backend: SIGKILL whatever is left of the old process
+        (fences a SIGSTOP'd zombie too), spawn a new child, and let it
+        restore from the snapshot inside its own address space.
+        Returns (engine, runner)."""
+        if self.backend == "process":
+            rep.engine.kill()
+            client = self._launcher.spawn(rep.index, role=rep.role,
+                                          snapshot=snapshot)
+            return client, None
+        runner = self._make_runner(rep.index)
+        kw = self._engine_kw
+        if snapshot is not None:
+            engine = ServingEngine.restore(
+                runner, snapshot, tokenizer=kw.get("tokenizer"),
+                sleep_fn=kw.get("sleep_fn"), audit=kw.get("audit"))
+        else:
+            engine = self._build_engine(runner, rep.role)
+        return engine, runner
+
+    def _replica_dead(self, rep: "EngineReplica") -> bool:
+        """waitpid-style liveness probe (process backend): True when
+        the replica's OS process has exited even though no command has
+        surfaced the death yet — the supervisor polls this so an IDLE
+        replica's SIGKILL is detected without waiting for traffic."""
+        probe = getattr(rep.engine, "proc_dead", None)
+        return bool(probe and probe())
+
+    def _note_dead(self, rep: "EngineReplica", why: str) -> None:
+        """Fence a replica whose death surfaced OUTSIDE its worker
+        thread (a submit/inject command hit a dead socket)."""
+        with self._lock:
+            if rep.fenced:
+                return
+            rep.crash = why
+            rep.status = "crashed"
+            rep.fenced = True
+            rep.stop = True
+            self.metrics.replica_crashes.inc()
+            self.metrics.live_replicas.set(
+                sum(1 for r in self._replicas if r.status == "live"))
+        rep.wake.set()
+        self._completion.set()
+        logger.warning("replica %d dead: %s", rep.index, why)
 
     def _spawn(self, idx: int, engine: ServingEngine, runner,
-               start: bool = True) -> EngineReplica:
+               start: bool = True, role: Optional[str] = None
+               ) -> EngineReplica:
         rep = EngineReplica(idx, next(self._epochs), engine, runner,
-                            self._clock())
+                            self._clock(),
+                            role=role if role is not None
+                            else self._roles[idx])
         with self._lock:
             if idx == len(self._replicas):
                 self._replicas.append(rep)
@@ -367,16 +510,17 @@ class ServingRouter:
                     try:
                         events = rep.engine.step()
                     except BaseException as e:   # replica death, not load
-                        rep.crash = f"{type(e).__name__}: {e}"
-                        rep.status = "crashed"
-                        rep.fenced = True
-                        self.metrics.replica_crashes.inc()
-                        self.metrics.live_replicas.set(
-                            sum(1 for r in self._replicas
-                                if r.status == "live"))
-                        self._completion.set()
-                        logger.warning("replica %d crashed: %s",
-                                       rep.index, rep.crash)
+                        if not rep.fenced:       # a fenced process's EOF
+                            rep.crash = f"{type(e).__name__}: {e}"
+                            rep.status = "crashed"
+                            rep.fenced = True
+                            self.metrics.replica_crashes.inc()
+                            self.metrics.live_replicas.set(
+                                sum(1 for r in self._replicas
+                                    if r.status == "live"))
+                            self._completion.set()
+                            logger.warning("replica %d crashed: %s",
+                                           rep.index, rep.crash)
                         return
                     rep.steps_done += 1
                     rep.last_beat = self._clock()
@@ -386,6 +530,12 @@ class ServingRouter:
                             and rep.steps_done % self._snapshot_every == 0):
                         rep.last_snapshot = rep.engine.snapshot()
                     stepped = True
+            if rep.role == "prefill" and not rep.fenced and not rep.stop:
+                # disaggregated split (ISSUE 12): migrate every staged
+                # handoff to a decode replica. Outside rep.lock — the
+                # move takes prefill.lock then decode.lock, and only
+                # prefill replicas initiate, so the order is acyclic
+                self._service_handoffs(rep)
             if not stepped:
                 rep.wake.wait(self._idle_wait_s)
                 rep.wake.clear()
@@ -420,6 +570,9 @@ class ServingRouter:
                 if rec.first_token_time is None:
                     rec.first_token_time = now
                     self.metrics.ttft_s.observe(now - rec.submit_time)
+                else:
+                    self.metrics.itl_s.observe(now - rec.last_token_time)
+                rec.last_token_time = now
                 if ev.finished:
                     self._finish(rec, ev.finish_reason)
 
@@ -485,13 +638,21 @@ class ServingRouter:
             return True
         return rep.engine.scheduler.queue_depth < self.max_queue_depth
 
+    def _intake_ok(self, rep: EngineReplica) -> bool:
+        """Eligibility of a replica for a FRESH prompt: under the
+        prefill/decode split new requests enter through the prefill
+        tier only (decode replicas receive work via handoff/recovery
+        injection, never via submit)."""
+        return not self._split or rep.role in ("prefill", "mixed")
+
     def _choose(self, chain: Sequence[int],
                 session_id: Optional[str] = None
                 ) -> Tuple[EngineReplica, str]:
         with self._lock:
-            live = [r for r in self._replicas if r.status == "live"]
+            live = [r for r in self._replicas
+                    if r.status == "live" and self._intake_ok(r)]
             if not live:
-                raise RuntimeError("no live replicas")
+                raise RuntimeError("no live intake replicas")
             first, how = None, None
             if self._policy == "prefix":
                 # session stickiness outranks content affinity (ISSUE 10
@@ -500,13 +661,15 @@ class ServingRouter:
                 if session_id is not None:
                     idx = self._sessions.get(session_id)
                     if idx is not None \
-                            and self._replicas[idx].status == "live":
+                            and self._replicas[idx].status == "live" \
+                            and self._intake_ok(self._replicas[idx]):
                         first, how = self._replicas[idx], "session"
                 if first is None:
                     for h in reversed(chain):
                         idx = self._affinity.get(h)
                         if idx is not None \
-                                and self._replicas[idx].status == "live":
+                                and self._replicas[idx].status == "live" \
+                                and self._intake_ok(self._replicas[idx]):
                             first, how = self._replicas[idx], "affinity"
                             break
             elif self._policy == "round_robin":
@@ -549,14 +712,27 @@ class ServingRouter:
                 if request_id in self._reqs:
                     raise ValueError(f"request {request_id!r} already "
                                      "submitted")
+        elif self.backend == "process":
+            # the router mints tier-unique auto ids here: each replica
+            # PROCESS has its own private arrival counter, so engine-
+            # assigned "req-N" names would collide across replicas and
+            # corrupt the delivery registry
+            request_id = f"req-p{next(self._rids)}"
         chain = self._affinity_chain(prompt)
         for _ in range(len(self._replicas) + 2):
             rep, how = self._choose(chain, sampling.session_id)
             with rep.lock:
                 if rep.fenced or rep.status != "live":
                     continue           # died between choose and lock
-                rid = rep.engine.add_request(prompt, sampling,
-                                             request_id=request_id)
+                try:
+                    rid = rep.engine.add_request(prompt, sampling,
+                                                 request_id=request_id)
+                except ReplicaCrashError as e:
+                    # process died under the submit (ISSUE 12): fence
+                    # it and try the next replica — the supervisor
+                    # respawns it in the background
+                    self._note_dead(rep, f"{type(e).__name__}: {e}")
+                    continue
                 arrival_index = rep.engine._requests[rid].arrival_index
                 with self._lock:
                     rec = _RequestRecord(rid, prompt, sampling, rep.index,
@@ -720,6 +896,102 @@ class ServingRouter:
             moved += 1
         return moved
 
+    # ------------------------------------------ prefill/decode handoff
+
+    def _choose_decode(self) -> Optional[EngineReplica]:
+        """Least-loaded live decode-capable replica — where a finished
+        prefill's KV pages land. None when every decode replica is
+        down (the handoff then stays staged; the supervisor's respawn
+        unblocks it on a later service pass)."""
+        with self._lock:
+            cands = [r for r in self._replicas if r.status == "live"
+                     and r.role in ("decode", "mixed")]
+        cands.sort(key=lambda r: (self._load(r), r.index))
+        return cands[0] if cands else None
+
+    def _service_handoffs(self, rep: EngineReplica) -> None:
+        """Move every handoff the prefill replica has staged onto a
+        decode replica. Lock order: rep (prefill) first, target
+        (decode) second, registry last — only prefill replicas
+        initiate, so the order is globally acyclic. Any failure
+        degrades to a registry resubmission (recompute on a live
+        replica): the registry holds the full delivered prefix, so
+        nothing is ever lost and the cursor dedupes any overlap."""
+        try:
+            ready = rep.engine.handoff_ready()
+        except BaseException:
+            return                       # dying replica: supervisor's job
+        for rid in ready:
+            with self._lock:
+                rec = self._reqs.get(rid)
+            if rec is None or rec.done:
+                # aborted/expired tier-side while staged: release the
+                # engine-side state (frees the spilled host slots)
+                try:
+                    with rep.lock:
+                        rep.engine.abort(rid, "aborted")
+                except BaseException:
+                    pass
+                continue
+            target = self._choose_decode()
+            if target is None or target is rep:
+                return
+            self._migrate_handoff(rep, target, rec)
+
+    def _migrate_handoff(self, rep: EngineReplica,
+                         target: EngineReplica,
+                         rec: _RequestRecord) -> None:
+        try:
+            with rep.lock:
+                if rep.fenced:
+                    return
+                state, payload = rep.engine.extract_handoff(
+                    rec.request_id)
+        except KeyError:
+            return                       # raced an abort
+        except BaseException as e:
+            # prefill replica died mid-extract: its engine state is
+            # gone, but the registry record survives — the supervisor
+            # fences + backfills it like any other orphan
+            if isinstance(e, ReplicaCrashError):
+                self._note_dead(rep, f"{type(e).__name__}: {e}")
+            return
+        npages = len(payload["hashes"]) if payload else 0
+        try:
+            with target.lock:
+                if target.fenced or target.status != "live":
+                    raise ReplicaCrashError("handoff target fenced")
+                target.engine.import_handoff(state, payload)
+                target.last_beat = max(target.last_beat, self._clock())
+        except BaseException as e:
+            # decode side refused or died (fence, crash, or a content-
+            # hash mismatch raised loudly at receive): the request is
+            # already out of the prefill engine, so resubmit it from
+            # the registry — recompute, token-exact, counted
+            if isinstance(e, ReplicaCrashError):
+                self._note_dead(target, f"{type(e).__name__}: {e}")
+            else:
+                logger.warning("handoff of %s to replica %d failed "
+                               "(%s); falling back to recompute "
+                               "resubmission", rec.request_id,
+                               target.index, e)
+            self.metrics.handoff_fallbacks.inc()
+            fallback = self._choose_decode()
+            with self._lock:
+                live = [r for r in self._replicas if r.status == "live"]
+            if fallback is None and live:
+                fallback = live[0]
+            if fallback is not None:
+                self._inject(fallback, rec)
+            return
+        with self._lock:
+            rec.owner_idx, rec.owner_epoch = target.index, target.epoch
+            rec.replicas.append(target.index)
+        self.metrics.handoffs.inc()
+        logger.debug("handoff %s: replica %d -> %d (%d pages)",
+                     rec.request_id, rep.index, target.index, npages)
+        target.wake.set()
+
     # ----------------------------------------------------------- drills
 
     def kill_replica(self, idx: int, reason: str = "killed") -> bool:
@@ -737,6 +1009,13 @@ class ServingRouter:
             self.metrics.replica_crashes.inc()
             self.metrics.live_replicas.set(
                 sum(1 for r in self._replicas if r.status == "live"))
+        if self.backend == "process":
+            # a drill kill means the PROCESS dies (SIGKILL), not just
+            # the proxy — recovery must prove a real respawn
+            try:
+                rep.engine.kill()
+            except Exception:  # pragma: no cover
+                pass
         rep.wake.set()
         self._completion.set()
         return True
@@ -843,6 +1122,14 @@ class ServingRouter:
             t = rep.thread
             if t is not None and t.is_alive():
                 t.join(timeout_s)
+        if self.backend == "process":
+            for rep in list(self._replicas):
+                try:
+                    rep.engine.shutdown()
+                except BaseException:  # pragma: no cover
+                    pass
+            if self._launcher is not None:
+                self._launcher.close()
 
     def __enter__(self) -> "ServingRouter":
         return self

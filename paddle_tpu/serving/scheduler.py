@@ -534,6 +534,20 @@ class FCFSScheduler:
             if req.phase == "offloaded":
                 req.phase = "prefill"
 
+    def release_running(self, req: Request) -> None:
+        """Release a RUNNING request's device resources WITHOUT
+        finishing it — the handoff-staging path (ISSUE 12): pages and
+        slot are freed (the pages were already spilled to the host
+        tier by the caller) and the request leaves the running set in
+        state WAITING, but does NOT rejoin the waiting queue:
+        ownership passes to the engine's handoff buffer, from which
+        the router extracts it for migration to a decode replica."""
+        req.kv.release()
+        req.kv = None
+        self._release_slot(req)
+        self.running.remove(req)
+        req.state = RequestState.WAITING
+
     # ---------------------------------------------------------- finish
 
     def remove_waiting(self, req: Request) -> None:

@@ -48,8 +48,6 @@ import logging
 import threading
 from typing import Optional
 
-from paddle_tpu.serving.engine import ServingEngine
-
 logger = logging.getLogger(__name__)
 
 
@@ -97,7 +95,10 @@ class Supervisor:
         while not self._stop_evt.wait(self.poll_interval_s):
             try:
                 self.poll()
-            except Exception:            # pragma: no cover — must never
+            except BaseException:        # pragma: no cover — must never
+                # (BaseException: a ReplicaGoneError from a freshly-
+                # respawned replica dying mid-backfill lands here; the
+                # next poll's waitpid probe recovers it again)
                 logger.exception("supervisor poll failed")   # kill the loop
 
     # ------------------------------------------------------- detection
@@ -130,6 +131,22 @@ class Supervisor:
                 if rep.status == "crashed":
                     self._recover(rep, "crash")
                     recovered += 1
+                elif (rep.status == "live"
+                        and self.router._replica_dead(rep)):
+                    # waitpid-style detect (ISSUE 12): the replica
+                    # PROCESS exited (SIGKILL, OOM, segfault) before
+                    # any command surfaced the death — an idle
+                    # replica's corpse is found here, not on traffic
+                    rep.status = "crashed"
+                    rep.fenced = True
+                    rep.stop = True
+                    rc = rep.engine.proc.poll()
+                    rep.crash = f"process exited rc={rc}"
+                    self.router.metrics.replica_crashes.inc()
+                    logger.warning("replica %d process died (rc=%s)",
+                                   rep.index, rc)
+                    self._recover(rep, "crash")
+                    recovered += 1
                 elif rep.status == "live" and self._hung(rep):
                     rep.status = "hung"
                     rep.fenced = True
@@ -153,10 +170,11 @@ class Supervisor:
         rep.wake.set()
         # the dead engine's counters join the tier history so aggregate
         # metrics survive the restart (reading without rep.lock is safe:
-        # plain python floats, and the worker is fenced)
+        # plain python floats, and the worker is fenced; a dead PROCESS
+        # answers from the client's last-good cache)
         try:
             router._retired_metrics.append(rep.engine.metrics.snapshot())
-        except Exception:                # pragma: no cover
+        except BaseException:            # pragma: no cover
             pass
         orphans = router._orphans(rep.index, rep.epoch)
         if self.max_restarts is not None \
@@ -164,17 +182,17 @@ class Supervisor:
             self._retire(rep, orphans)
             return
         self.restarts += 1
-        # NEVER reuse the dead runner: a hung thread may still be inside
-        # one of its jitted calls
-        runner = router._make_runner(rep.index)
+        # NEVER reuse the dead runner/process: a hung thread may still
+        # be inside one of its jitted calls, and a SIGSTOP'd process is
+        # SIGKILLed by the revive before its replacement spawns
         snap = rep.last_snapshot
-        kw = router._engine_kw
-        if snap is not None:
-            engine = ServingEngine.restore(
-                runner, snap, tokenizer=kw.get("tokenizer"),
-                sleep_fn=kw.get("sleep_fn"), audit=kw.get("audit"))
-        else:
-            engine = router._build_engine(runner)
+        try:
+            engine, runner = router._revive_engine(rep, snap)
+        except BaseException as e:       # respawn itself failed: the
+            logger.error(                # replica retires, tier degrades
+                "replica %d revive failed (%s); retiring", rep.index, e)
+            self._retire(rep, orphans)
+            return
         new = router._spawn(rep.index, engine, runner, start=False)
         # reconcile the restored engine against the router registry
         # BEFORE its worker starts (no lock races: the thread is ours)

@@ -1,0 +1,206 @@
+"""Length-prefixed socket protocol for process-per-engine replicas
+(ISSUE 12).
+
+One message = one JSON header frame + `nbufs` raw binary frames. A
+frame is a 4-byte little-endian length followed by that many bytes.
+The header is an arbitrary JSON object; binary frames carry numpy
+arrays (KV page bytes for the prefill->decode handoff — raw page
+bytes + scale rows ride the wire untouched, which is what makes the
+transfer bit-exact including int8 codes). Array metadata (dtype,
+shape) rides the header under "bufs" so the receiving side can
+reconstruct views without copies beyond the recv itself.
+
+Every recv/send loops over partial I/O and retries EINTR explicitly
+(the TCPStore-hardening satellite applies the same discipline to the
+rendezvous store): a SIGCHLD from a dying sibling replica, or a
+profiler's SIGPROF, must never tear a frame mid-read. EOF mid-frame
+raises ConnectionError — the caller (EngineClient / the replica loop)
+treats that as peer death, never as data.
+
+The payloads themselves are the engine's existing serialization
+surfaces: `snapshot()` JSON for restore, the `extract_request` /
+`inject_request` per-request state dicts for migration, TokenEvent /
+RequestOutput dataclass dicts for streaming — the wire adds framing,
+not a second serialization scheme.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import socket
+import struct
+from dataclasses import asdict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# one frame may carry a whole layer's stacked handoff pages; 1 GiB is
+# far above any sane page payload and low enough to catch a corrupted
+# length prefix before it turns into an allocation bomb
+MAX_FRAME_BYTES = 1 << 30
+
+
+def send_all(sock: socket.socket, data: bytes) -> None:
+    """sendall with an explicit EINTR retry loop (python retries EINTR
+    since PEP 475 *unless* a signal handler raised — the loop makes the
+    contract unconditional)."""
+    view = memoryview(data)
+    while view:
+        try:
+            n = sock.send(view)
+        except InterruptedError:
+            continue
+        except OSError as e:  # pragma: no cover — platform-dependent
+            if e.errno == errno.EINTR:
+                continue
+            raise
+        if n == 0:
+            raise ConnectionError("socket closed mid-send")
+        view = view[n:]
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Read exactly n bytes, retrying partial recvs and EINTR. Raises
+    ConnectionError on EOF (peer died) — never returns short."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        try:
+            r = sock.recv_into(view[got:], n - got)
+        except InterruptedError:
+            continue
+        except OSError as e:  # pragma: no cover — platform-dependent
+            if e.errno == errno.EINTR:
+                continue
+            raise
+        if r == 0:
+            raise ConnectionError(
+                f"socket closed mid-recv ({got}/{n} bytes)")
+        got += r
+    return bytes(buf)
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    send_all(sock, struct.pack("<I", len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (n,) = struct.unpack("<I", recv_exact(sock, 4))
+    if n > MAX_FRAME_BYTES:
+        raise ConnectionError(f"frame length {n} exceeds "
+                              f"{MAX_FRAME_BYTES} — corrupted stream")
+    return recv_exact(sock, n) if n else b""
+
+
+def send_msg(sock: socket.socket, header: dict,
+             bufs: Sequence[np.ndarray] = ()) -> None:
+    """One message: JSON header + binary frames. Array dtype/shape
+    metadata is recorded in the header so the peer can reconstruct."""
+    header = dict(header)
+    header["bufs"] = [{"dtype": str(b.dtype), "shape": list(b.shape)}
+                      for b in bufs]
+    _send_frame(sock, json.dumps(header).encode())
+    for b in bufs:
+        _send_frame(sock, np.ascontiguousarray(b).tobytes())
+
+
+def recv_msg(sock: socket.socket) -> Tuple[dict, List[np.ndarray]]:
+    header = json.loads(_recv_frame(sock).decode())
+    bufs = []
+    for meta in header.pop("bufs", []):
+        raw = _recv_frame(sock)
+        bufs.append(np.frombuffer(raw, dtype=np.dtype(meta["dtype"]))
+                    .reshape(meta["shape"]).copy())
+    return header, bufs
+
+
+# ------------------------------------------------- payload (de)serializers
+
+
+def sampling_to_dict(sampling) -> dict:
+    """SamplingParams -> JSON-safe dict (the snapshot() shape)."""
+    sp = asdict(sampling)
+    sp["stop_token_ids"] = list(sp["stop_token_ids"])
+    return sp
+
+
+def sampling_from_dict(sp: dict):
+    from paddle_tpu.serving.scheduler import SamplingParams
+
+    sp = dict(sp)
+    sp["stop_token_ids"] = tuple(sp.get("stop_token_ids", ()))
+    return SamplingParams(**sp)
+
+
+def state_to_wire(state: dict) -> dict:
+    """extract_request/_record_state dict -> JSON-safe (the sampling
+    field is a live SamplingParams object)."""
+    out = dict(state)
+    out["sampling"] = sampling_to_dict(state["sampling"])
+    return out
+
+
+def state_from_wire(state: dict) -> dict:
+    out = dict(state)
+    out["sampling"] = sampling_from_dict(state["sampling"])
+    return out
+
+
+def events_to_wire(events) -> List[dict]:
+    return [asdict(ev) for ev in events]
+
+
+def events_from_wire(raw: Sequence[dict]):
+    from paddle_tpu.serving.engine import TokenEvent
+
+    return [TokenEvent(**ev) for ev in raw]
+
+
+def outputs_to_wire(outputs: Dict[str, object]) -> Dict[str, dict]:
+    return {rid: asdict(o) for rid, o in outputs.items()}
+
+
+def outputs_from_wire(raw: Dict[str, dict]):
+    from paddle_tpu.serving.engine import RequestOutput
+
+    return {rid: RequestOutput(**o) for rid, o in raw.items()}
+
+
+# ---------------------------------------------- handoff payload framing
+
+
+def handoff_to_wire(payload: Optional[dict]
+                    ) -> Tuple[dict, List[np.ndarray]]:
+    """Flatten an engine.extract_handoff page payload into (header,
+    frames): per layer, per pool array, one stacked [n_slots, ...]
+    binary frame — raw page bytes + scale rows in pool order, with the
+    per-slot content hashes in the header for receive-time
+    verification."""
+    if payload is None:
+        return {"handoff": None}, []
+    bufs: List[np.ndarray] = []
+    for layer in payload["layers"]:
+        bufs.extend(layer)
+    return {"handoff": {
+        "start_page": payload["start_page"],
+        "covered_tokens": payload["covered_tokens"],
+        "hashes": [int(h) for h in payload["hashes"]],
+        "arrays_per_layer": len(payload["layers"][0]),
+        "num_layers": len(payload["layers"]),
+    }}, bufs
+
+
+def handoff_from_wire(header: dict,
+                      bufs: Sequence[np.ndarray]) -> Optional[dict]:
+    meta = header.get("handoff")
+    if meta is None:
+        return None
+    per = meta["arrays_per_layer"]
+    layers = [tuple(bufs[li * per + j] for j in range(per))
+              for li in range(meta["num_layers"])]
+    return {"start_page": meta["start_page"],
+            "covered_tokens": meta["covered_tokens"],
+            "hashes": list(meta["hashes"]),
+            "layers": layers}

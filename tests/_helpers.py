@@ -209,6 +209,20 @@ class PeriodicStubRunner(StubPagedRunner):
         return row
 
 
+def stub_runner_factory(index=0, vocab_size=31, block_size=4,
+                        max_model_len=64, period=0):
+    """Importable replica-process factory (ISSUE 12): the launcher spec
+    `{"factory": "_helpers:stub_runner_factory", "sys_path": [tests/]}`
+    rebuilds a StubPagedRunner inside each replica child — the runners
+    are deterministic, so every process computes identical streams."""
+    if period:
+        return PeriodicStubRunner(period=period, vocab_size=vocab_size,
+                                  block_size=block_size,
+                                  max_model_len=max_model_len)
+    return StubPagedRunner(vocab_size=vocab_size, block_size=block_size,
+                           max_model_len=max_model_len)
+
+
 def child_env(repo_on_pythonpath=True, num_cpu_devices=None):
     """Env for spawning CPU-only child processes from tests.
 

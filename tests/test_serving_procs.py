@@ -1,0 +1,599 @@
+"""Disaggregated serving (ISSUE 12): process-per-engine replicas and
+the prefill/decode split over the KV-handoff machinery.
+
+The contract under test: replica PROCESSES behind the process-backend
+ServingRouter produce EXACTLY the single-engine / naive-oracle token
+streams (greedy and seeded temperature), a SIGKILLed replica process
+recovers with zero lost and zero duplicated tokens, the rendezvous
+path fails LOUDLY naming missing ranks, and the prefill->decode KV
+handoff is bit-exact — raw page bytes (int8 codes + scale rows
+included) ride the wire and are content-hash-verified at receive.
+
+Process-spawning tests share one module-scoped launcher environment;
+the pure protocol / tier / handoff machinery is pinned in-process on
+the numpy stub so the suite stays fast.
+"""
+
+import os
+import signal
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from _helpers import StubPagedRunner, child_env, stub_runner_factory
+from paddle_tpu.serving import (
+    KVCachePool, SamplingParams, ServingEngine, ServingRouter,
+    audit_engine, audit_router, naive_generate,
+)
+from paddle_tpu.serving.launch import ReplicaLauncher
+from paddle_tpu.serving.resilience import ReplicaGoneError
+from paddle_tpu.serving import wire
+
+VOCAB, BLOCK, MAXLEN = 31, 4, 64
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+STUB_SPEC = {"factory": "_helpers:stub_runner_factory",
+             "factory_kw": {"vocab_size": VOCAB, "block_size": BLOCK,
+                            "max_model_len": MAXLEN},
+             "sys_path": [TESTS_DIR]}
+ENGINE_KW = dict(num_blocks=24, max_batch_size=4, max_model_len=MAXLEN,
+                 enable_prefix_cache=True, max_prefill_tokens_per_step=8)
+
+
+@pytest.fixture(autouse=True)
+def _audit_every_engine(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_SERVING_AUDIT", "1")
+
+
+def workload(n=10, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        plen = int(rng.integers(4, 16))
+        prompt = list(map(int, rng.integers(1, VOCAB, plen)))
+        sp = SamplingParams(
+            max_tokens=int(rng.integers(3, 8)),
+            temperature=0.5 if i % 3 == 0 else 0.0,
+            seed=100 + i if i % 3 == 0 else None)
+        out.append((prompt, sp))
+    return out
+
+
+def oracle(prompt, sp):
+    return naive_generate(StubPagedRunner(vocab_size=VOCAB,
+                                          block_size=BLOCK,
+                                          max_model_len=MAXLEN),
+                          prompt, sp, max_model_len=MAXLEN)
+
+
+# ------------------------------------------------------------- wire layer
+
+
+class TestWire:
+    def test_roundtrip_header_and_buffers(self):
+        a, b = socket.socketpair()
+        bufs = [np.arange(12, dtype=np.int8).reshape(3, 4),
+                np.linspace(0, 1, 6, dtype=np.float32).reshape(2, 3)]
+        wire.send_msg(a, {"cmd": "x", "k": [1, 2]}, bufs)
+        header, got = wire.recv_msg(b)
+        assert header["cmd"] == "x" and header["k"] == [1, 2]
+        assert len(got) == 2
+        np.testing.assert_array_equal(got[0], bufs[0])
+        np.testing.assert_array_equal(got[1], bufs[1])
+        assert got[0].dtype == np.int8 and got[1].dtype == np.float32
+        a.close(), b.close()
+
+    def test_recv_exact_survives_partial_writes(self):
+        """A frame dribbled one byte at a time must reassemble whole —
+        the partial-recv retry loop the satellite hardens."""
+        a, b = socket.socketpair()
+        payload = struct.pack("<I", 5) + b"hello"
+
+        def dribble():
+            for i in range(len(payload)):
+                a.sendall(payload[i:i + 1])
+                time.sleep(0.001)
+
+        t = threading.Thread(target=dribble)
+        t.start()
+        assert wire._recv_frame(b) == b"hello"
+        t.join()
+        a.close(), b.close()
+
+    def test_eof_mid_frame_raises_connection_error(self):
+        a, b = socket.socketpair()
+        a.sendall(struct.pack("<I", 100) + b"short")
+        a.close()
+        with pytest.raises(ConnectionError):
+            wire._recv_frame(b)
+        b.close()
+
+    def test_handoff_payload_roundtrip(self):
+        payload = {"start_page": 0, "covered_tokens": 9,
+                   "hashes": [11, 22],
+                   "layers": [(np.ones((2, 4, 1, 1), np.float32),
+                               np.zeros((2, 4, 1, 1), np.float32))]}
+        header, bufs = wire.handoff_to_wire(payload)
+        back = wire.handoff_from_wire(
+            {"handoff": header["handoff"]}, bufs)
+        assert back["covered_tokens"] == 9
+        assert back["hashes"] == [11, 22]
+        np.testing.assert_array_equal(back["layers"][0][0],
+                                      payload["layers"][0][0])
+        assert wire.handoff_from_wire(
+            wire.handoff_to_wire(None)[0], []) is None
+
+    def test_sampling_roundtrip(self):
+        sp = SamplingParams(max_tokens=7, temperature=0.3, top_k=5,
+                            seed=42, stop_token_ids=(1, 2),
+                            session_id="s1")
+        back = wire.sampling_from_dict(wire.sampling_to_dict(sp))
+        assert back == sp
+
+
+# ------------------------------------------- TCPStore hardening satellite
+
+
+class TestStoreHardening:
+    @pytest.fixture()
+    def py_store(self, monkeypatch):
+        """Force the pure-python socket fallback even when the C++
+        lib is available — the fallback must be a REAL cross-peer
+        store now, not an in-process dict."""
+        import paddle_tpu.parallel.store as st
+
+        monkeypatch.setattr(st, "_LIB", None)
+        monkeypatch.setattr(st, "_LIB_ERR", RuntimeError("forced"))
+        return st
+
+    def test_socket_fallback_ops(self, py_store):
+        m = py_store.TCPStore("127.0.0.1", 0, is_master=True, timeout=2.0)
+        c = py_store.TCPStore("127.0.0.1", m.port, timeout=2.0)
+        m.set("k", b"v")
+        assert c.get("k") == b"v"
+        assert c.add("n", 2) == 2 and m.add("n", 3) == 5
+        assert c.check("k") and not c.check("zz")
+        assert c.try_get("zz") is None
+        c.delete_key("k")
+        assert not m.check("k")
+        threading.Timer(0.15, lambda: m.set("late", b"x")).start()
+        c.wait(["late"])        # blocking wait satisfied cross-client
+        m.close(), c.close()
+
+    def test_wait_timeout_is_loud(self, py_store):
+        m = py_store.TCPStore("127.0.0.1", 0, is_master=True, timeout=0.3)
+        with pytest.raises(TimeoutError, match="never"):
+            m.get("never")
+        m.close()
+
+    def test_connect_timeout_names_knob(self, py_store):
+        with pytest.raises(TimeoutError,
+                           match="connect_timeout"):
+            py_store.TCPStore("127.0.0.1", 1, timeout=1.0,
+                              connect_timeout=0.2)
+
+
+# ------------------------------------- engine-level handoff (in-process)
+
+
+def build_engine(**kw):
+    merged = dict(ENGINE_KW)
+    merged.update(kw)
+    return ServingEngine(StubPagedRunner(vocab_size=VOCAB,
+                                         block_size=BLOCK,
+                                         max_model_len=MAXLEN), **merged)
+
+
+class TestEngineHandoff:
+    def test_prefill_role_stages_and_decode_continues_token_exact(self):
+        """The core handoff pin: a prefill-role engine samples each
+        request's first token(s), spills its pages, and a sibling
+        continues via import_handoff — streams equal naive_generate
+        for greedy AND seeded temperature."""
+        pre = build_engine(role="prefill", host_tier_pages=32)
+        dec = build_engine(role="decode", host_tier_pages=32)
+        work = workload(6)
+        rids = [pre.add_request(p, sp) for p, sp in work]
+        for _ in range(60):
+            pre.step()
+            if len(pre.handoff_ready()) == sum(
+                    1 for r in rids if r in pre._requests):
+                break
+        assert not pre.scheduler.has_work()
+        moved = 0
+        for rid in list(pre.handoff_ready()):
+            state, payload = pre.extract_handoff(rid)
+            assert payload is not None and payload["hashes"]
+            dec.import_handoff(state, payload)
+            moved += 1
+        assert moved >= 5          # ultra-short requests may finish early
+        outs = dict(pre.outputs())
+        outs.update(dec.run())
+        for rid, (p, sp) in zip(rids, work):
+            assert outs[rid].output_tokens == oracle(p, sp), rid
+        assert dec.metrics.handoff_recompute_fallbacks.value == 0
+        assert dec.metrics.handoff_pages_in.value > 0
+        pre.release_prefix_cache()
+        dec.release_prefix_cache()
+        assert pre.pool.allocator.check_no_leaks()
+        assert dec.pool.allocator.check_no_leaks()
+        audit_engine(pre), audit_engine(dec)
+
+    def test_handoff_without_tier_falls_back_to_recompute(self):
+        pre = build_engine(role="prefill")        # no host tier
+        dec = build_engine()
+        p, sp = [1, 2, 3, 4, 5, 6], SamplingParams(max_tokens=6)
+        rid = pre.add_request(p, sp)
+        for _ in range(30):
+            pre.step()
+            if pre.handoff_ready():
+                break
+        state, payload = pre.extract_handoff(rid)
+        assert payload is None                    # pages could not ride
+        dec.import_handoff(state, payload)
+        outs = dec.run()
+        assert outs[rid].output_tokens == oracle(p, sp)
+        assert dec.metrics.handoff_recompute_fallbacks.value == 1
+
+    def test_corrupted_payload_raises_at_receive(self):
+        """Content hashes are verified against the bytes actually
+        written on the receiving side — a flipped byte is refused."""
+        pre = build_engine(role="prefill", host_tier_pages=32)
+        dec = build_engine(host_tier_pages=32)
+        rid = pre.add_request([5, 4, 3, 2, 1, 6, 7, 8],
+                              SamplingParams(max_tokens=6))
+        for _ in range(30):
+            pre.step()
+            if pre.handoff_ready():
+                break
+        state, payload = pre.extract_handoff(rid)
+        payload["layers"][0][0][0].flat[0] += 1.0     # tamper one value
+        with pytest.raises(ValueError, match="content-hash"):
+            dec.import_handoff(state, payload)
+        # the refused slots were freed — nothing leaked host-side
+        assert dec.pool.host_tier.used_count == 0
+
+    def test_abort_of_staged_handoff_releases_slots(self):
+        pre = build_engine(role="prefill", host_tier_pages=32)
+        rid = pre.add_request([1, 2, 3, 4, 5, 6, 7],
+                              SamplingParams(max_tokens=6))
+        for _ in range(30):
+            pre.step()
+            if pre.handoff_ready():
+                break
+        used = pre.pool.host_tier.used_count
+        assert used > 0
+        assert pre.abort(rid)
+        assert pre.handoff_ready() == []
+        assert pre.pool.host_tier.used_count == 0
+        assert pre.outputs()[rid].finish_reason == "aborted"
+        audit_engine(pre)
+
+    def test_snapshot_carries_staged_handoffs_and_role(self):
+        """A crash mid-handoff loses the host pages but never the
+        request: the snapshot serializes staged handoffs as plain
+        waiters and the restored prefill engine re-stages them."""
+        pre = build_engine(role="prefill", host_tier_pages=32)
+        p, sp = [9, 8, 7, 6, 5, 4], SamplingParams(max_tokens=5)
+        rid = pre.add_request(p, sp)
+        for _ in range(30):
+            pre.step()
+            if pre.handoff_ready():
+                break
+        snap = pre.snapshot()
+        assert snap["config"]["role"] == "prefill"
+        assert any(r["request_id"] == rid for r in snap["requests"])
+        fresh = ServingEngine.restore(
+            StubPagedRunner(vocab_size=VOCAB, block_size=BLOCK,
+                            max_model_len=MAXLEN), snap)
+        assert fresh.role == "prefill"
+        for _ in range(30):
+            fresh.step()
+            if fresh.handoff_ready():
+                break
+        state, payload = fresh.extract_handoff(rid)
+        dec = build_engine(host_tier_pages=32)
+        dec.import_handoff(state, payload)
+        assert dec.run()[rid].output_tokens == oracle(p, sp)
+
+
+class TestInt8HandoffBitExact:
+    def test_int8_pages_and_scales_byte_identical_after_transfer(self):
+        """ISSUE 12 acceptance: int8 pages (codes AND scale rows) are
+        byte-identical after the spill -> wire -> import round trip,
+        with content hashes re-verified at receive. Pinned directly at
+        the pool/tier layer: two int8 pools, random codes + scales,
+        raw-byte comparison on both the exported payload and the
+        receiving tier's buffers."""
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(7)
+        src = KVCachePool(2, 8, BLOCK, 2, 4, jnp.float32,
+                          kv_dtype="int8")
+        dst = KVCachePool(2, 8, BLOCK, 2, 4, jnp.float32,
+                          kv_dtype="int8")
+        src.enable_host_tier(8)
+        dst.enable_host_tier(8)
+        pages = src.allocator.alloc(3)
+        # scribble random int8 codes + fp32 scales into the source pages
+        new_pools = []
+        for (k, v, ks, vs) in src.pools:
+            k = k.at[jnp.asarray(pages)].set(jnp.asarray(
+                rng.integers(-128, 128, (3,) + k.shape[1:]), jnp.int8))
+            v = v.at[jnp.asarray(pages)].set(jnp.asarray(
+                rng.integers(-128, 128, (3,) + v.shape[1:]), jnp.int8))
+            ks = ks.at[jnp.asarray(pages)].set(jnp.asarray(
+                rng.random((3,) + ks.shape[1:]), jnp.float32))
+            vs = vs.at[jnp.asarray(pages)].set(jnp.asarray(
+                rng.random((3,) + vs.shape[1:]), jnp.float32))
+            new_pools.append((k, v, ks, vs))
+        src.pools = new_pools
+        slots = src.host_tier.spill_pages(pages)
+        hashes = [src.host_tier.slot_hash(s) for s in slots]
+        layers = src.host_tier.export_slots(slots)
+        # int8 code frames really are int8; scale frames fp32
+        assert str(layers[0][0].dtype) == "int8"
+        assert str(layers[0][2].dtype) == "float32"
+        got = dst.host_tier.import_slots(layers, hashes)
+        assert got is not None
+        # receiving tier's buffer bytes == source tier's, per slot
+        for a, b in zip(src.host_tier.export_slots(slots),
+                        dst.host_tier.export_slots(got)):
+            for x, y in zip(a, b):
+                assert x.tobytes() == y.tobytes()
+        # and the hashes re-verify (CRC-stable across processes)
+        for s_src, s_dst in zip(slots, got):
+            assert (src.host_tier.slot_hash(s_src)
+                    == dst.host_tier.slot_hash(s_dst))
+        # tampered transfer is refused
+        layers[0][0].flat[0] ^= 1
+        with pytest.raises(ValueError, match="content-hash"):
+            dst.host_tier.import_slots(layers, hashes)
+
+
+# ----------------------------------------- thread-backend split (fast)
+
+
+class TestThreadSplit:
+    def test_split_router_token_exact_with_handoffs(self):
+        """prefill_replicas works on the THREAD backend too — same
+        roles, same handoff machinery, no processes: the cheap pin
+        that the router-level split logic is sound."""
+        router = ServingRouter(
+            lambda idx: StubPagedRunner(vocab_size=VOCAB,
+                                        block_size=BLOCK,
+                                        max_model_len=MAXLEN),
+            replicas=2, prefill_replicas=1, host_tier_pages=64,
+            heartbeat_timeout_s=30.0, poll_interval_s=0.05,
+            **ENGINE_KW)
+        assert [r.role for r in router._replicas] == ["prefill",
+                                                      "decode"]
+        work = workload(8)
+        rids = [router.submit(p, sp) for p, sp in work]
+        outs = router.drain(timeout_s=60.0)
+        audit_router(router)
+        for rid, (p, sp) in zip(rids, work):
+            assert outs[rid].output_tokens == oracle(p, sp), rid
+        rm = router.metrics.snapshot()
+        assert rm["handoffs"] >= 6
+        assert rm["itl_s_p99"] >= 0.0
+        # intake only ever touched the prefill replica
+        assert all(o.replicas[0] == 0 for o in outs.values())
+        router.release_prefix_caches()
+        assert router.check_no_leaks()
+        router.shutdown()
+
+
+# ------------------------------------------ process backend (spawning)
+
+
+@pytest.fixture(scope="module")
+def proc_env():
+    return child_env()
+
+
+class TestProcessRouter:
+    def test_cross_process_token_exact_greedy_and_seeded(self, proc_env):
+        router = ServingRouter(
+            STUB_SPEC, replicas=2, backend="process",
+            child_env=proc_env, heartbeat_timeout_s=60.0,
+            poll_interval_s=0.05, rendezvous_timeout_s=120.0,
+            **ENGINE_KW)
+        try:
+            work = workload(10)
+            rids = [router.submit(p, sp) for p, sp in work]
+            outs = router.drain(timeout_s=120.0)
+            audit_router(router)
+            for rid, (p, sp) in zip(rids, work):
+                assert outs[rid].output_tokens == oracle(p, sp), rid
+            # both processes actually served traffic
+            assert len({o.replica for o in outs.values()}) == 2
+            rm = router.metrics.snapshot()
+            assert rm["duplicate_tokens_dropped"] == 0
+            router.release_prefix_caches()
+            assert router.check_no_leaks()
+        finally:
+            router.shutdown()
+
+    def test_sigkill_respawn_zero_loss_zero_dup(self, proc_env):
+        """ISSUE 12 acceptance: SIGKILL a replica process mid-decode;
+        the supervisor detects the corpse (waitpid / dead socket),
+        respawns a fresh process, restores from the crash-safe
+        snapshot + registry backfill — zero lost tokens, zero
+        duplicated tokens, token-exact."""
+        router = ServingRouter(
+            STUB_SPEC, replicas=2, backend="process",
+            child_env=proc_env, heartbeat_timeout_s=60.0,
+            poll_interval_s=0.05, snapshot_every_steps=2,
+            rendezvous_timeout_s=120.0, **ENGINE_KW)
+        try:
+            work = workload(10)
+            rids = [router.submit(p, sp) for p, sp in work]
+            deadline = time.monotonic() + 60
+            while (router.metrics.tokens_delivered.value < 8
+                    and time.monotonic() < deadline):
+                time.sleep(0.002)
+            os.kill(router._replicas[0].engine.proc.pid, signal.SIGKILL)
+            outs = router.drain(timeout_s=120.0)
+            for rid, (p, sp) in zip(rids, work):
+                assert outs[rid].output_tokens == oracle(p, sp), rid
+            assert len(outs) == len(rids)
+            # the kill may land after replica 0 already finished its
+            # share — drain() then completes without waiting on
+            # recovery, and the supervisor's waitpid probe respawns in
+            # the background; wait for it before asserting
+            deadline = time.monotonic() + 30
+            while (router.metrics.snapshot()["replica_restarts"] < 1
+                    and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert router.metrics.snapshot()["replica_restarts"] >= 1
+            audit_router(router)
+        finally:
+            router.shutdown()
+
+    def test_registry_backfill_without_snapshots(self, proc_env):
+        """snapshot_every_steps=0: recovery has NO snapshot to restore
+        from — the router registry alone must regenerate every
+        in-flight request token-exactly (cursor-deduped)."""
+        router = ServingRouter(
+            STUB_SPEC, replicas=2, backend="process",
+            child_env=proc_env, heartbeat_timeout_s=60.0,
+            poll_interval_s=0.05, snapshot_every_steps=0,
+            rendezvous_timeout_s=120.0, **ENGINE_KW)
+        try:
+            work = workload(8)
+            rids = [router.submit(p, sp) for p, sp in work]
+            deadline = time.monotonic() + 60
+            while (router.metrics.tokens_delivered.value < 6
+                    and time.monotonic() < deadline):
+                time.sleep(0.002)
+            os.kill(router._replicas[1].engine.proc.pid, signal.SIGKILL)
+            outs = router.drain(timeout_s=120.0)
+            audit_router(router)
+            for rid, (p, sp) in zip(rids, work):
+                assert outs[rid].output_tokens == oracle(p, sp), rid
+            assert router.metrics.snapshot()["resubmitted_requests"] >= 0
+        finally:
+            router.shutdown()
+
+    def test_process_split_handoff_token_exact(self, proc_env):
+        """The full disaggregated path: 1 prefill + 1 decode PROCESS,
+        KV pages serialized over the wire, decode continues via the
+        page-in machinery — token-exact, no recompute fallbacks."""
+        router = ServingRouter(
+            STUB_SPEC, replicas=2, backend="process",
+            prefill_replicas=1, host_tier_pages=64,
+            child_env=proc_env, heartbeat_timeout_s=60.0,
+            poll_interval_s=0.05, rendezvous_timeout_s=120.0,
+            **ENGINE_KW)
+        try:
+            work = workload(8)
+            rids = [router.submit(p, sp) for p, sp in work]
+            outs = router.drain(timeout_s=120.0)
+            audit_router(router)
+            for rid, (p, sp) in zip(rids, work):
+                assert outs[rid].output_tokens == oracle(p, sp), rid
+            rm = router.metrics.snapshot()
+            agg = router.metrics_snapshot()["engines"]
+            assert rm["handoffs"] >= 6
+            assert agg["handoff_pages_in"] > 0
+            assert agg["pagein_pages"] >= agg["handoff_pages_in"]
+        finally:
+            router.shutdown()
+
+
+class TestRendezvous:
+    def test_timeout_names_missing_rank(self, monkeypatch, proc_env):
+        """The loud-error satellite: a rank that never publishes its
+        port must be NAMED in the timeout, with its liveness. Both
+        children are inert `sleep` stand-ins — rank 0's port is
+        published by hand, rank 1 stays silent — so the test pins the
+        error shape in ~2s without spawning jax processes."""
+        import subprocess
+        import sys as _sys
+
+        launcher = ReplicaLauncher(STUB_SPEC, ENGINE_KW,
+                                   rendezvous_timeout_s=2.0,
+                                   env=proc_env)
+
+        def inert(rank):
+            proc = subprocess.Popen(
+                [_sys.executable, "-c", "import time; time.sleep(60)"])
+            key = f"{launcher.session}/r{rank}e{launcher._epoch}"
+            launcher._epoch += 1
+            if rank == 0:       # rank 0 "arrives"; rank 1 never does
+                launcher.store.set(f"{key}/port", b"1")
+            return proc, key
+
+        monkeypatch.setattr(launcher, "_spawn_proc", inert)
+        with pytest.raises(TimeoutError) as ei:
+            launcher.spawn_all(["mixed", "mixed"])
+        msg = str(ei.value)
+        assert "rank 1" in msg and "alive but silent" in msg
+        assert "1/2 replicas arrived" in msg
+        assert "rendezvous timeout" in msg
+        launcher.close()
+
+    def test_death_during_rendezvous_reports_exit_code(self, monkeypatch,
+                                                       proc_env):
+        import subprocess
+        import sys as _sys
+
+        launcher = ReplicaLauncher(STUB_SPEC, ENGINE_KW,
+                                   rendezvous_timeout_s=5.0,
+                                   env=proc_env)
+
+        def die(rank):
+            proc = subprocess.Popen([_sys.executable, "-c",
+                                     "import sys; sys.exit(7)"])
+            launcher._epoch += 1
+            return proc, f"{launcher.session}/r{rank}edead"
+
+        monkeypatch.setattr(launcher, "_spawn_proc", die)
+        with pytest.raises(ReplicaGoneError, match="exit code 7"):
+            launcher.spawn(0)
+        launcher.close()
+
+    def test_non_serializable_engine_kw_is_loud(self):
+        with pytest.raises(TypeError, match="JSON"):
+            ReplicaLauncher(STUB_SPEC, {"sleep_fn": lambda s: None})
+
+
+@pytest.mark.slow
+class TestProcessHang:
+    def test_sigstop_hang_detected_and_respawned(self, proc_env):
+        """SIGSTOP drill: a stopped process makes no step progress;
+        the heartbeat trips, the fence SIGKILLs the stopped corpse,
+        and the respawned replica finishes the work token-exact."""
+        router = ServingRouter(
+            STUB_SPEC, replicas=2, backend="process",
+            child_env=proc_env, heartbeat_timeout_s=1.5,
+            poll_interval_s=0.1, snapshot_every_steps=2,
+            rendezvous_timeout_s=120.0, command_timeout_s=30.0,
+            **ENGINE_KW)
+        try:
+            # warm both replicas so the hang window measures steps
+            for w in range(4):
+                router.submit([1 + w, 2, 3], SamplingParams(max_tokens=2),
+                              request_id=f"warm-{w}")
+            router.drain(timeout_s=60.0)
+            work = workload(8, seed=3)
+            rids = [router.submit(p, sp) for p, sp in work]
+            deadline = time.monotonic() + 60
+            while (router.metrics.tokens_delivered.value < 4
+                    and time.monotonic() < deadline):
+                time.sleep(0.002)
+            os.kill(router._replicas[0].engine.proc.pid, signal.SIGSTOP)
+            outs = router.drain(timeout_s=120.0)
+            audit_router(router)
+            for rid, (p, sp) in zip(rids, work):
+                assert outs[rid].output_tokens == oracle(p, sp), rid
+            rm = router.metrics.snapshot()
+            assert rm["replica_hangs"] + rm["replica_crashes"] >= 1
+            assert rm["replica_restarts"] >= 1
+        finally:
+            router.shutdown()

@@ -112,6 +112,22 @@ preempt/spill/page-in continuously — it must stay token-exact vs the
 oracle with zero device OR host leaks. Records add the offload
 counters (spill/page-in/hidden-ratio/resumes/fallbacks/drops).
 
+ISSUE 12: `--procs N` (N >= 2) switches to the PROCESS-tier drill: N
+replica processes (each a `python -m paddle_tpu.serving.replica`
+command loop holding its own Llama runner rebuilt from the shared
+seed) behind a process-backend ServingRouter, drilled with REAL
+signals — none (baseline + oracle equality), replica_sigkill (SIGKILL
+mid-decode; waitpid/socket-EOF detection, respawn + restore +
+registry backfill), replica_sigstop (a stopped process trips the
+step-progress heartbeat; the fence SIGKILLs the corpse), handoff
+(1 prefill + 1 decode replica: KV pages spill, cross the wire
+content-hashed, page in on the decode side — token-exact including
+the first-token boundary), and handoff_prefill_kill (the prefill
+replica dies mid-stream; staged handoffs regenerate from the
+registry). Every class must recover with ZERO lost and ZERO
+duplicated tokens, token-exact vs the parent process's naive oracle.
+`--faults` filters these classes too.
+
 ISSUE 5: `--speculate [K]` (K defaults to 4) drills every fault class
 with speculative decoding ON: decode rides n-gram verify spans through
 the full-logits ragged call — the same decode-op fault schedules now
@@ -450,6 +466,161 @@ def run_router_class(fault: str, runner, args) -> dict:
     }
 
 
+PROC_FAULTS = ("none", "replica_sigkill", "replica_sigstop", "handoff",
+               "handoff_prefill_kill")
+
+
+def run_proc_class(fault: str, runner, args) -> dict:
+    """One PROCESS-tier fault class (ISSUE 12): N replica processes
+    behind a process-backend ServingRouter, drilled with real signals —
+    SIGKILL (waitpid-detected death), SIGSTOP (heartbeat-detected
+    hang; the fence SIGKILLs the stopped corpse), and the
+    prefill/decode split incl. killing the PREFILL replica mid-stream.
+    Every class must drain with zero lost and zero duplicated tokens,
+    token-exact vs the parent's naive oracle (the children rebuild
+    IDENTICAL weights from the same seed), audit_router green."""
+    import os as _os
+    import signal
+    import time as _time
+
+    import numpy as np
+
+    from paddle_tpu.serving import (
+        SamplingParams, ServingRouter, audit_router, naive_generate,
+    )
+
+    child_env = dict(_os.environ)
+    child_env["JAX_PLATFORMS"] = "cpu"
+    for k in ("PALLAS_AXON_POOL_IPS", "PJRT_NAMES_AND_LIBRARY_PATHS",
+              "CUSTOM_DEVICE_ROOT"):
+        child_env.pop(k, None)
+    spec = {"factory": "paddle_tpu.serving.replica:model_runner_factory",
+            "factory_kw": {
+                "model": "llama", "seed": 0,
+                "block_size": args.block_size,
+                "max_model_len": args.max_model_len,
+                "attn_impl": args.attn_impl,
+                "kv_dtype": args.kv_dtype,
+                "weight_dtype": args.weight_dtype,
+                "vocab_size": 97, "hidden_size": args.hidden,
+                "num_layers": args.layers,
+                "num_heads": max(2, args.hidden // 16),
+                "num_kv_heads": None,
+                "max_seq_len": args.max_model_len, "dropout": 0.0}}
+    split = fault in ("handoff", "handoff_prefill_kill")
+    router = ServingRouter(
+        spec, replicas=args.procs, backend="process",
+        child_env=child_env, rendezvous_timeout_s=300.0,
+        command_timeout_s=300.0,
+        prefill_replicas=1 if split else 0,
+        host_tier_pages=args.offload or (64 if split else 0),
+        num_blocks=args.num_blocks, max_batch_size=args.max_batch,
+        max_model_len=args.max_model_len, max_step_retries=2,
+        retry_backoff_s=0.001, audit=True,
+        enable_prefix_cache=args.prefix_cache,
+        max_prefill_tokens_per_step=args.chunk or None,
+        snapshot_every_steps=2,
+        # the hang drill's heartbeat must outlive a cold child's jit
+        # compiles (a first step stuck in XLA is not a hang)
+        heartbeat_timeout_s=15.0 if fault == "replica_sigstop" else 600.0,
+        poll_interval_s=0.1)
+
+    rng = np.random.default_rng(0)
+    vocab = 97
+    n = args.requests
+    header = list(rng.integers(1, vocab, 9))
+    work = []
+    crashed = None
+    try:
+        # warm every replica's jit caches first (fresh processes
+        # compile their own) so the signal drills hit STEPS, not
+        # compiles — and so the sigstop heartbeat window is honest
+        for w in range(2 * args.procs):
+            router.submit(list(rng.integers(1, vocab, 8)),
+                          SamplingParams(max_tokens=2),
+                          request_id=f"warm-{w}")
+        router.drain(timeout_s=600.0)
+        for i in range(n):
+            plen = int(rng.integers(4, 20))
+            prompt = list(rng.integers(1, vocab, plen))
+            if i % 2:
+                prompt[:min(len(header), len(prompt) - 1)] = \
+                    header[:len(prompt) - 1]
+            sp = SamplingParams(
+                max_tokens=int(rng.integers(3, args.max_tokens)),
+                temperature=0.7 if i % 4 == 0 else 0.0,
+                seed=1000 + i if i % 4 == 0 else None)
+            rid = router.submit(prompt, sp)
+            work.append((rid, prompt, sp))
+        if fault in ("replica_sigkill", "handoff_prefill_kill"):
+            deadline = _time.monotonic() + 60.0
+            bar = (1 if fault == "handoff_prefill_kill" else n)
+            while (router.metrics.tokens_delivered.value < bar
+                    and _time.monotonic() < deadline):
+                _time.sleep(0.01)
+            # replica 0 is the PREFILL replica in the split drill
+            _os.kill(router._replicas[0].engine.proc.pid, signal.SIGKILL)
+        elif fault == "replica_sigstop":
+            deadline = _time.monotonic() + 60.0
+            while (router.metrics.tokens_delivered.value < 2
+                    and _time.monotonic() < deadline):
+                _time.sleep(0.01)
+            _os.kill(router._replicas[0].engine.proc.pid, signal.SIGSTOP)
+        outs = router.drain(timeout_s=600.0)
+        audit_router(router)
+    except Exception as e:      # must never happen — that's the point
+        crashed = f"{type(e).__name__}: {e}"
+        outs = router.outputs()
+
+    rm = router.metrics.snapshot()
+    agg = router.metrics_snapshot()["engines"]
+    router.release_prefix_caches()
+    leaks_ok = router.check_no_leaks()
+
+    oracle_ok = True
+    for rid, prompt, sp in work:
+        o = outs.get(rid)
+        if o is None:
+            oracle_ok = False
+            break
+        ref = naive_generate(runner, prompt, sp,
+                             max_model_len=args.max_model_len)
+        if o.output_tokens != ref:
+            oracle_ok = False
+            break
+    router.shutdown()
+
+    ok = (crashed is None and leaks_ok and oracle_ok
+          and len([r for r in outs if not r.startswith("warm-")]) == n
+          and all(o.finish_reason for o in outs.values())
+          and (fault not in ("replica_sigkill", "replica_sigstop",
+                             "handoff_prefill_kill")
+               or rm["replica_restarts"] >= 1)
+          and (fault != "replica_sigstop" or rm["replica_hangs"] >= 1)
+          and (not split or rm["handoffs"] >= 1))
+    return {
+        "fault": f"procs_{fault}", "ok": ok, "requests": n,
+        "replicas": args.procs, "backend": "process",
+        "prefill_replicas": 1 if split else 0,
+        "no_unhandled_exception": crashed is None, "crash": crashed,
+        "requests_lost": n - len([r for r in outs
+                                  if not r.startswith("warm-")]),
+        "pages_leaked": not leaks_ok,
+        "oracle_token_equal": oracle_ok,
+        "replica_crashes": rm["replica_crashes"],
+        "replica_hangs": rm["replica_hangs"],
+        "replica_restarts": rm["replica_restarts"],
+        "resubmitted_requests": rm["resubmitted_requests"],
+        "duplicate_tokens_dropped": rm["duplicate_tokens_dropped"],
+        "handoffs": rm["handoffs"],
+        "handoff_fallbacks": rm["handoff_fallbacks"],
+        "handoff_pages_in": agg["handoff_pages_in"],
+        "handoff_recompute_fallbacks": agg["handoff_recompute_fallbacks"],
+        "pagein_pages": agg["pagein_pages"],
+        "step_retries": agg["step_retries"],
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--faults", default=",".join(FAULTS),
@@ -501,6 +672,15 @@ def main() -> int:
                          "and must recover token-exact; implies "
                          "--decode-horizon 4 when left at 1, and adds "
                          "the preempt_storm class to the default drill")
+    ap.add_argument("--procs", type=int, default=0, metavar="N",
+                    help="PROCESS tier drill (ISSUE 12): run the "
+                         "process fault classes (replica_sigkill / "
+                         "replica_sigstop / handoff / "
+                         "handoff_prefill_kill) over N engine replica "
+                         "PROCESSES behind a process-backend "
+                         "ServingRouter — real signals, waitpid "
+                         "detection, respawn + restore, and the "
+                         "prefill/decode KV handoff")
     ap.add_argument("--router", type=int, default=0, metavar="N",
                     help="tier drill (ISSUE 8): run the router fault "
                          "classes (replica_kill / replica_hang / "
@@ -568,6 +748,19 @@ def main() -> int:
     warm.run()
 
     all_ok = True
+    if args.procs >= 2:
+        # ISSUE 12 process-tier drill: replica processes, real signals
+        # (--faults filters here too: `--procs 2 --faults handoff`)
+        classes = (PROC_FAULTS if args.faults == ",".join(FAULTS)
+                   else [f for f in args.faults.split(",")
+                         if f in PROC_FAULTS])
+        for fault in classes:
+            rec = run_proc_class(fault, runner, args)
+            all_ok &= rec["ok"]
+            print(json.dumps(rec))
+        print(f"\nfault smoke (procs x{args.procs}): "
+              f"{'ALL RECOVERED' if all_ok else 'FAILURES'}")
+        return 0 if all_ok else 1
     if args.router >= 2:
         # ISSUE 8 tier drill: the router fault classes replace the
         # single-engine ones (the engine classes are the tier's
