@@ -1549,6 +1549,148 @@ def child_serving_procs(layers: int, hidden: int, max_batch: int,
             if split_arm["ttft_s_p99"] else 0.0)})
 
 
+def child_serving_chaos(layers: int, hidden: int, max_batch: int,
+                        requests: int, prompt: int, gen: int, vocab: int):
+    """Tier-durability chaos rung (ISSUE 13): what does the write-ahead
+    journal COST, and how fast does the tier come back from the two
+    crash shapes it now survives?
+
+    Arms (thread-backend 2-replica router over the shared GPT runners):
+
+      journal off/on   identical closed-batch workloads with the WAL
+                       disabled vs enabled (fsync="interval"); commits
+                       tokens/s for both and the overhead percentage —
+                       acceptance: < 3% regression (best-of-2 per arm
+                       to cut scheduler noise)
+      replica_kill     journal on; one replica fenced at half-stream;
+                       supervisor restore — commits the fence-to-live
+                       recovery time (router.metrics recovery_s) and
+                       the zero-lost/zero-dup record
+      router_kill      journal on; at half-stream the ROUTER dies the
+                       hard way (every worker fenced mid-flight, no
+                       graceful teardown — the in-process equivalent
+                       of SIGKILL, the real-signal version lives in
+                       fault_smoke --net) and ServingRouter.recover()
+                       rebuilds the tier from the journal — commits
+                       recover-to-drained time, zero lost, token-exact
+                       vs naive_generate
+    """
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.serving import (
+        GPTRunner, SamplingParams, ServingRouter, audit_router,
+        naive_generate,
+    )
+
+    backend = jax.default_backend()
+    paddle.seed(0)
+    max_len = prompt + gen
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+                    num_heads=max(hidden // 64, 1), max_seq_len=max_len,
+                    dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+    block_size = min(16, max_len)
+    pages_per_seq = -(-max_len // block_size)
+    runners = [GPTRunner(model, block_size=block_size,
+                         max_model_len=max_len) for _ in range(2)]
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(0, vocab, prompt)) for _ in range(requests)]
+    common = dict(replicas=2, num_blocks=max_batch * pages_per_seq + 1,
+                  max_batch_size=max_batch, max_model_len=max_len,
+                  enable_prefix_cache=True,
+                  max_prefill_tokens_per_step=4 * block_size,
+                  snapshot_every_steps=4, poll_interval_s=0.05,
+                  heartbeat_timeout_s=300.0)
+
+    def run_arm(journal: bool, kill: str = "") -> dict:
+        jp = tempfile.mktemp(suffix=".jsonl") if journal else None
+        router = ServingRouter(lambda idx: runners[idx],
+                               journal_path=jp, **common)
+        t0 = time.time()
+        rids = [router.submit(p, SamplingParams(max_tokens=gen),
+                              request_id=f"c{i}")
+                for i, p in enumerate(prompts)]
+        recovery_s = 0.0
+        if kill:
+            half = requests * gen // 2
+            deadline = time.time() + 120.0
+            while (router.metrics.tokens_delivered.value < half
+                    and time.time() < deadline):
+                time.sleep(0.002)
+        if kill == "replica":
+            router.kill_replica(0)
+        elif kill == "router":
+            # the hard router death: fence every worker mid-flight and
+            # recover a FRESH tier from nothing but the journal
+            for rep in router._replicas:
+                rep.fenced = True
+                rep.stop = True
+                rep.wake.set()
+            router.supervisor.stop()
+            router._journal.close()
+            r0 = time.time()
+            router = ServingRouter.recover(lambda idx: runners[idx],
+                                           jp, **common)
+            recovery_s = time.time() - r0
+        outs = router.drain(timeout_s=600.0)
+        wall = time.time() - t0
+        audit_router(router)
+        rm = router.metrics.snapshot()
+        jstats = (router.metrics_snapshot().get("journal", {})
+                  if journal else {})
+        exact = all(
+            outs[rid].output_tokens == naive_generate(
+                runners[0], p, SamplingParams(max_tokens=gen),
+                max_model_len=max_len)
+            for rid, p in zip(rids, prompts)) if kill else True
+        arm = {"journal": journal, "kill": kill,
+               "wall_s": round(wall, 3),
+               "tokens_per_sec": requests * gen / wall,
+               "requests_lost": requests - len(outs),
+               "duplicate_tokens_dropped": rm["duplicate_tokens_dropped"],
+               "replica_restarts": rm["replica_restarts"],
+               "recovered_requests": rm.get("recovered_requests", 0.0),
+               "supervisor_recovery_s_max": rm["recovery_s_max"],
+               "router_recovery_s": round(recovery_s, 3),
+               "token_exact": exact, **jstats}
+        router.release_prefix_caches()
+        arm["pages_leaked"] = not router.check_no_leaks()
+        router.shutdown()
+        if jp is not None and os.path.exists(jp):
+            os.unlink(jp)
+        return arm
+
+    run_arm(False)                       # warmup: compiles chunk+decode
+    # best-of-2 per arm: tokens/s on a shared host is noisy, and the
+    # overhead claim divides two of these numbers
+    off = max((run_arm(False) for _ in range(2)),
+              key=lambda a: a["tokens_per_sec"])
+    on = max((run_arm(True) for _ in range(2)),
+             key=lambda a: a["tokens_per_sec"])
+    replica_kill = run_arm(True, kill="replica")
+    router_kill = run_arm(True, kill="router")
+    overhead_pct = 100.0 * (1.0 - on["tokens_per_sec"]
+                            / off["tokens_per_sec"])
+    _write_child({
+        "backend": backend, "layers": layers, "hidden": hidden,
+        "max_batch": max_batch, "requests": requests, "prompt": prompt,
+        "gen": gen, "workload": "chaos",
+        "journal_off": off, "journal_on": on,
+        "replica_kill": replica_kill, "router_kill": router_kill,
+        "journal_overhead_pct": round(overhead_pct, 2),
+        "journal_overhead_ok": overhead_pct < 3.0,
+        "replica_kill_recovery_s":
+            replica_kill["supervisor_recovery_s_max"],
+        "router_kill_recovery_s": router_kill["router_recovery_s"]})
+
+
 def _write_child(obj: dict) -> None:
     with open(os.environ["BENCH_CHILD_OUT"], "w") as f:
         json.dump(obj, f)
@@ -2078,6 +2220,49 @@ def main():
                 f"{mx['itl_s_p99']*1e3:.1f}ms, "
                 f"{sp['handoffs']:.0f} handoffs")
 
+    # tier-durability chaos rung (ISSUE 13): write-ahead journal
+    # overhead (acceptance < 3% tokens/s regression) and recovery time
+    # for the two crash shapes — replica SIGKILL (supervisor restore)
+    # vs router death (ServingRouter.recover from the journal)
+    if on_tpu and remaining() > 120:
+        r = run_child("serving:4:256:4:12:64:32:32768:chaos",
+                      min(900, remaining()))
+        if r is not None and "journal_overhead_pct" in r:
+            line = {"metric": "serving_chaos_journal_overhead_pct",
+                    "value": r["journal_overhead_pct"], "unit": "%",
+                    "vs_baseline": 0.0,
+                    "journal_overhead_ok": r["journal_overhead_ok"],
+                    "tokens_per_sec_journal_off":
+                        round(r["journal_off"]["tokens_per_sec"], 1),
+                    "tokens_per_sec_journal_on":
+                        round(r["journal_on"]["tokens_per_sec"], 1),
+                    "journal_records":
+                        r["journal_on"].get("journal_records", 0),
+                    "backend": r["backend"]}
+            emit(line)
+            _cache_result(line)
+            rk, xk = r["replica_kill"], r["router_kill"]
+            line = {"metric": "serving_chaos_recovery_s",
+                    "value": round(r["router_kill_recovery_s"], 3),
+                    "unit": "s", "vs_baseline": 0.0,
+                    "replica_kill_recovery_s":
+                        round(r["replica_kill_recovery_s"], 3),
+                    "router_kill_lost": xk["requests_lost"],
+                    "router_kill_token_exact": xk["token_exact"],
+                    "router_kill_dup_dropped":
+                        xk["duplicate_tokens_dropped"],
+                    "replica_kill_lost": rk["requests_lost"],
+                    "backend": r["backend"]}
+            emit(line)
+            _cache_result(line)
+            log(f"chaos rung: journal overhead "
+                f"{r['journal_overhead_pct']:.2f}% "
+                f"({'<3% OK' if r['journal_overhead_ok'] else 'OVER BAR'}), "
+                f"recovery router-kill {r['router_kill_recovery_s']:.2f}s "
+                f"vs replica-kill {r['replica_kill_recovery_s']:.2f}s, "
+                f"router-kill lost={xk['requests_lost']} "
+                f"exact={xk['token_exact']}")
+
     if best is not None:
         # headline repeated last: drivers that parse the final stdout JSON
         # line get the largest completed config
@@ -2133,6 +2318,8 @@ def _child_main(mode: str) -> None:
             child_serving_router(*[int(x) for x in parts[:-1]])
         elif parts and parts[-1] == "procs":
             child_serving_procs(*[int(x) for x in parts[:-1]])
+        elif parts and parts[-1] == "chaos":
+            child_serving_chaos(*[int(x) for x in parts[:-1]])
         else:
             child_serving(*[int(x) for x in parts])
     else:

@@ -115,6 +115,22 @@ page-in path, token-exact including int8 codes. The Supervisor
 recovers dead PROCESSES (waitpid probe, socket-EOF ReplicaGoneError,
 SIGSTOP hang fencing) with the same fence/restore/backfill machinery.
 
+TIER DURABILITY (ISSUE 13): `journal.py` gives the router a durable
+control plane — an append-only write-ahead JSONL journal (CRC per
+line, fsync policy, snapshot compaction) recording the at-most-once
+registry, delivery cursors, ownership changes and replica snapshots;
+`ServingRouter.recover(factory, journal_path)` rebuilds the whole
+tier after a router SIGKILL with zero lost and zero duplicated
+tokens. The wire protocol CRC32-checks every frame (corruption is
+NAK'd or retried, never mis-parsed), every EngineClient RPC runs
+under an explicit per-RPC deadline, and idempotent RPCs retry
+transiently (seq-deduped) while mutating ones fail fast to the
+supervisor. `router.drain_replica` / `router.rolling_restart` cycle
+replicas gracefully — running requests migrate with their KV pages
+through the handoff machinery. `resilience.WireFaultInjector` +
+`tools/fault_smoke.py --net` drill drop/corrupt/truncate/delay/reset
+plus the router-kill recovery end to end.
+
 Entry points: `paddle_tpu.inference.create_serving_engine(model)` /
 `create_serving_router(model, replicas=N)` are the bridges from the
 Predictor world; `tools/serving_smoke.py` is a runnable demo;
@@ -139,9 +155,14 @@ from paddle_tpu.serving.metrics import (  # noqa: F401
 from paddle_tpu.serving.model_runner import (  # noqa: F401
     GPTRunner, LlamaRunner, PagedModelRunner, bucket_len, runner_for,
 )
+from paddle_tpu.serving.journal import RouterJournal  # noqa: F401
 from paddle_tpu.serving.resilience import (  # noqa: F401
     FaultInjector, InjectedDeviceError, InvariantViolation, QueueFullError,
-    ReplicaCrashError, ReplicaGoneError, audit_engine, audit_router,
+    ReplicaCrashError, ReplicaGoneError, WireFaultInjector, audit_engine,
+    audit_router,
+)
+from paddle_tpu.serving.wire import (  # noqa: F401
+    WireCorruptionError, WireTimeoutError,
 )
 # process-per-engine replicas (ISSUE 12): the launcher spawns replica
 # processes (paddle_tpu/serving/replica.py command loops) rendezvoused
@@ -175,7 +196,8 @@ __all__ = [
     "PagedModelRunner", "PrefixCache",
     "EngineClient", "ReplicaLauncher",
     "QueueFullError", "ReplicaCrashError", "ReplicaGoneError",
-    "Request", "RequestOutput",
+    "Request", "RequestOutput", "RouterJournal",
+    "WireCorruptionError", "WireFaultInjector", "WireTimeoutError",
     "RequestState", "RouterMetrics", "RouterOutput", "SCRATCH_PAGE",
     "SamplingParams", "SequenceKV", "ServingEngine", "ServingRouter",
     "SpecLayout", "StreamDetokenizer", "Supervisor", "TokenEvent",

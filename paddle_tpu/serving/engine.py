@@ -1781,6 +1781,39 @@ class ServingEngine:
             if rec is not None:
                 self.metrics.handoff_pages_out.inc(len(rec.slots))
 
+    def stage_migration(self, request_id: str) -> bool:
+        """Park ONE RUNNING decode-phase request in the handoff buffer
+        on demand — the graceful-drain primitive (ISSUE 13). Exactly
+        the `_stage_handoffs` spill (pages to the host tier from page
+        0, coverage clamped to context-1, slot released) but role-
+        agnostic and per-request: `router.drain_replica` stages a
+        draining replica's running requests so their KV pages ride to
+        a sibling via extract_handoff/import_handoff instead of being
+        recomputed. Returns False when the request is not in a
+        stageable state (waiting, finished, still prefilling, or no
+        sampled token yet) — the caller then falls back to
+        extract_request / registry recompute, which is always
+        correct."""
+        req = self._requests.get(request_id)
+        if (req is None or req.done
+                or req.state is not RequestState.RUNNING
+                or req.phase != "decode" or not req.output_tokens):
+            return False
+        tier = self.pool.host_tier
+        rec = None
+        if tier is not None:
+            covered = min(req.kv.num_tokens, req.num_context - 1)
+            rec = tier.spill_sequence(req.kv, covered,
+                                      include_registered=True)
+        self.scheduler.release_running(req)
+        req.phase = "handoff"
+        req.offload = None
+        self._handoffs[req.request_id] = rec
+        self.metrics.handoffs_out.inc()
+        if rec is not None:
+            self.metrics.handoff_pages_out.inc(len(rec.slots))
+        return True
+
     def handoff_ready(self) -> List[str]:
         """Request ids staged for handoff, oldest first — what the
         router polls after each step on a prefill replica."""

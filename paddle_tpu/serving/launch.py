@@ -37,6 +37,7 @@ backfill, redistribution, new epoch).
 
 from __future__ import annotations
 
+import itertools
 import logging
 import os
 import socket
@@ -49,11 +50,34 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from paddle_tpu.serving.resilience import QueueFullError, ReplicaGoneError
 from paddle_tpu.serving.wire import (
+    IDEMPOTENT_RPCS, WireCorruptionError, WireTimeoutError, encode_msg,
     events_from_wire, handoff_from_wire, handoff_to_wire, outputs_from_wire,
-    recv_msg, sampling_to_dict, send_msg, state_from_wire, state_to_wire,
+    recv_msg, sampling_to_dict, send_all, send_msg, state_from_wire,
+    state_to_wire,
 )
 
 logger = logging.getLogger(__name__)
+
+# RPC deadline classes (ISSUE 13 satellite): NO EngineClient call site
+# may run with an unbounded timeout — a wedged socket must never hang
+# a router worker past its deadline, even when the SIGSTOP heartbeat
+# fence misses it. FAST RPCs (health/stats reads) get a short deadline;
+# everything that may sit behind a jit compile inside the child (step,
+# submit, snapshot, handoff, ...) gets the caller-tuned
+# command_timeout_s, and init gets extra headroom for a cold import.
+RPC_FAST = frozenset({"ping", "metrics", "audit", "check_no_leaks",
+                      "requests"})
+
+
+class _TransientRpcFailure(Exception):
+    """Internal: an RPC attempt failed in a way that leaves the stream
+    framed (clean deadline trip, CRC reject, peer NAK) — retryable for
+    idempotent RPCs, escalated to ReplicaGoneError otherwise."""
+
+    def __init__(self, why: str, elapsed: float):
+        super().__init__(why)
+        self.why = why
+        self.elapsed = elapsed
 
 
 def _repo_pythonpath(env: dict) -> dict:
@@ -126,9 +150,9 @@ class _MetricsShim:
             return dict(self._last)
         c._io_lock.release()
         try:
-            self._last = c._call(
-                {"cmd": "metrics"},
-                timeout=min(c.command_timeout_s, 30.0))[0]["snapshot"]
+            # "metrics" rides the FAST deadline class (the per-RPC
+            # deadline table) — no explicit timeout needed here
+            self._last = c._call({"cmd": "metrics"})[0]["snapshot"]
         except BaseException:           # dead replica: serve the cache
             pass
         return dict(self._last)
@@ -138,14 +162,28 @@ class EngineClient:
     """ServingEngine facade over one replica process."""
 
     def __init__(self, proc: subprocess.Popen, sock: socket.socket,
-                 rank: int, key: str, command_timeout_s: float = 120.0):
+                 rank: int, key: str, command_timeout_s: float = 120.0,
+                 rpc_fast_timeout_s: float = 30.0,
+                 rpc_max_retries: int = 2,
+                 rpc_backoff_s: float = 0.05):
         self.proc = proc
         self.sock = sock
         self.rank = rank
         self.key = key
         self.command_timeout_s = command_timeout_s
+        self.rpc_fast_timeout_s = rpc_fast_timeout_s
+        self.rpc_max_retries = max(0, int(rpc_max_retries))
+        self.rpc_backoff_s = rpc_backoff_s
         self.dead = False
         self._io_lock = threading.Lock()
+        self._seq = itertools.count(1)
+        self._ack_next: set = set()     # output rids to ack next command
+        # wire fault injection seam (ISSUE 13): resilience.
+        # WireFaultInjector, consulted once per RPC attempt
+        self.wire_faults = None
+        self.rpc_stats = {"retries": 0, "deadline_trips": 0,
+                          "crc_rejects": 0, "naks": 0,
+                          "stale_replies": 0}
         self._outputs: Dict[str, object] = {}
         self._requests: Dict[str, _ReqShim] = {}
         self.scheduler = _SchedulerShim()
@@ -167,22 +205,61 @@ class EngineClient:
             f"replica {self.key} (pid {self.proc.pid}) gone: {why} "
             f"[{detail}]")
 
+    def _deadline_for(self, cmd: str) -> float:
+        """The per-RPC deadline table (ISSUE 13 satellite): every call
+        site gets a FINITE deadline — short for health/stats reads,
+        the caller-tuned command_timeout_s for anything that may sit
+        behind device work or a jit compile in the child, extra for
+        init's cold import."""
+        if cmd in RPC_FAST:
+            return min(self.rpc_fast_timeout_s, self.command_timeout_s)
+        if cmd == "init":
+            return max(self.command_timeout_s, 300.0)
+        return self.command_timeout_s
+
     def _call(self, header: dict, bufs=(),
               timeout: Optional[float] = None):
-        """One command round trip. Serialized by _io_lock (the router's
-        per-replica lock already serializes engine touches; this is the
-        backstop for metrics/audit reads from other threads). Raises
-        ReplicaGoneError on any transport failure or timeout."""
+        """One command round trip with an explicit per-RPC deadline.
+        Serialized by _io_lock (the router's per-replica lock already
+        serializes engine touches; this is the backstop for metrics/
+        audit reads from other threads).
+
+        Transient/fatal split (ISSUE 13): failures that provably leave
+        the byte stream framed — a deadline that tripped before any
+        reply byte, a CRC-rejected reply, the replica's NAK for a
+        CRC-rejected request — RETRY with capped exponential backoff,
+        but only for IDEMPOTENT_RPCS (re-execution inside the replica
+        is side-effect-free) and only rpc_max_retries times. Everything
+        else — mid-frame timeouts (desync), EOF/reset, exhausted
+        retries, any failure on a mutating RPC — raises
+        ReplicaGoneError NAMING the RPC and the elapsed time, which
+        fences the replica and hands recovery to the supervisor."""
+        cmd = header["cmd"]
         if self.dead:
             raise ReplicaGoneError(f"replica {self.key} already fenced")
-        with self._io_lock:
+        deadline_s = float(timeout if timeout is not None
+                           else self._deadline_for(cmd))
+        attempts = 0
+        backoff = self.rpc_backoff_s
+        while True:
             try:
-                self.sock.settimeout(timeout if timeout is not None
-                                     else self.command_timeout_s)
-                send_msg(self.sock, header, bufs)
-                reply, frames = recv_msg(self.sock)
-            except (ConnectionError, socket.timeout, OSError) as e:
-                raise self._gone(f"{type(e).__name__}: {e}") from e
+                reply, frames = self._attempt(cmd, header, bufs,
+                                              deadline_s)
+                break
+            except _TransientRpcFailure as e:
+                if (cmd not in IDEMPOTENT_RPCS
+                        or attempts >= self.rpc_max_retries
+                        or self.proc.poll() is not None):
+                    raise self._gone(
+                        f"rpc {cmd!r} failed after {e.elapsed:.2f}s "
+                        f"(deadline {deadline_s:.1f}s, "
+                        f"{attempts} retries): {e.why}") from e
+                attempts += 1
+                self.rpc_stats["retries"] += 1
+                logger.debug("replica %s rpc %r transient (%s); "
+                             "retry %d", self.key, cmd, e.why, attempts)
+                time.sleep(min(backoff, 1.0))
+                backoff *= 2
         self._apply(reply)
         if not reply.get("ok", False):
             err = reply.get("error", "unknown")
@@ -195,6 +272,89 @@ class EngineClient:
             raise RuntimeError(f"replica {self.key} command "
                                f"{header['cmd']!r} failed: {reply}")
         return reply, frames
+
+    def _attempt(self, cmd: str, header: dict, bufs,
+                 deadline_s: float):
+        """One send + receive-matching-seq attempt under _io_lock."""
+        seq = next(self._seq)
+        header = dict(header)
+        header["seq"] = seq
+        # ack the outputs folded from the previous reply so the
+        # replica stops re-shipping them (outputs are shipped until
+        # acked — a reply lost to a deadline/CRC can never lose them)
+        header["ack_outputs"] = sorted(self._ack_next)
+        start = time.monotonic()
+        with self._io_lock:
+            try:
+                act = (self.wire_faults.action(cmd)
+                       if self.wire_faults is not None else None)
+                blob = encode_msg(header, bufs)
+                self.sock.settimeout(deadline_s)
+                if act == "reset":
+                    # simulated peer reset: the connection dies under
+                    # the RPC — always fatal, supervisor respawns
+                    try:
+                        self.sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                if act == "drop":
+                    pass                 # bytes never leave the host
+                elif act == "corrupt":
+                    # flip one payload byte AFTER the 8-byte frame
+                    # header: length stays sane, CRC must catch it
+                    bad = bytearray(blob)
+                    bad[8] ^= 0xFF
+                    send_all(self.sock, bytes(bad))
+                elif act == "truncate":
+                    send_all(self.sock, blob[:max(9, len(blob) // 2)])
+                else:
+                    send_all(self.sock, blob)
+                if act == "delay":
+                    # gray failure: the replica is alive but slow — the
+                    # reply arrives after the client's deadline
+                    time.sleep(self.wire_faults.delay_s)
+                while True:
+                    remaining = deadline_s - (time.monotonic() - start)
+                    if remaining <= 0:
+                        raise WireTimeoutError(
+                            "deadline exhausted awaiting reply",
+                            partial=False)
+                    self.sock.settimeout(remaining)
+                    reply, frames = recv_msg(self.sock)
+                    if (reply.get("error") == "wire_corrupt"
+                            and reply.get("seq") is None):
+                        # the replica CRC-rejected OUR request frame
+                        self.rpc_stats["naks"] += 1
+                        raise _TransientRpcFailure(
+                            "request frame corrupted (peer CRC "
+                            "reject)", time.monotonic() - start)
+                    if reply.get("seq") in (None, seq):
+                        return reply, frames
+                    # a previous timed-out attempt's reply arriving
+                    # late: fold its stats/outputs (never lose a
+                    # finished output), then keep waiting for ours
+                    self.rpc_stats["stale_replies"] += 1
+                    self._apply(reply)
+            except WireTimeoutError as e:
+                elapsed = time.monotonic() - start
+                self.rpc_stats["deadline_trips"] += 1
+                if e.partial:
+                    raise self._gone(
+                        f"rpc {cmd!r} deadline tripped MID-FRAME after "
+                        f"{elapsed:.2f}s (deadline {deadline_s:.1f}s) "
+                        "— stream desynced") from e
+                raise _TransientRpcFailure(
+                    f"deadline exceeded ({deadline_s:.1f}s)",
+                    elapsed) from e
+            except WireCorruptionError as e:
+                self.rpc_stats["crc_rejects"] += 1
+                raise _TransientRpcFailure(
+                    f"reply frame corrupted: {e}",
+                    time.monotonic() - start) from e
+            except (ConnectionError, socket.timeout, OSError) as e:
+                raise self._gone(
+                    f"rpc {cmd!r}: {type(e).__name__}: {e} after "
+                    f"{time.monotonic() - start:.2f}s") from e
 
     def _apply(self, reply: dict) -> None:
         """Fold a reply's stats + fresh outputs into the cached view."""
@@ -220,6 +380,9 @@ class EngineClient:
                 if shim is None:
                     shim = self._requests[rid] = _ReqShim(rid, -1)
                 shim.done = True
+        # replica ships outputs until acked: ack exactly what this
+        # reply carried (re-acks happen naturally if the ack is lost)
+        self._ack_next = set(outs or ())
 
     # --------------------------------------------------- engine surface
 
@@ -299,6 +462,14 @@ class EngineClient:
         self._requests.pop(request_id, None)
         return state_from_wire(reply["state"])
 
+    def stage_migration(self, request_id: str) -> bool:
+        """Park one RUNNING request in the replica's handoff buffer
+        (graceful drain, ISSUE 13) — its KV pages spill to the child's
+        host tier so extract_handoff can ship them to a sibling."""
+        reply, _ = self._call({"cmd": "stage_migration",
+                               "request_id": request_id})
+        return bool(reply["staged"])
+
     def handoff_ready(self) -> List[str]:
         return list(self._handoffs)
 
@@ -339,16 +510,37 @@ class EngineClient:
         return self.proc.poll() is not None
 
     def shutdown(self, timeout_s: float = 5.0) -> None:
-        try:
-            self._call({"cmd": "shutdown"}, timeout=timeout_s)
-        except BaseException:
-            pass
-        self.kill(timeout_s)
+        """Graceful stop, BOUNDED by timeout_s end to end (ISSUE 13
+        satellite): the whole sequence — waiting for the command lock
+        (another thread may be parked in a recv on a half-closed
+        socket), the shutdown round trip, and reaping the process —
+        must finish within ~timeout_s even when the child ignores the
+        shutdown command entirely. The lock is acquired WITH a
+        deadline (never `with self._io_lock`, which waits forever) and
+        whatever budget remains bounds the socket I/O; kill() then
+        always completes because SIGKILL needs no cooperation."""
+        start = time.monotonic()
+        got = self._io_lock.acquire(timeout=timeout_s)
+        if got:
+            try:
+                remaining = max(0.05, timeout_s
+                                - (time.monotonic() - start))
+                self.sock.settimeout(remaining)
+                send_msg(self.sock, {"cmd": "shutdown",
+                                     "seq": next(self._seq)})
+                recv_msg(self.sock)      # best-effort goodbye
+            except BaseException:
+                pass
+            finally:
+                self._io_lock.release()
+        self.kill(max(0.1, timeout_s - (time.monotonic() - start)))
 
     def kill(self, timeout_s: float = 5.0) -> None:
         """SIGKILL the replica process and reap it — also the recovery
         path for a SIGSTOP'd (hung) process: SIGKILL applies to stopped
-        processes, so the fence always completes."""
+        processes, so the fence always completes. Never touches the
+        command lock: closing the socket unblocks any reader thread
+        still parked in a recv (it surfaces ReplicaGoneError there)."""
         self.dead = True
         try:
             if self.proc.poll() is None:
@@ -382,6 +574,8 @@ class ReplicaLauncher:
     def __init__(self, spec: dict, engine_kw: dict, *,
                  rendezvous_timeout_s: float = 120.0,
                  command_timeout_s: float = 120.0,
+                 rpc_fast_timeout_s: float = 30.0,
+                 rpc_max_retries: int = 2,
                  env: Optional[dict] = None):
         import json as _json
 
@@ -396,6 +590,8 @@ class ReplicaLauncher:
             ) from e
         self.rendezvous_timeout_s = rendezvous_timeout_s
         self.command_timeout_s = command_timeout_s
+        self.rpc_fast_timeout_s = rpc_fast_timeout_s
+        self.rpc_max_retries = rpc_max_retries
         self.session = f"serving-{uuid.uuid4().hex[:8]}"
         self._env = dict(env if env is not None else os.environ)
         _repo_pythonpath(self._env)
@@ -459,8 +655,7 @@ class ReplicaLauncher:
             if proc.poll() is None:
                 proc.kill()
             raise
-        client = EngineClient(proc, sock, rank, key,
-                              self.command_timeout_s)
+        client = self._client(proc, sock, rank, key)
         kw = dict(engine_kw if engine_kw is not None else self.engine_kw)
         kw["role"] = role
         try:
@@ -470,11 +665,21 @@ class ReplicaLauncher:
             raise
         return client
 
-    def spawn_all(self, roles: Sequence[str]) -> List[EngineClient]:
+    def _client(self, proc, sock, rank, key) -> EngineClient:
+        return EngineClient(proc, sock, rank, key,
+                            self.command_timeout_s,
+                            rpc_fast_timeout_s=self.rpc_fast_timeout_s,
+                            rpc_max_retries=self.rpc_max_retries)
+
+    def spawn_all(self, roles: Sequence[str],
+                  snapshots: Optional[Sequence[Optional[dict]]] = None
+                  ) -> List[EngineClient]:
         """Spawn the initial fleet concurrently and rendezvous with ONE
         shared deadline; on timeout the error names EXACTLY which ranks
         are missing — and which of those already died, with their exit
-        codes — instead of a bare hang."""
+        codes — instead of a bare hang. `snapshots[i]`, when given,
+        restores replica i's engine inside its child (the router-crash
+        recovery path, ISSUE 13)."""
         procs = [self._spawn_proc(rank) for rank in range(len(roles))]
         deadline = time.monotonic() + self.rendezvous_timeout_s
         ports: Dict[int, int] = {}
@@ -510,12 +715,13 @@ class ReplicaLauncher:
             clients = []
             for rank, (proc, key) in enumerate(procs):
                 sock = self._connect(proc, key, ports[rank])
-                clients.append(EngineClient(proc, sock, rank, key,
-                                            self.command_timeout_s))
-            for client, role in zip(clients, roles):
+                clients.append(self._client(proc, sock, rank, key))
+            for rank, (client, role) in enumerate(zip(clients, roles)):
                 kw = dict(self.engine_kw)
                 kw["role"] = role
-                client.init(self.spec, kw)
+                client.init(self.spec, kw,
+                            snapshot=(snapshots[rank] if snapshots
+                                      else None))
             return clients
         except BaseException:
             for proc, _ in procs:

@@ -65,6 +65,13 @@ class Histogram:
     def mean(self) -> float:
         return self.sum / self.count if self._samples else 0.0
 
+    @property
+    def max(self) -> float:
+        """Worst observation (0.0 when empty) — the number the chaos
+        bench commits for recovery latency (ISSUE 13): a p99 hides a
+        single catastrophic recovery, the max cannot."""
+        return max(self._samples) if self._samples else 0.0
+
     def percentile(self, p: float) -> float:
         """Exact nearest-rank percentile, p in [0, 100]."""
         if not self._samples:

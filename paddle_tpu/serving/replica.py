@@ -93,7 +93,11 @@ class ReplicaServer:
     def __init__(self):
         self.engine = None
         self.steps = 0
-        self._reported = set()      # finished outputs already shipped
+        # finished outputs the parent has ACKED (ISSUE 13): outputs are
+        # re-shipped in every reply until the parent acks them in a
+        # later command header — a reply lost to a deadline trip or a
+        # CRC reject can therefore never lose a finished output
+        self._acked = set()
 
     # ------------------------------------------------------------ state
 
@@ -116,8 +120,7 @@ class ReplicaServer:
         from paddle_tpu.serving.wire import outputs_to_wire
 
         fresh = {rid: o for rid, o in self.engine._outputs.items()
-                 if rid not in self._reported}
-        self._reported.update(fresh)
+                 if rid not in self._acked}
         return outputs_to_wire(fresh)
 
     def _reply(self, **extra) -> dict:
@@ -143,6 +146,7 @@ class ReplicaServer:
         )
 
         cmd = header["cmd"]
+        self._acked.update(header.get("ack_outputs", ()))
         if cmd == "init":
             factory = resolve_factory(header["spec"])
             try:
@@ -227,6 +231,11 @@ class ReplicaServer:
                         "message": str(e), "stats": self._stats(),
                         "outputs": self._new_outputs()}
             return self._reply(request_id=rid)
+        if cmd == "stage_migration":
+            # graceful drain (ISSUE 13): park one RUNNING request in
+            # the handoff buffer so its KV pages can ride to a sibling
+            return self._reply(
+                staged=self.engine.stage_migration(header["request_id"]))
         if cmd == "release_prefix_cache":
             return self._reply(released=self.engine.release_prefix_cache())
         if cmd == "check_no_leaks":
@@ -247,15 +256,33 @@ class ReplicaServer:
         raise ValueError(f"unknown command {cmd!r}")
 
     def serve(self, conn: socket.socket) -> None:
-        from paddle_tpu.serving.wire import recv_msg, send_msg
+        from paddle_tpu.serving.wire import (
+            WireCorruptionError, recv_msg, send_msg,
+        )
 
         while True:
-            header, bufs = recv_msg(conn)
+            try:
+                header, bufs = recv_msg(conn)
+            except WireCorruptionError as e:
+                # the parent's request frame failed its CRC (ISSUE 13):
+                # the advertised bytes were consumed so the stream is
+                # still framed — NAK it (seq=None marks "your current
+                # request", the client retries idempotent RPCs) and
+                # keep serving. Never parse corrupted bytes as a
+                # command.
+                send_msg(conn, {"ok": False, "error": "wire_corrupt",
+                                "seq": None, "message": str(e)})
+                continue
             out = self.handle(header, bufs)
             if isinstance(out, tuple):
                 reply, frames = out
             else:
                 reply, frames = out, ()
+            # echo the sequence number: the client matches replies to
+            # attempts with it, so a reply that arrives after its
+            # attempt's deadline is recognized as stale, folded for its
+            # stats/outputs, and never mistaken for the retry's answer
+            reply.setdefault("seq", header.get("seq"))
             send_msg(conn, reply, frames)
             if header["cmd"] == "shutdown":
                 return

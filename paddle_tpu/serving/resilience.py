@@ -226,6 +226,97 @@ class FaultInjector:
         return logits, pools
 
 
+class WireFaultInjector:
+    """Deterministic WIRE fault schedules for the process tier
+    (ISSUE 13): attached to an `EngineClient` (`client.wire_faults =
+    WireFaultInjector(...)`), consulted once per RPC attempt, and keyed
+    by call index over the RPCs the `target` matches — never wall time
+    or RNG, so a failing trace replays exactly (the FaultInjector
+    discipline, moved from the device to the socket).
+
+    Fault classes (each with ``*_every`` periodic and ``*_calls`` exact
+    schedules; call indices are 1-based over TARGET-matched RPCs):
+
+      drop      the request's framed bytes never leave the host — the
+                client's per-RPC deadline trips cleanly (idempotent
+                RPCs retry, mutating ones escalate to the supervisor);
+      corrupt   one payload byte of the outbound request is flipped
+                AFTER framing — the replica's CRC must reject it and
+                NAK (never parse it as a command);
+      truncate  only the first half of the framed bytes are sent — the
+                replica blocks mid-frame, the client's deadline trips,
+                and any retry desyncs into a loud connection error,
+                never a silent mis-parse;
+      delay     the request is sent, then the client sleeps `delay_s`
+                before reading — the gray-failure class: a
+                slow-but-alive replica whose reply lands after the
+                deadline (the late reply is seq-matched as stale and
+                discarded by the retry);
+      reset     the client's half of the connection is shut down under
+                the RPC — EOF/EPIPE both ways, always fatal, the
+                supervisor respawns.
+
+    `target` picks which RPCs the schedule counts: "all", "idempotent"
+    (the retry-safe set), "mutating", or an exact command name / tuple
+    of names (e.g. "step").
+    """
+
+    ACTIONS = ("reset", "truncate", "corrupt", "drop", "delay")
+
+    def __init__(self, *, drop_every: int = 0,
+                 drop_calls: Iterable[int] = (),
+                 corrupt_every: int = 0,
+                 corrupt_calls: Iterable[int] = (),
+                 truncate_every: int = 0,
+                 truncate_calls: Iterable[int] = (),
+                 delay_every: int = 0, delay_calls: Iterable[int] = (),
+                 delay_s: float = 0.5,
+                 reset_every: int = 0, reset_calls: Iterable[int] = (),
+                 target="all"):
+        from paddle_tpu.serving.wire import IDEMPOTENT_RPCS
+
+        self._idempotent = IDEMPOTENT_RPCS
+        if isinstance(target, str) and target not in ("all",
+                                                      "idempotent",
+                                                      "mutating"):
+            target = (target,)
+        self.target = target
+        self.delay_s = float(delay_s)
+        self._sched = {
+            "drop": (drop_every, frozenset(drop_calls)),
+            "corrupt": (corrupt_every, frozenset(corrupt_calls)),
+            "truncate": (truncate_every, frozenset(truncate_calls)),
+            "delay": (delay_every, frozenset(delay_calls)),
+            "reset": (reset_every, frozenset(reset_calls)),
+        }
+        self.calls = 0
+        self.injected = {a: 0 for a in self.ACTIONS}
+
+    def _matches(self, cmd: str) -> bool:
+        if self.target == "all":
+            return True
+        if self.target == "idempotent":
+            return cmd in self._idempotent
+        if self.target == "mutating":
+            return cmd not in self._idempotent
+        return cmd in self.target
+
+    def action(self, cmd: str) -> Optional[str]:
+        """The fault to inject on this RPC attempt, or None. Counts
+        only target-matched attempts; the first scheduled class in
+        ACTIONS order wins when several match one index."""
+        if not self._matches(cmd):
+            return None
+        self.calls += 1
+        n = self.calls
+        for act in self.ACTIONS:
+            every, calls = self._sched[act]
+            if (every > 0 and n % every == 0) or n in calls:
+                self.injected[act] += 1
+                return act
+        return None
+
+
 def audit_engine(engine) -> None:
     """Assert page accounting, slot assignment, and block tables are
     mutually consistent — the opt-in post-step invariant check

@@ -55,6 +55,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from paddle_tpu.serving.engine import ServingEngine
+from paddle_tpu.serving.journal import RouterJournal
 from paddle_tpu.serving.kv_cache import _CHAIN_SEED, page_content_hash
 from paddle_tpu.serving.metrics import (
     Counter, Gauge, Histogram, aggregate_snapshots,
@@ -63,6 +64,7 @@ from paddle_tpu.serving.resilience import (
     QueueFullError, ReplicaCrashError,
 )
 from paddle_tpu.serving.scheduler import SamplingParams
+from paddle_tpu.serving.wire import sampling_from_dict, sampling_to_dict
 
 logger = logging.getLogger(__name__)
 
@@ -176,6 +178,18 @@ class RouterMetrics:
         self.replica_restarts = Counter("replica_restarts")
         self.resubmitted_requests = Counter("resubmitted_requests")
         self.redistributed_requests = Counter("redistributed_requests")
+        # graceful maintenance (ISSUE 13): drain_replica/rolling_restart
+        # — replicas cycled on purpose, and the requests their drains
+        # migrated to siblings (KV-handoff or recompute resubmission)
+        self.replica_drains = Counter("replica_drains")
+        self.drain_migrations = Counter("drain_migrations")
+        self.rolling_restarts = Counter("rolling_restarts")
+        # durable control plane (ISSUE 13): requests rebuilt from the
+        # write-ahead journal by ServingRouter.recover()
+        self.recovered_requests = Counter("recovered_requests")
+        # crash-to-recovered latency (replica respawns AND journal
+        # recoveries), the chaos bench's recovery-time number
+        self.recovery_s = Histogram("router_recovery_s")
         # prefill/decode split (ISSUE 12): requests migrated from a
         # prefill replica to a decode replica WITH their KV pages, and
         # the ones whose pages could not ride (decode side recomputed)
@@ -199,9 +213,13 @@ class RouterMetrics:
             self.tokens_delivered, self.duplicate_tokens_dropped,
             self.replica_crashes, self.replica_hangs,
             self.replica_restarts, self.resubmitted_requests,
-            self.redistributed_requests, self.handoffs,
+            self.redistributed_requests, self.replica_drains,
+            self.drain_migrations, self.rolling_restarts,
+            self.recovered_requests, self.handoffs,
             self.handoff_fallbacks)}
         out["live_replicas"] = self.live_replicas.value
+        out["recovery_s_max"] = self.recovery_s.max
+        out["recovery_s_mean"] = self.recovery_s.mean
         out["ttft_s_p50"] = self.ttft_s.percentile(50)
         out["ttft_s_p99"] = self.ttft_s.percentile(99)
         out["ttft_s_mean"] = self.ttft_s.mean
@@ -278,6 +296,24 @@ class ServingRouter:
                            back over the tier through the normal routing
                            policy instead of leaving it all on the
                            restarted replica
+      journal_path         durable control plane (ISSUE 13): append-only
+                           write-ahead JSONL journal recording registry
+                           records at submit, delivery-cursor advances,
+                           ownership/epoch changes and replica
+                           snapshots; `ServingRouter.recover(factory,
+                           path)` rebuilds the whole tier after a
+                           router SIGKILL from it. None (default) = no
+                           journal
+      journal_fsync        "always" | "interval" (default) | "never" —
+                           see journal.RouterJournal
+      journal_compact_every  appends between snapshot compactions
+      rpc_fast_timeout_s   process backend: deadline for the FAST RPC
+                           class (ping/metrics/audit/stats reads);
+                           mutating RPCs use command_timeout_s
+      rpc_max_retries      process backend: capped-backoff retries for
+                           idempotent RPCs on clean deadline trips /
+                           CRC rejects before escalating to
+                           ReplicaGoneError
     """
 
     def __init__(self, runner_factory, *, replicas: int = 2,
@@ -294,9 +330,15 @@ class ServingRouter:
                  redistribute: bool = True,
                  rendezvous_timeout_s: float = 120.0,
                  command_timeout_s: float = 120.0,
+                 rpc_fast_timeout_s: float = 30.0,
+                 rpc_max_retries: int = 2,
                  child_env: Optional[dict] = None,
+                 journal_path: Optional[str] = None,
+                 journal_fsync: str = "interval",
+                 journal_compact_every: int = 512,
                  clock: Optional[Callable[[], float]] = None,
                  metrics: Optional[RouterMetrics] = None,
+                 _recover_state: Optional[dict] = None,
                  **engine_kw):
         if replicas < 1:
             raise ValueError("replicas must be >= 1")
@@ -349,6 +391,17 @@ class ServingRouter:
         self._rng = np.random.default_rng(0)
         self._replicas: List[EngineReplica] = []
         self._launcher = None
+        # durable control plane (ISSUE 13): the write-ahead journal.
+        # With _recover_state (the replayed view of a dead router's
+        # journal) the file is compacted to one state record first, so
+        # a second crash replays the recovered tier, not stale history
+        self._journal: Optional[RouterJournal] = None
+        if journal_path is not None:
+            self._journal = RouterJournal(
+                journal_path, fsync=journal_fsync,
+                compact_every=journal_compact_every,
+                resume_state=_recover_state)
+        recover_snaps = ((_recover_state or {}).get("snaps") or {})
         if backend == "process":
             # the tentpole (ISSUE 12): replicas are OS PROCESSES —
             # runner_factory is a JSON spec the launcher ships to each
@@ -360,18 +413,41 @@ class ServingRouter:
             self._launcher = ReplicaLauncher(
                 runner_factory, engine_kw,
                 rendezvous_timeout_s=rendezvous_timeout_s,
-                command_timeout_s=command_timeout_s, env=child_env)
+                command_timeout_s=command_timeout_s,
+                rpc_fast_timeout_s=rpc_fast_timeout_s,
+                rpc_max_retries=rpc_max_retries, env=child_env)
+            snaps = ([recover_snaps.get(i) for i in range(replicas)]
+                     if recover_snaps else None)
             for idx, client in enumerate(
-                    self._launcher.spawn_all(self._roles)):
+                    self._launcher.spawn_all(self._roles,
+                                             snapshots=snaps)):
                 self._spawn(idx, client, None, start=False,
                             role=self._roles[idx])
         else:
             for idx in range(replicas):
                 runner = self._make_runner(idx)
-                self._spawn(idx, self._build_engine(runner,
-                                                    self._roles[idx]),
-                            runner, start=False, role=self._roles[idx])
+                snap = recover_snaps.get(idx)
+                if snap is not None:
+                    # router recovery (ISSUE 13): the replica restarts
+                    # from its last JOURNALED crash-safe snapshot —
+                    # recompute-on-resume, token-exact, and anything
+                    # the snapshot missed is backfilled from the
+                    # journaled registry below
+                    engine = ServingEngine.restore(
+                        runner, snap,
+                        tokenizer=engine_kw.get("tokenizer"),
+                        sleep_fn=engine_kw.get("sleep_fn"),
+                        audit=engine_kw.get("audit"))
+                else:
+                    engine = self._build_engine(runner, self._roles[idx])
+                self._spawn(idx, engine, runner, start=False,
+                            role=self._roles[idx])
         self.block_size = self._replicas[0].engine.pool.block_size
+        if _recover_state is not None:
+            # rebuild the at-most-once registry from the journal BEFORE
+            # any worker steps: cursors restored, undelivered work
+            # resubmitted, zombies aborted
+            self._restore_registry(_recover_state)
         for rep in self._replicas:
             self._start_worker(rep)
         self.metrics.live_replicas.set(replicas)
@@ -384,6 +460,132 @@ class ServingRouter:
                 poll_interval_s=poll_interval_s,
                 redistribute=redistribute)
             self.supervisor.start()
+
+    # ------------------------------------------- durable control plane
+
+    @classmethod
+    def recover(cls, runner_factory, journal_path: str, **kw):
+        """Rebuild a serving tier after a router crash (ISSUE 13
+        tentpole): replay the write-ahead journal at `journal_path`,
+        respawn the replica fleet (each replica restored from its last
+        journaled crash-safe snapshot when one exists), rebuild the
+        at-most-once registry with the journaled delivery cursors,
+        resubmit every undelivered request, and drop any token a
+        restored/regenerated execution re-delivers (the cursor is
+        authoritative). Engines are deterministic, so the continued
+        streams are token-exact vs an uninterrupted run — zero lost,
+        zero duplicated.
+
+        `runner_factory` and the keyword knobs must describe the same
+        tier the dead router ran (same factory/spec, same replica
+        count and engine knobs) — the journal records request state,
+        not model code. The journal keeps being written (compacted
+        first), so recovery survives repeated crashes."""
+        state, discarded = RouterJournal.replay(journal_path)
+        if discarded:
+            logger.warning(
+                "journal %s: %d torn/corrupt trailing line(s) "
+                "discarded — their tokens will be regenerated",
+                journal_path, discarded)
+        kw.setdefault("journal_path", journal_path)
+        return cls(runner_factory, _recover_state=state, **kw)
+
+    def _jot(self, rec: dict) -> None:
+        """Append one record to the write-ahead journal (no-op without
+        one). A failing journal write degrades durability, never
+        availability: log and keep serving."""
+        if self._journal is None:
+            return
+        try:
+            self._journal.append(rec)
+        except OSError as e:             # pragma: no cover — disk full
+            logger.error("journal append failed (%s); tier keeps "
+                         "serving without durability for this record", e)
+
+    def _restore_registry(self, state: dict) -> None:
+        """Rebuild self._reqs from a replayed journal state and place
+        every unfinished request on a live replica. Runs BEFORE the
+        worker threads start, so no locking races exist yet."""
+        now = self._clock()
+        max_pid = -1
+        reqs = state.get("reqs", {})
+        order = sorted(reqs.items(),
+                       key=lambda kv: (kv[1].get("ai") is None,
+                                       kv[1].get("ai") or 0))
+        for rid, js in order:
+            sampling = sampling_from_dict(js["sampling"])
+            rec = _RequestRecord(rid, list(js["prompt"]), sampling,
+                                 owner_idx=int(js.get("owner") or 0),
+                                 owner_epoch=-1,
+                                 arrival_index=js.get("ai"),
+                                 submit_time=now)
+            rec.tokens = list(map(int, js["tokens"]))
+            rec.cursor = len(rec.tokens)
+            if rec.tokens:
+                rec.first_token_time = now   # TTFT is meaningless
+            rec.last_token_time = now        # across a router crash
+            if js["done"]:
+                rec.done = True
+                rec.finish_reason = js.get("reason") or "stop"
+                rec.finish_time = now
+            self._reqs[rid] = rec
+            if rid.startswith("req-p"):
+                try:
+                    max_pid = max(max_pid, int(rid[5:]))
+                except ValueError:
+                    pass
+        # auto-minted ids must never collide with journaled ones
+        self._rids = itertools.count(max_pid + 1)
+        live = [r for r in self._replicas if r.status == "live"]
+        # place every unfinished request: ADOPT it where a restored
+        # snapshot already carries it (the engine will re-run delivered
+        # history; the cursor drops the re-delivered tokens), otherwise
+        # INJECT it from the registry with its full delivered prefix
+        for rid, rec in self._reqs.items():
+            if rec.done:
+                continue
+            # a crash can land BETWEEN a step's token batch and its fin
+            # record: the journal then shows an unfinished request that
+            # already satisfies its stop condition — finish it here,
+            # resubmitting it would decode past max_tokens
+            sampling = rec.sampling
+            if rec.tokens and rec.tokens[-1] in sampling.stop_token_ids:
+                self._finish(rec, "stop")
+                continue
+            if len(rec.tokens) >= sampling.max_tokens:
+                self._finish(rec, "length")
+                continue
+            owner = next(
+                (rep for rep in live
+                 if rid in rep.engine._requests
+                 and not rep.engine._requests[rid].done), None)
+            if owner is not None:
+                self._adopt(owner, rec)
+            else:
+                target = None
+                want = rec.owner_idx
+                if 0 <= want < len(self._replicas) \
+                        and self._replicas[want].status == "live":
+                    target = self._replicas[want]
+                if target is None and live:
+                    target = min(live,
+                                 key=lambda r: (self._load(r), r.index))
+                if target is None:
+                    self._finish(rec, "error")
+                    continue
+                self._inject(target, rec)
+            self.metrics.recovered_requests.inc()
+        # zombies: a restored snapshot resurrected requests the tier
+        # already finished — abort them instead of burning compute
+        for rep in live:
+            for rid in list(rep.engine._requests):
+                req = rep.engine._requests[rid]
+                rec = self._reqs.get(rid)
+                if not req.done and (rec is None or rec.done):
+                    try:
+                        rep.engine.abort(rid, "aborted")
+                    except BaseException:    # pragma: no cover
+                        pass
 
     # --------------------------------------------------------- plumbing
 
@@ -529,6 +731,11 @@ class ServingRouter:
                     if (self._snapshot_every and not rep.fenced
                             and rep.steps_done % self._snapshot_every == 0):
                         rep.last_snapshot = rep.engine.snapshot()
+                        # WAL (ISSUE 13): journal the crash-safe
+                        # snapshot — router recovery restores this
+                        # replica from its LAST journaled snapshot
+                        self._jot({"t": "snap", "rep": rep.index,
+                                   "snapshot": rep.last_snapshot})
                     stepped = True
             if rep.role == "prefill" and not rep.fenced and not rep.stop:
                 # disaggregated split (ISSUE 12): migrate every staged
@@ -550,6 +757,8 @@ class ServingRouter:
         if not events:
             return
         now = self._clock()
+        delivered: Dict[str, List[int]] = {}
+        finished: Dict[str, str] = {}
         with self._lock:
             if rep.fenced:
                 return
@@ -566,6 +775,8 @@ class ServingRouter:
                 # next undelivered index is the only possible new event
                 rec.tokens.append(int(ev.token))
                 rec.cursor += 1
+                delivered.setdefault(rec.request_id,
+                                     []).append(int(ev.token))
                 self.metrics.tokens_delivered.inc()
                 if rec.first_token_time is None:
                     rec.first_token_time = now
@@ -574,7 +785,16 @@ class ServingRouter:
                     self.metrics.itl_s.observe(now - rec.last_token_time)
                 rec.last_token_time = now
                 if ev.finished:
-                    self._finish(rec, ev.finish_reason)
+                    self._finish(rec, ev.finish_reason, jot=False)
+                    finished[rec.request_id] = ev.finish_reason
+        # WAL: journal the step's cursor advances as ONE record, and
+        # only THEN the finishes — "done" must never become durable
+        # before the tokens it covers, or a crash landing between the
+        # two records would finish the request one token short
+        if delivered:
+            self._jot({"t": "tok", "d": delivered})
+        for rid, reason in finished.items():
+            self._jot({"t": "fin", "rid": rid, "reason": reason})
 
     def _collect(self, rep: EngineReplica) -> None:
         """Pick up completions that produced no TokenEvent (timeout,
@@ -583,6 +803,8 @@ class ServingRouter:
         outs = rep.engine._outputs
         if not outs:
             return
+        delivered: Dict[str, List[int]] = {}
+        finished: Dict[str, str] = {}
         with self._lock:
             if rep.fenced:
                 return
@@ -595,17 +817,30 @@ class ServingRouter:
                 for tok in out.output_tokens[rec.cursor:]:
                     rec.tokens.append(int(tok))
                     rec.cursor += 1
+                    delivered.setdefault(rid, []).append(int(tok))
                     self.metrics.tokens_delivered.inc()
-                self._finish(rec, out.finish_reason)
+                self._finish(rec, out.finish_reason, jot=False)
+                finished[rid] = out.finish_reason
+        if delivered:
+            self._jot({"t": "tok", "d": delivered})
+        for rid, reason in finished.items():
+            self._jot({"t": "fin", "rid": rid, "reason": reason})
 
-    def _finish(self, rec: _RequestRecord, reason: str) -> None:
-        """Caller holds self._lock."""
+    def _finish(self, rec: _RequestRecord, reason: str,
+                jot: bool = True) -> None:
+        """Caller holds self._lock. `jot=False` defers the journal's
+        fin record to the caller, which must write it AFTER the step's
+        token batch — done-ness must never be durable before the
+        tokens it claims were delivered (torn-tail exactness)."""
         rec.done = True
         rec.finish_reason = reason
         rec.finish_time = self._clock()
         self.metrics.requests_completed.inc()
         self.metrics.e2e_latency_s.observe(rec.finish_time
                                            - rec.submit_time)
+        if jot:
+            self._jot({"t": "fin", "rid": rec.request_id,
+                       "reason": reason})
         self._completion.set()
 
     # ---------------------------------------------------------- routing
@@ -743,6 +978,13 @@ class ServingRouter:
                         self._affinity[h] = rep.index
                     if sampling.session_id is not None:
                         self._sessions[sampling.session_id] = rep.index
+                # WAL (ISSUE 13): the registry record is durable before
+                # submit() returns — a router crash after this line can
+                # never lose the request
+                self._jot({"t": "sub", "rid": rid, "prompt": prompt,
+                           "sampling": sampling_to_dict(sampling),
+                           "rep": rep.index, "epoch": rep.epoch,
+                           "ai": arrival_index})
                 # a drop_oldest overflow may have shed a sibling request
                 # synchronously inside add_request — record it now
                 self._collect(rep)
@@ -835,6 +1077,7 @@ class ServingRouter:
             sid = getattr(rec.sampling, "session_id", None)
             if sid is not None:      # the session follows its request
                 self._sessions[sid] = rep.index
+        self._jot({"t": "own", "rid": rec.request_id, "rep": rep.index})
         self.metrics.resubmitted_requests.inc()
         rep.wake.set()
 
@@ -845,6 +1088,7 @@ class ServingRouter:
             rec.owner_idx, rec.owner_epoch = rep.index, rep.epoch
             if not rec.replicas or rec.replicas[-1] != rep.index:
                 rec.replicas.append(rep.index)
+        self._jot({"t": "own", "rid": rec.request_id, "rep": rep.index})
 
     def _orphans(self, idx: int, epoch: int) -> List[_RequestRecord]:
         with self._lock:
@@ -987,10 +1231,172 @@ class ServingRouter:
         with self._lock:
             rec.owner_idx, rec.owner_epoch = target.index, target.epoch
             rec.replicas.append(target.index)
+        self._jot({"t": "own", "rid": rec.request_id,
+                   "rep": target.index})
         self.metrics.handoffs.inc()
         logger.debug("handoff %s: replica %d -> %d (%d pages)",
                      rec.request_id, rep.index, target.index, npages)
         target.wake.set()
+
+    # --------------------------------- graceful drain / rolling restart
+
+    def _drain_target(self, rep: "EngineReplica"
+                      ) -> Optional["EngineReplica"]:
+        """Least-loaded live sibling of a draining replica. Capacity is
+        deliberately ignored — drain migration rides inject_request,
+        which bypasses the shed gate (a request must never be shed by
+        its own migration)."""
+        with self._lock:
+            cands = [r for r in self._replicas
+                     if r.status == "live" and r is not rep]
+        cands.sort(key=lambda r: (self._load(r), r.index))
+        return cands[0] if cands else None
+
+    def _migrate_out(self, rep: "EngineReplica",
+                     rec: _RequestRecord) -> int:
+        """Move ONE request off a draining replica. Preference order:
+        (1) the KV-handoff path — already-staged handoffs, or RUNNING
+        decode-phase requests staged on demand via stage_migration, so
+        their KV pages ride to the sibling when the host tier is on;
+        (2) extract_request for WAITING requests; (3) the registry
+        resubmission (recompute from the delivered prefix), which is
+        always correct. Returns 1 when the request moved."""
+        rid = rec.request_id
+        # 1) KV-handoff
+        try:
+            with rep.lock:
+                staged = rid in rep.engine.handoff_ready()
+                if not staged:
+                    stage = getattr(rep.engine, "stage_migration", None)
+                    staged = bool(stage is not None and stage(rid))
+            if staged:
+                target = self._choose_decode()
+                if target is not None and target is not rep:
+                    self._migrate_handoff(rep, target, rec)
+                    with self._lock:
+                        moved = not (rec.owner_idx == rep.index
+                                     and rec.owner_epoch == rep.epoch)
+                    if moved:
+                        self.metrics.drain_migrations.inc()
+                        return 1
+        except BaseException as e:
+            logger.warning("drain: handoff migration of %s failed "
+                           "(%s); falling back to resubmission", rid, e)
+        # 2) queued: extract the serialized state (frees any host slots)
+        state = None
+        try:
+            with rep.lock:
+                state = rep.engine.extract_request(rid)
+        except BaseException:
+            state = None                 # running/finished/dead replica
+        with self._lock:
+            if rec.done:
+                return 0
+        target = self._drain_target(rep)
+        if target is None:
+            return 0     # no live sibling: restart_replica backfills
+        # 3) inject (uses `state` when the extract succeeded, else the
+        # registry record — recompute, token-exact via the cursor)
+        self._inject(target, rec, state)
+        self.metrics.drain_migrations.inc()
+        return 1
+
+    def drain_replica(self, idx: int, timeout_s: float = 60.0) -> int:
+        """Gracefully drain replica `idx` (ISSUE 13): stop routing to
+        it, stop its worker (the graceful-stop path flushes any
+        pipelined launch so every committed token reaches the delivery
+        registry), migrate its queued AND running requests to siblings
+        — KV pages ride the existing handoff machinery when the host
+        tier is on, recompute resubmission otherwise — then shut the
+        replica down cleanly (process backend: bounded shutdown RPC +
+        reap). The replica ends status='drained'; `restart_replica`
+        brings a fresh one back. Zero tokens are lost or duplicated:
+        the registry holds every delivered prefix and the cursor
+        absorbs any overlap. Returns the number of requests migrated."""
+        with self._lock:
+            rep = self._replicas[idx]
+            if rep.status != "live":
+                return 0
+            rep.status = "draining"      # routing no longer offers it
+            self.metrics.live_replicas.set(
+                sum(1 for r in self._replicas if r.status == "live"))
+        rep.stop = True
+        rep.wake.set()
+        t = rep.thread
+        if t is not None and t.is_alive():
+            t.join(timeout_s)
+            if t.is_alive():
+                raise TimeoutError(
+                    f"drain_replica({idx}): worker still stepping "
+                    f"after {timeout_s}s — treat as hung and use "
+                    "kill_replica/supervisor recovery instead")
+        moved = 0
+        for rec in self._orphans(rep.index, rep.epoch):
+            moved += self._migrate_out(rep, rec)
+        # the drained engine's counters join tier history, like a
+        # supervisor recovery's would
+        try:
+            self._retired_metrics.append(rep.engine.metrics.snapshot())
+        except BaseException:            # pragma: no cover
+            pass
+        if self.backend == "process":
+            try:
+                rep.engine.shutdown()
+            except BaseException:        # pragma: no cover
+                pass
+        with self._lock:
+            rep.fenced = True
+            rep.status = "drained"
+            self._affinity = {h: i for h, i in self._affinity.items()
+                              if i != idx}
+            self._sessions = {s: i for s, i in self._sessions.items()
+                              if i != idx}
+        self.metrics.replica_drains.inc()
+        self._completion.set()
+        logger.info("replica %d drained (%d requests migrated)",
+                    idx, moved)
+        return moved
+
+    def restart_replica(self, idx: int) -> "EngineReplica":
+        """Bring a drained (or retired) replica back as a FRESH engine
+        — new epoch, empty pool, process backend respawns a child —
+        and backfill any registry request still owned by the dead
+        epoch (the no-live-sibling drain case)."""
+        rep = self._replicas[idx]
+        if rep.status == "live":
+            return rep
+        old_epoch = rep.epoch
+        if self.backend == "process":
+            engine, runner = self._launcher.spawn(rep.index,
+                                                  role=rep.role), None
+        else:
+            runner = self._make_runner(idx)
+            engine = self._build_engine(runner, rep.role)
+        new = self._spawn(idx, engine, runner, start=False,
+                          role=rep.role)
+        for rec in self._orphans(idx, old_epoch):
+            self._inject(new, rec)
+        self._start_worker(new)
+        self.metrics.replica_restarts.inc()
+        self._completion.set()
+        return new
+
+    def rolling_restart(self, drain_timeout_s: float = 60.0) -> int:
+        """Cycle the whole tier one replica at a time (ISSUE 13):
+        drain_replica -> restart_replica for every index, in order.
+        The planned-maintenance path — kernel upgrades, weight
+        reloads, host moves — with zero lost and zero duplicated
+        tokens, token-exact vs the oracle (pinned in
+        tests/test_serving_durability.py). In-flight traffic keeps
+        flowing through the siblings of whichever replica is down.
+        Returns the number of replicas cycled."""
+        cycled = 0
+        for idx in range(len(self._replicas)):
+            self.drain_replica(idx, timeout_s=drain_timeout_s)
+            self.restart_replica(idx)
+            cycled += 1
+        self.metrics.rolling_restarts.inc()
+        return cycled
 
     # ----------------------------------------------------------- drills
 
@@ -1086,9 +1492,12 @@ class ServingRouter:
         engine_snaps = [{k: v for k, v in p.items()
                          if k not in ("replica", "epoch", "steps")}
                         for p in per] + retired
-        return {"router": self.metrics.snapshot(),
-                "engines": aggregate_snapshots(engine_snaps),
-                "per_replica": per}
+        out = {"router": self.metrics.snapshot(),
+               "engines": aggregate_snapshots(engine_snaps),
+               "per_replica": per}
+        if self._journal is not None:
+            out["journal"] = self._journal.stats()
+        return out
 
     # --------------------------------------------------------- teardown
 
@@ -1130,6 +1539,8 @@ class ServingRouter:
                     pass
             if self._launcher is not None:
                 self._launcher.close()
+        if self._journal is not None:
+            self._journal.close()
 
     def __enter__(self) -> "ServingRouter":
         return self

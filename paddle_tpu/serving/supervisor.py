@@ -164,6 +164,7 @@ class Supervisor:
 
     def _recover(self, rep, reason: str) -> None:
         router = self.router
+        t0 = router._clock()             # fence-to-live recovery latency
         self._recovered.add(id(rep))
         rep.fenced = True
         rep.stop = True
@@ -218,6 +219,10 @@ class Supervisor:
             router._redistribute_from(new)
         router._start_worker(new)
         router.metrics.replica_restarts.inc()
+        # replica-kill recovery latency (ISSUE 13): fence -> respawned
+        # worker live — the chaos bench commits this next to the
+        # router-kill journal-recovery time
+        router.metrics.recovery_s.observe(router._clock() - t0)
         router._completion.set()
         logger.warning("replica %d recovered from %s (epoch %d -> %d, "
                        "%d in-flight requests, snapshot=%s)",

@@ -1,21 +1,35 @@
 """Length-prefixed socket protocol for process-per-engine replicas
-(ISSUE 12).
+(ISSUE 12), CRC-hardened per ISSUE 13.
 
 One message = one JSON header frame + `nbufs` raw binary frames. A
-frame is a 4-byte little-endian length followed by that many bytes.
-The header is an arbitrary JSON object; binary frames carry numpy
-arrays (KV page bytes for the prefill->decode handoff — raw page
-bytes + scale rows ride the wire untouched, which is what makes the
-transfer bit-exact including int8 codes). Array metadata (dtype,
-shape) rides the header under "bufs" so the receiving side can
-reconstruct views without copies beyond the recv itself.
+frame is a 4-byte little-endian length, a 4-byte little-endian CRC32
+of the payload, then that many payload bytes. The header is an
+arbitrary JSON object; binary frames carry numpy arrays (KV page
+bytes for the prefill->decode handoff — raw page bytes + scale rows
+ride the wire untouched, which is what makes the transfer bit-exact
+including int8 codes). Array metadata (dtype, shape) rides the header
+under "bufs" so the receiving side can reconstruct views without
+copies beyond the recv itself.
+
+Corruption is DETECTED, never mis-parsed (ISSUE 13): every frame's
+payload is CRC32-checked at receive. A failed check raises
+WireCorruptionError — and only after the advertised payload bytes
+were fully consumed, so the stream stays framed and the caller can
+NAK (replica side) or retry an idempotent RPC (client side) without
+resynchronizing. A corrupted LENGTH prefix cannot be told from data,
+which is why the MAX_FRAME_BYTES guard turns an insane length into a
+loud ConnectionError instead of an allocation bomb.
 
 Every recv/send loops over partial I/O and retries EINTR explicitly
 (the TCPStore-hardening satellite applies the same discipline to the
 rendezvous store): a SIGCHLD from a dying sibling replica, or a
 profiler's SIGPROF, must never tear a frame mid-read. EOF mid-frame
 raises ConnectionError — the caller (EngineClient / the replica loop)
-treats that as peer death, never as data.
+treats that as peer death, never as data. A socket timeout surfaces
+as WireTimeoutError carrying `partial`: False means the deadline
+tripped between messages (the stream is still framed — an idempotent
+RPC may retry), True means it tripped mid-frame (desynced — only
+escalation is safe).
 
 The payloads themselves are the engine's existing serialization
 surfaces: `snapshot()` JSON for restore, the `extract_request` /
@@ -30,6 +44,7 @@ import errno
 import json
 import socket
 import struct
+import zlib
 from dataclasses import asdict
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -39,6 +54,33 @@ import numpy as np
 # far above any sane page payload and low enough to catch a corrupted
 # length prefix before it turns into an allocation bomb
 MAX_FRAME_BYTES = 1 << 30
+
+# RPCs a client may safely re-send after a deadline trip or a CRC
+# reject (ISSUE 13): re-executing them inside the replica changes no
+# engine state, and their replies carry no binary frames, so a retry
+# never desyncs the stream. Everything else (step, submit, inject,
+# handoff_*, ...) mutates and must FAIL FAST to the supervisor path.
+IDEMPOTENT_RPCS = frozenset(
+    {"ping", "metrics", "audit", "check_no_leaks", "requests",
+     "snapshot"})
+
+
+class WireCorruptionError(ConnectionError):
+    """A frame's payload failed its CRC32 check. Raised only after the
+    advertised payload bytes were consumed — the stream remains framed
+    and the connection is still usable (NAK / idempotent retry)."""
+
+
+class WireTimeoutError(ConnectionError):
+    """A socket deadline tripped. `partial=False`: no byte of the
+    message had been read — the stream is still framed and an
+    idempotent RPC may retry. `partial=True`: the timeout hit mid-
+    frame/mid-message — the stream is desynced and only escalation
+    (fence + respawn) is safe."""
+
+    def __init__(self, msg: str, partial: bool):
+        super().__init__(msg)
+        self.partial = partial
 
 
 def send_all(sock: socket.socket, data: bytes) -> None:
@@ -51,6 +93,9 @@ def send_all(sock: socket.socket, data: bytes) -> None:
             n = sock.send(view)
         except InterruptedError:
             continue
+        except socket.timeout:
+            raise WireTimeoutError("socket send timed out (peer not "
+                                   "draining)", partial=True) from None
         except OSError as e:  # pragma: no cover — platform-dependent
             if e.errno == errno.EINTR:
                 continue
@@ -60,9 +105,13 @@ def send_all(sock: socket.socket, data: bytes) -> None:
         view = view[n:]
 
 
-def recv_exact(sock: socket.socket, n: int) -> bytes:
+def recv_exact(sock: socket.socket, n: int,
+               clean_start: bool = True) -> bytes:
     """Read exactly n bytes, retrying partial recvs and EINTR. Raises
-    ConnectionError on EOF (peer died) — never returns short."""
+    ConnectionError on EOF (peer died) — never returns short. A socket
+    timeout raises WireTimeoutError; it is `partial` (stream desynced)
+    unless zero bytes were read AND the caller says this read began at
+    a message boundary (`clean_start`)."""
     buf = bytearray(n)
     view = memoryview(buf)
     got = 0
@@ -71,6 +120,10 @@ def recv_exact(sock: socket.socket, n: int) -> bytes:
             r = sock.recv_into(view[got:], n - got)
         except InterruptedError:
             continue
+        except socket.timeout:
+            raise WireTimeoutError(
+                f"socket recv timed out ({got}/{n} bytes)",
+                partial=got > 0 or not clean_start) from None
         except OSError as e:  # pragma: no cover — platform-dependent
             if e.errno == errno.EINTR:
                 continue
@@ -82,37 +135,68 @@ def recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+def _frame(payload: bytes) -> bytes:
+    return struct.pack("<II", len(payload),
+                       zlib.crc32(payload) & 0xFFFFFFFF) + payload
+
+
 def _send_frame(sock: socket.socket, payload: bytes) -> None:
-    send_all(sock, struct.pack("<I", len(payload)) + payload)
+    send_all(sock, _frame(payload))
 
 
-def _recv_frame(sock: socket.socket) -> bytes:
-    (n,) = struct.unpack("<I", recv_exact(sock, 4))
+def _recv_frame(sock: socket.socket, clean_start: bool = True) -> bytes:
+    head = recv_exact(sock, 8, clean_start=clean_start)
+    n, crc = struct.unpack("<II", head)
     if n > MAX_FRAME_BYTES:
         raise ConnectionError(f"frame length {n} exceeds "
                               f"{MAX_FRAME_BYTES} — corrupted stream")
-    return recv_exact(sock, n) if n else b""
+    payload = recv_exact(sock, n, clean_start=False) if n else b""
+    # verify AFTER the payload is fully consumed: the stream stays
+    # framed, so the caller can NAK or retry without a resync
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise WireCorruptionError(
+            f"frame CRC mismatch ({n} bytes) — payload corrupted in "
+            "transit")
+    return payload
+
+
+def encode_msg(header: dict, bufs: Sequence[np.ndarray] = ()) -> bytes:
+    """Serialize one full message (header frame + binary frames) to a
+    byte blob — the send path, exposed so the wire fault injector can
+    corrupt/truncate real framed bytes."""
+    header = dict(header)
+    header["bufs"] = [{"dtype": str(b.dtype), "shape": list(b.shape)}
+                      for b in bufs]
+    out = [_frame(json.dumps(header).encode())]
+    for b in bufs:
+        out.append(_frame(np.ascontiguousarray(b).tobytes()))
+    return b"".join(out)
 
 
 def send_msg(sock: socket.socket, header: dict,
              bufs: Sequence[np.ndarray] = ()) -> None:
     """One message: JSON header + binary frames. Array dtype/shape
     metadata is recorded in the header so the peer can reconstruct."""
-    header = dict(header)
-    header["bufs"] = [{"dtype": str(b.dtype), "shape": list(b.shape)}
-                      for b in bufs]
-    _send_frame(sock, json.dumps(header).encode())
-    for b in bufs:
-        _send_frame(sock, np.ascontiguousarray(b).tobytes())
+    send_all(sock, encode_msg(header, bufs))
 
 
 def recv_msg(sock: socket.socket) -> Tuple[dict, List[np.ndarray]]:
     header = json.loads(_recv_frame(sock).decode())
     bufs = []
+    corrupt: Optional[WireCorruptionError] = None
     for meta in header.pop("bufs", []):
-        raw = _recv_frame(sock)
+        # consume EVERY advertised frame even when one fails its CRC:
+        # the stream must end this message framed, or the corruption
+        # would cascade into a desync on the next message
+        try:
+            raw = _recv_frame(sock, clean_start=False)
+        except WireCorruptionError as e:
+            corrupt = e
+            continue
         bufs.append(np.frombuffer(raw, dtype=np.dtype(meta["dtype"]))
                     .reshape(meta["shape"]).copy())
+    if corrupt is not None:
+        raise corrupt
     return header, bufs
 
 

@@ -1,0 +1,822 @@
+"""Tier durability & network chaos (ISSUE 13): the write-ahead request
+journal + ServingRouter.recover, CRC-hardened wire frames, per-RPC
+deadlines with the transient/fatal split, and graceful drain / rolling
+restart.
+
+The contract under test: a router death loses NOTHING the journal saw
+(recover() resumes every in-flight request token-exact with zero lost
+and zero duplicated tokens, at any journal truncation offset), a
+corrupted frame is CRC-rejected — never mis-parsed — and either
+retried transparently (idempotent RPCs) or escalated to supervisor
+recovery, no EngineClient call site can block unboundedly, and a
+drained/rolling-restarted tier keeps every stream exact while its
+replicas cycle one at a time.
+"""
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from _helpers import StubPagedRunner, child_env
+from paddle_tpu.serving import (
+    RouterJournal, SamplingParams, ServingRouter, WireFaultInjector,
+    audit_router, naive_generate,
+)
+from paddle_tpu.serving.launch import EngineClient
+from paddle_tpu.serving.resilience import ReplicaGoneError
+from paddle_tpu.serving import wire
+
+VOCAB, BLOCK, MAXLEN = 31, 4, 64
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+STUB_SPEC = {"factory": "_helpers:stub_runner_factory",
+             "factory_kw": {"vocab_size": VOCAB, "block_size": BLOCK,
+                            "max_model_len": MAXLEN},
+             "sys_path": [TESTS_DIR]}
+ENGINE_KW = dict(num_blocks=24, max_batch_size=4, max_model_len=MAXLEN,
+                 enable_prefix_cache=True, max_prefill_tokens_per_step=8)
+
+
+@pytest.fixture(autouse=True)
+def _audit_every_engine(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_SERVING_AUDIT", "1")
+
+
+def factory(idx=0):
+    return StubPagedRunner(vocab_size=VOCAB, block_size=BLOCK,
+                           max_model_len=MAXLEN)
+
+
+def oracle(prompt, sp):
+    return naive_generate(factory(), prompt, sp, max_model_len=MAXLEN)
+
+
+def workload(n=12, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        plen = int(rng.integers(4, 16))
+        prompt = list(map(int, rng.integers(1, VOCAB, plen)))
+        sp = SamplingParams(
+            max_tokens=int(rng.integers(3, 8)),
+            temperature=0.5 if i % 3 == 0 else 0.0,
+            seed=100 + i if i % 3 == 0 else None)
+        out.append((prompt, sp))
+    return out
+
+
+# --------------------------------------------------------- journal unit
+
+
+class TestJournal:
+    def test_append_replay_roundtrip(self, tmp_path):
+        jp = str(tmp_path / "j.jsonl")
+        j = RouterJournal(jp, fsync="never", compact_every=10_000)
+        sp = SamplingParams(max_tokens=5)
+        j.append({"t": "sub", "rid": "a", "prompt": [1, 2],
+                  "sampling": wire.sampling_to_dict(sp), "rep": 0,
+                  "epoch": 0, "ai": 0})
+        j.append({"t": "tok", "d": {"a": [7, 8]}})
+        j.append({"t": "own", "rid": "a", "rep": 1})
+        j.append({"t": "snap", "rep": 1, "snapshot": {"k": "v"}})
+        j.append({"t": "tok", "d": {"a": [9]}})
+        j.append({"t": "fin", "rid": "a", "reason": "length"})
+        j.close()
+        state, discarded = RouterJournal.replay(jp)
+        assert discarded == 0
+        r = state["reqs"]["a"]
+        assert r["tokens"] == [7, 8, 9]
+        assert r["done"] and r["reason"] == "length"
+        assert r["owner"] == 1 and r["ai"] == 0
+        assert state["snaps"][1] == {"k": "v"}
+
+    def test_fin_before_final_tok_record(self, tmp_path):
+        """Regression: _finish journals under the router lock, the
+        step's token batch right after it — replay must extend the
+        stream past the fin record."""
+        jp = str(tmp_path / "j.jsonl")
+        j = RouterJournal(jp, fsync="never")
+        j.append({"t": "sub", "rid": "a", "prompt": [1],
+                  "sampling": wire.sampling_to_dict(SamplingParams()),
+                  "rep": 0, "epoch": 0, "ai": 0})
+        j.append({"t": "fin", "rid": "a", "reason": "length"})
+        j.append({"t": "tok", "d": {"a": [3, 4]}})
+        j.close()
+        state, _ = RouterJournal.replay(jp)
+        assert state["reqs"]["a"]["tokens"] == [3, 4]
+        assert state["reqs"]["a"]["done"]
+
+    def test_compaction_preserves_state_and_bounds_file(self, tmp_path):
+        jp = str(tmp_path / "j.jsonl")
+        j = RouterJournal(jp, fsync="never", compact_every=5)
+        sp = wire.sampling_to_dict(SamplingParams(max_tokens=3))
+        for i in range(4):
+            j.append({"t": "sub", "rid": f"r{i}", "prompt": [i],
+                      "sampling": sp, "rep": 0, "epoch": 0, "ai": i})
+        for k in range(20):
+            j.append({"t": "tok", "d": {f"r{k % 4}": [k]}})
+        assert j.compactions >= 3
+        j.close()
+        with open(jp) as f:
+            lines = [ln for ln in f.read().split("\n") if ln]
+        assert len(lines) <= 6          # one state record + short tail
+        state, _ = RouterJournal.replay(jp)
+        assert sorted(state["reqs"]) == ["r0", "r1", "r2", "r3"]
+        assert state["reqs"]["r0"]["tokens"] == [0, 4, 8, 12, 16]
+
+    def test_torn_tail_discarded(self, tmp_path):
+        jp = str(tmp_path / "j.jsonl")
+        j = RouterJournal(jp, fsync="never")
+        sp = wire.sampling_to_dict(SamplingParams())
+        j.append({"t": "sub", "rid": "a", "prompt": [1], "sampling": sp,
+                  "rep": 0, "epoch": 0, "ai": 0})
+        j.append({"t": "tok", "d": {"a": [5]}})
+        j.close()
+        with open(jp, "a") as f:         # torn mid-append, no newline
+            f.write('deadbeef {"t": "tok", "d": {"a": [6')
+        state, discarded = RouterJournal.replay(jp)
+        assert discarded == 1
+        assert state["reqs"]["a"]["tokens"] == [5]
+
+    def test_corrupt_line_stops_replay(self, tmp_path):
+        jp = str(tmp_path / "j.jsonl")
+        j = RouterJournal(jp, fsync="never")
+        sp = wire.sampling_to_dict(SamplingParams())
+        j.append({"t": "sub", "rid": "a", "prompt": [1], "sampling": sp,
+                  "rep": 0, "epoch": 0, "ai": 0})
+        j.append({"t": "tok", "d": {"a": [5]}})
+        j.append({"t": "tok", "d": {"a": [6]}})
+        j.close()
+        with open(jp) as f:
+            lines = f.read().split("\n")
+        # flip one byte inside the SECOND tok record's body (line 2):
+        # replay must keep sub + first tok and distrust the suffix
+        lines[2] = lines[2][:12] + ("X" if lines[2][12] != "X" else "Y") \
+            + lines[2][13:]
+        with open(jp, "w") as f:
+            f.write("\n".join(lines))
+        state, discarded = RouterJournal.replay(jp)
+        assert discarded == 1            # the corrupt line is the tail
+        assert state["reqs"]["a"]["tokens"] == [5]
+
+    def test_fsync_policy_validated(self, tmp_path):
+        with pytest.raises(ValueError, match="fsync"):
+            RouterJournal(str(tmp_path / "x"), fsync="sometimes")
+        for pol in ("always", "interval", "never"):
+            j = RouterJournal(str(tmp_path / pol), fsync=pol)
+            j.append({"t": "fin", "rid": "z", "reason": "stop"})
+            j.close()
+        assert RouterJournal(
+            str(tmp_path / "always"), fsync="always").fsync == "always"
+
+
+# ------------------------------------------------------- wire CRC layer
+
+
+class TestWireCRC:
+    def test_frame_has_crc_and_roundtrips(self):
+        a, b = socket.socketpair()
+        wire.send_msg(a, {"cmd": "x"}, [np.arange(4, dtype=np.int32)])
+        header, bufs = wire.recv_msg(b)
+        assert header["cmd"] == "x"
+        np.testing.assert_array_equal(bufs[0],
+                                      np.arange(4, dtype=np.int32))
+        a.close(), b.close()
+
+    def test_corrupted_header_frame_rejected_stream_stays_framed(self):
+        """A flipped payload byte must raise WireCorruptionError — and
+        the NEXT message on the same socket must still parse, because
+        the corrupted frame's bytes were fully consumed."""
+        a, b = socket.socketpair()
+        blob = bytearray(wire.encode_msg({"cmd": "evil"}))
+        blob[8] ^= 0xFF                 # first payload byte
+        a.sendall(bytes(blob))
+        wire.send_msg(a, {"cmd": "good"})
+        with pytest.raises(wire.WireCorruptionError, match="CRC"):
+            wire.recv_msg(b)
+        header, _ = wire.recv_msg(b)    # stream still framed
+        assert header["cmd"] == "good"
+        a.close(), b.close()
+
+    def test_corrupted_binary_frame_consumed_then_rejected(self):
+        a, b = socket.socketpair()
+        blob = bytearray(wire.encode_msg(
+            {"cmd": "h"}, [np.zeros(8, np.int8), np.ones(8, np.int8)]))
+        # flip a byte in the LAST frame's payload (binary buf 2)
+        blob[-3] ^= 0x01
+        a.sendall(bytes(blob))
+        wire.send_msg(a, {"cmd": "after"})
+        with pytest.raises(wire.WireCorruptionError):
+            wire.recv_msg(b)
+        assert wire.recv_msg(b)[0]["cmd"] == "after"
+        a.close(), b.close()
+
+    def test_insane_length_prefix_is_loud_not_allocating(self):
+        a, b = socket.socketpair()
+        a.sendall(struct.pack("<II", wire.MAX_FRAME_BYTES + 1, 0))
+        with pytest.raises(ConnectionError, match="exceeds"):
+            wire._recv_frame(b)
+        a.close(), b.close()
+
+    def test_timeout_clean_vs_partial(self):
+        a, b = socket.socketpair()
+        b.settimeout(0.1)
+        with pytest.raises(wire.WireTimeoutError) as ei:
+            wire.recv_msg(b)
+        assert ei.value.partial is False     # no byte read: retryable
+        a.sendall(b"\x08\x00")               # half a frame header
+        with pytest.raises(wire.WireTimeoutError) as ei:
+            wire.recv_msg(b)
+        assert ei.value.partial is True      # mid-frame: desynced
+        a.close(), b.close()
+
+
+# ------------------------------- RPC deadlines + transient/fatal split
+
+
+class _FakeProc:
+    pid = 4242
+
+    def __init__(self):
+        self.rc = None
+
+    def poll(self):
+        return self.rc
+
+    def kill(self):
+        self.rc = -9
+
+    def wait(self, timeout=None):
+        return self.rc
+
+
+class _ScriptedReplica:
+    """A fake replica on the far end of a socketpair: executes one
+    scripted behavior per received message — 'reply', 'ignore',
+    ('late', s), 'nak' — then replies normally forever."""
+
+    def __init__(self, script):
+        self.client_sock, self._sock = socket.socketpair()
+        self.script = list(script)
+        self.received = []
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _serve(self):
+        try:
+            while True:
+                header, _ = wire.recv_msg(self._sock)
+                self.received.append(header["cmd"])
+                beh = self.script.pop(0) if self.script else "reply"
+                if beh == "ignore":
+                    continue
+                if isinstance(beh, tuple) and beh[0] == "late":
+                    time.sleep(beh[1])
+                    beh = "reply"
+                if beh == "nak":
+                    wire.send_msg(self._sock,
+                                  {"ok": False, "error": "wire_corrupt",
+                                   "seq": None, "message": "nak"})
+                    continue
+                wire.send_msg(self._sock,
+                              {"ok": True, "seq": header.get("seq"),
+                               "events": []})
+        except (ConnectionError, OSError):
+            return
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def make_client(script, **kw):
+    srv = _ScriptedReplica(script)
+    kw.setdefault("command_timeout_s", 0.6)
+    kw.setdefault("rpc_fast_timeout_s", 0.3)
+    kw.setdefault("rpc_backoff_s", 0.01)
+    client = EngineClient(_FakeProc(), srv.client_sock, 0, "test", **kw)
+    return client, srv
+
+
+class TestRpcDeadlines:
+    def test_every_rpc_deadline_is_finite(self):
+        """The satellite's audit: no call site may run unbounded — the
+        deadline table must return a finite positive deadline for the
+        whole replica command vocabulary."""
+        client, srv = make_client([])
+        cmds = ("init", "ping", "submit", "abort", "step", "flush",
+                "snapshot", "inject", "extract", "handoff_extract",
+                "handoff_inject", "stage_migration",
+                "release_prefix_cache", "check_no_leaks", "metrics",
+                "audit", "requests", "shutdown")
+        for cmd in cmds:
+            d = client._deadline_for(cmd)
+            assert 0 < d < float("inf"), cmd
+        # fast class strictly shorter than the slow class
+        assert client._deadline_for("ping") < client._deadline_for("step")
+        srv.close()
+
+    def test_idempotent_timeout_retries_then_succeeds(self):
+        client, srv = make_client(["ignore"])   # first ping swallowed
+        client.ping()
+        assert client.rpc_stats["retries"] == 1
+        assert client.rpc_stats["deadline_trips"] == 1
+        assert not client.dead
+        srv.close()
+
+    def test_late_reply_discarded_by_seq(self):
+        """Gray failure: the first reply arrives after the deadline.
+        The retry must seq-discard the stale reply and take the fresh
+        one — never mistake the late answer for the retry's."""
+        client, srv = make_client([("late", 0.6)])
+        client.ping()
+        assert client.rpc_stats["retries"] == 1
+        assert client.rpc_stats["stale_replies"] >= 1
+        srv.close()
+
+    def test_mutating_timeout_fails_fast_naming_rpc(self):
+        client, srv = make_client(["ignore"])
+        with pytest.raises(ReplicaGoneError, match=r"rpc 'step'"):
+            client.step()
+        assert client.rpc_stats["retries"] == 0
+        assert client.dead
+        srv.close()
+
+    def test_deadline_error_names_elapsed_time(self):
+        client, srv = make_client(["ignore"])
+        with pytest.raises(ReplicaGoneError, match=r"deadline"):
+            client.step()
+        srv.close()
+
+    def test_nak_retries_idempotent_but_kills_mutating(self):
+        client, srv = make_client(["nak"])
+        client.ping()                    # NAK -> transparent retry
+        assert client.rpc_stats["naks"] == 1
+        assert client.rpc_stats["retries"] == 1
+        srv.close()
+        client2, srv2 = make_client(["nak"])
+        with pytest.raises(ReplicaGoneError, match="CRC"):
+            client2.step()
+        srv2.close()
+
+    def test_retry_budget_exhausts_to_replica_gone(self):
+        client, srv = make_client(["ignore"] * 10, rpc_max_retries=2)
+        with pytest.raises(ReplicaGoneError, match="2 retries"):
+            client.ping()
+        assert client.dead
+        srv.close()
+
+
+class TestShutdownBounded:
+    def test_shutdown_bounded_when_child_ignores_command(self):
+        """The small-fix satellite: a child that ignores the shutdown
+        command (half-closed socket, wedged loop) must not stall
+        teardown past ~timeout_s."""
+        client, srv = make_client(["ignore", "ignore", "ignore"])
+        t0 = time.monotonic()
+        client.shutdown(timeout_s=0.5)
+        assert time.monotonic() - t0 < 2.0
+        assert client.dead
+        srv.close()
+
+    def test_shutdown_bounded_with_stuck_reader_holding_lock(self):
+        """A reader thread parked in a blocked recv holds _io_lock;
+        shutdown must bound its lock wait instead of joining forever."""
+        client, srv = make_client([])
+        client._io_lock.acquire()        # simulate the stuck reader
+        try:
+            t0 = time.monotonic()
+            client.shutdown(timeout_s=0.5)
+            assert time.monotonic() - t0 < 2.0
+            assert client.dead
+        finally:
+            client._io_lock.release()
+            srv.close()
+
+    def test_kill_never_touches_the_lock(self):
+        client, srv = make_client([])
+        client._io_lock.acquire()
+        try:
+            t0 = time.monotonic()
+            client.kill(timeout_s=0.5)
+            assert time.monotonic() - t0 < 1.0
+        finally:
+            client._io_lock.release()
+            srv.close()
+
+
+class TestWireFaultInjectorUnit:
+    def test_schedules_and_targets(self):
+        inj = WireFaultInjector(corrupt_every=2, target="idempotent")
+        assert inj.action("step") is None          # not matched
+        assert inj.action("ping") is None          # call 1
+        assert inj.action("metrics") == "corrupt"  # call 2
+        assert inj.injected["corrupt"] == 1
+        inj2 = WireFaultInjector(reset_calls=[2], target="step")
+        assert inj2.action("ping") is None
+        assert inj2.action("step") is None         # step call 1
+        assert inj2.action("step") == "reset"      # step call 2
+
+    def test_exact_command_target(self):
+        inj = WireFaultInjector(drop_calls=[1], target="snapshot")
+        assert inj.action("metrics") is None
+        assert inj.action("snapshot") == "drop"
+
+
+# ------------------------------------- drain / rolling restart (thread)
+
+
+class TestDrainRollingRestart:
+    def _router(self, replicas=2, **kw):
+        merged = dict(ENGINE_KW)
+        merged.update(kw)
+        return ServingRouter(factory, replicas=replicas,
+                             heartbeat_timeout_s=30.0,
+                             poll_interval_s=0.05, **merged)
+
+    def test_drain_replica_migrates_and_stays_token_exact(self):
+        """Greedy AND seeded-temperature streams survive a mid-run
+        drain with the host tier on: running requests ride the
+        KV-handoff machinery, queued ones extract/inject."""
+        router = self._router(replicas=2, host_tier_pages=64)
+        work = workload(12)
+        rids = [router.submit(p, sp) for p, sp in work]
+        deadline = time.monotonic() + 30
+        while (router.metrics.tokens_delivered.value < 6
+                and time.monotonic() < deadline):
+            time.sleep(0.002)
+        moved = router.drain_replica(0)
+        assert router._replicas[0].status == "drained"
+        outs = router.drain(timeout_s=60.0)
+        audit_router(router)
+        for rid, (p, sp) in zip(rids, work):
+            assert outs[rid].output_tokens == oracle(p, sp), rid
+        m = router.metrics.snapshot()
+        assert m["replica_drains"] == 1
+        assert m["drain_migrations"] == moved
+        assert m["duplicate_tokens_dropped"] == 0
+        router.release_prefix_caches()
+        assert router.check_no_leaks()
+        router.shutdown()
+
+    def test_drained_replica_receives_no_new_traffic(self):
+        router = self._router(replicas=2)
+        router.drain_replica(0)
+        rids = [router.submit(p, sp) for p, sp in workload(6)]
+        with router._lock:
+            owners = {router._reqs[r].owner_idx for r in rids}
+        assert owners == {1}
+        router.drain(timeout_s=60.0)
+        router.shutdown()
+
+    def test_restart_replica_comes_back_live_and_serves(self):
+        router = self._router(replicas=2)
+        router.drain_replica(0)
+        rep = router.restart_replica(0)
+        assert rep.status == "live" and rep.epoch > 0
+        work = workload(8, seed=5)
+        rids = [router.submit(p, sp) for p, sp in work]
+        outs = router.drain(timeout_s=60.0)
+        audit_router(router)
+        for rid, (p, sp) in zip(rids, work):
+            assert outs[rid].output_tokens == oracle(p, sp)
+        # the restarted replica takes traffic again
+        assert {o.replica for o in outs.values()} == {0, 1}
+        router.shutdown()
+
+    def test_rolling_restart_three_replicas_token_exact(self):
+        """The acceptance pin: rolling_restart() across a 3-replica
+        tier mid-stream — zero lost, zero duplicated, token-exact for
+        greedy and seeded-temperature requests."""
+        router = self._router(replicas=3, host_tier_pages=64)
+        work = workload(14)
+        rids = [router.submit(p, sp) for p, sp in work]
+        deadline = time.monotonic() + 30
+        while (router.metrics.tokens_delivered.value < 8
+                and time.monotonic() < deadline):
+            time.sleep(0.002)
+        assert router.rolling_restart() == 3
+        outs = router.drain(timeout_s=120.0)
+        audit_router(router)
+        for rid, (p, sp) in zip(rids, work):
+            assert outs[rid].output_tokens == oracle(p, sp), rid
+        m = router.metrics.snapshot()
+        assert m["replica_drains"] == 3
+        assert m["rolling_restarts"] == 1
+        assert m["duplicate_tokens_dropped"] == 0
+        assert len(outs) == len(rids)
+        assert all(r.status == "live" for r in router._replicas)
+        router.release_prefix_caches()
+        assert router.check_no_leaks()
+        router.shutdown()
+
+    def test_single_replica_drain_backfills_on_restart(self):
+        """No live sibling: the drained requests wait in the registry
+        and restart_replica's backfill resumes them token-exact."""
+        router = self._router(replicas=1)
+        work = workload(6, seed=2)
+        rids = [router.submit(p, sp) for p, sp in work]
+        deadline = time.monotonic() + 30
+        while (router.metrics.tokens_delivered.value < 3
+                and time.monotonic() < deadline):
+            time.sleep(0.002)
+        router.drain_replica(0)
+        assert router.has_work()         # undone work parked in registry
+        router.restart_replica(0)
+        outs = router.drain(timeout_s=60.0)
+        audit_router(router)
+        for rid, (p, sp) in zip(rids, work):
+            assert outs[rid].output_tokens == oracle(p, sp)
+        router.shutdown()
+
+
+# ------------------------------------------- router recovery (journal)
+
+
+def _crash_router(router):
+    """The in-process equivalent of SIGKILLing the router: fence every
+    worker mid-flight (their tokens are discarded, exactly like a dead
+    process's), stop the supervisor, close the journal file handle."""
+    for rep in router._replicas:
+        rep.fenced = True
+        rep.stop = True
+        rep.wake.set()
+    if router.supervisor is not None:
+        router.supervisor.stop()
+    router._journal.close()
+
+
+class TestRouterRecover:
+    def _run_and_crash(self, tmp_path, bar, work, snapshot_every=2):
+        jp = str(tmp_path / "wal.jsonl")
+        router = ServingRouter(factory, replicas=2, journal_path=jp,
+                               snapshot_every_steps=snapshot_every,
+                               heartbeat_timeout_s=30.0,
+                               poll_interval_s=0.05, **ENGINE_KW)
+        rids = [router.submit(p, sp) for p, sp in work]
+        deadline = time.monotonic() + 30
+        while (router.metrics.tokens_delivered.value < bar
+                and time.monotonic() < deadline):
+            time.sleep(0.001)
+        _crash_router(router)
+        return jp, rids
+
+    @pytest.mark.parametrize("bar", [4, 16, 30])
+    def test_recover_mid_stream_token_exact(self, tmp_path, bar):
+        """The ISSUE 13 acceptance pin: router killed mid-stream at
+        several depths; recover(journal) resumes ALL in-flight
+        requests token-exact with zero lost and zero duplicated."""
+        work = workload(12)
+        jp, rids = self._run_and_crash(tmp_path, bar, work)
+        router = ServingRouter.recover(
+            factory, jp, replicas=2, snapshot_every_steps=2,
+            heartbeat_timeout_s=30.0, poll_interval_s=0.05, **ENGINE_KW)
+        outs = router.drain(timeout_s=60.0)
+        audit_router(router)
+        assert len(outs) == len(rids)            # zero lost
+        for rid, (p, sp) in zip(rids, work):
+            assert outs[rid].output_tokens == oracle(p, sp), rid
+        router.release_prefix_caches()
+        assert router.check_no_leaks()
+        router.shutdown()
+
+    def test_recover_without_snapshots_registry_only(self, tmp_path):
+        """snapshot_every_steps=0: no engine snapshot ever journaled —
+        the journaled registry alone regenerates everything."""
+        work = workload(10, seed=3)
+        jp, rids = self._run_and_crash(tmp_path, 8, work,
+                                       snapshot_every=0)
+        state, _ = RouterJournal.replay(jp)
+        assert state["snaps"] == {}
+        router = ServingRouter.recover(
+            factory, jp, replicas=2, snapshot_every_steps=0,
+            heartbeat_timeout_s=30.0, poll_interval_s=0.05, **ENGINE_KW)
+        outs = router.drain(timeout_s=60.0)
+        audit_router(router)
+        for rid, (p, sp) in zip(rids, work):
+            assert outs[rid].output_tokens == oracle(p, sp)
+        assert router.metrics.snapshot()["recovered_requests"] >= 1
+        router.shutdown()
+
+    def test_recover_restores_finished_outputs_and_new_ids(self,
+                                                          tmp_path):
+        """Finished requests survive as outputs, and freshly submitted
+        requests after recovery never collide with journaled ids."""
+        work = workload(6, seed=4)
+        jp = str(tmp_path / "wal.jsonl")
+        router = ServingRouter(factory, replicas=2, journal_path=jp,
+                               heartbeat_timeout_s=30.0,
+                               poll_interval_s=0.05, **ENGINE_KW)
+        rids = [router.submit(p, sp) for p, sp in work]
+        router.drain(timeout_s=60.0)     # finish EVERYTHING
+        _crash_router(router)
+        r2 = ServingRouter.recover(
+            factory, jp, replicas=2, heartbeat_timeout_s=30.0,
+            poll_interval_s=0.05, **ENGINE_KW)
+        outs = r2.outputs()
+        for rid, (p, sp) in zip(rids, work):
+            assert outs[rid].output_tokens == oracle(p, sp)
+        p2, sp2 = workload(1, seed=9)[0]
+        new_rid = r2.submit(p2, sp2)
+        assert new_rid not in rids
+        assert r2.drain(timeout_s=30.0)[new_rid].output_tokens \
+            == oracle(p2, sp2)
+        r2.shutdown()
+
+    def test_recover_fin_cut_after_final_tok(self, tmp_path):
+        """Regression for the torn-tail boundary BETWEEN a request's
+        final token batch and its fin record: replay shows an
+        unfinished request already holding all max_tokens tokens —
+        recovery must finish it in place (reason 'length'), never
+        resubmit it to decode an extra token. The writer orders
+        tok-before-fin precisely so this cut finishes exact instead of
+        one short."""
+        p, sp = [3, 1, 4, 1, 5], SamplingParams(max_tokens=4)
+        ref = oracle(p, sp)
+        assert len(ref) == 4
+        jp = str(tmp_path / "wal.jsonl")
+        j = RouterJournal(jp, fsync="never")
+        j.append({"t": "sub", "rid": "cut", "prompt": p,
+                  "sampling": wire.sampling_to_dict(sp), "rep": 0,
+                  "epoch": 0, "ai": 0})
+        j.append({"t": "tok", "d": {"cut": ref}})
+        j.close()                        # fin record never made it
+        router = ServingRouter.recover(
+            factory, jp, replicas=2, heartbeat_timeout_s=30.0,
+            poll_interval_s=0.05, **ENGINE_KW)
+        outs = router.drain(timeout_s=30.0)
+        assert outs["cut"].output_tokens == ref      # not 5 tokens
+        assert outs["cut"].finish_reason == "length"
+        router.shutdown()
+
+    def test_recover_fuzz_random_journal_offsets(self, tmp_path):
+        """Kill the router at RANDOM journal offsets: truncate the
+        journal at arbitrary byte positions (simulating death mid-
+        append at any point in history) and recover — every request
+        whose submit record survived must finish token-exact under
+        audit_router, with zero duplicated tokens."""
+        work = workload(10, seed=6)
+        jp = str(tmp_path / "wal.jsonl")
+        router = ServingRouter(factory, replicas=2, journal_path=jp,
+                               journal_compact_every=10_000,
+                               snapshot_every_steps=2,
+                               heartbeat_timeout_s=30.0,
+                               poll_interval_s=0.05, **ENGINE_KW)
+        rids = [router.submit(p, sp) for p, sp in work]
+        router.drain(timeout_s=60.0)
+        _crash_router(router)
+        raw = open(jp, "rb").read()
+        rng = np.random.default_rng(7)
+        offsets = sorted({int(x) for x in
+                          rng.integers(1, len(raw), 5)})
+        for off in offsets:
+            jcut = str(tmp_path / f"cut{off}.jsonl")
+            with open(jcut, "wb") as f:
+                f.write(raw[:off])
+            state, _ = RouterJournal.replay(jcut)
+            known = set(state["reqs"])
+            r2 = ServingRouter.recover(
+                factory, jcut, replicas=2, heartbeat_timeout_s=30.0,
+                poll_interval_s=0.05, **ENGINE_KW)
+            outs = r2.drain(timeout_s=60.0)
+            audit_router(r2)
+            for rid, (p, sp) in zip(rids, work):
+                if rid in known:
+                    assert outs[rid].output_tokens == oracle(p, sp), \
+                        (off, rid)
+            assert r2.metrics.snapshot()["duplicate_tokens_dropped"] \
+                >= 0
+            r2.release_prefix_caches()
+            assert r2.check_no_leaks()
+            r2.shutdown()
+
+    def test_journal_stats_ride_metrics_snapshot(self, tmp_path):
+        jp = str(tmp_path / "wal.jsonl")
+        router = ServingRouter(factory, replicas=1, journal_path=jp,
+                               heartbeat_timeout_s=30.0,
+                               poll_interval_s=0.05, **ENGINE_KW)
+        rid = router.submit([1, 2, 3], SamplingParams(max_tokens=3))
+        router.drain(timeout_s=30.0)
+        snap = router.metrics_snapshot()
+        assert snap["journal"]["journal_records"] >= 2
+        assert snap["journal"]["journal_bytes"] > 0
+        router.shutdown()
+
+
+# ------------------------------------------ process-backend durability
+
+
+@pytest.fixture(scope="module")
+def proc_env():
+    return child_env()
+
+
+@pytest.mark.slow
+class TestProcessDurability:
+    """Real replica PROCESSES (the fast tier-1 pins cover the same
+    machinery on the thread backend and in test_serving_procs; these
+    spawning drills ride the slow tier to protect the 870s budget)."""
+
+    def test_process_rolling_restart_token_exact(self, proc_env):
+        """rolling_restart over real replica PROCESSES: each child is
+        drained (bounded shutdown RPC) and respawned fresh; every
+        stream stays exact."""
+        router = ServingRouter(
+            STUB_SPEC, replicas=2, backend="process",
+            child_env=proc_env, heartbeat_timeout_s=60.0,
+            poll_interval_s=0.05, rendezvous_timeout_s=120.0,
+            **ENGINE_KW)
+        try:
+            work = workload(8)
+            rids = [router.submit(p, sp) for p, sp in work]
+            deadline = time.monotonic() + 60
+            while (router.metrics.tokens_delivered.value < 4
+                    and time.monotonic() < deadline):
+                time.sleep(0.002)
+            old_pids = [r.engine.proc.pid for r in router._replicas]
+            assert router.rolling_restart(drain_timeout_s=60.0) == 2
+            new_pids = [r.engine.proc.pid for r in router._replicas]
+            assert set(old_pids).isdisjoint(new_pids)
+            outs = router.drain(timeout_s=120.0)
+            audit_router(router)
+            for rid, (p, sp) in zip(rids, work):
+                assert outs[rid].output_tokens == oracle(p, sp), rid
+            rm = router.metrics.snapshot()
+            assert rm["replica_drains"] == 2
+            assert rm["duplicate_tokens_dropped"] == 0
+            router.release_prefix_caches()
+            assert router.check_no_leaks()
+        finally:
+            router.shutdown()
+
+    def test_wire_corrupt_idempotent_retries_on_live_process(
+            self, proc_env):
+        """CRC reject on a real child: corrupted idempotent request
+        frames are NAK'd and retried transparently — the replica is
+        never fenced and traffic completes exact."""
+        router = ServingRouter(
+            STUB_SPEC, replicas=2, backend="process",
+            child_env=proc_env, heartbeat_timeout_s=60.0,
+            poll_interval_s=0.05, rendezvous_timeout_s=120.0,
+            rpc_fast_timeout_s=1.0, **ENGINE_KW)
+        try:
+            client = router._replicas[0].engine
+            client.wire_faults = WireFaultInjector(
+                corrupt_every=2, target="idempotent")
+            for _ in range(4):
+                client.ping()
+            assert client.rpc_stats["naks"] >= 2
+            assert client.rpc_stats["retries"] >= 2
+            assert not client.dead
+            work = workload(6, seed=8)
+            rids = [router.submit(p, sp) for p, sp in work]
+            outs = router.drain(timeout_s=120.0)
+            audit_router(router)
+            for rid, (p, sp) in zip(rids, work):
+                assert outs[rid].output_tokens == oracle(p, sp)
+            assert router.metrics.snapshot()["replica_restarts"] == 0
+        finally:
+            router.shutdown()
+
+    def test_process_recover_from_journal(self, proc_env, tmp_path):
+        """Router-crash recovery with PROCESS replicas: the dead
+        router's children die with it (socket EOF); recover() respawns
+        a fresh fleet from the journaled snapshots + registry."""
+        jp = str(tmp_path / "wal.jsonl")
+        router = ServingRouter(
+            STUB_SPEC, replicas=2, backend="process",
+            child_env=proc_env, journal_path=jp,
+            snapshot_every_steps=2, heartbeat_timeout_s=60.0,
+            poll_interval_s=0.05, rendezvous_timeout_s=120.0,
+            **ENGINE_KW)
+        work = workload(8, seed=1)
+        rids = [router.submit(p, sp) for p, sp in work]
+        deadline = time.monotonic() + 60
+        while (router.metrics.tokens_delivered.value < 6
+                and time.monotonic() < deadline):
+            time.sleep(0.002)
+        _crash_router(router)
+        # the dead router's children: kill like the OS would reap them
+        for rep in router._replicas:
+            rep.engine.kill()
+        r2 = ServingRouter.recover(
+            STUB_SPEC, jp, replicas=2, backend="process",
+            child_env=proc_env, snapshot_every_steps=2,
+            heartbeat_timeout_s=60.0, poll_interval_s=0.05,
+            rendezvous_timeout_s=120.0, **ENGINE_KW)
+        try:
+            outs = r2.drain(timeout_s=120.0)
+            audit_router(r2)
+            assert len(outs) == len(rids)
+            for rid, (p, sp) in zip(rids, work):
+                assert outs[rid].output_tokens == oracle(p, sp), rid
+        finally:
+            r2.shutdown()

@@ -90,8 +90,10 @@ class TestWire:
     def test_recv_exact_survives_partial_writes(self):
         """A frame dribbled one byte at a time must reassemble whole —
         the partial-recv retry loop the satellite hardens."""
+        import zlib
+
         a, b = socket.socketpair()
-        payload = struct.pack("<I", 5) + b"hello"
+        payload = struct.pack("<II", 5, zlib.crc32(b"hello")) + b"hello"
 
         def dribble():
             for i in range(len(payload)):
@@ -106,7 +108,7 @@ class TestWire:
 
     def test_eof_mid_frame_raises_connection_error(self):
         a, b = socket.socketpair()
-        a.sendall(struct.pack("<I", 100) + b"short")
+        a.sendall(struct.pack("<II", 100, 0) + b"short")
         a.close()
         with pytest.raises(ConnectionError):
             wire._recv_frame(b)

@@ -128,6 +128,33 @@ registry). Every class must recover with ZERO lost and ZERO
 duplicated tokens, token-exact vs the parent process's naive oracle.
 `--faults` filters these classes too.
 
+ISSUE 13: `--net [N]` (N replicas, default 2) switches to the TIER
+DURABILITY / NETWORK CHAOS drill:
+
+    router_kill    the whole router runs in a CHILD process journaling
+                   to a write-ahead JSONL (--net-child is that child's
+                   entry); the parent SIGKILLs it mid-stream, then
+                   `ServingRouter.recover(journal)` rebuilds the tier —
+                   replicas restored from their journaled snapshots,
+                   undelivered work resubmitted, re-delivered tokens
+                   cursor-deduped — and finishes token-exact vs the
+                   oracle with zero lost and zero duplicated tokens.
+    frame_corrupt  real replica processes; one client's wire injector
+                   first corrupts IDEMPOTENT request frames (the
+                   replica CRC-rejects and NAKs, the client retries
+                   transparently), then corrupts a STEP frame (fail
+                   fast -> ReplicaGoneError -> supervisor respawn).
+                   Never a silent mis-parse.
+    rpc_delay      gray failure: scheduled delays push idempotent
+                   replies past the FAST RPC deadline — the client
+                   times out, retries, and seq-discards the late
+                   stale replies; the slow-but-alive replica is never
+                   fenced and the stream stays token-exact.
+    conn_reset     the command connection dies under a step RPC —
+                   always fatal, supervisor respawn, token-exact.
+
+All classes must end RECOVERED with zero lost/duplicated tokens.
+
 ISSUE 5: `--speculate [K]` (K defaults to 4) drills every fault class
 with speculative decoding ON: decode rides n-gram verify spans through
 the full-logits ragged call — the same decode-op fault schedules now
@@ -621,6 +648,283 @@ def run_proc_class(fault: str, runner, args) -> dict:
     }
 
 
+NET_FAULTS = ("router_kill", "frame_corrupt", "rpc_delay", "conn_reset")
+
+
+def _net_workload(args, vocab: int):
+    """Deterministic workload shared by the --net parent (oracle side)
+    and the --net-child router process (submit side)."""
+    import numpy as np
+
+    from paddle_tpu.serving import SamplingParams
+
+    rng = np.random.default_rng(0)
+    work = []
+    for i in range(args.requests):
+        plen = int(rng.integers(4, 20))
+        prompt = [int(t) for t in rng.integers(1, vocab, plen)]
+        sp = SamplingParams(
+            max_tokens=int(rng.integers(4, args.max_tokens)),
+            temperature=0.7 if i % 4 == 0 else 0.0,
+            seed=1000 + i if i % 4 == 0 else None)
+        work.append((f"net-{i}", prompt, sp))
+    return work
+
+
+def _net_router_kw(args) -> dict:
+    return dict(num_blocks=args.num_blocks, max_batch_size=args.max_batch,
+                max_model_len=args.max_model_len, max_step_retries=2,
+                retry_backoff_s=0.001, audit=True,
+                enable_prefix_cache=args.prefix_cache,
+                max_prefill_tokens_per_step=args.chunk or None,
+                snapshot_every_steps=2, poll_interval_s=0.05,
+                heartbeat_timeout_s=600.0)
+
+
+def _run_net_child(args, runner) -> int:
+    """--net-child entry: host a journaling thread-backend router in
+    THIS process, submit the shared workload, serve until the parent
+    SIGKILLs us mid-stream (the whole point — no graceful teardown
+    ever runs, the journal is all that survives)."""
+    import time as _time
+
+    from paddle_tpu.serving import ServingRouter
+
+    router = ServingRouter(lambda idx: runner, replicas=args.net,
+                           journal_path=args.net_child,
+                           journal_fsync="interval",
+                           **_net_router_kw(args))
+    for rid, prompt, sp in _net_workload(args, runner.vocab_size):
+        router.submit(prompt, sp, request_id=rid)
+    deadline = _time.monotonic() + 600.0
+    while router.has_work() and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    _time.sleep(60.0)        # hold state; the parent kills us long before
+    return 0
+
+
+def run_net_router_kill(runner, args) -> dict:
+    """SIGKILL the ROUTER process mid-stream, then recover the tier
+    from its write-ahead journal (ISSUE 13 acceptance)."""
+    import os as _os
+    import signal
+    import subprocess
+    import sys as _sys
+    import tempfile
+    import time as _time
+
+    from paddle_tpu.serving import (
+        RouterJournal, ServingRouter, audit_router, naive_generate,
+    )
+
+    journal = tempfile.mktemp(prefix="fault_smoke_net_", suffix=".jsonl")
+    cmd = [_sys.executable, _os.path.abspath(__file__),
+           "--net", str(args.net), "--net-child", journal,
+           "--requests", str(args.requests),
+           "--num-blocks", str(args.num_blocks),
+           "--block-size", str(args.block_size),
+           "--max-batch", str(args.max_batch),
+           "--max-model-len", str(args.max_model_len),
+           "--max-tokens", str(args.max_tokens),
+           "--layers", str(args.layers), "--hidden", str(args.hidden),
+           "--chunk", str(args.chunk)]
+    env = dict(_os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(cmd, env=env)
+    work = _net_workload(args, runner.vocab_size)
+    total = sum(sp.max_tokens for _, _, sp in work)
+    bar = max(4, total // 3)
+    crashed = None
+    delivered_before = 0
+    router, outs, recovery_s = None, {}, -1.0
+    try:
+        # poll the journal until the child has durably delivered a
+        # third of the stream, then SIGKILL it mid-flight
+        deadline = _time.monotonic() + 300.0
+        while _time.monotonic() < deadline and proc.poll() is None:
+            try:
+                state, _ = RouterJournal.replay(journal)
+                delivered_before = sum(len(r["tokens"])
+                                       for r in state["reqs"].values())
+            except (OSError, ValueError):
+                delivered_before = 0
+            if delivered_before >= bar:
+                break
+            _time.sleep(0.02)
+        _os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+
+        t0 = _time.monotonic()
+        router = ServingRouter.recover(
+            lambda idx: runner, journal, replicas=args.net,
+            **_net_router_kw(args))
+        outs = router.drain(timeout_s=300.0)
+        recovery_s = _time.monotonic() - t0
+        audit_router(router)
+    except Exception as e:
+        crashed = f"{type(e).__name__}: {e}"
+
+    oracle_ok = True
+    if router is not None:
+        for rid, prompt, sp in work:
+            o = outs.get(rid)
+            ref = naive_generate(runner, prompt, sp,
+                                 max_model_len=args.max_model_len)
+            if o is None or o.output_tokens != ref:
+                oracle_ok = False
+                break
+        rm = router.metrics.snapshot()
+        router.release_prefix_caches()
+        leaks_ok = router.check_no_leaks()
+        router.shutdown()
+    else:
+        rm, leaks_ok, oracle_ok = {}, False, False
+    try:
+        _os.unlink(journal)
+    except OSError:
+        pass
+    ok = (crashed is None and oracle_ok and leaks_ok
+          and len(outs) == len(work)
+          and delivered_before > 0
+          and rm.get("recovered_requests", 0) >= 1)
+    return {"fault": "net_router_kill", "ok": ok,
+            "requests": len(work), "replicas": args.net,
+            "no_unhandled_exception": crashed is None, "crash": crashed,
+            "requests_lost": len(work) - len(outs),
+            "tokens_delivered_before_kill": delivered_before,
+            "recovery_s": round(recovery_s, 3),
+            "oracle_token_equal": oracle_ok,
+            "pages_leaked": not leaks_ok,
+            "recovered_requests": rm.get("recovered_requests", 0),
+            "duplicate_tokens_dropped":
+                rm.get("duplicate_tokens_dropped", 0)}
+
+
+def run_net_wire_class(fault: str, runner, args) -> dict:
+    """One WIRE fault class over real replica processes (ISSUE 13):
+    frame_corrupt / rpc_delay / conn_reset through the per-RPC
+    deadline + idempotent-retry machinery."""
+    import os as _os
+    import time as _time
+
+    from paddle_tpu.serving import (
+        SamplingParams, ServingRouter, WireFaultInjector, audit_router,
+        naive_generate,
+    )
+
+    child_env = dict(_os.environ)
+    child_env["JAX_PLATFORMS"] = "cpu"
+    for k in ("PALLAS_AXON_POOL_IPS", "PJRT_NAMES_AND_LIBRARY_PATHS",
+              "CUSTOM_DEVICE_ROOT"):
+        child_env.pop(k, None)
+    spec = {"factory": "paddle_tpu.serving.replica:model_runner_factory",
+            "factory_kw": {
+                "model": "llama", "seed": 0,
+                "block_size": args.block_size,
+                "max_model_len": args.max_model_len,
+                "vocab_size": 97, "hidden_size": args.hidden,
+                "num_layers": args.layers,
+                "num_heads": max(2, args.hidden // 16),
+                "num_kv_heads": None,
+                "max_seq_len": args.max_model_len, "dropout": 0.0}}
+    router = ServingRouter(
+        spec, replicas=args.net, backend="process",
+        child_env=child_env, rendezvous_timeout_s=300.0,
+        command_timeout_s=300.0, rpc_fast_timeout_s=0.5,
+        num_blocks=args.num_blocks, max_batch_size=args.max_batch,
+        max_model_len=args.max_model_len, max_step_retries=2,
+        retry_backoff_s=0.001, audit=True,
+        enable_prefix_cache=args.prefix_cache,
+        max_prefill_tokens_per_step=args.chunk or None,
+        snapshot_every_steps=2, heartbeat_timeout_s=600.0,
+        poll_interval_s=0.1)
+    client = router._replicas[0].engine
+
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    vocab = 97
+    work = []
+    crashed = None
+    retried_ok = True
+    try:
+        # warm both children's jit caches so the faults hit steps
+        for w in range(2 * args.net):
+            router.submit(list(rng.integers(1, vocab, 8)),
+                          SamplingParams(max_tokens=2),
+                          request_id=f"warm-{w}")
+        router.drain(timeout_s=600.0)
+        if fault == "frame_corrupt":
+            # phase A: corrupt idempotent request frames — the replica
+            # NAKs, the client retries TRANSPARENTLY (no restarts)
+            client.wire_faults = WireFaultInjector(
+                corrupt_every=2, target="idempotent")
+            for _ in range(4):
+                client.ping()
+            retried_ok = (client.rpc_stats["naks"] >= 2
+                          and client.rpc_stats["retries"] >= 2
+                          and not client.dead)
+            # phase B: corrupt a STEP frame — fail fast, supervisor
+            client.wire_faults = WireFaultInjector(
+                corrupt_calls=[3], target="step")
+        elif fault == "rpc_delay":
+            client.wire_faults = WireFaultInjector(
+                delay_every=3, delay_s=1.0, target="idempotent")
+        elif fault == "conn_reset":
+            client.wire_faults = WireFaultInjector(
+                reset_calls=[4], target="step")
+        for i in range(args.requests):
+            plen = int(rng.integers(4, 20))
+            prompt = list(rng.integers(1, vocab, plen))
+            sp = SamplingParams(
+                max_tokens=int(rng.integers(3, args.max_tokens)))
+            work.append((router.submit(prompt, sp), prompt, sp))
+        if fault == "rpc_delay":
+            # gray failure needs a caller on the idempotent path: poke
+            # the remote metrics while the tier decodes
+            for _ in range(9):
+                router.metrics_snapshot()
+                _time.sleep(0.05)
+            retried_ok = (client.rpc_stats["deadline_trips"] >= 1
+                          and client.rpc_stats["retries"] >= 1)
+        outs = router.drain(timeout_s=600.0)
+        audit_router(router)
+    except Exception as e:      # must never happen — that's the point
+        crashed = f"{type(e).__name__}: {e}"
+        outs = router.outputs()
+
+    rm = router.metrics.snapshot()
+    stats = dict(client.rpc_stats)
+    router.release_prefix_caches()
+    leaks_ok = router.check_no_leaks()
+    oracle_ok = True
+    for rid, prompt, sp in work:
+        o = outs.get(rid)
+        if o is None or o.output_tokens != naive_generate(
+                runner, prompt, sp, max_model_len=args.max_model_len):
+            oracle_ok = False
+            break
+    router.shutdown()
+
+    escalates = fault in ("frame_corrupt", "conn_reset")
+    ok = (crashed is None and leaks_ok and oracle_ok and retried_ok
+          and all(o.finish_reason for o in outs.values())
+          and (not escalates or rm["replica_restarts"] >= 1)
+          and (fault != "rpc_delay" or rm["replica_restarts"] == 0))
+    return {"fault": f"net_{fault}", "ok": ok, "requests": len(work),
+            "replicas": args.net, "backend": "process",
+            "no_unhandled_exception": crashed is None, "crash": crashed,
+            "requests_lost": len(work) - len([r for r in outs
+                                              if not r.startswith("warm")]),
+            "oracle_token_equal": oracle_ok,
+            "retry_path_exercised": retried_ok,
+            "pages_leaked": not leaks_ok,
+            "rpc_stats": stats,
+            "replica_restarts": rm["replica_restarts"],
+            "replica_crashes": rm["replica_crashes"],
+            "duplicate_tokens_dropped": rm["duplicate_tokens_dropped"]}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--faults", default=",".join(FAULTS),
@@ -681,6 +985,17 @@ def main() -> int:
                          "ServingRouter — real signals, waitpid "
                          "detection, respawn + restore, and the "
                          "prefill/decode KV handoff")
+    ap.add_argument("--net", type=int, nargs="?", const=2, default=0,
+                    metavar="N",
+                    help="tier durability / network chaos drill "
+                         "(ISSUE 13): router_kill (SIGKILL the router "
+                         "process mid-stream, recover() from the "
+                         "write-ahead journal), frame_corrupt, "
+                         "rpc_delay (gray failure) and conn_reset over "
+                         "N replicas — all classes must finish "
+                         "token-exact with zero lost/dup tokens")
+    ap.add_argument("--net-child", default=None, metavar="JOURNAL",
+                    help=argparse.SUPPRESS)   # router_kill's child entry
     ap.add_argument("--router", type=int, default=0, metavar="N",
                     help="tier drill (ISSUE 8): run the router fault "
                          "classes (replica_kill / replica_hang / "
@@ -734,6 +1049,11 @@ def main() -> int:
         from paddle_tpu.parallel.mesh import serving_mesh
 
         runner.shard(serving_mesh(data=1, model=args.tp))
+    if args.net_child:
+        # router_kill's child: host the journaling router until the
+        # parent SIGKILLs this process (no warmup detour — the parent
+        # polls the journal, not the clock)
+        return _run_net_child(args, runner)
     # warm the prefill buckets + decode step so deadline-sensitive classes
     # (stall) measure steps, not compiles
     import numpy as np
@@ -748,6 +1068,22 @@ def main() -> int:
     warm.run()
 
     all_ok = True
+    if args.net >= 2:
+        # ISSUE 13 durability/network-chaos drill (--faults filters:
+        # `--net 2 --faults router_kill,rpc_delay`)
+        classes = (NET_FAULTS if args.faults == ",".join(FAULTS)
+                   else [f for f in args.faults.split(",")
+                         if f in NET_FAULTS])
+        for fault in classes:
+            if fault == "router_kill":
+                rec = run_net_router_kill(runner, args)
+            else:
+                rec = run_net_wire_class(fault, runner, args)
+            all_ok &= rec["ok"]
+            print(json.dumps(rec))
+        print(f"\nfault smoke (net x{args.net}): "
+              f"{'ALL RECOVERED' if all_ok else 'FAILURES'}")
+        return 0 if all_ok else 1
     if args.procs >= 2:
         # ISSUE 12 process-tier drill: replica processes, real signals
         # (--faults filters here too: `--procs 2 --faults handoff`)
