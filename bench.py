@@ -1691,6 +1691,187 @@ def child_serving_chaos(layers: int, hidden: int, max_batch: int,
         "router_kill_recovery_s": router_kill["router_recovery_s"]})
 
 
+def child_serving_shared_kv(layers: int, hidden: int, max_batch: int,
+                            requests: int, prompt: int, gen: int,
+                            vocab: int):
+    """Cluster-wide KV rung (ISSUE 14): a MIGRATED multi-replica session
+    workload — every session runs turn 1, the tier rolling-restarts
+    (half the turn-2 requests already in flight, so they migrate via
+    the drain path), and the remaining sessions resume AFTER the
+    restart on whichever replica routing picks. Two arms:
+
+      private   per-engine HostKVTier (the PR-10/12 shape): the drain
+                migration ships raw page BYTES, and post-restart
+                session resumes RECOMPUTE their whole context — the
+                dead replicas' tiers died with them;
+      shared    one router-owned SharedKVStore: draining replicas
+                demote their device caches tier-wide, migration moves
+                slot REFERENCES (zero payload bytes), and post-restart
+                resumes page in from the store on any replica.
+
+    Committed numbers: `resume_compute_reduction_x` (post-restart
+    resume prefill tokens computed, private / shared — >= 3x required),
+    `handoff_bytes_private` vs `handoff_bytes_shared` (the wire-bytes
+    split), and the shared arm's store hit rate. Both arms must stay
+    token-exact vs the naive oracle across every migration; an int8
+    rider re-runs the shared flow on quantized pools (distinct
+    prompts, so code adoption cannot diverge from the oracle) and
+    pins exactness there too — migrations copy codes + scale rows,
+    never requantize. The tier-aware auditor runs at every phase
+    boundary."""
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.serving import (
+        GPTRunner, SamplingParams, ServingRouter, audit_router,
+        naive_generate,
+    )
+
+    backend = jax.default_backend()
+    paddle.seed(0)
+    max_len = prompt + 2 * gen           # turn-2 context + its tokens
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                    num_layers=layers, num_heads=max(hidden // 64, 1),
+                    max_seq_len=max_len, dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+    block_size = min(16, max_len)
+    runners = [GPTRunner(model, block_size=block_size,
+                         max_model_len=max_len) for _ in range(2)]
+    pages_per_seq = -(-max_len // block_size)
+    pool_blocks = max_batch * pages_per_seq + 2
+    store_pages = 4 * max_batch * pages_per_seq
+    rng = np.random.default_rng(0)
+    # shared system header (page-aligned chains dedup tier-wide) + a
+    # small per-session tail
+    tail = max(4, min(8, prompt // 3))
+    header = list(rng.integers(0, vocab, prompt - tail))
+    sessions = [header + list(rng.integers(0, vocab, tail))
+                for _ in range(requests)]
+
+    def run_arm(shared: bool) -> dict:
+        ekw = ({} if shared
+               else {"host_tier_pages": store_pages // 2})
+        rkw = ({"shared_kv_pages": store_pages} if shared else {})
+        router = ServingRouter(
+            lambda idx: runners[idx], replicas=2,
+            num_blocks=pool_blocks, max_batch_size=max_batch,
+            max_model_len=max_len, enable_prefix_cache=True,
+            snapshot_every_steps=4, **ekw, **rkw)
+        t0 = time.time()
+        t1 = [router.submit(p, SamplingParams(max_tokens=gen,
+                                              session_id=f"s{j}"))
+              for j, p in enumerate(sessions)]
+        outs1 = router.drain(timeout_s=600.0)
+        audit_router(router)
+        # phase 2: half the turn-2s in flight while the tier cycles —
+        # these migrate via the drain path (bytes vs slot refs)
+        mid = max(1, requests // 2)
+        t2 = {}
+        for j in range(mid):
+            p2 = sessions[j] + outs1[t1[j]].output_tokens
+            t2[router.submit(p2, SamplingParams(
+                max_tokens=gen, session_id=f"s{j}"))] = p2
+        router.rolling_restart()
+        router.drain(timeout_s=600.0)
+        audit_router(router)
+        after = router.metrics_snapshot()["engines"]
+        # phase 3: the rest of the sessions resume AFTER the restart —
+        # the cross-replica resume-compute number
+        for j in range(mid, requests):
+            p2 = sessions[j] + outs1[t1[j]].output_tokens
+            t2[router.submit(p2, SamplingParams(
+                max_tokens=gen, session_id=f"s{j}"))] = p2
+        outs = router.drain(timeout_s=600.0)
+        wall = time.time() - t0
+        audit_router(router)
+        snap = router.metrics_snapshot()
+        eng = snap["engines"]
+        exact = all(
+            outs[rid].output_tokens == naive_generate(
+                runners[0], p2, SamplingParams(max_tokens=gen),
+                max_model_len=max_len)
+            for rid, p2 in t2.items())
+        resume_ctx = sum(len(sessions[j]) + gen
+                         for j in range(mid, requests))
+        resumed_computed = eng["prefill_tokens"] - after["prefill_tokens"]
+        # phase-3 hits only: the rate is store-served context / resumed
+        # context, same window as the compute number
+        hit_pages = eng["store_hit_pages"] - after["store_hit_pages"]
+        arm = {
+            "shared": shared, "wall_s": round(wall, 3),
+            "tokens_per_sec": eng["tokens_generated"] / wall,
+            "token_exact": exact,
+            "resume_context_tokens": resume_ctx,
+            "resume_compute_tokens": resumed_computed,
+            "handoff_bytes": eng["handoff_bytes_out"],
+            "handoffs": snap["router"]["handoffs"],
+            "drain_migrations": snap["router"]["drain_migrations"],
+            "store_hit_pages": hit_pages,
+            "store_dedup_pages": eng["store_dedup_pages"],
+            "store_hit_rate": (hit_pages * block_size / resume_ctx
+                               if resume_ctx else 0.0),
+        }
+        if shared:
+            arm["store"] = snap.get("store", {})
+        router.release_prefix_caches()
+        arm["pages_leaked"] = not router.check_no_leaks()
+        router.shutdown()
+        return arm
+
+    def int8_rider() -> dict:
+        """Shared-store flow on QUANTIZED pools, exactness-pinned:
+        distinct prompts (adoption cannot diverge), rolling restart
+        mid-stream, outputs must equal the int8 naive oracle —
+        migrations copy codes + scale rows verbatim."""
+        r8 = [GPTRunner(model, block_size=block_size,
+                        max_model_len=max_len, kv_dtype="int8")
+              for _ in range(2)]
+        router = ServingRouter(
+            lambda idx: r8[idx], replicas=2, num_blocks=pool_blocks,
+            max_batch_size=max_batch, max_model_len=max_len,
+            enable_prefix_cache=True,
+            shared_kv_pages=store_pages, snapshot_every_steps=4)
+        work = {}
+        for j in range(min(2, requests)):
+            p = list(rng.integers(0, vocab, prompt))
+            work[router.submit(p, SamplingParams(
+                max_tokens=gen, session_id=f"q{j}"))] = p
+        router.rolling_restart()
+        outs = router.drain(timeout_s=600.0)
+        audit_router(router)
+        exact = all(
+            outs[rid].output_tokens == naive_generate(
+                r8[0], p, SamplingParams(max_tokens=gen),
+                max_model_len=max_len)
+            for rid, p in work.items())
+        router.release_prefix_caches()
+        leaked = not router.check_no_leaks()
+        router.shutdown()
+        return {"token_exact": exact, "pages_leaked": leaked}
+
+    run_arm(True)                 # warmup: compile chunk/decode buckets
+    private = run_arm(False)
+    shared = run_arm(True)
+    reduction = (private["resume_compute_tokens"]
+                 / max(shared["resume_compute_tokens"], 1))
+    _write_child({
+        "backend": backend, "layers": layers, "hidden": hidden,
+        "max_batch": max_batch, "requests": requests, "prompt": prompt,
+        "gen": gen, "workload": "shared_kv",
+        "num_blocks": pool_blocks, "store_pages": store_pages,
+        "private": private, "shared": shared,
+        # THE acceptance number: post-restart session-resume compute
+        "resume_compute_reduction_x": reduction,
+        "handoff_bytes_private": private["handoff_bytes"],
+        "handoff_bytes_shared": shared["handoff_bytes"],
+        "store_hit_rate": round(shared["store_hit_rate"], 4),
+        "int8": int8_rider()})
+
+
 def _write_child(obj: dict) -> None:
     with open(os.environ["BENCH_CHILD_OUT"], "w") as f:
         json.dump(obj, f)
@@ -2263,6 +2444,43 @@ def main():
                 f"router-kill lost={xk['requests_lost']} "
                 f"exact={xk['token_exact']}")
 
+    # cluster-wide KV rung (ISSUE 14): private-tier vs shared-store arms
+    # on a migrated session workload — post-restart resume compute (the
+    # >= 3x acceptance), handoff bytes on the wire (raw pages vs slot
+    # references), the shared arm's store hit rate, and int8 exactness
+    if on_tpu and remaining() > 120:
+        r = run_child("serving:4:256:4:8:64:24:32768:shared_kv",
+                      min(900, remaining()))
+        if r is not None and "resume_compute_reduction_x" in r:
+            pv, sh = r["private"], r["shared"]
+            line = {"metric": "serving_shared_kv_resume_reduction_x",
+                    "value": round(r["resume_compute_reduction_x"], 2),
+                    "unit": "x", "vs_baseline": 0.0,
+                    "resume_tokens_private": pv["resume_compute_tokens"],
+                    "resume_tokens_shared": sh["resume_compute_tokens"],
+                    "handoff_bytes_private": pv["handoff_bytes"],
+                    "handoff_bytes_shared": sh["handoff_bytes"],
+                    "store_hit_rate": r["store_hit_rate"],
+                    "store_dedup_pages": sh["store_dedup_pages"],
+                    "token_exact_private": pv["token_exact"],
+                    "token_exact_shared": sh["token_exact"],
+                    "token_exact_int8": r["int8"]["token_exact"],
+                    "tokens_per_sec_private":
+                        round(pv["tokens_per_sec"], 1),
+                    "tokens_per_sec_shared":
+                        round(sh["tokens_per_sec"], 1),
+                    "backend": r["backend"]}
+            emit(line)
+            _cache_result(line)
+            log(f"shared-kv rung: resume compute "
+                f"{r['resume_compute_reduction_x']:.1f}x cheaper "
+                f"({pv['resume_compute_tokens']:.0f} -> "
+                f"{sh['resume_compute_tokens']:.0f} tokens), handoff "
+                f"bytes {pv['handoff_bytes']:.0f} -> "
+                f"{sh['handoff_bytes']:.0f}, store hit rate "
+                f"{r['store_hit_rate']*100:.0f}%, int8 exact="
+                f"{r['int8']['token_exact']}")
+
     if best is not None:
         # headline repeated last: drivers that parse the final stdout JSON
         # line get the largest completed config
@@ -2320,6 +2538,8 @@ def _child_main(mode: str) -> None:
             child_serving_procs(*[int(x) for x in parts[:-1]])
         elif parts and parts[-1] == "chaos":
             child_serving_chaos(*[int(x) for x in parts[:-1]])
+        elif parts and parts[-1] == "shared_kv":
+            child_serving_shared_kv(*[int(x) for x in parts[:-1]])
         else:
             child_serving(*[int(x) for x in parts])
     else:

@@ -22,7 +22,19 @@ paged-decode kernel (`ops/pallas/paged_attention.py`):
                    so resume and re-match page bytes back in (async
                    device_put ahead of the step, fence at read time)
                    instead of recomputing, with recompute as the
-                   always-correct fallback;
+                   always-correct fallback; plus the SharedKVStore
+                   (ISSUE 14): ONE router-owned content-addressed host
+                   pool per host replacing the private tiers — chain
+                   hashes indexed tier-wide with refcounted dual
+                   ownership (per-engine owner refs + an index ref),
+                   dedup on publish, slot-reference handoffs, dead
+                   replicas reaped by refcount, optional shared-memory
+                   segments process replicas map directly;
+  store_service.py StoreServer (router side) + SharedKVStoreClient
+                   (replica-child side): the SharedKVStore's metadata
+                   ops over a loopback socket while page BYTES ride the
+                   shared-memory segments — the store attach RPC of the
+                   process backend (ISSUE 14);
   scheduler.py     FCFS continuous-batching scheduler with prefill/decode
                    phases, chunked prefill under a per-step token budget
                    (max_prefill_tokens_per_step), and youngest-first
@@ -147,7 +159,8 @@ from paddle_tpu.serving.engine import (  # noqa: F401
 )
 from paddle_tpu.serving.kv_cache import (  # noqa: F401
     BlockAllocator, HostKVTier, KVCachePool, OffloadRecord, PrefixCache,
-    SCRATCH_PAGE, SequenceKV, page_content_hash, quantized_page_write,
+    SCRATCH_PAGE, SequenceKV, SharedKVStore, page_content_hash,
+    quantized_page_write,
 )
 from paddle_tpu.serving.metrics import (  # noqa: F401
     Counter, EngineMetrics, Gauge, Histogram, aggregate_snapshots,
@@ -159,7 +172,10 @@ from paddle_tpu.serving.journal import RouterJournal  # noqa: F401
 from paddle_tpu.serving.resilience import (  # noqa: F401
     FaultInjector, InjectedDeviceError, InvariantViolation, QueueFullError,
     ReplicaCrashError, ReplicaGoneError, WireFaultInjector, audit_engine,
-    audit_router,
+    audit_router, audit_store,
+)
+from paddle_tpu.serving.store_service import (  # noqa: F401
+    SharedKVStoreClient, StoreServer,
 )
 from paddle_tpu.serving.wire import (  # noqa: F401
     WireCorruptionError, WireTimeoutError,
@@ -200,8 +216,9 @@ __all__ = [
     "WireCorruptionError", "WireFaultInjector", "WireTimeoutError",
     "RequestState", "RouterMetrics", "RouterOutput", "SCRATCH_PAGE",
     "SamplingParams", "SequenceKV", "ServingEngine", "ServingRouter",
+    "SharedKVStore", "SharedKVStoreClient", "StoreServer",
     "SpecLayout", "StreamDetokenizer", "Supervisor", "TokenEvent",
-    "TokenizerAdapter", "audit_engine", "audit_router",
+    "TokenizerAdapter", "audit_engine", "audit_router", "audit_store",
     "aggregate_snapshots", "bucket_len", "complete_utf8_prefix",
     "create_engine", "greedy_grid", "naive_generate", "page_content_hash",
     "quantized_page_write", "replica_submeshes", "runner_for",

@@ -395,6 +395,21 @@ class ServingEngine:
                            decode side recomputes
                            (handoff_recompute_fallbacks), exactness
                            untouched.
+      kv_store             cluster-wide KV (ISSUE 14): a SharedKVStore
+                           (or process-backend SharedKVStoreClient)
+                           backing the host tier instead of private
+                           buffers. Capacity is the store's; spills
+                           and prefix demotions PUBLISH tier-wide
+                           (content-addressed, dedup by chain hash);
+                           admission resolves its prefix chain against
+                           every replica's demotions; handoffs move
+                           slot references instead of page bytes.
+                           `kv_store_owner` tags this engine
+                           incarnation's refs so a dead replica's
+                           slots are reaped by refcount. Usually wired
+                           by ServingRouter(shared_kv_pages=...); None
+                           = the PR-10 private tier via
+                           host_tier_pages.
       spill_async          threaded spill I/O (ISSUE 11 satellite):
                            preemption's device->host page copy runs on
                            a worker thread against the immutable
@@ -441,6 +456,8 @@ class ServingEngine:
                  horizon_early_stop: bool = False,
                  spill_async: bool = False,
                  role: str = "mixed",
+                 kv_store=None,
+                 kv_store_owner: Optional[str] = None,
                  num_speculative_tokens: int = 0,
                  spec_max_ngram: int = 3,
                  spec_min_ngram: int = 1,
@@ -557,8 +574,21 @@ class ServingEngine:
         self.metrics.sessions_per_pool_x.set(
             self.pool.kv_bytes_reduction_x())
         # host-RAM KV tier (ISSUE 10): built after the metrics so the
-        # tier mirrors its spill/drop accounting straight into them
-        if self.host_tier_pages:
+        # tier mirrors its spill/drop accounting straight into them.
+        # With `kv_store` (ISSUE 14) the tier is a facade over the
+        # host-wide SharedKVStore instead of private buffers: capacity
+        # is the store's, spills publish tier-wide under this engine's
+        # owner tag, and handoffs move slot references instead of bytes
+        self.kv_store = kv_store
+        self.kv_store_owner = (str(kv_store_owner) if kv_store_owner
+                               else f"eng-{id(self):x}")
+        if kv_store is not None:
+            self.pool.enable_host_tier(kv_store.max_pages,
+                                       metrics=self.metrics,
+                                       async_spill=self.spill_async,
+                                       store=kv_store,
+                                       owner=self.kv_store_owner)
+        elif self.host_tier_pages:
             self.pool.enable_host_tier(self.host_tier_pages,
                                        metrics=self.metrics,
                                        async_spill=self.spill_async)
@@ -1848,13 +1878,35 @@ class ServingEngine:
         payload = None
         tier = self.pool.host_tier
         if rec is not None and tier is not None:
-            payload = {
-                "start_page": rec.start_page,
-                "covered_tokens": rec.covered_tokens,
-                "hashes": [tier.slot_hash(s) for s in rec.slots],
-                "layers": tier.export_slots(rec.slots),
-            }
-            tier.free_slots(rec.slots)
+            if tier.store is not None:
+                # slot-REFERENCE handoff (ISSUE 14): the pages already
+                # live in the host-wide store — ownership moves to a
+                # transfer tag and only slot ids + generations + CRCs
+                # cross the wire; the receiving replica adopts the
+                # same bytes by reference. Page bytes cross the wire
+                # ZERO times on the same host.
+                xfer = f"xfer:{request_id}"
+                hashes = [tier.slot_hash(s) for s in rec.slots]
+                tier.retag_out(rec.slots, xfer)
+                payload = {
+                    "start_page": rec.start_page,
+                    "covered_tokens": rec.covered_tokens,
+                    "slot_refs": list(rec.slots),
+                    "gens": [tier.generation(s) for s in rec.slots],
+                    "hashes": hashes,
+                    "xfer_owner": xfer,
+                }
+            else:
+                payload = {
+                    "start_page": rec.start_page,
+                    "covered_tokens": rec.covered_tokens,
+                    "hashes": [tier.slot_hash(s) for s in rec.slots],
+                    "layers": tier.export_slots(rec.slots),
+                }
+                self.metrics.handoff_bytes_out.inc(sum(
+                    int(a.nbytes) for layer in payload["layers"]
+                    for a in layer))
+                tier.free_slots(rec.slots)
         del self._requests[request_id]
         self._detoks.pop(request_id, None)
         return state, payload
@@ -1872,9 +1924,22 @@ class ServingEngine:
         full) degrades to the recompute path, counted."""
         rec = None
         tier = self.pool.host_tier
+        if (payload is not None and payload.get("slot_refs") is not None
+                and (tier is None or tier.store is None)):
+            # loud, not a silent recompute: the sender moved ownership
+            # to a transfer tag — the router's fallback path reaps it
+            raise ValueError(
+                "received a slot-reference handoff but this engine has "
+                "no shared KV store — sender and receiver must share "
+                "one host store")
         if payload is not None and tier is not None:
-            slots = tier.import_slots(payload["layers"],
-                                      payload["hashes"])
+            if payload.get("slot_refs") is not None:
+                slots = tier.adopt_slots(
+                    payload["slot_refs"], payload["gens"],
+                    payload["hashes"], payload["xfer_owner"])
+            else:
+                slots = tier.import_slots(payload["layers"],
+                                          payload["hashes"])
             if slots is not None:
                 rec = OffloadRecord(
                     start_page=int(payload["start_page"]),
@@ -2105,6 +2170,7 @@ class ServingEngine:
     def restore(cls, runner: PagedModelRunner, state: dict, *,
                 metrics: Optional[EngineMetrics] = None,
                 tokenizer=None,
+                kv_store=None, kv_store_owner: Optional[str] = None,
                 sleep_fn: Optional[Callable[[float], None]] = None,
                 audit: Optional[bool] = None) -> "ServingEngine":
         """Rebuild an engine from snapshot() on a fresh runner. Every
@@ -2142,6 +2208,7 @@ class ServingEngine:
                   spec_max_ngram=cfg.get("spec_max_ngram", 3),
                   spec_min_ngram=cfg.get("spec_min_ngram", 1),
                   tokenizer=tokenizer,
+                  kv_store=kv_store, kv_store_owner=kv_store_owner,
                   metrics=metrics, sleep_fn=sleep_fn, audit=audit)
         for r in state["requests"]:
             sp = dict(r["sampling"])

@@ -55,7 +55,7 @@ FSYNC_POLICIES = ("always", "interval", "never")
 
 
 def _empty_state() -> dict:
-    return {"reqs": {}, "snaps": {}}
+    return {"reqs": {}, "snaps": {}, "store": None, "store_idx": None}
 
 
 def _apply(state: dict, rec: dict) -> None:
@@ -67,6 +67,8 @@ def _apply(state: dict, rec: dict) -> None:
         state["reqs"] = dict(rec.get("reqs", {}))
         state["snaps"] = {int(k): v
                           for k, v in rec.get("snaps", {}).items()}
+        state["store"] = rec.get("store")
+        state["store_idx"] = rec.get("store_idx")
     elif t == "sub":
         state["reqs"][rec["rid"]] = {
             "prompt": list(rec["prompt"]),
@@ -98,6 +100,14 @@ def _apply(state: dict, rec: dict) -> None:
             r["owner"] = rec.get("rep")
     elif t == "snap":
         state["snaps"][int(rec["rep"])] = rec["snapshot"]
+    elif t == "store":
+        # cluster-wide KV (ISSUE 14): the store's shared-memory segment
+        # map — recover() reattaches the surviving segments
+        state["store"] = rec.get("spec")
+    elif t == "store_idx":
+        # the content index snapshot; recover() revives entries whose
+        # segment bytes still CRC-verify
+        state["store_idx"] = rec.get("state")
 
 
 class RouterJournal:

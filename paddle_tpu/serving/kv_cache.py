@@ -56,6 +56,7 @@ pools are byte-identical to the pre-ISSUE-9 layout.
 
 from __future__ import annotations
 
+import threading
 from bisect import insort
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -361,26 +362,34 @@ class PrefixCache:
         whose content the engine pages in from a demoted host copy joins
         the index exactly as if its first writer had registered it.
         First-writer-wins like register_seq; returns False if the hash
-        is already indexed (the page then stays private)."""
+        is already indexed (the page then stays private). Marked as a
+        PROMOTION to the tier: with a shared store (ISSUE 14) the
+        resident copy is the source this page was restored from and
+        stays indexed for every sibling replica."""
         if h in self._index:
             return False
         self._index[h] = page
         self._page_hash[page] = h
         self.pool.allocator.incref(page)       # the cache's own ref
         self._touch(page)
-        self._drop_host_duplicate(h)
+        self._drop_host_duplicate(h, promoted=True)
         return True
 
-    def _drop_host_duplicate(self, h: int) -> None:
+    def _drop_host_duplicate(self, h: int, promoted: bool = False) -> None:
         """Keep chain hashes device-live XOR host-resident (the
-        auditor's tier invariant): when a recomputed sequence registers
-        a hash the host tier still mirrors — its page was demoted AFTER
-        this sequence's admission match, or sat past match()'s strict
-        cap — the freshly computed device page wins and the redundant
-        host copy is dropped."""
+        auditor's per-engine tier invariant): when a RECOMPUTED
+        sequence registers a hash the host tier still mirrors — its
+        page was demoted AFTER this sequence's admission match, or sat
+        past match()'s strict cap — the freshly computed device page
+        wins and the redundant host copy is dropped. With a shared
+        store the drop is TIER-WIDE (the ISSUE 14 satellite: decref
+        the stale store copy, not just a local index entry), while a
+        `promoted` registration keeps the store copy — it IS the bytes
+        this page was just restored from, and the siblings still want
+        it."""
         tier = self.pool.host_tier
-        if tier is not None and tier.has_prefix(h):
-            tier.free_slots([tier.promote(h)])
+        if tier is not None:
+            tier.drop_stale_prefix(h, promoted=promoted)
 
     # ---------------------------------------------------------- eviction
 
@@ -439,11 +448,571 @@ class OffloadRecord:
     from (prefix-cache pages for [0, start_page)) + (these slots). The
     record rides `Request.offload` while the request waits with
     phase="offloaded"; admission either connects it back to a matching
-    prefix (page-in resume) or drops it (recompute fallback)."""
+    prefix (page-in resume) or drops it (recompute fallback). With a
+    store-backed tier (ISSUE 14) the slots name SharedKVStore slots the
+    owning engine holds references on — same lifecycle, tier-wide
+    scope."""
 
     start_page: int                        # first page index the slots cover
     covered_tokens: int                    # positions [0, covered) restorable
     slots: List[int] = field(default_factory=list)
+
+
+def _open_shm(name: str, tracked: bool = False):
+    """Attach an existing shared_memory segment. `tracked=False` (the
+    replica-child path) keeps the attaching process's resource tracker
+    OUT of it — an attached segment must never be unlinked by a child's
+    exit; only the owning router unlinks. `tracked=True` (the recovery
+    path: this process WILL own and later unlink the segment) leaves
+    the default tracking in place so unlink's unregister stays
+    balanced. `track=` exists from python 3.13; older versions need the
+    explicit unregister."""
+    from multiprocessing import shared_memory
+
+    if tracked:
+        return shared_memory.SharedMemory(name=name)
+    try:
+        return shared_memory.SharedMemory(name=name, track=False)
+    except TypeError:                      # python < 3.13
+        seg = shared_memory.SharedMemory(name=name)
+        try:
+            from multiprocessing import resource_tracker
+
+            resource_tracker.unregister(seg._name,  # noqa: SLF001
+                                        "shared_memory")
+        except Exception:                  # pragma: no cover
+            pass
+        return seg
+
+
+class SharedKVStore:
+    """Host-wide content-addressed KV page store (ISSUE 14 tentpole).
+
+    ONE store per host replaces N private `HostKVTier` buffer sets: the
+    router owns it, every engine replica's tier is a thin facade over
+    it (`HostKVTier(store=...)`), and the page BYTES live either in
+    plain numpy buffers (thread backend — every engine shares the
+    router's address space) or in `multiprocessing.shared_memory`
+    segments (`use_shm=True`, the process backend) that replica
+    children map directly, so page bytes never cross a socket between
+    processes on the same host.
+
+    Two reference classes keep every slot alive, audited tier-wide
+    (resilience.audit_store):
+
+      owner refs   per-(slot, owner) counts. An owner is one engine
+                   incarnation (e.g. "r0o3" / the launcher key) holding
+                   the slot inside an OffloadRecord or a pending
+                   page-in, or a transfer tag ("xfer:<rid>") while a
+                   handoff's ownership is mid-flight between two
+                   engines. `reap_owner` releases everything a dead
+                   replica held — slots are reclaimed by refcount,
+                   never leaked and never yanked from under a live
+                   sibling.
+      index ref    the content index's own single ref per indexed
+                   slot: `index_prefix(chain_hash, slot)` publishes a
+                   full page under its token-chain hash, tier-wide.
+                   A second publication of the same chain is a DEDUP
+                   (no copy, no slot); `acquire_prefix` hands any
+                   engine a reference to the one resident copy — the
+                   "page in once per host" property. The index entry
+                   outlives every engine that used it; LRU eviction
+                   (deterministic tick order) reclaims index-only
+                   slots when the free list runs dry.
+
+    A slot returns to the free list only when BOTH classes drop to
+    zero; its generation then bumps, so staged transfers and stale
+    handoff references self-invalidate (`generation`). Content hashes
+    are CRC-accumulated at publish (stable across processes) and
+    re-checked by the auditor's rotating spot check and at every
+    handoff adoption, so corrupted segment bytes are caught, never
+    served.
+    """
+
+    def __init__(self, layout, max_pages: int, *, use_shm: bool = False,
+                 _attach: Optional[dict] = None):
+        if max_pages < 1:
+            raise ValueError("SharedKVStore needs max_pages >= 1")
+        # layout: per layer, a tuple of (page_shape, dtype_str) per pool
+        # array — the shape ONE page occupies in the host mirror
+        self.layout = [tuple((tuple(int(d) for d in shape), str(dt))
+                             for shape, dt in layer) for layer in layout]
+        self.max_pages = int(max_pages)
+        self.use_shm = bool(use_shm)
+        self._segments: List = []          # SharedMemory handles
+        self._segment_names: List[str] = []
+        self._owns_segments = _attach is None
+        self.bufs = self._map_buffers(_attach)
+        self._lock = threading.RLock()
+        self._free: List[int] = list(range(self.max_pages))
+        # slot -> {owner: count}; empty/missing dict = no owner refs
+        self._owners: Dict[int, Dict[str, int]] = {}
+        self._indexed: set = set()         # slots the prefix index pins
+        self._hash: Dict[int, Optional[int]] = {}
+        self._gen: Dict[int, int] = {}
+        self._prefix: Dict[int, int] = {}        # chain hash -> slot
+        self._prefix_slot: Dict[int, int] = {}   # slot -> chain hash
+        self._tick = 0
+        self._slot_tick: Dict[int, int] = {}
+        # cumulative tier-wide accounting (stats()/audit/bench)
+        self.published_pages = 0           # fresh pages indexed
+        self.dedup_pages = 0               # publications skipped: resident
+        self.prefix_hits = 0               # acquire_prefix successes
+        self.evictions = 0                 # LRU index-only reclaims
+        self.reaped_slots = 0              # freed by dead-owner reaping
+        self.dropped_pages = 0             # allocs a full store refused
+
+    # ------------------------------------------------------ construction
+
+    @classmethod
+    def layout_for(cls, num_layers: int, block_size: int, n_kv_heads: int,
+                   head_dim: int, dtype="float32",
+                   kv_dtype: str = "fp32") -> list:
+        """The host-mirror page layout for a pool geometry — exactly
+        the per-page slices of KVCachePool's layer tuples."""
+        dt = str(np.dtype(str(jnp.zeros((), dtype).dtype))
+                 if not isinstance(dtype, str) else np.dtype(dtype))
+        page = (block_size, n_kv_heads, head_dim)
+        if kv_dtype == "int8":
+            layer = ((page, "int8"), (page, "int8"),
+                     ((n_kv_heads,), "float32"), ((n_kv_heads,), "float32"))
+        else:
+            layer = ((page, dt), (page, dt))
+        return [layer for _ in range(num_layers)]
+
+    @classmethod
+    def for_runner(cls, runner, max_pages: int, *, use_shm: bool = False
+                   ) -> "SharedKVStore":
+        """Build a store sized for a PagedModelRunner's pool geometry
+        (the thread-backend router path: one runner is enough — every
+        replica must share the model config, which attach-time shape
+        validation enforces loudly)."""
+        return cls(cls.layout_for(
+            runner.num_layers, runner.block_size, runner.n_kv_heads,
+            runner.head_dim, runner.dtype,
+            getattr(runner, "kv_dtype", "fp32")), max_pages,
+            use_shm=use_shm)
+
+    @classmethod
+    def for_geometry(cls, geometry: dict, max_pages: int, *,
+                     use_shm: bool = False) -> "SharedKVStore":
+        """Build from a JSON-able geometry dict (the process-backend
+        router path, where no runner exists in the router process):
+        {num_layers, block_size, n_kv_heads, head_dim, dtype?,
+        kv_dtype?}."""
+        return cls(cls.layout_for(
+            int(geometry["num_layers"]), int(geometry["block_size"]),
+            int(geometry["n_kv_heads"]), int(geometry["head_dim"]),
+            geometry.get("dtype", "float32"),
+            geometry.get("kv_dtype", "fp32")), max_pages, use_shm=use_shm)
+
+    def _map_buffers(self, attach: Optional[dict]):
+        bufs = []
+        names = iter(attach["segments"]) if attach is not None else None
+        for layer in self.layout:
+            arrs = []
+            for shape, dt in layer:
+                full = (self.max_pages,) + shape
+                if attach is not None:
+                    # reattach = this process takes ownership (it will
+                    # unlink at shutdown): keep tracking balanced
+                    seg = _open_shm(next(names), tracked=True)
+                    self._segments.append(seg)
+                    self._segment_names.append(seg.name)
+                    arr = np.ndarray(full, dtype=np.dtype(dt),
+                                     buffer=seg.buf)
+                elif self.use_shm:
+                    from multiprocessing import shared_memory
+
+                    nbytes = int(np.prod(full, dtype=np.int64)
+                                 * np.dtype(dt).itemsize)
+                    seg = shared_memory.SharedMemory(create=True,
+                                                     size=max(1, nbytes))
+                    self._segments.append(seg)
+                    self._segment_names.append(seg.name)
+                    arr = np.ndarray(full, dtype=np.dtype(dt),
+                                     buffer=seg.buf)
+                    arr[...] = 0
+                else:
+                    arr = np.zeros(full, np.dtype(dt))
+                arrs.append(arr)
+            bufs.append(tuple(arrs))
+        return bufs
+
+    def attach_spec(self) -> Optional[dict]:
+        """JSON-able description a replica child (or a recovering
+        router) needs to map the SAME segment bytes: segment names in
+        layout order plus the layout itself. None without shm — plain
+        numpy buffers cannot cross a process boundary."""
+        if not self.use_shm:
+            return None
+        return {"max_pages": self.max_pages,
+                "layout": [[[list(shape), dt] for shape, dt in layer]
+                           for layer in self.layout],
+                "segments": list(self._segment_names)}
+
+    @classmethod
+    def reattach(cls, spec: dict) -> "SharedKVStore":
+        """Map an existing store's segments (router recovery, ISSUE 14:
+        shared-memory segments survive a router SIGKILL until unlinked)
+        with EMPTY metadata — restore_index() then revives the content
+        index entries whose bytes still CRC-verify."""
+        layout = [tuple((tuple(shape), dt) for shape, dt in layer)
+                  for layer in spec["layout"]]
+        store = cls(layout, int(spec["max_pages"]), use_shm=True,
+                    _attach=spec)
+        store._owns_segments = True        # the recovered router owns them
+        return store
+
+    @staticmethod
+    def unlink_spec(spec: Optional[dict]) -> int:
+        """Best-effort unlink of a dead store's segments (recovery
+        decided not to reattach). Returns segments unlinked."""
+        if not spec:
+            return 0
+        n = 0
+        for name in spec.get("segments", ()):
+            try:
+                seg = _open_shm(name, tracked=True)
+                seg.close()
+                seg.unlink()
+                n += 1
+            except FileNotFoundError:
+                pass
+            except Exception:              # pragma: no cover
+                pass
+        return n
+
+    def close(self, unlink: Optional[bool] = None) -> None:
+        """Release the segment mappings; the creating (or recovered)
+        router also unlinks, so host RAM is returned when the tier
+        shuts down."""
+        if unlink is None:
+            unlink = self._owns_segments
+        self.bufs = []
+        for seg in self._segments:
+            try:
+                seg.close()
+            except Exception:              # pragma: no cover
+                pass
+            if unlink:
+                try:
+                    seg.unlink()
+                except Exception:          # pragma: no cover
+                    pass
+        self._segments = []
+
+    # ------------------------------------------------------- accounting
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.max_pages - len(self._free)
+
+    @property
+    def prefix_count(self) -> int:
+        return len(self._prefix)
+
+    def page_bytes(self) -> int:
+        return sum(int(np.prod(shape, dtype=np.int64)
+                       * np.dtype(dt).itemsize)
+                   for layer in self.layout for shape, dt in layer)
+
+    @property
+    def bytes_used(self) -> int:
+        return self.used_count * self.page_bytes()
+
+    def refcount(self, slot: int) -> int:
+        with self._lock:
+            return (sum(self._owners.get(slot, {}).values())
+                    + (1 if slot in self._indexed else 0))
+
+    def owner_count(self, slot: int, owner: str) -> int:
+        with self._lock:
+            return self._owners.get(slot, {}).get(owner, 0)
+
+    def owners_snapshot(self) -> Dict[int, Dict[str, int]]:
+        with self._lock:
+            return {s: dict(o) for s, o in self._owners.items() if o}
+
+    def generation(self, slot: int) -> int:
+        with self._lock:
+            return self._gen.get(slot, 0)
+
+    def slot_hash(self, slot: int) -> Optional[int]:
+        with self._lock:
+            return self._hash.get(slot)
+
+    def set_hash(self, slot: int, h: int) -> None:
+        with self._lock:
+            self._hash[slot] = int(h)
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "store_max_pages": float(self.max_pages),
+                "store_free": float(len(self._free)),
+                "store_used": float(self.max_pages - len(self._free)),
+                "store_prefix_pages": float(len(self._prefix)),
+                "store_published_pages": float(self.published_pages),
+                "store_dedup_pages": float(self.dedup_pages),
+                "store_prefix_hits": float(self.prefix_hits),
+                "store_evictions": float(self.evictions),
+                "store_reaped_slots": float(self.reaped_slots),
+                "store_dropped_pages": float(self.dropped_pages),
+                "store_bytes_used": float(self.bytes_used),
+            }
+
+    # ------------------------------------------------------- slot refs
+
+    def _touch_locked(self, slot: int) -> None:
+        self._tick += 1
+        self._slot_tick[slot] = self._tick
+
+    def alloc(self, n: int, owner: str) -> List[int]:
+        """Hand out up to n slots at one `owner` ref each (lowest-id
+        first — spill traces stay deterministic). A dry free list first
+        evicts LRU index-only slots; whatever still cannot be funded is
+        dropped and counted, never an error — exactly the private
+        tier's cap-pressure contract."""
+        with self._lock:
+            if n > len(self._free):
+                self._evict_locked(n - len(self._free))
+            take = min(n, len(self._free))
+            if take < n:
+                self.dropped_pages += n - take
+            slots, self._free = self._free[:take], self._free[take:]
+            for s in slots:
+                self._owners[s] = {owner: 1}
+                self._hash[s] = None
+                self._touch_locked(s)
+            return slots
+
+    def incref(self, slots: Sequence[int], owner: str) -> None:
+        with self._lock:
+            for s in slots:
+                own = self._owners.setdefault(s, {})
+                if not own and s not in self._indexed:
+                    raise ValueError(f"incref of free store slot {s}")
+                own[owner] = own.get(owner, 0) + 1
+
+    def release(self, slots: Sequence[int], owner: str) -> None:
+        """Drop one `owner` ref per listed slot; a slot with no owner
+        refs and no index ref returns to the free list (generation
+        bumps). Over-release raises — the tier-wide double-free
+        guard."""
+        with self._lock:
+            for s in slots:
+                own = self._owners.get(s)
+                if not own or own.get(owner, 0) <= 0:
+                    raise ValueError(
+                        f"release of store slot {s} not held by "
+                        f"{owner!r}")
+                own[owner] -= 1
+                if own[owner] == 0:
+                    del own[owner]
+                self._maybe_free_locked(s)
+
+    def retag(self, slots: Sequence[int], old_owner: str,
+              new_owner: str) -> None:
+        """Atomically move one ref per slot from `old_owner` to
+        `new_owner` — the slot-reference handoff's ownership transfer
+        (prefill engine -> "xfer:<rid>" -> decode engine): the bytes
+        never move, only the tag does."""
+        with self._lock:
+            for s in slots:
+                own = self._owners.get(s)
+                if not own or own.get(old_owner, 0) <= 0:
+                    raise ValueError(
+                        f"retag of store slot {s}: no ref held by "
+                        f"{old_owner!r}")
+                own[old_owner] -= 1
+                if own[old_owner] == 0:
+                    del own[old_owner]
+                own[new_owner] = own.get(new_owner, 0) + 1
+
+    def _maybe_free_locked(self, s: int) -> bool:
+        if self._owners.get(s) or s in self._indexed:
+            return False
+        self._owners.pop(s, None)
+        self._hash.pop(s, None)
+        self._slot_tick.pop(s, None)
+        self._gen[s] = self._gen.get(s, 0) + 1
+        insort(self._free, s)
+        return True
+
+    def reap_owner(self, owner: str) -> int:
+        """Release EVERY ref a dead owner held (supervisor recovery,
+        drain residue, abandoned transfer tags). Slots another engine
+        or the index still references survive untouched; the rest are
+        reclaimed by refcount — a dead replica can never leak store
+        RAM. Returns slots actually freed."""
+        with self._lock:
+            freed = 0
+            for s in list(self._owners):
+                own = self._owners.get(s)
+                if own and owner in own:
+                    del own[owner]
+                    if self._maybe_free_locked(s):
+                        freed += 1
+            self.reaped_slots += freed
+            return freed
+
+    # ---------------------------------------------------- content index
+
+    def has_prefix(self, h: int) -> bool:
+        with self._lock:
+            return h in self._prefix
+
+    def index_prefix(self, h: int, slot: int) -> bool:
+        """Publish a written slot under its token-chain hash. The index
+        takes its OWN ref (on top of whatever owner refs exist), so the
+        content outlives the publishing engine. False = the chain is
+        already resident (dedup — caller keeps/releases its slot; the
+        FIRST publication wins, the PrefixCache registration rule
+        stretched tier-wide)."""
+        with self._lock:
+            if h in self._prefix:
+                self.dedup_pages += 1
+                return False
+            self._prefix[h] = slot
+            self._prefix_slot[slot] = h
+            self._indexed.add(slot)
+            self.published_pages += 1
+            self._touch_locked(slot)
+            return True
+
+    def acquire_prefix(self, h: int, owner: str) -> Optional[int]:
+        """Take one `owner` ref on the chain's resident slot for a
+        page-in (the hash STAYS indexed — the same bytes keep serving
+        every sibling, which is the whole point). None on a miss (the
+        entry raced away: recompute fallback applies)."""
+        with self._lock:
+            slot = self._prefix.get(h)
+            if slot is None:
+                return None
+            own = self._owners.setdefault(slot, {})
+            own[owner] = own.get(owner, 0) + 1
+            self.prefix_hits += 1
+            self._touch_locked(slot)
+            return slot
+
+    def drop_prefix(self, h: int) -> bool:
+        """Remove a chain from the index and drop the index's ref (the
+        store analogue of PR 10's device-XOR-host fix, ISSUE 14
+        satellite: a recomputed device registration supersedes the
+        store copy TIER-WIDE). Engines holding page-in refs keep the
+        bytes alive until their fences release — refcounts make the
+        race benign."""
+        with self._lock:
+            slot = self._prefix.pop(h, None)
+            if slot is None:
+                return False
+            del self._prefix_slot[slot]
+            self._indexed.discard(slot)
+            self._maybe_free_locked(slot)
+            return True
+
+    def _evict_locked(self, n: int) -> int:
+        """Reclaim up to n index-only slots (no owner refs), least-
+        recently-used first by the deterministic tick."""
+        victims = sorted((s for s in self._indexed
+                          if not self._owners.get(s)),
+                         key=lambda s: self._slot_tick.get(s, 0))[:n]
+        for s in victims:
+            h = self._prefix_slot.pop(s)
+            del self._prefix[h]
+            self._indexed.discard(s)
+            self._maybe_free_locked(s)
+            self.evictions += 1
+        return len(victims)
+
+    # ------------------------------------------------------ byte access
+
+    def read_slot(self, slot: int) -> List[Tuple[np.ndarray, ...]]:
+        return [tuple(np.array(buf[slot]) for buf in layer)
+                for layer in self.bufs]
+
+    def export_slots(self, slots: Sequence[int]
+                     ) -> List[Tuple[np.ndarray, ...]]:
+        return [tuple(np.stack([buf[s] for s in slots]) for buf in layer)
+                for layer in self.bufs]
+
+    def content_hash(self, slot: int) -> int:
+        """CRC-accumulated hash over the slot's bytes across every
+        layer buffer — the same math HostKVTier records, stable across
+        processes (the audit spot check and handoff adoption both
+        re-verify against it)."""
+        import zlib
+
+        h = 0x9E3779B9
+        for layer in self.bufs:
+            for buf in layer:
+                h = zlib.crc32(np.ascontiguousarray(buf[slot]).tobytes(),
+                               h)
+        return h
+
+    def scrub(self) -> int:
+        """Re-CRC every indexed slot and DROP the entries whose segment
+        bytes no longer match their recorded hash — the operator-grade
+        response to a failed spot check: corrupted content falls back
+        to recompute instead of ever serving (in-flight refs keep their
+        bytes alive but the chain stops matching). Returns entries
+        dropped."""
+        with self._lock:
+            entries = list(self._prefix.items())
+        dropped = 0
+        for h, s in entries:
+            rec = self.slot_hash(s)
+            if rec is not None and self.content_hash(s) != rec:
+                if self.drop_prefix(h):
+                    dropped += 1
+        return dropped
+
+    # ------------------------------------------------ journal round trip
+
+    def journal_state(self) -> dict:
+        """The content index as a JSON-able record — journaled beside
+        replica snapshots so ServingRouter.recover can revive the index
+        over segments that survived a router SIGKILL. Only INDEXED
+        slots ride along: owner refs belong to engines that died with
+        the router."""
+        with self._lock:
+            return {"prefix": [
+                [int(h), int(s), int(self._gen.get(s, 0)),
+                 int(self._hash.get(s) or 0)]
+                for h, s in self._prefix.items()]}
+
+    def restore_index(self, state: Optional[dict]) -> int:
+        """Revive journaled index entries onto a reattached store.
+        Every entry is CRC-verified against the segment bytes it names
+        before it re-enters the index — a slot whose bytes did not
+        survive (torn write, recycled segment) is silently skipped and
+        its content recomputes on demand. Returns entries restored."""
+        if not state:
+            return 0
+        restored = 0
+        for h, s, g, crc in state.get("prefix", ()):
+            s = int(s)
+            if not 0 <= s < self.max_pages:
+                continue
+            if self.content_hash(s) != int(crc):
+                continue                   # corrupt/stale: recompute wins
+            with self._lock:
+                if int(h) in self._prefix or s not in self._free:
+                    continue
+                self._free.remove(s)
+                self._prefix[int(h)] = s
+                self._prefix_slot[s] = int(h)
+                self._indexed.add(s)
+                self._hash[s] = int(crc)
+                self._gen[s] = int(g)
+                self._touch_locked(s)
+            restored += 1
+        return restored
 
 
 class HostKVTier:
@@ -456,6 +1025,16 @@ class HostKVTier:
     either dtype (offload composes with ISSUE 9 by construction). Slots
     are handed out lowest-id-first from a sorted free list, mirroring
     the device BlockAllocator, so spill traces are deterministic.
+
+    ISSUE 14 adds the CLUSTER-WIDE mode: constructed with a
+    `SharedKVStore` (and this engine's `owner` tag) the tier keeps its
+    whole engine-facing surface but becomes a facade over the host-wide
+    store — buffers alias the store's (possibly shared-memory)
+    segments, slots are store slots refcounted under `owner`, the
+    prefix index is tier-wide (dedup on publish, references on
+    acquire), and handoffs move slot references instead of bytes. The
+    private-buffer semantics below describe the store mode too, with
+    "free" meaning "this engine's reference released".
 
     Two populations share the buffers, each owned by exactly one party
     (the auditor pins it):
@@ -476,11 +1055,26 @@ class HostKVTier:
     """
 
     def __init__(self, pool: "KVCachePool", max_pages: int, metrics=None,
-                 async_spill: bool = False):
-        if max_pages < 1:
+                 async_spill: bool = False, store=None,
+                 owner: str = "engine"):
+        if store is None and max_pages < 1:
             raise ValueError("host tier needs max_pages >= 1 (omit the "
                              "tier entirely to disable offload)")
         self.pool = pool
+        # cluster-wide mode (ISSUE 14): `store` is a SharedKVStore (or
+        # a process-backend SharedKVStoreClient) — this tier becomes a
+        # per-engine FACADE over the host-wide store: page bytes live
+        # in the store's buffers (possibly shared-memory segments),
+        # slots are refcounted under this engine's `owner` tag, and the
+        # prefix index is TIER-WIDE (a page demoted by any replica
+        # serves every replica's admission). All engine-facing
+        # semantics (spill/page-in/free, drop-on-overflow, async spill
+        # worker) are unchanged.
+        self.store = store
+        self.owner = str(owner)
+        if store is not None:
+            self._validate_store_layout(pool, store)
+            max_pages = store.max_pages
         self.max_pages = int(max_pages)
         self.metrics = metrics             # optional EngineMetrics mirror
         # threaded spill I/O (ISSUE 11 satellite): with async_spill the
@@ -497,17 +1091,29 @@ class HostKVTier:
         self.async_spill = bool(async_spill)
         self._executor = None
         self._pending: Dict[int, object] = {}     # slot -> Future
-        # pinned host mirrors of the device pool layout, one buffer per
-        # (layer, pool-array): [max_pages, *page_shape] at the pool dtype
-        self._bufs: List[Tuple[np.ndarray, ...]] = [
-            tuple(np.zeros((self.max_pages,) + tuple(a.shape[1:]),
-                           np.dtype(str(a.dtype))) for a in layer)
-            for layer in pool.pools]
-        self._free: List[int] = list(range(self.max_pages))   # ascending
-        self._hash: Dict[int, int] = {}     # slot -> content hash (used set)
-        self._gen: Dict[int, int] = {}      # slot -> reuse generation
-        self._prefix: Dict[int, int] = {}   # chain hash -> slot
-        self._prefix_slot: Dict[int, int] = {}   # slot -> chain hash
+        if store is not None:
+            # the store's buffers ARE this tier's buffers (same host
+            # bytes for every engine on the host — shared-memory-backed
+            # under the process backend)
+            self._bufs = store.bufs
+            self._free = None
+            self._hash = None
+            self._gen = None
+            self._prefix = None
+            self._prefix_slot = None
+        else:
+            # pinned host mirrors of the device pool layout, one buffer
+            # per (layer, pool-array): [max_pages, *page_shape] at the
+            # pool dtype
+            self._bufs: List[Tuple[np.ndarray, ...]] = [
+                tuple(np.zeros((self.max_pages,) + tuple(a.shape[1:]),
+                               np.dtype(str(a.dtype))) for a in layer)
+                for layer in pool.pools]
+            self._free: List[int] = list(range(self.max_pages))  # asc.
+            self._hash: Dict[int, int] = {}   # slot -> content hash
+            self._gen: Dict[int, int] = {}    # slot -> reuse generation
+            self._prefix: Dict[int, int] = {}   # chain hash -> slot
+            self._prefix_slot: Dict[int, int] = {}  # slot -> chain hash
         # cumulative accounting (authoritative; the engine mirrors them
         # into EngineMetrics when `metrics` is set)
         self.spilled_pages = 0
@@ -516,19 +1122,54 @@ class HostKVTier:
         self.resumes = 0                    # page-in resumes served
         self.fallbacks = 0                  # offload records dropped to
         #                                     the recompute path
+        # store-mode accounting (ISSUE 14)
+        self.store_hits = 0                 # pages acquired from the index
+        self.store_dedups = 0               # copies skipped: chain resident
+        self.store_published = 0            # pages this engine indexed
+        # satellite observability (ISSUE 14): spills that read the
+        # device SYNCHRONOUSLY on the calling thread, and _wait_slot
+        # joins that actually blocked on an unfinished worker copy —
+        # the counting-stub pin for the async preempt-spill path
+        self.sync_spill_reads = 0
+        self.blocking_joins = 0
+
+    @staticmethod
+    def _validate_store_layout(pool: "KVCachePool", store) -> None:
+        """A store only serves pools with the EXACT page geometry it
+        was built for — a replica with a different model config mapping
+        the same segments would corrupt every sibling. Loud, at attach
+        time."""
+        want = [tuple((tuple(a.shape[1:]), str(np.dtype(str(a.dtype))))
+                      for a in layer) for layer in pool.pools]
+        have = [tuple((tuple(shape), str(np.dtype(dt)))
+                      for shape, dt in layer) for layer in store.layout]
+        if want != have:
+            raise ValueError(
+                "SharedKVStore layout mismatch: pool pages are "
+                f"{want[0] if want else '?'} x {len(want)} layers but "
+                f"the store was built for "
+                f"{have[0] if have else '?'} x {len(have)} layers — "
+                "every replica sharing a store must run the same model "
+                "geometry and kv_dtype")
 
     # ------------------------------------------------------- accounting
 
     @property
     def free_count(self) -> int:
+        if self.store is not None:
+            return self.store.free_count
         return len(self._free)
 
     @property
     def used_count(self) -> int:
+        if self.store is not None:
+            return self.store.used_count
         return len(self._hash)
 
     @property
     def prefix_count(self) -> int:
+        if self.store is not None:
+            return self.store.prefix_count
         return len(self._prefix)
 
     @property
@@ -545,11 +1186,22 @@ class HostKVTier:
         """Reuse generation of a slot — bumped on every free, so a
         staged device_put keyed by (slot, generation) can never serve a
         later tenant's bytes."""
+        if self.store is not None:
+            return self.store.generation(slot)
         return self._gen.get(slot, 0)
 
     def slot_hash(self, slot: int) -> int:
         self._wait_slot(slot)
+        if self.store is not None:
+            return self.store.slot_hash(slot)
         return self._hash[slot]
+
+    def _set_hash(self, slot: int, h: Optional[int]) -> None:
+        if self.store is not None:
+            if h is not None:
+                self.store.set_hash(slot, h)
+        else:
+            self._hash[slot] = h
 
     # ------------------------------------------ async spill worker plumbing
 
@@ -564,9 +1216,15 @@ class HostKVTier:
     def _wait_slot(self, slot: int) -> None:
         """Join the pending spill copy covering one slot (no-op when the
         slot has none). A future may cover several slots; popping one
-        leaves the rest mapped — result() is idempotent."""
+        leaves the rest mapped — result() is idempotent.
+        `blocking_joins` counts the joins that actually waited — the
+        observable the async-preempt-spill pin reads (ISSUE 14
+        satellite): a spill itself must never add one on the engine
+        loop; only a consumer racing its own copy legitimately can."""
         fut = self._pending.pop(slot, None)
         if fut is not None:
+            if not fut.done():
+                self.blocking_joins += 1
             fut.result()
 
     def sync(self) -> None:
@@ -577,15 +1235,49 @@ class HostKVTier:
         for fut in {id(f): f for f in pending.values()}.values():
             fut.result()
 
-    def _spill_job(self, slots: List[int], arrs) -> None:
+    def _spill_job(self, slots: List[int], arrs, gens=None,
+                   publish=()) -> None:
         """Worker-thread half of an async spill: materialize the device
         gather (np.asarray blocks HERE, not on the engine loop) into the
-        pinned buffers and record the content hashes."""
-        for layer_bufs, layer_data in zip(self._bufs, arrs):
-            for buf, arr in zip(layer_bufs, layer_data):
-                buf[slots] = np.asarray(arr)
+        pinned buffers and record the content hashes. Store mode guards
+        each write by slot generation (a crashed engine's reaped slot
+        must never be scribbled by its orphaned worker job) and then
+        publishes any registered-page chain hashes into the tier-wide
+        index — publication happens strictly AFTER the bytes land, so a
+        sibling can never page in a half-written slot."""
+        if gens is not None:
+            live = [i for i, s in enumerate(slots)
+                    if self.store.generation(s) == gens[i]]
+            if len(live) < len(slots):
+                slots = [slots[i] for i in live]
+                publish = [p for p in publish if p[0] in set(slots)]
+                idx = np.asarray(live, np.int64)
+            else:
+                idx = None
+            if not slots:
+                return
+            for layer_bufs, layer_data in zip(self._bufs, arrs):
+                for buf, arr in zip(layer_bufs, layer_data):
+                    host = np.asarray(arr)
+                    buf[slots] = host if idx is None else host[idx]
+        else:
+            for layer_bufs, layer_data in zip(self._bufs, arrs):
+                for buf, arr in zip(layer_bufs, layer_data):
+                    buf[slots] = np.asarray(arr)
         for s in slots:
-            self._hash[s] = self.content_hash(s)
+            self._set_hash(s, self.content_hash(s))
+        for s, h in publish:
+            if self.store.index_prefix(h, s):
+                self.store_published += 1
+
+    def _finish_spill(self, slots: List[int], publish=()) -> None:
+        """Synchronous-path epilogue: record hashes, then publish any
+        chain hashes into the tier-wide index (store mode)."""
+        for s in slots:
+            self._set_hash(s, self.content_hash(s))
+        for s, h in publish:
+            if self.store.index_prefix(h, s):
+                self.store_published += 1
 
     def content_hash(self, slot: int) -> int:
         """Deterministic hash over the slot's bytes across every layer
@@ -605,13 +1297,25 @@ class HostKVTier:
 
     # ------------------------------------------------------------ spill
 
-    def spill_pages(self, device_pages: Sequence[int]) -> List[int]:
+    def spill_pages(self, device_pages: Sequence[int],
+                    publish: Sequence[Tuple[int, int]] = ()) -> List[int]:
         """Copy device pages into host slots (device->host sync copy —
         the cost preemption pays ONCE instead of a full re-prefill
         later). Takes as many as fit; the overflow is dropped and
         counted, never an error. Returns the slots, aligned with the
-        leading device_pages they hold."""
-        n = min(len(device_pages), len(self._free))
+        leading device_pages they hold.
+
+        `publish` (store mode) maps positions in `device_pages` to
+        chain hashes to index tier-wide once the bytes land — the
+        handoff/demotion publication path; positions past the fitted
+        prefix are dropped with their pages."""
+        if self.store is not None:
+            slots = self.store.alloc(len(device_pages), self.owner)
+            n = len(slots)
+        else:
+            n = min(len(device_pages), len(self._free))
+            slots = self._free[:n]
+            del self._free[:n]
         dropped = len(device_pages) - n
         if dropped:
             self.dropped_pages += dropped
@@ -619,28 +1323,31 @@ class HostKVTier:
                 self.metrics.host_tier_drops.inc(dropped)
         if n == 0:
             return []
-        slots = self._free[:n]
-        del self._free[:n]
+        pub = [(slots[i], h) for i, h in publish if i < n]
         if self.async_spill:
             # dispatch the device-side gather now (async, immutable
             # functional snapshot) and hand the blocking np.asarray +
-            # buffer write + hashing to the worker; the slot is "used"
-            # immediately (placeholder hash) so accounting stays
-            # synchronous and deterministic
+            # buffer write + hashing + index publication to the worker;
+            # the slot is "used" immediately (placeholder hash) so
+            # accounting stays synchronous and deterministic — the
+            # engine loop never blocks on a spill's np.asarray
+            # (the ISSUE 14 satellite pin)
             arrs = self.pool.gather_pages(list(device_pages)[:n])
             for s in slots:
-                self._hash[s] = None
+                self._set_hash(s, None)
+            gens = ([self.store.generation(s) for s in slots]
+                    if self.store is not None else None)
             fut = self._ensure_executor().submit(self._spill_job, slots,
-                                                 arrs)
+                                                 arrs, gens, pub)
             for s in slots:
                 self._pending[s] = fut
         else:
+            self.sync_spill_reads += 1
             data = self.pool.read_pages(list(device_pages)[:n])
             for layer_bufs, layer_data in zip(self._bufs, data):
                 for buf, arr in zip(layer_bufs, layer_data):
                     buf[slots] = arr
-            for s in slots:
-                self._hash[s] = self.content_hash(s)
+            self._finish_spill(slots, pub)
         self.spilled_pages += n
         if self.metrics is not None:
             self.metrics.offload_spill_pages.inc(n)
@@ -665,7 +1372,19 @@ class HostKVTier:
         replica owns its own pool, so refcounts are irrelevant — what
         matters is that the record is self-contained (start_page=0)
         and connects on a sibling whose prefix cache may hold none of
-        the sender's pages."""
+        the sender's pages.
+
+        STORE mode (ISSUE 14) adds content-addressed dedup on fp32
+        pools: a registered page whose chain hash is already resident
+        tier-wide contributes a REFERENCE (refcount bump on the one
+        resident copy) instead of a copy, and freshly spilled
+        registered pages are PUBLISHED into the index once their bytes
+        land — so the host materializes a hot shared prefix once, no
+        matter how many requests or replicas hand it around. Int8
+        pools skip the dedup/publish (codes are chunk-history-
+        dependent, so equal chains do not guarantee equal bytes; the
+        record must carry THIS sequence's exact codes for the
+        continuation to stay pinned) but still ride store slots."""
         bs = self.pool.block_size
         covered = min(int(covered_tokens), kv.num_tokens)
         start = 0 if include_registered else kv.registered_pages
@@ -684,13 +1403,58 @@ class HostKVTier:
                 if self.metrics is not None:
                     self.metrics.offload_recompute_fallbacks.inc()
                 return None
-        slots = self.spill_pages(cand)
+        dedup_ok = (self.store is not None
+                    and self.pool.kv_dtype == "fp32")
+        if not dedup_ok:
+            slots = self.spill_pages(cand)
+            if not slots:
+                return None
+            if len(slots) < len(cand):
+                covered = (start + len(slots)) * bs
+            return OffloadRecord(start_page=start, covered_tokens=covered,
+                                 slots=slots)
+        # store-mode dedup/publish: registered pages are chain-hashed
+        slots: List[Optional[int]] = [None] * len(cand)
+        fresh_pages: List[int] = []
+        fresh_pos: List[int] = []
+        publish: List[Tuple[int, int]] = []   # (fresh_pages idx, hash)
+        for j, page in enumerate(cand):
+            idx = start + j
+            h = (kv.hash_chain[idx] if idx < kv.registered_pages
+                 else None)
+            if h is not None:
+                s = self.store.acquire_prefix(h, self.owner)
+                if s is not None:
+                    self._wait_slot(s)    # never reference a half-copy
+                    slots[j] = s
+                    self.store_dedups += 1
+                    if self.metrics is not None:
+                        self.metrics.store_dedup_pages.inc()
+                    continue
+                publish.append((len(fresh_pages), h))
+            fresh_pages.append(page)
+            fresh_pos.append(j)
+        fresh_slots = self.spill_pages(fresh_pages, publish=publish)
+        for j, s in zip(fresh_pos, fresh_slots):
+            slots[j] = s
+        # a partial fit truncates at the first hole so the record stays
+        # contiguous. Holes are dropped FRESH pages, and fresh slots
+        # are assigned in ascending position, so everything past the
+        # first hole that still holds a slot is a dedup reference —
+        # release those refs (the resident copies stay indexed)
+        k = 0
+        while k < len(slots) and slots[k] is not None:
+            k += 1
+        tail_refs = [s for s in slots[k:] if s is not None]
+        if tail_refs:
+            self.store.release(tail_refs, self.owner)
+        slots = slots[:k]
         if not slots:
             return None
         if len(slots) < len(cand):
             covered = (start + len(slots)) * bs
         return OffloadRecord(start_page=start, covered_tokens=covered,
-                             slots=slots)
+                             slots=list(slots))
 
     # -------------------------------------------- prefix demotion (hook)
 
@@ -698,7 +1462,46 @@ class HostKVTier:
         """PrefixCache.evict_hook target: demote a full cached page to
         the host before the device page is reclaimed. Fires for both
         LRU eviction and clear() — the clear-path hook is what keeps
-        teardown from silently leaking tier bookkeeping."""
+        teardown from silently leaking tier bookkeeping.
+
+        Store mode: demotion PUBLISHES tier-wide. A chain already
+        resident (any sibling demoted it first, or a handoff published
+        it) is a pure dedup — no copy, the device page just dies while
+        the content stays reachable from every replica; otherwise the
+        page spills into a fresh slot that the index alone then owns
+        (publication rides the spill worker under async_spill, so a
+        sibling can never acquire a half-written slot)."""
+        if self.store is not None:
+            if self.store.has_prefix(chain_hash):
+                self.store_dedups += 1
+                if self.metrics is not None:
+                    self.metrics.store_dedup_pages.inc()
+                return True                # content already host-resident
+            slots = self.spill_pages([page], publish=[(0, chain_hash)])
+            if not slots:
+                return False               # store full: the page dies
+            # the spill allocated under this engine's owner tag; the
+            # published page must end INDEX-owned only, so the content
+            # outlives this engine. On the async path the release is a
+            # SECOND job on the same single-thread executor: FIFO
+            # ordering runs it strictly after the copy+publish job, and
+            # re-mapping the pending future makes every joiner
+            # (sync()/_wait_slot, the leak checks) wait through it.
+            if self.async_spill:
+                s = slots[0]
+                fut1 = self._pending.get(s)
+
+                def _release(s=s, fut1=fut1):
+                    if fut1 is not None:
+                        fut1.result()      # surface copy-job failures
+                    try:
+                        self.store.release([s], self.owner)
+                    except ValueError:     # pragma: no cover — reaped
+                        pass
+                self._pending[s] = self._ensure_executor().submit(_release)
+            else:
+                self.store.release([slots[0]], self.owner)
+            return True
         if chain_hash in self._prefix:      # pragma: no cover — the
             return False                    # index is hash-unique
         slots = self.spill_pages([page])
@@ -709,16 +1512,48 @@ class HostKVTier:
         return True
 
     def has_prefix(self, h: int) -> bool:
+        if self.store is not None:
+            return self.store.has_prefix(h)
         return h in self._prefix
 
-    def promote(self, h: int) -> int:
-        """Claim a demoted prefix page for re-promotion: the hash leaves
-        the host index (device-live XOR host-resident — the auditor's
-        invariant), and the slot stays pinned until the engine's fence
-        pages it in and frees it."""
+    def promote(self, h: int) -> Optional[int]:
+        """Claim a demoted prefix page for re-promotion. Private tier:
+        the hash LEAVES the host index (device-live XOR host-resident —
+        the single-ownership invariant) and the slot stays pinned until
+        the engine's fence pages it in and frees it. Store mode: the
+        hash STAYS indexed (the same bytes keep serving every sibling —
+        "page in once per host"); this engine just takes a reference
+        for the duration of its page-in. Returns None when the entry
+        raced away tier-wide (another replica's recomputed registration
+        dropped it) — the caller then falls back to recompute."""
+        if self.store is not None:
+            slot = self.store.acquire_prefix(h, self.owner)
+            if slot is not None:
+                self.store_hits += 1
+                if self.metrics is not None:
+                    self.metrics.store_hit_pages.inc()
+            return slot
         slot = self._prefix.pop(h)
         del self._prefix_slot[slot]
         return slot
+
+    def drop_stale_prefix(self, h: int, promoted: bool = False) -> None:
+        """Registration-time reconciliation (the device-XOR-host fix of
+        PR 10 and its STORE analogue, ISSUE 14 satellite). `promoted`
+        marks a registration that just paged the content IN from this
+        tier — the resident copy is the source of truth and must stay
+        (store mode) / is already gone (private promote removed it).
+        A RECOMPUTED registration (promoted=False) supersedes the tier
+        copy: private mode frees the slot, store mode drops the index
+        entry TIER-WIDE — in-flight sibling page-ins keep the bytes
+        alive through their own refs, so the decref can never corrupt
+        them."""
+        if self.store is not None:
+            if not promoted and self.store.has_prefix(h):
+                self.store.drop_prefix(h)
+            return
+        if self.has_prefix(h):
+            self.free_slots([self.promote(h)])
 
     # ---------------------------------------------------------- page-in
 
@@ -754,24 +1589,36 @@ class HostKVTier:
         ValueError rather than ever serving corrupted KV. Returns None
         when the tier cannot hold the whole payload (the caller then
         degrades to the recompute path: partial imports would leave an
-        unconnectable record)."""
+        unconnectable record). The cross-host path — same-host
+        transfers use adopt_slots (slot references, zero byte
+        copies)."""
         n = len(hashes)
         if n == 0:
             return []
-        if n > len(self._free):
-            self.dropped_pages += n
-            if self.metrics is not None:
-                self.metrics.host_tier_drops.inc(n)
-            return None
-        slots = self._free[:n]
-        del self._free[:n]
+        if self.store is not None:
+            slots = self.store.alloc(n, self.owner)
+            if len(slots) < n:
+                if slots:
+                    self.store.release(slots, self.owner)
+                self.dropped_pages += n
+                if self.metrics is not None:
+                    self.metrics.host_tier_drops.inc(n)
+                return None
+        else:
+            if n > len(self._free):
+                self.dropped_pages += n
+                if self.metrics is not None:
+                    self.metrics.host_tier_drops.inc(n)
+                return None
+            slots = self._free[:n]
+            del self._free[:n]
         for layer_bufs, data in zip(self._bufs, layer_data):
             for buf, arr in zip(layer_bufs, data):
                 buf[slots] = np.asarray(arr).astype(buf.dtype, copy=False)
         bad = []
         for j, s in enumerate(slots):
             h = self.content_hash(s)
-            self._hash[s] = h
+            self._set_hash(s, h)
             if h != int(hashes[j]):
                 bad.append(s)
         if bad:
@@ -785,12 +1632,62 @@ class HostKVTier:
             self.metrics.offload_spill_pages.inc(n)
         return slots
 
+    # --------------------------------- slot-reference transfer (ISSUE 14)
+
+    def retag_out(self, slots: Sequence[int], to_owner: str) -> None:
+        """Hand this engine's refs on `slots` to a transfer tag (the
+        slot-reference handoff's extract half): pending spill copies
+        are joined first so the reference never names half-written
+        bytes, then ownership moves atomically in the store — no bytes
+        touched."""
+        for s in slots:
+            self._wait_slot(s)
+        self.store.retag(list(slots), self.owner, to_owner)
+
+    def adopt_slots(self, slots: Sequence[int], gens: Sequence[int],
+                    hashes: Sequence[int], from_owner: str
+                    ) -> Optional[List[int]]:
+        """Accept a slot-reference handoff: verify each slot's
+        generation is current (a stale reference names recycled bytes —
+        degrade to recompute, never serve) and RE-VERIFY the CRC
+        content hash against the segment bytes (the import-verify
+        contract of ISSUE 12, kept: corruption raises loudly), then
+        move the refs from the transfer tag to this engine. ZERO page
+        bytes move — the transfer is bookkeeping."""
+        slots = [int(s) for s in slots]
+        stale = [s for s, g in zip(slots, gens)
+                 if self.store.generation(s) != int(g)]
+        if stale:
+            self.store.release(slots, from_owner)
+            self.fallbacks += 1
+            if self.metrics is not None:
+                self.metrics.offload_recompute_fallbacks.inc()
+            return None
+        bad = [s for s, h in zip(slots, hashes)
+               if self.content_hash(s) != int(h)]
+        if bad:
+            self.store.release(slots, from_owner)
+            raise ValueError(
+                f"handoff content-hash mismatch on {len(bad)} of "
+                f"{len(slots)} store slots ({bad}) — segment bytes "
+                "corrupted; refusing to serve them")
+        self.store.retag(slots, from_owner, self.owner)
+        return slots
+
     def free_slots(self, slots: Sequence[int]) -> None:
-        """Return slots to the (sorted) free list, bumping each slot's
-        generation so stale staged transfers can never resolve. A slot
-        with a spill copy still in flight is joined first — a freed
-        (and possibly re-spilled) slot must never be written by a
-        worker job from its previous tenancy."""
+        """Return slots to the tier, bumping each slot's generation so
+        stale staged transfers can never resolve. A slot with a spill
+        copy still in flight is joined first — a freed (and possibly
+        re-spilled) slot must never be written by a worker job from its
+        previous tenancy. Store mode releases this engine's REFS: the
+        slot is actually reclaimed only when no sibling, transfer, or
+        index reference remains."""
+        if self.store is not None:
+            for s in slots:
+                self._wait_slot(s)
+            if slots:
+                self.store.release(list(slots), self.owner)
+            return
         for s in slots:
             self._wait_slot(s)
             if s not in self._hash:
@@ -900,16 +1797,22 @@ class KVCachePool:
         return self.prefix_cache
 
     def enable_host_tier(self, max_pages: int, metrics=None,
-                         async_spill: bool = False) -> HostKVTier:
+                         async_spill: bool = False, store=None,
+                         owner: str = "engine") -> HostKVTier:
         """Turn on the host-RAM offload tier (ISSUE 10, idempotent):
         preemption spills exclusively-owned pages to pinned host
         buffers, and prefix-cache eviction demotes cached pages through
         evict_hook instead of dropping them. `async_spill` (ISSUE 11
         satellite) moves the blocking device->host copy of each spill
-        onto a worker thread."""
+        onto a worker thread. `store` (ISSUE 14) backs the tier with a
+        host-wide SharedKVStore under this engine's `owner` tag instead
+        of private buffers — spills publish tier-wide, admission
+        matches against every replica's demotions, and handoffs move
+        slot references instead of bytes."""
         if self.host_tier is None:
             self.host_tier = HostKVTier(self, max_pages, metrics=metrics,
-                                        async_spill=async_spill)
+                                        async_spill=async_spill,
+                                        store=store, owner=owner)
             if self.prefix_cache is not None:
                 self.prefix_cache.evict_hook = self.host_tier.on_evict
         return self.host_tier

@@ -388,10 +388,12 @@ class EngineClient:
 
     def init(self, spec: dict, engine_kw: dict,
              snapshot: Optional[dict] = None,
+             store: Optional[dict] = None,
              init_timeout_s: Optional[float] = None) -> None:
         reply, _ = self._call(
             {"cmd": "init", "spec": spec, "engine_kw": engine_kw,
-             "index": self.rank, "snapshot": snapshot},
+             "index": self.rank, "snapshot": snapshot,
+             "store": store},
             timeout=init_timeout_s or max(self.command_timeout_s, 300.0))
         self.pool.block_size = int(reply["block_size"])
         self.max_batch_size = int(reply["max_batch_size"])
@@ -576,10 +578,16 @@ class ReplicaLauncher:
                  command_timeout_s: float = 120.0,
                  rpc_fast_timeout_s: float = 30.0,
                  rpc_max_retries: int = 2,
-                 env: Optional[dict] = None):
+                 env: Optional[dict] = None,
+                 store_spec: Optional[dict] = None):
         import json as _json
 
         self.spec = dict(spec)
+        # cluster-wide KV attach info (ISSUE 14): {"attach": segment
+        # map, "addr": [host, port] of the router's StoreServer} — each
+        # child's init command carries it plus the child's unique owner
+        # tag (its launcher key), which is the store ATTACH RPC
+        self.store_spec = store_spec
         try:
             _json.dumps(self.spec)
             self.engine_kw = _json.loads(_json.dumps(engine_kw))
@@ -659,11 +667,17 @@ class ReplicaLauncher:
         kw = dict(engine_kw if engine_kw is not None else self.engine_kw)
         kw["role"] = role
         try:
-            client.init(self.spec, kw, snapshot=snapshot)
+            client.init(self.spec, kw, snapshot=snapshot,
+                        store=self._store_for(key))
         except BaseException:
             client.kill()
             raise
         return client
+
+    def _store_for(self, key: str) -> Optional[dict]:
+        if self.store_spec is None:
+            return None
+        return {**self.store_spec, "owner": key}
 
     def _client(self, proc, sock, rank, key) -> EngineClient:
         return EngineClient(proc, sock, rank, key,
@@ -721,7 +735,8 @@ class ReplicaLauncher:
                 kw["role"] = role
                 client.init(self.spec, kw,
                             snapshot=(snapshots[rank] if snapshots
-                                      else None))
+                                      else None),
+                            store=self._store_for(client.key))
             return clients
         except BaseException:
             for proc, _ in procs:

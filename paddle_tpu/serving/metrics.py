@@ -105,7 +105,8 @@ SUMMABLE_KEYS = (
     "offload_resumes", "offload_recompute_fallbacks", "host_tier_drops",
     "host_tier_bytes",
     "handoffs_out", "handoffs_in", "handoff_pages_out", "handoff_pages_in",
-    "handoff_recompute_fallbacks",
+    "handoff_recompute_fallbacks", "handoff_bytes_out",
+    "store_hit_pages", "store_dedup_pages",
     "decode_steps", "queue_depth", "running", "pool_used_pages",
 )
 
@@ -250,6 +251,17 @@ class EngineMetrics:
         self.handoff_pages_in = Counter("handoff_pages_in")
         self.handoff_recompute_fallbacks = Counter(
             "handoff_recompute_fallbacks")
+        # cluster-wide KV store (ISSUE 14): handoff_bytes_out counts
+        # raw page-payload bytes a handoff actually serialized (the
+        # byte-copy path; slot-reference handoffs over the shared
+        # store add ZERO here — the number the bench arms compare);
+        # store_hit_pages counts pages this engine paged in from the
+        # host-wide content index (a sibling's demotion served this
+        # replica), store_dedup_pages counts copies skipped because
+        # the chain was already store-resident
+        self.handoff_bytes_out = Counter("handoff_bytes_out")
+        self.store_hit_pages = Counter("store_hit_pages")
+        self.store_dedup_pages = Counter("store_dedup_pages")
         self.decode_steps = Counter("decode_steps")
         self.queue_depth = Gauge("queue_depth")
         self.running = Gauge("running")
@@ -371,6 +383,9 @@ class EngineMetrics:
             "handoff_pages_in": self.handoff_pages_in.value,
             "handoff_recompute_fallbacks":
                 self.handoff_recompute_fallbacks.value,
+            "handoff_bytes_out": self.handoff_bytes_out.value,
+            "store_hit_pages": self.store_hit_pages.value,
+            "store_dedup_pages": self.store_dedup_pages.value,
             "decode_steps": self.decode_steps.value,
             "queue_depth": self.queue_depth.value,
             "queue_depth_peak": self.queue_depth.peak,

@@ -92,6 +92,7 @@ class ReplicaServer:
 
     def __init__(self):
         self.engine = None
+        self._kv_store = None       # SharedKVStoreClient when attached
         self.steps = 0
         # finished outputs the parent has ACKED (ISSUE 13): outputs are
         # re-shipped in every reply until the parent acks them in a
@@ -154,11 +155,31 @@ class ReplicaServer:
                                  **header["spec"].get("factory_kw", {}))
             except TypeError:       # index-blind factories are fine too
                 runner = factory(**header["spec"].get("factory_kw", {}))
+            # cluster-wide KV attach (ISSUE 14): map the router's
+            # shared-memory segments and open the metadata channel —
+            # this engine's host tier then IS the host-wide store,
+            # under this child's unique owner tag
+            store_info = header.get("store")
+            kv_store = kv_owner = None
+            if store_info is not None:
+                from paddle_tpu.serving.store_service import (
+                    SharedKVStoreClient,
+                )
+
+                kv_store = SharedKVStoreClient(store_info["attach"],
+                                               store_info["addr"])
+                kv_owner = store_info.get("owner")
+                self._kv_store = kv_store
             snap = header.get("snapshot")
             if snap is not None:
-                self.engine = ServingEngine.restore(runner, snap)
+                self.engine = ServingEngine.restore(
+                    runner, snap, kv_store=kv_store,
+                    kv_store_owner=kv_owner)
             else:
-                self.engine = ServingEngine(runner, **header["engine_kw"])
+                self.engine = ServingEngine(runner,
+                                            kv_store=kv_store,
+                                            kv_store_owner=kv_owner,
+                                            **header["engine_kw"])
             return self._reply(
                 block_size=self.engine.pool.block_size,
                 max_batch_size=self.engine.max_batch_size,

@@ -512,7 +512,59 @@ def audit_engine(engine) -> None:
     #    of spilled slots is content-hash spot-checked so a corrupted
     #    host buffer is caught before it is ever paged back in.
     tier = getattr(engine.pool, "host_tier", None)
-    if tier is not None:
+    if tier is not None and getattr(tier, "store", None) is not None:
+        # cluster-wide store mode (ISSUE 14): slot populations are
+        # TIER-WIDE (audit_store checks the partition/refcount/index
+        # invariants and runs the rotating CRC spot check); here we
+        # check THIS engine's view — every slot an offload record, a
+        # pending page-in, or a staged handoff names must carry at
+        # least the matching number of this engine's owner refs, and
+        # no pending page-in survives the step fence. The per-engine
+        # device-XOR-host check is deliberately GONE: the shared index
+        # legitimately mirrors device-live hashes (promotion keeps the
+        # store copy serving siblings).
+        if hasattr(tier, "sync"):
+            tier.sync()
+        store = tier.store
+        need: dict = {}
+        for req in sched.waiting:
+            off = getattr(req, "offload", None)
+            if off is not None:
+                if req.phase != "offloaded":
+                    problems.append(
+                        f"{req.request_id} holds an offload record but "
+                        f"phase={req.phase!r}")
+                for s in off.slots:
+                    need[s] = need.get(s, 0) + 1
+            elif req.phase == "offloaded":
+                problems.append(f"{req.request_id} phase 'offloaded' "
+                                "without an offload record")
+        for rid, hrec in getattr(engine, "_handoffs", {}).items():
+            if hrec is None:
+                continue
+            for s in hrec.slots:
+                need[s] = need.get(s, 0) + 1
+        for req in sched.running:
+            if getattr(req, "offload", None) is not None:
+                problems.append(f"{req.request_id} RUNNING with an "
+                                "offload record")
+            if getattr(req, "pending_pagein", None):
+                problems.append(f"{req.request_id} pending page-ins "
+                                "survived the step fence")
+        owner = tier.owner
+        for s, cnt in need.items():
+            have = store.owner_count(s, owner)
+            if have < cnt:
+                problems.append(
+                    f"store slot {s}: engine {owner!r} references it "
+                    f"{cnt}x but holds only {have} store refs")
+        # local structural + content audit when the store object is in
+        # this process (thread backend / standalone engines); the
+        # process backend audits the store router-side
+        if getattr(store, "_lock", None) is not None:
+            problems.extend(store_audit_problems(
+                store, tick=int(engine.metrics.decode_steps.value)))
+    elif tier is not None:
         # threaded spill I/O (ISSUE 11): join any in-flight worker
         # copies first — slot contents and content hashes are only
         # defined once the copy lands, and the auditor must never race
@@ -621,6 +673,91 @@ def audit_engine(engine) -> None:
         raise InvariantViolation("; ".join(problems))
 
 
+def store_audit_problems(store, live_owners: Optional[set] = None,
+                         tick: int = 0, spot_checks: int = 4) -> list:
+    """Structural + content invariants of one SharedKVStore (ISSUE 14),
+    returned as a problem list (audit_engine folds them in; audit_store
+    raises). Checks, all under the store lock where it matters:
+
+      * free/used partition covers exactly range(max_pages), no dupes;
+      * prefix index <-> reverse map <-> indexed set are a bijection;
+      * every used slot is reachable: owner refs and/or the index ref
+        (refcount == live referencing engines + index ref — the
+        cross-engine ownership rule); a used slot nobody references is
+        a leak, a free slot somebody references is a corruption;
+      * with `live_owners`: every owner tag belongs to a live engine
+        incarnation or an in-flight transfer ("xfer:*") — a dead
+        replica's refs must have been reaped;
+      * a rotating `spot_checks`-slot window re-CRCs segment bytes
+        against the recorded content hashes, so silent shared-memory
+        corruption is caught before any replica serves it.
+    """
+    problems = []
+    with store._lock:
+        free = list(store._free)
+        fset = set(free)
+        owned = {s for s, o in store._owners.items() if o}
+        indexed = set(store._indexed)
+        used = owned | indexed
+        if len(free) != len(fset):
+            problems.append("duplicate slots in the store free list")
+        if fset & used:
+            problems.append(
+                f"store slots both free and referenced: "
+                f"{sorted(fset & used)}")
+        if (fset | used) != set(range(store.max_pages)):
+            lost = sorted(set(range(store.max_pages)) - fset - used)
+            foreign = sorted((fset | used)
+                             - set(range(store.max_pages)))
+            problems.append(f"store slot accounting broken: "
+                            f"lost={lost} foreign={foreign}")
+        stale_hash = sorted(set(store._hash) - used)
+        if stale_hash:
+            problems.append("store hash bookkeeping survives on "
+                            f"unreferenced slots: {stale_hash}")
+        if len(set(store._prefix.values())) != len(store._prefix):
+            problems.append("store prefix index maps two hashes to one "
+                            "slot")
+        if {s: h for h, s in store._prefix.items()} != store._prefix_slot:
+            problems.append("store prefix index and reverse map disagree")
+        if indexed != set(store._prefix.values()):
+            problems.append("store indexed-slot set disagrees with the "
+                            "prefix index")
+        for s, own in store._owners.items():
+            if any(c <= 0 for c in own.values()):
+                problems.append(f"store slot {s} holds a non-positive "
+                                f"owner count: {own}")
+        if live_owners is not None:
+            legit = set(live_owners)
+            for s, own in store._owners.items():
+                for o in own:
+                    if o not in legit and not str(o).startswith("xfer:"):
+                        problems.append(
+                            f"store slot {s} referenced by dead/unknown "
+                            f"owner {o!r} — reap leaked")
+        sample = sorted(s for s in used
+                        if store._hash.get(s) is not None)
+    if sample:
+        start = int(tick) % len(sample)
+        for i in range(min(spot_checks, len(sample))):
+            s = sample[(start + i) % len(sample)]
+            recorded = store.slot_hash(s)
+            if recorded is not None and store.content_hash(s) != recorded:
+                problems.append(
+                    f"store slot {s} content-hash mismatch — segment "
+                    "bytes corrupted")
+    return problems
+
+
+def audit_store(store, live_owners: Optional[set] = None,
+                tick: int = 0) -> None:
+    """Raise InvariantViolation on any broken SharedKVStore invariant
+    (see store_audit_problems)."""
+    problems = store_audit_problems(store, live_owners, tick)
+    if problems:
+        raise InvariantViolation("; ".join(problems))
+
+
 def audit_router(router) -> None:
     """Tier-level invariant auditor (ISSUE 8): every LIVE replica passes
     audit_engine, and the router's at-most-once bookkeeping is
@@ -655,6 +792,31 @@ def audit_router(router) -> None:
             # the supervisor, not an invariant violation
             logger.warning("replica %d unreachable mid-audit: %s",
                            rep.index, e)
+
+    store = getattr(router, "kv_store", None)
+    if store is not None and getattr(store, "_lock", None) is not None:
+        # cluster-wide store (ISSUE 14): every live replica's tier
+        # joins its pending spill copies, then the store's structural/
+        # ownership/content invariants are checked with the LIVE owner
+        # set — a dead replica's un-reaped refs are a violation
+        live_owners = set()
+        for rep in replicas:
+            if rep.status != "live":
+                continue
+            owner = getattr(rep, "store_owner", None)
+            if owner:
+                live_owners.add(owner)
+            t = getattr(getattr(rep.engine, "pool", None), "host_tier",
+                        None)
+            if t is not None and hasattr(t, "sync"):
+                try:
+                    with rep.lock:
+                        t.sync()
+                except BaseException:      # pragma: no cover — dying
+                    pass
+        problems.extend(store_audit_problems(
+            store, live_owners,
+            tick=int(router.metrics.requests_completed.value)))
 
     with router._lock:
         n = len(replicas)

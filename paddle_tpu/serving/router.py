@@ -307,6 +307,27 @@ class ServingRouter:
       journal_fsync        "always" | "interval" (default) | "never" —
                            see journal.RouterJournal
       journal_compact_every  appends between snapshot compactions
+      shared_kv_pages      cluster-wide KV (ISSUE 14): capacity, in
+                           pages, of ONE router-owned content-addressed
+                           SharedKVStore replacing every replica's
+                           private host tier. Spills/demotions from any
+                           engine publish into it (dedup by chain
+                           hash), admission on ANY replica resolves its
+                           prefix against it, handoffs/migrations move
+                           slot REFERENCES instead of page bytes, and a
+                           dead replica's slots are reaped by refcount.
+                           0 = off (private per-engine tiers via the
+                           host_tier_pages engine knob, the PR-10
+                           shape)
+      shared_kv_shm        back the store with multiprocessing shared-
+                           memory segments (None = auto: processes
+                           yes, threads no). Segments survive a router
+                           SIGKILL, so recover() can re-attach them and
+                           revive the journaled content index
+      shared_kv_geometry   process backend only: the pool page geometry
+                           ({num_layers, block_size, n_kv_heads,
+                           head_dim, dtype?, kv_dtype?}) — the router
+                           process holds no runner to derive it from
       rpc_fast_timeout_s   process backend: deadline for the FAST RPC
                            class (ping/metrics/audit/stats reads);
                            mutating RPCs use command_timeout_s
@@ -320,6 +341,9 @@ class ServingRouter:
                  policy: str = "prefix",
                  backend: str = "thread",
                  prefill_replicas: int = 0,
+                 shared_kv_pages: int = 0,
+                 shared_kv_shm: Optional[bool] = None,
+                 shared_kv_geometry: Optional[dict] = None,
                  max_queue_depth: Optional[int] = None,
                  shed_policy: str = "reject",
                  snapshot_every_steps: int = 1,
@@ -391,6 +415,13 @@ class ServingRouter:
         self._rng = np.random.default_rng(0)
         self._replicas: List[EngineReplica] = []
         self._launcher = None
+        # cluster-wide KV store (ISSUE 14)
+        self.shared_kv_pages = int(shared_kv_pages)
+        self._shared_kv_shm = shared_kv_shm
+        self._shared_kv_geometry = shared_kv_geometry
+        self.kv_store = None
+        self._store_server = None
+        self._owner_seq = itertools.count()
         # durable control plane (ISSUE 13): the write-ahead journal.
         # With _recover_state (the replayed view of a dead router's
         # journal) the file is compacted to one state record first, so
@@ -410,12 +441,18 @@ class ServingRouter:
             # `engine` here is an EngineClient proxy over its socket
             from paddle_tpu.serving.launch import ReplicaLauncher
 
+            # the cluster-wide store (ISSUE 14) must exist before any
+            # child spawns: its shared-memory segments + the metadata
+            # service address ride each child's init command
+            self._init_store(geometry=self._shared_kv_geometry,
+                             recover_state=_recover_state)
             self._launcher = ReplicaLauncher(
                 runner_factory, engine_kw,
                 rendezvous_timeout_s=rendezvous_timeout_s,
                 command_timeout_s=command_timeout_s,
                 rpc_fast_timeout_s=rpc_fast_timeout_s,
-                rpc_max_retries=rpc_max_retries, env=child_env)
+                rpc_max_retries=rpc_max_retries, env=child_env,
+                store_spec=self._store_attach_spec())
             snaps = ([recover_snaps.get(i) for i in range(replicas)]
                      if recover_snaps else None)
             for idx, client in enumerate(
@@ -426,7 +463,14 @@ class ServingRouter:
         else:
             for idx in range(replicas):
                 runner = self._make_runner(idx)
+                if idx == 0:
+                    # the store's page layout mirrors the pool's, so
+                    # the first runner fixes it (every replica must
+                    # share the model geometry — attach validates)
+                    self._init_store(runner=runner,
+                                     recover_state=_recover_state)
                 snap = recover_snaps.get(idx)
+                owner = self._mint_owner(idx)
                 if snap is not None:
                     # router recovery (ISSUE 13): the replica restarts
                     # from its last JOURNALED crash-safe snapshot —
@@ -437,9 +481,11 @@ class ServingRouter:
                         runner, snap,
                         tokenizer=engine_kw.get("tokenizer"),
                         sleep_fn=engine_kw.get("sleep_fn"),
-                        audit=engine_kw.get("audit"))
+                        audit=engine_kw.get("audit"),
+                        kv_store=self.kv_store, kv_store_owner=owner)
                 else:
-                    engine = self._build_engine(runner, self._roles[idx])
+                    engine = self._build_engine(runner, self._roles[idx],
+                                                store_owner=owner)
                 self._spawn(idx, engine, runner, start=False,
                             role=self._roles[idx])
         self.block_size = self._replicas[0].engine.pool.block_size
@@ -587,6 +633,96 @@ class ServingRouter:
                     except BaseException:    # pragma: no cover
                         pass
 
+    # ---------------------------------------- cluster-wide KV (ISSUE 14)
+
+    def _mint_owner(self, idx: int) -> Optional[str]:
+        """Store owner tag for one engine INCARNATION — unique per
+        (replica, restart), so a respawned replica can never be
+        confused with its dead predecessor's un-reaped refs."""
+        if not self.shared_kv_pages:
+            return None
+        return f"r{idx}o{next(self._owner_seq)}"
+
+    def _init_store(self, runner=None, geometry=None,
+                    recover_state: Optional[dict] = None) -> None:
+        """Build (or, on recovery, RE-ATTACH) the host-wide store.
+        Shared-memory segments survive a router SIGKILL until unlinked,
+        so recover() maps the dead router's segments back in and
+        revives the journaled content index — every entry CRC-verified
+        against the surviving bytes before it serves again; anything
+        that fails the check silently recomputes."""
+        from paddle_tpu.serving.kv_cache import SharedKVStore
+
+        old_spec = (recover_state or {}).get("store")
+        if not self.shared_kv_pages:
+            if old_spec:               # dead store we will not revive
+                SharedKVStore.unlink_spec(old_spec)
+            return
+        use_shm = (self._shared_kv_shm if self._shared_kv_shm is not None
+                   else self.backend == "process")
+        store, revived = None, 0
+        if old_spec and use_shm:
+            try:
+                store = SharedKVStore.reattach(old_spec)
+                revived = store.restore_index(
+                    (recover_state or {}).get("store_idx"))
+                logger.info("recover: reattached shared KV store "
+                            "(%d/%d journaled prefix pages revived)",
+                            revived, len(((recover_state or {})
+                                          .get("store_idx") or {})
+                                         .get("prefix", ())))
+            except BaseException as e:
+                logger.warning("recover: store reattach failed (%s); "
+                               "starting fresh", e)
+                SharedKVStore.unlink_spec(old_spec)
+                store = None
+        elif old_spec:
+            SharedKVStore.unlink_spec(old_spec)
+        if store is None:
+            if geometry is not None:
+                store = SharedKVStore.for_geometry(
+                    geometry, self.shared_kv_pages, use_shm=use_shm)
+            elif runner is not None:
+                store = SharedKVStore.for_runner(
+                    runner, self.shared_kv_pages, use_shm=use_shm)
+            else:
+                raise ValueError(
+                    "shared_kv_pages with backend='process' needs "
+                    "shared_kv_geometry={num_layers, block_size, "
+                    "n_kv_heads, head_dim, dtype?, kv_dtype?} — the "
+                    "router process holds no runner to derive the "
+                    "page layout from")
+        self.kv_store = store
+        self._jot({"t": "store", "spec": store.attach_spec()})
+        if self.backend == "process":
+            from paddle_tpu.serving.store_service import StoreServer
+
+            self._store_server = StoreServer(store)
+
+    def _store_attach_spec(self) -> Optional[dict]:
+        """What a replica child needs to join the store: the segment
+        map plus the metadata service address (launch.py ships it in
+        the init command — the attach RPC)."""
+        if self.kv_store is None or self._store_server is None:
+            return None
+        return {"attach": self.kv_store.attach_spec(),
+                "addr": list(self._store_server.address)}
+
+    def _reap_store_owner(self, rep: "EngineReplica") -> int:
+        """Release every store ref a dead/drained replica incarnation
+        still holds — slots are reclaimed by refcount (indexed content
+        and siblings' refs survive), never leaked."""
+        if self.kv_store is None:
+            return 0
+        owner = getattr(rep, "store_owner", None)
+        if not owner:
+            return 0
+        freed = self.kv_store.reap_owner(owner)
+        if freed:
+            logger.info("reaped %d store slots from dead replica %d "
+                        "(owner %s)", freed, rep.index, owner)
+        return freed
+
     # --------------------------------------------------------- plumbing
 
     def _make_runner(self, idx: int):
@@ -596,8 +732,13 @@ class ServingRouter:
             # zero-arg factories are fine too (index-blind replicas)
             return self._runner_factory()
 
-    def _build_engine(self, runner, role: str = "mixed") -> ServingEngine:
-        return ServingEngine(runner, role=role, **self._engine_kw)
+    def _build_engine(self, runner, role: str = "mixed",
+                      store_owner: Optional[str] = None) -> ServingEngine:
+        kw = dict(self._engine_kw)
+        if self.kv_store is not None:
+            kw["kv_store"] = self.kv_store
+            kw["kv_store_owner"] = store_owner
+        return ServingEngine(runner, role=role, **kw)
 
     def _revive_engine(self, rep: "EngineReplica",
                        snapshot: Optional[dict]):
@@ -615,12 +756,15 @@ class ServingRouter:
             return client, None
         runner = self._make_runner(rep.index)
         kw = self._engine_kw
+        owner = self._mint_owner(rep.index)
         if snapshot is not None:
             engine = ServingEngine.restore(
                 runner, snapshot, tokenizer=kw.get("tokenizer"),
-                sleep_fn=kw.get("sleep_fn"), audit=kw.get("audit"))
+                sleep_fn=kw.get("sleep_fn"), audit=kw.get("audit"),
+                kv_store=self.kv_store, kv_store_owner=owner)
         else:
-            engine = self._build_engine(runner, rep.role)
+            engine = self._build_engine(runner, rep.role,
+                                        store_owner=owner)
         return engine, runner
 
     def _replica_dead(self, rep: "EngineReplica") -> bool:
@@ -655,6 +799,14 @@ class ServingRouter:
                             self._clock(),
                             role=role if role is not None
                             else self._roles[idx])
+        if self.kv_store is not None:
+            # the engine incarnation's store owner tag — the process
+            # backend uses the launcher key (unique per spawn), threads
+            # the minted tag the engine was built with
+            rep.store_owner = (getattr(engine, "key", None)
+                               or getattr(engine, "kv_store_owner", None))
+        else:
+            rep.store_owner = None
         with self._lock:
             if idx == len(self._replicas):
                 self._replicas.append(rep)
@@ -736,6 +888,13 @@ class ServingRouter:
                         # replica from its LAST journaled snapshot
                         self._jot({"t": "snap", "rep": rep.index,
                                    "snapshot": rep.last_snapshot})
+                        if self.kv_store is not None:
+                            # the store's content index rides beside
+                            # the snapshots: recover() revives it over
+                            # surviving shm segments, CRC-verified
+                            self._jot({
+                                "t": "store_idx",
+                                "state": self.kv_store.journal_state()})
                     stepped = True
             if rep.role == "prefill" and not rep.fenced and not rep.stop:
                 # disaggregated split (ISSUE 12): migrate every staged
@@ -1220,6 +1379,11 @@ class ServingRouter:
                                "resubmission", rec.request_id,
                                target.index, e)
             self.metrics.handoff_fallbacks.inc()
+            if self.kv_store is not None:
+                # the transfer tag's refs must not outlive the failed
+                # handoff (idempotent: an adopt/verify failure inside
+                # import_handoff already released them)
+                self.kv_store.reap_owner(f"xfer:{rec.request_id}")
             fallback = self._choose_decode()
             with self._lock:
                 live = [r for r in self._replicas if r.status == "live"]
@@ -1333,6 +1497,21 @@ class ServingRouter:
         moved = 0
         for rec in self._orphans(rep.index, rep.epoch):
             moved += self._migrate_out(rep, rec)
+        if self.kv_store is not None:
+            # cluster-wide KV (ISSUE 14): a DRAINING replica demotes
+            # its whole device prefix cache into the shared store
+            # (clear() fires evict_hook per page -> publish, dedup'd)
+            # before dying, so the sessions it served resume on any
+            # sibling by page-in instead of recompute — the zero-
+            # recompute rolling restart. Residual owner refs (nothing
+            # should remain after migration) are reaped by refcount.
+            try:
+                with rep.lock:
+                    rep.engine.release_prefix_cache()
+            except BaseException:        # pragma: no cover — dying
+                pass
+            self._jot({"t": "store_idx",
+                       "state": self.kv_store.journal_state()})
         # the drained engine's counters join tier history, like a
         # supervisor recovery's would
         try:
@@ -1351,6 +1530,7 @@ class ServingRouter:
                               if i != idx}
             self._sessions = {s: i for s, i in self._sessions.items()
                               if i != idx}
+        self._reap_store_owner(rep)
         self.metrics.replica_drains.inc()
         self._completion.set()
         logger.info("replica %d drained (%d requests migrated)",
@@ -1371,7 +1551,8 @@ class ServingRouter:
                                                   role=rep.role), None
         else:
             runner = self._make_runner(idx)
-            engine = self._build_engine(runner, rep.role)
+            engine = self._build_engine(runner, rep.role,
+                                        store_owner=self._mint_owner(idx))
         new = self._spawn(idx, engine, runner, start=False,
                           role=rep.role)
         for rec in self._orphans(idx, old_epoch):
@@ -1497,6 +1678,8 @@ class ServingRouter:
                "per_replica": per}
         if self._journal is not None:
             out["journal"] = self._journal.stats()
+        if self.kv_store is not None:
+            out["store"] = self.kv_store.stats()
         return out
 
     # --------------------------------------------------------- teardown
@@ -1541,6 +1724,12 @@ class ServingRouter:
                 self._launcher.close()
         if self._journal is not None:
             self._journal.close()
+        if self._store_server is not None:
+            self._store_server.close()
+            self._store_server = None
+        if self.kv_store is not None:
+            self.kv_store.close()
+            self.kv_store = None
 
     def __enter__(self) -> "ServingRouter":
         return self

@@ -300,19 +300,28 @@ class FCFSScheduler:
                 req.kv.adopt_prefix(matched, bs)
             # host-demoted prefix pages: a fresh device page per hash,
             # content restored by the engine's fence before this step's
-            # compute; the page re-enters the device index (promotion)
+            # compute; the page re-enters the device index (promotion).
+            # With a shared store (ISSUE 14) promote() takes a tier-wide
+            # reference and may MISS — a sibling's recomputed
+            # registration dropped the entry between match and promote —
+            # in which case the chain truncates here and the remaining
+            # tokens recompute (exactness untouched)
+            promoted = 0
             for h in host_matched:
-                page = alloc.alloc(1)[0]
                 slot = tier.promote(h)
+                if slot is None:
+                    break
+                page = alloc.alloc(1)[0]
                 cache.register_page(page, h)
                 req.kv.pages.append(page)
                 req.kv.hash_chain.append(h)
                 req.kv.registered_pages += 1
                 req.kv.num_tokens = len(req.kv.pages) * bs
                 req.pending_pagein.append((page, slot))
+                promoted += 1
             req.admit_prefix_tokens = req.kv.num_tokens
             req.admit_pagein_tokens = 0
-            m_total = len(matched) + len(host_matched)
+            m_total = len(matched) + promoted
             off, req.offload = req.offload, None
             if off is not None and tier is not None:
                 connected = (m_total >= off.start_page

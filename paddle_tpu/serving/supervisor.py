@@ -177,6 +177,12 @@ class Supervisor:
             router._retired_metrics.append(rep.engine.metrics.snapshot())
         except BaseException:            # pragma: no cover
             pass
+        # cluster-wide KV (ISSUE 14): release every store ref the dead
+        # incarnation held — its offload/transfer slots are reclaimed by
+        # refcount; content the INDEX owns (published prefixes) and any
+        # sibling's refs survive, so the store never leaks a dead
+        # replica's slots and never loses shared pages to its death
+        router._reap_store_owner(rep)
         orphans = router._orphans(rep.index, rep.epoch)
         if self.max_restarts is not None \
                 and self.restarts >= self.max_restarts:
@@ -234,6 +240,7 @@ class Supervisor:
         requests re-route to the survivors (or fail loudly with reason
         'error' when none remain) — degraded, never wedged."""
         rep.status = "retired"
+        self.router._reap_store_owner(rep)
         with self.router._lock:
             self.router.metrics.live_replicas.set(
                 sum(1 for r in self.router._replicas

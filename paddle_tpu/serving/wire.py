@@ -261,9 +261,25 @@ def handoff_to_wire(payload: Optional[dict]
     frames): per layer, per pool array, one stacked [n_slots, ...]
     binary frame — raw page bytes + scale rows in pool order, with the
     per-slot content hashes in the header for receive-time
-    verification."""
+    verification.
+
+    A slot-REFERENCE payload (ISSUE 14: sender and receiver share one
+    SharedKVStore, so the bytes already live host-wide) serializes to
+    the HEADER ALONE — slot ids, generations, CRCs, the transfer tag —
+    and ZERO binary frames: handoff page bytes cross the wire once per
+    host (when first spilled into the store), not once per decode
+    replica."""
     if payload is None:
         return {"handoff": None}, []
+    if payload.get("slot_refs") is not None:
+        return {"handoff": {
+            "start_page": payload["start_page"],
+            "covered_tokens": payload["covered_tokens"],
+            "slot_refs": [int(s) for s in payload["slot_refs"]],
+            "gens": [int(g) for g in payload["gens"]],
+            "hashes": [int(h) for h in payload["hashes"]],
+            "xfer_owner": payload["xfer_owner"],
+        }}, []
     bufs: List[np.ndarray] = []
     for layer in payload["layers"]:
         bufs.extend(layer)
@@ -281,6 +297,13 @@ def handoff_from_wire(header: dict,
     meta = header.get("handoff")
     if meta is None:
         return None
+    if meta.get("slot_refs") is not None:
+        return {"start_page": meta["start_page"],
+                "covered_tokens": meta["covered_tokens"],
+                "slot_refs": list(meta["slot_refs"]),
+                "gens": list(meta["gens"]),
+                "hashes": list(meta["hashes"]),
+                "xfer_owner": meta["xfer_owner"]}
     per = meta["arrays_per_layer"]
     layers = [tuple(bufs[li * per + j] for j in range(per))
               for li in range(meta["num_layers"])]

@@ -20,8 +20,11 @@ def _img(n=1, size=96):
 @pytest.mark.parametrize("ctor,kw", [
     ("alexnet", {}),
     ("squeezenet1_1", {}),
-    ("densenet121", {}),
-    ("googlenet", {}),
+    # the two heaviest zoo builds (~20s + ~15s compile-bound) ride the
+    # slow suite to keep tier-1 inside its 870s budget — same move as
+    # the auto_tuner grid test; coverage is unchanged, just re-tiered
+    pytest.param("densenet121", {}, marks=pytest.mark.slow),
+    pytest.param("googlenet", {}, marks=pytest.mark.slow),
     ("inception_v3", {}),
     ("shufflenet_v2_x1_0", {}),
     ("mobilenet_v1", {"scale": 0.5}),

@@ -155,6 +155,31 @@ DURABILITY / NETWORK CHAOS drill:
 
 All classes must end RECOVERED with zero lost/duplicated tokens.
 
+ISSUE 14: `--shared-kv [N]` (N store pages, default 64) switches to the
+CLUSTER-WIDE KV drill: 2 thread replicas share ONE router-owned
+content-addressed SharedKVStore (shm-backed for the router_kill class).
+Session turns run, the tier rolling-restarts (draining replicas demote
+their device prefix caches into the store), and turn 2 resumes through
+the store on whichever replica routing picks. Classes:
+
+    none          baseline: token-exact both turns, store hits > 0,
+                  tier-wide audit green
+    replica_kill  a replica dies with store-resident pages (offload +
+                  page-in refs live): supervisor recovery reaps its
+                  refs by refcount — INDEX-owned content survives for
+                  the siblings, nothing leaks, streams token-exact
+    router_kill   the whole router dies mid-stream (workers fenced,
+                  journal closed); ServingRouter.recover reattaches
+                  the SURVIVING shared-memory segments, revives the
+                  journaled store index (each entry CRC-verified
+                  against the surviving bytes), and finishes
+                  token-exact with the revived pages serving turn 2
+    corrupt_slot  a published slot's segment bytes are flipped: the
+                  armed rotating CRC spot check must TRIP, scrub()
+                  drops the corrupted entry, and the affected session
+                  turn recomputes — token-exact, corruption never
+                  served
+
 ISSUE 5: `--speculate [K]` (K defaults to 4) drills every fault class
 with speculative decoding ON: decode rides n-gram verify spans through
 the full-logits ragged call — the same decode-op fault schedules now
@@ -490,6 +515,158 @@ def run_router_class(fault: str, runner, args) -> dict:
         "prefix_hit_tokens": agg["prefix_hit_tokens"],
         "step_retries": agg["step_retries"],
         "preemptions": agg["preemptions"],
+    }
+
+
+SHARED_KV_FAULTS = ("none", "replica_kill", "router_kill", "corrupt_slot")
+
+
+def run_shared_kv_class(fault: str, runner, args) -> dict:
+    """One cluster-wide-KV fault class (ISSUE 14): 2 thread replicas
+    over ONE SharedKVStore, a session workload whose turn-2 resumes
+    ride the store across a rolling restart, and a fault injected at
+    the store's weakest moment for the class."""
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    from paddle_tpu.serving import (
+        SamplingParams, ServingRouter, audit_router, naive_generate,
+    )
+    from paddle_tpu.serving.resilience import InvariantViolation, audit_store
+
+    rng = np.random.default_rng(0)
+    vocab = runner.vocab_size
+    jp = (tempfile.mktemp(suffix=".jsonl") if fault == "router_kill"
+          else None)
+    rkw = dict(replicas=2, num_blocks=args.num_blocks,
+               max_batch_size=args.max_batch,
+               max_model_len=args.max_model_len, max_step_retries=2,
+               retry_backoff_s=0.001, audit=True,
+               enable_prefix_cache=True,
+               max_prefill_tokens_per_step=args.chunk or None,
+               heartbeat_timeout_s=0.25, poll_interval_s=0.05,
+               shared_kv_pages=args.shared_kv, snapshot_every_steps=1)
+    if jp is not None:
+        rkw.update(journal_path=jp, journal_fsync="always",
+                   shared_kv_shm=True)
+    router = ServingRouter(lambda idx: runner, **rkw)
+    header = list(rng.integers(1, vocab, 2 * args.block_size))
+    work = []
+    t2 = []
+    outs1 = {}
+    crashed = None
+    recovery = {}
+    dead_owner = None
+    try:
+        for i in range(args.requests):
+            plen = int(rng.integers(2, 8))
+            prompt = header + list(rng.integers(1, vocab, plen))
+            sp = SamplingParams(
+                max_tokens=int(rng.integers(3, args.max_tokens)),
+                session_id=f"s{i}")
+            work.append((router.submit(prompt, sp), prompt, sp))
+        outs1 = router.drain(timeout_s=120.0)
+        audit_router(router)
+        # every session's turn-1 KV reaches the store: cycle the tier
+        # (draining replicas demote their device caches tier-wide)
+        router.rolling_restart()
+        store = router.kv_store
+        recovery["store_prefix_pages"] = store.prefix_count
+        if fault == "corrupt_slot":
+            victim = next(iter(store._prefix.values()))
+            store.bufs[0][0][victim] += 1.0
+            tripped = False
+            try:
+                audit_store(store)
+            except InvariantViolation:
+                tripped = True
+            recovery["spot_check_tripped"] = tripped
+            recovery["scrubbed"] = store.scrub()
+        # turn 2: resume through the store on whatever replica routing
+        # picks (the corrupted entry, if any, recomputes instead)
+        t2 = []
+        for i, (rid, p, sp) in enumerate(work):
+            p2 = p + outs1[rid].output_tokens
+            sp2 = SamplingParams(max_tokens=4, session_id=f"s{i}")
+            t2.append((router.submit(p2, sp2), p2, sp2))
+        if fault == "replica_kill":
+            dead = router._replicas[0]
+            dead_owner = dead.store_owner
+            router.kill_replica(0)
+        elif fault == "router_kill":
+            # the router dies mid-turn-2: fence every worker, close
+            # the journal, recover from journal + surviving segments
+            for rep in router._replicas:
+                rep.fenced = True
+                rep.stop = True
+                rep.wake.set()
+            router.supervisor.stop()
+            router._journal.close()
+            t0 = _time.time()
+            rkw2 = {k: v for k, v in rkw.items()
+                    if k != "journal_path"}
+            router = ServingRouter.recover(lambda idx: runner, jp,
+                                           **rkw2)
+            recovery["router_recovery_s"] = round(_time.time() - t0, 3)
+            recovery["store_index_revived"] = \
+                router.kv_store.prefix_count
+        outs = router.drain(timeout_s=120.0)
+        audit_router(router)
+    except Exception as e:      # must never happen — that's the point
+        crashed = f"{type(e).__name__}: {e}"
+        outs = router.outputs()
+
+    rm = router.metrics.snapshot()
+    agg = router.metrics_snapshot()["engines"]
+    sstats = (router.kv_store.stats()
+              if router.kv_store is not None else {})
+    owners = (router.kv_store.owners_snapshot()
+              if router.kv_store is not None else {})
+    reaped_clean = all(dead_owner not in own for own in owners.values()) \
+        if dead_owner else True
+    router.release_prefix_caches()
+    leaks_ok = router.check_no_leaks()
+
+    oracle_ok = True
+    for rid, prompt, sp in work + t2:
+        o = outs.get(rid) or outs1.get(rid)
+        if o is None or o.output_tokens != naive_generate(
+                runner, prompt, sp, max_model_len=args.max_model_len):
+            oracle_ok = False
+            break
+    router.shutdown()
+    if jp is not None and os.path.exists(jp):
+        os.unlink(jp)
+
+    ok = (crashed is None and leaks_ok and oracle_ok and reaped_clean
+          and all(o.finish_reason for o in outs.values())
+          and recovery.get("store_prefix_pages", 0) > 0
+          and agg["store_hit_pages"] > 0
+          and (fault != "replica_kill" or rm["replica_restarts"] >= 1)
+          and (fault != "router_kill"
+               or recovery.get("store_index_revived", 0) > 0)
+          and (fault != "corrupt_slot"
+               or (recovery.get("spot_check_tripped")
+                   and recovery.get("scrubbed", 0) >= 1)))
+    return {
+        "fault": f"shared_kv_{fault}", "ok": ok,
+        "requests": len(work) + len(t2),
+        "no_unhandled_exception": crashed is None, "crash": crashed,
+        "oracle_token_equal": oracle_ok,
+        "pages_leaked": not leaks_ok,
+        "dead_owner_reaped": reaped_clean,
+        "store_hit_pages": agg["store_hit_pages"],
+        "store_dedup_pages": agg["store_dedup_pages"],
+        "handoff_bytes_out": agg["handoff_bytes_out"],
+        "rolling_restarts": rm["rolling_restarts"],
+        "replica_restarts": rm["replica_restarts"],
+        "drain_migrations": rm["drain_migrations"],
+        **{k: sstats.get(k, 0.0) for k in
+           ("store_published_pages", "store_prefix_hits",
+            "store_reaped_slots", "store_evictions")},
+        **recovery,
     }
 
 
@@ -956,6 +1133,12 @@ def main() -> int:
                          "tokens per verify span (bare flag: K=4; "
                          "default: off) — half the prompts become "
                          "periodic so proposals fire")
+    ap.add_argument("--shared-kv", type=int, nargs="?", const=64,
+                    default=0, metavar="N",
+                    help="ISSUE 14: cluster-wide KV drill — 2 thread "
+                         "replicas over ONE shared content-addressed "
+                         "store of N pages (default 64); classes none/"
+                         "replica_kill/router_kill/corrupt_slot")
     ap.add_argument("--offload", type=int, nargs="?", const=64, default=0,
                     metavar="N",
                     help="tiered KV host offload (ISSUE 10): an N-page "
@@ -1095,6 +1278,19 @@ def main() -> int:
             all_ok &= rec["ok"]
             print(json.dumps(rec))
         print(f"\nfault smoke (procs x{args.procs}): "
+              f"{'ALL RECOVERED' if all_ok else 'FAILURES'}")
+        return 0 if all_ok else 1
+    if args.shared_kv:
+        # ISSUE 14 cluster-wide KV drill (--faults filters:
+        # `--shared-kv --faults router_kill,corrupt_slot`)
+        classes = (SHARED_KV_FAULTS if args.faults == ",".join(FAULTS)
+                   else [f for f in args.faults.split(",")
+                         if f in SHARED_KV_FAULTS])
+        for fault in classes:
+            rec = run_shared_kv_class(fault, runner, args)
+            all_ok &= rec["ok"]
+            print(json.dumps(rec))
+        print(f"\nfault smoke (shared-kv x{args.shared_kv} pages): "
               f"{'ALL RECOVERED' if all_ok else 'FAILURES'}")
         return 0 if all_ok else 1
     if args.router >= 2:
