@@ -680,6 +680,156 @@ def child_serving_kvq(layers: int, hidden: int, max_batch: int,
     })
 
 
+def child_serving_quant_comm(layers: int, hidden: int, max_batch: int,
+                             requests: int, prompt: int, gen: int,
+                             vocab: int):
+    """Quantized-collectives + fp8-KV rung (ISSUE 15): the tp=2
+    long-context GQA-Llama workload run in FOUR arms — fp32 baseline,
+    int8-psum (comm_dtype="int8": the chunked two-level quantized
+    reduce behind the SpecLayout row-parallel hook), fp8-kv
+    (kv_dtype="fp8": native float8_e4m3fn pages, scale-free casts),
+    and both rungs together. Each arm commits tokens/s, the
+    instrumented per-shard `tp_comm_bytes` (scale bytes counted — the
+    comm reduction is measured, never an assumed 4x) and
+    `attn_kv_bytes_read` (the KV-bytes reduction), and the
+    teacher-forced accuracy record vs the fp32 TP arm: mean |dlogit|,
+    top-5 overlap, greedy agreement — the three acceptance-gate
+    numbers."""
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import Llama, LlamaConfig
+    from paddle_tpu.parallel.mesh import serving_mesh
+    from paddle_tpu.serving import (
+        KVCachePool, LlamaRunner, SamplingParams, ServingEngine,
+    )
+
+    backend = jax.default_backend()
+    paddle.seed(0)
+    max_len = prompt + gen
+    heads = max(hidden // 64, 4)
+    n_kv = 4 if heads % 4 == 0 else heads
+    cfg = LlamaConfig(vocab_size=vocab, hidden_size=hidden,
+                      num_layers=layers, num_heads=heads, num_kv_heads=n_kv,
+                      max_seq_len=max_len, dropout=0.0)
+    model = Llama(cfg)
+    model.eval()
+    block_size = min(16, max_len)
+    pages_per_seq = -(-max_len // block_size)
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, vocab, prompt)) for _ in range(requests)]
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        _write_child({"status": "child_error", "mode": "quant_comm",
+                      "error_type": "InsufficientDevices",
+                      "error": f"quant_comm rung needs >= 2 devices for "
+                               f"tp=2, backend {backend!r} has {n_dev}"})
+        return
+    mesh = serving_mesh(data=1, model=2)
+
+    def make_runner(comm_dtype, kv_dtype):
+        return LlamaRunner(model, block_size=block_size,
+                           max_model_len=max_len, kv_dtype=kv_dtype
+                           ).shard(mesh, comm_dtype=comm_dtype)
+
+    def run_arm(runner) -> dict:
+        def once():
+            runner.reset_attn_counters()
+            eng = ServingEngine(runner,
+                                num_blocks=max_batch * pages_per_seq + 1,
+                                max_batch_size=max_batch,
+                                max_model_len=max_len,
+                                max_prefill_tokens_per_step=4 * block_size,
+                                ragged_batch=True)
+            t0 = time.time()
+            for i, p in enumerate(prompts):
+                eng.add_request(p, SamplingParams(max_tokens=gen),
+                                request_id=f"r{i}")
+            eng.run()
+            wall = time.time() - t0
+            snap = eng.metrics.snapshot()
+            return {"wall_s": round(wall, 3),
+                    "comm_dtype": runner.comm_dtype,
+                    "kv_dtype": runner.kv_dtype,
+                    "tokens_per_sec": snap["tokens_generated"] / wall,
+                    "ttft_s_p50": snap["ttft_s_p50"],
+                    "tp_comm_gb": snap["tp_comm_bytes"] / 1e9,
+                    "tp_comm_gb_fp32": snap["tp_comm_bytes_fp32"] / 1e9,
+                    "tp_comm_bytes_reduction_x":
+                        snap["tp_comm_bytes_reduction_x"],
+                    "attn_kv_gb_read": snap["attn_kv_bytes_read"] / 1e9,
+                    "kv_bytes_reduction_x": snap["kv_bytes_reduction_x"]}
+
+        once()              # warmup compiles this arm's buckets
+        return once()
+
+    def teacher_forced_accuracy(r_ref, r_q, n_prompts=2, steps=24) -> dict:
+        """Replay the fp32 TP arm's greedy stream through a quantized
+        arm's runner and compare per-step logits — the three
+        acceptance-gate numbers, workload-matched."""
+        steps = min(steps, gen)     # stay inside the pool's positions
+        dl, overlap, agree, total = [], [], 0, 0
+        for p in prompts[:n_prompts]:
+            pools, tbls = [], []
+            for r in (r_ref, r_q):
+                pool = KVCachePool(r.num_layers, pages_per_seq + 1,
+                                   block_size, r.n_kv_heads, r.head_dim,
+                                   r.dtype, mesh=r.mesh,
+                                   model_axis=r.model_axis,
+                                   kv_dtype=r.kv_dtype)
+                pages = pool.allocator.alloc(pages_per_seq)
+                tbls.append(pool.pad_table(pages, pages_per_seq))
+                pools.append(pool.pools)
+            l_ref, pools[0] = r_ref.prefill(p, tbls[0], pools[0])
+            l_q, pools[1] = r_q.prefill(p, tbls[1], pools[1])
+            toks = list(p)
+            for _ in range(steps):
+                a, b = np.asarray(l_ref), np.asarray(l_q)
+                dl.append(np.abs(a - b).mean())
+                top_ref = set(np.argsort(a)[-5:].tolist())
+                top_q = set(np.argsort(b)[-5:].tolist())
+                overlap.append(len(top_ref & top_q) / 5.0)
+                agree += int(np.argmax(a) == np.argmax(b))
+                total += 1
+                tok = int(np.argmax(a))          # teacher: the fp32 path
+                pos = np.asarray([len(toks)], np.int32)
+                toks.append(tok)
+                l_ref, pools[0] = r_ref.decode(
+                    np.asarray([tok], np.int32),
+                    np.asarray(tbls[0], np.int32)[None], pos, pools[0])
+                l_q, pools[1] = r_q.decode(
+                    np.asarray([tok], np.int32),
+                    np.asarray(tbls[1], np.int32)[None], pos, pools[1])
+                l_ref, l_q = l_ref[0], l_q[0]
+        return {"mean_abs_dlogit": float(np.mean(dl)),
+                "top5_overlap": float(np.mean(overlap)),
+                "greedy_agreement": agree / total if total else 0.0}
+
+    r_fp32 = make_runner("fp32", "fp32")
+    r_qpsum = make_runner("int8", "fp32")
+    r_fp8 = make_runner("fp32", "fp8")
+    r_both = make_runner("int8", "fp8")
+    arms = [run_arm(r) for r in (r_fp32, r_qpsum, r_fp8, r_both)]
+    comm_fp32, comm_q = arms[0]["tp_comm_gb"], arms[1]["tp_comm_gb"]
+    kv_fp32, kv_fp8 = arms[0]["attn_kv_gb_read"], arms[2]["attn_kv_gb_read"]
+    _write_child({
+        "backend": backend, "layers": layers, "hidden": hidden,
+        "max_batch": max_batch, "requests": requests, "prompt": prompt,
+        "gen": gen, "workload": "quant_comm", "tp": 2, "arms": arms,
+        # THE acceptance numbers: measured wire bytes the row-parallel
+        # allreduces moved (scale bytes counted on the int8 side) and
+        # measured KV bytes the attention path read, fp8 vs fp32
+        "tp_comm_bytes_reduction_x": (comm_fp32 / comm_q
+                                      if comm_q else 0.0),
+        "kv_bytes_reduction_x": kv_fp32 / kv_fp8 if kv_fp8 else 0.0,
+        "accuracy_int8_psum": teacher_forced_accuracy(r_fp32, r_qpsum),
+        "accuracy_fp8_kv": teacher_forced_accuracy(r_fp32, r_fp8),
+        "accuracy_both": teacher_forced_accuracy(r_fp32, r_both),
+    })
+
+
 def child_serving_offload(layers: int, hidden: int, max_batch: int,
                           requests: int, prompt: int, gen: int, vocab: int):
     """Tiered-KV offload rung (ISSUE 10): a deliberately TIGHT pool
@@ -2138,6 +2288,41 @@ def main():
                 f"{acc['top5_overlap']:.3f}, greedy agreement "
                 f"{acc['greedy_agreement']*100:.1f}%")
 
+    # quantized-collectives + fp8-KV rung (ISSUE 15): the tp=2
+    # long-context workload in fp32 / int8-psum / fp8-kv / both arms;
+    # commits the MEASURED row-parallel comm-bytes reduction (scale
+    # bytes counted), the fp8-vs-fp32 KV-bytes reduction, tokens/s per
+    # arm, and the teacher-forced accuracy gates vs the fp32 TP engine
+    if on_tpu and remaining() > 120:
+        r = run_child("serving:6:512:4:6:448:64:32768:quant_comm",
+                      min(900, remaining()))
+        if r is not None and "arms" in r:
+            acc = r["accuracy_both"]
+            line = {"metric": "serving_quant_comm_bytes_reduction_x",
+                    "value": round(r["tp_comm_bytes_reduction_x"], 2),
+                    "unit": "x", "vs_baseline": 0.0,
+                    "kv_bytes_reduction_x":
+                        round(r["kv_bytes_reduction_x"], 2),
+                    "tokens_per_sec_fp32":
+                        round(r["arms"][0]["tokens_per_sec"], 1),
+                    "tokens_per_sec_int8_psum":
+                        round(r["arms"][1]["tokens_per_sec"], 1),
+                    "tokens_per_sec_fp8_kv":
+                        round(r["arms"][2]["tokens_per_sec"], 1),
+                    "tokens_per_sec_both":
+                        round(r["arms"][3]["tokens_per_sec"], 1),
+                    "mean_abs_dlogit": round(acc["mean_abs_dlogit"], 6),
+                    "top5_overlap": round(acc["top5_overlap"], 4),
+                    "greedy_agreement": round(acc["greedy_agreement"], 4),
+                    "backend": r["backend"]}
+            emit(line)
+            _cache_result(line)
+            log(f"quant-comm rung: comm bytes reduction "
+                f"{r['tp_comm_bytes_reduction_x']:.2f}x, KV bytes "
+                f"{r['kv_bytes_reduction_x']:.2f}x, top-5 overlap "
+                f"{acc['top5_overlap']:.3f}, greedy agreement "
+                f"{acc['greedy_agreement']*100:.1f}%")
+
     # tiered-KV offload rung (ISSUE 10): recompute-vs-pagein resume cost
     # on a deliberately tight pool, the sessions uplift from the
     # watermark headroom knob, and the host<->device page copy-bandwidth
@@ -2522,6 +2707,8 @@ def _child_main(mode: str) -> None:
             child_serving_long(*[int(x) for x in parts[:-1]])
         elif parts and parts[-1] == "kv_quant":
             child_serving_kvq(*[int(x) for x in parts[:-1]])
+        elif parts and parts[-1] == "quant_comm":
+            child_serving_quant_comm(*[int(x) for x in parts[:-1]])
         elif parts and parts[-1] == "kv_offload":
             child_serving_offload(*[int(x) for x in parts[:-1]])
         elif parts and parts[-1] == "speculative":

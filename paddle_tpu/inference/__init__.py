@@ -186,13 +186,24 @@ def create_serving_engine(model, dtype=None, **kw):
     ragged kernel's page walk, and/or weight-only int8 linears — the
     serving analogue of the reference weight_only_linear path. Accuracy-
     gated (top-k overlap vs the fp32 oracle), ~half the attention HBM
-    bytes; composes with `mesh=` (scales shard with their pools)."""
+    bytes; composes with `mesh=` (scales shard with their pools).
+
+    ISSUE 15 rungs: `kv_dtype="fp8"` (native float8 pages, 4x fewer KV
+    bytes), `kv_dtype="mixed"` (per-request SamplingParams.kv_dtype
+    tenants in one pool), and `comm_dtype="int8"` (with `mesh=`: the
+    row-parallel allreduce becomes the chunked quantized psum)."""
     import jax.numpy as jnp
 
     from paddle_tpu.serving import ServingEngine
     from paddle_tpu.serving.model_runner import runner_for
 
     mesh = kw.pop("mesh", None)
+    comm_dtype = kw.pop("comm_dtype", "fp32")
+    if comm_dtype != "fp32" and mesh is None:
+        raise ValueError(
+            f"comm_dtype={comm_dtype!r} needs a tensor-parallel mesh — "
+            "the quantized collective replaces the row-parallel "
+            "allreduce, which only exists at tp > 1")
     runner = runner_for(model,
                         **{k: kw.pop(k) for k in
                            ("block_size", "max_model_len", "attn_impl",
@@ -205,7 +216,7 @@ def create_serving_engine(model, dtype=None, **kw):
     if mesh is not None:
         # cast first, shard second: the device_put then ships the final
         # serving dtype, not fp32 weights that get re-cast on device
-        runner.shard(mesh)
+        runner.shard(mesh, comm_dtype=comm_dtype)
     kw.setdefault("num_blocks", 128)
     return ServingEngine(runner, **kw)
 

@@ -460,6 +460,17 @@ def _spec_layout():
         """Canonical PartitionSpecs for a tensor-parallel decoder over a
         `(data, model)` serving mesh (ISSUE 7).
 
+        ISSUE 15 adds the COMMUNICATION side of the row-parallel
+        placement: `comm_dtype` names the wire precision of the
+        allreduce that completes every row-parallel matmul, and
+        `row_parallel_reduce()` returns the collective that implements
+        it — `lax.psum` at "fp32" (the default, bit-identical to the
+        GSPMD-inserted psum), or the chunked two-level int8 reduce
+        (`quantization.qcomm.quantized_psum`) at "int8". The runner
+        routes its row-parallel matmuls through this hook inside a
+        shard_map, so swapping the collective never touches the
+        matmul, the specs, or the engine above.
+
         The spec shapes are exactly the ColWiseParallel / RowWiseParallel
         placements above, named per decoder weight role so the serving
         model runner can build a full param->spec table from one object:
@@ -488,9 +499,28 @@ def _spec_layout():
 
         data_axis: str = "data"
         model_axis: str = "model"
+        # wire precision of the row-parallel allreduce (ISSUE 15):
+        # "fp32" = lax.psum (default, bit-exact), "int8" = the chunked
+        # two-level quantized reduce (quantization.qcomm)
+        comm_dtype: str = "fp32"
 
         def replicated(self) -> PS:
             return PS()
+
+        def row_parallel_reduce(self):
+            """The collective behind a row-parallel matmul's output:
+            fn(partial_sums, axis_name) -> allreduced sum. Called
+            inside a shard_map body over the model axis."""
+            if self.comm_dtype == "fp32":
+                return lambda part, axis_name: jax.lax.psum(part,
+                                                            axis_name)
+            if self.comm_dtype == "int8":
+                from paddle_tpu.quantization.qcomm import quantized_psum
+
+                return quantized_psum
+            raise ValueError(
+                f"comm_dtype={self.comm_dtype!r}; expected 'fp32' or "
+                "'int8'")
 
         def embeddings(self) -> PS:
             return PS(self.model_axis, None)

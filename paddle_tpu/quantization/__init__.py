@@ -200,3 +200,6 @@ from paddle_tpu.quantization.int8 import (  # noqa: F401,E402
     Int8Linear, apply_per_channel_scale, dequantize_linear, llm_int8_linear,
     quantize_linear, weight_dequantize, weight_only_linear, weight_quantize,
 )
+from paddle_tpu.quantization.qcomm import (  # noqa: F401,E402
+    allreduce_bytes, quantized_allreduce_reference, quantized_psum,
+)

@@ -1,0 +1,146 @@
+"""Quantized collectives for tensor-parallel serving (ISSUE 15).
+
+Reference: EQuARX (PAPERS.md) — an in-XLA quantized allreduce recovers
+most of the row-parallel psum's interconnect bandwidth at negligible
+quality cost. TP serving moves fp32 activations through the
+row-parallel allreduce on every o_proj/down_proj of every layer; after
+PRs 9-14 quantized the KV pools, the weights, and the handoff paths,
+that psum is the last fp32-width hot path left.
+
+`quantized_psum` is the reusable primitive: a CHUNKED TWO-LEVEL reduce
+that replaces one fp32 `lax.psum` inside a shard_map body.
+
+  level 1 (scales)  each shard computes a per-(row, chunk) abs-max
+                    scale over its own partial sums, then the shards
+                    agree on ONE shared scale per chunk via
+                    `lax.pmax` — a tiny fp32 collective. Sharing by
+                    max keeps the scales per-shard-honest: every
+                    shard's values fit the shared scale, so the int8
+                    quantization below can never clip (the clip is a
+                    guard, not a rounding path).
+  level 2 (codes)   each shard quantizes its partial sums at the
+                    shared scale and the int8 codes allreduce
+                    (accumulated wide — int32 — transmitted narrow;
+                    a real ring implementation requantizes per hop,
+                    which is what the byte accounting models), then
+                    one dequant multiply recovers the sum.
+
+Chunking is along the LAST axis of each row, never across rows: a
+row's quantization depends only on that row's values, so the reduced
+output is BATCH-SHAPE INVARIANT — the same token position produces
+bit-identical values whether it rides a monolithic prefill, a chunked
+prefill, a mixed ragged batch, or a decode horizon (padding rows and
+dead slots cannot leak into live rows). That invariance is what lets
+the serving engine stay token-exact against its own naive oracle with
+the quantized psum on; accuracy vs the FP32 engine is gated instead
+(teacher-forced |dlogit| / top-5 overlap / greedy agreement, the PR 9
+methodology).
+
+`allreduce_bytes` is the honest wire accounting the serving counters
+use: per shard, the fp32 psum moves rows*width*4 bytes; the quantized
+one moves rows*width int8 code bytes PLUS 4 bytes per (row, chunk)
+shared scale — scale bytes are counted, so the committed reduction is
+4 / (1 + 4/chunk), measured, never an assumed 4x.
+
+Everything here is jit-pure and shard_map-compatible: no host state,
+no python branches on traced values.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# symmetric int8 range shared with the KV quantization (ISSUE 9)
+QCOMM_QMAX = 127.0
+
+# default chunk width (elements per shared scale along the last axis).
+# 128 keeps the scale overhead at 4/128 bytes/element (3.88x reduction)
+# while a per-chunk outlier only costs its own 128 elements precision.
+QCOMM_CHUNK = 128
+
+COMM_DTYPES = ("fp32", "int8")
+
+
+def quantized_psum(x, axis_name, *, chunk: int = QCOMM_CHUNK):
+    """Sum `x` over the mapped mesh axis with int8 wire traffic.
+
+    Drop-in for `jax.lax.psum(x, axis_name)` inside a shard_map body:
+    `x` is this shard's partial sums (any float dtype, any shape with
+    at least one axis); returns the allreduced sum at x's dtype.
+
+    Two-level: per-(row, chunk) scales agree via `lax.pmax` (fp32,
+    tiny), codes ride an int8-wide `lax.psum` (int32 accumulators —
+    tp * 127 overflows int8, and a real ring requantizes per hop
+    anyway), one fused dequant multiply at the end. Scales are
+    per-shard-honest (pmax >= every local abs-max), so quantization
+    never clips; rows quantize independently, so the result is
+    batch-shape invariant (see module docstring).
+    """
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    orig_dtype = x.dtype
+    shape = x.shape
+    width = shape[-1]
+    c = min(int(chunk), int(width))
+    rows = x.astype(jnp.float32).reshape(-1, width)         # [R, W]
+    pad = (-width) % c
+    if pad:
+        rows = jnp.pad(rows, ((0, 0), (0, pad)))
+    chunks = rows.reshape(rows.shape[0], -1, c)             # [R, C, c]
+    local = jnp.max(jnp.abs(chunks), axis=-1) / QCOMM_QMAX  # [R, C]
+    scale = jax.lax.pmax(local, axis_name)                  # shared, honest
+    safe = jnp.maximum(scale, 1e-30)[..., None]
+    codes = jnp.clip(jnp.round(chunks / safe),
+                     -QCOMM_QMAX, QCOMM_QMAX).astype(jnp.int8)
+    total = jax.lax.psum(codes.astype(jnp.int32), axis_name)
+    out = total.astype(jnp.float32) * scale[..., None]
+    out = out.reshape(rows.shape[0], -1)[:, :width]
+    return out.reshape(shape).astype(orig_dtype)
+
+
+def quantized_allreduce_reference(parts, *, chunk: int = QCOMM_CHUNK):
+    """Host-side oracle of `quantized_psum`: `parts` is the per-shard
+    list of partial-sum arrays (all the same shape); returns the exact
+    value the shard_map primitive produces on every shard. Pure numpy —
+    the unit tests compare the two bit-for-bit."""
+    parts = [np.asarray(p, np.float32) for p in parts]
+    shape = parts[0].shape
+    width = shape[-1]
+    c = min(int(chunk), int(width))
+    pad = (-width) % c
+    rows = [p.reshape(-1, width) for p in parts]
+    if pad:
+        rows = [np.pad(r, ((0, 0), (0, pad))) for r in rows]
+    chunks = [r.reshape(r.shape[0], -1, c) for r in rows]
+    local = [np.abs(ch).max(axis=-1) / QCOMM_QMAX for ch in chunks]
+    scale = np.maximum.reduce(local)                        # pmax
+    safe = np.maximum(scale, 1e-30)[..., None]
+    total = np.zeros_like(chunks[0], dtype=np.int32)
+    for ch in chunks:
+        total += np.clip(np.round(ch / safe),
+                         -QCOMM_QMAX, QCOMM_QMAX).astype(np.int32)
+    out = total.astype(np.float32) * scale[..., None]
+    return out.reshape(total.shape[0], -1)[:, :width].reshape(shape)
+
+
+def allreduce_bytes(rows: int, width: int, comm_dtype: str,
+                    *, chunk: int = QCOMM_CHUNK) -> int:
+    """Wire bytes ONE shard contributes to one row-parallel allreduce
+    of a [rows, width] activation — the serving `tp_comm_bytes`
+    accounting (host-side, CPU-countable, like the attention byte
+    counters). fp32: the full payload at 4 bytes/element. int8: 1 code
+    byte per element PLUS 4 bytes per (row, chunk) shared scale — the
+    scale pmax is wire traffic too, so it is counted, and the
+    committed reduction is 4/(1 + 4/chunk), never an assumed 4x."""
+    if comm_dtype not in COMM_DTYPES:
+        raise ValueError(f"comm_dtype={comm_dtype!r}; expected one of "
+                         f"{COMM_DTYPES}")
+    rows, width = int(rows), int(width)
+    if comm_dtype == "fp32":
+        return rows * width * 4
+    c = min(int(chunk), max(int(width), 1))
+    n_chunks = -(-width // c)
+    return rows * width + rows * n_chunks * 4

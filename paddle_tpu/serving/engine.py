@@ -603,6 +603,25 @@ class ServingEngine:
 
     # ----------------------------------------------------------- intake
 
+    def _check_kv_dtype(self, sampling: SamplingParams) -> None:
+        """Per-request KV precision gate (ISSUE 15): a homogeneous pool
+        only serves its own rung; "mixed" pools serve fp32 AND fp8
+        tenants side by side (pages tagged at alloc). Loud at intake —
+        a silently widened/narrowed tenant would break the byte
+        accounting AND the accuracy story."""
+        want = sampling.kv_dtype
+        if want is None:
+            return
+        allowed = ({"fp32", "fp8"} if self.kv_dtype == "mixed"
+                   else {self.pool.native_kv_tag()})
+        if want not in allowed:
+            raise ValueError(
+                f"SamplingParams.kv_dtype={want!r} is not servable by "
+                f"this engine's kv_dtype={self.kv_dtype!r} pool "
+                f"(allowed: {sorted(allowed)}) — build the engine with "
+                "kv_dtype='mixed' to serve mixed-precision tenants "
+                "from one pool geometry")
+
     def add_request(self, prompt_tokens: Sequence[int],
                     sampling: Optional[SamplingParams] = None,
                     request_id: Optional[str] = None) -> str:
@@ -614,6 +633,7 @@ class ServingEngine:
                 f"prompt({len(req.prompt_tokens)}) + max_tokens"
                 f"({sampling.max_tokens}) exceeds max_model_len="
                 f"{self.max_model_len}")
+        self._check_kv_dtype(sampling)
         if (self.max_queue_depth is not None
                 and self.scheduler.queue_depth >= self.max_queue_depth):
             self.metrics.shed_requests.inc()
@@ -979,6 +999,18 @@ class ServingEngine:
             self.metrics.attn_kv_bytes_read.set(read)
             self.metrics.attn_kv_bytes_gather.set(
                 self.runner.attn_kv_bytes_gather)
+        comm = getattr(self.runner, "tp_comm_bytes", None)
+        if comm is not None:
+            # quantized-collective accounting (ISSUE 15): wire bytes
+            # the row-parallel allreduces moved per shard (scale bytes
+            # counted) vs the fp32 cost of the same calls — mirrored
+            # from the runner's host-side counters like the attention
+            # bytes above, so the comm reduction is measured
+            self.metrics.tp_comm_bytes.set(comm)
+            self.metrics.tp_comm_bytes_fp32.set(
+                self.runner.tp_comm_bytes_fp32)
+            self.metrics.tp_comm_bytes_reduction_x.set(
+                self.runner.tp_comm_bytes_fp32 / comm if comm else 0.0)
         a = self.pool.allocator
         self.metrics.queue_depth.set(self.scheduler.queue_depth)
         self.metrics.running.set(len(self.scheduler.running))
@@ -2154,6 +2186,10 @@ class ServingEngine:
                 "kv_dtype": self.kv_dtype,
                 "weight_dtype": getattr(self.runner, "weight_dtype",
                                         "fp32"),
+                # quantized-collective knob (ISSUE 15) rides along for
+                # the record like the other dtypes; restore follows
+                # the NEW runner's comm_dtype (logged on mismatch)
+                "comm_dtype": getattr(self.runner, "comm_dtype", "fp32"),
                 # mesh shape rides along for the record (ISSUE 7); the
                 # restored engine follows the NEW runner's mesh — the
                 # recompute-on-resume path is sharding-agnostic, so a
@@ -2233,8 +2269,10 @@ class ServingEngine:
             logger.info("restore: snapshot mesh %s -> runner mesh %s",
                         snap_mesh, run_mesh)
         snap_q = (cfg.get("kv_dtype", "fp32"),
-                  cfg.get("weight_dtype", "fp32"))
-        run_q = (eng.kv_dtype, getattr(runner, "weight_dtype", "fp32"))
+                  cfg.get("weight_dtype", "fp32"),
+                  cfg.get("comm_dtype", "fp32"))
+        run_q = (eng.kv_dtype, getattr(runner, "weight_dtype", "fp32"),
+                 getattr(runner, "comm_dtype", "fp32"))
         if snap_q != run_q:
             # also legal (restore recomputes KV from tokens), but the
             # continued stream follows the NEW runner's quantization
@@ -2260,6 +2298,12 @@ def naive_generate(runner: PagedModelRunner, prompt_tokens: Sequence[int],
                        runner.head_dim, runner.dtype,
                        kv_dtype=getattr(runner, "kv_dtype", "fp32"))
     pages = pool.allocator.alloc(max_pages)
+    # per-request KV precision (ISSUE 15): the oracle's pages carry the
+    # request's effective tag, so a mixed-pool fp8 tenant's oracle
+    # writes through the same fp8 round-trip the engine does
+    pool.tag_pages(pages,
+                   getattr(sampling, "kv_dtype", None)
+                   or pool.native_kv_tag())
     table = pool.pad_table(pages, max_pages)
     tokens = list(map(int, prompt_tokens))
     logits, pools = runner.prefill(tokens, table, pool.pools)
@@ -2284,6 +2328,7 @@ def create_engine(model, *, num_blocks: int = 128,
                   attn_impl: str = "auto", mesh=None,
                   data_axis: str = "data", model_axis: str = "model",
                   kv_dtype: str = "fp32", weight_dtype: str = "fp32",
+                  comm_dtype: str = "fp32",
                   **engine_kw) -> ServingEngine:
     """Build a ServingEngine for a supported decoder Layer (Llama, GPT).
 
@@ -2295,12 +2340,25 @@ def create_engine(model, *, num_blocks: int = 128,
     `kv_dtype="int8"` / `weight_dtype="int8"` (ISSUE 9) serve with
     quantized K/V pools (per-page-per-head scales, dequant inside the
     ragged kernel's page walk) and/or weight-only int8 linears —
-    accuracy-gated vs the fp32 oracle, ~half the attention HBM bytes."""
+    accuracy-gated vs the fp32 oracle, ~half the attention HBM bytes.
+
+    ISSUE 15 rungs: `kv_dtype="fp8"` stores native float8_e4m3fn pages
+    (scale-free casts, 4x fewer KV bytes); `kv_dtype="mixed"` serves
+    fp32 and fp8 tenants from one pool via `SamplingParams.kv_dtype`;
+    `comm_dtype="int8"` (needs a mesh) swaps the row-parallel allreduce
+    for the chunked quantized psum — accuracy-gated vs the fp32 TP
+    engine, ~4x fewer wire bytes (scale bytes counted)."""
+    if comm_dtype != "fp32" and mesh is None:
+        raise ValueError(
+            f"comm_dtype={comm_dtype!r} needs a tensor-parallel mesh — "
+            "the quantized collective replaces the row-parallel "
+            "allreduce, which only exists at tp > 1")
     runner = runner_for(model, block_size=block_size,
                         max_model_len=max_model_len, attn_impl=attn_impl,
                         kv_dtype=kv_dtype, weight_dtype=weight_dtype)
     if mesh is not None:
-        runner.shard(mesh, data_axis=data_axis, model_axis=model_axis)
+        runner.shard(mesh, data_axis=data_axis, model_axis=model_axis,
+                     comm_dtype=comm_dtype)
     return ServingEngine(runner, num_blocks=num_blocks,
                          block_size=block_size,
                          max_batch_size=max_batch_size,

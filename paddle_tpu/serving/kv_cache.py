@@ -52,6 +52,22 @@ there), already-resident codes are requantized to the grown scale, and
 the new rows are quantized at it — so one (page, head) scale always
 dequantizes every live code in the page. Default stays "fp32": those
 pools are byte-identical to the pre-ISSUE-9 layout.
+
+ISSUE 15 extends the ladder one rung down: ``kv_dtype="fp8"`` stores
+pages as native ``float8_e4m3fn`` — appends are a scale-free
+per-element cast (``fp8_page_write``), so there are NO scale pools and
+NO requant-on-grow, and the layer tuples stay plain ``(k, v)`` pairs
+at 1 byte/element (4x vs fp32, measured by ``page_bytes``). And
+``kv_dtype="mixed"`` serves mixed-precision TENANTS from one pool
+geometry: fp32 storage plus a per-page TAG PLANE in each layer tuple
+(``(k, v, tag)``); pages are tagged at alloc with their owner
+request's effective kv_dtype (``SequenceKV.kv_tag`` from
+``SamplingParams.kv_dtype``), fp8-tagged pages are written through the
+fp8 round-trip cast (bit-identical values to a native fp8 pool), and
+non-default tags seed DISJOINT prefix-hash chains so tenants of
+different precision can never share pages. The auditor pins the tag
+bijection (device plane == allocator tag map == owner requests'
+dtypes).
 """
 
 from __future__ import annotations
@@ -69,7 +85,48 @@ SCRATCH_PAGE = 0
 # int8 symmetric quantization range of the quantized KV pools (ISSUE 9)
 KV_QMAX = 127.0
 
-KV_DTYPES = ("fp32", "int8")
+# pool storage rungs of the quantization ladder. "fp8" (ISSUE 15) is
+# native float8_e4m3fn pages — a scale-free per-element cast at append,
+# no scale pools, no requant-on-grow. "mixed" serves MIXED-PRECISION
+# TENANTS from one pool geometry: fp32 storage plus a per-page tag
+# plane; pages tagged "fp8" (per-request SamplingParams.kv_dtype) are
+# written through the fp8 round-trip cast, so an fp8 tenant's values
+# are bit-identical to a native fp8 pool while fp32 tenants stay
+# bit-exact.
+KV_DTYPES = ("fp32", "int8", "fp8", "mixed")
+
+
+def fp8_supported() -> bool:
+    """Whether this jax/ml_dtypes build carries float8_e4m3fn."""
+    return hasattr(jnp, "float8_e4m3fn")
+
+
+def require_fp8(context: str) -> None:
+    """Loud gate for the fp8 rung (ISSUE 15 satellite): fp8 pools need
+    float8_e4m3fn in jax (native fp8 hardware, or XLA's emulation on
+    CPU/older TPUs) — never a silent fp32 fallback."""
+    if not fp8_supported():
+        raise RuntimeError(
+            f"{context}: this jax/ml_dtypes build has no float8_e4m3fn "
+            "support, so fp8 KV pages cannot be stored (or emulated) — "
+            "upgrade jax (>= 0.4.14 ships fp8 dtypes) or serve with "
+            "kv_dtype='int8' instead")
+
+
+def fp8_round(x):
+    """Round-trip through float8_e4m3fn: the exact value a native fp8
+    page stores, represented at the input dtype — the mixed-pool write
+    path (per-element, scale-free)."""
+    return x.astype(jnp.float8_e4m3fn).astype(x.dtype)
+
+
+def fp8_page_write(pool, write_page, write_off, x):
+    """Append fp rows into a NATIVE fp8 page pool (ISSUE 15): a pure
+    per-element cast — no scales to grow, no resident codes to
+    requantize (the int8 path's whole lifecycle machinery evaporates).
+    Deterministic and idempotent like `quantized_page_write`, so step
+    retries stay exact."""
+    return pool.at[write_page, write_off].set(x.astype(pool.dtype))
 
 
 def quantized_page_write(codes, scales, write_page, write_off, x):
@@ -152,6 +209,11 @@ class BlockAllocator:
         self.num_blocks = num_blocks
         self._free = list(range(1, num_blocks))  # ascending
         self._ref: Dict[int, int] = {}           # page -> refcount (>= 1)
+        # per-page kv-dtype tags (ISSUE 15): stamped by SequenceKV at
+        # alloc time with the owning request's effective kv_dtype,
+        # cleared when the page's refcount hits zero — the auditor's
+        # tag-bijection invariant reads this map
+        self._tags: Dict[int, str] = {}
         self.evictor: Optional["PrefixCache"] = None
 
     @property
@@ -207,6 +269,7 @@ class BlockAllocator:
         rc = self._ref[page]
         if rc == 0:
             del self._ref[page]
+            self._tags.pop(page, None)   # tag dies with the last ref
             insort(self._free, page)   # keep sorted: allocation stays
         return rc                      # deterministic
 
@@ -271,14 +334,18 @@ class PrefixCache:
 
     # ---------------------------------------------------------- matching
 
-    def match(self, tokens: Sequence[int]) -> List[Tuple[int, int]]:
+    def match(self, tokens: Sequence[int],
+              tag: Optional[str] = None) -> List[Tuple[int, int]]:
         """Longest cached page-aligned prefix of `tokens`, as a list of
         (chain_hash, page) pairs. Capped STRICTLY below len(tokens): at
         least one token is always left to compute, so admission always
-        produces the logits it must sample from."""
+        produces the logits it must sample from. `tag` is the
+        requesting tenant's effective kv_dtype (ISSUE 15): non-default
+        tags seed a DISJOINT hash chain, so mixed-precision tenants
+        can never share each other's pages."""
         limit = (len(tokens) - 1) // self.block_size
         out: List[Tuple[int, int]] = []
-        prev = _CHAIN_SEED
+        prev = self.pool.chain_seed(tag)
         for i in range(limit):
             h = page_content_hash(
                 prev, tokens[i * self.block_size:(i + 1) * self.block_size])
@@ -291,7 +358,8 @@ class PrefixCache:
         self.hit_pages += len(out)
         return out
 
-    def match_tiered(self, tokens: Sequence[int]
+    def match_tiered(self, tokens: Sequence[int],
+                     tag: Optional[str] = None
                      ) -> Tuple[List[Tuple[int, int]], List[int]]:
         """match() extended into the host tier (ISSUE 10): after the
         device index misses, the chain continues against the tier's
@@ -301,12 +369,13 @@ class PrefixCache:
         fresh device page for and the engine must page in before the
         step that reads them. Same strict cap as match(): the combined
         prefix always leaves at least one token to compute."""
-        matched = self.match(tokens)
+        matched = self.match(tokens, tag)
         tier = self.pool.host_tier
         host: List[int] = []
         if tier is not None and tier.prefix_count:
             limit = (len(tokens) - 1) // self.block_size
-            prev = matched[-1][0] if matched else _CHAIN_SEED
+            prev = (matched[-1][0] if matched
+                    else self.pool.chain_seed(tag))
             for i in range(len(matched), limit):
                 h = page_content_hash(
                     prev,
@@ -341,7 +410,8 @@ class PrefixCache:
         added = 0
         while kv.registered_pages < full:
             i = kv.registered_pages
-            prev = kv.hash_chain[i - 1] if i else _CHAIN_SEED
+            prev = (kv.hash_chain[i - 1] if i
+                    else self.pool.chain_seed(kv.kv_tag))
             h = page_content_hash(
                 prev, tokens[i * self.block_size:(i + 1) * self.block_size])
             page = kv.pages[i]
@@ -576,6 +646,13 @@ class SharedKVStore:
         if kv_dtype == "int8":
             layer = ((page, "int8"), (page, "int8"),
                      ((n_kv_heads,), "float32"), ((n_kv_heads,), "float32"))
+        elif kv_dtype == "fp8":
+            # native fp8 pages (ISSUE 15): ml_dtypes registers the
+            # numpy dtype, so host mirrors carry the exact bytes
+            layer = ((page, "float8_e4m3fn"), (page, "float8_e4m3fn"))
+        elif kv_dtype == "mixed":
+            # fp32 pages + the per-page tag bit (scalar per page)
+            layer = ((page, dt), (page, dt), ((), "bool"))
         else:
             layer = ((page, dt), (page, dt))
         return [layer for _ in range(num_layers)]
@@ -1740,6 +1817,8 @@ class KVCachePool:
         if kv_dtype not in KV_DTYPES:
             raise ValueError(f"kv_dtype={kv_dtype!r}; expected one of "
                              f"{KV_DTYPES}")
+        if kv_dtype in ("fp8", "mixed"):
+            require_fp8(f"KVCachePool(kv_dtype={kv_dtype!r})")
         self.kv_dtype = kv_dtype
         self.mesh = mesh
         self.model_axis = model_axis
@@ -1747,6 +1826,7 @@ class KVCachePool:
         self.allocator = BlockAllocator(num_blocks)
         self.prefix_cache: Optional[PrefixCache] = None
         self.host_tier: Optional[HostKVTier] = None
+        store_dtype = jnp.float8_e4m3fn if kv_dtype == "fp8" else dtype
         shape = (num_blocks, block_size, n_kv_heads, head_dim)
         sshape = (num_blocks, n_kv_heads)     # one scale per page per head
         if mesh is not None:
@@ -1772,10 +1852,18 @@ class KVCachePool:
                      jax.device_put(jnp.zeros(sshape, jnp.float32), s_shard),
                      jax.device_put(jnp.zeros(sshape, jnp.float32), s_shard))
                     for _ in range(num_layers)]
-            else:
+            elif kv_dtype == "mixed":
+                # the tag plane has no head axis — replicated per shard
+                rep = NamedSharding(mesh, PartitionSpec())
                 self.pools = [
                     (jax.device_put(jnp.zeros(shape, dtype), sharding),
-                     jax.device_put(jnp.zeros(shape, dtype), sharding))
+                     jax.device_put(jnp.zeros(shape, dtype), sharding),
+                     jax.device_put(jnp.zeros((num_blocks,), bool), rep))
+                    for _ in range(num_layers)]
+            else:                          # fp32 or native fp8 pages
+                self.pools = [
+                    (jax.device_put(jnp.zeros(shape, store_dtype), sharding),
+                     jax.device_put(jnp.zeros(shape, store_dtype), sharding))
                     for _ in range(num_layers)]
         elif kv_dtype == "int8":
             self.pools = [(jnp.zeros(shape, jnp.int8),
@@ -1783,9 +1871,57 @@ class KVCachePool:
                            jnp.zeros(sshape, jnp.float32),
                            jnp.zeros(sshape, jnp.float32))
                           for _ in range(num_layers)]
-        else:
-            self.pools = [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+        elif kv_dtype == "mixed":
+            # mixed-precision tenants (ISSUE 15): fp32 storage + a
+            # per-page tag plane steering the write path — one plane
+            # per layer tuple so the pools stay a uniform pytree
+            # through every jitted step (the planes are kept identical;
+            # tag_pages updates all of them)
+            self.pools = [(jnp.zeros(shape, dtype),
+                           jnp.zeros(shape, dtype),
+                           jnp.zeros((num_blocks,), bool))
                           for _ in range(num_layers)]
+        else:
+            self.pools = [(jnp.zeros(shape, store_dtype),
+                           jnp.zeros(shape, store_dtype))
+                          for _ in range(num_layers)]
+
+    # -------------------------------- per-request kv-dtype tags (ISSUE 15)
+
+    def native_kv_tag(self) -> str:
+        """The kv_dtype a request gets when it does not override: the
+        pool's own storage rung, except "mixed" pools default to fp32
+        (their storage width — fp8 is the opt-in tenant override)."""
+        return "fp32" if self.kv_dtype == "mixed" else self.kv_dtype
+
+    def chain_seed(self, tag: Optional[str]) -> int:
+        """Prefix-chain seed for a tenant's kv-dtype tag: the default
+        tag keeps the historical seed (host-tier indexes, journals and
+        handoffs stay compatible); any OTHER tag folds itself in, so
+        two tenants of different precision can NEVER share a prefix
+        page — their KV bytes for equal tokens differ."""
+        if tag is None or tag == self.native_kv_tag():
+            return _CHAIN_SEED
+        return hash((_CHAIN_SEED, tag))
+
+    def tag_pages(self, pages: Sequence[int], tag: str) -> None:
+        """Stamp freshly-allocated pages with their owner's effective
+        kv_dtype (the auditor's bijection invariant reads the tags).
+        On a "mixed" pool this also flips the device-side tag plane
+        every layer tuple carries, which is what steers the jitted
+        write path — fp8-tagged pages get the fp8 round-trip cast."""
+        if not pages:
+            return
+        for p in pages:
+            self.allocator._tags[p] = tag
+        if self.kv_dtype == "mixed":
+            idx = jnp.asarray(list(pages), jnp.int32)
+            flag = tag == "fp8"
+            self.pools = [(k, v, t.at[idx].set(flag))
+                          for (k, v, t) in self.pools]
+
+    def page_tag(self, page: int) -> Optional[str]:
+        return self.allocator._tags.get(page)
 
     def enable_prefix_cache(self) -> PrefixCache:
         """Turn on shared-prefix page caching (idempotent)."""
@@ -1883,8 +2019,14 @@ class KVCachePool:
         per_kv = self.block_size * self.n_kv_heads * self.head_dim
         if self.kv_dtype == "int8":
             return 2 * self.num_layers * (per_kv + self.n_kv_heads * 4)
+        if self.kv_dtype == "fp8":
+            # native fp8: 1 byte/element, NO scale rows (ISSUE 15)
+            return 2 * self.num_layers * per_kv
         itemsize = jnp.zeros((), self.dtype).dtype.itemsize
-        return 2 * self.num_layers * per_kv * itemsize
+        base = 2 * self.num_layers * per_kv * itemsize
+        if self.kv_dtype == "mixed":
+            return base + 1            # + the page's dtype tag bit
+        return base
 
     def unquantized_page_bytes(self) -> int:
         """What the same page would cost stored at the pool's logical
@@ -1926,8 +2068,13 @@ class SequenceKV:
     and `ensure_writable` copy-on-write forks any shared page before the
     runner would write through it."""
 
-    def __init__(self, pool: KVCachePool):
+    def __init__(self, pool: KVCachePool, kv_tag: Optional[str] = None):
         self.pool = pool
+        # effective kv_dtype of this sequence's pages (ISSUE 15):
+        # every page this sequence allocates is stamped with it — the
+        # per-request override on "mixed" pools, the pool's own rung
+        # otherwise
+        self.kv_tag = kv_tag or pool.native_kv_tag()
         self.pages: List[int] = []
         self.num_tokens = 0
         self.registered_pages = 0          # leading pages already cached
@@ -1949,7 +2096,9 @@ class SequenceKV:
     def grow(self, upcoming_tokens: int = 1) -> None:
         short = self.pages_short(upcoming_tokens)
         if short:
-            self.pages.extend(self.pool.allocator.alloc(short))
+            fresh = self.pool.allocator.alloc(short)
+            self.pages.extend(fresh)
+            self.pool.tag_pages(fresh, self.kv_tag)   # tagged at alloc
 
     def truncate(self, num_tokens: int) -> int:
         """Roll back over-committed tail state (ISSUE 5 + 6): keep only
@@ -1995,6 +2144,7 @@ class SequenceKV:
             if alloc.refcount(page) > 1:
                 new = alloc.alloc(1)[0]
                 self.pool.copy_page(page, new)
+                self.pool.tag_pages([new], self.kv_tag)
                 alloc.decref(page)
                 self.pages[idx] = new
                 # the fork is private and its content will diverge: it is

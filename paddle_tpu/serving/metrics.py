@@ -97,6 +97,7 @@ SUMMABLE_KEYS = (
     "nan_logit_events", "shed_requests", "tokens_generated",
     "prefill_tokens", "prefill_chunks", "prefix_hit_tokens", "cow_copies",
     "prefix_cached_pages", "attn_kv_bytes_read", "attn_kv_bytes_gather",
+    "tp_comm_bytes", "tp_comm_bytes_fp32",
     "spec_proposed_tokens", "spec_accepted_tokens", "spec_rollback_pages",
     "host_syncs", "decode_horizon_steps", "horizon_overshoot_tokens",
     "planned_ahead_steps", "host_plan_seconds", "overlapped_plan_seconds",
@@ -146,6 +147,13 @@ def aggregate_snapshots(snaps) -> Dict[str, float]:
         if st > 0 else 0.0)
     out["tokens_per_sec"] = (toks / out["busy_seconds"]
                              if out["busy_seconds"] > 0 else 0.0)
+    # quantized collectives (ISSUE 15): the tier-level comm reduction
+    # is recomputed from the SUMMED byte counters, never averaged
+    # (per-replica ratios over different traffic cannot be averaged
+    # honestly)
+    comm = out["tp_comm_bytes"]
+    out["tp_comm_bytes_reduction_x"] = (out["tp_comm_bytes_fp32"] / comm
+                                        if comm > 0 else 0.0)
     out["replicas"] = float(len(snaps))
     return out
 
@@ -273,6 +281,16 @@ class EngineMetrics:
         # CPU-countable form of the ragged kernel's bandwidth win
         self.attn_kv_bytes_read = Gauge("attn_kv_bytes_read")
         self.attn_kv_bytes_gather = Gauge("attn_kv_bytes_gather")
+        # quantized collectives (ISSUE 15), mirrored from the runner's
+        # host-side comm accounting each step: wire bytes the
+        # row-parallel allreduces moved PER SHARD at the configured
+        # comm_dtype (int8 code bytes PLUS the per-(row, chunk) scale
+        # bytes — honest accounting) vs the fp32 cost of the same
+        # calls; the reduction gauge is their ratio, i.e. the measured
+        # interconnect win, CPU-countable like the attention bytes
+        self.tp_comm_bytes = Gauge("tp_comm_bytes")
+        self.tp_comm_bytes_fp32 = Gauge("tp_comm_bytes_fp32")
+        self.tp_comm_bytes_reduction_x = Gauge("tp_comm_bytes_reduction_x")
         # quantized-KV accounting (ISSUE 9): per-page byte reduction of
         # the pool vs storing at the logical dtype (scale bytes counted;
         # 1.0 on fp32 pools), and the matching concurrent-sessions-per-
@@ -350,6 +368,10 @@ class EngineMetrics:
             "prefix_cached_pages": self.prefix_cached_pages.value,
             "attn_kv_bytes_read": self.attn_kv_bytes_read.value,
             "attn_kv_bytes_gather": self.attn_kv_bytes_gather.value,
+            "tp_comm_bytes": self.tp_comm_bytes.value,
+            "tp_comm_bytes_fp32": self.tp_comm_bytes_fp32.value,
+            "tp_comm_bytes_reduction_x":
+                self.tp_comm_bytes_reduction_x.value,
             "kv_bytes_reduction_x": self.kv_bytes_reduction_x.value,
             "sessions_per_pool_x": self.sessions_per_pool_x.value,
             "spec_proposed_tokens": self.spec_proposed_tokens.value,

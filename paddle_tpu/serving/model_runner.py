@@ -64,6 +64,32 @@ top-k overlap) rather than exactness-pinned. The instrumented byte
 counters count the quantized page bytes PLUS scale bytes, so the
 fp32-vs-int8 bandwidth claim is measured, not assumed.
 
+Quantized collectives (ISSUE 15): `shard(mesh, comm_dtype="int8")`
+swaps the row-parallel allreduce — the fp32 psum GSPMD inserts behind
+every o_proj/down_proj — for the chunked two-level quantized reduce
+(`quantization/qcomm.py`): per-(row, chunk) fp32 scales agree via
+psum-max, int8 codes ride the allreduce, one dequant multiply
+recovers the sum. The runner routes exactly the matmuls whose spec is
+`SpecLayout.row_parallel` through an explicit shard_map
+(`_row_mm`) whose reduce comes from the layout's
+`row_parallel_reduce()` hook; `comm_dtype="fp32"` (default) keeps the
+GSPMD path untouched and bit-exact. Per-row chunk scales make the
+reduce batch-shape invariant, so the engine stays token-exact against
+its own oracle; accuracy is gated vs the fp32 TP engine instead (the
+PR 9 methodology). `tp_comm_bytes` / `tp_comm_bytes_fp32` count the
+wire bytes per shard host-side (scale bytes counted) — the measured
+comm reduction, CPU-countable like the attention byte counters.
+
+The fp8 KV rung (ISSUE 15): `kv_dtype="fp8"` stores the paged pools
+as native `float8_e4m3fn` — a scale-free per-element cast at append
+(no scale pools, no requant-on-grow: simpler than int8), dequantized
+by a plain astype inside the ragged kernel's page walk and the gather
+reference. `kv_dtype="mixed"` serves MIXED-PRECISION TENANTS from one
+pool geometry: fp32 storage plus a per-page tag plane — pages a
+request tagged "fp8" (SamplingParams.kv_dtype) are written through
+the fp8 round-trip cast, so an fp8 tenant's values are bit-identical
+to a native fp8 pool while fp32 tenants stay bit-exact.
+
 `shard(mesh)` (ISSUE 7 tentpole) turns any runner tensor-parallel over
 a `(data, model)` jax mesh: weights get the Megatron column/row
 PartitionSpecs (`parallel.compat.SpecLayout` — column-wise QKV/up/gate,
@@ -100,7 +126,8 @@ from paddle_tpu.models.generation import (
 )
 from paddle_tpu.models.llama import _rope_tables
 from paddle_tpu.serving.kv_cache import (
-    KV_DTYPES, SCRATCH_PAGE, quantized_page_write,
+    KV_DTYPES, SCRATCH_PAGE, fp8_page_write, fp8_round,
+    quantized_page_write, require_fp8,
 )
 
 # params-dict key suffix of a weight-only-int8 weight's per-output-channel
@@ -159,10 +186,13 @@ def paged_attend(q, k_new, v_new, layer_pools, tables, write_page,
     """Write this step's K/V through the block table, then attend.
 
     q: [B, T, n_h, d]; k_new/v_new: [B, T, n_kv, d]; layer_pools: one
-    layer's pool tuple — fp32 `(k_pool, v_pool)` or int8
-    `(k_codes, v_codes, k_scale, v_scale)` (ISSUE 9: the write path
-    quantizes at append time via `quantized_page_write`, the attend
-    paths dequantize with the per-page-per-head scales); tables: [B, P];
+    layer's pool tuple — fp32/fp8 `(k_pool, v_pool)` (fp8 appends are
+    a pure cast, ISSUE 15), mixed `(k_pool, v_pool, tag)` (fp32
+    storage, fp8-tagged pages written through the fp8 round-trip), or
+    int8 `(k_codes, v_codes, k_scale, v_scale)` (ISSUE 9: the write
+    path quantizes at append time via `quantized_page_write`, the
+    attend paths dequantize with the per-page-per-head scales);
+    tables: [B, P];
     write_page/write_off: [B, T] int32; pos_q: [B] context position of q
     row 0; q_len: [B] live rows per span (rows past it are padding).
     impl is the statically-resolved attention path ("reference" |
@@ -173,6 +203,7 @@ def paged_attend(q, k_new, v_new, layer_pools, tables, write_page,
     GSPMD partitions it from the pool sharding alone. Returns
     ([B, T, n_h*d], new_layer_pools)."""
     quantized = len(layer_pools) == 4
+    mixed = len(layer_pools) == 3
     if quantized:
         k_pool, v_pool, k_scale, v_scale = layer_pools
         k_pool, k_scale = quantized_page_write(k_pool, k_scale, write_page,
@@ -180,6 +211,26 @@ def paged_attend(q, k_new, v_new, layer_pools, tables, write_page,
         v_pool, v_scale = quantized_page_write(v_pool, v_scale, write_page,
                                                write_off, v_new)
         out_pools = (k_pool, v_pool, k_scale, v_scale)
+    elif mixed:
+        # mixed-precision tenants (ISSUE 15): fp32 storage + per-page
+        # tag plane — rows landing on fp8-tagged pages are written
+        # through the fp8 round-trip cast (exactly the value a native
+        # fp8 pool would dequantize); untagged pages take the verbatim
+        # fp32 write, so fp32 tenants stay bit-exact
+        k_pool, v_pool, tag = layer_pools
+        is8 = tag[write_page][..., None, None]              # [B, T, 1, 1]
+        k_pool = k_pool.at[write_page, write_off].set(
+            jnp.where(is8, fp8_round(k_new), k_new))
+        v_pool = v_pool.at[write_page, write_off].set(
+            jnp.where(is8, fp8_round(v_new), v_new))
+        out_pools = (k_pool, v_pool, tag)
+    elif k_new.dtype != layer_pools[0].dtype:
+        # native fp8 pools (ISSUE 15): append is a pure per-element
+        # cast — no scales, no requant-on-grow
+        k_pool, v_pool = layer_pools
+        k_pool = fp8_page_write(k_pool, write_page, write_off, k_new)
+        v_pool = fp8_page_write(v_pool, write_page, write_off, v_new)
+        out_pools = (k_pool, v_pool)
     else:
         k_pool, v_pool = layer_pools
         k_pool = k_pool.at[write_page, write_off].set(k_new)
@@ -190,10 +241,11 @@ def paged_attend(q, k_new, v_new, layer_pools, tables, write_page,
         from paddle_tpu.ops.pallas.paged_attention import \
             paged_decode_attention
 
-        if quantized:   # dispatch never routes int8 pools here
-            raise ValueError("paged_decode has no int8-pool path — "
-                             "_attn_impl_for routes int8 pools to the "
-                             "ragged kernel or the gather reference")
+        if quantized or str(k_pool.dtype).startswith("float8"):
+            # dispatch never routes int8/fp8 pools here
+            raise ValueError("paged_decode has no int8/fp8-pool path — "
+                             "_attn_impl_for routes quantized pools to "
+                             "the ragged kernel or the gather reference")
         fn = paged_decode_attention
         if shard_ctx is not None:
             fn = _shard_mapped_kernel(fn, shard_ctx,
@@ -276,6 +328,9 @@ class PagedModelRunner:
         if kv_dtype not in KV_DTYPES:
             raise ValueError(f"kv_dtype={kv_dtype!r}; expected one of "
                              f"{KV_DTYPES}")
+        if kv_dtype in ("fp8", "mixed"):
+            # loud at construction, never a silent fallback (ISSUE 15)
+            require_fp8(f"PagedModelRunner(kv_dtype={kv_dtype!r})")
         if weight_dtype not in WEIGHT_DTYPES:
             raise ValueError(f"weight_dtype={weight_dtype!r}; expected one "
                              f"of {WEIGHT_DTYPES}")
@@ -291,6 +346,22 @@ class PagedModelRunner:
         self.tp_size = 1
         self._layout = None                  # parallel.compat.SpecLayout
         self._param_shardings = None         # name -> NamedSharding
+        # quantized collectives (ISSUE 15): set by shard(comm_dtype=);
+        # "fp32" keeps the GSPMD-inserted psum (bit-exact default),
+        # "int8" routes the row-parallel matmuls through _row_mm's
+        # explicit shard_map + quantized reduce. _row_names are the
+        # params whose FINAL spec is row-parallel; _row_out_dims their
+        # output widths (the comm byte accounting's operand shapes)
+        self.comm_dtype = "fp32"
+        self._row_names: frozenset = frozenset()
+        self._row_out_dims: tuple = ()
+        # instrumented-comm counters (ISSUE 15): wire bytes PER SHARD
+        # the row-parallel allreduces moved at the configured comm
+        # dtype vs what fp32 psums would have moved for the same calls
+        # (scale bytes counted on the int8 side) — host-side analytics
+        # like the attention byte counters below
+        self.tp_comm_bytes = 0.0
+        self.tp_comm_bytes_fp32 = 0.0
         # instrumented-pool counters: HBM bytes of KV pool the chosen
         # attention path touches (host-side analytics, CPU-countable) vs
         # what the gather path would have read for the same calls.
@@ -335,12 +406,49 @@ class PagedModelRunner:
         the exact pre-ISSUE-9 `x @ w` (bit-identical default path);
         int8 weights dequantize in the matmul epilogue — the int8 codes
         are what HBM reads, the per-output-channel scale multiplies the
-        dot output (exactly `x @ (qw * scale)` by column linearity)."""
+        dot output (exactly `x @ (qw * scale)` by column linearity).
+        With a quantized comm_dtype (ISSUE 15), row-parallel weights
+        route through _row_mm's explicit shard_map + quantized reduce;
+        everything else (and the whole fp32-comm default) keeps the
+        GSPMD path verbatim."""
+        if self.comm_dtype != "fp32" and name in self._row_names:
+            return self._row_mm(params, name, x)
         w = params[name]
         s = params.get(name + SCALE_SUFFIX)
         if s is None:
             return x @ w
         return (x @ w.astype(x.dtype)) * s.astype(x.dtype)
+
+    def _row_mm(self, params, name, x):
+        """Row-parallel matmul with an EXPLICIT collective (ISSUE 15):
+        each model shard computes its partial product from its input
+        slice, then the layout's `row_parallel_reduce()` hook sums the
+        partials — `quantized_psum` at comm_dtype="int8" (per-row
+        chunked scales via pmax + int8 code psum + dequant). Runs as a
+        shard_map over the model axis because the collective must be
+        explicit to be quantized (GSPMD would insert its own fp32
+        psum). Weight-only int8 (ISSUE 9) composes: the
+        per-output-channel scale is replicated on row-parallel weights
+        and multiplies AFTER the reduce (exact by linearity for psum;
+        the honest dequant point for the quantized reduce)."""
+        from paddle_tpu.parallel.pipeline import compat_shard_map
+
+        axis = self.model_axis
+        reduce_fn = self._layout.row_parallel_reduce()
+        w = params[name]
+        s = params.get(name + SCALE_SUFFIX)
+        x_spec = P(*((None,) * (x.ndim - 1) + (axis,)))
+
+        def f(x_local, w_local):
+            part = x_local @ w_local.astype(x_local.dtype)
+            return reduce_fn(part, axis)
+
+        out = compat_shard_map(
+            f, mesh=self.mesh, in_specs=(x_spec, P(axis, None)),
+            out_specs=P(), axis_names=frozenset({axis}))(x, w)
+        if s is not None:
+            out = out * s.astype(x.dtype)
+        return out
 
     # --------------------------------------------------- sharding (ISSUE 7)
 
@@ -367,7 +475,8 @@ class PagedModelRunner:
         return True
 
     def shard(self, mesh, *, data_axis: str = "data",
-              model_axis: str = "model") -> "PagedModelRunner":
+              model_axis: str = "model",
+              comm_dtype: str = "fp32") -> "PagedModelRunner":
         """Shard this runner's weights over `mesh`'s model axis and
         re-mint every jitted step with explicit in/out shardings (the
         ISSUE 7 tentpole). Embeddings go vocab-sharded (replicated over
@@ -379,7 +488,18 @@ class PagedModelRunner:
         divisible by the model-axis degree is a LOUD error, never a
         silent replication. Params whose other dims don't divide (e.g. a
         prime vocab) fall back to replication for that one param, logged.
-        Idempotent per mesh; returns self for chaining."""
+        Idempotent per mesh; returns self for chaining.
+
+        `comm_dtype="int8"` (ISSUE 15) swaps the row-parallel allreduce
+        for the chunked two-level quantized reduce behind the layout's
+        `row_parallel_reduce()` hook: the affected matmuls run in an
+        explicit shard_map (`_row_mm`), everything else keeps the GSPMD
+        placement. "fp32" (default) changes nothing — bit-exact."""
+        from paddle_tpu.quantization.qcomm import COMM_DTYPES
+
+        if comm_dtype not in COMM_DTYPES:
+            raise ValueError(f"comm_dtype={comm_dtype!r}; expected one "
+                             f"of {COMM_DTYPES}")
         for axis in (data_axis, model_axis):
             if axis not in mesh.axis_names:
                 raise ValueError(
@@ -400,7 +520,8 @@ class PagedModelRunner:
                 f"parallel degree {tp} ({model_axis!r} axis)")
         from paddle_tpu.parallel.compat import SpecLayout
 
-        layout = SpecLayout(data_axis=data_axis, model_axis=model_axis)
+        layout = SpecLayout(data_axis=data_axis, model_axis=model_axis,
+                            comm_dtype=comm_dtype)
         specs = self._param_specs(layout)
         # weight-only int8 (ISSUE 9): a quantized weight's scale vector
         # shards WITH its output columns — column-parallel weights
@@ -429,12 +550,27 @@ class PagedModelRunner:
         self.tp_size = tp
         self._layout = layout
         self._param_shardings = shardings
+        # the row-parallel set (ISSUE 15): exactly the params whose
+        # FINAL spec is the row placement (fallback-replicated params
+        # excluded — they never psum), frozen so _mm's routing and the
+        # comm byte accounting can never disagree about which matmuls
+        # communicate
+        row = tuple(layout.row_parallel())
+        rows = sorted(n for n in specs
+                      if not n.endswith(SCALE_SUFFIX)
+                      and tuple(shardings[n].spec) == row)
+        self.comm_dtype = comm_dtype
+        self._row_names = frozenset(rows)
+        self._row_out_dims = tuple(int(self.params[n].shape[1])
+                                   for n in rows)
         self._jit_cache.clear()        # shardings are baked per jit entry
         logger.info(
             "serving runner sharded: mesh=%s tp=%d (%d/%d heads, %d/%d "
-            "kv-heads per shard)",
+            "kv-heads per shard) comm_dtype=%s (%d row-parallel "
+            "allreduces/step)",
             dict(mesh.shape), tp, self.n_heads // tp, self.n_heads,
-            self.n_kv_heads // tp, self.n_kv_heads)
+            self.n_kv_heads // tp, self.n_kv_heads, comm_dtype,
+            len(rows))
         return self
 
     @property
@@ -467,7 +603,10 @@ class PagedModelRunner:
             return jax.device_put(layer_data)
         kv = NamedSharding(self.mesh, P(None, self.model_axis, None))
         sc = NamedSharding(self.mesh, P(self.model_axis))
-        return [tuple(jax.device_put(a, kv if np.ndim(a) == 3 else sc)
+        rep = NamedSharding(self.mesh, P())
+        return [tuple(jax.device_put(
+                    a, kv if np.ndim(a) == 3
+                    else (rep if np.ndim(a) == 0 else sc))
                       for a in layer)
                 for layer in layer_data]
 
@@ -496,6 +635,10 @@ class PagedModelRunner:
         if self.kv_dtype == "int8":
             sc = NamedSharding(mesh, P(None, self.model_axis))
             layer = (kv, kv, sc, sc)
+        elif self.kv_dtype == "mixed":
+            # the per-page tag plane is page-indexed like the pools but
+            # has no head axis — replicated on every shard (ISSUE 15)
+            layer = (kv, kv, rep)
         else:
             layer = (kv, kv)
         pools = [layer for _ in range(self.num_layers)]
@@ -534,10 +677,12 @@ class PagedModelRunner:
             else:          # auto: kernels on TPU, gather oracle on CPU
                 impl = (best or "reference"
                         if jax.default_backend() == "tpu" else "reference")
-        if self.kv_dtype == "int8" and impl == "paged_decode":
-            # the single-token paged-decode kernel has no dequant step;
-            # int8 pools route to the ragged kernel (which dequantizes
-            # in its page walk) or the dequantizing gather reference
+        if self.kv_dtype in ("int8", "fp8") and impl == "paged_decode":
+            # the single-token paged-decode kernel has no dequant/cast
+            # step; int8 and native-fp8 pools route to the ragged
+            # kernel (which dequantizes in its page walk) or the
+            # gather reference ("mixed" pools store fp32 — they keep
+            # the full dispatch)
             from paddle_tpu.ops.pallas.ragged_paged_attention import \
                 ragged_attention_ok
 
@@ -562,6 +707,11 @@ class PagedModelRunner:
         data = self.block_size * nkv * self.head_dim
         if self.kv_dtype == "int8":
             return 2 * self.num_layers * (data + nkv * 4)
+        if self.kv_dtype == "fp8":
+            # native fp8 pages: 1 byte/element, no scale rows (ISSUE 15)
+            return 2 * self.num_layers * data
+        # "mixed" pools store fp32 (the tag plane steers the write
+        # path, the attend path never reads it) — fp32-width reads
         return 2 * self.num_layers * data * np.dtype(self.dtype).itemsize
 
     def _account_attn(self, impl: str, starts, q_lens, table_width: int):
@@ -589,9 +739,29 @@ class PagedModelRunner:
         self.attn_kv_bytes_read += pages * per_page
         self.attn_kv_bytes_gather += gather_pages * per_page
 
+    def _account_comm(self, rows: int, steps: int = 1) -> None:
+        """Bump the instrumented comm counters for one step call
+        (ISSUE 15): every forward runs all `_row_out_dims` row-parallel
+        allreduces over [rows, out_dim] activations (rows = the call's
+        padded B*T operand rows — what the wire actually carries), so
+        the per-shard wire bytes are countable host-side from the same
+        operands the device call gets, quantized-vs-fp32 honestly
+        (scale bytes included via qcomm.allreduce_bytes). No-op on
+        unsharded runners."""
+        if self.tp_size <= 1 or not self._row_out_dims:
+            return
+        from paddle_tpu.quantization.qcomm import allreduce_bytes
+
+        r = int(rows) * int(steps)
+        for d in self._row_out_dims:
+            self.tp_comm_bytes_fp32 += allreduce_bytes(r, d, "fp32")
+            self.tp_comm_bytes += allreduce_bytes(r, d, self.comm_dtype)
+
     def reset_attn_counters(self) -> None:
         self.attn_kv_bytes_read = 0.0
         self.attn_kv_bytes_gather = 0.0
+        self.tp_comm_bytes = 0.0
+        self.tp_comm_bytes_fp32 = 0.0
 
     # ------------------------------------------------------------- steps
 
@@ -837,6 +1007,7 @@ class PagedModelRunner:
         self._account_attn(self._attn_impl_for(tb),
                            np.asarray([start_pos]), np.asarray([t]),
                            len(table_row))
+        self._account_comm(tb)
         fn = self._jitted("prefill", tb)
         # host operands go to the jitted fn as-is — jit commits them in
         # one hop; a jnp.asarray(np.asarray(...)) round-trip here used to
@@ -853,6 +1024,7 @@ class PagedModelRunner:
         self._account_attn(self._attn_impl_for(1), pos_np,
                            np.ones_like(pos_np),
                            np.asarray(tables).shape[1])
+        self._account_comm(pos_np.shape[0])
         fn = self._jitted("decode", np.asarray(tokens).shape[0])
         toks, tabs, pos_a = self._stage(
             np.asarray(tokens, np.int32)[:, None],
@@ -895,6 +1067,7 @@ class PagedModelRunner:
             # earlier, so this upper-bounds the extended horizon's reads
             self._account_attn(impl, pos_np + t, np.ones_like(pos_np),
                                width)
+        self._account_comm(pos_np.shape[0], steps=num_steps)
         B = pos_np.shape[0]
         sampling = temps is not None
         extended = sampling or early_stop
@@ -941,6 +1114,7 @@ class PagedModelRunner:
         q_lens = np.asarray(q_lens, np.int32)
         self._account_attn(self._attn_impl_for(T), start_pos, q_lens,
                            np.asarray(tables).shape[1])
+        self._account_comm(B * T)
         fn = self._jitted("ragged_full" if full_logits else "ragged", (B, T))
         toks, tabs, starts, lens = self._stage(
             tokens, np.asarray(tables, np.int32), start_pos, q_lens)

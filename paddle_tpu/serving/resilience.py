@@ -447,13 +447,17 @@ def audit_engine(engine) -> None:
                 for p in cached):
             problems.append("prefix-cache hash index and page index disagree")
 
-    # -- quantized pools (ISSUE 9): an int8 pool's layer tuples must
-    #    carry the parallel scale pools — ONE scale per page per kv-head
-    #    — and the code pools must actually be int8; an fp32 pool must
-    #    carry the plain (k, v) pairs
+    # -- quantized pools (ISSUE 9 + 15): an int8 pool's layer tuples
+    #    must carry the parallel scale pools — ONE scale per page per
+    #    kv-head — and the code pools must actually be int8; an fp8
+    #    pool must store float8 pages and carry NO scale rows (fp8
+    #    casts are scale-free per element — a scale pool appearing on
+    #    an fp8 pool means someone reintroduced the int8 lifecycle); a
+    #    "mixed" pool carries the per-page tag plane; an fp32 pool
+    #    must carry the plain (k, v) pairs
     pool = engine.pool
     kv_dtype = getattr(pool, "kv_dtype", "fp32")
-    want_len = 4 if kv_dtype == "int8" else 2
+    want_len = {"int8": 4, "mixed": 3}.get(kv_dtype, 2)
     for li, layer in enumerate(pool.pools):
         if len(layer) != want_len:
             problems.append(
@@ -473,6 +477,57 @@ def audit_engine(engine) -> None:
                         f"{tuple(arr.shape)} != "
                         f"{(pool.num_blocks, pool.n_kv_heads)} — one scale "
                         "per page per kv-head")
+        elif kv_dtype == "fp8":
+            for nm, arr in (("k", layer[0]), ("v", layer[1])):
+                if not str(arr.dtype).startswith("float8"):
+                    problems.append(
+                        f"layer {li} {nm}-pool dtype {arr.dtype} is not "
+                        "a float8 type on an fp8 pool")
+        elif kv_dtype == "mixed":
+            tag = layer[2]
+            if str(tag.dtype) != "bool" or tuple(tag.shape) != (
+                    pool.num_blocks,):
+                problems.append(
+                    f"layer {li} tag plane shape/dtype "
+                    f"{tuple(tag.shape)}/{tag.dtype} != "
+                    f"({pool.num_blocks},)/bool")
+
+    # -- per-request kv-dtype tag bijection (ISSUE 15): every page a
+    #    running sequence owns carries exactly its owner's effective
+    #    kv_dtype tag, tagged pages are a subset of allocated pages,
+    #    the scratch page is never tagged, and on a "mixed" pool the
+    #    DEVICE tag planes agree with the host tag map on every
+    #    allocated page (and with each other across layers)
+    tags = dict(alloc._tags)
+    if SCRATCH_PAGE in tags:
+        problems.append("scratch page carries a kv-dtype tag")
+    stray = sorted(set(tags) - aset)
+    if stray:
+        problems.append(f"kv-dtype tags on unallocated pages: {stray}")
+    for req in sched.running:
+        if req.kv is None:
+            continue
+        want_tag = getattr(req.kv, "kv_tag", None)
+        bad = [p for p in req.kv.pages if tags.get(p) != want_tag]
+        if want_tag is not None and bad:
+            problems.append(
+                f"{req.request_id} (kv_tag={want_tag!r}) owns pages "
+                f"with mismatched tags: "
+                f"{[(p, tags.get(p)) for p in bad[:8]]}")
+    if kv_dtype == "mixed" and pool.pools:
+        planes = [np.asarray(layer[2]) for layer in pool.pools]
+        if any(not np.array_equal(planes[0], pl) for pl in planes[1:]):
+            problems.append("mixed-pool tag planes disagree across layers")
+        plane = planes[0]
+        for p in sorted(aset):
+            want8 = tags.get(p) == "fp8"
+            if bool(plane[p]) != want8:
+                problems.append(
+                    f"page {p} device tag bit {bool(plane[p])} != host "
+                    f"tag {tags.get(p)!r}")
+                break
+        if bool(plane[SCRATCH_PAGE]):
+            problems.append("scratch page tagged fp8 on the device plane")
 
     # -- sharded pools (ISSUE 7): per-shard shapes must agree with the
     #    replicated block tables — every model shard holds EVERY page's

@@ -86,12 +86,22 @@ class SamplingParams:
     # prefix-affinity, so repeat turns land where the session's KV pages
     # (device prefix cache + host tier) already live. None = stateless.
     session_id: Optional[str] = None
+    # per-request KV precision (ISSUE 15): None = the pool's own rung.
+    # On a kv_dtype="mixed" engine, "fp8" tenants get fp8-rounded pages
+    # (tagged at alloc, bit-identical to a native fp8 pool) beside
+    # "fp32" tenants in ONE pool geometry; on homogeneous pools only
+    # the pool's own dtype is accepted (the engine validates loudly).
+    kv_dtype: Optional[str] = None
 
     def __post_init__(self):
         if self.max_tokens < 1:
             raise ValueError("max_tokens must be >= 1")
         if self.timeout_s is not None and self.timeout_s <= 0:
             raise ValueError("timeout_s must be positive (None = no deadline)")
+        if self.kv_dtype not in (None, "fp32", "fp8", "int8"):
+            raise ValueError(
+                f"kv_dtype={self.kv_dtype!r}; expected None, 'fp32', "
+                "'fp8', or 'int8'")
 
 
 class RequestState(Enum):
@@ -269,9 +279,14 @@ class FCFSScheduler:
                 raise ValueError(
                     f"request {req.request_id} needs {need} pages > "
                     f"max_pages_per_seq={self.max_pages_per_seq}")
+            # the request's effective kv-dtype tag (ISSUE 15): every
+            # page it allocates is stamped with it, and its prefix
+            # chain is seeded by it (mixed-precision tenants can never
+            # share pages — their KV bytes for equal tokens differ)
+            tag = req.sampling.kv_dtype or self.pool.native_kv_tag()
             if cache is not None:
                 matched, host_matched = cache.match_tiered(
-                    req.context_tokens)
+                    req.context_tokens, tag=tag)
             else:
                 matched, host_matched = [], []
             if matched:
@@ -295,7 +310,7 @@ class FCFSScheduler:
                 # than the watermark must still be servable alone)
                 break
             self.waiting.popleft()
-            req.kv = SequenceKV(self.pool)
+            req.kv = SequenceKV(self.pool, kv_tag=tag)
             if matched:
                 req.kv.adopt_prefix(matched, bs)
             # host-demoted prefix pages: a fresh device page per hash,
@@ -312,6 +327,7 @@ class FCFSScheduler:
                 if slot is None:
                     break
                 page = alloc.alloc(1)[0]
+                self.pool.tag_pages([page], tag)
                 cache.register_page(page, h)
                 req.kv.pages.append(page)
                 req.kv.hash_chain.append(h)
@@ -336,6 +352,7 @@ class FCFSScheduler:
                             tier.free_slots([slot])
                             continue
                         page = alloc.alloc(1)[0]
+                        self.pool.tag_pages([page], tag)
                         req.kv.pages.append(page)
                         req.pending_pagein.append((page, slot))
                     req.admit_pagein_tokens = (off.covered_tokens

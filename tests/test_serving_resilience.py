@@ -388,7 +388,8 @@ def test_auditor_catches_leaked_and_double_owned_pages():
         audit_engine(eng)
     eng.pool.allocator._free.remove(page)      # un-corrupt
     eng.pool.allocator._ref[page] = 1
-    audit_engine(eng)
+    eng.pool.allocator._tags[page] = victim.kv.kv_tag   # tag died with
+    audit_engine(eng)                                   # the forced free
 
 
 def test_auditor_catches_slot_corruption():

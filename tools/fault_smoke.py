@@ -180,6 +180,21 @@ the store on whichever replica routing picks. Classes:
                   turn recomputes — token-exact, corruption never
                   served
 
+ISSUE 15: `--quant-comm` drills every fault class with BOTH new
+quantization rungs armed at once: tensor parallelism at tp=2 (unless
+--tp asks for more) with the int8-quantized row-parallel psum
+(comm_dtype="int8" — chunked two-level reduce behind the SpecLayout
+hook) AND native fp8 KV pages (kv_dtype="fp8", scale-free casts, no
+scale pools — the armed auditor asserts their ABSENCE). Both rungs are
+batch-shape invariant (per-row chunk scales / per-element casts), so
+the none/device_error classes stay TOKEN-EXACT against the engine's
+own naive oracle (same quantized runner), and an fp32 twin runner
+additionally gates greedy agreement >= 99% — the PR 9 split: exactness
+pinned against self, accuracy gated against fp32. `--comm-dtype` /
+`--kv-dtype fp8|mixed` are also available individually. Records add
+comm_dtype / tp_comm_bytes / tp_comm_bytes_reduction_x /
+fp32_greedy_agreement.
+
 ISSUE 5: `--speculate [K]` (K defaults to 4) drills every fault class
 with speculative decoding ON: decode rides n-gram verify spans through
 the full-logits ragged call — the same decode-op fault schedules now
@@ -319,7 +334,13 @@ def run_class(fault: str, runner, args) -> dict:
                or set(tier._hash) == set(tier._prefix.values()))
 
     oracle_ok = True
-    quantized = (args.kv_dtype != "fp32" or args.weight_dtype != "fp32")
+    accuracy = None
+    # int8 KV / int8 weights: chunked prefill legitimately changes the
+    # rounding vs a monolithic naive prefill -> twin pin. The ISSUE 15
+    # rungs (fp8 KV: per-element casts; int8 psum: per-row chunk
+    # scales) are BATCH-SHAPE INVARIANT, so they stay on the naive
+    # oracle — token-exact against the engine's own quantized runner
+    quantized = (args.kv_dtype == "int8" or args.weight_dtype == "int8")
     if fault in ("none", "device_error", "preempt_storm"):
         if quantized:
             # int8 pools: chunked prefill legitimately changes int8
@@ -347,9 +368,25 @@ def run_class(fault: str, runner, args) -> dict:
                 if outs[rid].output_tokens != ref:
                     oracle_ok = False
                     break
+        twin_fp32 = getattr(args, "fp32_twin_runner", None)
+        if twin_fp32 is not None and fault in ("none", "device_error"):
+            # ISSUE 15 accuracy gate (the PR 9 split): the quantized
+            # rungs are exactness-pinned against the engine's OWN
+            # oracle above; greedy agreement vs an fp32 twin runner is
+            # gated at >= 99% — quantization noise must not rewrite
+            # the streams wholesale
+            agree = total = 0
+            for rid, prompt, sp in work:
+                ref = naive_generate(twin_fp32, prompt, sp,
+                                     max_model_len=args.max_model_len)
+                got = outs[rid].output_tokens
+                total += max(len(ref), len(got))
+                agree += sum(int(a == b) for a, b in zip(ref, got))
+            accuracy = agree / total if total else 1.0
 
     ok = (crashed is None and leaks_ok and slots_ok and host_ok
           and oracle_ok and len(outs) == n
+          and (accuracy is None or accuracy >= 0.99)
           and all(o.finish_reason for o in outs.values()))
     return {
         "fault": fault, "ok": ok, "requests": n,
@@ -363,8 +400,12 @@ def run_class(fault: str, runner, args) -> dict:
         "offload_recompute_fallbacks": m["offload_recompute_fallbacks"],
         "host_tier_drops": m["host_tier_drops"],
         "kv_dtype": args.kv_dtype, "weight_dtype": args.weight_dtype,
+        "comm_dtype": getattr(runner, "comm_dtype", "fp32"),
         "kv_bytes_reduction_x": m["kv_bytes_reduction_x"],
         "sessions_per_pool_x": m["sessions_per_pool_x"],
+        "tp_comm_bytes": m["tp_comm_bytes"],
+        "tp_comm_bytes_reduction_x": m["tp_comm_bytes_reduction_x"],
+        "fp32_greedy_agreement": accuracy,
         "finish_reasons": reasons,
         "no_unhandled_exception": crashed is None,
         "crash": crashed,
@@ -1195,16 +1236,40 @@ def main() -> int:
                          "oracle on CPU; ragged: force the ragged "
                          "paged-attention kernel, interpret mode off-TPU)")
     ap.add_argument("--kv-dtype", default="fp32",
-                    choices=("fp32", "int8"),
-                    help="K/V page pool storage (ISSUE 9): int8 codes + "
-                         "per-page-per-head scale pools, dequantized in "
-                         "the attention page walk (default fp32)")
+                    choices=("fp32", "int8", "fp8", "mixed"),
+                    help="K/V page pool storage (ISSUE 9/15): int8 codes "
+                         "+ per-page-per-head scale pools; fp8 native "
+                         "float8_e4m3fn pages (scale-free casts); mixed "
+                         "= fp32 storage serving per-request fp8 tenants "
+                         "(default fp32)")
     ap.add_argument("--weight-dtype", default="fp32",
                     choices=("fp32", "int8"),
                     help="matmul weight storage (ISSUE 9): weight-only "
                          "int8 with per-output-channel scales, dequant "
                          "in the matmul epilogue (default fp32)")
+    ap.add_argument("--comm-dtype", default="fp32",
+                    choices=("fp32", "int8"),
+                    help="row-parallel allreduce wire precision (ISSUE "
+                         "15): int8 = the chunked two-level quantized "
+                         "psum behind the SpecLayout hook (needs --tp "
+                         ">= 2; default fp32)")
+    ap.add_argument("--quant-comm", action="store_true",
+                    help="ISSUE 15 drill: arm BOTH new rungs at once — "
+                         "tp=2 (unless --tp asks for more) with the "
+                         "int8-quantized psum AND fp8 KV pages; "
+                         "none/device_error stay token-exact vs the "
+                         "engine's own oracle and gate greedy agreement "
+                         ">= 99%% vs an fp32 twin runner")
     args = ap.parse_args()
+    if args.quant_comm:
+        args.tp = max(args.tp, 2)
+        args.comm_dtype = "int8"
+        if args.kv_dtype == "fp32":
+            args.kv_dtype = "fp8"
+    if args.comm_dtype != "fp32" and args.tp < 2:
+        raise SystemExit("--comm-dtype int8 needs --tp >= 2 (the "
+                         "quantized collective replaces the row-parallel "
+                         "allreduce, which only exists at tp > 1)")
     if args.pipelined and args.decode_horizon == 1:
         args.decode_horizon = 4     # horizons must actually engage
     # refcounted invariants audited after every step, engine-independent
@@ -1231,7 +1296,15 @@ def main() -> int:
     if args.tp > 1:
         from paddle_tpu.parallel.mesh import serving_mesh
 
-        runner.shard(serving_mesh(data=1, model=args.tp))
+        runner.shard(serving_mesh(data=1, model=args.tp),
+                     comm_dtype=args.comm_dtype)
+    if args.comm_dtype != "fp32" or args.kv_dtype in ("fp8", "mixed"):
+        # the ISSUE 15 accuracy gate's fp32 twin: an UNSHARDED fp32
+        # runner of the same weights (the fp32 tp engine is pinned
+        # bit-exact to it, so this is the same oracle, compile-cheaper)
+        args.fp32_twin_runner = LlamaRunner(
+            model, block_size=args.block_size,
+            max_model_len=args.max_model_len, attn_impl=args.attn_impl)
     if args.net_child:
         # router_kill's child: host the journaling router until the
         # parent SIGKILLs this process (no warmup detour — the parent
