@@ -1226,6 +1226,123 @@ def child_serving_zero_bubble(layers: int, hidden: int, max_batch: int,
                                        else 0.0)})
 
 
+def child_serving_spec_horizon(layers: int, hidden: int, max_batch: int,
+                               requests: int, prompt: int, gen: int,
+                               vocab: int):
+    """Verify-in-scan rung (ISSUE 18): the repetition-heavy speculative
+    workload on the PIPELINED multi-step engine (decode_horizon=8,
+    early stop, horizon sampling), swept over four arms:
+
+      off          num_speculative_tokens=0 — the non-speculative s=8
+                   pipelined baseline BOTH acceptance numbers compare
+                   against (steps/token reduction AND the
+                   syncs-no-worse bar)
+      per_step     n-gram speculation forced onto the legacy per-step
+                   verify path (sampled rows + horizon_sampling=False
+                   — the ISSUE-5 routing): one host sync per decode
+                   step, the cost the tentpole removes
+      ngram_fused  the same n-gram drafts verified ON DEVICE inside
+                   the scan (ISSUE 18 tentpole): one packed drain per
+                   horizon, steps AND syncs collapse together
+      draft_fused  the model-based rung — spec_draft_model shadows the
+                   target (fp32) with adaptive per-request k: the
+                   acceptance-rate upper bound for draft-model
+                   speculation at zero extra weight memory
+
+    off/ngram_fused/draft_fused run greedy and are token-exact with
+    each other; per_step runs seeded-sampled (the spelling that forces
+    the legacy route) so its steps/syncs are the contrast, not its
+    stream. Headline: step_reduction_x (off over ngram_fused
+    steps/token) and sync_ratio_vs_off (must stay <= 1.0)."""
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.serving import GPTRunner, SamplingParams, ServingEngine
+
+    backend = jax.default_backend()
+    paddle.seed(0)
+    max_len = prompt + gen
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+                    num_heads=max(hidden // 64, 1), max_seq_len=max_len,
+                    dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+    block_size = min(16, max_len)
+    runner = GPTRunner(model, block_size=block_size, max_model_len=max_len)
+    pages_per_seq = -(-max_len // block_size)
+    rng = np.random.default_rng(0)
+    prompts = []
+    for _ in range(requests):
+        pattern = list(rng.integers(0, vocab, int(rng.integers(3, 7))))
+        prompts.append((pattern * (prompt // len(pattern) + 1))[:prompt])
+
+    def run_once(name: str, spec: int, sampled: bool = False,
+                 **kw) -> dict:
+        kw.setdefault("horizon_sampling", True)
+        eng = ServingEngine(runner,
+                            num_blocks=max_batch * pages_per_seq + 1,
+                            max_batch_size=max_batch, max_model_len=max_len,
+                            max_prefill_tokens_per_step=4 * block_size,
+                            decode_horizon=8, pipelined=True,
+                            horizon_early_stop=True,
+                            num_speculative_tokens=spec, **kw)
+        t0 = time.time()
+        for i, p in enumerate(prompts):
+            sp = SamplingParams(
+                max_tokens=gen,
+                temperature=0.8 if sampled else 0.0,
+                seed=1000 + i if sampled else None)
+            eng.add_request(p, sp, request_id=f"r{i}")
+        eng.run()
+        wall = time.time() - t0
+        snap = eng.metrics.snapshot()
+        return {"arm": name, "speculative_tokens": spec,
+                "wall_s": round(wall, 3),
+                "tokens_per_sec": snap["tokens_generated"] / wall,
+                "tokens_generated": snap["tokens_generated"],
+                "decode_steps": snap["decode_steps"],
+                "steps_per_token": snap["steps_per_token"],
+                "host_syncs": snap["host_syncs"],
+                "host_syncs_per_token": snap["host_syncs_per_token"],
+                "spec_fused_horizons": snap["spec_fused_horizons"],
+                "spec_dead_positions": snap["spec_dead_positions"],
+                "spec_proposed_tokens": snap["spec_proposed_tokens"],
+                "spec_accepted_tokens": snap["spec_accepted_tokens"],
+                "spec_acceptance_rate": snap["spec_acceptance_rate"]}
+
+    arms_spec = [
+        ("off", 0, False, {}),
+        ("per_step", 4, True, {"horizon_sampling": False}),
+        ("ngram_fused", 4, False, {}),
+        ("draft_fused", 4, False, {"spec_draft_model": "shadow:fp32",
+                                   "spec_adaptive_k": True}),
+    ]
+    for name, spec, sampled, kw in arms_spec:    # warmup/compile pass
+        run_once(name, spec, sampled, **kw)
+    arms = [run_once(name, spec, sampled, **kw)
+            for name, spec, sampled, kw in arms_spec]
+    off, fused = arms[0], arms[2]
+    _write_child({"backend": backend, "layers": layers, "hidden": hidden,
+                  "max_batch": max_batch, "requests": requests,
+                  "prompt": prompt, "gen": gen,
+                  "workload": "spec_horizon", "arms": arms,
+                  "step_reduction_x": (off["steps_per_token"]
+                                       / fused["steps_per_token"]
+                                       if fused["steps_per_token"]
+                                       else 0.0),
+                  "sync_ratio_vs_off": (fused["host_syncs_per_token"]
+                                        / off["host_syncs_per_token"]
+                                        if off["host_syncs_per_token"]
+                                        else 0.0),
+                  "tokens_per_sec_x": (fused["tokens_per_sec"]
+                                       / off["tokens_per_sec"]
+                                       if off["tokens_per_sec"]
+                                       else 0.0)})
+
+
 def child_serving_tp(layers: int, hidden: int, max_batch: int,
                      requests: int, prompt: int, gen: int, vocab: int):
     """Tensor-parallel serving rung (ISSUE 7): the same closed-batch
@@ -2392,6 +2509,45 @@ def main():
                 f" ({r['step_reduction_x']:.2f}x fewer), acceptance "
                 f"{sp['spec_acceptance_rate']*100:.0f}%")
 
+    # verify-in-scan rung (ISSUE 18): speculation riding INSIDE the
+    # pipelined multi-step scan — off / legacy per-step / fused n-gram /
+    # fused shadow-draft arms; commits the steps-per-token reduction vs
+    # the non-speculative s=8 baseline and the syncs-no-worse ratio
+    if on_tpu and remaining() > 120:
+        r = run_child("serving:6:512:4:8:96:64:32768:spec_horizon",
+                      min(900, remaining()))
+        if r is not None:
+            by = {a["arm"]: a for a in r["arms"]}
+            off, step = by["off"], by["per_step"]
+            fused, draft = by["ngram_fused"], by["draft_fused"]
+            line = {"metric": "serving_spec_horizon_step_reduction_x",
+                    "value": round(r["step_reduction_x"], 2),
+                    "unit": "x", "vs_baseline": 0.0,
+                    "sync_ratio_vs_off": round(r["sync_ratio_vs_off"], 3),
+                    "tokens_per_sec_x": round(r["tokens_per_sec_x"], 2),
+                    "fused_tokens_per_sec":
+                        round(fused["tokens_per_sec"], 1),
+                    "off_tokens_per_sec": round(off["tokens_per_sec"], 1),
+                    "per_step_syncs_per_token":
+                        round(step["host_syncs_per_token"], 4),
+                    "fused_syncs_per_token":
+                        round(fused["host_syncs_per_token"], 4),
+                    "fused_acceptance_rate":
+                        round(fused["spec_acceptance_rate"], 4),
+                    "draft_acceptance_rate":
+                        round(draft["spec_acceptance_rate"], 4),
+                    "draft_dead_positions": draft["spec_dead_positions"],
+                    "backend": r["backend"]}
+            emit(line)
+            _cache_result(line)
+            log(f"spec_horizon rung: {r['step_reduction_x']:.2f}x fewer "
+                f"steps/token vs s=8 baseline, syncs ratio "
+                f"{r['sync_ratio_vs_off']:.2f} (per-step arm "
+                f"{step['host_syncs_per_token']:.3f} -> fused "
+                f"{fused['host_syncs_per_token']:.3f}), acceptance "
+                f"ngram {fused['spec_acceptance_rate']*100:.0f}% / draft "
+                f"{draft['spec_acceptance_rate']*100:.0f}%")
+
     # multi-step decode rung (ISSUE 6): pure-greedy workload at
     # decode_horizon 1/4/8; commits tokens/s per arm and the
     # host-syncs-per-token trajectory (the >= 4x reduction criterion
@@ -2717,6 +2873,8 @@ def _child_main(mode: str) -> None:
             child_serving_multistep(*[int(x) for x in parts[:-1]])
         elif parts and parts[-1] == "zero_bubble":
             child_serving_zero_bubble(*[int(x) for x in parts[:-1]])
+        elif parts and parts[-1] == "spec_horizon":
+            child_serving_spec_horizon(*[int(x) for x in parts[:-1]])
         elif parts and parts[-1] == "tp":
             child_serving_tp(*[int(x) for x in parts[:-1]])
         elif parts and parts[-1] == "router":

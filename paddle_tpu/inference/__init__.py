@@ -242,7 +242,17 @@ def create_serving_router(model, *, replicas: int = 2, dtype=None,
     mesh whose data-axis degree equals `replicas` — it is then split into
     per-replica `(model,)` sub-meshes via parallel.mesh.replica_submeshes,
     finally mapping the data axis onto engine replicas. A single mesh
-    with data=1 shards every replica identically."""
+    with data=1 shards every replica identically.
+
+    Every other keyword reaches each replica's ServingEngine verbatim —
+    including the speculation knobs (ISSUE 18): num_speculative_tokens,
+    spec_max_ngram/spec_min_ngram/spec_ngram_window, spec_adaptive_k,
+    and spec_draft_model/spec_draft_blocks. On the process backend
+    (backend="process") engine_kw crosses the wire as JSON, so pass the
+    draft rung as its "shadow[:int8|fp32]" string spec (each child
+    builds its own shadow from its own runner), not a runner instance;
+    the same string round-trips through engine snapshots, so a
+    Supervisor respawn keeps the tier speculating."""
     import jax.numpy as jnp
 
     from paddle_tpu.serving import ServingRouter

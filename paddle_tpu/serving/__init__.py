@@ -51,12 +51,21 @@ paged-decode kernel (`ops/pallas/paged_attention.py`):
                    packed token buffer per horizon instead of one
                    transfer per token;
   speculate.py     NgramProposer (ISSUE 5): model-free prompt-lookup
-                   draft proposals mined from the request's own context;
-                   the engine verifies all k+1 span positions in ONE
-                   fused ragged launch and accepts the longest draft
-                   prefix the target model reproduces — several tokens
-                   per engine step on repetition-heavy workloads,
-                   token-exact vs naive_generate by construction;
+                   draft proposals mined from the request's own context
+                   (incrementally indexed, ISSUE 18); the engine
+                   verifies all k+1 span positions in ONE fused launch
+                   and accepts the longest draft prefix the target
+                   model reproduces — several tokens per engine step on
+                   repetition-heavy workloads, token-exact vs
+                   naive_generate by construction. ISSUE 18 moves the
+                   verify spans INSIDE the decode_multi scan
+                   (runner.decode_multi_spec: accept/reject on device,
+                   one drain per horizon, composing with pipelined /
+                   horizon_sampling / early stop) and adds the model-
+                   based draft rung: DraftModelProposer (a small or
+                   int8-shadow runner proposing whole chains) plus
+                   AdaptiveK (per-request acceptance-EWMA draft
+                   lengths);
   detokenize.py    StreamDetokenizer (ISSUE 5): incremental streaming
                    detokenization over TokenEvents, buffering raw bytes
                    to byte-complete UTF-8 boundaries
@@ -194,7 +203,9 @@ from paddle_tpu.serving.router import (  # noqa: F401
 from paddle_tpu.serving.scheduler import (  # noqa: F401
     FCFSScheduler, Request, RequestState, SamplingParams,
 )
-from paddle_tpu.serving.speculate import NgramProposer  # noqa: F401
+from paddle_tpu.serving.speculate import (  # noqa: F401
+    AdaptiveK, DraftModelProposer, NgramProposer, shadow_runner,
+)
 from paddle_tpu.serving.supervisor import Supervisor  # noqa: F401
 # the serving (data, model) mesh builder + spec layout (ISSUE 7) and the
 # per-replica sub-mesh splitter (ISSUE 8) live in parallel/ —
@@ -205,6 +216,7 @@ from paddle_tpu.parallel.mesh import (  # noqa: F401
 from paddle_tpu.parallel.compat import SpecLayout  # noqa: F401
 
 __all__ = [
+    "AdaptiveK", "DraftModelProposer", "shadow_runner",
     "BlockAllocator", "Counter", "EngineMetrics", "EngineReplica",
     "FCFSScheduler", "FaultInjector", "GPTRunner", "Gauge", "Histogram",
     "HostKVTier", "InjectedDeviceError", "InvariantViolation",

@@ -28,7 +28,16 @@ target model reproduces (argmax equality under greedy; the seeded step-
 indexed sample under temperature > 0) is accepted at once — rejected-
 tail KV rolls back through the refcount machinery (`SequenceKV.truncate`
 + page decref) so a speculated page never leaks or corrupts the prefix
-cache.
+cache. ISSUE 18 moves the verify spans INSIDE the device-resident scan
+whenever no prefill chunk shares the step (`runner.decode_multi_spec`:
+per-position accept/reject on device, bit-identical to the host loop,
+one packed drain per horizon), composing speculation with `pipelined`,
+`decode_horizon`, `horizon_sampling`, `horizon_early_stop`, and tp>1 —
+with a model-based draft rung (`spec_draft_model`, a quantized shadow
+or any small runner proposing whole chains) and per-request
+acceptance-adaptive draft lengths (`spec_adaptive_k`) beside the
+n-gram proposer. The per-step ragged path remains the fallback for
+chunk-sharing steps and batches outside the in-scan sampler envelope.
 
 With `decode_horizon=s > 1` (ISSUE 6) the engine stops paying a host
 round-trip per token: a pure-greedy decode batch runs s consecutive
@@ -40,8 +49,9 @@ per horizon (`host_syncs` drops toward tokens/s) instead of blocking on
 every step's logits. The drained buffer replays token-by-token through
 the same stop/length/NaN bookkeeping, discarding overshoot past a stop
 and reclaiming its pages, so the token streams are the s=1 streams
-verbatim; batches the horizon can't serve (temperature > 0, verify
-spans, prefill chunks in flight) fall back to the per-step path.
+verbatim; batches the horizon can't serve (temperature > 0 without
+horizon_sampling, prefill chunks in flight) fall back to the per-step
+path — verify spans ride their own fused scan (ISSUE 18).
 
 With `host_tier_pages=N > 0` (ISSUE 10) preemption stops costing a
 re-prefill: victims spill their exclusively-owned KV pages to a pinned
@@ -116,7 +126,8 @@ from paddle_tpu.serving.scheduler import (
     FCFSScheduler, Request, RequestState, SamplingParams,
     ensure_arrival_counter_above,
 )
-from paddle_tpu.serving.speculate import NgramProposer
+from paddle_tpu.serving.speculate import (AdaptiveK, DraftModelProposer,
+                                          NgramProposer, shadow_runner)
 
 logger = logging.getLogger(__name__)
 
@@ -207,7 +218,7 @@ class _InflightLaunch:
     launch consumed, kept so a drain-time device error can roll back and
     rerun the step through the normal retry path."""
 
-    kind: str                    # "decode" | "decode_multi" | "ragged"
+    kind: str        # "decode" | "decode_multi" | "decode_spec" | "ragged"
     batch: list                  # [(Request, slot), ...] at launch
     result: object               # logits [B, V] or packed [2|3, B, s]
     prev_pools: list             # pool snapshot for drain-failure rollback
@@ -217,6 +228,13 @@ class _InflightLaunch:
     # launch time — so the commit can replay chunk-coverage advances
     # and completing-chunk samples exactly like the sync path
     spans: Optional[list] = None
+    # fused speculative horizons (ISSUE 18) carry the launch's draft
+    # grid ([B, s, K] -1-padded) for the commit-time accept replay, and
+    # a per-request {id(req): funded_upcoming_tokens} map so the
+    # auditor's over-provision check credits exactly the pages
+    # plan_spec_horizon committed (s alone under-counts a k>0 row)
+    spec: Optional[dict] = None
+    upcoming: Optional[dict] = None
 
 
 class ServingEngine:
@@ -420,6 +438,25 @@ class ServingEngine:
                            default.
       spec_max_ngram /     suffix n-gram lengths the draft proposer
       spec_min_ngram       matches (longest first, most recent wins)
+      spec_ngram_window    bound the stateless n-gram scan to the last
+                           N context tokens (ISSUE 18); None =
+                           unbounded (the per-request incremental
+                           suffix index makes the engine's own calls
+                           O(1) amortized either way)
+      spec_adaptive_k      acceptance-rate-adaptive per-request draft
+                           length (ISSUE 18): an EWMA over
+                           accepted/proposed clamps each request's k
+                           into [0, num_speculative_tokens], so a
+                           low-acceptance stream stops paying dead
+                           verify positions
+      spec_draft_model     model-based draft rung (ISSUE 18): None =
+                           n-gram prompt lookup; "shadow[:int8|fp32]"
+                           = a quantized shadow of the target runner
+                           proposing whole greedy chains from its own
+                           small paged pool (spec_draft_blocks caps
+                           it); or a runner instance (same tokenizer).
+                           Drafts never affect token streams — only
+                           the acceptance rate
       tokenizer            optional tokenizer (id_to_bytes(tok) or
                            decode([tok])) enabling stream_text():
                            incremental detokenization that buffers
@@ -461,6 +498,10 @@ class ServingEngine:
                  num_speculative_tokens: int = 0,
                  spec_max_ngram: int = 3,
                  spec_min_ngram: int = 1,
+                 spec_adaptive_k: bool = False,
+                 spec_draft_model=None,
+                 spec_draft_blocks: Optional[int] = None,
+                 spec_ngram_window: Optional[int] = None,
                  tokenizer=None,
                  sleep_fn: Optional[Callable[[float], None]] = None,
                  audit: Optional[bool] = None):
@@ -538,11 +579,45 @@ class ServingEngine:
         self.num_speculative_tokens = int(num_speculative_tokens)
         self.spec_max_ngram = int(spec_max_ngram)
         self.spec_min_ngram = int(spec_min_ngram)
+        self.spec_adaptive_k = bool(spec_adaptive_k)
+        self.spec_ngram_window = (int(spec_ngram_window)
+                                  if spec_ngram_window else None)
+        self.spec_draft_blocks = (int(spec_draft_blocks)
+                                  if spec_draft_blocks else None)
+        # draft rung spec (ISSUE 18): None = n-gram prompt lookup; a
+        # "shadow[:int8|fp32]" string builds a quantized shadow of the
+        # target runner; a runner instance is used directly (recorded
+        # as "custom" — a snapshot cannot rebuild it)
+        self.spec_draft_model = (spec_draft_model
+                                 if isinstance(spec_draft_model, str)
+                                 else None if spec_draft_model is None
+                                 else "custom")
         # the proposer validates the n-gram range; built lazily-but-eager
         # here so a bad knob combination fails at construction time
-        self.proposer = (NgramProposer(self.spec_max_ngram,
-                                       self.spec_min_ngram)
-                         if self.num_speculative_tokens else None)
+        self.proposer = None
+        if self.num_speculative_tokens:
+            if spec_draft_model is not None:
+                if isinstance(spec_draft_model, str):
+                    base, _, dt = spec_draft_model.partition(":")
+                    if base != "shadow":
+                        raise ValueError(
+                            f"spec_draft_model={spec_draft_model!r}; "
+                            "expected a runner instance or "
+                            "'shadow[:int8|fp32]'")
+                    draft = shadow_runner(runner, dt or "int8")
+                else:
+                    draft = spec_draft_model
+                self.proposer = DraftModelProposer(
+                    draft, num_blocks=self.spec_draft_blocks,
+                    max_model_len=self.max_model_len)
+            else:
+                self.proposer = NgramProposer(
+                    self.spec_max_ngram, self.spec_min_ngram,
+                    scan_window=self.spec_ngram_window)
+        # acceptance-rate-adaptive per-request draft length (ISSUE 18)
+        self.adaptive_k = (AdaptiveK(self.num_speculative_tokens)
+                          if self.num_speculative_tokens
+                          and self.spec_adaptive_k else None)
         self.tokenizer = tokenizer
         self._detoks: Dict[str, StreamDetokenizer] = {}
         self.max_pages_per_seq = self.pool.blocks_for_tokens(
@@ -703,6 +778,7 @@ class ServingEngine:
             req.finish_reason = reason
         else:                                    # pragma: no cover
             return
+        self._release_spec_state(req)
         req.finish_time = now
         if not counted:        # shed is pre-counted at the add_request gate
             counter = {"timeout": self.metrics.requests_timed_out,
@@ -954,16 +1030,29 @@ class ServingEngine:
                      and self.scheduler.decode_ready())
         if self.num_speculative_tokens > 0 and self.scheduler.decode_ready():
             chunk_tokens = sum(end - start for _, start, end in plan)
-            if not fused:
-                for req, start, end in plan:
-                    ev = self._prefill_chunk_with_recovery(req, start, end)
-                    if ev is not None:
-                        events.append(ev)
-            for v in self.scheduler.reserve_decode():
-                self.metrics.preemptions.inc()
-            proposals = self._plan_speculation(chunk_tokens)
-            events.extend(self._ragged_step_with_recovery(
-                proposals, include_chunks=fused))
+            if not plan and self._spec_horizon_ready():
+                # fused verify-in-scan (ISSUE 18): drafts ride the
+                # device-resident horizon — accept/reject on device,
+                # ONE drain per horizon, defers like any horizon
+                for v in self.scheduler.reserve_decode():
+                    self.metrics.preemptions.inc()
+                events.extend(self._decode_spec_with_recovery(
+                    defer=self.pipelined))
+            else:
+                # per-step verify fallback: prefill chunks this step
+                # (they fuse into the ragged launch under ragged_batch)
+                # or a batch outside the in-scan sampler's envelope
+                if not fused:
+                    for req, start, end in plan:
+                        ev = self._prefill_chunk_with_recovery(req, start,
+                                                               end)
+                        if ev is not None:
+                            events.append(ev)
+                for v in self.scheduler.reserve_decode():
+                    self.metrics.preemptions.inc()
+                proposals = self._plan_speculation(chunk_tokens)
+                events.extend(self._ragged_step_with_recovery(
+                    proposals, include_chunks=fused))
         elif fused:
             for v in self.scheduler.reserve_decode():
                 self.metrics.preemptions.inc()
@@ -1080,6 +1169,20 @@ class ServingEngine:
         req.phase = "decode"
         return self._append_token(req, tok)
 
+    def _release_spec_state(self, req: Request) -> None:
+        """Drop per-request proposer/adaptive-k state on ANY terminal
+        path (normal finish and abnormal alike): the incremental n-gram
+        suffix index, a draft model's shadow KV pages, and the
+        acceptance-rate EWMA all key on request_id and would otherwise
+        leak across a long-lived engine."""
+        if self.num_speculative_tokens <= 0:
+            return
+        release = getattr(self.proposer, "release", None)
+        if release is not None:
+            release(req.request_id)
+        if self.adaptive_k is not None:
+            self.adaptive_k.release(req.request_id)
+
     def _plan_speculation(self, chunk_tokens: int) -> Dict[Request,
                                                            List[int]]:
         """n-gram draft proposals for this step's decode batch (ISSUE 5),
@@ -1094,13 +1197,16 @@ class ServingEngine:
         proposals: Dict[Request, List[int]] = {}
         for req in self.scheduler.decode_ready():      # admission order
             k = self.num_speculative_tokens
+            if self.adaptive_k is not None:
+                k = min(k, self.adaptive_k.k_for(req.request_id))
             k = min(k, req.sampling.max_tokens - len(req.output_tokens) - 1)
             k = min(k, self.max_model_len - req.num_context)
             if budget is not None:
                 k = min(k, budget)
             if k <= 0:
                 continue
-            prop = self.proposer.propose(req.context_tokens, k)
+            prop = self.proposer.propose(req.context_tokens, k,
+                                         request_id=req.request_id)
             if not prop:
                 continue
             if budget is not None:
@@ -1136,8 +1242,10 @@ class ServingEngine:
         _finish_ragged — chunk coverage advances, completing-chunk
         samples, fused decode appends — and the next step's prefill
         plan is re-sliced AFTER that commit, so no chunk is ever
-        computed twice. Verify spans (proposals) never defer:
-        speculation keeps its per-step fallback for now."""
+        computed twice. Verify spans (proposals) never defer HERE: this
+        is speculation's per-step fallback (chunks in flight, or a
+        batch outside the in-scan sampler's envelope) — the fused path
+        that does defer is _decode_spec_with_recovery (ISSUE 18)."""
         from paddle_tpu.serving.model_runner import bucket_len
 
         full = proposals is not None
@@ -1321,6 +1429,9 @@ class ServingEngine:
                 break
         self.metrics.spec_proposed_tokens.inc(k)
         self.metrics.spec_accepted_tokens.inc(accepted)
+        self.metrics.spec_dead_positions.inc(max(k - accepted, 0))
+        if self.adaptive_k is not None:
+            self.adaptive_k.update(req.request_id, k, accepted)
         # positions C..C+accepted-1 hold accepted-draft KV; the rejected
         # tail [C+accepted, C+k) is dead weight — roll it back through
         # the refcount machinery, then register/append
@@ -1338,6 +1449,283 @@ class ServingEngine:
         if aborted and not req.done:
             self._finish_abnormal(req, "error")
 
+    # ------------------------------- fused verify-in-scan (ISSUE 18)
+
+    def _spec_horizon_ready(self) -> bool:
+        """Gate for the fused verify-in-scan path (ISSUE 18 tentpole):
+        True when this step's decode batch can ride drafts inside the
+        device-resident scan. Mirrors _plan_horizon's sampling envelope
+        — the in-scan sampler bakes ONE (top_k, top_p) pair per jit
+        entry and carries int32 seeds — and defers to the per-step
+        verify path for a batch carrying a mid-horizon NaN deferral
+        (the per-step path refetches real logits to rescue from).
+        Unlike _plan_horizon there is no decode_horizon >= 2
+        requirement: a fused verify span wins even at s == 1 (one
+        drain resolves k+1 tokens instead of a full-logits pull)."""
+        batch = self.scheduler.decode_ready()
+        if not batch:
+            return False
+        deferred = False
+        for r in batch:
+            if r.defer_horizon:
+                r.defer_horizon = False
+                deferred = True
+        if deferred:
+            return False
+        sampled = [r for r in batch if r.sampling.temperature != 0.0]
+        if sampled:
+            if not self.horizon_sampling:
+                return False
+            if len({(r.sampling.top_k, r.sampling.top_p)
+                    for r in sampled}) > 1:
+                return False
+            if any((r.sampling.seed if r.sampling.seed is not None
+                    else r.arrival_index) >= 2 ** 31 for r in sampled):
+                return False
+        return True
+
+    def _decode_spec_with_recovery(self, defer: bool = False
+                                   ) -> List[TokenEvent]:
+        """One fused speculative horizon (ISSUE 18 tentpole): the
+        batch's next `s` scan steps each carry a per-row draft span —
+        k proposed tokens, -1-padded to the batch's bucketed K —
+        through runner.decode_multi_spec, where accept/reject is
+        resolved ON DEVICE per position and the corrected/bonus token
+        feeds back into the scan. The host drains ONE packed
+        [3, B, s, K+1] buffer per horizon (host_syncs += 1, not one
+        full-logits pull per verify span) and replays acceptance
+        through _replay_spec_horizon, which applies exactly
+        _accept_verify's bookkeeping per kept position.
+
+        Drafts come from ONE proposer chain per row per horizon
+        (s*(k+1)-1 tokens — the continuation under full acceptance),
+        sliced at fixed (k+1)-strides: after a rejection the remaining
+        slices usually stop matching and the row degrades to plain
+        multi-step decode for the horizon's tail. Exactness never
+        depends on draft quality — a wrong draft is simply rejected
+        and the device emits the target model's own token.
+
+        Page funding goes through scheduler.plan_spec_horizon: up to
+        min(s*(k+1), remaining+k) tokens per row, trimming s first and
+        then per-row k under pool pressure, never preempting. The
+        on-device stop plane ALWAYS runs in this mode (stop_ids +
+        remaining budgets) — it is what bounds kept emissions by
+        `remaining` and makes that funding formula a true worst case.
+
+        Retries are exact like every other launch kind: proposals are
+        deterministic given the (unchanged) context, and acceptance is
+        deterministic given the seeded streams, so a rebuilt launch
+        commits the identical token stream; exhausted retries
+        quarantine the youngest spanning request and rebuild. With
+        `defer` (pipelined) the launch stays IN FLIGHT and the next
+        step's commit drains it; the _InflightLaunch carries the draft
+        grid for commit-time replay and the per-row funded `upcoming`
+        token counts for the auditor's over-provision credit."""
+        from paddle_tpu.serving.model_runner import bucket_len
+
+        batch = self.scheduler.decode_ready()
+        if not batch:
+            return []
+        # ---- plan once: deterministic given request state, so retries
+        # rebuild the identical launch
+        rem = {r: self._row_remaining(r) for r in batch}
+        s = max(1, min(self.decode_horizon, max(rem.values())))
+        budget = self.scheduler.speculation_budget(0)
+        row_k: Dict[Request, int] = {}
+        chains: Dict[Request, List[int]] = {}
+        for req in batch:
+            k = self.num_speculative_tokens
+            if self.adaptive_k is not None:
+                k = min(k, self.adaptive_k.k_for(req.request_id))
+            k = min(k, max(rem[req] - 1, 0))
+            if budget is not None:
+                k = min(k, budget)
+            chain: List[int] = []
+            if k > 0:
+                chain = list(self.proposer.propose_chain(
+                    req.context_tokens, s * (k + 1) - 1,
+                    request_id=req.request_id))
+                if not chain:
+                    k = 0
+            if k > 0 and budget is not None:
+                budget -= k
+            row_k[req] = k
+            chains[req] = chain
+        s = self.scheduler.plan_spec_horizon(s, row_k, rem)
+        kmax = max(row_k.values())
+        if kmax <= 0:
+            # every draft shrank away (cold proposer / pool pressure /
+            # adaptive-k at 0): ride the plain horizon machinery. The
+            # fused funding (min(s, rem) per row) is NOT enough for a
+            # plain scan without early stop — decode_multi writes all
+            # s positions per row (overshoot) — so re-plan through
+            # _plan_horizon, which applies the overshoot caps and
+            # funds the difference (grow is incremental)
+            s = self._plan_horizon(False)
+            if s > 1:
+                return self._decode_multi_with_recovery(s, defer=defer)
+            return self._decode_with_recovery(defer=defer)
+        K = bucket_len(1 + kmax) - 1
+        # mirrors plan_spec_horizon's funding formula exactly (the
+        # auditor's over-provision credit) — including the block-table
+        # wall clamp on the +k rejected-draft slack
+        wall = self.max_pages_per_seq * self.pool.block_size
+        upc = {r: max(1, min(s * (row_k[r] + 1), rem[r] + row_k[r],
+                             wall - r.kv.num_tokens))
+               for r in batch}
+        attempts = 0
+        delay = self.retry_backoff_s
+        while True:
+            batch = [r for r in self.scheduler.decode_ready()
+                     if r in row_k]
+            if not batch:
+                return []
+            B = self.max_batch_size
+            P = self.max_pages_per_seq
+            tokens = np.zeros((B,), np.int32)
+            tables = np.full((B, P), SCRATCH_PAGE, np.int32)
+            pos = np.zeros((B,), np.int32)
+            drafts = np.full((B, s, K), -1, np.int32)
+            sampling = any(r.sampling.temperature != 0.0 for r in batch)
+            seeds = np.zeros((B,), np.int32)
+            base = np.zeros((B,), np.int32)
+            temps = np.zeros((B,), np.float32)
+            top_k = top_p = None
+            S = max([1] + [len(r.sampling.stop_token_ids) for r in batch])
+            stop_ids = np.full((B, S), -1, np.int32)
+            remaining = np.ones((B,), np.int32)
+            for req in batch:
+                # every page the horizon may write must be private
+                # BEFORE launch (idempotent: forks survive a retry)
+                cow = req.kv.ensure_writable(req.num_context - 1,
+                                             req.num_context - 1 + upc[req])
+                if cow:
+                    self.metrics.cow_copies.inc(cow)
+                sl = req.slot
+                sp = req.sampling
+                tokens[sl] = req.output_tokens[-1]
+                tables[sl, :len(req.kv.pages)] = req.kv.pages
+                pos[sl] = req.num_context - 1
+                k = row_k[req]
+                chain = chains[req]
+                for t in range(s):
+                    piece = chain[t * (k + 1):t * (k + 1) + k]
+                    if piece:
+                        drafts[sl, t, :len(piece)] = piece
+                seeds[sl] = (sp.seed if sp.seed is not None
+                             else req.arrival_index)
+                base[sl] = len(req.output_tokens)
+                temps[sl] = sp.temperature
+                if sp.temperature != 0.0:
+                    top_k, top_p = sp.top_k, sp.top_p
+                ids = tuple(sp.stop_token_ids)
+                stop_ids[sl, :len(ids)] = ids
+                remaining[sl] = rem[req]
+            kw: dict = dict(stop_ids=stop_ids, remaining=remaining)
+            if sampling:
+                kw.update(seeds=seeds, base_steps=base, temps=temps,
+                          top_k=top_k, top_p=top_p)
+            prev = self.pool.pools
+            try:
+                packed, new_pools = self.runner.decode_multi_spec(
+                    tokens, tables, pos, self.pool.pools, drafts, **kw)
+                break
+            except Exception:
+                if attempts < self.max_step_retries:
+                    attempts += 1
+                    self.metrics.step_retries.inc()
+                    self._sleep(delay)
+                    delay *= 2
+                    continue
+                self._finish_abnormal(batch[-1], "error")
+                attempts = 0
+                delay = self.retry_backoff_s
+        self.pool.pools = new_pools
+        self.metrics.batch_occupancy.observe(len(batch))
+        self.metrics.decode_horizon_steps.inc(s)
+        self.metrics.spec_fused_horizons.inc()
+        slots = [(r, r.slot) for r in batch]
+        if defer:
+            self._inflight = _InflightLaunch(
+                "decode_spec", slots, packed, prev, s,
+                spec={"drafts": drafts},
+                upcoming={id(r): upc[r] for r in batch})
+            return []
+        drained = self._timed_drain(lambda: _to_host(packed))
+        self.metrics.host_syncs.inc()       # the horizon's ONE host sync
+        return self._replay_spec_horizon(slots, drained, drafts)
+
+    def _replay_spec_horizon(self, batch_slots, drained, drafts
+                             ) -> List[TokenEvent]:
+        """Replay one drained fused speculative horizon. `drained` is
+        [3, B, s, K+1]: per scan step, the span's emitted tokens, a
+        finiteness plane, and the KEEP plane — the device's accepted
+        prefix (position 0 = the fed token's emission, positions 1..m-1
+        = accepted-draft continuations, all gated by the row's live
+        bit). Per kept position this applies exactly _accept_verify's
+        bookkeeping — acceptance counting, coverage advance + prefix
+        registration before each append, _append_token's stop/length
+        handling, the NaN policy via _horizon_nan — so token streams,
+        finish reasons, and spec_* metrics match the per-step verify
+        path verbatim. An unfinished row then truncates its KV back to
+        the per-step invariant (num_tokens = num_context - 1): pages
+        grown only for rejected/unreached span positions are decref'd
+        on the spot — a speculated page never survives its rejection,
+        and the auditor's over-provision check pins it. A batch member
+        that finished while the launch was in flight is skipped."""
+        toks, fins, keeps = drained[0], drained[1], drained[2]
+        s = toks.shape[1]
+        events: List[TokenEvent] = []
+        for req, sl in batch_slots:
+            if req.done:
+                continue
+            C = req.num_context
+            emitted = 0
+            proposed = 0
+            accepted = 0
+            halted = False
+            for t in range(s):
+                krow = keeps[sl, t]
+                if not krow[0]:
+                    break          # row froze on device: tail is dead
+                row_draft = drafts[sl, t]
+                ndraft = int(np.sum(row_draft >= 0))
+                proposed += ndraft
+                m = int(np.sum(krow != 0))
+                for i in range(m):
+                    if not fins[sl, t, i]:
+                        self._horizon_nan(req, C, emitted)
+                        halted = True
+                        break
+                    tok = int(toks[sl, t, i])
+                    if i < ndraft and int(row_draft[i]) == tok:
+                        accepted += 1
+                    req.kv.num_tokens = C + emitted
+                    if self.pool.prefix_cache is not None:
+                        self.pool.prefix_cache.register_seq(
+                            req.kv, req.context_tokens)
+                    events.append(self._append_token(req, tok))
+                    emitted += 1
+                    if req.done:
+                        halted = True
+                        break
+                if halted:
+                    break
+            self.metrics.spec_proposed_tokens.inc(proposed)
+            self.metrics.spec_accepted_tokens.inc(accepted)
+            self.metrics.spec_dead_positions.inc(
+                max(proposed - accepted, 0))
+            if self.adaptive_k is not None:
+                self.adaptive_k.update(req.request_id, proposed, accepted)
+            if not req.done and emitted > 0:
+                # rejected/unreached tail: drop back to the per-step
+                # invariant and decref pages grown past it (NaN rows
+                # already truncated via _horizon_nan)
+                dropped = req.kv.truncate(C + emitted - 1)
+                if dropped:
+                    self.metrics.spec_rollback_pages.inc(dropped)
+        return events
+
     # ------------------------------------------- multi-step decode (s>1)
 
     def _plan_horizon(self, chunks_in_flight: bool) -> int:
@@ -1345,9 +1733,10 @@ class ServingEngine:
         (ISSUE 6) — the fallback matrix in one place. Returns 1 (the
         per-step path) whenever the batch can't ride a device-resident
         horizon: decode_horizon off, prefill chunks in flight this step
-        (their completing logits need per-step sampling), speculation on
-        (verify spans already batch several tokens per sync and need
-        full logits), any request sampling at temperature > 0 (needs
+        (their completing logits need per-step sampling — speculation
+        itself no longer forces this path: verify spans ride the fused
+        scan via _decode_spec_with_recovery, ISSUE 18), any request
+        sampling at temperature > 0 (needs
         its [V] rows on host), or a request deferred here by a mid-
         horizon NaN (the per-step path refetches real logits to rescue
         from). Otherwise caps s at the batch's token headroom (never
@@ -1358,8 +1747,7 @@ class ServingEngine:
         under pool pressure."""
         s = self.decode_horizon
         batch = self.scheduler.decode_ready()
-        if (s <= 1 or not batch or chunks_in_flight
-                or self.num_speculative_tokens):
+        if s <= 1 or not batch or chunks_in_flight:
             return 1
         deferred = False
         for r in batch:
@@ -1719,12 +2107,20 @@ class ServingEngine:
                 # commit), so the rebuilt spans recompute the identical
                 # chunks and decode feeds — retry-exact like decode
                 return self._ragged_step_with_recovery()
+            if inf.kind == "decode_spec":
+                # proposals are deterministic given the (unchanged)
+                # context and acceptance never depends on draft quality,
+                # so the synchronous rerun commits the identical stream
+                return self._decode_spec_with_recovery()
             return self._decode_multi_with_recovery(inf.s)
         self.metrics.host_syncs.inc()
         if inf.kind == "decode":
             return self._finish_decode(inf.batch, inf.result, grid)
         if inf.kind == "ragged":
             return self._finish_ragged(inf.spans, inf.result, False, grid)
+        if inf.kind == "decode_spec":
+            return self._replay_spec_horizon(inf.batch, drained,
+                                             inf.spec["drafts"])
         return self._replay_horizon(inf.batch, drained, inf.s)
 
     def flush(self) -> List[TokenEvent]:
@@ -1751,6 +2147,7 @@ class ServingEngine:
         if reason is not None:
             req.finish_time = now
             self.scheduler.finish(req, reason)
+            self._release_spec_state(req)
             self.metrics.requests_finished.inc()
             self.metrics.e2e_latency_s.observe(now - req.arrival_time)
             self._outputs[req.request_id] = RequestOutput(
@@ -2178,6 +2575,15 @@ class ServingEngine:
                 "num_speculative_tokens": self.num_speculative_tokens,
                 "spec_max_ngram": self.spec_max_ngram,
                 "spec_min_ngram": self.spec_min_ngram,
+                # fused-speculation knobs (ISSUE 18) ride along so a
+                # restored engine keeps its draft rung; a caller-built
+                # draft-model INSTANCE snapshots as "custom" and is
+                # restored as the n-gram proposer (logged) — only the
+                # "shadow[:dtype]" string spec round-trips losslessly
+                "spec_adaptive_k": self.spec_adaptive_k,
+                "spec_draft_model": self.spec_draft_model,
+                "spec_draft_blocks": self.spec_draft_blocks,
+                "spec_ngram_window": self.spec_ngram_window,
                 # quantization knobs ride along for the record (ISSUE 9);
                 # restore() follows the NEW runner's dtypes — recompute-
                 # on-resume rebuilds KV from scratch, so it is
@@ -2217,6 +2623,14 @@ class ServingEngine:
         if state.get("version") != 1:
             raise ValueError(f"unknown snapshot version {state.get('version')}")
         cfg = state["config"]
+        draft_model = cfg.get("spec_draft_model")
+        if draft_model == "custom":
+            # a caller-built draft-runner instance can't be rebuilt from
+            # JSON; token streams stay exact either way (acceptance
+            # never depends on draft quality), only the speedup differs
+            logger.info("restore: snapshot used a custom draft-model "
+                        "instance; restoring with the n-gram proposer")
+            draft_model = None
         eng = cls(runner, num_blocks=cfg["num_blocks"],
                   block_size=cfg["block_size"],
                   max_batch_size=cfg["max_batch_size"],
@@ -2243,6 +2657,10 @@ class ServingEngine:
                   num_speculative_tokens=cfg.get("num_speculative_tokens", 0),
                   spec_max_ngram=cfg.get("spec_max_ngram", 3),
                   spec_min_ngram=cfg.get("spec_min_ngram", 1),
+                  spec_adaptive_k=cfg.get("spec_adaptive_k", False),
+                  spec_draft_model=draft_model,
+                  spec_draft_blocks=cfg.get("spec_draft_blocks"),
+                  spec_ngram_window=cfg.get("spec_ngram_window"),
                   tokenizer=tokenizer,
                   kv_store=kv_store, kv_store_owner=kv_store_owner,
                   metrics=metrics, sleep_fn=sleep_fn, audit=audit)

@@ -99,6 +99,7 @@ SUMMABLE_KEYS = (
     "prefix_cached_pages", "attn_kv_bytes_read", "attn_kv_bytes_gather",
     "tp_comm_bytes", "tp_comm_bytes_fp32",
     "spec_proposed_tokens", "spec_accepted_tokens", "spec_rollback_pages",
+    "spec_fused_horizons", "spec_dead_positions",
     "host_syncs", "decode_horizon_steps", "horizon_overshoot_tokens",
     "planned_ahead_steps", "host_plan_seconds", "overlapped_plan_seconds",
     "drain_wait_seconds", "step_seconds",
@@ -196,6 +197,12 @@ class EngineMetrics:
         self.spec_proposed_tokens = Counter("spec_proposed_tokens")
         self.spec_accepted_tokens = Counter("spec_accepted_tokens")
         self.spec_rollback_pages = Counter("spec_rollback_pages")
+        # fused verify-in-scan (ISSUE 18): horizons that carried drafts
+        # through decode_multi_spec (one drain each), and proposed-but-
+        # rejected verify positions — the waste adaptive-k exists to
+        # shrink on low-acceptance streams
+        self.spec_fused_horizons = Counter("spec_fused_horizons")
+        self.spec_dead_positions = Counter("spec_dead_positions")
         # multi-step decode (ISSUE 6): host_syncs counts every blocking
         # device->host drain the engine performs (one per step on the
         # s=1 path, one per HORIZON on the multi-step path — the number
@@ -377,6 +384,8 @@ class EngineMetrics:
             "spec_proposed_tokens": self.spec_proposed_tokens.value,
             "spec_accepted_tokens": self.spec_accepted_tokens.value,
             "spec_rollback_pages": self.spec_rollback_pages.value,
+            "spec_fused_horizons": self.spec_fused_horizons.value,
+            "spec_dead_positions": self.spec_dead_positions.value,
             "spec_acceptance_rate": self.spec_acceptance_rate(),
             "steps_per_token": self.steps_per_token(),
             "host_syncs": self.host_syncs.value,

@@ -909,6 +909,110 @@ class PagedModelRunner:
                             lives.T.astype(jnp.int32)])     # [3, B, s]
         return packed, pools
 
+    @staticmethod
+    def _sampled_span(logits, seeds, steps, temps, top_k, top_p):
+        """Per-position seeded sampling over verify spans (ISSUE 18):
+        position i of row b draws fold_in(key(seeds[b]), steps[b, i]) —
+        the same step-indexed stream `_sampled_rows` uses, widened to a
+        [B, T] step grid so every span position's target token comes
+        from exactly the key the host would have used had that position
+        been reached per-step. Division by temperature happens here
+        (the host order); `_sample` runs at 1.0 on the same [1, V]
+        shape, so acceptance is bit-identical to host `_accept_verify`."""
+        from paddle_tpu.models.generation import _sample
+
+        def one(row, seed, step, temp):
+            key = jax.random.fold_in(jax.random.key(seed), step)
+            l = row[None].astype(jnp.float32) / jnp.where(temp > 0.0,
+                                                          temp, 1.0)
+            return _sample(l, key, 1.0, top_k, top_p)[0]
+
+        per_row = jax.vmap(one, in_axes=(0, None, 0, None))
+        return jax.vmap(per_row)(logits, seeds, steps, temps)
+
+    def _decode_multi_spec_step(self, params, tokens, tables, pos, pools,
+                                drafts, seeds, base_steps, temps, stop_ids,
+                                remaining, num_steps: int, top_k, top_p,
+                                sampling: bool):
+        """Verify-in-scan (ISSUE 18 tentpole): the extended decode
+        horizon where every scan step carries a per-row DRAFT SPAN.
+
+        drafts is [B, num_steps, K] int32, -1-padded: step t feeds row
+        b the span [fed_token, draft[b, t, :]] through the ragged-core
+        forward (q_len = 1 + #real drafts; every span position's K/V
+        lands at p..p+K through `_write_indices`' scratch masking), then
+        resolves accept/reject ON DEVICE per position: emission i is
+        argmax (or the seeded-stream sample at step base+cnt+i) of span
+        position i, and it is KEPT iff the row is live, every earlier
+        draft matched its emission, and no earlier kept emission hit a
+        stop/budget bound. The last kept emission (corrected or bonus
+        token) feeds the next scan step; positions advance by the kept
+        count, so a fully-accepted span moves K+1 tokens per step while
+        a rejected one degrades to ordinary multi-step decode. Rejected-
+        tail K/V self-heals: the next span re-writes from its own start,
+        and the host truncates the final overhang at commit
+        (`SequenceKV.truncate`). Writes past max_model_len (only ever
+        proposed-tail garbage — kept emissions are budget-bounded) are
+        masked to scratch rather than letting the page-table gather
+        clamp into a live page.
+
+        Returns packed [3, B, num_steps, K+1] int32 — plane 0 emitted
+        tokens, plane 1 per-position finiteness, plane 2 the KEEP mask
+        (a per-step prefix; everything past it is garbage the host must
+        not replay) — ONE host transfer per horizon."""
+        B, _, K = drafts.shape
+        T = K + 1
+        wall = jnp.int32(self.max_model_len)
+        offs = jnp.arange(T, dtype=jnp.int32)[None, :]             # [1, T]
+
+        def body(carry, draft_t):
+            toks, p, done, cnt, pools = carry
+            ndraft = jnp.sum((draft_t >= 0).astype(jnp.int32), axis=1)
+            span = jnp.concatenate([toks[:, None],
+                                    jnp.maximum(draft_t, 0)], axis=1)
+            q_lens = jnp.where(done, 0, ndraft + 1)
+            valid = (offs < q_lens[:, None]) & (p[:, None] + offs < wall)
+            positions = jnp.where(valid, p[:, None] + offs, 0)
+            page, off = self._write_indices(positions, tables, valid)
+            logits, pools = self._forward(params, span, positions, page,
+                                          off, tables, p, q_lens, pools)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, T]
+            fin = jnp.all(jnp.isfinite(logits), axis=-1)            # [B, T]
+            if sampling:
+                steps = base_steps[:, None] + cnt[:, None] + offs
+                sampled = self._sampled_span(logits, seeds, steps, temps,
+                                             top_k, top_p)
+                nxt = jnp.where(temps[:, None] > 0.0, sampled, greedy)
+            else:
+                nxt = greedy
+            match = (draft_t == nxt[:, :K]) & (draft_t >= 0)        # [B, K]
+            hit = jnp.any(nxt[:, :, None] == stop_ids[:, None, :], axis=2)
+            pos_done = hit | (cnt[:, None] + 1 + offs
+                              >= remaining[:, None])                # [B, T]
+            cont = match & jnp.logical_not(pos_done[:, :K])
+            live = jnp.logical_not(done)
+            keep = jnp.concatenate(
+                [live[:, None],
+                 live[:, None] & jnp.cumprod(
+                     cont.astype(jnp.int32), axis=1).astype(bool)],
+                axis=1)                                             # [B, T]
+            m = jnp.sum(keep.astype(jnp.int32), axis=1)
+            last = jnp.maximum(m - 1, 0)
+            fb = jnp.take_along_axis(nxt, last[:, None], axis=1)[:, 0]
+            fb = jnp.where(m > 0, fb, toks)
+            done2 = done | jnp.any(keep & pos_done, axis=1)
+            return (fb, p + m, done2, cnt + m, pools), (nxt, fin, keep)
+
+        init = (tokens.astype(jnp.int32), pos.astype(jnp.int32),
+                jnp.zeros((B,), bool), jnp.zeros((B,), jnp.int32), pools)
+        (_, _, _, _, pools), (toks, fins, keeps) = jax.lax.scan(
+            body, init, jnp.swapaxes(drafts, 0, 1), length=num_steps)
+        packed = jnp.stack(
+            [jnp.swapaxes(toks, 0, 1),
+             jnp.swapaxes(fins, 0, 1).astype(jnp.int32),
+             jnp.swapaxes(keeps, 0, 1).astype(jnp.int32)])  # [3, B, s, T]
+        return packed, pools
+
     def _ragged_core(self, params, tokens, tables, start_pos, q_lens,
                      pools):
         """One mixed ragged batch: every slot carries its own query span
@@ -951,24 +1055,28 @@ class PagedModelRunner:
               "decode": self._decode_step,
               "decode_multi": self._decode_multi_step,
               "decode_multi_x": self._decode_multi_x_step,
+              "decode_multi_spec": self._decode_multi_spec_step,
               "ragged": self._ragged_step,
               "ragged_full": self._ragged_core}[kind]
         pools_arg = {"prefill": 5, "decode": 4, "decode_multi": 4,
-                     "decode_multi_x": 4,
+                     "decode_multi_x": 4, "decode_multi_spec": 4,
                      "ragged": 5, "ragged_full": 5}[kind]
         donate = (pools_arg,) if jax.default_backend() == "tpu" else ()
         # decode_multi's horizon length is a lax.scan bound — static;
         # the extended horizon additionally bakes the sampling config
-        # and the early-stop switch per jit entry
+        # and the early-stop switch per jit entry; the verify-in-scan
+        # horizon bakes the sampling config (its stop plane is always on)
         static = {"decode_multi": (5,),
-                  "decode_multi_x": (10, 11, 12, 13, 14)}.get(kind, ())
+                  "decode_multi_x": (10, 11, 12, 13, 14),
+                  "decode_multi_spec": (11, 12, 13, 14)}.get(kind, ())
         if self.mesh is not None:
             # sharded runner (ISSUE 7): every step is pjit'd with
             # explicit in/out shardings — params per spec, pools split
             # on the kv-head axis both ways, host operands replicated
             ins, outs = self._step_shardings(
                 kind, pools_arg,
-                trailing_args=5 if kind == "decode_multi_x" else 0)
+                trailing_args={"decode_multi_x": 5,
+                               "decode_multi_spec": 6}.get(kind, 0))
             jitted = jax.jit(fn, donate_argnums=donate,
                              static_argnums=static, in_shardings=ins,
                              out_shardings=outs)
@@ -1096,6 +1204,55 @@ class PagedModelRunner:
         return fn(self.params, toks, tabs, pos_a, pools, sd, bs, tp, si,
                   rem, num_steps, top_k, top_p, sampling,
                   bool(early_stop))
+
+    def decode_multi_spec(self, tokens, tables, pos, pools, drafts, *,
+                          seeds=None, base_steps=None, temps=None,
+                          top_k=None, top_p=None, stop_ids=None,
+                          remaining=None):
+        """Fused speculative horizon (ISSUE 18): `drafts.shape[1]` scan
+        steps, each carrying a [B, K] -1-padded draft span verified and
+        accepted ON DEVICE (see `_decode_multi_spec_step`). tokens [B]
+        (fed last tokens), tables [B, P] (must map every page the
+        horizon's funded writes can touch), pos [B], drafts [B, s, K]
+        int32 — K pre-padded by the engine to `bucket_len(1 + k) - 1`
+        so fused spans share the per-step verify path's bucket rule
+        (same attention impl, bit-identical logits). The stop plane
+        (stop_ids [B, S] -1-padded + remaining [B]) is ALWAYS on: the
+        budget bound is what keeps every kept emission inside the funded
+        page range. Seeded sampling mirrors decode_multi's extension
+        operands. Returns (packed [3, B, s, K+1] int32, pools): planes
+        tokens / finiteness / keep-mask, one host transfer per horizon."""
+        drafts = np.asarray(drafts, np.int32)
+        if drafts.ndim != 3 or drafts.shape[1] < 1:
+            raise ValueError(
+                f"drafts must be [B, num_steps>=1, K], got {drafts.shape}")
+        B, num_steps, K = drafts.shape
+        pos_np = np.asarray(pos, np.int32)
+        width = np.asarray(tables).shape[1]
+        impl = self._attn_impl_for(K + 1)
+        spans = np.full((B,), K + 1, np.int32)
+        for t in range(num_steps):   # upper-bounds the per-step reads
+            self._account_attn(impl, pos_np + t * (K + 1), spans, width)
+        self._account_comm(B * (K + 1), steps=num_steps)
+        sampling = temps is not None
+        seeds = np.zeros((B,), np.int32) if seeds is None \
+            else np.asarray(seeds, np.int32)
+        base_steps = np.zeros((B,), np.int32) if base_steps is None \
+            else np.asarray(base_steps, np.int32)
+        temps = np.zeros((B,), np.float32) if temps is None \
+            else np.asarray(temps, np.float32)
+        stop_ids = np.full((B, 1), -1, np.int32) if stop_ids is None \
+            else np.asarray(stop_ids, np.int32)
+        remaining = np.full((B,), num_steps * (K + 1), np.int32) \
+            if remaining is None else np.asarray(remaining, np.int32)
+        fn = self._jitted("decode_multi_spec",
+                          (B, num_steps, K, top_k, top_p, sampling,
+                           stop_ids.shape[1]))
+        toks, tabs, pos_a, dr, sd, bs, tp, si, rem = self._stage(
+            np.asarray(tokens, np.int32), np.asarray(tables, np.int32),
+            pos_np, drafts, seeds, base_steps, temps, stop_ids, remaining)
+        return fn(self.params, toks, tabs, pos_a, pools, dr, sd, bs, tp,
+                  si, rem, num_steps, top_k, top_p, sampling)
 
     def ragged_step(self, tokens, tables, start_pos, q_lens, pools,
                     full_logits: bool = False):

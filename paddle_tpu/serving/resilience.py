@@ -204,6 +204,25 @@ class FaultInjector:
             packed = arr
         return packed, pools
 
+    def decode_multi_spec(self, tokens, tables, pos, pools, drafts, **kw):
+        # the fused speculative horizon (ISSUE 18) IS the step's decode
+        # call site — same "decode" op counter as decode_multi and
+        # ragged_step, so every fault schedule keeps firing when verify
+        # spans ride the scan. NaN injection zeroes the packed
+        # finiteness plane (plane 1 on the [3, B, s, K+1] layout, same
+        # index as the horizon layouts): the engine sees the whole
+        # horizon "go NaN" at its first kept position, exercising
+        # _horizon_nan's truncate + per-step deferral under speculation.
+        n = self._pre("decode")
+        packed, pools = self._runner.decode_multi_spec(
+            tokens, tables, pos, pools, drafts, **kw)
+        if self._hits(self._nan, "decode", n):
+            self.injected["nan"] += 1
+            arr = np.array(packed, np.int32, copy=True)
+            arr[1] = 0
+            packed = arr
+        return packed, pools
+
     def ragged_step(self, tokens, tables, start_pos, q_lens, pools,
                     full_logits: bool = False):
         # the fused chunk+decode call (engine ragged_batch mode, ISSUE 4)
@@ -344,6 +363,13 @@ def audit_engine(engine) -> None:
     inflight = getattr(engine, "_inflight", None)
     inflight_horizon = ({id(r): inflight.s for r, _ in inflight.batch}
                         if inflight is not None else {})
+    # a fused speculative launch (ISSUE 18) pre-commits pages for up to
+    # min(s*(k+1), remaining+k) tokens per row — the launch records the
+    # exact funded count per request, which overrides the plain-horizon
+    # `s` credit below
+    inflight_upcoming = (dict(inflight.upcoming)
+                         if inflight is not None
+                         and getattr(inflight, "upcoming", None) else {})
 
     # -- allocator self-consistency -------------------------------------
     free_list = list(alloc._free)
@@ -406,7 +432,8 @@ def audit_engine(engine) -> None:
         # launch legitimately in flight — its batch members hold pages
         # pre-committed for the whole undrained horizon until the next
         # step's commit replays (and finish-releases / truncates) them
-        upcoming = 1 + inflight_horizon.get(id(req), 0)
+        upcoming = inflight_upcoming.get(
+            id(req), 1 + inflight_horizon.get(id(req), 0))
         cap = engine.pool.blocks_for_tokens(req.num_context + upcoming)
         if len(req.kv.pages) > cap:
             problems.append(

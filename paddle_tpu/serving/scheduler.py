@@ -452,6 +452,67 @@ class FCFSScheduler:
                 total += k
         return total
 
+    def plan_spec_horizon(self, s: int, row_k: Dict[Request, int],
+                          row_rem: Dict[Request, int]) -> int:
+        """Page funding for the fused verify-in-scan horizon (ISSUE 18):
+        a speculative horizon of `s` scan steps writes, per decode-ready
+        row, up to min(s * (k+1), remaining + k) tokens beyond its
+        current coverage — full acceptance moves k+1 tokens per step,
+        while the on-device stop plane bounds kept emissions by
+        `remaining`, so the worst-case overhang past the last kept token
+        is one span's k draft writes. Like `plan_decode_horizon` this
+        NEVER preempts: first `s` is trimmed toward 1 under free-list /
+        watermark pressure; at s == 1 each row's k is then shrunk in
+        place (the `reserve_speculation` degradation — speculation
+        collapses to plain decode before anyone is evicted).
+        `row_k` is mutated to the funded per-row draft lengths. Returns
+        the effective horizon (0 with no decode-ready requests)."""
+        batch = self.decode_ready()
+        if not batch:
+            return 0
+        s = max(1, int(s))
+        alloc = self.pool.allocator
+
+        cap = self.max_pages_per_seq * self.pool.block_size
+
+        def up(r, n, k=None):
+            # rem is wall-capped but the +k rejected-draft slack is
+            # not: clamp at the block-table width or a near-wall row
+            # funds (and tables) a page past max_pages_per_seq that
+            # the kernel's wall mask would never write
+            k = row_k.get(r, 0) if k is None else k
+            return max(1, min(n * (k + 1), row_rem.get(r, 1) + k,
+                              cap - r.kv.num_tokens))
+
+        while s > 1:
+            short = sum(r.kv.pages_short(up(r, s)) for r in batch)
+            if short == 0:
+                break
+            used_live = (alloc.num_usable - alloc.num_free
+                         - alloc.num_evictable)
+            if (alloc.can_alloc(short)
+                    and used_live + short <= self._effective_watermark()):
+                break
+            s -= 1
+        if s == 1:
+            # shrink-and-grow per row IN ORDER: the grow must land
+            # before the next row's can_alloc check, or N rows each
+            # "fit" against the same last free page and the batch-wide
+            # grow below blows past the pool
+            for r in batch:
+                k = row_k.get(r, 0)
+                while k:
+                    short = r.kv.pages_short(up(r, 1, k))
+                    if short == 0 or alloc.can_alloc(short):
+                        break
+                    k -= 1
+                row_k[r] = k
+                r.kv.grow(up(r, 1, k))
+            return 1
+        for r in batch:
+            r.kv.grow(up(r, s))
+        return s
+
     # ------------------------------------------------- multi-step decode
 
     def plan_decode_horizon(self, s: int, row_caps=None) -> int:

@@ -152,6 +152,100 @@ class StubPagedRunner:
         return (jnp.asarray(np.stack(planes)),
                 [(jnp.asarray(k), v)])
 
+    def decode_multi_spec(self, tokens, tables, pos, pools, drafts,
+                          seeds=None, base_steps=None, temps=None,
+                          top_k=None, top_p=None, stop_ids=None,
+                          remaining=None):
+        """Fused verify-in-scan horizon (ISSUE 18): each scan step
+        carries a per-row draft span (drafts[b, t], -1-padded) — the
+        span's tokens are written through the block table position by
+        position, each position's emission is resolved with the SAME
+        seeded/greedy math as decode_multi, and the kept prefix is the
+        run of matching-draft positions that hit no stop/budget wall
+        (position 0, the fed token's emission, is always kept while the
+        row is live). The last kept emission feeds the next scan step.
+        Returns the packed [3, B, s, K+1] buffer (tokens, finiteness,
+        keep planes) the real runner's scan emits. Rejected-tail writes
+        land in the pool exactly like the device's (overwritten by the
+        next span before any query can attend them), so a missed host
+        rollback still breaks oracle equivalence."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        (k, v), = pools
+        k = np.array(k)
+        tokens = np.asarray(tokens).copy()
+        tables = np.asarray(tables)
+        pos = np.asarray(pos).copy()
+        drafts = np.asarray(drafts)
+        B, num_steps, K = drafts.shape
+        T = K + 1
+        toks = np.zeros((B, num_steps, T), np.int32)
+        fins = np.zeros((B, num_steps, T), np.int32)
+        keeps = np.zeros((B, num_steps, T), np.int32)
+        done = np.zeros((B,), bool)
+        cnt = np.zeros((B,), np.int32)
+        for t in range(num_steps):
+            for b in range(B):
+                if done[b]:
+                    continue          # frozen row: no write, no compute
+                row_draft = drafts[b, t]
+                ndraft = int(np.sum(row_draft >= 0))
+                span = [int(tokens[b])] + [int(x)
+                                           for x in row_draft[:ndraft]]
+                self.counted_row_steps += 1
+                stops = (set(int(x) for x in stop_ids[b] if int(x) >= 0)
+                         if stop_ids is not None else set())
+                rem = (int(remaining[b]) if remaining is not None
+                       else 1 << 30)
+                kept = 0
+                for i, tok_in in enumerate(span):
+                    p = int(pos[b]) + i
+                    if p >= self.max_model_len:
+                        break         # the device's wall mask
+                    page = int(tables[b, p // self.block_size])
+                    k[page, p % self.block_size, 0, 0] = float(tok_in)
+                    hist = [k[int(tables[b, j // self.block_size]),
+                              j % self.block_size, 0, 0]
+                            for j in range(p + 1)]
+                    row = self._logits(hist)
+                    if (temps is not None and float(temps[b]) > 0.0
+                            and np.all(np.isfinite(row))):
+                        from paddle_tpu.serving.engine import seeded_sample
+
+                        nxt = seeded_sample(
+                            row, int(seeds[b]),
+                            int(base_steps[b]) + int(cnt[b]) + i,
+                            float(temps[b]), top_k, top_p)
+                    else:
+                        nxt = int(np.argmax(row))
+                    toks[b, t, i] = nxt
+                    fins[b, t, i] = int(np.all(np.isfinite(row)))
+                    if i == kept:     # still on the kept prefix
+                        keeps[b, t, i] = 1
+                        kept += 1
+                        pos_done = (nxt in stops
+                                    or int(cnt[b]) + 1 + i >= rem)
+                        if pos_done:
+                            done[b] = True
+                        matched = (i < ndraft
+                                   and int(row_draft[i]) == nxt)
+                        if pos_done or not matched:
+                            # later span positions still write KV (the
+                            # device can't know acceptance pre-forward)
+                            # but nothing past here is kept
+                            pass
+                        else:
+                            continue
+                        # freeze the kept prefix; keep writing the tail
+                        kept = -1
+                tokens[b] = int(toks[b, t, max(
+                    0, int(np.sum(keeps[b, t])) - 1)])
+                cnt[b] += int(np.sum(keeps[b, t]))
+                pos[b] += int(np.sum(keeps[b, t]))
+        return (jnp.asarray(np.stack([toks, fins, keeps])),
+                [(jnp.asarray(k), v)])
+
     def ragged_step(self, tokens, tables, start_pos, q_lens, pools,
                     full_logits=False):
         """Mixed ragged batch (fused chunk+decode and the ISSUE-5 verify

@@ -49,8 +49,9 @@ by FaultInjector on the decode op counter — injected errors hit the
 horizon launch, injected NaN drops the packed finiteness flags), and
 recovery must stay token-exact with zero leaked pre-committed horizon
 pages. Records add host_syncs / host_syncs_per_token /
-decode_horizon_steps / horizon_overshoot_tokens. Mutually exclusive
-with --speculate (speculative batches fall back to per-step decode).
+decode_horizon_steps / horizon_overshoot_tokens. Composes with
+--speculate since ISSUE 18: verify spans ride INSIDE the multi-step
+scan (`runner.decode_multi_spec`, same decode op counter).
 
 ISSUE 11: `--pipelined` drills every class (plus preempt_storm) with
 the ZERO-BUBBLE loop on: host planning runs under the in-flight launch
@@ -203,6 +204,28 @@ periodic patterns so proposals actually fire. Recovery must stay
 token-exact (none/device_error classes still compare against the
 naive oracle) and the rejected-tail rollback must leave zero leaked
 pages. Records add the proposed/accepted counters and acceptance rate.
+
+ISSUE 18: --speculate now composes with --decode-horizon / --pipelined
+— whenever a decode batch has no prefill chunks in flight, verify
+spans ride INSIDE the device-resident multi-step scan
+(engine._decode_spec_with_recovery -> runner.decode_multi_spec): accept
+/reject happens on device, the corrected token feeds the next scan
+step, and ONE packed drain carries up to s*(k+1)-1 tokens per row.
+FaultInjector wraps the fused launch on the same decode op counter
+(injected NaN zeroes the packed finiteness plane), the armed auditor
+bounds page over-provision by the launch's recorded per-row funding,
+and drain-failure recovery reruns the horizon synchronously —
+token-exactness holds because rejected drafts never change the
+emitted stream. `--spec-adaptive-k` arms the per-request EWMA draft
+-length controller; `--spec-draft shadow[:int8|fp32]` swaps the n-gram
+proposer for the model-based draft rung (a quantized shadow of the
+target proposing via its own paged pool). The canonical drill:
+
+    JAX_PLATFORMS=cpu python tools/fault_smoke.py --speculate \
+        --pipelined --decode-horizon 4 --tp 2
+
+runs all six classes + preempt_storm with fused verify horizons on a
+sharded engine. Records add spec_fused_horizons / spec_dead_positions.
 """
 
 from __future__ import annotations
@@ -230,6 +253,8 @@ def build_engine(runner, args, **kw):
     kw.setdefault("max_prefill_tokens_per_step", args.chunk or None)
     kw.setdefault("ragged_batch", args.ragged_batch)
     kw.setdefault("num_speculative_tokens", args.speculate)
+    kw.setdefault("spec_adaptive_k", getattr(args, "spec_adaptive_k", False))
+    kw.setdefault("spec_draft_model", getattr(args, "spec_draft", None))
     kw.setdefault("decode_horizon", args.decode_horizon)
     kw.setdefault("host_tier_pages", args.offload)
     kw.setdefault("host_tier_headroom", args.offload > 0)
@@ -365,7 +390,8 @@ def run_class(fault: str, runner, args) -> dict:
             for rid, prompt, sp in work:
                 ref = naive_generate(runner, prompt, sp,
                                      max_model_len=args.max_model_len)
-                if outs[rid].output_tokens != ref:
+                o = outs.get(rid)
+                if o is None or o.output_tokens != ref:
                     oracle_ok = False
                     break
         twin_fp32 = getattr(args, "fp32_twin_runner", None)
@@ -425,6 +451,8 @@ def run_class(fault: str, runner, args) -> dict:
         "spec_proposed_tokens": m["spec_proposed_tokens"],
         "spec_accepted_tokens": m["spec_accepted_tokens"],
         "spec_acceptance_rate": m["spec_acceptance_rate"],
+        "spec_fused_horizons": m["spec_fused_horizons"],
+        "spec_dead_positions": m["spec_dead_positions"],
         "steps_per_token": m["steps_per_token"],
         "host_syncs": m["host_syncs"],
         "host_syncs_per_token": m["host_syncs_per_token"],
@@ -1174,6 +1202,14 @@ def main() -> int:
                          "tokens per verify span (bare flag: K=4; "
                          "default: off) — half the prompts become "
                          "periodic so proposals fire")
+    ap.add_argument("--spec-adaptive-k", action="store_true",
+                    help="ISSUE 18: acceptance-rate-adaptive per-request "
+                         "draft length (EWMA, clamped to [0, K])")
+    ap.add_argument("--spec-draft", default=None,
+                    metavar="shadow[:int8|fp32]",
+                    help="ISSUE 18: model-based draft rung — replace the "
+                         "n-gram proposer with a quantized shadow of the "
+                         "target model (default: n-gram)")
     ap.add_argument("--shared-kv", type=int, nargs="?", const=64,
                     default=0, metavar="N",
                     help="ISSUE 14: cluster-wide KV drill — 2 thread "
