@@ -830,6 +830,174 @@ def child_serving_quant_comm(layers: int, hidden: int, max_batch: int,
     })
 
 
+def child_serving_weight_quant(layers: int, hidden: int, max_batch: int,
+                               requests: int, prompt: int, gen: int,
+                               vocab: int):
+    """Weight-ladder rung (ISSUE 19): the tp=2 GQA-Llama workload in
+    FOUR arms — fp32 baseline, int8 weights (per-output-channel
+    scales), int4 weights (packed nibble codes + group-128 scales, run
+    with comm_dtype="int8" so the lm_head's column-parallel logits
+    all-gather rides the quantized collective too), and fp8 weights
+    (native float8 casts). Each arm commits tokens/s, the MEASURED
+    resident weight-bytes reduction (packed codes + group scales
+    counted — the int4 acceptance gate is >= 3.5x, never an assumed
+    8x), the gather-direction `tp_gather_bytes` split on the int4 arm,
+    and the teacher-forced accuracy record vs the fp32 TP arm: mean
+    |dlogit|, top-5 overlap, greedy agreement."""
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import Llama, LlamaConfig
+    from paddle_tpu.parallel.mesh import serving_mesh
+    from paddle_tpu.serving import (
+        KVCachePool, LlamaRunner, SamplingParams, ServingEngine,
+    )
+
+    backend = jax.default_backend()
+    paddle.seed(0)
+    max_len = prompt + gen
+    heads = max(hidden // 64, 4)
+    n_kv = 4 if heads % 4 == 0 else heads
+    # vocab must split over tp=2 for the lm_head's column-parallel
+    # gather to engage (an odd vocab falls back replicated, logged)
+    vocab -= vocab % 2
+    cfg = LlamaConfig(vocab_size=vocab, hidden_size=hidden,
+                      num_layers=layers, num_heads=heads, num_kv_heads=n_kv,
+                      max_seq_len=max_len, dropout=0.0)
+    model = Llama(cfg)
+    model.eval()
+    block_size = min(16, max_len)
+    pages_per_seq = -(-max_len // block_size)
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, vocab, prompt)) for _ in range(requests)]
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        _write_child({"status": "child_error", "mode": "weight_quant",
+                      "error_type": "InsufficientDevices",
+                      "error": f"weight_quant rung needs >= 2 devices "
+                               f"for tp=2, backend {backend!r} has {n_dev}"})
+        return
+    mesh = serving_mesh(data=1, model=2)
+
+    def make_runner(weight_dtype, comm_dtype="fp32"):
+        try:
+            r = LlamaRunner(model, block_size=block_size,
+                            max_model_len=max_len,
+                            weight_dtype=weight_dtype)
+        except Exception as e:         # fp8 unsupported on this backend
+            _write_child({"status": "child_error", "mode": "weight_quant",
+                          "error_type": type(e).__name__,
+                          "error": f"backend_init weight_dtype="
+                                   f"{weight_dtype!r}: {e}"})
+            raise SystemExit(0)
+        return r.shard(mesh, comm_dtype=comm_dtype)
+
+    def run_arm(runner) -> dict:
+        def once():
+            runner.reset_attn_counters()
+            eng = ServingEngine(runner,
+                                num_blocks=max_batch * pages_per_seq + 1,
+                                max_batch_size=max_batch,
+                                max_model_len=max_len,
+                                max_prefill_tokens_per_step=4 * block_size,
+                                ragged_batch=True)
+            t0 = time.time()
+            for i, p in enumerate(prompts):
+                eng.add_request(p, SamplingParams(max_tokens=gen),
+                                request_id=f"r{i}")
+            eng.run()
+            wall = time.time() - t0
+            snap = eng.metrics.snapshot()
+            return {"wall_s": round(wall, 3),
+                    "weight_dtype": runner.weight_dtype,
+                    "comm_dtype": runner.comm_dtype,
+                    "tokens_per_sec": snap["tokens_generated"] / wall,
+                    "ttft_s_p50": snap["ttft_s_p50"],
+                    "weight_mb": runner.weight_bytes() / 1e6,
+                    "weight_mb_fp32": runner.weight_bytes_fp32() / 1e6,
+                    "weight_bytes_reduction_x":
+                        snap["weight_bytes_reduction_x"],
+                    "tp_gather_mb": snap["tp_gather_bytes"] / 1e6,
+                    "tp_gather_mb_fp32":
+                        snap["tp_gather_bytes_fp32"] / 1e6,
+                    "tp_gather_bytes_reduction_x":
+                        snap["tp_gather_bytes_reduction_x"]}
+
+        once()              # warmup compiles this arm's buckets
+        return once()
+
+    def teacher_forced_accuracy(r_ref, r_q, n_prompts=2, steps=24) -> dict:
+        """Replay the fp32 TP arm's greedy stream through a quantized
+        arm's runner and compare per-step logits — the three
+        acceptance-gate numbers, workload-matched (the ISSUE 15
+        methodology verbatim)."""
+        steps = min(steps, gen)
+        dl, overlap, agree, total = [], [], 0, 0
+        for p in prompts[:n_prompts]:
+            pools, tbls = [], []
+            for r in (r_ref, r_q):
+                pool = KVCachePool(r.num_layers, pages_per_seq + 1,
+                                   block_size, r.n_kv_heads, r.head_dim,
+                                   r.dtype, mesh=r.mesh,
+                                   model_axis=r.model_axis,
+                                   kv_dtype=r.kv_dtype)
+                pages = pool.allocator.alloc(pages_per_seq)
+                tbls.append(pool.pad_table(pages, pages_per_seq))
+                pools.append(pool.pools)
+            l_ref, pools[0] = r_ref.prefill(p, tbls[0], pools[0])
+            l_q, pools[1] = r_q.prefill(p, tbls[1], pools[1])
+            toks = list(p)
+            for _ in range(steps):
+                a, b = np.asarray(l_ref), np.asarray(l_q)
+                dl.append(np.abs(a - b).mean())
+                top_ref = set(np.argsort(a)[-5:].tolist())
+                top_q = set(np.argsort(b)[-5:].tolist())
+                overlap.append(len(top_ref & top_q) / 5.0)
+                agree += int(np.argmax(a) == np.argmax(b))
+                total += 1
+                tok = int(np.argmax(a))          # teacher: the fp32 path
+                pos = np.asarray([len(toks)], np.int32)
+                toks.append(tok)
+                l_ref, pools[0] = r_ref.decode(
+                    np.asarray([tok], np.int32),
+                    np.asarray(tbls[0], np.int32)[None], pos, pools[0])
+                l_q, pools[1] = r_q.decode(
+                    np.asarray([tok], np.int32),
+                    np.asarray(tbls[1], np.int32)[None], pos, pools[1])
+                l_ref, l_q = l_ref[0], l_q[0]
+        return {"mean_abs_dlogit": float(np.mean(dl)),
+                "top5_overlap": float(np.mean(overlap)),
+                "greedy_agreement": agree / total if total else 0.0}
+
+    r_fp32 = make_runner("fp32")
+    r_int8 = make_runner("int8")
+    r_int4 = make_runner("int4", comm_dtype="int8")
+    r_fp8 = make_runner("fp8")
+    arms = [run_arm(r) for r in (r_fp32, r_int8, r_int4, r_fp8)]
+    int4_arm = arms[2]
+    _write_child({
+        "backend": backend, "layers": layers, "hidden": hidden,
+        "max_batch": max_batch, "requests": requests, "prompt": prompt,
+        "gen": gen, "workload": "weight_quant", "tp": 2, "arms": arms,
+        # THE acceptance numbers: measured resident weight bytes (codes
+        # + group scales counted) per arm, the gather-direction wire
+        # split on the int4+int8-comm arm, and the accuracy gates
+        "weight_bytes_reduction_int4_x":
+            int4_arm["weight_bytes_reduction_x"],
+        "weight_bytes_reduction_int8_x":
+            arms[1]["weight_bytes_reduction_x"],
+        "weight_bytes_reduction_fp8_x":
+            arms[3]["weight_bytes_reduction_x"],
+        "tp_gather_bytes_reduction_x":
+            int4_arm["tp_gather_bytes_reduction_x"],
+        "accuracy_int8": teacher_forced_accuracy(r_fp32, r_int8),
+        "accuracy_int4": teacher_forced_accuracy(r_fp32, r_int4),
+        "accuracy_fp8": teacher_forced_accuracy(r_fp32, r_fp8),
+    })
+
+
 def child_serving_offload(layers: int, hidden: int, max_batch: int,
                           requests: int, prompt: int, gen: int, vocab: int):
     """Tiered-KV offload rung (ISSUE 10): a deliberately TIGHT pool
@@ -2440,6 +2608,46 @@ def main():
                 f"{acc['top5_overlap']:.3f}, greedy agreement "
                 f"{acc['greedy_agreement']*100:.1f}%")
 
+    # weight-ladder rung (ISSUE 19): the tp=2 workload in fp32 / int8 /
+    # int4+int8-comm / fp8 weight arms; commits the MEASURED resident
+    # weight-bytes reduction (packed codes + group scales counted — the
+    # int4 gate is >= 3.5x), tokens/s per arm, the gather-direction
+    # comm-bytes split (the quantized lm_head logits all-gather), and
+    # the teacher-forced accuracy gates vs the fp32 TP engine
+    if on_tpu and remaining() > 120:
+        r = run_child("serving:6:512:4:6:448:64:32768:weight_quant",
+                      min(900, remaining()))
+        if r is not None and "arms" in r:
+            acc = r["accuracy_int4"]
+            line = {"metric": "serving_weight_quant_bytes_reduction_x",
+                    "value": round(r["weight_bytes_reduction_int4_x"], 2),
+                    "unit": "x", "vs_baseline": 0.0,
+                    "weight_bytes_reduction_int8_x":
+                        round(r["weight_bytes_reduction_int8_x"], 2),
+                    "weight_bytes_reduction_fp8_x":
+                        round(r["weight_bytes_reduction_fp8_x"], 2),
+                    "tp_gather_bytes_reduction_x":
+                        round(r["tp_gather_bytes_reduction_x"], 2),
+                    "tokens_per_sec_fp32":
+                        round(r["arms"][0]["tokens_per_sec"], 1),
+                    "tokens_per_sec_int8":
+                        round(r["arms"][1]["tokens_per_sec"], 1),
+                    "tokens_per_sec_int4":
+                        round(r["arms"][2]["tokens_per_sec"], 1),
+                    "tokens_per_sec_fp8":
+                        round(r["arms"][3]["tokens_per_sec"], 1),
+                    "mean_abs_dlogit": round(acc["mean_abs_dlogit"], 6),
+                    "top5_overlap": round(acc["top5_overlap"], 4),
+                    "greedy_agreement": round(acc["greedy_agreement"], 4),
+                    "backend": r["backend"]}
+            emit(line)
+            _cache_result(line)
+            log(f"weight-quant rung: int4 weight bytes reduction "
+                f"{r['weight_bytes_reduction_int4_x']:.2f}x, gather "
+                f"bytes {r['tp_gather_bytes_reduction_x']:.2f}x, top-5 "
+                f"overlap {acc['top5_overlap']:.3f}, greedy agreement "
+                f"{acc['greedy_agreement']*100:.1f}%")
+
     # tiered-KV offload rung (ISSUE 10): recompute-vs-pagein resume cost
     # on a deliberately tight pool, the sessions uplift from the
     # watermark headroom knob, and the host<->device page copy-bandwidth
@@ -2865,6 +3073,8 @@ def _child_main(mode: str) -> None:
             child_serving_kvq(*[int(x) for x in parts[:-1]])
         elif parts and parts[-1] == "quant_comm":
             child_serving_quant_comm(*[int(x) for x in parts[:-1]])
+        elif parts and parts[-1] == "weight_quant":
+            child_serving_weight_quant(*[int(x) for x in parts[:-1]])
         elif parts and parts[-1] == "kv_offload":
             child_serving_offload(*[int(x) for x in parts[:-1]])
         elif parts and parts[-1] == "speculative":

@@ -191,7 +191,13 @@ def create_serving_engine(model, dtype=None, **kw):
     ISSUE 15 rungs: `kv_dtype="fp8"` (native float8 pages, 4x fewer KV
     bytes), `kv_dtype="mixed"` (per-request SamplingParams.kv_dtype
     tenants in one pool), and `comm_dtype="int8"` (with `mesh=`: the
-    row-parallel allreduce becomes the chunked quantized psum)."""
+    row-parallel allreduce becomes the chunked quantized psum).
+
+    ISSUE 19 rungs: `weight_dtype="int4"` (packed nibble codes + group
+    scales, `weight_group_size` reduction rows per scale, dequant in
+    the matmul epilogue), `weight_dtype="fp8"` (native float8 weights,
+    scale-free); with `mesh=`, `comm_dtype="int8"` also quantizes the
+    lm_head's column-parallel logits all-gather."""
     import jax.numpy as jnp
 
     from paddle_tpu.serving import ServingEngine
@@ -207,7 +213,8 @@ def create_serving_engine(model, dtype=None, **kw):
     runner = runner_for(model,
                         **{k: kw.pop(k) for k in
                            ("block_size", "max_model_len", "attn_impl",
-                            "kv_dtype", "weight_dtype")
+                            "kv_dtype", "weight_dtype",
+                            "weight_group_size")
                            if k in kw})
     if dtype is not None:
         runner.params = {
@@ -228,7 +235,8 @@ def create_serving_router(model, *, replicas: int = 2, dtype=None,
                           data_axis: str = "data",
                           model_axis: str = "model",
                           kv_dtype: str = "fp32",
-                          weight_dtype: str = "fp32", **kw):
+                          weight_dtype: str = "fp32",
+                          weight_group_size: int = 128, **kw):
     """Build a multi-engine ServingRouter for a decoder Layer (ISSUE 8).
 
     The fleet-tier analogue of create_serving_engine: N full serving
@@ -249,8 +257,8 @@ def create_serving_router(model, *, replicas: int = 2, dtype=None,
     spec_max_ngram/spec_min_ngram/spec_ngram_window, spec_adaptive_k,
     and spec_draft_model/spec_draft_blocks. On the process backend
     (backend="process") engine_kw crosses the wire as JSON, so pass the
-    draft rung as its "shadow[:int8|fp32]" string spec (each child
-    builds its own shadow from its own runner), not a runner instance;
+    draft rung as its "shadow[:int8|int4|fp8|fp32]" string spec (each
+    child builds its own shadow from its own runner), not an instance;
     the same string round-trips through engine snapshots, so a
     Supervisor respawn keeps the tier speculating."""
     import jax.numpy as jnp
@@ -274,7 +282,8 @@ def create_serving_router(model, *, replicas: int = 2, dtype=None,
         runner = runner_for(model, block_size=block_size,
                             max_model_len=max_model_len,
                             attn_impl=attn_impl, kv_dtype=kv_dtype,
-                            weight_dtype=weight_dtype)
+                            weight_dtype=weight_dtype,
+                            weight_group_size=weight_group_size)
         if dtype is not None:
             runner.params = {
                 k: (v.astype(dtype)
@@ -312,7 +321,9 @@ def restore_serving_engine(model, state, attn_impl: str = "auto",
                         attn_impl=attn_impl,
                         kv_dtype=state["config"].get("kv_dtype", "fp32"),
                         weight_dtype=state["config"].get("weight_dtype",
-                                                         "fp32"))
+                                                         "fp32"),
+                        weight_group_size=state["config"].get(
+                            "weight_group_size", 128))
     if mesh is not None:
         runner.shard(mesh)
     return ServingEngine.restore(runner, state, **kw)

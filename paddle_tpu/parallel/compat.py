@@ -522,6 +522,28 @@ def _spec_layout():
                 f"comm_dtype={self.comm_dtype!r}; expected 'fp32' or "
                 "'int8'")
 
+        def column_parallel_gather(self):
+            """The collective behind a column-parallel matmul whose
+            output is consumed REPLICATED (the lm_head's logits —
+            ISSUE 19): fn(local_cols, axis_name) -> full-width value,
+            tiled in axis-index order along the last axis. Called
+            inside a shard_map body over the model axis. "fp32" is the
+            plain tiled all_gather (bit-identical to what GSPMD
+            inserts for a replicated output); "int8" is the
+            pmax-scaled quantized gather (quantization.qcomm) — the
+            gather-direction twin of `row_parallel_reduce()`."""
+            if self.comm_dtype == "fp32":
+                return lambda x, axis_name: jax.lax.all_gather(
+                    x, axis_name, axis=x.ndim - 1, tiled=True)
+            if self.comm_dtype == "int8":
+                from paddle_tpu.quantization.qcomm import \
+                    quantized_allgather
+
+                return quantized_allgather
+            raise ValueError(
+                f"comm_dtype={self.comm_dtype!r}; expected 'fp32' or "
+                "'int8'")
+
         def embeddings(self) -> PS:
             return PS(self.model_axis, None)
 

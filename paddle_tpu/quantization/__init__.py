@@ -200,6 +200,12 @@ from paddle_tpu.quantization.int8 import (  # noqa: F401,E402
     Int8Linear, apply_per_channel_scale, dequantize_linear, llm_int8_linear,
     quantize_linear, weight_dequantize, weight_only_linear, weight_quantize,
 )
+from paddle_tpu.quantization.int4 import (  # noqa: F401,E402
+    int4_dequantize, int4_dequantize_reference, int4_matmul, int4_quantize,
+    int4_weight_bytes,
+)
 from paddle_tpu.quantization.qcomm import (  # noqa: F401,E402
-    allreduce_bytes, quantized_allreduce_reference, quantized_psum,
+    allgather_bytes, allreduce_bytes, quantized_allgather,
+    quantized_allgather_reference, quantized_allreduce_reference,
+    quantized_psum,
 )

@@ -42,6 +42,11 @@ one moves rows*width int8 code bytes PLUS 4 bytes per (row, chunk)
 shared scale — scale bytes are counted, so the committed reduction is
 4 / (1 + 4/chunk), measured, never an assumed 4x.
 
+ISSUE 19 adds the OTHER direction: `quantized_allgather` quantizes
+the column-parallel all-gather (the lm_head's logits gather) with the
+same pmax-shared per-(row, chunk) scales — codes gathered wide, one
+dequant — and `allgather_bytes` its honest per-shard wire accounting.
+
 Everything here is jit-pure and shard_map-compatible: no host state,
 no python branches on traced values.
 """
@@ -99,6 +104,95 @@ def quantized_psum(x, axis_name, *, chunk: int = QCOMM_CHUNK):
     out = total.astype(jnp.float32) * scale[..., None]
     out = out.reshape(rows.shape[0], -1)[:, :width]
     return out.reshape(shape).astype(orig_dtype)
+
+
+def quantized_allgather(x, axis_name, *, chunk: int = QCOMM_CHUNK):
+    """Gather the shards' last-axis slices with int8 wire traffic
+    (ISSUE 19): the COLUMN-parallel collective, the other direction of
+    `quantized_psum`. Inside a shard_map body over `axis_name`, `x` is
+    this shard's [..., width] slice of a column-sharded activation
+    (e.g. the lm_head's logits slice); returns the full
+    [..., width * axis_size] value, tiled in axis-index order — exactly
+    what `lax.all_gather(x, axis_name, axis=-1, tiled=True)` returns,
+    at x's dtype.
+
+    Same two-level shape as the psum: per-(row, chunk) scales agree via
+    `lax.pmax` over the shards (fp32, tiny — and per-shard-honest, so
+    quantizing at the shared scale never clips any shard's values),
+    then only the int8 codes ride the wide all-gather, and ONE dequant
+    multiply at the shared scale recovers every shard's slice. Chunking
+    is along each row's last axis, never across rows, so the gathered
+    value is BATCH-SHAPE INVARIANT like the psum's — the property that
+    keeps engine streams token-exact vs their own oracle."""
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    orig_dtype = x.dtype
+    shape = x.shape
+    width = shape[-1]
+    c = min(int(chunk), int(width))
+    rows = x.astype(jnp.float32).reshape(-1, width)         # [R, W]
+    pad = (-width) % c
+    if pad:
+        rows = jnp.pad(rows, ((0, 0), (0, pad)))
+    chunks = rows.reshape(rows.shape[0], -1, c)             # [R, C, c]
+    local = jnp.max(jnp.abs(chunks), axis=-1) / QCOMM_QMAX  # [R, C]
+    scale = jax.lax.pmax(local, axis_name)                  # shared, honest
+    safe = jnp.maximum(scale, 1e-30)[..., None]
+    codes = jnp.clip(jnp.round(chunks / safe),
+                     -QCOMM_QMAX, QCOMM_QMAX).astype(jnp.int8)
+    wide = jax.lax.all_gather(codes, axis_name)             # [S, R, C, c]
+    out = wide.astype(jnp.float32) * scale[None, ..., None]
+    out = out.reshape(wide.shape[0], rows.shape[0], -1)[:, :, :width]
+    out = jnp.moveaxis(out, 0, 1).reshape(rows.shape[0], -1)  # [R, S*W]
+    return out.reshape(*shape[:-1], -1).astype(orig_dtype)
+
+
+def quantized_allgather_reference(parts, *, chunk: int = QCOMM_CHUNK):
+    """Host-side oracle of `quantized_allgather`: `parts` is the
+    per-shard list of last-axis slices (all the same shape); returns
+    the exact tiled value the shard_map primitive produces on every
+    shard. Pure numpy, compared bit-for-bit by the unit tests."""
+    parts = [np.asarray(p, np.float32) for p in parts]
+    shape = parts[0].shape
+    width = shape[-1]
+    c = min(int(chunk), int(width))
+    pad = (-width) % c
+    rows = [p.reshape(-1, width) for p in parts]
+    if pad:
+        rows = [np.pad(r, ((0, 0), (0, pad))) for r in rows]
+    chunks = [r.reshape(r.shape[0], -1, c) for r in rows]
+    local = [np.abs(ch).max(axis=-1) / QCOMM_QMAX for ch in chunks]
+    scale = np.maximum.reduce(local)                        # pmax
+    safe = np.maximum(scale, 1e-30)[..., None]
+    slices = []
+    for ch in chunks:
+        codes = np.clip(np.round(ch / safe),
+                        -QCOMM_QMAX, QCOMM_QMAX).astype(np.int32)
+        deq = codes.astype(np.float32) * scale[..., None]
+        slices.append(deq.reshape(deq.shape[0], -1)[:, :width])
+    out = np.concatenate(slices, axis=-1)
+    return out.reshape(*shape[:-1], -1)
+
+
+def allgather_bytes(rows: int, width: int, comm_dtype: str,
+                    *, chunk: int = QCOMM_CHUNK) -> int:
+    """Wire bytes ONE shard contributes to one column-parallel
+    all-gather of its [rows, width] LOCAL slice — the serving
+    `tp_gather_bytes` accounting (ISSUE 19). fp32: the shard ships its
+    full slice at 4 bytes/element. int8: 1 code byte per element PLUS
+    4 bytes per (row, chunk) shared scale — the scale pmax is wire
+    traffic too, so it is counted, same honesty rule as
+    `allreduce_bytes` (the committed reduction is 4/(1 + 4/chunk),
+    never an assumed 4x)."""
+    if comm_dtype not in COMM_DTYPES:
+        raise ValueError(f"comm_dtype={comm_dtype!r}; expected one of "
+                         f"{COMM_DTYPES}")
+    rows, width = int(rows), int(width)
+    if comm_dtype == "fp32":
+        return rows * width * 4
+    c = min(int(chunk), max(int(width), 1))
+    n_chunks = -(-width // c)
+    return rows * width + rows * n_chunks * 4
 
 
 def quantized_allreduce_reference(parts, *, chunk: int = QCOMM_CHUNK):

@@ -108,7 +108,17 @@ in the epilogue). The fp32 default stays bit-exact vs naive_generate;
 the quantized path is accuracy-gated (top-5 overlap >= 0.99, greedy
 agreement >= 99% vs the fp32 oracle — tests/test_serving_quant.py)
 and the byte accounting counts code + scale bytes honestly
-(`kv_bytes_reduction_x` ~3.9x at block 16 / head_dim 64).
+(`kv_bytes_reduction_x` ~3.9x at block 16 / head_dim 64). ISSUE 19
+takes the weight rung to the floor: `weight_dtype="int4"` packs
+nibble codes two-per-byte with group-wise fp32 scales along the
+reduction dim (`weight_group_size`, `quantization/int4.py`; grouped
+dequant fused into the matmul epilogue, ~5.6x resident weight bytes
+down with scales counted), `weight_dtype="fp8"` stores scale-free
+`float8_e4m3fn` casts, `comm_dtype="int8"` additionally quantizes
+the column-parallel logits all-gather (`quantized_allgather`,
+`tp_gather_bytes` ~3.7x down), and `spec_draft_model="shadow:int4"`
+drafts from a packed-int4 shadow of the target
+(tests/test_serving_weight_quant.py).
 
 The serving TIER (ISSUE 8): `router.py` (ServingRouter — N engine
 replicas, thread-per-engine, prefix-affinity routing keyed by the

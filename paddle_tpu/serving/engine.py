@@ -584,10 +584,10 @@ class ServingEngine:
                                   if spec_ngram_window else None)
         self.spec_draft_blocks = (int(spec_draft_blocks)
                                   if spec_draft_blocks else None)
-        # draft rung spec (ISSUE 18): None = n-gram prompt lookup; a
-        # "shadow[:int8|fp32]" string builds a quantized shadow of the
-        # target runner; a runner instance is used directly (recorded
-        # as "custom" — a snapshot cannot rebuild it)
+        # draft rung spec (ISSUE 18/19): None = n-gram prompt lookup; a
+        # "shadow[:int8|int4|fp8|fp32]" string builds a weight-quantized
+        # shadow of the target runner; a runner instance is used
+        # directly (recorded as "custom" — a snapshot cannot rebuild it)
         self.spec_draft_model = (spec_draft_model
                                  if isinstance(spec_draft_model, str)
                                  else None if spec_draft_model is None
@@ -603,7 +603,7 @@ class ServingEngine:
                         raise ValueError(
                             f"spec_draft_model={spec_draft_model!r}; "
                             "expected a runner instance or "
-                            "'shadow[:int8|fp32]'")
+                            "'shadow[:int8|int4|fp8|fp32]'")
                     draft = shadow_runner(runner, dt or "int8")
                 else:
                     draft = spec_draft_model
@@ -648,6 +648,12 @@ class ServingEngine:
             self.pool.kv_bytes_reduction_x())
         self.metrics.sessions_per_pool_x.set(
             self.pool.kv_bytes_reduction_x())
+        # weight-ladder HBM ratio (ISSUE 19): logical fp32 bytes over
+        # resident bytes (packed codes + group scales counted) — 1.0 on
+        # fp32 runners or runners without the accessor
+        wbx = getattr(runner, "weight_bytes_reduction_x", None)
+        if callable(wbx):
+            self.metrics.weight_bytes_reduction_x.set(float(wbx()))
         # host-RAM KV tier (ISSUE 10): built after the metrics so the
         # tier mirrors its spill/drop accounting straight into them.
         # With `kv_store` (ISSUE 14) the tier is a facade over the
@@ -1100,6 +1106,17 @@ class ServingEngine:
                 self.runner.tp_comm_bytes_fp32)
             self.metrics.tp_comm_bytes_reduction_x.set(
                 self.runner.tp_comm_bytes_fp32 / comm if comm else 0.0)
+        gather = getattr(self.runner, "tp_gather_bytes", None)
+        if gather is not None:
+            # the gather direction (ISSUE 19): wire bytes the column-
+            # parallel all-gathers (lm_head logits) moved per shard,
+            # scale bytes counted, vs the fp32 cost of the same calls
+            self.metrics.tp_gather_bytes.set(gather)
+            self.metrics.tp_gather_bytes_fp32.set(
+                self.runner.tp_gather_bytes_fp32)
+            self.metrics.tp_gather_bytes_reduction_x.set(
+                self.runner.tp_gather_bytes_fp32 / gather
+                if gather else 0.0)
         a = self.pool.allocator
         self.metrics.queue_depth.set(self.scheduler.queue_depth)
         self.metrics.running.set(len(self.scheduler.running))
@@ -2592,6 +2609,10 @@ class ServingEngine:
                 "kv_dtype": self.kv_dtype,
                 "weight_dtype": getattr(self.runner, "weight_dtype",
                                         "fp32"),
+                # int4 group geometry rides along with the dtype — the
+                # scale shapes (and thus accuracy) depend on it
+                "weight_group_size": getattr(self.runner,
+                                             "weight_group_size", 128),
                 # quantized-collective knob (ISSUE 15) rides along for
                 # the record like the other dtypes; restore follows
                 # the NEW runner's comm_dtype (logged on mismatch)
@@ -2688,9 +2709,11 @@ class ServingEngine:
                         snap_mesh, run_mesh)
         snap_q = (cfg.get("kv_dtype", "fp32"),
                   cfg.get("weight_dtype", "fp32"),
-                  cfg.get("comm_dtype", "fp32"))
+                  cfg.get("comm_dtype", "fp32"),
+                  cfg.get("weight_group_size", 128))
         run_q = (eng.kv_dtype, getattr(runner, "weight_dtype", "fp32"),
-                 getattr(runner, "comm_dtype", "fp32"))
+                 getattr(runner, "comm_dtype", "fp32"),
+                 getattr(runner, "weight_group_size", 128))
         if snap_q != run_q:
             # also legal (restore recomputes KV from tokens), but the
             # continued stream follows the NEW runner's quantization
@@ -2746,6 +2769,7 @@ def create_engine(model, *, num_blocks: int = 128,
                   attn_impl: str = "auto", mesh=None,
                   data_axis: str = "data", model_axis: str = "model",
                   kv_dtype: str = "fp32", weight_dtype: str = "fp32",
+                  weight_group_size: int = 128,
                   comm_dtype: str = "fp32",
                   **engine_kw) -> ServingEngine:
     """Build a ServingEngine for a supported decoder Layer (Llama, GPT).
@@ -2765,7 +2789,15 @@ def create_engine(model, *, num_blocks: int = 128,
     fp32 and fp8 tenants from one pool via `SamplingParams.kv_dtype`;
     `comm_dtype="int8"` (needs a mesh) swaps the row-parallel allreduce
     for the chunked quantized psum — accuracy-gated vs the fp32 TP
-    engine, ~4x fewer wire bytes (scale bytes counted)."""
+    engine, ~4x fewer wire bytes (scale bytes counted).
+
+    ISSUE 19 rungs: `weight_dtype="int4"` stores 2-D matmul weights as
+    packed nibble codes + group-wise fp32 scales (`weight_group_size`
+    reduction rows per scale, default 128) with the dequant fused into
+    the matmul epilogue — >= 3.5x fewer resident weight bytes, scale
+    bytes counted; `weight_dtype="fp8"` stores native float8_e4m3fn
+    weights (scale-free); `comm_dtype="int8"` now also quantizes the
+    column-parallel all-gather on the lm_head logits path."""
     if comm_dtype != "fp32" and mesh is None:
         raise ValueError(
             f"comm_dtype={comm_dtype!r} needs a tensor-parallel mesh — "
@@ -2773,7 +2805,8 @@ def create_engine(model, *, num_blocks: int = 128,
             "allreduce, which only exists at tp > 1")
     runner = runner_for(model, block_size=block_size,
                         max_model_len=max_model_len, attn_impl=attn_impl,
-                        kv_dtype=kv_dtype, weight_dtype=weight_dtype)
+                        kv_dtype=kv_dtype, weight_dtype=weight_dtype,
+                        weight_group_size=weight_group_size)
     if mesh is not None:
         runner.shard(mesh, data_axis=data_axis, model_axis=model_axis,
                      comm_dtype=comm_dtype)

@@ -98,6 +98,7 @@ SUMMABLE_KEYS = (
     "prefill_tokens", "prefill_chunks", "prefix_hit_tokens", "cow_copies",
     "prefix_cached_pages", "attn_kv_bytes_read", "attn_kv_bytes_gather",
     "tp_comm_bytes", "tp_comm_bytes_fp32",
+    "tp_gather_bytes", "tp_gather_bytes_fp32",
     "spec_proposed_tokens", "spec_accepted_tokens", "spec_rollback_pages",
     "spec_fused_horizons", "spec_dead_positions",
     "host_syncs", "decode_horizon_steps", "horizon_overshoot_tokens",
@@ -155,6 +156,9 @@ def aggregate_snapshots(snaps) -> Dict[str, float]:
     comm = out["tp_comm_bytes"]
     out["tp_comm_bytes_reduction_x"] = (out["tp_comm_bytes_fp32"] / comm
                                         if comm > 0 else 0.0)
+    gather = out["tp_gather_bytes"]
+    out["tp_gather_bytes_reduction_x"] = (
+        out["tp_gather_bytes_fp32"] / gather if gather > 0 else 0.0)
     out["replicas"] = float(len(snaps))
     return out
 
@@ -298,6 +302,19 @@ class EngineMetrics:
         self.tp_comm_bytes = Gauge("tp_comm_bytes")
         self.tp_comm_bytes_fp32 = Gauge("tp_comm_bytes_fp32")
         self.tp_comm_bytes_reduction_x = Gauge("tp_comm_bytes_reduction_x")
+        # the gather direction (ISSUE 19): wire bytes the column-
+        # parallel all-gathers (the lm_head logits path) moved per
+        # shard at the configured comm_dtype vs fp32 — same honest
+        # scale-bytes-counted accounting as the allreduce gauges
+        self.tp_gather_bytes = Gauge("tp_gather_bytes")
+        self.tp_gather_bytes_fp32 = Gauge("tp_gather_bytes_fp32")
+        self.tp_gather_bytes_reduction_x = Gauge(
+            "tp_gather_bytes_reduction_x")
+        # weight-ladder accounting (ISSUE 19): logical fp32 weight
+        # bytes over resident bytes (packed int4 codes + group scales /
+        # fp8 casts, scale bytes counted; 1.0 on fp32 runners) —
+        # measured from what the params dict actually stores
+        self.weight_bytes_reduction_x = Gauge("weight_bytes_reduction_x")
         # quantized-KV accounting (ISSUE 9): per-page byte reduction of
         # the pool vs storing at the logical dtype (scale bytes counted;
         # 1.0 on fp32 pools), and the matching concurrent-sessions-per-
@@ -379,6 +396,12 @@ class EngineMetrics:
             "tp_comm_bytes_fp32": self.tp_comm_bytes_fp32.value,
             "tp_comm_bytes_reduction_x":
                 self.tp_comm_bytes_reduction_x.value,
+            "tp_gather_bytes": self.tp_gather_bytes.value,
+            "tp_gather_bytes_fp32": self.tp_gather_bytes_fp32.value,
+            "tp_gather_bytes_reduction_x":
+                self.tp_gather_bytes_reduction_x.value,
+            "weight_bytes_reduction_x":
+                self.weight_bytes_reduction_x.value,
             "kv_bytes_reduction_x": self.kv_bytes_reduction_x.value,
             "sessions_per_pool_x": self.sessions_per_pool_x.value,
             "spec_proposed_tokens": self.spec_proposed_tokens.value,

@@ -130,11 +130,17 @@ from paddle_tpu.serving.kv_cache import (
     quantized_page_write, require_fp8,
 )
 
-# params-dict key suffix of a weight-only-int8 weight's per-output-channel
-# scale vector (ISSUE 9): "layers.0.self_attn.q_proj.weight::scale"
+# params-dict key suffix of a quantized weight's scale tensor (ISSUE 9):
+# "layers.0.self_attn.q_proj.weight::scale" — a 1-D [out] per-output-
+# channel vector for int8, a 2-D [out, ceil(in/group)] group-scale
+# matrix for int4 (ISSUE 19); fp8 weights are scale-free (no entry)
 SCALE_SUFFIX = "::scale"
 
-WEIGHT_DTYPES = ("fp32", "int8")
+# the weight ladder (ISSUE 9 -> 19): "int8" = per-output-channel scales
+# (2x fewer weight bytes), "int4" = packed nibble codes + group-wise
+# scales (~8x, group overhead counted), "fp8" = native float8_e4m3fn,
+# scale-free like the ISSUE 15 KV rung (4x)
+WEIGHT_DTYPES = ("fp32", "int8", "int4", "fp8")
 
 
 def bucket_len(t: int, minimum: int = 8) -> int:
@@ -310,7 +316,8 @@ class PagedModelRunner:
 
     def __init__(self, params: Dict[str, jnp.ndarray], block_size: int,
                  max_model_len: int, attn_impl: str = "auto",
-                 kv_dtype: str = "fp32", weight_dtype: str = "fp32"):
+                 kv_dtype: str = "fp32", weight_dtype: str = "fp32",
+                 weight_group_size: int = 128):
         self.params = params
         self.block_size = block_size
         self.max_model_len = max_model_len
@@ -321,10 +328,12 @@ class PagedModelRunner:
         # quantized serving knobs (ISSUE 9): kv_dtype="int8" makes the
         # engine build int8 page pools + per-page-per-head scale pools
         # (this runner quantizes at append time and dequantizes in the
-        # attend paths); weight_dtype="int8" converts the 2-D matmul
-        # weights to int8 codes + per-output-channel scales at
-        # construction (subclasses call _quantize_weights). Both default
-        # to "fp32", which is bit-identical to the pre-ISSUE-9 runner.
+        # attend paths); weight_dtype walks the weight ladder (ISSUE 19)
+        # — "int8" per-output-channel scales, "int4" packed nibble codes
+        # + group-wise scales (weight_group_size reduction rows per
+        # scale), "fp8" native float8_e4m3fn, scale-free. Subclasses
+        # call _quantize_weights at construction. Both knobs default to
+        # "fp32", which is bit-identical to the pre-ISSUE-9 runner.
         if kv_dtype not in KV_DTYPES:
             raise ValueError(f"kv_dtype={kv_dtype!r}; expected one of "
                              f"{KV_DTYPES}")
@@ -334,8 +343,18 @@ class PagedModelRunner:
         if weight_dtype not in WEIGHT_DTYPES:
             raise ValueError(f"weight_dtype={weight_dtype!r}; expected one "
                              f"of {WEIGHT_DTYPES}")
+        if weight_dtype == "fp8":
+            require_fp8(f"PagedModelRunner(weight_dtype={weight_dtype!r})")
+        if int(weight_group_size) < 1:
+            raise ValueError(f"weight_group_size must be >= 1, got "
+                             f"{weight_group_size}")
         self.kv_dtype = kv_dtype
         self.weight_dtype = weight_dtype
+        self.weight_group_size = int(weight_group_size)
+        # the params _quantize_weights converted (codes under the weight
+        # name, scales under name+SCALE_SUFFIX) — the weight_bytes()
+        # accounting's map back to logical fp32 shapes
+        self._quantized_names: frozenset = frozenset()
         self._jit_cache: "OrderedDict" = OrderedDict()
         self._impl_logged: set = set()
         # tensor-parallel state (ISSUE 7): set by shard(); mesh=None is
@@ -355,13 +374,23 @@ class PagedModelRunner:
         self.comm_dtype = "fp32"
         self._row_names: frozenset = frozenset()
         self._row_out_dims: tuple = ()
+        # the gather direction (ISSUE 19): column-parallel weights whose
+        # output is consumed REPLICATED (the lm_head's logits) — with a
+        # quantized comm_dtype these route through _col_mm's explicit
+        # shard_map + layout.column_parallel_gather(). _gather_out_dims
+        # are their per-shard output widths (the gather wire operands)
+        self._gather_names: frozenset = frozenset()
+        self._gather_out_dims: tuple = ()
         # instrumented-comm counters (ISSUE 15): wire bytes PER SHARD
         # the row-parallel allreduces moved at the configured comm
         # dtype vs what fp32 psums would have moved for the same calls
         # (scale bytes counted on the int8 side) — host-side analytics
-        # like the attention byte counters below
+        # like the attention byte counters below. ISSUE 19 adds the
+        # gather direction's pair (the column-parallel all-gather)
         self.tp_comm_bytes = 0.0
         self.tp_comm_bytes_fp32 = 0.0
+        self.tp_gather_bytes = 0.0
+        self.tp_gather_bytes_fp32 = 0.0
         # instrumented-pool counters: HBM bytes of KV pool the chosen
         # attention path touches (host-side analytics, CPU-countable) vs
         # what the gather path would have read for the same calls.
@@ -383,40 +412,77 @@ class PagedModelRunner:
     def n_rep(self) -> int:
         return self.n_heads // self.n_kv_heads
 
-    # ------------------------------------------- weight-only int8 (ISSUE 9)
+    # --------------------------------- the weight ladder (ISSUE 9 / 19)
 
     def _quantize_weights(self, names) -> None:
-        """Convert the named 2-D [in, out] matmul weights to int8 codes
-        plus per-output-channel fp32 scales (`name + "::scale"` params).
-        Uses the established quantization/int8.py abs-max scheme; the
-        matmul epilogue dequant lives in `_mm`. Norms, biases, and
-        embeddings stay floating — only the HBM-heavy matrices halve."""
-        from paddle_tpu.quantization.int8 import _weight_quantize
+        """Convert the named 2-D [in, out] matmul weights to this
+        runner's weight_dtype rung (ISSUE 19): "int8" = int8 codes +
+        per-output-channel fp32 scale vectors (the established
+        quantization/int8.py abs-max scheme), "int4" = packed nibble
+        codes + group-wise scales ([out, ceil(in/group)] — see
+        quantization/int4.py's layout contract), "fp8" = a scale-free
+        float8_e4m3fn cast. Scales land as `name + "::scale"` params;
+        the matmul epilogue dequant lives in `_mm`. Norms, biases, and
+        embeddings stay floating — only the HBM-heavy matrices shrink."""
+        if self.weight_dtype == "int4":
+            from paddle_tpu.quantization.int4 import int4_quantize
 
-        for name in names:
-            w = self.params[name]
-            qw, scale = _weight_quantize(w)
-            self.params[name] = qw
-            self.params[name + SCALE_SUFFIX] = scale.astype(jnp.float32)
-        logger.info("serving weights quantized int8: %d matrices "
-                    "(per-output-channel scales)", len(names))
+            for name in names:
+                qw, scale = int4_quantize(self.params[name],
+                                          self.weight_group_size)
+                self.params[name] = qw
+                self.params[name + SCALE_SUFFIX] = scale
+            logger.info("serving weights quantized int4: %d matrices "
+                        "(packed nibbles, group scales, group=%d)",
+                        len(names), self.weight_group_size)
+        elif self.weight_dtype == "fp8":
+            for name in names:
+                self.params[name] = self.params[name].astype(
+                    jnp.float8_e4m3fn)
+            logger.info("serving weights cast fp8: %d matrices "
+                        "(float8_e4m3fn, scale-free)", len(names))
+        else:
+            from paddle_tpu.quantization.int8 import _weight_quantize
+
+            for name in names:
+                w = self.params[name]
+                qw, scale = _weight_quantize(w)
+                self.params[name] = qw
+                self.params[name + SCALE_SUFFIX] = scale.astype(jnp.float32)
+            logger.info("serving weights quantized int8: %d matrices "
+                        "(per-output-channel scales)", len(names))
+        self._quantized_names = frozenset(names)
 
     def _mm(self, params, name, x):
         """Matmul against a possibly-quantized weight: fp32 weights take
         the exact pre-ISSUE-9 `x @ w` (bit-identical default path);
-        int8 weights dequantize in the matmul epilogue — the int8 codes
-        are what HBM reads, the per-output-channel scale multiplies the
-        dot output (exactly `x @ (qw * scale)` by column linearity).
-        With a quantized comm_dtype (ISSUE 15), row-parallel weights
-        route through _row_mm's explicit shard_map + quantized reduce;
-        everything else (and the whole fp32-comm default) keeps the
-        GSPMD path verbatim."""
-        if self.comm_dtype != "fp32" and name in self._row_names:
-            return self._row_mm(params, name, x)
+        quantized weights dequantize in the matmul epilogue — the codes
+        are what HBM reads. int8: the per-output-channel scale (1-D)
+        multiplies the dot output (exactly `x @ (qw * scale)` by column
+        linearity). int4 (ISSUE 19): the 2-D group-scale matrix rides
+        quantization/int4.py's grouped epilogue (scale per reduction
+        group BEFORE the group-sum — exact by the same linearity).
+        fp8: a scale-free cast into the dot. With a quantized
+        comm_dtype (ISSUE 15/19), row-parallel weights route through
+        _row_mm's explicit shard_map + quantized reduce and the
+        replicated-output column weights (lm_head) through _col_mm's
+        quantized gather; everything else (and the whole fp32-comm
+        default) keeps the GSPMD path verbatim."""
+        if self.comm_dtype != "fp32":
+            if name in self._row_names:
+                return self._row_mm(params, name, x)
+            if name in self._gather_names:
+                return self._col_mm(params, name, x)
         w = params[name]
         s = params.get(name + SCALE_SUFFIX)
         if s is None:
+            if str(w.dtype).startswith("float8"):
+                return x @ w.astype(x.dtype)
             return x @ w
+        if s.ndim == 2:
+            from paddle_tpu.quantization.int4 import int4_matmul
+
+            return int4_matmul(x, w, s, self.weight_group_size)
         return (x @ w.astype(x.dtype)) * s.astype(x.dtype)
 
     def _row_mm(self, params, name, x):
@@ -427,10 +493,13 @@ class PagedModelRunner:
         chunked scales via pmax + int8 code psum + dequant). Runs as a
         shard_map over the model axis because the collective must be
         explicit to be quantized (GSPMD would insert its own fp32
-        psum). Weight-only int8 (ISSUE 9) composes: the
-        per-output-channel scale is replicated on row-parallel weights
-        and multiplies AFTER the reduce (exact by linearity for psum;
-        the honest dequant point for the quantized reduce)."""
+        psum). The weight ladder composes: int8's per-output-channel
+        scale is replicated on row-parallel weights and multiplies
+        AFTER the reduce (exact by linearity for psum; the honest
+        dequant point for the quantized reduce); int4's group scales
+        shard WITH the reduction dim (each shard owns whole groups —
+        shard() enforces the alignment) so the grouped epilogue runs
+        in-shard BEFORE the reduce; fp8 weights cast in-shard."""
         from paddle_tpu.parallel.pipeline import compat_shard_map
 
         axis = self.model_axis
@@ -438,6 +507,19 @@ class PagedModelRunner:
         w = params[name]
         s = params.get(name + SCALE_SUFFIX)
         x_spec = P(*((None,) * (x.ndim - 1) + (axis,)))
+        if s is not None and s.ndim == 2:
+            from paddle_tpu.quantization.int4 import int4_matmul
+
+            g = self.weight_group_size
+
+            def f4(x_local, w_local, s_local):
+                part = int4_matmul(x_local, w_local, s_local, g)
+                return reduce_fn(part, axis)
+
+            return compat_shard_map(
+                f4, mesh=self.mesh,
+                in_specs=(x_spec, P(axis, None), P(None, axis)),
+                out_specs=P(), axis_names=frozenset({axis}))(x, w, s)
 
         def f(x_local, w_local):
             part = x_local @ w_local.astype(x_local.dtype)
@@ -449,6 +531,54 @@ class PagedModelRunner:
         if s is not None:
             out = out * s.astype(x.dtype)
         return out
+
+    def _col_mm(self, params, name, x):
+        """Column-parallel matmul whose output is consumed REPLICATED —
+        the lm_head's logits (ISSUE 19) — with an EXPLICIT gather: each
+        model shard computes its own output-column slice (weight-ladder
+        epilogue included, since scales shard with the columns), then
+        the layout's `column_parallel_gather()` hook assembles the full
+        width — `quantized_allgather` at comm_dtype="int8" (pmax-shared
+        per-row chunk scales, int8 codes gathered wide, one dequant).
+        Explicit shard_map for the same reason as _row_mm: GSPMD would
+        insert its own fp32 all-gather. x rides in replicated (the
+        column-parallel input contract)."""
+        from paddle_tpu.parallel.pipeline import compat_shard_map
+
+        axis = self.model_axis
+        gather_fn = self._layout.column_parallel_gather()
+        w = params[name]
+        s = params.get(name + SCALE_SUFFIX)
+        w_spec = P(None, axis)
+        if s is None:
+            def f(x_local, w_local):
+                part = x_local @ w_local.astype(x_local.dtype)
+                return gather_fn(part, axis)
+
+            return compat_shard_map(
+                f, mesh=self.mesh, in_specs=(P(), w_spec),
+                out_specs=P(), axis_names=frozenset({axis}))(x, w)
+        if s.ndim == 2:
+            from paddle_tpu.quantization.int4 import int4_matmul
+
+            g = self.weight_group_size
+
+            def f4(x_local, w_local, s_local):
+                part = int4_matmul(x_local, w_local, s_local, g)
+                return gather_fn(part, axis)
+
+            return compat_shard_map(
+                f4, mesh=self.mesh, in_specs=(P(), w_spec, P(axis, None)),
+                out_specs=P(), axis_names=frozenset({axis}))(x, w, s)
+
+        def f8(x_local, w_local, s_local):
+            part = (x_local @ w_local.astype(x_local.dtype)
+                    ) * s_local.astype(x_local.dtype)
+            return gather_fn(part, axis)
+
+        return compat_shard_map(
+            f8, mesh=self.mesh, in_specs=(P(), w_spec, P(axis)),
+            out_specs=P(), axis_names=frozenset({axis}))(x, w, s)
 
     # --------------------------------------------------- sharding (ISSUE 7)
 
@@ -523,25 +653,56 @@ class PagedModelRunner:
         layout = SpecLayout(data_axis=data_axis, model_axis=model_axis,
                             comm_dtype=comm_dtype)
         specs = self._param_specs(layout)
-        # weight-only int8 (ISSUE 9): a quantized weight's scale vector
-        # shards WITH its output columns — column-parallel weights
-        # ([in, out] split on out) carry P(model) scales, row-parallel
-        # ones ([in, out] split on in) carry replicated scales. Derived
-        # from the weight's own spec so the two can never disagree.
+        # a quantized weight's scale tensor shards WITH its weight
+        # (ISSUE 9/19), derived from the weight's own spec so the two
+        # can never disagree. int8's 1-D [out] vector takes the
+        # out-dim's axes (column-parallel -> P(model), row-parallel ->
+        # replicated). int4's 2-D [out, groups] matrix takes the
+        # TRANSPOSED weight spec: column-parallel shards codes AND
+        # scales on the out dim; row-parallel shards the packed in-dim
+        # and the reduction-dim groups with it. fp8 is scale-free.
         for name in list(specs):
             sname = name + SCALE_SUFFIX
             if sname in self.params:
                 spec = tuple(specs[name])
-                specs[sname] = P(spec[1]) if len(spec) >= 2 else P()
+                if len(spec) < 2:
+                    specs[sname] = P()
+                elif self.params[sname].ndim == 2:
+                    specs[sname] = P(spec[1], spec[0])
+                else:
+                    specs[sname] = P(spec[1])
         shardings: Dict[str, NamedSharding] = {}
         for name, v in self.params.items():
+            if name.endswith(SCALE_SUFFIX):
+                continue                # placed with its weight below
             spec = specs.get(name, P())
-            if spec != P() and not self._spec_fits(v.shape, spec, mesh):
+            sname = name + SCALE_SUFFIX
+            sspec = specs.get(sname, P())
+            fits = spec == P() or self._spec_fits(v.shape, spec, mesh)
+            if fits and sname in self.params and sspec != P():
+                sarr = self.params[sname]
+                fits = self._spec_fits(sarr.shape, sspec, mesh)
+                if fits and sarr.ndim == 2 and \
+                        tuple(spec) == tuple(layout.row_parallel()):
+                    # int4 row-parallel: every shard must own WHOLE
+                    # reduction groups or the grouped epilogue would
+                    # mis-scale across the shard boundary — the logical
+                    # in-dim is 2x the packed code rows
+                    k = 2 * int(v.shape[0])
+                    fits = (k // tp) % min(self.weight_group_size,
+                                           k) == 0
+            if spec != P() and not fits:
+                # a non-dividing weight (or non-aligning scale) falls
+                # back replicated TOGETHER with its scale — codes and
+                # scales never disagree about placement
                 logger.warning(
                     "shard: %s %s does not divide over %s — this param "
-                    "stays replicated", name, tuple(v.shape), spec)
-                spec = P()
+                    "(and its scale) stays replicated", name,
+                    tuple(v.shape), spec)
+                spec, sspec = P(), P()
             shardings[name] = NamedSharding(mesh, spec)
+            if sname in self.params:
+                shardings[sname] = NamedSharding(mesh, sspec)
         self.params = {name: jax.device_put(v, shardings[name])
                        for name, v in self.params.items()}
         self.mesh = mesh
@@ -559,18 +720,36 @@ class PagedModelRunner:
         rows = sorted(n for n in specs
                       if not n.endswith(SCALE_SUFFIX)
                       and tuple(shardings[n].spec) == row)
+        # the gather direction (ISSUE 19): column-parallel weights whose
+        # OUTPUT the step consumes replicated. That is exactly the
+        # logits head — q/k/v/gate/up outputs stay head-/hidden-sharded
+        # into the next op, so only lm_head ever pays a (quantizable)
+        # all-gather. Tied-embedding models compute logits off the
+        # embedding table and keep the GSPMD path (logged).
+        col = tuple(layout.column_parallel())
+        gathers = sorted(
+            n for n in ("lm_head.weight",)
+            if n in self.params and tuple(shardings[n].spec) == col)
         self.comm_dtype = comm_dtype
         self._row_names = frozenset(rows)
         self._row_out_dims = tuple(int(self.params[n].shape[1])
                                    for n in rows)
+        self._gather_names = frozenset(gathers)
+        self._gather_out_dims = tuple(int(self.params[n].shape[1]) // tp
+                                      for n in gathers)
+        if comm_dtype != "fp32" and not gathers:
+            logger.info(
+                "shard: no column-parallel gather to quantize (tied "
+                "embeddings or replicated lm_head) — the logits path "
+                "keeps GSPMD")
         self._jit_cache.clear()        # shardings are baked per jit entry
         logger.info(
             "serving runner sharded: mesh=%s tp=%d (%d/%d heads, %d/%d "
             "kv-heads per shard) comm_dtype=%s (%d row-parallel "
-            "allreduces/step)",
+            "allreduces + %d column-parallel gathers/step)",
             dict(mesh.shape), tp, self.n_heads // tp, self.n_heads,
             self.n_kv_heads // tp, self.n_kv_heads, comm_dtype,
-            len(rows))
+            len(rows), len(gathers))
         return self
 
     @property
@@ -748,20 +927,68 @@ class PagedModelRunner:
         operands the device call gets, quantized-vs-fp32 honestly
         (scale bytes included via qcomm.allreduce_bytes). No-op on
         unsharded runners."""
-        if self.tp_size <= 1 or not self._row_out_dims:
+        if self.tp_size <= 1 or not (self._row_out_dims
+                                     or self._gather_out_dims):
             return
-        from paddle_tpu.quantization.qcomm import allreduce_bytes
+        from paddle_tpu.quantization.qcomm import (
+            allgather_bytes, allreduce_bytes,
+        )
 
         r = int(rows) * int(steps)
         for d in self._row_out_dims:
             self.tp_comm_bytes_fp32 += allreduce_bytes(r, d, "fp32")
             self.tp_comm_bytes += allreduce_bytes(r, d, self.comm_dtype)
+        # the gather direction (ISSUE 19): the logits head's
+        # column-parallel all-gather moves each shard's [rows, V/tp]
+        # slice — counted at the configured comm dtype vs fp32, scale
+        # bytes included, same honesty rule as the reduce side (the
+        # fp32 engine pays this gather too, via GSPMD)
+        for d in self._gather_out_dims:
+            self.tp_gather_bytes_fp32 += allgather_bytes(r, d, "fp32")
+            self.tp_gather_bytes += allgather_bytes(r, d, self.comm_dtype)
 
     def reset_attn_counters(self) -> None:
         self.attn_kv_bytes_read = 0.0
         self.attn_kv_bytes_gather = 0.0
         self.tp_comm_bytes = 0.0
         self.tp_comm_bytes_fp32 = 0.0
+        self.tp_gather_bytes = 0.0
+        self.tp_gather_bytes_fp32 = 0.0
+
+    # ----------------------------------- weight byte accounting (ISSUE 19)
+
+    def weight_bytes(self) -> int:
+        """Resident HBM bytes of the whole params dict — quantized
+        codes + scale tensors + the floating params (embeddings, norms,
+        biases) counted at their actual storage dtypes. Honest by
+        construction: scales and packed nibbles are real residents, so
+        the committed reduction is measured, never an assumed 8x."""
+        return int(sum(int(v.nbytes) for v in self.params.values()))
+
+    def weight_bytes_fp32(self) -> int:
+        """What the SAME logical params would cost at fp32: quantized
+        weights count their logical [in, out] element count (packed
+        int4 codes hold TWO logical elements per byte) at 4 bytes,
+        scale tensors count zero (they don't exist on an fp32 runner),
+        floating params count their element count at 4 bytes."""
+        total = 0
+        for name, v in self.params.items():
+            if name.endswith(SCALE_SUFFIX):
+                continue
+            elems = int(v.size)
+            if name in self._quantized_names and self.weight_dtype == \
+                    "int4":
+                elems *= 2              # two nibbles per packed byte
+            total += elems * 4
+        return total
+
+    def weight_bytes_reduction_x(self) -> float:
+        """Measured whole-model weight-byte reduction vs fp32 — 1.0 on
+        the default runner, the bench/acceptance number on quantized
+        ones (int4 >= 3.5x on matmul-dominated configs with the group
+        scales counted)."""
+        wb = self.weight_bytes()
+        return self.weight_bytes_fp32() / wb if wb else 1.0
 
     # ------------------------------------------------------------- steps
 
@@ -1290,14 +1517,15 @@ class LlamaRunner(PagedModelRunner):
 
     def __init__(self, model, block_size: int = 16,
                  max_model_len: int | None = None, attn_impl: str = "auto",
-                 kv_dtype: str = "fp32", weight_dtype: str = "fp32"):
+                 kv_dtype: str = "fp32", weight_dtype: str = "fp32",
+                 weight_group_size: int = 128):
         from paddle_tpu.jit.functionalize import functionalize
 
         cfg = model.cfg
         params = functionalize(model).param_values()
         super().__init__(params, block_size,
                          max_model_len or cfg.max_seq_len, attn_impl,
-                         kv_dtype, weight_dtype)
+                         kv_dtype, weight_dtype, weight_group_size)
         self.cfg = cfg
         self.num_layers = cfg.num_layers
         self.n_heads = cfg.num_heads
@@ -1307,7 +1535,7 @@ class LlamaRunner(PagedModelRunner):
         cos, sin = _rope_tables(self.max_model_len, self.head_dim,
                                 cfg.rope_theta)
         self._rope_cos, self._rope_sin = cos, sin      # [L, d] fp32
-        if weight_dtype == "int8":
+        if weight_dtype != "fp32":
             names = []
             for i in range(self.num_layers):
                 pre = f"layers.{i}."
@@ -1402,26 +1630,28 @@ class GPTRunner(PagedModelRunner):
 
     def __init__(self, model, block_size: int = 16,
                  max_model_len: int | None = None, attn_impl: str = "auto",
-                 kv_dtype: str = "fp32", weight_dtype: str = "fp32"):
+                 kv_dtype: str = "fp32", weight_dtype: str = "fp32",
+                 weight_group_size: int = 128):
         from paddle_tpu.jit.functionalize import functionalize
 
         cfg = model.cfg
         params = functionalize(model).param_values()
         super().__init__(params, block_size,
                          max_model_len or cfg.max_seq_len, attn_impl,
-                         kv_dtype, weight_dtype)
+                         kv_dtype, weight_dtype, weight_group_size)
         self.cfg = cfg
         self.num_layers = cfg.num_layers
         self.n_heads = cfg.num_heads
         self.n_kv_heads = cfg.num_heads
         self.head_dim = cfg.hidden_size // cfg.num_heads
         self.vocab_size = cfg.vocab_size
-        if weight_dtype == "int8":
+        if weight_dtype != "fp32":
             # GPT stores the fused QKV weight FLAT as [hidden, 3*nh*d]
-            # (column order (3, nh, d)), so per-output-channel abs-max
-            # quantization is exact per fused column; _weight_quantize
-            # itself rejects a raw (3, nh, d) tensor loudly (ISSUE 9
-            # satellite) rather than silently scaling over the qkv axis.
+            # (column order (3, nh, d)), so per-output-channel/group
+            # abs-max quantization is exact per fused column; the
+            # quantizers reject a raw (3, nh, d) tensor loudly (ISSUE 9
+            # satellite, generalized to int4 in ISSUE 19) rather than
+            # silently scaling over the qkv axis.
             # MoE blocks (mlp.gate present) keep their expert weights
             # floating — only dense matmul matrices quantize.
             names = []
@@ -1477,10 +1707,15 @@ class GPTRunner(PagedModelRunner):
             x = x + (self._mm(p, "attn.out.weight", out)
                      + p["attn.out.bias"])
             h = _layer_norm(x, p["ln2.weight"], p["ln2.bias"])
-            if "mlp.fc1.weight" + SCALE_SUFFIX in p:
-                # dense MLP with int8 weights: same gelu(fc1)+fc2 math,
-                # matmuls through the dequant epilogue (_mlp stays the
-                # untouched fp32 path so the default is bit-identical)
+            fc1 = p.get("mlp.fc1.weight")
+            if fc1 is not None and (
+                    "mlp.fc1.weight" + SCALE_SUFFIX in p
+                    or str(fc1.dtype).startswith("float8")):
+                # dense MLP with quantized weights (scale-carrying int8/
+                # int4 or scale-free fp8 — keyed on both, since fp8 has
+                # no scale entry): same gelu(fc1)+fc2 math, matmuls
+                # through the dequant epilogue (_mlp stays the untouched
+                # fp32 path so the default is bit-identical)
                 hm = jax.nn.gelu(self._mm(p, "mlp.fc1.weight", h)
                                  + p["mlp.fc1.bias"], approximate=True)
                 x = x + self._mm(p, "mlp.fc2.weight", hm) + p["mlp.fc2.bias"]
@@ -1488,7 +1723,13 @@ class GPTRunner(PagedModelRunner):
                 x = x + _mlp(p, h)
             new_pools.append(layer)
         x = _layer_norm(x, params["ln_f.weight"], params["ln_f.bias"])
-        if "lm_head.weight" + SCALE_SUFFIX in params:
+        if "lm_head.weight" in params and (
+                "lm_head.weight" + SCALE_SUFFIX in params
+                or str(params["lm_head.weight"].dtype).startswith("float8")
+                or (self.comm_dtype != "fp32"
+                    and "lm_head.weight" in self._gather_names)):
+            # quantized head, or a head whose gather is routed through
+            # the explicit quantized collective (ISSUE 19)
             logits = self._mm(params, "lm_head.weight", x)
         elif "lm_head.weight" in params:
             logits = jnp.einsum("bth,hv->btv", x, params["lm_head.weight"])
@@ -1499,17 +1740,18 @@ class GPTRunner(PagedModelRunner):
 
 def runner_for(model, block_size: int = 16, max_model_len: int | None = None,
                attn_impl: str = "auto", kv_dtype: str = "fp32",
-               weight_dtype: str = "fp32") -> PagedModelRunner:
+               weight_dtype: str = "fp32",
+               weight_group_size: int = 128) -> PagedModelRunner:
     """Pick the runner for a supported decoder Layer."""
     from paddle_tpu.models.gpt import GPT
     from paddle_tpu.models.llama import Llama
 
     if isinstance(model, Llama):
         return LlamaRunner(model, block_size, max_model_len, attn_impl,
-                           kv_dtype, weight_dtype)
+                           kv_dtype, weight_dtype, weight_group_size)
     if isinstance(model, GPT):
         return GPTRunner(model, block_size, max_model_len, attn_impl,
-                         kv_dtype, weight_dtype)
+                         kv_dtype, weight_dtype, weight_group_size)
     raise TypeError(
         f"no serving runner for {type(model).__name__}; supported: Llama, "
         "GPT (write a PagedModelRunner subclass for custom decoders)")

@@ -61,7 +61,8 @@ def model_runner_factory(index: int = 0, *, model: str = "llama",
                          seed: int = 0, block_size: int = 16,
                          max_model_len: Optional[int] = None,
                          attn_impl: str = "auto", kv_dtype: str = "fp32",
-                         weight_dtype: str = "fp32", **cfg_kw):
+                         weight_dtype: str = "fp32",
+                         weight_group_size: int = 128, **cfg_kw):
     """Built-in factory for real-model replicas: builds a Llama/GPT
     PagedModelRunner from config kwargs, seeded — every process that
     calls this with the same arguments holds IDENTICAL weights, which
@@ -84,7 +85,8 @@ def model_runner_factory(index: int = 0, *, model: str = "llama",
     net.eval()
     return runner_for(net, block_size=block_size,
                       max_model_len=max_model_len, attn_impl=attn_impl,
-                      kv_dtype=kv_dtype, weight_dtype=weight_dtype)
+                      kv_dtype=kv_dtype, weight_dtype=weight_dtype,
+                      weight_group_size=weight_group_size)
 
 
 class ReplicaServer:

@@ -586,6 +586,82 @@ def audit_engine(engine) -> None:
                         f"{pool.num_blocks} pages sharded only on the "
                         "kv-head axis")
 
+    # -- packed weights (ISSUE 19): a quantized runner's params dict
+    #    must honor the weight-ladder storage contract. int4: every
+    #    quantized weight is an int8 packed-code matrix whose companion
+    #    "<name>::scale" tensor is fp32 of shape [out, ceil(in/g)]
+    #    (in = 2 * packed rows, g = the runner's group size). int8:
+    #    the scale is the 1-D per-output-channel vector. fp8: the
+    #    weight itself is pinned float8 and carries NO scale entry (a
+    #    scale on an fp8 weight means someone reintroduced the int
+    #    lifecycle). At tp > 1 the same formula must hold PER SHARD —
+    #    column-parallel splits codes and scales on out, row-parallel
+    #    splits codes on in and scales on the group axis, and in both
+    #    cases scale_shard == (code_out_local, ceil(code_in_local/g)).
+    runner = engine.runner
+    w_dtype = getattr(runner, "weight_dtype", "fp32")
+    qnames = getattr(runner, "_quantized_names", frozenset())
+    params = getattr(runner, "params", None)
+    if w_dtype != "fp32" and params is not None:
+        gs = int(getattr(runner, "weight_group_size", 128))
+        suffix = "::scale"
+        for name in sorted(qnames):
+            w = params.get(name)
+            s = params.get(name + suffix)
+            if w is None:
+                problems.append(f"quantized weight {name} missing from "
+                                "params")
+                continue
+            if w_dtype == "fp8":
+                if not str(w.dtype).startswith("float8"):
+                    problems.append(
+                        f"{name} dtype {w.dtype} is not a float8 type on "
+                        "an fp8 runner")
+                if s is not None:
+                    problems.append(
+                        f"{name} carries a scale tensor on an fp8 runner "
+                        "— fp8 weights are scale-free casts")
+                continue
+            if str(w.dtype) != "int8":
+                problems.append(f"{name} code dtype {w.dtype} != int8 on "
+                                f"a {w_dtype} runner")
+            if s is None:
+                problems.append(f"{name} has no {suffix} tensor on a "
+                                f"{w_dtype} runner")
+                continue
+            if str(s.dtype) != "float32":
+                problems.append(f"{name}{suffix} dtype {s.dtype} != "
+                                "float32")
+            if w_dtype == "int4":
+                k = 2 * int(w.shape[0])
+                g = min(gs, k)
+                want = (int(w.shape[1]), -(-k // g))
+                if tuple(s.shape) != want:
+                    problems.append(
+                        f"{name}{suffix} shape {tuple(s.shape)} != {want}"
+                        f" — one fp32 scale per output channel per "
+                        f"{g}-row reduction group")
+                shards = getattr(w, "addressable_shards", None)
+                s_shards = getattr(s, "addressable_shards", None)
+                if shards and s_shards and len(shards) > 1:
+                    w_shapes = {tuple(sh.data.shape) for sh in shards}
+                    s_shapes = {tuple(sh.data.shape) for sh in s_shards}
+                    want_s = {(n_loc, -(-(2 * k2_loc) // g))
+                              for k2_loc, n_loc in w_shapes}
+                    if s_shapes != want_s:
+                        problems.append(
+                            f"{name}{suffix} per-shard shapes "
+                            f"{sorted(s_shapes)} != {sorted(want_s)} — "
+                            "codes and scales must split on the same "
+                            "axis (out column-parallel, groups row-"
+                            "parallel) or replicate together")
+            else:  # int8: 1-D per-output-channel scale
+                if s.ndim != 1 or int(s.shape[0]) != int(w.shape[1]):
+                    problems.append(
+                        f"{name}{suffix} shape {tuple(s.shape)} != "
+                        f"({int(w.shape[1])},) — one scale per output "
+                        "channel")
+
     # -- host KV tier (ISSUE 10): every page is device-live XOR host-
     #    resident XOR free. Host-slot accounting mirrors the device
     #    allocator's (free/used partition, single ownership: one

@@ -198,23 +198,31 @@ class AdaptiveK:
 
 
 def shadow_runner(target, weight_dtype: str = "int8"):
-    """An int8-quantized shadow of ``target`` for the draft rung: same
+    """A weight-quantized shadow of ``target`` for the draft rung: same
     weights, same paged-pool geometry, own params dict and jit cache.
-    Quantizes every 2-D non-embedding ``.weight`` via the ISSUE 9
-    weight-only path (dequant in the matmul epilogue); embeddings and
-    norms stay floating, exactly like the subclass int8 constructors.
+    Quantizes every 2-D non-embedding ``.weight`` down the ISSUE 19
+    weight ladder — int8 per-channel, int4 packed + group scales, or
+    fp8 native — with the dequant in the matmul epilogue; embeddings
+    and norms stay floating, exactly like the subclass constructors.
     The shadow is draft-only, so quantization noise costs acceptance
     rate, never exactness."""
     import copy
     from collections import OrderedDict
 
-    if weight_dtype not in ("fp32", "int8"):
-        raise ValueError(f"unsupported shadow weight_dtype {weight_dtype!r}")
+    from .model_runner import WEIGHT_DTYPES
+
+    if weight_dtype not in WEIGHT_DTYPES:
+        raise ValueError(f"unsupported shadow weight_dtype {weight_dtype!r}"
+                         f"; expected one of {WEIGHT_DTYPES}")
+    if weight_dtype == "fp8":
+        from .kv_cache import require_fp8
+
+        require_fp8(f"shadow_runner(weight_dtype={weight_dtype!r})")
     r = copy.copy(target)
     r.params = dict(target.params)
     r._jit_cache = OrderedDict()
     r._impl_logged = set()
-    if weight_dtype == "int8" and getattr(target, "weight_dtype",
+    if weight_dtype != "fp32" and getattr(target, "weight_dtype",
                                           "fp32") == "fp32":
         import numpy as np
 
@@ -227,6 +235,7 @@ def shadow_runner(target, weight_dtype: str = "int8"):
                     and not any(s in name for s in skip)):
                 names.append(name)
         r.weight_dtype = weight_dtype
+        r.weight_group_size = getattr(target, "weight_group_size", 128)
         r._quantize_weights(names)
     return r
 

@@ -44,6 +44,7 @@ def test_ernie_attention_mask_blocks_padding():
                                np.asarray(s2._value)[:, :8], atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ernie_pretrain_trainstep_converges():
     paddle.seed(0)
     cfg = ErnieConfig(**TINY)

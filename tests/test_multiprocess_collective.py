@@ -108,6 +108,7 @@ def _free_port():
     return port
 
 
+@pytest.mark.slow
 def test_four_process_collectives_and_dp_step(tmp_path):
     script = tmp_path / "collective_worker.py"
     script.write_text(_WORKER)

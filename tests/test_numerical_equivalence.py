@@ -105,7 +105,11 @@ def test_dp_trainstep_matches_single_device():
 
 # ------------------------------------------------------------- ZeRO stages
 
-@pytest.mark.parametrize("level", ["os", "os_g", "p_g_os"])
+@pytest.mark.parametrize("level", [
+    pytest.param("os", marks=pytest.mark.slow),
+    pytest.param("os_g", marks=pytest.mark.slow),
+    "p_g_os",
+])
 def test_zero_stage_matches_single_device(level):
     tokens = paddle.to_tensor(rng.integers(0, 64, (8, 16)))
 

@@ -69,6 +69,7 @@ def test_sp_config_accepted():
         dist.set_mesh(None)
 
 
+@pytest.mark.slow
 def test_moe_greedy_matches_full_forward():
     paddle.seed(5)
     model = GPT(GPTConfig(**dict(CFG, moe_every=2, moe_experts=4)))

@@ -94,6 +94,7 @@ def test_interleave_simulator_constraints():
 
 # ------------------------------------------------------- interleave numerics
 
+@pytest.mark.slow
 def test_interleave_matches_chain_and_gpipe(mesh_pp4):
     mesh = dist.current_mesh()
     m, b = 8, 2
@@ -163,7 +164,11 @@ def test_1f1b_loss_and_grads_match_autodiff(mesh_pp4):
 
 # ----------------------------------------------------------- GPT end-to-end
 
-@pytest.mark.parametrize("schedule", ["1f1b", "interleave", "zbh1"])
+@pytest.mark.parametrize("schedule", [
+    "1f1b",
+    pytest.param("interleave", marks=pytest.mark.slow),
+    pytest.param("zbh1", marks=pytest.mark.slow),
+])
 def test_gpt_pipeline_schedules_train(mesh_pp4, schedule):
     from paddle_tpu.models.gpt import GPTConfig, build_pipeline_train_step
 
@@ -189,6 +194,7 @@ def test_gpt_pipeline_schedules_train(mesh_pp4, schedule):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_gpt_interleave_grouped_chunks(mesh_pp4):
     """v smaller than layers/pp: each virtual stage chains several blocks."""
     from paddle_tpu.models.gpt import GPTConfig, build_pipeline_train_step

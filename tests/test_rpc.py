@@ -129,6 +129,7 @@ print(f"RANK{rank}_OK")
 """
 
 
+@pytest.mark.slow
 def test_rpc_two_processes(tmp_path):
     """Real process isolation: two workers, store-rendezvous, cross calls,
     graceful barrier shutdown."""

@@ -284,6 +284,7 @@ def test_engine_pallas_decode_path_matches_reference():
         assert outs[rid].output_tokens == ref
 
 
+@pytest.mark.slow
 def test_scheduler_fuzz_no_leaks_and_oracle_equivalence():
     """ISSUE-2 satellite: ~200 seeded trials of random arrivals, prompt
     lengths, pool sizes, and batch limits — every trial must drain with

@@ -361,6 +361,7 @@ def test_syncs_per_token_drops_4x_at_horizon_8():
 # ------------------------------------------------------------------ fuzz
 
 
+@pytest.mark.slow
 def test_fuzz_multistep_oracle_equivalence():
     """ISSUE 6 acceptance: 200 seeded trials of random horizons (1-8),
     pool sizes, budgets, stop tokens mid-horizon, immediate deadlines,
@@ -463,6 +464,7 @@ def test_fuzz_multistep_oracle_equivalence():
 # ------------------------------------------------------ real-model pin
 
 
+@pytest.mark.slow
 def test_real_llama_decode_multi_matches_naive():
     """End-to-end on the real jitted runner: GQA Llama, prefix cache,
     decode_horizon=8 — bit-exact vs the sequential oracle (the lax.scan

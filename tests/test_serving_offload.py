@@ -452,6 +452,7 @@ def test_snapshot_restore_roundtrips_tier_config_host_pages_die():
 # ---------------------------------------------------- int8 composition
 
 
+@pytest.mark.slow
 def test_int8_offload_resume_matches_int8_naive_oracle():
     """ISSUE-10 acceptance, int8 half: with monolithic prefill (no
     chunking, no prefix sharing) the int8 engine is token-exact vs the
@@ -488,6 +489,7 @@ def test_int8_offload_resume_matches_int8_naive_oracle():
     assert eng.pool.host_tier.used_count == 0
 
 
+@pytest.mark.slow
 def test_tp2_sharded_offload_spill_pagein_token_exact():
     """Offload composes with tensor parallelism (ISSUE 7): on a tp=2
     CPU mesh the spill gathers each shard's kv-head slice, the staging
@@ -527,6 +529,7 @@ def test_tp2_sharded_offload_spill_pagein_token_exact():
 # ------------------------------------------------------------------ fuzz
 
 
+@pytest.mark.slow
 def test_fuzz_spill_pagein_200_trials_token_exact_no_leaks():
     """ISSUE-10 satellite: 200 seeded trials of random pools, preemption
     storms, host-tier caps (tiny caps force drop-and-recompute), random
@@ -635,6 +638,7 @@ def test_fuzz_spill_pagein_200_trials_token_exact_no_leaks():
 # ------------------------------------------------------- bench child
 
 
+@pytest.mark.slow
 def test_bench_serving_kv_offload_child_cpu():
     """bench.py's kv_offload child commits the recompute-vs-pagein
     resume cost, the sessions uplift, and the copy-bandwidth microbench

@@ -464,6 +464,7 @@ def test_async_spill_readers_join_pending_copy():
 # ------------------------------------------------------------- the fuzz
 
 
+@pytest.mark.slow
 def test_fuzz_pipeline_oracle_equivalence():
     """200 trials: random horizons, prefill budgets, temperatures,
     prefix cache, offload tier (sync + threaded spill), early stop,

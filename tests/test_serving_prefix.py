@@ -310,6 +310,7 @@ def test_snapshot_drops_prefix_cache_and_restores_token_exact():
 # -------------------------------------------------------- jit-cache cap
 
 
+@pytest.mark.slow
 def test_jit_cache_buckets_chunks_and_honors_cap(monkeypatch):
     from paddle_tpu.models.gpt import GPT, GPTConfig
     from paddle_tpu.serving import GPTRunner
@@ -426,6 +427,7 @@ def test_bench_serving_shared_prefix_child_cpu():
 # ------------------------------------------------------------------ fuzz
 
 
+@pytest.mark.slow
 def test_fuzz_chunked_prefix_no_leaks_and_oracle_equivalence():
     """ISSUE-3 satellite: 200 seeded trials of random pools, arrivals,
     shared-prefix prompts, and chunk budgets — with the prefix cache and

@@ -399,6 +399,7 @@ def proc_env():
 
 
 class TestProcessRouter:
+    @pytest.mark.slow
     def test_cross_process_token_exact_greedy_and_seeded(self, proc_env):
         router = ServingRouter(
             STUB_SPEC, replicas=2, backend="process",

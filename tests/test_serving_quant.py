@@ -294,6 +294,7 @@ def _agreement(streams, oracle):
     return match / total
 
 
+@pytest.mark.slow
 def test_int8_kv_engine_greedy_agreement(int8_runner, prompts, fp32_oracle):
     """The tentpole accuracy gate: int8-KV engine streams agree with the
     fp32 oracle >= 99% greedy tokens on the real Llama config."""
@@ -347,6 +348,7 @@ def test_int8_kv_forced_ragged_kernel_engine(llama_model, prompts,
     assert _agreement(toks, fp32_oracle[:3]) >= 0.99
 
 
+@pytest.mark.slow
 def test_int8_weights_engine_agreement(llama_model, fp32_runner, prompts,
                                        fp32_oracle):
     """Weight-only int8 (per-output-channel scales, dequant in the

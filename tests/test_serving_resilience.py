@@ -162,6 +162,7 @@ def test_watermark_progress_guarantee():
 # -------------------------------------------------- transient-step faults
 
 
+@pytest.mark.slow
 def test_decode_fault_one_in_five_full_workload(llama_setup):
     """ISSUE-2 acceptance: FaultInjector raising on 1-in-5 decode calls, a
     16-request workload completes with zero page/slot leaks and every

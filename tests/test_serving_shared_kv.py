@@ -708,6 +708,7 @@ def test_recover_skips_corrupted_journaled_index_entries(tmp_path):
         r2.shutdown()
 
 
+@pytest.mark.slow
 def test_process_backend_store_handoff_zero_wire_bytes():
     """Process replicas share the store through shared memory: the
     prefill->decode handoff ships slot references (handoff_bytes_out
@@ -793,6 +794,7 @@ def test_bench_serving_shared_kv_child_cpu():
 # ----------------------------------------------------- 200-trial fuzz
 
 
+@pytest.mark.slow
 def test_fuzz_multi_replica_200_trials_token_exact_no_leaks():
     """200 randomized trials over two engines sharing one store:
     random workloads, tight pools (preemption spills), random

@@ -388,6 +388,7 @@ def test_fused_steps_and_syncs_acceptance_pin():
 # ------------------------------------------------------------------ fuzz
 
 
+@pytest.mark.slow
 def test_fuzz_spec_horizon_oracle_equivalence():
     """ISSUE-18 acceptance: 200 seeded trials composing speculation x
     decode_horizon x pipelined x horizon_sampling x early stop x prefix
@@ -504,6 +505,7 @@ def _real_work(rng, temps):
     return work
 
 
+@pytest.mark.slow
 def test_real_model_fused_vs_per_step_bit_exact(llama_runner):
     """The real jitted scan, greedy AND seeded temperature: the fused
     engine (pipelined, s=8, horizon sampling, early stop, prefix cache,
@@ -540,6 +542,7 @@ def test_real_model_fused_vs_per_step_bit_exact(llama_runner):
             llama_runner, p, sp, max_model_len=64), f"r{i}"
 
 
+@pytest.mark.slow
 def test_real_model_shadow_acceptance_rate_greedy(llama_runner):
     """All-greedy + a bit-identical fp32 shadow: acceptance should be
     near-total — the only rejections are drafts proposed past the
@@ -587,6 +590,7 @@ def test_shadow_string_spec_snapshot_round_trip(llama_runner):
                       spec_draft_model="what:ever")
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("kv_dtype", ["int8", "fp8"])
 def test_quantized_pools_fused_spec_deterministic(kv_dtype):
     """int8/fp8 KV pages under the fused verify-in-scan: the run is

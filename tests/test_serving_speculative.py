@@ -456,6 +456,7 @@ def test_sequence_kv_truncate_refuses_registered_pages():
 # ------------------------------------------------------ real-model pin
 
 
+@pytest.mark.slow
 def test_real_llama_speculative_matches_naive():
     """End-to-end on the real runner: GQA Llama, chunked prefill, prefix
     cache, fused ragged verify — bit-exact vs the sequential oracle."""
@@ -493,6 +494,7 @@ def test_real_llama_speculative_matches_naive():
 # ------------------------------------------------------------------ fuzz
 
 
+@pytest.mark.slow
 def test_fuzz_speculative_oracle_equivalence():
     """ISSUE-5 acceptance: 200 seeded trials of random pools, batches,
     chunk budgets, speculation depths, temperatures, prefix cache +

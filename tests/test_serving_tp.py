@@ -153,6 +153,7 @@ def _run_engine(runner, work, **kw):
     return eng, {rid: outs[rid].output_tokens for rid, _, _ in work}
 
 
+@pytest.mark.slow
 def test_llama_token_exact_tp_sweep(llama_model):
     """THE acceptance pins in one sweep: tp in {1, 2, 4} engines on the
     CPU mesh are token-for-token the single-device engine (and the
@@ -191,6 +192,7 @@ def test_llama_token_exact_tp_sweep(llama_model):
             == pytest.approx(base_bytes / tp)
 
 
+@pytest.mark.slow
 def test_gpt_token_exact_and_vocab_sharded(gpt_model):
     """GPT at tp=2 (data=2 x model=2 sub-mesh — the data axis carries
     replicas, serving state is replicated over it): token-exact, with

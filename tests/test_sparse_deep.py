@@ -50,6 +50,7 @@ def test_pow_cast_coalesce():
                                [[0, 3.0], [0, 0]])
 
 
+@pytest.mark.slow
 def test_binary_ops():
     x, dx = _rand_coo((5, 6), seed=1)
     y, dy = _rand_coo((5, 6), seed=2)

@@ -2,6 +2,7 @@
 (reference: test/legacy_test/test_vision_models.py, hapi tests)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
@@ -44,6 +45,7 @@ def test_cifar_synthetic():
     assert len(ds) == 1000
 
 
+@pytest.mark.slow
 def test_resnet18_forward():
     paddle.seed(0)
     net = resnet18(num_classes=10)

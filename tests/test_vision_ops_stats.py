@@ -61,6 +61,7 @@ def test_roi_align_constant_and_ramp():
     np.testing.assert_allclose(got, [1.0, 3.0, 5.0, 6.875], atol=0.15)
 
 
+@pytest.mark.slow
 def test_roi_align_grad():
     x = paddle.to_tensor(rng.standard_normal((1, 2, 8, 8)).astype(np.float32),
                          stop_gradient=False)
@@ -88,6 +89,7 @@ def test_statistics_ops():
     assert b.numpy().tolist() == [1, 3]
 
 
+@pytest.mark.slow
 def test_mobilenet_v2():
     from paddle_tpu.vision import mobilenet_v2
 

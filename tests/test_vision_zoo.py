@@ -18,17 +18,17 @@ def _img(n=1, size=96):
 
 
 @pytest.mark.parametrize("ctor,kw", [
-    ("alexnet", {}),
-    ("squeezenet1_1", {}),
+    pytest.param("alexnet", {}, marks=pytest.mark.slow),
+    pytest.param("squeezenet1_1", {}, marks=pytest.mark.slow),
     # the two heaviest zoo builds (~20s + ~15s compile-bound) ride the
     # slow suite to keep tier-1 inside its 870s budget — same move as
     # the auto_tuner grid test; coverage is unchanged, just re-tiered
     pytest.param("densenet121", {}, marks=pytest.mark.slow),
     pytest.param("googlenet", {}, marks=pytest.mark.slow),
-    ("inception_v3", {}),
-    ("shufflenet_v2_x1_0", {}),
-    ("mobilenet_v1", {"scale": 0.5}),
-    ("mobilenet_v3_small", {}),
+    pytest.param("inception_v3", {}, marks=pytest.mark.slow),
+    pytest.param("shufflenet_v2_x1_0", {}, marks=pytest.mark.slow),
+    pytest.param("mobilenet_v1", {"scale": 0.5}, marks=pytest.mark.slow),
+    pytest.param("mobilenet_v3_small", {}, marks=pytest.mark.slow),
 ])
 def test_zoo_forward_shapes(ctor, kw):
     paddle.seed(0)
@@ -39,6 +39,7 @@ def test_zoo_forward_shapes(ctor, kw):
     assert np.isfinite(np.asarray(out._value)).all()
 
 
+@pytest.mark.slow
 def test_mobilenet_v3_large_and_densenet_variant():
     paddle.seed(0)
     m = models.mobilenet_v3_large(num_classes=7)
@@ -46,6 +47,7 @@ def test_mobilenet_v3_large_and_densenet_variant():
     assert tuple(m(_img()).shape) == (1, 7)
 
 
+@pytest.mark.slow
 def test_zoo_trains_one_step():
     paddle.seed(0)
     m = models.mobilenet_v1(scale=0.25, num_classes=4)
@@ -59,6 +61,7 @@ def test_zoo_trains_one_step():
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_scale_params_actually_scale():
     n_small = sum(p.size for p in
                   models.mobilenet_v3_small(num_classes=10,

@@ -167,6 +167,7 @@ def test_zbvpp_loss_and_grads_match_autodiff(mesh_pp4):
                                atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_zbvpp_device_layout_matches_layer_layout(mesh_pp2):
     """layout='device' with a pre-permuted stack gives identical results
     to layout='layer' (and grads come back in the matching order)."""

@@ -217,9 +217,10 @@ bounds page over-provision by the launch's recorded per-row funding,
 and drain-failure recovery reruns the horizon synchronously —
 token-exactness holds because rejected drafts never change the
 emitted stream. `--spec-adaptive-k` arms the per-request EWMA draft
--length controller; `--spec-draft shadow[:int8|fp32]` swaps the n-gram
-proposer for the model-based draft rung (a quantized shadow of the
-target proposing via its own paged pool). The canonical drill:
+-length controller; `--spec-draft shadow[:int8|int4|fp8|fp32]` swaps
+the n-gram proposer for the model-based draft rung (a weight-quantized
+shadow of the target proposing via its own paged pool — int4 packs the
+shadow to nibbles + group scales, ISSUE 19). The canonical drill:
 
     JAX_PLATFORMS=cpu python tools/fault_smoke.py --speculate \
         --pipelined --decode-horizon 4 --tp 2
@@ -365,7 +366,8 @@ def run_class(fault: str, runner, args) -> dict:
     # rungs (fp8 KV: per-element casts; int8 psum: per-row chunk
     # scales) are BATCH-SHAPE INVARIANT, so they stay on the naive
     # oracle — token-exact against the engine's own quantized runner
-    quantized = (args.kv_dtype == "int8" or args.weight_dtype == "int8")
+    quantized = (args.kv_dtype == "int8"
+                 or args.weight_dtype in ("int8", "int4"))
     if fault in ("none", "device_error", "preempt_storm"):
         if quantized:
             # int8 pools: chunked prefill legitimately changes int8
@@ -1206,10 +1208,10 @@ def main() -> int:
                     help="ISSUE 18: acceptance-rate-adaptive per-request "
                          "draft length (EWMA, clamped to [0, K])")
     ap.add_argument("--spec-draft", default=None,
-                    metavar="shadow[:int8|fp32]",
-                    help="ISSUE 18: model-based draft rung — replace the "
-                         "n-gram proposer with a quantized shadow of the "
-                         "target model (default: n-gram)")
+                    metavar="shadow[:int8|int4|fp8|fp32]",
+                    help="ISSUE 18/19: model-based draft rung — replace "
+                         "the n-gram proposer with a weight-quantized "
+                         "shadow of the target model (default: n-gram)")
     ap.add_argument("--shared-kv", type=int, nargs="?", const=64,
                     default=0, metavar="N",
                     help="ISSUE 14: cluster-wide KV drill — 2 thread "
@@ -1279,10 +1281,16 @@ def main() -> int:
                          "= fp32 storage serving per-request fp8 tenants "
                          "(default fp32)")
     ap.add_argument("--weight-dtype", default="fp32",
-                    choices=("fp32", "int8"),
-                    help="matmul weight storage (ISSUE 9): weight-only "
-                         "int8 with per-output-channel scales, dequant "
-                         "in the matmul epilogue (default fp32)")
+                    choices=("fp32", "int8", "int4", "fp8"),
+                    help="matmul weight storage (ISSUE 9/19): int8 = "
+                         "per-output-channel scales; int4 = packed "
+                         "nibble codes + group-wise scales; fp8 = "
+                         "native float8 casts — dequant always in the "
+                         "matmul epilogue (default fp32)")
+    ap.add_argument("--weight-group-size", type=int, default=128,
+                    metavar="G",
+                    help="int4 reduction rows per group scale "
+                         "(ISSUE 19; default 128)")
     ap.add_argument("--comm-dtype", default="fp32",
                     choices=("fp32", "int8"),
                     help="row-parallel allreduce wire precision (ISSUE "
@@ -1328,14 +1336,16 @@ def main() -> int:
                          max_model_len=args.max_model_len,
                          attn_impl=args.attn_impl,
                          kv_dtype=args.kv_dtype,
-                         weight_dtype=args.weight_dtype)
+                         weight_dtype=args.weight_dtype,
+                         weight_group_size=args.weight_group_size)
     if args.tp > 1:
         from paddle_tpu.parallel.mesh import serving_mesh
 
         runner.shard(serving_mesh(data=1, model=args.tp),
                      comm_dtype=args.comm_dtype)
-    if args.comm_dtype != "fp32" or args.kv_dtype in ("fp8", "mixed"):
-        # the ISSUE 15 accuracy gate's fp32 twin: an UNSHARDED fp32
+    if (args.comm_dtype != "fp32" or args.kv_dtype in ("fp8", "mixed")
+            or args.weight_dtype in ("int4", "fp8")):
+        # the ISSUE 15/19 accuracy gate's fp32 twin: an UNSHARDED fp32
         # runner of the same weights (the fp32 tp engine is pinned
         # bit-exact to it, so this is the same oracle, compile-cheaper)
         args.fp32_twin_runner = LlamaRunner(
